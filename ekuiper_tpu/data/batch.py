@@ -1,0 +1,229 @@
+"""Columnar micro-batch — the TPU-native data representation.
+
+The reference's experimental SliceTuple (internal/xsql/slice_tuple.go:25,
+planner index assignment planner.go:88-165) replaces map rows with
+index-addressed slices; this module completes that direction: runs of events
+become a struct-of-arrays ColumnBatch whose numeric columns upload to device
+HBM as jnp arrays, so window/aggregate kernels run vectorized on the VPU/MXU
+instead of per-row interpreter walks (the hot loop at internal/xsql/valuer.go:289).
+
+String columns stay host-side; GROUP BY keys are dictionary-encoded to int32
+slot ids by the key table (ops/keytable.py) before device upload.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .rows import Tuple
+from .types import DataType, Schema, np_dtype
+
+
+@dataclass
+class ColumnBatch:
+    """Struct-of-arrays batch. All columns have equal length `n`.
+
+    - numeric columns: np.float32 / np.int64 / np.bool_
+    - host columns (strings, arrays, structs, schemaless): dtype=object
+    - `valid[name]`: optional bool mask (absent = all valid)
+    - `timestamps`: int64 ms (event time when configured, else ingest time)
+    """
+
+    n: int
+    columns: Dict[str, np.ndarray] = field(default_factory=dict)
+    valid: Dict[str, np.ndarray] = field(default_factory=dict)
+    timestamps: Optional[np.ndarray] = None
+    emitter: str = ""
+
+    def __len__(self) -> int:
+        return self.n
+
+    def names(self) -> List[str]:
+        return list(self.columns.keys())
+
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def is_valid(self, name: str) -> np.ndarray:
+        v = self.valid.get(name)
+        if v is None:
+            return np.ones(self.n, dtype=np.bool_)
+        return v
+
+    def numeric_names(self) -> List[str]:
+        return [k for k, v in self.columns.items() if v.dtype != np.object_]
+
+    def select(self, mask: np.ndarray) -> "ColumnBatch":
+        idx = np.nonzero(mask)[0]
+        return self.take(idx)
+
+    def take(self, idx: np.ndarray) -> "ColumnBatch":
+        return ColumnBatch(
+            n=len(idx),
+            columns={k: v[idx] for k, v in self.columns.items()},
+            valid={k: v[idx] for k, v in self.valid.items()},
+            timestamps=None if self.timestamps is None else self.timestamps[idx],
+            emitter=self.emitter,
+        )
+
+    def to_tuples(self) -> List[Tuple]:
+        """Back to row objects (sink/interpreter path)."""
+        out: List[Tuple] = []
+        names = self.names()
+        cols = [self.columns[k] for k in names]
+        valids = [self.valid.get(k) for k in names]
+        ts = self.timestamps
+        for i in range(self.n):
+            msg: Dict[str, Any] = {}
+            for name, col, v in zip(names, cols, valids):
+                if v is not None and not v[i]:
+                    continue
+                val = col[i]
+                if isinstance(val, np.generic):
+                    val = val.item()
+                msg[name] = val
+            out.append(
+                Tuple(
+                    emitter=self.emitter,
+                    message=msg,
+                    timestamp=int(ts[i]) if ts is not None else 0,
+                )
+            )
+        return out
+
+    @staticmethod
+    def concat(batches: Sequence["ColumnBatch"]) -> "ColumnBatch":
+        batches = [b for b in batches if b.n > 0]
+        if not batches:
+            return ColumnBatch(n=0)
+        if len(batches) == 1:
+            return batches[0]
+        names: List[str] = []
+        for b in batches:
+            for k in b.columns:
+                if k not in names:
+                    names.append(k)
+        n_total = sum(b.n for b in batches)
+        columns: Dict[str, np.ndarray] = {}
+        valid: Dict[str, np.ndarray] = {}
+        for name in names:
+            parts, vparts, need_valid = [], [], False
+            for b in batches:
+                col = b.columns.get(name)
+                if col is None:
+                    dtype = np.object_
+                    for ob in batches:
+                        if name in ob.columns:
+                            dtype = ob.columns[name].dtype
+                            break
+                    col = np.zeros(b.n, dtype=dtype)
+                    vp = np.zeros(b.n, dtype=np.bool_)
+                    need_valid = True
+                else:
+                    vp = b.valid.get(name)
+                    if vp is None:
+                        vp = np.ones(b.n, dtype=np.bool_)
+                    else:
+                        need_valid = need_valid or not vp.all()
+                parts.append(col)
+                vparts.append(vp)
+            columns[name] = np.concatenate(parts)
+            if need_valid:
+                valid[name] = np.concatenate(vparts)
+        ts = None
+        if all(b.timestamps is not None for b in batches):
+            ts = np.concatenate([b.timestamps for b in batches])
+        return ColumnBatch(
+            n=n_total, columns=columns, valid=valid, timestamps=ts,
+            emitter=batches[0].emitter,
+        )
+
+
+def from_tuples(
+    tuples: Sequence[Tuple], schema: Optional[Schema] = None, emitter: str = ""
+) -> ColumnBatch:
+    """Columnarize a run of rows. With a schema, columns get typed numpy
+    dtypes; schemaless columns are inferred from observed python types
+    (promoted to object on conflict)."""
+    n = len(tuples)
+    if n == 0:
+        return ColumnBatch(n=0, emitter=emitter)
+
+    names: List[str] = []
+    declared: Dict[str, Any] = {}
+    if schema is not None and not schema.schemaless:
+        for f in schema.fields:
+            names.append(f.name)
+            declared[f.name] = np_dtype(f.type)
+    else:
+        seen = set()
+        for t in tuples:
+            for k in t.message:
+                if k not in seen:
+                    seen.add(k)
+                    names.append(k)
+
+    columns: Dict[str, np.ndarray] = {}
+    valid: Dict[str, np.ndarray] = {}
+    for name in names:
+        raw = [t.message.get(name) for t in tuples]
+        mask = np.array([r is not None for r in raw], dtype=np.bool_)
+        dtype = declared.get(name)
+        if dtype is None:
+            dtype = _infer_dtype(raw, mask)
+        if dtype == np.object_:
+            col = np.empty(n, dtype=np.object_)
+            col[:] = raw
+        else:
+            col = np.zeros(n, dtype=dtype)
+            if mask.all():
+                try:
+                    col[:] = raw
+                except (ValueError, TypeError, OverflowError):
+                    col = np.empty(n, dtype=np.object_)
+                    col[:] = raw
+                    dtype = np.object_
+            else:
+                for i, r in enumerate(raw):
+                    if mask[i]:
+                        try:
+                            col[i] = r
+                        except (ValueError, TypeError, OverflowError):
+                            mask[i] = False
+                if dtype == np.float32:
+                    col[~mask] = np.nan
+        columns[name] = col
+        if not mask.all():
+            valid[name] = mask
+
+    ts = np.fromiter((t.timestamp for t in tuples), dtype=np.int64, count=n)
+    return ColumnBatch(n=n, columns=columns, valid=valid, timestamps=ts, emitter=emitter)
+
+
+def _infer_dtype(raw: List[Any], mask: np.ndarray):
+    saw_float = saw_int = saw_bool = saw_other = False
+    for r, ok in zip(raw, mask):
+        if not ok:
+            continue
+        if isinstance(r, bool):
+            saw_bool = True
+        elif isinstance(r, int):
+            saw_int = True
+        elif isinstance(r, float):
+            saw_float = True
+        else:
+            saw_other = True
+    if saw_other:
+        return np.object_
+    if saw_bool and (saw_int or saw_float):
+        # don't silently coerce True/False into 1/1.0 — keep originals
+        return np.object_
+    if saw_float:
+        return np.float32
+    if saw_int:
+        return np.int64
+    if saw_bool:
+        return np.bool_
+    return np.object_

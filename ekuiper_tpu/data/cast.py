@@ -1,0 +1,192 @@
+"""Type coercion — analogue of eKuiper's pkg/cast/cast.go (1234 LoC).
+
+The reference coerces arbitrary decoded JSON values to schema types with two
+strictness levels (STRICT vs CONVERT_ALL); the preprocessor op applies it per
+field (reference: internal/topo/operator/preprocessor.go). We mirror the
+semantics that matter for SQL behavior: numeric cross-casts, string parsing,
+bool ints, datetime from ISO strings / epoch numbers.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, List, Optional
+
+from .types import DataType, Field
+
+STRICT = "strict"
+CONVERT_ALL = "convert_all"
+
+
+class CastError(ValueError):
+    pass
+
+
+def to_int(v: Any, strict: str = CONVERT_ALL) -> int:
+    if isinstance(v, bool):
+        if strict == STRICT:
+            raise CastError(f"cannot cast bool {v} to bigint strictly")
+        return 1 if v else 0
+    if isinstance(v, int):
+        return v
+    if isinstance(v, float):
+        if strict == STRICT and not float(v).is_integer():
+            raise CastError(f"cannot cast float {v} to bigint strictly")
+        return int(v)
+    if isinstance(v, str) and strict != STRICT:
+        try:
+            return int(float(v)) if ("." in v or "e" in v.lower()) else int(v)
+        except ValueError as e:
+            raise CastError(f"cannot cast string {v!r} to bigint") from e
+    raise CastError(f"cannot cast {type(v).__name__} {v!r} to bigint")
+
+
+def to_float(v: Any, strict: str = CONVERT_ALL) -> float:
+    if isinstance(v, bool):
+        if strict == STRICT:
+            raise CastError(f"cannot cast bool {v} to float strictly")
+        return 1.0 if v else 0.0
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, str) and strict != STRICT:
+        try:
+            return float(v)
+        except ValueError as e:
+            raise CastError(f"cannot cast string {v!r} to float") from e
+    raise CastError(f"cannot cast {type(v).__name__} {v!r} to float")
+
+
+def to_bool(v: Any, strict: str = CONVERT_ALL) -> bool:
+    if isinstance(v, bool):
+        return v
+    if strict != STRICT:
+        if isinstance(v, (int, float)) and v in (0, 1):
+            return bool(v)
+        if isinstance(v, str):
+            low = v.lower()
+            if low in ("true", "1"):
+                return True
+            if low in ("false", "0"):
+                return False
+    raise CastError(f"cannot cast {type(v).__name__} {v!r} to boolean")
+
+
+def to_string(v: Any, strict: str = CONVERT_ALL) -> str:
+    if isinstance(v, str):
+        return v
+    if strict == STRICT:
+        raise CastError(f"cannot cast {type(v).__name__} {v!r} to string strictly")
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, bytes):
+        return v.decode("utf-8", errors="replace")
+    if isinstance(v, float) and float(v).is_integer():
+        return str(int(v))
+    return str(v)
+
+
+def to_bytes(v: Any, strict: str = CONVERT_ALL) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str) and strict != STRICT:
+        return v.encode("utf-8")
+    raise CastError(f"cannot cast {type(v).__name__} {v!r} to bytea")
+
+
+_ISO_FORMATS = (
+    "%Y-%m-%dT%H:%M:%S.%f%z",
+    "%Y-%m-%dT%H:%M:%S%z",
+    "%Y-%m-%dT%H:%M:%S.%fZ",
+    "%Y-%m-%dT%H:%M:%SZ",
+    "%Y-%m-%dT%H:%M:%S.%f",
+    "%Y-%m-%dT%H:%M:%S",
+    "%Y-%m-%d %H:%M:%S.%f",
+    "%Y-%m-%d %H:%M:%S",
+    "%Y-%m-%d",
+)
+
+
+def to_datetime_ms(v: Any, strict: str = CONVERT_ALL) -> int:
+    """Coerce to epoch milliseconds (the engine-wide time representation)."""
+    if isinstance(v, bool):
+        raise CastError("cannot cast bool to datetime")
+    if isinstance(v, (int, float)):
+        return int(v)
+    if isinstance(v, _dt.datetime):
+        if v.tzinfo is None:
+            v = v.replace(tzinfo=_dt.timezone.utc)
+        return int(v.timestamp() * 1000)
+    if isinstance(v, str):
+        for fmt in _ISO_FORMATS:
+            try:
+                parsed = _dt.datetime.strptime(v, fmt)
+                if parsed.tzinfo is None:
+                    parsed = parsed.replace(tzinfo=_dt.timezone.utc)
+                return int(parsed.timestamp() * 1000)
+            except ValueError:
+                continue
+        try:
+            return int(float(v))
+        except ValueError:
+            pass
+    raise CastError(f"cannot cast {type(v).__name__} {v!r} to datetime")
+
+
+def to_typed(v: Any, f: Field, strict: str = CONVERT_ALL) -> Any:
+    """Coerce a decoded value to a schema field's type."""
+    if v is None:
+        return None
+    t = f.type
+    if t in (DataType.UNKNOWN,):
+        return v
+    if t == DataType.BIGINT:
+        return to_int(v, strict)
+    if t == DataType.FLOAT:
+        return to_float(v, strict)
+    if t == DataType.STRING:
+        return to_string(v, strict)
+    if t == DataType.BOOLEAN:
+        return to_bool(v, strict)
+    if t == DataType.DATETIME:
+        return to_datetime_ms(v, strict)
+    if t == DataType.BYTEA:
+        return to_bytes(v, strict)
+    if t == DataType.ARRAY:
+        if not isinstance(v, (list, tuple)):
+            raise CastError(f"cannot cast {type(v).__name__} to array")
+        if f.elem_type is not None and f.elem_type != DataType.UNKNOWN:
+            elem_field = Field(name=f.name, type=f.elem_type)
+            return [to_typed(x, elem_field, strict) for x in v]
+        return list(v)
+    if t == DataType.STRUCT:
+        if not isinstance(v, dict):
+            raise CastError(f"cannot cast {type(v).__name__} to struct")
+        if f.fields:
+            out = {}
+            for sub in f.fields:
+                if sub.name in v:
+                    out[sub.name] = to_typed(v[sub.name], sub, strict)
+            return out
+        return dict(v)
+    raise CastError(f"unknown target type {t}")
+
+
+def compare(a: Any, b: Any) -> Optional[int]:
+    """Three-way compare with eKuiper-style cross-type numeric comparison.
+    Returns None for incomparable (NULL-ish) pairs."""
+    if a is None or b is None:
+        return None
+    if isinstance(a, bool) or isinstance(b, bool):
+        if isinstance(a, bool) and isinstance(b, bool):
+            return (a > b) - (a < b)
+        return None
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return (a > b) - (a < b)
+    if isinstance(a, str) and isinstance(b, str):
+        return (a > b) - (a < b)
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        for x, y in zip(a, b):
+            c = compare(x, y)
+            if c is None or c != 0:
+                return c
+        return (len(a) > len(b)) - (len(a) < len(b))
+    return None

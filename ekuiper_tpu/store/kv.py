@@ -1,0 +1,200 @@
+"""KV storage — analogue of eKuiper's internal/pkg/store (sqlite default,
+memory for tests; reference: internal/pkg/store/, pkg/kv).
+
+Namespaced key→value tables (JSON-serialized values) over sqlite or an
+in-memory dict. Used for stream/table/rule definitions, rule state/checkpoints,
+keyed state and schema registry — same division of labor as the reference.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+
+class KV:
+    """One namespace (table) of the store."""
+
+    def set(self, key: str, value: Any) -> None:
+        raise NotImplementedError
+
+    def setnx(self, key: str, value: Any) -> bool:
+        raise NotImplementedError
+
+    def get_ok(self, key: str) -> Tuple[Any, bool]:
+        """(value, found) — mirrors the reference kv.Get so a stored null is
+        distinguishable from an absent key."""
+        raise NotImplementedError
+
+    def get(self, key: str) -> Optional[Any]:
+        v, _ = self.get_ok(key)
+        return v
+
+    def delete(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def keys(self) -> List[str]:
+        raise NotImplementedError
+
+    def items(self) -> Iterator[Tuple[str, Any]]:
+        for k in self.keys():
+            v, ok = self.get_ok(k)
+            if ok:
+                yield k, v
+
+    def clean(self) -> None:
+        for k in self.keys():
+            self.delete(k)
+
+
+class MemoryKV(KV):
+    def __init__(self) -> None:
+        self._data: Dict[str, str] = {}
+        self._lock = threading.RLock()
+
+    def set(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._data[key] = json.dumps(value)
+
+    def setnx(self, key: str, value: Any) -> bool:
+        with self._lock:
+            if key in self._data:
+                return False
+            self._data[key] = json.dumps(value)
+            return True
+
+    def get_ok(self, key: str) -> Tuple[Any, bool]:
+        with self._lock:
+            if key not in self._data:
+                return None, False
+            return json.loads(self._data[key]), True
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            if key not in self._data:
+                return False
+            del self._data[key]
+            return True
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._data.keys())
+
+
+class SqliteKV(KV):
+    def __init__(self, conn: sqlite3.Connection, lock: threading.RLock, table: str) -> None:
+        self._conn = conn
+        self._lock = lock
+        # namespace strings may start with digits or contain punctuation
+        # (rule ids appear in checkpoint namespaces) — sanitize AND prefix so
+        # the identifier is always valid unquoted SQL
+        self._table = "ns_" + "".join(
+            c if c.isalnum() or c == "_" else "_" for c in table
+        )
+        with self._lock:
+            self._conn.execute(
+                f"CREATE TABLE IF NOT EXISTS {self._table} (k TEXT PRIMARY KEY, v TEXT)"
+            )
+            self._conn.commit()
+
+    def set(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._conn.execute(
+                f"INSERT OR REPLACE INTO {self._table} (k, v) VALUES (?, ?)",
+                (key, json.dumps(value)),
+            )
+            self._conn.commit()
+
+    def setnx(self, key: str, value: Any) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                f"INSERT OR IGNORE INTO {self._table} (k, v) VALUES (?, ?)",
+                (key, json.dumps(value)),
+            )
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    def get_ok(self, key: str) -> Tuple[Any, bool]:
+        with self._lock:
+            cur = self._conn.execute(
+                f"SELECT v FROM {self._table} WHERE k = ?", (key,)
+            )
+            row = cur.fetchone()
+            return (None, False) if row is None else (json.loads(row[0]), True)
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                f"DELETE FROM {self._table} WHERE k = ?", (key,)
+            )
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            cur = self._conn.execute(f"SELECT k FROM {self._table}")
+            return [r[0] for r in cur.fetchall()]
+
+
+class Store:
+    """Store root: hands out namespaced KV tables
+    (analogue of store.SetupWithConfig, internal/server/server.go:183)."""
+
+    def __init__(self, kind: str = "memory", path: str = "data") -> None:
+        self.kind = kind
+        self._lock = threading.RLock()
+        self._namespaces: Dict[str, KV] = {}
+        self._conn: Optional[sqlite3.Connection] = None
+        if kind == "sqlite":
+            os.makedirs(path, exist_ok=True)
+            self._conn = sqlite3.connect(
+                os.path.join(path, "ekuiper_tpu.db"), check_same_thread=False
+            )
+        elif kind != "memory":
+            raise ValueError(f"unknown store kind {kind!r} (want sqlite|memory)")
+
+    def kv(self, namespace: str) -> KV:
+        with self._lock:
+            kv = self._namespaces.get(namespace)
+            if kv is None:
+                if self._conn is not None:
+                    kv = SqliteKV(self._conn, self._lock, namespace)
+                else:
+                    kv = MemoryKV()
+                self._namespaces[namespace] = kv
+            return kv
+
+    def drop(self, namespace: str) -> None:
+        with self._lock:
+            # materialize first so sqlite-persisted data from a previous
+            # process is actually deleted, not just the in-memory handle
+            kv = self._namespaces.pop(namespace, None) or self.kv(namespace)
+            self._namespaces.pop(namespace, None)
+            kv.clean()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+
+_store: Optional[Store] = None
+_store_lock = threading.Lock()
+
+
+def setup(kind: str = "memory", path: str = "data") -> Store:
+    global _store
+    with _store_lock:
+        _store = Store(kind, path)
+        return _store
+
+
+def get_store() -> Store:
+    global _store
+    with _store_lock:
+        if _store is None:
+            _store = Store("memory")
+        return _store

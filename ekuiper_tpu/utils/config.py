@@ -1,0 +1,152 @@
+"""Engine configuration — analogue of eKuiper's etc/kuiper.yaml → model.KuiperConf
+(reference: pkg/model/conf.go:28, internal/conf/env_manager.go).
+
+Sections mirror the reference: basic / rule / sink / source / store / portable.
+Values can be overridden by environment variables of the form
+EKUIPER_TPU__<SECTION>__<KEY> (double underscore separators), mirroring the
+reference's env overlay scheme.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+ENV_PREFIX = "EKUIPER_TPU__"
+
+
+@dataclass
+class RuleOptionConfig:
+    """Default per-rule options (reference: internal/pkg/def/rule.go:27-49)."""
+
+    debug: bool = False
+    log_filename: str = ""
+    is_event_time: bool = False
+    late_tolerance_ms: int = 1000
+    concurrency: int = 1
+    buffer_length: int = 1024
+    send_error: bool = True
+    qos: int = 0  # 0 AtMostOnce, 1 AtLeastOnce, 2 ExactlyOnce
+    checkpoint_interval_ms: int = 300_000
+    restart_attempts: int = 0  # 0 = no restart; -1 = infinite
+    restart_delay_ms: int = 1000
+    restart_multiplier: float = 2.0
+    restart_max_delay_ms: int = 30_000
+    restart_jitter_factor: float = 0.1
+    disable_buffer_full_discard: bool = False
+    # TPU execution options
+    micro_batch_rows: int = 4096
+    micro_batch_linger_ms: int = 10
+    key_slots: int = 16384  # group-by hash-slot table size per rule
+    use_device_kernel: bool = True  # fuse window+agg into a jitted kernel when possible
+
+
+@dataclass
+class StoreConfig:
+    type: str = "sqlite"  # sqlite | memory
+    path: str = "data"
+
+
+@dataclass
+class BasicConfig:
+    log_level: str = "info"
+    rest_port: int = 9081
+    rest_ip: str = "0.0.0.0"
+    prometheus: bool = False
+    prometheus_port: int = 20499
+    ignore_case: bool = False
+    time_zone: str = "UTC"
+
+
+@dataclass
+class SinkConfig:
+    mem_cache_threshold: int = 1024
+    max_disk_cache: int = 1024000
+    buffer_page_size: int = 256
+    resend_interval_ms: int = 0
+    clean_cache_at_stop: bool = False
+
+
+@dataclass
+class SourceConfig:
+    http_server_ip: str = "0.0.0.0"
+    http_server_port: int = 10081
+
+
+@dataclass
+class PortableConfig:
+    python_bin: str = "python"
+    init_timeout_ms: int = 5000
+
+
+@dataclass
+class Config:
+    basic: BasicConfig = field(default_factory=BasicConfig)
+    rule: RuleOptionConfig = field(default_factory=RuleOptionConfig)
+    store: StoreConfig = field(default_factory=StoreConfig)
+    sink: SinkConfig = field(default_factory=SinkConfig)
+    source: SourceConfig = field(default_factory=SourceConfig)
+    portable: PortableConfig = field(default_factory=PortableConfig)
+    data_dir: str = "data"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _coerce(value: str, target_type: type) -> Any:
+    if target_type is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if target_type is int:
+        return int(value)
+    if target_type is float:
+        return float(value)
+    return value
+
+
+def _apply_env(cfg: Config) -> None:
+    for key, value in os.environ.items():
+        if not key.startswith(ENV_PREFIX):
+            continue
+        parts = key[len(ENV_PREFIX):].lower().split("__")
+        if len(parts) != 2:
+            continue
+        section, name = parts
+        sec = getattr(cfg, section, None)
+        if sec is None or not hasattr(sec, name):
+            continue
+        current = getattr(sec, name)
+        setattr(sec, name, _coerce(value, type(current)))
+
+
+def load_config(path: Optional[str] = None) -> Config:
+    """Load config from a JSON file (if given/exists) then apply env overrides."""
+    cfg = Config()
+    if path and os.path.exists(path):
+        with open(path) as f:
+            raw = json.load(f)
+        for section, values in raw.items():
+            sec = getattr(cfg, section, None)
+            if sec is None or not dataclasses.is_dataclass(sec):
+                continue
+            for k, v in values.items():
+                if hasattr(sec, k):
+                    setattr(sec, k, v)
+    _apply_env(cfg)
+    return cfg
+
+
+_global: Optional[Config] = None
+
+
+def get_config() -> Config:
+    global _global
+    if _global is None:
+        _global = load_config(os.environ.get("EKUIPER_TPU_CONFIG"))
+    return _global
+
+
+def set_config(cfg: Config) -> None:
+    global _global
+    _global = cfg

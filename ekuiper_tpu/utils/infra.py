@@ -1,0 +1,49 @@
+"""Panic isolation & error draining — analogue of eKuiper's pkg/infra/saferun.go.
+
+Every runtime-node thread body is wrapped in `safe_run` so a bug in one
+operator never takes down the process; the error is recovered and forwarded to
+the rule's drain channel, exactly like infra.SafeRun / infra.DrainError
+(reference: pkg/infra/saferun.go:34,57).
+"""
+from __future__ import annotations
+
+import logging
+import traceback
+from typing import Callable, Optional
+
+logger = logging.getLogger("ekuiper_tpu")
+
+
+class EngineError(Exception):
+    """Base class for engine errors."""
+
+
+class ParseError(EngineError):
+    pass
+
+
+class PlanError(EngineError):
+    pass
+
+
+class RuntimeError_(EngineError):
+    pass
+
+
+def safe_run(fn: Callable[[], Optional[BaseException]]) -> Optional[BaseException]:
+    """Run fn, converting any raised exception into a returned error."""
+    try:
+        return fn()
+    except BaseException as exc:  # noqa: BLE001 - this is the recover point
+        logger.debug("safe_run recovered: %s\n%s", exc, traceback.format_exc())
+        return exc
+
+
+def drain_error(err: Optional[BaseException], errq) -> None:
+    """Forward err to an error queue without blocking if it is full."""
+    if err is None:
+        return
+    try:
+        errq.put_nowait(err)
+    except Exception:  # queue full — an error is already being handled
+        pass

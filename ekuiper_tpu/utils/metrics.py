@@ -1,0 +1,104 @@
+"""Per-node metrics — analogue of eKuiper's StatManager
+(reference: internal/topo/node/metric/stats_manager.go:43-213).
+
+Each runtime node owns a StatManager recording records in/out/error, process
+latency, buffer length and last-invocation/exception info; a rule's status JSON
+aggregates them per node, matching the reference's /rules/{name}/status shape.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from . import timex
+
+
+class StatManager:
+    METRIC_NAMES = (
+        "records_in_total",
+        "records_out_total",
+        "messages_processed_total",
+        "process_latency_us",
+        "buffer_length",
+        "last_invocation",
+        "exceptions_total",
+        "last_exception",
+        "last_exception_time",
+    )
+
+    def __init__(self, op_type: str, op_id: str, instance: int = 0) -> None:
+        self.op_type = op_type
+        self.op_id = op_id
+        self.instance = instance
+        self._lock = threading.Lock()
+        self.records_in = 0
+        self.records_out = 0
+        self.messages_processed = 0
+        self.exceptions = 0
+        self.last_exception: str = ""
+        self.last_exception_time: int = 0
+        self.last_invocation: int = 0
+        self.process_latency_us: int = 0
+        self.buffer_length: int = 0
+        self._started_at: Optional[int] = None
+
+    def inc_in(self, n: int = 1) -> None:
+        with self._lock:
+            self.records_in += n
+            self.last_invocation = timex.now_ms()
+
+    def inc_out(self, n: int = 1) -> None:
+        with self._lock:
+            self.records_out += n
+
+    def inc_processed(self, n: int = 1) -> None:
+        with self._lock:
+            self.messages_processed += n
+
+    def inc_exception(self, err: str) -> None:
+        with self._lock:
+            self.exceptions += 1
+            self.last_exception = err
+            self.last_exception_time = timex.now_ms()
+
+    def process_begin(self) -> None:
+        self._started_at = timex.now_ms()
+
+    def process_end(self) -> None:
+        if self._started_at is not None:
+            with self._lock:
+                self.process_latency_us = (timex.now_ms() - self._started_at) * 1000
+            self._started_at = None
+
+    def set_buffer_length(self, n: int) -> None:
+        with self._lock:
+            self.buffer_length = n
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "records_in_total": self.records_in,
+                "records_out_total": self.records_out,
+                "messages_processed_total": self.messages_processed,
+                "process_latency_us": self.process_latency_us,
+                "buffer_length": self.buffer_length,
+                "last_invocation": self.last_invocation,
+                "exceptions_total": self.exceptions,
+                "last_exception": self.last_exception,
+                "last_exception_time": self.last_exception_time,
+            }
+
+    def metrics_list(self) -> List[Any]:
+        snap = self.snapshot()
+        return [snap[name] for name in self.METRIC_NAMES]
+
+
+def flatten_status(stats: Dict[str, StatManager]) -> Dict[str, Any]:
+    """Build the flat {op_id_metric: value} map used by rule status JSON
+    (reference: internal/topo/rule/state.go:244-275)."""
+    out: Dict[str, Any] = {}
+    for op_id, sm in stats.items():
+        snap = sm.snapshot()
+        for metric, value in snap.items():
+            out[f"{sm.op_type}_{op_id}_{sm.instance}_{metric}"] = value
+    return out

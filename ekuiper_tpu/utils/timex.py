@@ -1,0 +1,215 @@
+"""Mockable clock — the TPU-native analogue of eKuiper's pkg/timex.
+
+The reference wraps benbjohnson/clock and auto-switches to a mock clock under
+`go test` (reference: pkg/timex/timex.go), so window/ticker tests advance time
+deterministically. We carry the same pattern: a process-global Clock that all
+runtime components (window triggers, rate limiters, schedulers, metrics) must
+use instead of time.time().
+
+Real clock = wall clock. Mock clock = manually advanced; sleepers/timers are
+woken when `advance()` crosses their deadline, so a test can feed tuples, call
+`advance(10_000)`, and observe the tumbling window fire — no real waiting.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, Optional
+
+MS = 1
+SECOND = 1000
+MINUTE = 60 * SECOND
+HOUR = 60 * MINUTE
+
+_UNIT_MS = {"ms": MS, "ss": SECOND, "mi": MINUTE, "hh": HOUR}
+
+
+def unit_to_ms(unit: str) -> int:
+    """Window-size unit (as in TUMBLINGWINDOW(ss, 10)) to milliseconds."""
+    try:
+        return _UNIT_MS[unit.lower()]
+    except KeyError:
+        raise ValueError(f"unknown time unit {unit!r} (want ms/ss/mi/hh)")
+
+
+class Timer:
+    """One-shot timer handle. `wait()` blocks until it fires or is stopped."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self.fired_at: Optional[int] = None
+        self.stopped = False
+
+    def _fire(self, now_ms: int) -> None:
+        self.fired_at = now_ms
+        self._event.set()
+
+    def stop(self) -> None:
+        self.stopped = True
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    @property
+    def fired(self) -> bool:
+        return self.fired_at is not None
+
+
+class Clock:
+    """Interface. now_ms() is the engine-wide notion of processing time."""
+
+    def now_ms(self) -> int:
+        raise NotImplementedError
+
+    def sleep(self, ms: int) -> None:
+        raise NotImplementedError
+
+    def after(self, ms: int, callback: Optional[Callable[[int], None]] = None) -> Timer:
+        raise NotImplementedError
+
+    def is_mock(self) -> bool:
+        return False
+
+
+class RealClock(Clock):
+    def now_ms(self) -> int:
+        return int(time.time() * 1000)
+
+    def sleep(self, ms: int) -> None:
+        time.sleep(ms / 1000.0)
+
+    def after(self, ms: int, callback: Optional[Callable[[int], None]] = None) -> Timer:
+        timer = Timer()
+
+        def run() -> None:
+            time.sleep(ms / 1000.0)
+            if not timer.stopped:
+                now = self.now_ms()
+                timer._fire(now)
+                if callback is not None:
+                    callback(now)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return timer
+
+
+class MockClock(Clock):
+    """Deterministic clock. Time only moves via set()/advance().
+
+    Timers registered with `after()` fire synchronously inside the advancing
+    thread, in deadline order, which makes window-trigger tests reproducible.
+    """
+
+    def __init__(self, start_ms: int = 0) -> None:
+        self._now = start_ms
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._counter = itertools.count()
+        # heap of (deadline, seq, timer, callback)
+        self._timers: list = []
+
+    def is_mock(self) -> bool:
+        return True
+
+    def now_ms(self) -> int:
+        with self._lock:
+            return self._now
+
+    def set(self, ms: int) -> None:
+        with self._cond:
+            if ms < self._now:
+                raise ValueError(f"mock clock cannot go backwards ({ms} < {self._now})")
+            self._fire_until(ms)
+            self._now = ms
+            self._cond.notify_all()
+
+    def advance(self, ms: int) -> None:
+        with self._cond:
+            target = self._now + ms
+            self._fire_until(target)
+            self._now = target
+            self._cond.notify_all()
+
+    def _fire_until(self, target_ms: int) -> None:
+        # Fire due timers in deadline order, moving time to each deadline so a
+        # callback that re-registers (a ticker) keeps firing within one advance.
+        while self._timers and self._timers[0][0] <= target_ms:
+            deadline, _, timer, callback = heapq.heappop(self._timers)
+            if timer.stopped:
+                continue
+            self._now = max(self._now, deadline)
+            timer._fire(deadline)
+            if callback is not None:
+                callback(deadline)
+
+    def sleep(self, ms: int) -> None:
+        """Block until mock time passes now+ms (some other thread must advance)."""
+        with self._cond:
+            deadline = self._now + ms
+            while self._now < deadline:
+                self._cond.wait(timeout=5.0)
+
+    def after(self, ms: int, callback: Optional[Callable[[int], None]] = None) -> Timer:
+        timer = Timer()
+        with self._cond:
+            heapq.heappush(
+                self._timers, (self._now + ms, next(self._counter), timer, callback)
+            )
+        return timer
+
+
+_clock: Clock = RealClock()
+_lock = threading.Lock()
+
+
+def get_clock() -> Clock:
+    return _clock
+
+
+def now_ms() -> int:
+    return _clock.now_ms()
+
+
+def sleep(ms: int) -> None:
+    _clock.sleep(ms)
+
+
+def after(ms: int, callback: Optional[Callable[[int], None]] = None) -> Timer:
+    return _clock.after(ms, callback)
+
+
+def set_mock_clock(start_ms: int = 0) -> MockClock:
+    """Install (and return) a fresh mock clock — call from test setup."""
+    global _clock
+    with _lock:
+        mock = MockClock(start_ms)
+        _clock = mock
+        return mock
+
+
+def get_mock_clock() -> MockClock:
+    if not isinstance(_clock, MockClock):
+        raise RuntimeError("mock clock not installed; call set_mock_clock() first")
+    return _clock
+
+
+def use_real_clock() -> None:
+    global _clock
+    with _lock:
+        _clock = RealClock()
+
+
+def align_to_window(now: int, interval_ms: int) -> int:
+    """Next boundary of a tumbling/hopping interval at or after `now`.
+
+    eKuiper aligns window boundaries to the epoch (getAlignedWindowEndTime),
+    so a 10s tumbling window always fires at :00, :10, :20 ...
+    """
+    if interval_ms <= 0:
+        raise ValueError("interval must be positive")
+    rem = now % interval_ms
+    return now if rem == 0 else now + (interval_ms - rem)

@@ -1,0 +1,35 @@
+"""Test harness config.
+
+All tests run JAX on a virtual 8-device CPU mesh (no real TPU needed) so
+sharding/collective paths are exercised the way the reference tests exercise
+multi-goroutine topologies in one process. Mirrors eKuiper's auto-mock-clock
+under `go test` (pkg/timex): every test starts with a fresh mock clock.
+"""
+import os
+
+# Must happen before jax import anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from ekuiper_tpu.utils import timex  # noqa: E402
+from ekuiper_tpu.store import kv  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine_state():
+    """Fresh mock clock + in-memory store for every test."""
+    clock = timex.set_mock_clock(0)
+    kv.setup("memory")
+    yield clock
+    timex.use_real_clock()
+
+
+@pytest.fixture
+def mock_clock():
+    return timex.get_mock_clock()

@@ -1,0 +1,161 @@
+"""Foundation tests: mock clock, KV store, cast, columnar batch."""
+import numpy as np
+import pytest
+
+from ekuiper_tpu.data import cast
+from ekuiper_tpu.data.batch import ColumnBatch, from_tuples
+from ekuiper_tpu.data.rows import Tuple
+from ekuiper_tpu.data.types import DataType, Field, Schema
+from ekuiper_tpu.store import kv
+from ekuiper_tpu.utils import timex
+
+
+class TestMockClock:
+    def test_advance_and_now(self, mock_clock):
+        assert timex.now_ms() == 0
+        mock_clock.advance(1500)
+        assert timex.now_ms() == 1500
+
+    def test_timer_fires_on_advance(self, mock_clock):
+        fired = []
+        timer = mock_clock.after(1000, lambda ts: fired.append(ts))
+        mock_clock.advance(999)
+        assert not timer.fired and fired == []
+        mock_clock.advance(1)
+        assert timer.fired and fired == [1000]
+
+    def test_ticker_reregister_within_one_advance(self, mock_clock):
+        ticks = []
+
+        def on_tick(ts):
+            ticks.append(ts)
+            if len(ticks) < 5:
+                mock_clock.after(10, on_tick)
+
+        mock_clock.after(10, on_tick)
+        mock_clock.advance(100)
+        assert ticks == [10, 20, 30, 40, 50]
+        # clock must land exactly on the advance target, not double-count
+        # time moved while firing timers
+        assert timex.now_ms() == 100
+
+    def test_timer_stop(self, mock_clock):
+        timer = mock_clock.after(10)
+        timer.stop()
+        mock_clock.advance(20)
+        assert not timer.fired
+
+    def test_cannot_go_backwards(self, mock_clock):
+        mock_clock.set(100)
+        with pytest.raises(ValueError):
+            mock_clock.set(50)
+
+    def test_window_alignment(self):
+        assert timex.align_to_window(0, 10_000) == 0
+        assert timex.align_to_window(1, 10_000) == 10_000
+        assert timex.align_to_window(10_000, 10_000) == 10_000
+        assert timex.align_to_window(19_999, 10_000) == 20_000
+
+
+class TestKV:
+    def test_memory_roundtrip(self):
+        store = kv.get_store()
+        table = store.kv("stream")
+        table.set("demo", {"sql": "CREATE STREAM demo () WITH ()"})
+        assert table.get("demo")["sql"].startswith("CREATE")
+        assert table.keys() == ["demo"]
+        assert table.delete("demo")
+        assert table.get("demo") is None
+        assert not table.delete("demo")
+
+    def test_setnx(self):
+        table = kv.get_store().kv("rule")
+        assert table.setnx("r1", {"id": "r1"})
+        assert not table.setnx("r1", {"id": "other"})
+        assert table.get("r1")["id"] == "r1"
+
+    def test_sqlite_roundtrip(self, tmp_path):
+        store = kv.Store("sqlite", str(tmp_path))
+        table = store.kv("stream")
+        table.set("a", [1, 2, 3])
+        assert table.get("a") == [1, 2, 3]
+        assert table.setnx("b", "x") and not table.setnx("b", "y")
+        assert sorted(table.keys()) == ["a", "b"]
+        store.close()
+
+
+class TestCast:
+    def test_numeric(self):
+        assert cast.to_int("42") == 42
+        assert cast.to_int(3.0) == 3
+        assert cast.to_float("3.5") == 3.5
+        assert cast.to_bool("true") is True
+        with pytest.raises(cast.CastError):
+            cast.to_int("abc")
+        with pytest.raises(cast.CastError):
+            cast.to_int(3.5, strict=cast.STRICT)
+
+    def test_datetime(self):
+        assert cast.to_datetime_ms(1700000000000) == 1700000000000
+        assert cast.to_datetime_ms("1970-01-01T00:00:01Z") == 1000
+
+    def test_typed_struct_array(self):
+        f = Field("xs", DataType.ARRAY, elem_type=DataType.BIGINT)
+        assert cast.to_typed(["1", 2, 3.0], f) == [1, 2, 3]
+
+    def test_compare(self):
+        assert cast.compare(1, 2.5) == -1
+        assert cast.compare("a", "a") == 0
+        assert cast.compare(None, 1) is None
+        assert cast.compare([1, 2], [1, 3]) == -1
+
+
+class TestColumnBatch:
+    def _tuples(self):
+        return [
+            Tuple(emitter="demo", message={"device": "d1", "temp": 20.0, "n": 1}, timestamp=100),
+            Tuple(emitter="demo", message={"device": "d2", "temp": 21.5, "n": 2}, timestamp=200),
+            Tuple(emitter="demo", message={"device": "d1", "temp": 23.0}, timestamp=300),
+        ]
+
+    def test_from_tuples_schemaless(self):
+        b = from_tuples(self._tuples(), emitter="demo")
+        assert b.n == 3
+        assert b.columns["temp"].dtype == np.float32
+        assert b.columns["n"].dtype == np.int64
+        assert b.columns["device"].dtype == np.object_
+        assert not b.is_valid("n")[2]  # missing n in 3rd row
+        assert b.is_valid("temp").all()
+
+    def test_from_tuples_with_schema(self):
+        schema = Schema([
+            Field("device", DataType.STRING),
+            Field("temp", DataType.FLOAT),
+            Field("n", DataType.BIGINT),
+        ])
+        b = from_tuples(self._tuples(), schema=schema)
+        assert b.columns["temp"].dtype == np.float32
+        assert list(b.timestamps) == [100, 200, 300]
+
+    def test_roundtrip(self):
+        b = from_tuples(self._tuples())
+        rows = b.to_tuples()
+        assert rows[0].message == {"device": "d1", "temp": 20.0, "n": 1}
+        assert "n" not in rows[2].message
+        assert rows[2].timestamp == 300
+
+    def test_select_and_concat(self):
+        b = from_tuples(self._tuples())
+        hot = b.select(b.columns["temp"] > 21.0)
+        assert hot.n == 2
+        both = ColumnBatch.concat([b, hot])
+        assert both.n == 5
+        assert both.columns["temp"].dtype == np.float32
+
+    def test_concat_missing_column(self):
+        b1 = from_tuples([Tuple(message={"a": 1})])
+        b2 = from_tuples([Tuple(message={"b": 2.0})])
+        b = ColumnBatch.concat([b1, b2])
+        assert b.n == 2
+        assert not b.is_valid("a")[1]
+        assert not b.is_valid("b")[0]
