@@ -1,0 +1,32 @@
+"""Function execution context — analogue of api.FunctionContext
+(reference: contract/api/ctx.go:41-66 + internal/xsql functionRuntime).
+
+Carries per-call-instance state (for stateful analytic/accumulator functions),
+rule identity, and the current window range for window_start()/window_end().
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..data.rows import Row, WindowRange
+
+
+@dataclass
+class FunctionContext:
+    rule_id: str = ""
+    func_id: int = 0
+    state: Dict[str, Any] = field(default_factory=dict)
+    window_range: Optional[WindowRange] = None
+    row: Optional[Row] = None  # current row (meta access etc.)
+    keyed_state: Optional[Any] = None  # global cross-rule KV
+    trigger_time: int = 0
+
+    def get_state(self, key: str, default: Any = None) -> Any:
+        return self.state.get(key, default)
+
+    def put_state(self, key: str, value: Any) -> None:
+        self.state[key] = value
+
+
+EMPTY = FunctionContext()

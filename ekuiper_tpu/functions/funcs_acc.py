@@ -1,0 +1,62 @@
+"""Accumulator functions — analogue of internal/binder/function/funcs_acc.go:
+acc_sum/acc_count/acc_avg/acc_max/acc_min. Running accumulation across rows
+of the stream (not window-scoped); state persists in rule state and resets
+when the OVER (WHEN ...) condition fires.
+"""
+from __future__ import annotations
+
+from ..data import cast
+from .registry import SCALAR, register
+
+
+def _acc(ctx, key, default):
+    v = ctx.get_state("acc:" + key)
+    return default if v is None else v
+
+
+@register("acc_sum", SCALAR, stateful=True)
+def f_acc_sum(args, ctx):
+    total = _acc(ctx, "sum", 0.0)
+    if args[0] is not None:
+        total += cast.to_float(args[0])
+        ctx.put_state("acc:sum", total)
+    return total
+
+
+@register("acc_count", SCALAR, stateful=True)
+def f_acc_count(args, ctx):
+    n = _acc(ctx, "count", 0)
+    if args[0] is not None:
+        n += 1
+        ctx.put_state("acc:count", n)
+    return n
+
+
+@register("acc_avg", SCALAR, stateful=True)
+def f_acc_avg(args, ctx):
+    s = _acc(ctx, "avg_sum", 0.0)
+    n = _acc(ctx, "avg_n", 0)
+    if args[0] is not None:
+        s += cast.to_float(args[0])
+        n += 1
+        ctx.put_state("acc:avg_sum", s)
+        ctx.put_state("acc:avg_n", n)
+    return s / n if n else None
+
+
+@register("acc_max", SCALAR, stateful=True)
+def f_acc_max(args, ctx):
+    best = ctx.get_state("acc:max")
+    if args[0] is not None and (best is None or cast.compare(args[0], best) == 1):
+        best = args[0]
+        ctx.put_state("acc:max", best)
+    return best
+
+
+@register("acc_min", SCALAR, stateful=True)
+def f_acc_min(args, ctx):
+    best = ctx.get_state("acc:min")
+    if args[0] is not None and (best is None or cast.compare(args[0], best) == -1):
+        best = args[0]
+        ctx.put_state("acc:min", best)
+    return best

@@ -1,0 +1,186 @@
+"""Aggregate functions — analogue of internal/binder/function/funcs_agg.go:29-371.
+
+Each aggregate's row-path exec takes (args, ctx) where args[0] is the list of
+the group's values for the aggregated expression (None values excluded by the
+caller, matching the reference's null handling). The TPU path never calls
+these per-group python implementations for the fused kernels — sum/count/avg/
+min/max/stddev/var fold into device partials (ops/groupby.py); these remain
+for host fallback, small groups, and exotic aggregates.
+"""
+from __future__ import annotations
+
+import statistics
+from typing import Any, List
+
+from ..data import cast
+from .registry import AGGREGATE, register
+
+
+def _nums(values: List[Any]) -> List[float]:
+    return [cast.to_float(v) for v in values if v is not None]
+
+
+@register("avg", AGGREGATE, inc_name="inc_avg")
+def f_avg(args, ctx):
+    vals = [v for v in args[0] if v is not None]
+    if not vals:
+        return None
+    if all(isinstance(v, int) and not isinstance(v, bool) for v in vals):
+        return sum(vals) // len(vals)  # integer avg matches reference semantics
+    return sum(cast.to_float(v) for v in vals) / len(vals)
+
+
+@register("count", AGGREGATE, inc_name="inc_count")
+def f_count(args, ctx):
+    return sum(1 for v in args[0] if v is not None)
+
+
+@register("sum", AGGREGATE, inc_name="inc_sum")
+def f_sum(args, ctx):
+    vals = [v for v in args[0] if v is not None]
+    if not vals:
+        return None
+    if all(isinstance(v, int) and not isinstance(v, bool) for v in vals):
+        return sum(vals)
+    return sum(cast.to_float(v) for v in vals)
+
+
+@register("max", AGGREGATE, inc_name="inc_max")
+def f_max(args, ctx):
+    vals = [v for v in args[0] if v is not None]
+    if not vals:
+        return None
+    best = vals[0]
+    for v in vals[1:]:
+        if cast.compare(v, best) == 1:
+            best = v
+    return best
+
+
+@register("min", AGGREGATE, inc_name="inc_min")
+def f_min(args, ctx):
+    vals = [v for v in args[0] if v is not None]
+    if not vals:
+        return None
+    best = vals[0]
+    for v in vals[1:]:
+        if cast.compare(v, best) == -1:
+            best = v
+    return best
+
+
+@register("collect", AGGREGATE, inc_name="inc_collect")
+def f_collect(args, ctx):
+    return list(args[0])
+
+
+@register("merge_agg", AGGREGATE, inc_name="inc_merge_agg")
+def f_merge_agg(args, ctx):
+    """Merge all map values of the group into one object (last wins)."""
+    out = {}
+    for v in args[0]:
+        if isinstance(v, dict):
+            out.update(v)
+    return out
+
+
+@register("deduplicate", AGGREGATE)
+def f_deduplicate(args, ctx):
+    """deduplicate(col, all) — reference returns the deduplicated rows of the
+    window; with all=false returns just the latest row if new else empty."""
+    values, keep_all = args[0], args[1] if len(args) > 1 else True
+    all_vals = bool(keep_all[0]) if isinstance(keep_all, list) and keep_all else bool(keep_all)
+    seen = set()
+    out = []
+    for v in values:
+        marker = repr(v)
+        if marker not in seen:
+            seen.add(marker)
+            out.append(v)
+    if all_vals:
+        return out
+    if values and repr(values[-1]) not in {repr(v) for v in values[:-1]}:
+        return [values[-1]]
+    return []
+
+
+def _variance(values: List[Any], sample: bool) -> Any:
+    nums = _nums(values)
+    if len(nums) == 0:
+        return None
+    if len(nums) == 1:
+        return 0.0 if not sample else None
+    fn = statistics.variance if sample else statistics.pvariance
+    return float(fn(nums))
+
+
+@register("stddev", AGGREGATE, inc_name="inc_stddev")
+def f_stddev(args, ctx):
+    v = _variance(args[0], sample=False)
+    return None if v is None else float(v) ** 0.5
+
+
+@register("stddevs", AGGREGATE, inc_name="inc_stddevs")
+def f_stddevs(args, ctx):
+    v = _variance(args[0], sample=True)
+    return None if v is None else float(v) ** 0.5
+
+
+@register("var", AGGREGATE)
+def f_var(args, ctx):
+    return _variance(args[0], sample=False)
+
+
+@register("vars", AGGREGATE)
+def f_vars(args, ctx):
+    return _variance(args[0], sample=True)
+
+
+@register("median", AGGREGATE)
+def f_median(args, ctx):
+    nums = _nums(args[0])
+    if not nums:
+        return None
+    return float(statistics.median(nums))
+
+
+def _percentile(values: List[Any], frac: float, cont: bool) -> Any:
+    nums = sorted(_nums(values))
+    if not nums:
+        return None
+    if len(nums) == 1:
+        return nums[0]
+    idx = frac * (len(nums) - 1)
+    if cont:
+        lo = int(idx)
+        hi = min(lo + 1, len(nums) - 1)
+        w = idx - lo
+        return nums[lo] * (1 - w) + nums[hi] * w
+    return nums[min(int(round(idx + 0.5)) if idx % 1 else int(idx), len(nums) - 1)]
+
+
+@register("percentile_cont", AGGREGATE)
+def f_percentile_cont(args, ctx):
+    frac = cast.to_float(args[1][0] if isinstance(args[1], list) else args[1])
+    return _percentile(args[0], frac, cont=True)
+
+
+@register("percentile_disc", AGGREGATE)
+def f_percentile_disc(args, ctx):
+    frac = cast.to_float(args[1][0] if isinstance(args[1], list) else args[1])
+    return _percentile(args[0], frac, cont=False)
+
+
+@register("last_value", AGGREGATE, inc_name="inc_last_value")
+def f_last_value(args, ctx):
+    values = args[0]
+    ignore_null = True
+    if len(args) > 1:
+        second = args[1]
+        ignore_null = bool(second[0]) if isinstance(second, list) and second else bool(second)
+    if ignore_null:
+        for v in reversed(values):
+            if v is not None:
+                return v
+        return None
+    return values[-1] if values else None

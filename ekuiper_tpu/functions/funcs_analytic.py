@@ -1,0 +1,90 @@
+"""Analytic functions — analogue of internal/binder/function/funcs_analytic.go:
+lag, latest, changed_col, had_changed. Stateful per call instance and per
+partition (the `partition by` extra args, reference: internal/xsql/valuer.go:447).
+
+State layout: ctx.state[partition_key] holds the per-partition value, where
+partition_key is "" when no PARTITION BY is present. The AnalyticFuncsOp
+computes these per-row *before* filtering (reference:
+internal/topo/operator/analyticfuncs_operator.go).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from .registry import ANALYTIC, register
+
+
+def _pstate(ctx, partition: str) -> dict:
+    st = ctx.get_state("p:" + partition)
+    if st is None:
+        st = {}
+        ctx.put_state("p:" + partition, st)
+    return st
+
+
+@register("lag", ANALYTIC, stateful=True)
+def f_lag(args, ctx, partition: str = "", update: bool = True):
+    """lag(col[, index[, default]]) — value from `index` rows ago.
+    update=False (OVER WHEN false): peek without recording the row."""
+    val = args[0]
+    index = int(args[1]) if len(args) > 1 and args[1] is not None else 1
+    default = args[2] if len(args) > 2 else None
+    st = _pstate(ctx, partition)
+    hist = st.setdefault("hist", [])
+    out = hist[-index] if len(hist) >= index else default
+    if update:
+        hist.append(val)
+        if len(hist) > index:
+            del hist[: len(hist) - index]
+    return out
+
+
+@register("latest", ANALYTIC, stateful=True)
+def f_latest(args, ctx, partition: str = "", update: bool = True):
+    """latest(col[, default]) — most recent non-null value."""
+    val = args[0]
+    default = args[1] if len(args) > 1 else None
+    st = _pstate(ctx, partition)
+    if not update:
+        return st.get("latest", default)
+    if val is not None:
+        st["latest"] = val
+        return val
+    return st.get("latest", default)
+
+
+@register("changed_col", ANALYTIC, stateful=True)
+def f_changed_col(args, ctx, partition: str = "", update: bool = True):
+    """changed_col(ignore_null, col) — col value if changed since last row else null."""
+    ignore_null, val = bool(args[0]), args[1]
+    st = _pstate(ctx, partition)
+    if not update:
+        return None
+    if val is None and ignore_null:
+        return None
+    prev_set = "prev" in st
+    prev = st.get("prev")
+    st["prev"] = val
+    if not prev_set or prev != val:
+        return val
+    return None
+
+
+@register("had_changed", ANALYTIC, stateful=True)
+def f_had_changed(args, ctx, partition: str = "", update: bool = True):
+    """had_changed(ignore_null, col1[, col2...]) — true if any col changed."""
+    ignore_null = bool(args[0])
+    st = _pstate(ctx, partition)
+    if not update:
+        return False
+    changed = False
+    for i, val in enumerate(args[1:]):
+        key = f"hc{i}"
+        if val is None and ignore_null:
+            continue
+        prev_set = key in st
+        prev = st.get(key)
+        st[key] = val
+        if not prev_set or prev != val:
+            changed = True
+    return changed

@@ -1,0 +1,250 @@
+"""Array functions — analogue of internal/binder/function/funcs_array.go (24 funcs)."""
+from __future__ import annotations
+
+import random
+from typing import Any, List
+
+from ..data import cast
+from .registry import SCALAR, register
+
+
+def _arr(v: Any) -> List[Any]:
+    if not isinstance(v, (list, tuple)):
+        raise ValueError(f"expected array but got {type(v).__name__}")
+    return list(v)
+
+
+@register("array_create", SCALAR)
+def f_array_create(args, ctx):
+    return list(args)
+
+
+@register("array_position", SCALAR)
+def f_array_position(args, ctx):
+    if args[0] is None:
+        return -1
+    arr = _arr(args[0])
+    for i, v in enumerate(arr):
+        if v == args[1]:
+            return i
+    return -1
+
+
+@register("element_at", SCALAR)
+def f_element_at(args, ctx):
+    v = args[0]
+    if v is None:
+        return None
+    if isinstance(v, dict):
+        return v.get(cast.to_string(args[1]))
+    arr = _arr(v)
+    idx = cast.to_int(args[1])
+    if idx < -len(arr) or idx >= len(arr):
+        raise ValueError(f"element_at index {idx} out of range")
+    return arr[idx]
+
+
+@register("array_contains", SCALAR)
+def f_array_contains(args, ctx):
+    return args[0] is not None and args[1] in _arr(args[0])
+
+
+@register("array_remove", SCALAR)
+def f_array_remove(args, ctx):
+    if args[0] is None:
+        return None
+    return [v for v in _arr(args[0]) if v != args[1]]
+
+
+@register("array_last_position", SCALAR)
+def f_array_last_position(args, ctx):
+    if args[0] is None:
+        return -1
+    arr = _arr(args[0])
+    for i in range(len(arr) - 1, -1, -1):
+        if arr[i] == args[1]:
+            return i
+    return -1
+
+
+@register("array_contains_any", SCALAR)
+def f_array_contains_any(args, ctx):
+    if args[0] is None or args[1] is None:
+        return False
+    a = _arr(args[0])
+    return any(v in a for v in _arr(args[1]))
+
+
+@register("array_intersect", SCALAR)
+def f_array_intersect(args, ctx):
+    if args[0] is None or args[1] is None:
+        return None
+    b = _arr(args[1])
+    out, seen = [], []
+    for v in _arr(args[0]):
+        if v in b and v not in seen:
+            seen.append(v)
+            out.append(v)
+    return out
+
+
+@register("array_union", SCALAR)
+def f_array_union(args, ctx):
+    if args[0] is None or args[1] is None:
+        return None
+    out: List[Any] = []
+    for v in _arr(args[0]) + _arr(args[1]):
+        if v not in out:
+            out.append(v)
+    return out
+
+
+@register("array_max", SCALAR)
+def f_array_max(args, ctx):
+    if args[0] is None:
+        return None
+    best = None
+    for v in _arr(args[0]):
+        if v is None:
+            continue
+        if best is None or cast.compare(v, best) == 1:
+            best = v
+    return best
+
+
+@register("array_min", SCALAR)
+def f_array_min(args, ctx):
+    if args[0] is None:
+        return None
+    best = None
+    for v in _arr(args[0]):
+        if v is None:
+            continue
+        if best is None or cast.compare(v, best) == -1:
+            best = v
+    return best
+
+
+@register("array_except", SCALAR)
+def f_array_except(args, ctx):
+    if args[0] is None or args[1] is None:
+        return None
+    b = _arr(args[1])
+    out: List[Any] = []
+    for v in _arr(args[0]):
+        if v not in b and v not in out:
+            out.append(v)
+    return out
+
+
+@register("repeat", SCALAR)
+def f_repeat(args, ctx):
+    return [args[0]] * cast.to_int(args[1])
+
+
+@register("sequence", SCALAR)
+def f_sequence(args, ctx):
+    start, stop = cast.to_int(args[0]), cast.to_int(args[1])
+    step = cast.to_int(args[2]) if len(args) > 2 else (1 if stop >= start else -1)
+    if step == 0:
+        raise ValueError("sequence step cannot be 0")
+    return list(range(start, stop + (1 if step > 0 else -1), step))
+
+
+@register("array_cardinality", SCALAR)
+def f_array_cardinality(args, ctx):
+    return 0 if args[0] is None else len(_arr(args[0]))
+
+
+@register("array_flatten", SCALAR)
+def f_array_flatten(args, ctx):
+    if args[0] is None:
+        return None
+    out: List[Any] = []
+    for v in _arr(args[0]):
+        if isinstance(v, (list, tuple)):
+            out.extend(v)
+        else:
+            out.append(v)
+    return out
+
+
+@register("array_distinct", SCALAR)
+def f_array_distinct(args, ctx):
+    if args[0] is None:
+        return None
+    out: List[Any] = []
+    for v in _arr(args[0]):
+        if v not in out:
+            out.append(v)
+    return out
+
+
+@register("array_map", SCALAR)
+def f_array_map(args, ctx):
+    """array_map(func_name, arr) — applies a scalar builtin to each element."""
+    from . import registry as _r
+
+    if args[1] is None:
+        return None
+    fd = _r.lookup(cast.to_string(args[0]))
+    if fd is None or fd.ftype != SCALAR:
+        raise ValueError(f"array_map: unknown scalar function {args[0]}")
+    return [fd.exec([v], ctx) for v in _arr(args[1])]
+
+
+@register("array_join", SCALAR)
+def f_array_join(args, ctx):
+    if args[0] is None:
+        return None
+    sep = cast.to_string(args[1]) if len(args) > 1 else ","
+    null_repl = cast.to_string(args[2]) if len(args) > 2 else None
+    parts = []
+    for v in _arr(args[0]):
+        if v is None:
+            if null_repl is not None:
+                parts.append(null_repl)
+        else:
+            parts.append(cast.to_string(v))
+    return sep.join(parts)
+
+
+@register("array_shuffle", SCALAR)
+def f_array_shuffle(args, ctx):
+    if args[0] is None:
+        return None
+    out = _arr(args[0])
+    random.shuffle(out)
+    return out
+
+
+@register("array_sort", SCALAR)
+def f_array_sort(args, ctx):
+    if args[0] is None:
+        return None
+    import functools
+
+    return sorted(_arr(args[0]), key=functools.cmp_to_key(
+        lambda a, b: cast.compare(a, b) or 0
+    ))
+
+
+@register("array_concat", SCALAR)
+def f_array_concat(args, ctx):
+    out: List[Any] = []
+    for a in args:
+        if a is None:
+            return None
+        out.extend(_arr(a))
+    return out
+
+
+@register("kvpair_array_to_obj", SCALAR)
+def f_kvpair_array_to_obj(args, ctx):
+    if args[0] is None:
+        return None
+    out = {}
+    for pair in _arr(args[0]):
+        if isinstance(pair, dict) and "key" in pair:
+            out[cast.to_string(pair["key"])] = pair.get("value")
+    return out

@@ -1,0 +1,214 @@
+"""Datetime functions — analogue of internal/binder/function/funcs_datetime.go
+(25 registrations). All times are epoch-ms internally (the engine-wide
+representation); formatting uses Go-style reference layouts translated to
+strftime where needed, but the common format tokens (YYYY-MM-dd etc.) follow
+the reference's java-style patterns.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Optional
+
+from ..data import cast
+from ..utils import timex
+from .registry import SCALAR, register
+
+_EPOCH = _dt.timezone.utc
+
+
+def _dt_of(v: Any) -> _dt.datetime:
+    ms = cast.to_datetime_ms(v)
+    return _dt.datetime.fromtimestamp(ms / 1000.0, tz=_EPOCH)
+
+
+# longest-match-first single-pass scan: sequential str.replace would corrupt
+# earlier outputs (e.g. 'a'->'%p' rewriting the '%a' emitted for EEE)
+_JAVA_TOKENS = [
+    ("YYYY", "%Y"), ("yyyy", "%Y"), ("MMMM", "%B"), ("EEEE", "%A"),
+    ("SSS", "%f"), ("MMM", "%b"), ("EEE", "%a"),
+    ("YY", "%y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"), ("DD", "%d"),
+    ("HH", "%H"), ("hh", "%I"), ("mm", "%M"), ("ss", "%S"), ("zz", "%Z"),
+    ("a", "%p"), ("Z", "%z"),
+]
+
+
+def java_to_strftime(fmt: str) -> str:
+    out = []
+    i = 0
+    while i < len(fmt):
+        for token, repl in _JAVA_TOKENS:
+            if fmt.startswith(token, i):
+                out.append(repl)
+                i += len(token)
+                break
+        else:
+            c = fmt[i]
+            out.append("%%" if c == "%" else c)
+            i += 1
+    return "".join(out)
+
+
+def _now_ms() -> int:
+    return timex.now_ms()
+
+
+def _reg_now(name: str):
+    @register(name, SCALAR)
+    def f_now(args, ctx):
+        # now(fsp)/current_timestamp return datetime; engine keeps epoch ms
+        return _now_ms()
+
+    return f_now
+
+
+for _n in ("now", "current_timestamp", "local_time", "local_timestamp"):
+    _reg_now(_n)
+
+
+@register("cur_date", SCALAR)
+def f_cur_date(args, ctx):
+    d = _dt.datetime.fromtimestamp(_now_ms() / 1000.0, tz=_EPOCH)
+    midnight = d.replace(hour=0, minute=0, second=0, microsecond=0)
+    return int(midnight.timestamp() * 1000)
+
+
+register("current_date", SCALAR)(f_cur_date)
+
+
+@register("cur_time", SCALAR)
+def f_cur_time(args, ctx):
+    d = _dt.datetime.fromtimestamp(_now_ms() / 1000.0, tz=_EPOCH)
+    return d.strftime("%H:%M:%S")
+
+
+register("current_time", SCALAR)(f_cur_time)
+
+
+@register("format_time", SCALAR)
+def f_format_time(args, ctx):
+    if args[0] is None:
+        return None
+    d = _dt_of(args[0])
+    return d.strftime(java_to_strftime(cast.to_string(args[1])))
+
+
+@register("date_calc", SCALAR)
+def f_date_calc(args, ctx):
+    """date_calc(date, duration_str) — duration like "1h", "-30m", "24h"."""
+    if args[0] is None or args[1] is None:
+        return None
+    ms = cast.to_datetime_ms(args[0])
+    return ms + _parse_duration_ms(cast.to_string(args[1]))
+
+
+def _parse_duration_ms(s: str) -> int:
+    units = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000}
+    s = s.strip()
+    sign = 1
+    if s.startswith("-"):
+        sign, s = -1, s[1:]
+    total = 0
+    num = ""
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c.isdigit() or c == ".":
+            num += c
+            i += 1
+        else:
+            u = s[i:i + 2] if s[i:i + 2] == "ms" else c
+            i += len(u)
+            if u not in units:
+                raise ValueError(f"unknown duration unit {u!r} in {s!r}")
+            total += float(num) * units[u]
+            num = ""
+    return sign * int(total)
+
+
+@register("date_diff", SCALAR)
+def f_date_diff(args, ctx):
+    if args[0] is None or args[1] is None:
+        return None
+    return cast.to_datetime_ms(args[1]) - cast.to_datetime_ms(args[0])
+
+
+@register("day_name", SCALAR)
+def f_day_name(args, ctx):
+    return None if args[0] is None else _dt_of(args[0]).strftime("%A")
+
+
+@register("day_of_month", SCALAR)
+def f_day_of_month(args, ctx):
+    return None if args[0] is None else _dt_of(args[0]).day
+
+
+register("day", SCALAR)(f_day_of_month)
+
+
+@register("day_of_week", SCALAR)
+def f_day_of_week(args, ctx):
+    # reference: Sunday=1 .. Saturday=7
+    return None if args[0] is None else (_dt_of(args[0]).weekday() + 1) % 7 + 1
+
+
+@register("day_of_year", SCALAR)
+def f_day_of_year(args, ctx):
+    return None if args[0] is None else _dt_of(args[0]).timetuple().tm_yday
+
+
+@register("from_days", SCALAR)
+def f_from_days(args, ctx):
+    if args[0] is None:
+        return None
+    days = cast.to_int(args[0])
+    return days * 86_400_000
+
+
+@register("from_unix_time", SCALAR)
+def f_from_unix_time(args, ctx):
+    return None if args[0] is None else cast.to_int(args[0]) * 1000
+
+
+@register("hour", SCALAR)
+def f_hour(args, ctx):
+    return None if args[0] is None else _dt_of(args[0]).hour
+
+
+@register("minute", SCALAR)
+def f_minute(args, ctx):
+    return None if args[0] is None else _dt_of(args[0]).minute
+
+
+@register("second", SCALAR)
+def f_second(args, ctx):
+    return None if args[0] is None else _dt_of(args[0]).second
+
+
+@register("microsecond", SCALAR)
+def f_microsecond(args, ctx):
+    return None if args[0] is None else _dt_of(args[0]).microsecond
+
+
+@register("month", SCALAR)
+def f_month(args, ctx):
+    return None if args[0] is None else _dt_of(args[0]).month
+
+
+@register("month_name", SCALAR)
+def f_month_name(args, ctx):
+    return None if args[0] is None else _dt_of(args[0]).strftime("%B")
+
+
+@register("last_day", SCALAR)
+def f_last_day(args, ctx):
+    if args[0] is None:
+        return None
+    d = _dt_of(args[0])
+    nxt = (d.replace(day=28) + _dt.timedelta(days=4)).replace(day=1)
+    last = nxt - _dt.timedelta(days=1)
+    return int(last.replace(hour=0, minute=0, second=0, microsecond=0).timestamp() * 1000)
+
+
+@register("year", SCALAR)
+def f_year(args, ctx):
+    return None if args[0] is None else _dt_of(args[0]).year

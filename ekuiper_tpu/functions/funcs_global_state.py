@@ -1,0 +1,37 @@
+"""Rule-hit tracking functions — analogue of funcs_global_state.go:
+last_hit_count/last_hit_time/last_agg_hit_count/last_agg_hit_time.
+Backed by the rule's state (and keyed state for cross-rule visibility,
+reference: internal/keyedstate/kv.go:28-36).
+"""
+from __future__ import annotations
+
+from ..utils import timex
+from .registry import AGGREGATE, SCALAR, register
+
+
+@register("last_hit_count", SCALAR, stateful=True)
+def f_last_hit_count(args, ctx):
+    n = ctx.get_state("hit_count", 0)
+    ctx.put_state("hit_count", n + 1)
+    return n
+
+
+@register("last_hit_time", SCALAR, stateful=True)
+def f_last_hit_time(args, ctx):
+    t = ctx.get_state("hit_time", 0)
+    ctx.put_state("hit_time", timex.now_ms())
+    return t
+
+
+@register("last_agg_hit_count", AGGREGATE, stateful=True)
+def f_last_agg_hit_count(args, ctx):
+    n = ctx.get_state("agg_hit_count", 0)
+    ctx.put_state("agg_hit_count", n + 1)
+    return n
+
+
+@register("last_agg_hit_time", AGGREGATE, stateful=True)
+def f_last_agg_hit_time(args, ctx):
+    t = ctx.get_state("agg_hit_time", 0)
+    ctx.put_state("agg_hit_time", timex.now_ms())
+    return t

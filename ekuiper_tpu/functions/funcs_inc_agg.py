@@ -1,0 +1,153 @@
+"""Incremental aggregates — analogue of internal/binder/function/funcs_inc_agg.go:43-147.
+
+These are the streaming-partial forms the planner's incremental-agg rewrite
+targets (reference: planner.go:910-999) and the exact semantics the TPU
+group-by kernel implements natively: per-key device partials folded per
+micro-batch, finalized at window trigger. Each registers an Accumulator
+(init/step/merge/result); `merge` is the cross-shard combine used when the
+key axis is sharded over a mesh (psum-style tree merge).
+
+The row-path exec folds one value into ctx.state — used by the host fallback
+WindowIncAggOperator for types the device kernel doesn't handle (strings,
+objects).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ..data import cast
+from .registry import AGGREGATE, Accumulator, FunctionDef, register_def
+
+
+def _mk(name: str, acc: Accumulator) -> None:
+    def exec_fold(args, ctx):
+        state = ctx.get_state("acc")
+        if state is None:
+            state = acc.init()
+        # via the aggregate evaluator path args[0] is the group's value list;
+        # via the IncAgg operator it is a single value per call
+        values = args[0] if isinstance(args[0], list) else [args[0]]
+        for v in values:
+            state = acc.step(state, v)
+        ctx.put_state("acc", state)
+        return acc.result(state)
+
+    register_def(
+        FunctionDef(name=name, ftype=AGGREGATE, exec=exec_fold, stateful=True, acc=acc)
+    )
+
+
+def _num(v: Any) -> float:
+    return cast.to_float(v)
+
+
+# count: state = n
+_mk("inc_count", Accumulator(
+    init=lambda: 0,
+    step=lambda s, v: s + (0 if v is None else 1),
+    result=lambda s: s,
+    merge=lambda a, b: a + b,
+))
+
+# sum: state = (sum, has_any, all_int)
+_mk("inc_sum", Accumulator(
+    init=lambda: (0, False, True),
+    step=lambda s, v: s if v is None else (
+        s[0] + (v if isinstance(v, (int, float)) and not isinstance(v, bool) else _num(v)),
+        True,
+        s[2] and isinstance(v, int) and not isinstance(v, bool),
+    ),
+    result=lambda s: None if not s[1] else (int(s[0]) if s[2] else float(s[0])),
+    merge=lambda a, b: (a[0] + b[0], a[1] or b[1], a[2] and b[2]),
+))
+
+# avg: state = (sum, count, all_int)
+_mk("inc_avg", Accumulator(
+    init=lambda: (0.0, 0, True),
+    step=lambda s, v: s if v is None else (
+        s[0] + _num(v), s[1] + 1,
+        s[2] and isinstance(v, int) and not isinstance(v, bool),
+    ),
+    result=lambda s: None if s[1] == 0 else (
+        int(s[0]) // s[1] if s[2] else s[0] / s[1]
+    ),
+    merge=lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] and b[2]),
+))
+
+
+def _cmp_step(keep_gt: int):
+    def step(s, v):
+        if v is None:
+            return s
+        if s is None or cast.compare(v, s) == keep_gt:
+            return v
+        return s
+
+    return step
+
+
+def _cmp_merge(keep_gt: int):
+    def merge(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return b if cast.compare(b, a) == keep_gt else a
+
+    return merge
+
+
+_mk("inc_max", Accumulator(
+    init=lambda: None, step=_cmp_step(1), result=lambda s: s, merge=_cmp_merge(1),
+))
+_mk("inc_min", Accumulator(
+    init=lambda: None, step=_cmp_step(-1), result=lambda s: s, merge=_cmp_merge(-1),
+))
+
+_mk("inc_collect", Accumulator(
+    init=lambda: [],
+    step=lambda s, v: s + [v],
+    result=lambda s: s,
+    merge=lambda a, b: a + b,
+))
+
+
+def _merge_agg_step(s, v):
+    if isinstance(v, dict):
+        s = dict(s)
+        s.update(v)
+    return s
+
+
+_mk("inc_merge_agg", Accumulator(
+    init=lambda: {},
+    step=_merge_agg_step,
+    result=lambda s: s,
+    merge=lambda a, b: {**a, **b},
+))
+
+# last_value(ignore_null=True semantics for the inc form)
+_mk("inc_last_value", Accumulator(
+    init=lambda: None,
+    step=lambda s, v: v if v is not None else s,
+    result=lambda s: s,
+    merge=lambda a, b: b if b is not None else a,
+))
+
+# Welford-form variance partials: state = (count, sum, sum_sq)
+# (numerically fine in f64 host-side; the device kernel uses the same
+# (n, s1, s2) triple so shard merges are a simple add)
+_mk("inc_stddev", Accumulator(
+    init=lambda: (0, 0.0, 0.0),
+    step=lambda s, v: s if v is None else (s[0] + 1, s[1] + _num(v), s[2] + _num(v) ** 2),
+    result=lambda s: None if s[0] == 0 else max(s[2] / s[0] - (s[1] / s[0]) ** 2, 0.0) ** 0.5,
+    merge=lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2]),
+))
+_mk("inc_stddevs", Accumulator(
+    init=lambda: (0, 0.0, 0.0),
+    step=lambda s, v: s if v is None else (s[0] + 1, s[1] + _num(v), s[2] + _num(v) ** 2),
+    result=lambda s: None if s[0] < 2 else max(
+        (s[2] - s[1] ** 2 / s[0]) / (s[0] - 1), 0.0
+    ) ** 0.5,
+    merge=lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2]),
+))

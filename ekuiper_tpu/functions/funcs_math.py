@@ -1,0 +1,204 @@
+"""Math functions — analogue of internal/binder/function/funcs_math.go (33 funcs).
+
+Every function has both a row-path exec and a vectorized `vexec` over numpy
+arrays; the expression compiler (sql/compiler.py) uses vexec to build whole-
+batch computations that XLA fuses on the VPU.
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any
+
+import numpy as np
+
+from ..data import cast
+from .registry import SCALAR, register
+
+
+def _unary(name: str, fn, np_fn, int_passthrough: bool = False):
+    def exec_fn(args, ctx):
+        v = args[0]
+        if v is None:
+            return None
+        if int_passthrough and isinstance(v, int) and not isinstance(v, bool):
+            return fn(v)
+        return fn(cast.to_float(v))
+
+    exec_fn.__name__ = f"f_{name}"
+    register(name, SCALAR, vexec=np_fn)(exec_fn)
+
+
+def _abs(v):
+    return abs(v)
+
+
+_unary("abs", _abs, np.abs, int_passthrough=True)
+_unary("acos", math.acos, np.arccos)
+_unary("asin", math.asin, np.arcsin)
+_unary("atan", math.atan, np.arctan)
+_unary("cos", math.cos, np.cos)
+_unary("cosh", math.cosh, np.cosh)
+_unary("sin", math.sin, np.sin)
+_unary("sinh", math.sinh, np.sinh)
+_unary("tan", math.tan, np.tan)
+_unary("tanh", math.tanh, np.tanh)
+_unary("exp", math.exp, np.exp)
+_unary("ln", math.log, np.log)
+_unary("sqrt", math.sqrt, np.sqrt)
+_unary("radians", math.radians, np.radians)
+_unary("degrees", math.degrees, np.degrees)
+
+
+@register("log", SCALAR, vexec=lambda *a: np.log10(a[0]) if len(a) == 1 else np.log(a[1]) / np.log(a[0]))
+def f_log(args, ctx):
+    """log(x) = base-10; log(b, x) = base-b (reference semantics)."""
+    if any(a is None for a in args):
+        return None
+    if len(args) == 1:
+        return math.log10(cast.to_float(args[0]))
+    return math.log(cast.to_float(args[1]), cast.to_float(args[0]))
+
+
+@register("cot", SCALAR, vexec=lambda x: 1.0 / np.tan(x))
+def f_cot(args, ctx):
+    v = args[0]
+    return None if v is None else 1.0 / math.tan(cast.to_float(v))
+
+
+@register("atan2", SCALAR, vexec=np.arctan2)
+def f_atan2(args, ctx):
+    if args[0] is None or args[1] is None:
+        return None
+    return math.atan2(cast.to_float(args[0]), cast.to_float(args[1]))
+
+
+def _ceil_exec(args, ctx):
+    v = args[0]
+    if v is None:
+        return None
+    if isinstance(v, int) and not isinstance(v, bool):
+        return v
+    return float(math.ceil(cast.to_float(v)))
+
+
+register("ceil", SCALAR, vexec=np.ceil)(_ceil_exec)
+register("ceiling", SCALAR, vexec=np.ceil)(_ceil_exec)
+
+
+@register("floor", SCALAR, vexec=np.floor)
+def f_floor(args, ctx):
+    v = args[0]
+    if v is None:
+        return None
+    if isinstance(v, int) and not isinstance(v, bool):
+        return v
+    return float(math.floor(cast.to_float(v)))
+
+
+@register("round", SCALAR, vexec=np.round)
+def f_round(args, ctx):
+    v = args[0]
+    if v is None:
+        return None
+    if isinstance(v, int) and not isinstance(v, bool):
+        return v
+    # reference rounds half away from zero
+    f = cast.to_float(v)
+    return float(math.floor(f + 0.5) if f >= 0 else math.ceil(f - 0.5))
+
+
+@register("power", SCALAR, vexec=np.power)
+def f_power(args, ctx):
+    if args[0] is None or args[1] is None:
+        return None
+    x, y = args[0], args[1]
+    if (
+        isinstance(x, int) and isinstance(y, int)
+        and not isinstance(x, bool) and not isinstance(y, bool) and y >= 0
+    ):
+        return x ** y
+    return cast.to_float(x) ** cast.to_float(y)
+
+
+register("pow", SCALAR, vexec=np.power)(f_power)
+
+
+@register("mod", SCALAR, vexec=np.mod)
+def f_mod(args, ctx):
+    if args[0] is None or args[1] is None:
+        return None
+    x, y = args[0], args[1]
+    if (
+        isinstance(x, int) and isinstance(y, int)
+        and not isinstance(x, bool) and not isinstance(y, bool)
+    ):
+        return math.fmod(x, y).__trunc__()
+    return math.fmod(cast.to_float(x), cast.to_float(y))
+
+
+@register("sign", SCALAR, vexec=np.sign)
+def f_sign(args, ctx):
+    v = args[0]
+    if v is None:
+        return None
+    f = cast.to_float(v)
+    return 1 if f > 0 else (-1 if f < 0 else 0)
+
+
+@register("pi", SCALAR, vexec=lambda: np.float32(math.pi))
+def f_pi(args, ctx):
+    return math.pi
+
+
+@register("rand", SCALAR)
+def f_rand(args, ctx):
+    return random.random()
+
+
+@register("bitand", SCALAR, vexec=np.bitwise_and)
+def f_bitand(args, ctx):
+    if args[0] is None or args[1] is None:
+        return None
+    return cast.to_int(args[0], cast.STRICT) & cast.to_int(args[1], cast.STRICT)
+
+
+@register("bitor", SCALAR, vexec=np.bitwise_or)
+def f_bitor(args, ctx):
+    if args[0] is None or args[1] is None:
+        return None
+    return cast.to_int(args[0], cast.STRICT) | cast.to_int(args[1], cast.STRICT)
+
+
+@register("bitxor", SCALAR, vexec=np.bitwise_xor)
+def f_bitxor(args, ctx):
+    if args[0] is None or args[1] is None:
+        return None
+    return cast.to_int(args[0], cast.STRICT) ^ cast.to_int(args[1], cast.STRICT)
+
+
+@register("bitnot", SCALAR, vexec=np.invert)
+def f_bitnot(args, ctx):
+    v = args[0]
+    return None if v is None else ~cast.to_int(v, cast.STRICT)
+
+
+@register("conv", SCALAR)
+def f_conv(args, ctx):
+    """conv(str, from_base, to_base)"""
+    if any(a is None for a in args):
+        return None
+    s, fb, tb = cast.to_string(args[0]), cast.to_int(args[1]), cast.to_int(args[2])
+    n = int(s, fb)
+    if tb == 10:
+        return str(n)
+    digits = "0123456789abcdefghijklmnopqrstuvwxyz"
+    neg = n < 0
+    n = abs(n)
+    out = ""
+    while True:
+        out = digits[n % tb] + out
+        n //= tb
+        if n == 0:
+            break
+    return ("-" if neg else "") + out

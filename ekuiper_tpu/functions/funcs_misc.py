@@ -1,0 +1,346 @@
+"""Misc functions — analogue of internal/binder/function/funcs_misc.go (37 funcs):
+hashing, casts, json path, uuid, metadata, window info, keyed state.
+"""
+from __future__ import annotations
+
+import binascii
+import hashlib
+import json
+import uuid
+from typing import Any, List
+
+from ..data import cast
+from ..utils import timex
+from .registry import SCALAR, register
+
+
+@register("bypass", SCALAR)
+def f_bypass(args, ctx):
+    return args[0] if args else None
+
+
+@register("props", SCALAR)
+def f_props(args, ctx):
+    return None  # rule properties lookup; populated via ctx in runtime
+
+
+@register("cast", SCALAR)
+def f_cast(args, ctx):
+    """cast(value, "bigint"|"float"|"string"|"boolean"|"bytea"|"datetime")"""
+    v, t = args[0], cast.to_string(args[1]).lower()
+    if v is None:
+        return None
+    if t == "bigint":
+        return cast.to_int(v)
+    if t == "float":
+        return cast.to_float(v)
+    if t == "string":
+        return cast.to_string(v)
+    if t == "boolean":
+        return cast.to_bool(v)
+    if t == "bytea":
+        return cast.to_bytes(v)
+    if t == "datetime":
+        return cast.to_datetime_ms(v)
+    raise ValueError(f"unknown cast target type {t}")
+
+
+@register("convert_tz", SCALAR)
+def f_convert_tz(args, ctx):
+    import datetime as _dt
+    import zoneinfo
+
+    if args[0] is None:
+        return None
+    ms = cast.to_datetime_ms(args[0])
+    tz = zoneinfo.ZoneInfo(cast.to_string(args[1]))
+    d = _dt.datetime.fromtimestamp(ms / 1000.0, tz=tz)
+    # return wall-clock in target zone as epoch-ms-like naive value
+    naive = d.replace(tzinfo=_dt.timezone.utc)
+    return int(naive.timestamp() * 1000)
+
+
+@register("to_seconds", SCALAR)
+def f_to_seconds(args, ctx):
+    return None if args[0] is None else cast.to_datetime_ms(args[0]) // 1000
+
+
+@register("to_json", SCALAR)
+def f_to_json(args, ctx):
+    return json.dumps(args[0])
+
+
+@register("parse_json", SCALAR)
+def f_parse_json(args, ctx):
+    if args[0] is None or args[0] == "null":
+        return None
+    return json.loads(cast.to_string(args[0]))
+
+
+@register("chr", SCALAR)
+def f_chr(args, ctx):
+    v = args[0]
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return v[0] if v else None
+    return chr(cast.to_int(v))
+
+
+@register("encode", SCALAR)
+def f_encode(args, ctx):
+    import base64
+
+    if cast.to_string(args[1]).lower() != "base64":
+        raise ValueError("encode only supports base64")
+    v = args[0]
+    data = v if isinstance(v, bytes) else cast.to_string(v).encode()
+    return base64.b64encode(data).decode()
+
+
+@register("decode", SCALAR)
+def f_decode(args, ctx):
+    import base64
+
+    if cast.to_string(args[1]).lower() != "base64":
+        raise ValueError("decode only supports base64")
+    return base64.b64decode(cast.to_string(args[0]))
+
+
+@register("trunc", SCALAR)
+def f_trunc(args, ctx):
+    if args[0] is None:
+        return None
+    d = cast.to_int(args[1])
+    f = cast.to_float(args[0])
+    scale = 10 ** d
+    return int(f * scale) / scale
+
+
+def _hash(name: str, algo):
+    @register(name, SCALAR)
+    def f(args, ctx):
+        if args[0] is None:
+            return None
+        return algo(cast.to_string(args[0]).encode()).hexdigest()
+
+    return f
+
+
+_hash("md5", hashlib.md5)
+_hash("sha1", hashlib.sha1)
+_hash("sha256", hashlib.sha256)
+_hash("sha384", hashlib.sha384)
+_hash("sha512", hashlib.sha512)
+
+
+@register("crc32", SCALAR)
+def f_crc32(args, ctx):
+    if args[0] is None:
+        return None
+    return binascii.crc32(cast.to_string(args[0]).encode()) & 0xFFFFFFFF
+
+
+@register("isnull", SCALAR)
+def f_isnull(args, ctx):
+    return args[0] is None
+
+
+@register("coalesce", SCALAR)
+def f_coalesce(args, ctx):
+    for a in args:
+        if a is not None:
+            return a
+    return None
+
+
+@register("newuuid", SCALAR)
+def f_newuuid(args, ctx):
+    return str(uuid.uuid4())
+
+
+@register("tstamp", SCALAR)
+def f_tstamp(args, ctx):
+    return timex.now_ms()
+
+
+@register("rule_id", SCALAR)
+def f_rule_id(args, ctx):
+    return ctx.rule_id if ctx else ""
+
+
+@register("rule_start", SCALAR)
+def f_rule_start(args, ctx):
+    return ctx.get_state("__rule_start", 0) if ctx else 0
+
+
+@register("mqtt", SCALAR)
+def f_mqtt(args, ctx):
+    """mqtt(topic|messageid) — metadata of the mqtt source message."""
+    if ctx is None or ctx.row is None:
+        return None
+    key = args[0] if isinstance(args[0], str) else cast.to_string(args[0])
+    meta = getattr(ctx.row, "metadata", None)
+    return None if meta is None else meta.get(key)
+
+
+@register("meta", SCALAR)
+def f_meta(args, ctx):
+    if ctx is None or ctx.row is None:
+        return None
+    key = cast.to_string(args[0])
+    meta = getattr(ctx.row, "metadata", None)
+    if meta is None:
+        return None
+    # dotted path into metadata
+    cur: Any = meta
+    for part in key.split("."):
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        else:
+            return None
+    return cur
+
+
+@register("cardinality", SCALAR)
+def f_cardinality(args, ctx):
+    v = args[0]
+    if v is None:
+        return 0
+    if isinstance(v, (list, tuple, dict)):
+        return len(v)
+    raise ValueError("cardinality expects array or object")
+
+
+# ------------------------------------------------------------------ json path
+def json_path_eval(data: Any, path: str) -> List[Any]:
+    """Minimal eKuiper-compatible json path: $.a.b[0], [*], bare names."""
+    if path.startswith("$"):
+        path = path[1:]
+    cur: List[Any] = [data]
+    token = ""
+    i = 0
+    tokens: List[Any] = []
+    while i < len(path):
+        c = path[i]
+        if c == ".":
+            if token:
+                tokens.append(token)
+                token = ""
+            i += 1
+        elif c == "[":
+            if token:
+                tokens.append(token)
+                token = ""
+            j = path.find("]", i)
+            if j < 0:
+                raise ValueError(f"bad json path {path}")
+            inner = path[i + 1:j].strip()
+            if inner == "*":
+                tokens.append(("*",))
+            elif inner.startswith('"') or inner.startswith("'"):
+                tokens.append(inner[1:-1])
+            else:
+                tokens.append(("idx", int(inner)))
+            i = j + 1
+        else:
+            token += c
+            i += 1
+    if token:
+        tokens.append(token)
+    for t in tokens:
+        nxt: List[Any] = []
+        for item in cur:
+            if isinstance(t, str):
+                if isinstance(item, dict) and t in item:
+                    nxt.append(item[t])
+            elif t[0] == "*":
+                if isinstance(item, (list, tuple)):
+                    nxt.extend(item)
+                elif isinstance(item, dict):
+                    nxt.extend(item.values())
+            elif t[0] == "idx":
+                if isinstance(item, (list, tuple)) and -len(item) <= t[1] < len(item):
+                    nxt.append(item[t[1]])
+        cur = nxt
+    return cur
+
+
+@register("json_path_query", SCALAR)
+def f_json_path_query(args, ctx):
+    if args[0] is None:
+        return None
+    return json_path_eval(args[0], cast.to_string(args[1]))
+
+
+@register("json_path_query_first", SCALAR)
+def f_json_path_query_first(args, ctx):
+    if args[0] is None:
+        return None
+    out = json_path_eval(args[0], cast.to_string(args[1]))
+    return out[0] if out else None
+
+
+@register("json_path_exists", SCALAR)
+def f_json_path_exists(args, ctx):
+    if args[0] is None:
+        return False
+    try:
+        return len(json_path_eval(args[0], cast.to_string(args[1]))) > 0
+    except ValueError:
+        return False
+
+
+# ------------------------------------------------------------ window info
+@register("window_start", SCALAR)
+def f_window_start(args, ctx):
+    return ctx.window_range.window_start if ctx and ctx.window_range else 0
+
+
+@register("window_end", SCALAR)
+def f_window_end(args, ctx):
+    return ctx.window_range.window_end if ctx and ctx.window_range else 0
+
+
+@register("window_trigger", SCALAR)
+def f_window_trigger(args, ctx):
+    return ctx.trigger_time if ctx else 0
+
+
+@register("event_time", SCALAR)
+def f_event_time(args, ctx):
+    if ctx and ctx.row is not None:
+        return getattr(ctx.row, "timestamp", 0)
+    return 0
+
+
+@register("delay", SCALAR)
+def f_delay(args, ctx):
+    """delay(ms, value) — sleeps then returns value (reference parity)."""
+    timex.sleep(cast.to_int(args[0]))
+    return args[1]
+
+
+@register("get_keyed_state", SCALAR)
+def f_get_keyed_state(args, ctx):
+    """get_keyed_state(key, type, default) — global cross-rule state
+    (reference: internal/keyedstate/kv.go:28-36)."""
+    if ctx is None or ctx.keyed_state is None:
+        return args[2] if len(args) > 2 else None
+    v, ok = ctx.keyed_state.get_ok(cast.to_string(args[0]))
+    return v if ok else (args[2] if len(args) > 2 else None)
+
+
+@register("hex2dec", SCALAR)
+def f_hex2dec(args, ctx):
+    if args[0] is None:
+        return None
+    s = cast.to_string(args[0])
+    return int(s, 16)
+
+
+@register("dec2hex", SCALAR)
+def f_dec2hex(args, ctx):
+    if args[0] is None:
+        return None
+    return hex(cast.to_int(args[0]))

@@ -1,0 +1,106 @@
+"""Object functions — analogue of internal/binder/function/funcs_obj.go (11 funcs)."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..data import cast
+from .registry import SCALAR, register
+
+
+def _obj(v: Any) -> Dict[str, Any]:
+    if not isinstance(v, dict):
+        raise ValueError(f"expected object but got {type(v).__name__}")
+    return v
+
+
+@register("keys", SCALAR)
+def f_keys(args, ctx):
+    return None if args[0] is None else list(_obj(args[0]).keys())
+
+
+@register("values", SCALAR)
+def f_values(args, ctx):
+    return None if args[0] is None else list(_obj(args[0]).values())
+
+
+@register("object", SCALAR)
+def f_object(args, ctx):
+    """object(keys_array, values_array)"""
+    if args[0] is None or args[1] is None:
+        return None
+    ks, vs = args[0], args[1]
+    if len(ks) != len(vs):
+        raise ValueError("object(): keys and values must have equal length")
+    return {cast.to_string(k): v for k, v in zip(ks, vs)}
+
+
+@register("zip", SCALAR)
+def f_zip(args, ctx):
+    """zip(array_of_pairs) — [[k,v],...] → object"""
+    if args[0] is None:
+        return None
+    out = {}
+    for pair in args[0]:
+        if not isinstance(pair, (list, tuple)) or len(pair) != 2:
+            raise ValueError("zip(): each element must be a [key, value] pair")
+        out[cast.to_string(pair[0])] = pair[1]
+    return out
+
+
+@register("items", SCALAR)
+def f_items(args, ctx):
+    return None if args[0] is None else [[k, v] for k, v in _obj(args[0]).items()]
+
+
+@register("object_concat", SCALAR)
+def f_object_concat(args, ctx):
+    out: Dict[str, Any] = {}
+    for a in args:
+        if a is None:
+            continue
+        out.update(_obj(a))
+    return out
+
+
+@register("object_construct", SCALAR)
+def f_object_construct(args, ctx):
+    """object_construct(k1, v1, k2, v2, ...) — skips null values."""
+    if len(args) % 2 != 0:
+        raise ValueError("object_construct requires an even number of args")
+    out = {}
+    for i in range(0, len(args), 2):
+        if args[i + 1] is not None:
+            out[cast.to_string(args[i])] = args[i + 1]
+    return out
+
+
+@register("erase", SCALAR)
+def f_erase(args, ctx):
+    if args[0] is None:
+        return None
+    obj = dict(_obj(args[0]))
+    names = args[1] if isinstance(args[1], (list, tuple)) else [args[1]]
+    for name in names:
+        obj.pop(cast.to_string(name), None)
+    return obj
+
+
+@register("object_size", SCALAR)
+def f_object_size(args, ctx):
+    return 0 if args[0] is None else len(_obj(args[0]))
+
+
+@register("object_pick", SCALAR)
+def f_object_pick(args, ctx):
+    if args[0] is None:
+        return None
+    obj = _obj(args[0])
+    names = args[1] if isinstance(args[1], (list, tuple)) else list(args[1:])
+    return {cast.to_string(n): obj[cast.to_string(n)] for n in names if cast.to_string(n) in obj}
+
+
+@register("obj_to_kvpair_array", SCALAR)
+def f_obj_to_kvpair_array(args, ctx):
+    if args[0] is None:
+        return None
+    return [{"key": k, "value": v} for k, v in _obj(args[0]).items()]

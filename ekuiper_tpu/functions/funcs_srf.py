@@ -1,0 +1,17 @@
+"""Set-returning functions — analogue of internal/binder/function/funcs_srf.go.
+`unnest` expands an array field into multiple rows (ProjectSetOp)."""
+from __future__ import annotations
+
+from .registry import SRF, register
+
+
+@register("unnest", SRF)
+def f_unnest(args, ctx):
+    """Returns the list of rows to expand into. Array of objects merges each
+    object's fields into the row; scalars become the column value."""
+    v = args[0]
+    if v is None:
+        return []
+    if not isinstance(v, (list, tuple)):
+        raise ValueError("unnest expects an array")
+    return list(v)

@@ -1,0 +1,169 @@
+"""String functions — analogue of internal/binder/function/funcs_str.go (20 funcs).
+
+String columns live host-side (object dtype); these run on the host path. A
+few get numpy vexec via vectorized object ops where profitable.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from ..data import cast
+from .registry import SCALAR, register
+
+
+def _s(v: Any) -> str:
+    return cast.to_string(v)
+
+
+@register("concat", SCALAR)
+def f_concat(args, ctx):
+    return "".join(_s(a) for a in args if a is not None)
+
+
+@register("endswith", SCALAR)
+def f_endswith(args, ctx):
+    if args[0] is None or args[1] is None:
+        return False
+    return _s(args[0]).endswith(_s(args[1]))
+
+
+@register("startswith", SCALAR)
+def f_startswith(args, ctx):
+    if args[0] is None or args[1] is None:
+        return False
+    return _s(args[0]).startswith(_s(args[1]))
+
+
+@register("indexof", SCALAR)
+def f_indexof(args, ctx):
+    if args[0] is None or args[1] is None:
+        return None
+    return _s(args[0]).find(_s(args[1]))
+
+
+@register("length", SCALAR)
+def f_length(args, ctx):
+    v = args[0]
+    if v is None:
+        return None
+    if isinstance(v, (list, dict)):
+        return len(v)
+    return len(_s(v))
+
+
+@register("numbytes", SCALAR)
+def f_numbytes(args, ctx):
+    v = args[0]
+    return None if v is None else len(_s(v).encode("utf-8"))
+
+
+@register("lower", SCALAR)
+def f_lower(args, ctx):
+    v = args[0]
+    return None if v is None else _s(v).lower()
+
+
+@register("upper", SCALAR)
+def f_upper(args, ctx):
+    v = args[0]
+    return None if v is None else _s(v).upper()
+
+
+@register("lpad", SCALAR)
+def f_lpad(args, ctx):
+    if args[0] is None:
+        return None
+    return " " * cast.to_int(args[1]) + _s(args[0])
+
+
+@register("rpad", SCALAR)
+def f_rpad(args, ctx):
+    if args[0] is None:
+        return None
+    return _s(args[0]) + " " * cast.to_int(args[1])
+
+
+@register("ltrim", SCALAR)
+def f_ltrim(args, ctx):
+    v = args[0]
+    return None if v is None else _s(v).lstrip()
+
+
+@register("rtrim", SCALAR)
+def f_rtrim(args, ctx):
+    v = args[0]
+    return None if v is None else _s(v).rstrip()
+
+
+@register("trim", SCALAR)
+def f_trim(args, ctx):
+    v = args[0]
+    return None if v is None else _s(v).strip()
+
+
+@register("reverse", SCALAR)
+def f_reverse(args, ctx):
+    v = args[0]
+    return None if v is None else _s(v)[::-1]
+
+
+@register("regexp_matches", SCALAR)
+def f_regexp_matches(args, ctx):
+    if args[0] is None or args[1] is None:
+        return False
+    return re.search(_s(args[1]), _s(args[0])) is not None
+
+
+@register("regexp_replace", SCALAR)
+def f_regexp_replace(args, ctx):
+    if any(a is None for a in args[:3]):
+        return None
+    return re.sub(_s(args[1]), _s(args[2]), _s(args[0]))
+
+
+@register("regexp_substr", SCALAR)
+def f_regexp_substr(args, ctx):
+    if args[0] is None or args[1] is None:
+        return None
+    m = re.search(_s(args[1]), _s(args[0]))
+    return None if m is None else m.group(0)
+
+
+@register("substring", SCALAR)
+def f_substring(args, ctx):
+    """substring(str, start [, end]) — start inclusive, end exclusive
+    (reference semantics: 0-based)."""
+    if args[0] is None:
+        return None
+    s = _s(args[0])
+    start = cast.to_int(args[1])
+    if start < 0:
+        raise ValueError("substring start must be non-negative")
+    if len(args) > 2 and args[2] is not None:
+        end = cast.to_int(args[2])
+        if end < start:
+            raise ValueError("substring end must be >= start")
+        return s[start:end]
+    return s[start:]
+
+
+@register("split_value", SCALAR)
+def f_split_value(args, ctx):
+    """split_value(str, sep, index)"""
+    if any(a is None for a in args[:3]):
+        return None
+    parts = _s(args[0]).split(_s(args[1]))
+    idx = cast.to_int(args[2])
+    if idx >= len(parts) or idx < -len(parts):
+        raise ValueError(f"split_value index {idx} out of range")
+    return parts[idx]
+
+
+@register("format", SCALAR)
+def f_format(args, ctx):
+    """format(number, decimals) — fixed-point formatting."""
+    if args[0] is None:
+        return None
+    d = cast.to_int(args[1]) if len(args) > 1 else 0
+    return f"{cast.to_float(args[0]):.{d}f}"

@@ -1,0 +1,12 @@
+"""SQL window functions — analogue of internal/binder/function/funcs_window.go.
+Applied post-aggregation by the WindowFuncOp."""
+from __future__ import annotations
+
+from .registry import WINDOW_FUNC, register
+
+
+@register("row_number", WINDOW_FUNC, stateful=True)
+def f_row_number(args, ctx):
+    n = ctx.get_state("row_number", 0) + 1
+    ctx.put_state("row_number", n)
+    return n

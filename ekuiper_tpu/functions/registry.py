@@ -1,0 +1,147 @@
+"""Built-in function registry — analogue of eKuiper's single `builtins` map
+(reference: internal/binder/function/function.go:34-36) plus the binder
+factory chain (internal/binder/factory.go:24-61).
+
+Each function registers with metadata the planner needs:
+- `ftype`: scalar | aggregate | analytic | srf (set-returning) | window
+- `exec`: row-path implementation (python values)
+- `vexec`: optional vectorized implementation over numpy/jnp columns — the
+  TPU fast path; the expression compiler uses it when every node in an
+  expression tree is vectorizable
+- `val`: optional argument validator
+- `inc_name`: for aggregates with an incremental (streaming partial)
+  counterpart, its name (reference: funcs_inc_agg.go pairing)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+SCALAR = "scalar"
+AGGREGATE = "aggregate"
+ANALYTIC = "analytic"
+SRF = "srf"
+WINDOW_FUNC = "window"
+
+
+@dataclass
+class Accumulator:
+    """Streaming-partial protocol for incremental aggregates
+    (reference: funcs_inc_agg.go — WindowIncAggOperator pairing).
+
+    init() -> state; step(state, value) -> state; merge(a, b) -> state
+    (cross-shard combine over ICI); result(state) -> final value.
+    """
+
+    init: Callable[[], Any]
+    step: Callable[[Any, Any], Any]
+    result: Callable[[Any], Any]
+    merge: Optional[Callable[[Any, Any], Any]] = None
+
+
+@dataclass
+class FunctionDef:
+    name: str
+    ftype: str
+    exec: Callable[..., Any]
+    vexec: Optional[Callable[..., Any]] = None
+    val: Optional[Callable[[List[Any]], Optional[str]]] = None
+    inc_name: str = ""
+    # analytic/stateful functions get per-call-instance state
+    stateful: bool = False
+    # incremental-aggregate accumulator (inc_* functions)
+    acc: Optional[Accumulator] = None
+
+
+_registry: Dict[str, FunctionDef] = {}
+_providers: List[Callable[[str], Optional[FunctionDef]]] = []
+
+
+def register(
+    name: str,
+    ftype: str = SCALAR,
+    vexec: Optional[Callable[..., Any]] = None,
+    val: Optional[Callable[[List[Any]], Optional[str]]] = None,
+    inc_name: str = "",
+    stateful: bool = False,
+) -> Callable[[Callable[..., Any]], Callable[..., Any]]:
+    def wrap(fn: Callable[..., Any]) -> Callable[..., Any]:
+        _registry[name.lower()] = FunctionDef(
+            name=name.lower(), ftype=ftype, exec=fn, vexec=vexec, val=val,
+            inc_name=inc_name, stateful=stateful,
+        )
+        return fn
+
+    return wrap
+
+
+def register_def(fd: FunctionDef) -> None:
+    _registry[fd.name.lower()] = fd
+
+
+def add_provider(provider: Callable[[str], Optional[FunctionDef]]) -> None:
+    """Later-chance providers: plugins, external services, JS — the ordered
+    factory chain of the reference binder."""
+    _providers.append(provider)
+
+
+def lookup(name: str) -> Optional[FunctionDef]:
+    _ensure_loaded()
+    fd = _registry.get(name.lower())
+    if fd is not None:
+        return fd
+    for provider in _providers:
+        fd = provider(name.lower())
+        if fd is not None:
+            return fd
+    return None
+
+
+def exists(name: str) -> bool:
+    return lookup(name) is not None
+
+
+def is_aggregate(name: str) -> bool:
+    fd = lookup(name)
+    return fd is not None and fd.ftype == AGGREGATE
+
+
+def is_analytic(name: str) -> bool:
+    fd = lookup(name)
+    return fd is not None and fd.ftype == ANALYTIC
+
+
+def is_srf(name: str) -> bool:
+    fd = lookup(name)
+    return fd is not None and fd.ftype == SRF
+
+
+def all_names() -> List[str]:
+    _ensure_loaded()
+    return sorted(_registry.keys())
+
+
+_loaded = False
+
+
+def _ensure_loaded() -> None:
+    """Import builtin modules on first lookup (they self-register)."""
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    from . import (  # noqa: F401
+        funcs_acc,
+        funcs_agg,
+        funcs_analytic,
+        funcs_array,
+        funcs_datetime,
+        funcs_global_state,
+        funcs_inc_agg,
+        funcs_math,
+        funcs_misc,
+        funcs_obj,
+        funcs_srf,
+        funcs_str,
+        funcs_window,
+    )

@@ -1,0 +1,389 @@
+"""Row-path expression evaluator — analogue of eKuiper's ValuerEval tree
+interpreter (reference: internal/xsql/valuer.go:289 Eval, :574 evalBinaryExpr).
+
+This is the *fallback* path: per-row interpretation for expressions the
+vectorized compiler can't handle (and for joins/small collections). The hot
+path compiles expressions to whole-batch numpy/JAX computations instead
+(sql/compiler.py).
+"""
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+from ..data import cast
+from ..data.rows import GroupedTuples, Row
+from ..functions import registry
+from ..functions.context import FunctionContext
+from ..utils.infra import RuntimeError_
+from . import ast
+
+
+class EvalError(RuntimeError_):
+    pass
+
+
+class Evaluator:
+    """Evaluates expressions against a single Row.
+
+    `func_states` maps func_id -> per-instance state dict (stateful funcs);
+    owned by the operator so state survives across rows/batches and is
+    checkpointable.
+    """
+
+    def __init__(
+        self,
+        rule_id: str = "",
+        func_states: Optional[Dict[int, Dict[str, Any]]] = None,
+        window_range=None,
+        keyed_state=None,
+        trigger_time: int = 0,
+    ) -> None:
+        self.rule_id = rule_id
+        self.func_states = func_states if func_states is not None else {}
+        self.window_range = window_range
+        self.keyed_state = keyed_state
+        self.trigger_time = trigger_time
+
+    # ------------------------------------------------------------------ core
+    def eval(self, expr: ast.Expr, row: Optional[Row]) -> Any:
+        m = getattr(self, "_eval_" + type(expr).__name__, None)
+        if m is None:
+            raise EvalError(f"cannot evaluate {type(expr).__name__}")
+        return m(expr, row)
+
+    def eval_condition(self, expr: ast.Expr, row: Optional[Row]) -> bool:
+        v = self.eval(expr, row)
+        return v is True
+
+    # --------------------------------------------------------------- literals
+    def _eval_IntegerLiteral(self, e: ast.IntegerLiteral, row) -> Any:
+        return e.val
+
+    def _eval_NumberLiteral(self, e: ast.NumberLiteral, row) -> Any:
+        return e.val
+
+    def _eval_StringLiteral(self, e: ast.StringLiteral, row) -> Any:
+        return e.val
+
+    def _eval_BooleanLiteral(self, e: ast.BooleanLiteral, row) -> Any:
+        return e.val
+
+    def _eval_TimeLiteral(self, e: ast.TimeLiteral, row) -> Any:
+        return e.val
+
+    def _eval_Wildcard(self, e: ast.Wildcard, row) -> Any:
+        if row is None:
+            return {}
+        if e.stream and hasattr(row, "tuples"):
+            # stream.* over a join row: only that stream's columns
+            out: Dict[str, Any] = {}
+            for t in row.tuples:
+                if t.emitter == e.stream:
+                    out.update(t.all_values())
+        else:
+            out = row.all_values()
+        for name in e.except_names:
+            out.pop(name, None)
+        for f in e.replaces:
+            out[f.alias] = self.eval(f.expr, row)
+        return out
+
+    # ------------------------------------------------------------- references
+    def _eval_FieldRef(self, e: ast.FieldRef, row) -> Any:
+        if row is None:
+            return None
+        v, _ = row.value(e.name, e.stream)
+        return v
+
+    def _eval_MetaRef(self, e: ast.MetaRef, row) -> Any:
+        if row is None or not hasattr(row, "meta"):
+            return None
+        v, _ = row.meta(e.name)
+        return v
+
+    # -------------------------------------------------------------- operators
+    def _eval_UnaryExpr(self, e: ast.UnaryExpr, row) -> Any:
+        v = self.eval(e.expr, row)
+        if e.op == "NOT":
+            if v is None:
+                return None
+            return not cast.to_bool(v)
+        if e.op == "-":
+            if v is None:
+                return None
+            return -v
+        raise EvalError(f"unknown unary operator {e.op}")
+
+    def _eval_BinaryExpr(self, e: ast.BinaryExpr, row) -> Any:
+        op = e.op
+        if op == "AND":
+            lhs = self.eval(e.lhs, row)
+            if lhs is False:
+                return False
+            rhs = self.eval(e.rhs, row)
+            if rhs is False:
+                return False
+            if lhs is None or rhs is None:
+                return None
+            return cast.to_bool(lhs) and cast.to_bool(rhs)
+        if op == "OR":
+            lhs = self.eval(e.lhs, row)
+            if lhs is True:
+                return True
+            rhs = self.eval(e.rhs, row)
+            if rhs is True:
+                return True
+            if lhs is None or rhs is None:
+                return None
+            return cast.to_bool(lhs) or cast.to_bool(rhs)
+
+        lhs = self.eval(e.lhs, row)
+        rhs = self.eval(e.rhs, row)
+        if op in ("=", "!="):
+            if lhs is None or rhs is None:
+                # reference: null = null is true, null = x is false
+                eq = lhs is None and rhs is None
+                return eq if op == "=" else not eq
+            c = cast.compare(lhs, rhs)
+            if c is None:
+                eq = lhs == rhs
+            else:
+                eq = c == 0
+            return eq if op == "=" else not eq
+        if op in ("<", "<=", ">", ">="):
+            c = cast.compare(lhs, rhs)
+            if c is None:
+                return False
+            return {"<": c < 0, "<=": c <= 0, ">": c > 0, ">=": c >= 0}[op]
+        if lhs is None or rhs is None:
+            return None
+        if op in ("+", "-", "*", "/", "%"):
+            return self._arith(op, lhs, rhs)
+        if op in ("&", "|", "^"):
+            a, b = cast.to_int(lhs, cast.STRICT), cast.to_int(rhs, cast.STRICT)
+            return {"&": a & b, "|": a | b, "^": a ^ b}[op]
+        raise EvalError(f"unknown binary operator {op}")
+
+    @staticmethod
+    def _arith(op: str, lhs: Any, rhs: Any) -> Any:
+        if isinstance(lhs, str) or isinstance(rhs, str):
+            raise EvalError(
+                f"invalid operation string {op} — use concat() for strings"
+            )
+        both_int = (
+            isinstance(lhs, int) and isinstance(rhs, int)
+            and not isinstance(lhs, bool) and not isinstance(rhs, bool)
+        )
+        a = cast.to_float(lhs) if not both_int else lhs
+        b = cast.to_float(rhs) if not both_int else rhs
+        if op == "+":
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            if b == 0:
+                raise EvalError("division by zero")
+            return a // b if both_int else a / b
+        if op == "%":
+            if b == 0:
+                raise EvalError("division by zero")
+            return a % b
+        raise EvalError(f"unknown arith op {op}")
+
+    def _eval_BetweenExpr(self, e: ast.BetweenExpr, row) -> Any:
+        v = self.eval(e.value, row)
+        lo = self.eval(e.lo, row)
+        hi = self.eval(e.hi, row)
+        if v is None or lo is None or hi is None:
+            return None
+        c_lo = cast.compare(v, lo)
+        c_hi = cast.compare(v, hi)
+        if c_lo is None or c_hi is None:
+            return None  # incomparable types — NULL, like the comparison ops
+        result = c_lo >= 0 and c_hi <= 0
+        return not result if e.negate else result
+
+    def _eval_InExpr(self, e: ast.InExpr, row) -> Any:
+        v = self.eval(e.value, row)
+        if v is None:
+            return None
+        found = False
+        for item in e.values:
+            iv = self.eval(item, row)
+            if iv is not None and cast.compare(v, iv) == 0:
+                found = True
+                break
+            if iv == v:
+                found = True
+                break
+        return not found if e.negate else found
+
+    def _eval_LikeExpr(self, e: ast.LikeExpr, row) -> Any:
+        v = self.eval(e.value, row)
+        p = self.eval(e.pattern, row)
+        if v is None or p is None:
+            return None
+        # SQL LIKE: % any-run, _ single char; support \ escapes
+        regex = _like_to_regex(cast.to_string(p))
+        result = regex.fullmatch(cast.to_string(v)) is not None
+        return not result if e.negate else result
+
+    def _eval_CaseExpr(self, e: ast.CaseExpr, row) -> Any:
+        if e.value is not None:
+            v = self.eval(e.value, row)
+            for w in e.whens:
+                wv = self.eval(w.cond, row)
+                if wv is not None and (
+                    cast.compare(v, wv) == 0 or v == wv
+                ):
+                    return self.eval(w.result, row)
+        else:
+            for w in e.whens:
+                if self.eval(w.cond, row) is True:
+                    return self.eval(w.result, row)
+        if e.else_expr is not None:
+            return self.eval(e.else_expr, row)
+        return None
+
+    def _eval_IndexExpr(self, e: ast.IndexExpr, row) -> Any:
+        v = self.eval(e.value, row)
+        if v is None:
+            return None
+        if e.is_slice:
+            lo = self.eval(e.lo, row) if e.lo is not None else None
+            hi = self.eval(e.hi, row) if e.hi is not None else None
+            if not isinstance(v, (list, tuple, str)):
+                raise EvalError("slice on non-array value")
+            return v[lo:hi]
+        idx = self.eval(e.index, row)
+        if isinstance(v, dict):
+            return v.get(cast.to_string(idx))
+        if isinstance(v, (list, tuple, str)):
+            i = cast.to_int(idx)
+            if i < -len(v) or i >= len(v):
+                raise EvalError(f"index {i} out of range")
+            return v[i]
+        raise EvalError(f"cannot index {type(v).__name__}")
+
+    def _eval_ArrowExpr(self, e: ast.ArrowExpr, row) -> Any:
+        v = self.eval(e.value, row)
+        if v is None:
+            return None
+        if isinstance(v, dict):
+            return v.get(e.name)
+        raise EvalError(f"arrow access on non-struct {type(v).__name__}")
+
+    # ---------------------------------------------------------------- calls
+    def _ctx_for(self, call: ast.Call, row) -> FunctionContext:
+        state = self.func_states.setdefault(call.func_id, {})
+        return FunctionContext(
+            rule_id=self.rule_id,
+            func_id=call.func_id,
+            state=state,
+            window_range=self.window_range,
+            row=row,
+            keyed_state=self.keyed_state,
+            trigger_time=self.trigger_time,
+        )
+
+    def _eval_Call(self, e: ast.Call, row) -> Any:
+        fd = registry.lookup(e.name)
+        if fd is None:
+            raise EvalError(f"function {e.name} not found")
+        ctx = self._ctx_for(e, row)
+        if fd.ftype == registry.AGGREGATE:
+            return self._eval_agg_call(e, fd, row, ctx)
+        if fd.ftype == registry.ANALYTIC:
+            partition = ""
+            if e.partition:
+                partition = "#".join(
+                    cast.to_string(self.eval(p, row)) for p in e.partition
+                )
+            # OVER(WHEN false): peek state, don't update (reference validData=false)
+            update = e.when is None or self.eval(e.when, row) is True
+            args = [self.eval(a, row) for a in e.args]
+            try:
+                return fd.exec(args, ctx, partition, update)
+            except EvalError:
+                raise
+            except Exception as ex:
+                raise EvalError(f"call {e.name} error: {ex}") from ex
+        if e.filter is not None or e.partition:
+            raise EvalError(
+                f"FILTER/PARTITION BY not supported on scalar function {e.name}"
+            )
+        if e.when is not None:
+            if not fd.stateful:
+                raise EvalError(f"OVER(WHEN ...) not supported on {e.name}")
+            # stateful scalar (acc_*): WHEN true resets the accumulator state
+            if self.eval(e.when, row) is True:
+                ctx.state.clear()
+        args = [self.eval(a, row) for a in e.args]
+        try:
+            return fd.exec(args, ctx)
+        except EvalError:
+            raise
+        except Exception as ex:
+            raise EvalError(f"call {e.name} error: {ex}") from ex
+
+    def _eval_agg_call(self, e: ast.Call, fd, row, ctx) -> Any:
+        """Aggregate call: collect arg values over the group's rows.
+        `row` must be a GroupedTuples/Collection; a bare Row means we're in a
+        non-grouped agg context (whole collection = the row's group)."""
+        rows: List[Row]
+        if isinstance(row, GroupedTuples):
+            rows = row.rows()
+        elif hasattr(row, "rows"):
+            rows = row.rows()  # any Collection
+        else:
+            rows = [row] if row is not None else []
+        if e.filter is not None:
+            rows = [r for r in rows if self.eval_condition(e.filter, r)]
+        arg_lists: List[List[Any]] = []
+        for arg in e.args:
+            if isinstance(arg, ast.Wildcard):
+                arg_lists.append([1] * len(rows))  # count(*)
+            else:
+                vals = [self.eval(arg, r) for r in rows]
+                arg_lists.append(vals)
+        if not arg_lists:
+            arg_lists = [[1] * len(rows)]
+        # first arg: drop nulls for aggregates that skip them is handled in fn
+        try:
+            return fd.exec(arg_lists, ctx)
+        except EvalError:
+            raise
+        except Exception as ex:
+            raise EvalError(f"aggregate {e.name} error: {ex}") from ex
+
+
+_like_cache: Dict[str, Any] = {}
+
+
+def _like_to_regex(pattern: str):
+    rx = _like_cache.get(pattern)
+    if rx is None:
+        out = []
+        i = 0
+        while i < len(pattern):
+            c = pattern[i]
+            if c == "\\" and i + 1 < len(pattern):
+                out.append(re.escape(pattern[i + 1]))
+                i += 2
+                continue
+            if c == "%":
+                out.append(".*")
+            elif c == "_":
+                out.append(".")
+            else:
+                out.append(re.escape(c))
+            i += 1
+        rx = re.compile("".join(out), re.DOTALL)
+        if len(_like_cache) > 1024:
+            _like_cache.clear()
+        _like_cache[pattern] = rx
+    return rx

@@ -23,7 +23,9 @@ SECOND = 1000
 MINUTE = 60 * SECOND
 HOUR = 60 * MINUTE
 
-_UNIT_MS = {"ms": MS, "ss": SECOND, "mi": MINUTE, "hh": HOUR}
+DAY = 24 * HOUR
+
+_UNIT_MS = {"ms": MS, "ss": SECOND, "mi": MINUTE, "hh": HOUR, "dd": DAY}
 
 
 def unit_to_ms(unit: str) -> int:
@@ -31,7 +33,7 @@ def unit_to_ms(unit: str) -> int:
     try:
         return _UNIT_MS[unit.lower()]
     except KeyError:
-        raise ValueError(f"unknown time unit {unit!r} (want ms/ss/mi/hh)")
+        raise ValueError(f"unknown time unit {unit!r} (want dd/hh/mi/ss/ms)")
 
 
 class Timer:

@@ -1,0 +1,290 @@
+"""Expression evaluation tests: row interpreter, vectorized compiler, and
+cross-checks that both paths agree (the compat invariant the TPU path must
+hold against the reference's interpreter semantics)."""
+import numpy as np
+import pytest
+
+from ekuiper_tpu.data.batch import from_tuples
+from ekuiper_tpu.data.rows import GroupedTuples, Tuple
+from ekuiper_tpu.sql import ast
+from ekuiper_tpu.sql.compiler import NotVectorizable, compile_expr, try_compile
+from ekuiper_tpu.sql.eval import EvalError, Evaluator
+from ekuiper_tpu.sql.parser import parse_select
+
+
+def expr_of(sql_expr: str) -> ast.Expr:
+    return parse_select(f"SELECT {sql_expr} FROM demo").fields[0].expr
+
+
+def cond_of(sql_cond: str) -> ast.Expr:
+    return parse_select(f"SELECT * FROM demo WHERE {sql_cond}").condition
+
+
+ROW = Tuple(
+    emitter="demo",
+    message={
+        "a": 10, "b": 3, "f": 2.5, "s": "hello", "flag": True,
+        "arr": [1, 2, 3], "obj": {"x": 1, "y": {"z": 9}}, "nul": None,
+    },
+    timestamp=1000,
+    metadata={"topic": "t/1"},
+)
+
+
+class TestInterpreter:
+    def setup_method(self):
+        self.ev = Evaluator(rule_id="r1")
+
+    def t(self, expr_sql, expected):
+        assert self.ev.eval(expr_of(expr_sql), ROW) == expected
+
+    def test_arith(self):
+        self.t("a + b", 13)
+        self.t("a - b", 7)
+        self.t("a * b", 30)
+        self.t("a / b", 3)  # int division like the reference
+        self.t("a % b", 1)
+        self.t("a / 4.0", 2.5)
+        self.t("-a", -10)
+
+    def test_comparison(self):
+        self.t("a > b", True)
+        self.t("a = 10", True)
+        self.t("a != 10", False)
+        self.t("f <= 2.5", True)
+        self.t("s = 'hello'", True)
+
+    def test_logic_null(self):
+        self.t("a > 5 AND f < 3", True)
+        self.t("a > 5 OR f > 3", True)
+        self.t("NOT flag", False)
+        # null propagation: null = null true; null = x false
+        self.t("nul = nul", True)
+        self.t("nul = a", False)
+        assert self.ev.eval(cond_of("nul > 1"), ROW) is False
+
+    def test_division_by_zero(self):
+        with pytest.raises(EvalError):
+            self.ev.eval(expr_of("a / 0"), ROW)
+
+    def test_string_arith_error(self):
+        with pytest.raises(EvalError):
+            self.ev.eval(expr_of("s + 1"), ROW)
+
+    def test_in_between_like(self):
+        self.t("a IN (1, 10, 20)", True)
+        self.t("a NOT IN (1, 2)", True)
+        self.t("a BETWEEN 5 AND 15", True)
+        self.t("a NOT BETWEEN 5 AND 15", False)
+        self.t("s LIKE 'hel%'", True)
+        self.t("s LIKE 'h_llo'", True)
+        self.t("s NOT LIKE 'x%'", True)
+
+    def test_case(self):
+        self.t("CASE WHEN a > 5 THEN 'big' ELSE 'small' END", "big")
+        self.t("CASE a WHEN 10 THEN 'ten' WHEN 20 THEN 'twenty' END", "ten")
+        self.t("CASE WHEN a > 99 THEN 1 END", None)
+
+    def test_json_access(self):
+        self.t("arr[0]", 1)
+        self.t("arr[-1]", 3)
+        self.t("arr[1:3]", [2, 3])
+        self.t("obj->x", 1)
+        self.t("obj->y->z", 9)
+
+    def test_functions(self):
+        self.t("abs(0 - a)", 10)
+        self.t("lower('ABC')", "abc")
+        self.t("concat(s, '!')", "hello!")
+        self.t("coalesce(nul, a)", 10)
+        self.t("cast(f, 'bigint')", 2)
+        self.t("power(2, 10)", 1024)
+
+    def test_meta_function(self):
+        assert self.ev.eval(expr_of("meta('topic')"), ROW) == "t/1"
+
+    def test_wildcard(self):
+        out = self.ev.eval(expr_of("*"), ROW)
+        assert out["a"] == 10 and "s" in out
+
+
+class TestAggregates:
+    def setup_method(self):
+        self.ev = Evaluator()
+        rows = [
+            Tuple(message={"v": 1.0, "d": "x"}),
+            Tuple(message={"v": 2.0, "d": "x"}),
+            Tuple(message={"v": 6.0, "d": "x"}),
+        ]
+        self.group = GroupedTuples(content=rows, group_key="x")
+
+    def a(self, sql, expected):
+        assert self.ev.eval(expr_of(sql), self.group) == expected
+
+    def test_basic_aggs(self):
+        self.a("avg(v)", 3.0)
+        self.a("sum(v)", 9.0)
+        self.a("count(*)", 3)
+        self.a("count(v)", 3)
+        self.a("min(v)", 1.0)
+        self.a("max(v)", 6.0)
+        self.a("collect(v)", [1.0, 2.0, 6.0])
+
+    def test_agg_filter_clause(self):
+        self.a("sum(v) FILTER (WHERE v > 1)", 8.0)
+
+    def test_stddev(self):
+        out = self.ev.eval(expr_of("stddev(v)"), self.group)
+        assert abs(out - np.std([1, 2, 6])) < 1e-9
+
+    def test_int_avg(self):
+        rows = [Tuple(message={"n": 1}), Tuple(message={"n": 2})]
+        g = GroupedTuples(content=rows)
+        assert self.ev.eval(expr_of("avg(n)"), g) == 1  # int avg truncates
+
+    def test_group_key_column(self):
+        assert self.ev.eval(expr_of("d"), self.group) == "x"
+
+
+class TestAnalytic:
+    def test_lag(self):
+        ev = Evaluator()
+        e = expr_of("lag(a)")
+        rows = [Tuple(message={"a": i}) for i in (10, 20, 30)]
+        out = [ev.eval(e, r) for r in rows]
+        assert out == [None, 10, 20]
+
+    def test_lag_partitioned(self):
+        ev = Evaluator()
+        e = expr_of("lag(v) OVER (PARTITION BY dev)")
+        rows = [
+            Tuple(message={"dev": "a", "v": 1}),
+            Tuple(message={"dev": "b", "v": 2}),
+            Tuple(message={"dev": "a", "v": 3}),
+            Tuple(message={"dev": "b", "v": 4}),
+        ]
+        out = [ev.eval(e, r) for r in rows]
+        assert out == [None, None, 1, 2]
+
+    def test_had_changed(self):
+        ev = Evaluator()
+        e = expr_of("had_changed(true, a)")
+        rows = [Tuple(message={"a": 1}), Tuple(message={"a": 1}), Tuple(message={"a": 2})]
+        assert [ev.eval(e, r) for r in rows] == [True, False, True]
+
+
+def _batch():
+    rows = [
+        Tuple(message={"a": 10, "f": 1.5, "dev": "d1"}),
+        Tuple(message={"a": 20, "f": 2.5, "dev": "d2"}),
+        Tuple(message={"a": 30, "f": 3.5, "dev": "d1"}),
+    ]
+    return from_tuples(rows)
+
+
+class TestCompilerHost:
+    def c(self, sql):
+        return compile_expr(expr_of(sql), mode="host")
+
+    def test_arith_vec(self):
+        b = _batch()
+        out = self.c("a * 2 + f")(b.columns)
+        assert list(out) == [21.5, 42.5, 63.5]
+
+    def test_compare_logic(self):
+        b = _batch()
+        out = self.c("a > 15 AND f < 3.0")(b.columns)
+        assert list(out) == [False, True, False]
+
+    def test_case_where(self):
+        b = _batch()
+        out = self.c("CASE WHEN a > 15 THEN 1 ELSE 0 END")(b.columns)
+        assert list(out) == [0, 1, 1]
+
+    def test_in(self):
+        b = _batch()
+        out = self.c("a IN (10, 30)")(b.columns)
+        assert list(out) == [True, False, True]
+
+    def test_math_funcs(self):
+        b = _batch()
+        out = self.c("sqrt(f * f)")(b.columns)
+        np.testing.assert_allclose(out, [1.5, 2.5, 3.5], rtol=1e-6)
+
+    def test_string_like_host(self):
+        b = _batch()
+        out = self.c("dev LIKE 'd%'")(b.columns)
+        assert list(out) == [True, True, True]
+
+    def test_string_eq_host(self):
+        b = _batch()
+        out = self.c("dev = 'd1'")(b.columns)
+        assert list(out) == [True, False, True]
+
+    def test_not_vectorizable(self):
+        assert try_compile(expr_of("lag(a)")) is None
+        assert try_compile(expr_of("obj->x")) is None
+        assert try_compile(expr_of("newuuid()")) is None
+
+    def test_referenced_columns(self):
+        ce = self.c("a + f > 2")
+        assert ce.columns == {"a", "f"}
+
+
+class TestCompilerDevice:
+    def test_device_jit(self):
+        import jax
+        import jax.numpy as jnp
+
+        ce = compile_expr(expr_of("a * 2.0 + sqrt(f)"), mode="device")
+        fn = jax.jit(lambda cols: ce(cols))
+        cols = {
+            "a": jnp.asarray([1.0, 2.0], dtype=jnp.float32),
+            "f": jnp.asarray([4.0, 9.0], dtype=jnp.float32),
+        }
+        out = np.asarray(fn(cols))
+        np.testing.assert_allclose(out, [4.0, 7.0], rtol=1e-6)
+
+    def test_device_rejects_strings(self):
+        assert try_compile(expr_of("dev LIKE 'd%'"), mode="device") is None
+        assert try_compile(expr_of("concat(dev, 'x')"), mode="device") is None
+
+    def test_device_case_cond(self):
+        import jax
+        import jax.numpy as jnp
+
+        ce = compile_expr(
+            expr_of("CASE WHEN t > 30.0 THEN t - 30.0 ELSE 0.0 END"), mode="device"
+        )
+        out = jax.jit(ce.fn)({"t": jnp.asarray([25.0, 35.0])})
+        np.testing.assert_allclose(np.asarray(out), [0.0, 5.0])
+
+
+class TestCrossCheck:
+    """Interpreter and compiled host path must agree."""
+
+    EXPRS = [
+        "a + f * 2",
+        "a > 15",
+        "a % 3",
+        "a / 2",
+        "abs(0 - a)",
+        "CASE WHEN a >= 20 THEN f ELSE 0.0 END",
+        "a BETWEEN 15 AND 25",
+        "a IN (10, 20)",
+        "NOT (a > 15)",
+    ]
+
+    @pytest.mark.parametrize("sql", EXPRS)
+    def test_agree(self, sql):
+        expr = expr_of(sql)
+        b = _batch()
+        ev = Evaluator()
+        interp = [ev.eval(expr, r) for r in b.to_tuples()]
+        compiled = compile_expr(expr, mode="host")(b.columns)
+        for i, exp in enumerate(interp):
+            got = compiled[i]
+            if isinstance(exp, bool):
+                assert bool(got) == exp, f"{sql} row {i}: {got} != {exp}"
+            else:
+                assert abs(float(got) - float(exp)) < 1e-5, f"{sql} row {i}"

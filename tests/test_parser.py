@@ -1,0 +1,281 @@
+"""Parser golden tests — modeled on the reference's parser test strategy
+(internal/xsql/parser_test.go, parser_agg_test.go)."""
+import pytest
+
+from ekuiper_tpu.data.types import DataType
+from ekuiper_tpu.sql import ast
+from ekuiper_tpu.sql.parser import parse, parse_select
+from ekuiper_tpu.utils.infra import ParseError
+
+
+class TestSelect:
+    def test_simple(self):
+        stmt = parse_select("SELECT * FROM demo")
+        assert isinstance(stmt.fields[0].expr, ast.Wildcard)
+        assert stmt.sources[0].name == "demo"
+
+    def test_fields_alias(self):
+        stmt = parse_select("SELECT temperature AS t, humidity FROM demo")
+        assert stmt.fields[0].alias == "t"
+        assert stmt.fields[0].name == "temperature"
+        assert stmt.fields[1].name == "humidity"
+
+    def test_where_precedence(self):
+        stmt = parse_select(
+            "SELECT a FROM demo WHERE a > 1 AND b < 2 OR c = 3"
+        )
+        cond = stmt.condition
+        assert isinstance(cond, ast.BinaryExpr) and cond.op == "OR"
+        assert cond.lhs.op == "AND"
+        assert cond.lhs.lhs.op == ">"
+
+    def test_arith_precedence(self):
+        stmt = parse_select("SELECT a + b * c FROM demo")
+        e = stmt.fields[0].expr
+        assert e.op == "+" and e.rhs.op == "*"
+
+    def test_parens(self):
+        stmt = parse_select("SELECT (a + b) * c FROM demo")
+        e = stmt.fields[0].expr
+        assert e.op == "*" and e.lhs.op == "+"
+
+    def test_qualified_ref(self):
+        stmt = parse_select("SELECT demo.temperature FROM demo")
+        ref = stmt.fields[0].expr
+        assert ref.stream == "demo" and ref.name == "temperature"
+
+    def test_function_call(self):
+        stmt = parse_select("SELECT avg(temperature) AS t FROM demo")
+        call = stmt.fields[0].expr
+        assert isinstance(call, ast.Call) and call.name == "avg"
+        assert isinstance(call.args[0], ast.FieldRef)
+
+    def test_count_star(self):
+        stmt = parse_select("SELECT count(*) FROM demo")
+        call = stmt.fields[0].expr
+        assert call.name == "count" and isinstance(call.args[0], ast.Wildcard)
+        assert stmt.fields[0].name == "count"
+
+    def test_case_when(self):
+        stmt = parse_select(
+            "SELECT CASE WHEN a > 1 THEN 'big' ELSE 'small' END AS size FROM demo"
+        )
+        case = stmt.fields[0].expr
+        assert isinstance(case, ast.CaseExpr)
+        assert case.value is None and len(case.whens) == 1
+        assert case.else_expr.val == "small"
+
+    def test_case_value(self):
+        stmt = parse_select("SELECT CASE color WHEN 'red' THEN 1 WHEN 'blue' THEN 2 END FROM demo")
+        case = stmt.fields[0].expr
+        assert isinstance(case.value, ast.FieldRef) and len(case.whens) == 2
+
+    def test_in_between_like(self):
+        stmt = parse_select(
+            "SELECT a FROM demo WHERE a IN (1, 2, 3) AND b BETWEEN 1 AND 10 AND c LIKE 'x%'"
+        )
+        cond = stmt.condition
+        assert isinstance(cond.rhs, ast.LikeExpr)
+        assert isinstance(cond.lhs.rhs, ast.BetweenExpr)
+        assert isinstance(cond.lhs.lhs, ast.InExpr)
+        assert len(cond.lhs.lhs.values) == 3
+
+    def test_not_variants(self):
+        stmt = parse_select("SELECT a FROM demo WHERE a NOT IN (1) AND b NOT BETWEEN 1 AND 2 AND c NOT LIKE 'z'")
+        c = stmt.condition
+        assert c.rhs.negate and c.lhs.rhs.negate and c.lhs.lhs.negate
+
+    def test_json_path_ops(self):
+        stmt = parse_select("SELECT data->device->id, readings[0], values[1:3] FROM demo")
+        arrow = stmt.fields[0].expr
+        assert isinstance(arrow, ast.ArrowExpr) and arrow.name == "id"
+        assert isinstance(arrow.value, ast.ArrowExpr)
+        idx = stmt.fields[1].expr
+        assert isinstance(idx, ast.IndexExpr) and not idx.is_slice
+        sl = stmt.fields[2].expr
+        assert sl.is_slice and sl.lo.val == 1 and sl.hi.val == 3
+
+    def test_joins(self):
+        stmt = parse_select(
+            "SELECT * FROM s1 LEFT JOIN s2 ON s1.id = s2.id INNER JOIN t1 ON s1.id = t1.id"
+        )
+        assert stmt.joins[0].join_type == ast.JoinType.LEFT
+        assert stmt.joins[1].join_type == ast.JoinType.INNER
+        assert stmt.joins[0].table.name == "s2"
+
+    def test_group_having_order_limit(self):
+        stmt = parse_select(
+            "SELECT deviceId, avg(temp) FROM demo GROUP BY deviceId "
+            "HAVING avg(temp) > 20 ORDER BY deviceId DESC LIMIT 10"
+        )
+        assert len(stmt.dimensions) == 1
+        assert stmt.having.op == ">"
+        assert not stmt.sorts[0].ascending
+        assert stmt.limit == 10
+
+    def test_wildcard_except_replace(self):
+        stmt = parse_select("SELECT * EXCEPT(a, b) REPLACE(c*2 AS c) FROM demo")
+        wc = stmt.fields[0].expr
+        assert wc.except_names == ["a", "b"]
+        assert wc.replaces[0].alias == "c"
+
+
+class TestWindows:
+    def test_tumbling(self):
+        stmt = parse_select(
+            "SELECT count(*) FROM demo GROUP BY TUMBLINGWINDOW(ss, 10)"
+        )
+        w = stmt.window
+        assert w.window_type == ast.WindowType.TUMBLING_WINDOW
+        assert w.time_unit == "SS" and w.length == 10
+        assert w.length_ms() == 10_000
+
+    def test_hopping(self):
+        stmt = parse_select(
+            "SELECT * FROM demo GROUP BY deviceId, HOPPINGWINDOW(mi, 10, 5)"
+        )
+        w = stmt.window
+        assert w.window_type == ast.WindowType.HOPPING_WINDOW
+        assert w.length == 10 and w.interval == 5
+        assert len(stmt.dimensions) == 1
+
+    def test_sliding_with_delay(self):
+        stmt = parse_select("SELECT * FROM demo GROUP BY SLIDINGWINDOW(ss, 10, 2)")
+        w = stmt.window
+        assert w.window_type == ast.WindowType.SLIDING_WINDOW
+        assert w.length == 10 and w.delay == 2 and not w.interval
+
+    def test_session(self):
+        stmt = parse_select("SELECT * FROM demo GROUP BY SESSIONWINDOW(ss, 10, 5)")
+        w = stmt.window
+        assert w.window_type == ast.WindowType.SESSION_WINDOW
+        assert w.length == 10 and w.interval == 5
+
+    def test_count_window(self):
+        stmt = parse_select("SELECT * FROM demo GROUP BY COUNTWINDOW(5)")
+        assert stmt.window.window_type == ast.WindowType.COUNT_WINDOW
+        assert stmt.window.length == 5
+        stmt2 = parse_select("SELECT * FROM demo GROUP BY COUNTWINDOW(10, 5)")
+        assert stmt2.window.interval == 5
+
+    def test_count_window_invalid(self):
+        with pytest.raises(ParseError):
+            parse_select("SELECT * FROM demo GROUP BY COUNTWINDOW(5, 10)")
+
+    def test_window_bad_unit(self):
+        with pytest.raises(ParseError):
+            parse_select("SELECT * FROM demo GROUP BY TUMBLINGWINDOW(xx, 10)")
+
+    def test_window_bad_arity(self):
+        with pytest.raises(ParseError):
+            parse_select("SELECT * FROM demo GROUP BY TUMBLINGWINDOW(ss, 10, 5)")
+
+    def test_two_windows_rejected(self):
+        with pytest.raises(ParseError):
+            parse_select(
+                "SELECT * FROM demo GROUP BY TUMBLINGWINDOW(ss, 10), COUNTWINDOW(5)"
+            )
+
+    def test_sliding_over_when(self):
+        stmt = parse_select(
+            "SELECT * FROM demo GROUP BY SLIDINGWINDOW(ss, 10) OVER (WHEN temp > 30)"
+        )
+        assert stmt.window.trigger_condition is not None
+
+    def test_window_filter(self):
+        stmt = parse_select(
+            "SELECT * FROM demo GROUP BY TUMBLINGWINDOW(ss, 10) FILTER (WHERE temp > 0)"
+        )
+        assert stmt.window.filter is not None
+
+    def test_state_window(self):
+        stmt = parse_select(
+            "SELECT * FROM demo GROUP BY STATEWINDOW(a > 1, a < 0)"
+        )
+        w = stmt.window
+        assert w.window_type == ast.WindowType.STATE_WINDOW
+        assert w.begin_condition is not None and w.emit_condition is not None
+
+
+class TestAnalytic:
+    def test_lag_partition(self):
+        stmt = parse_select(
+            "SELECT lag(temp) OVER (PARTITION BY deviceId) FROM demo"
+        )
+        call = stmt.fields[0].expr
+        assert call.name == "lag" and len(call.partition) == 1
+
+    def test_filter_clause(self):
+        stmt = parse_select("SELECT count(*) FILTER (WHERE a > 1) FROM demo")
+        assert stmt.fields[0].expr.filter is not None
+
+    def test_func_ids_distinct(self):
+        stmt = parse_select("SELECT lag(a), lag(b) FROM demo")
+        assert stmt.fields[0].expr.func_id != stmt.fields[1].expr.func_id
+
+
+class TestDDL:
+    def test_create_stream(self):
+        stmt = parse(
+            'CREATE STREAM demo (deviceId STRING, temp FLOAT, ok BOOLEAN) '
+            'WITH (DATASOURCE="topic/demo", FORMAT="JSON", TYPE="mqtt")'
+        )
+        assert isinstance(stmt, ast.StreamStmt)
+        assert not stmt.is_table
+        assert [f.name for f in stmt.fields] == ["deviceId", "temp", "ok"]
+        assert stmt.fields[1].type == DataType.FLOAT
+        assert stmt.options.datasource == "topic/demo"
+        assert stmt.options.format == "JSON"
+        assert stmt.options.type == "mqtt"
+
+    def test_create_schemaless(self):
+        stmt = parse('CREATE STREAM demo () WITH (DATASOURCE="t", SHARED="true")')
+        assert stmt.fields == [] and stmt.options.shared
+
+    def test_create_nested_types(self):
+        stmt = parse(
+            "CREATE STREAM demo (tags ARRAY(STRING), info STRUCT(id BIGINT, name STRING)) "
+            'WITH (DATASOURCE="t")'
+        )
+        assert stmt.fields[0].type == DataType.ARRAY
+        assert stmt.fields[0].elem_type == DataType.STRING
+        assert stmt.fields[1].type == DataType.STRUCT
+        assert stmt.fields[1].fields[0].name == "id"
+
+    def test_create_table(self):
+        stmt = parse('CREATE TABLE t1 (id BIGINT) WITH (DATASOURCE="lookup.json", KIND="lookup")')
+        assert stmt.is_table and stmt.options.kind == "lookup"
+
+    def test_show_describe_drop(self):
+        assert parse("SHOW STREAMS").target == "STREAMS"
+        assert parse("SHOW TABLES").target == "TABLES"
+        d = parse("DESCRIBE STREAM demo")
+        assert d.target == "STREAM" and d.name == "demo"
+        assert parse("DROP STREAM demo").name == "demo"
+        assert parse("DROP TABLE t1").target == "TABLE"
+
+    def test_bad_option(self):
+        with pytest.raises(ParseError):
+            parse('CREATE STREAM demo () WITH (BOGUS="x")')
+
+
+class TestErrors:
+    def test_no_from(self):
+        with pytest.raises(ParseError):
+            parse("SELECT a")
+
+    def test_trailing_garbage(self):
+        with pytest.raises(ParseError):
+            parse("SELECT a FROM demo extra extra")
+
+    def test_unterminated_string(self):
+        with pytest.raises(ParseError):
+            parse("SELECT 'abc FROM demo")
+
+    def test_join_requires_on(self):
+        with pytest.raises(ParseError):
+            parse("SELECT * FROM a LEFT JOIN b")
+
+    def test_cross_join_no_on(self):
+        stmt = parse_select("SELECT * FROM a CROSS JOIN b")
+        assert stmt.joins[0].join_type == ast.JoinType.CROSS
