@@ -147,6 +147,16 @@ class WindowTuples(Collection):
     def rows(self) -> List[Row]:
         return self.content
 
+    # acts as the aggregate context for ungrouped agg queries: non-agg
+    # columns read from the first row (reference semantics)
+    def value(self, key: str, table: str = "") -> PyTuple[Any, bool]:
+        if self.content:
+            return self.content[0].value(key, table)
+        return None, False
+
+    def all_values(self) -> Dict[str, Any]:
+        return self.content[0].all_values() if self.content else {}
+
 
 @dataclass
 class GroupedTuples(Collection):
@@ -157,6 +167,9 @@ class GroupedTuples(Collection):
     group_key: str = ""
     window_range: Optional[WindowRange] = None
     cal_cols: Dict[str, Any] = field(default_factory=dict)
+    # precomputed aggregate results by call key — filled by the device kernel
+    # path so the evaluator skips per-group recomputation
+    agg_values: Dict[str, Any] = field(default_factory=dict)
 
     def rows(self) -> List[Row]:
         return self.content

@@ -1,0 +1,68 @@
+"""IO contract — analogue of eKuiper's contract/api source/sink interfaces
+(contract/api/source.go:24-70, sink.go:21-41).
+
+Sources push decoded payloads (dict / list / Tuple) into an ingest callback;
+sinks collect result rows. Both get (props, …) configuration at build time
+from the registry (io/registry.py) mirroring the binder io factories.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+IngestFn = Callable[..., None]
+
+
+class Source:
+    """Push source (analogue api.Source / api.TupleSource)."""
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        pass
+
+    def open(self, ingest: IngestFn) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LookupSource:
+    """Lookup-table source (analogue api.LookupSource)."""
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        pass
+
+    def open(self) -> None:
+        pass
+
+    def lookup(self, fields: List[str], keys: List[str], values: List[Any]) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class Sink:
+    """Collector sink (analogue api.Sink / api.TupleCollector)."""
+
+    def configure(self, props: Dict[str, Any]) -> None:
+        pass
+
+    def connect(self) -> None:
+        pass
+
+    def collect(self, item: Any) -> None:
+        """item: dict (single) or list of dicts."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class Rewindable:
+    """Sources that can report/replay offsets (contract/api/source.go:38-43)."""
+
+    def get_offset(self) -> Any:
+        raise NotImplementedError
+
+    def rewind(self, offset: Any) -> None:
+        raise NotImplementedError

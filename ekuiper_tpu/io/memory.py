@@ -1,0 +1,132 @@
+"""In-process memory pub/sub — analogue of eKuiper's memory source/sink
+(internal/io/memory/pubsub/manager.go:45-130): topic-based, wildcard
+subscriptions (`+` single level, `#` multi level), the rule-pipeline
+mechanism (rule A's memory sink feeds rule B's memory stream).
+"""
+from __future__ import annotations
+
+import re
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from .contract import Sink, Source
+
+_lock = threading.RLock()
+
+
+def _topic_regex(pattern: str) -> re.Pattern:
+    parts = pattern.split("/")
+    out = []
+    for i, p in enumerate(parts):
+        if p == "#":
+            out.append(".*")
+            break
+        if p == "+":
+            out.append("[^/]+")
+        else:
+            out.append(re.escape(p))
+    return re.compile("^" + "/".join(out) + "$")
+
+
+class _Sub:
+    def __init__(self, pattern: str, fn: Callable[[str, Any], None]) -> None:
+        self.pattern = pattern
+        self.regex = _topic_regex(pattern)
+        self.fn = fn
+
+
+_subs: List[_Sub] = []
+
+
+def publish(topic: str, payload: Any) -> None:
+    with _lock:
+        targets = [s.fn for s in _subs if s.regex.match(topic)]
+    for fn in targets:
+        fn(topic, payload)
+
+
+def subscribe(pattern: str, fn: Callable[[str, Any], None]) -> Callable[[], None]:
+    sub = _Sub(pattern, fn)
+    with _lock:
+        _subs.append(sub)
+
+    def unsubscribe() -> None:
+        with _lock:
+            try:
+                _subs.remove(sub)
+            except ValueError:
+                pass
+
+    return unsubscribe
+
+
+def reset() -> None:
+    with _lock:
+        _subs.clear()
+
+
+class MemorySource(Source):
+    def __init__(self) -> None:
+        self.topic = ""
+        self._unsub: Optional[Callable[[], None]] = None
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.topic = datasource or props.get("topic", "")
+
+    def open(self, ingest) -> None:
+        self._unsub = subscribe(
+            self.topic, lambda topic, payload: ingest(payload, {"topic": topic})
+        )
+
+    def close(self) -> None:
+        if self._unsub is not None:
+            self._unsub()
+
+
+class MemorySink(Sink):
+    def __init__(self) -> None:
+        self.topic = ""
+
+    def configure(self, props: Dict[str, Any]) -> None:
+        self.topic = props.get("topic", "")
+
+    def collect(self, item: Any) -> None:
+        publish(self.topic, item)
+
+
+class MemoryLookupSource:
+    """Lookup table over memory topic updates keyed by a field
+    (analogue internal/io/memory lookup)."""
+
+    def __init__(self) -> None:
+        self.topic = ""
+        self.key = ""
+        self._table: Dict[Any, Dict[str, Any]] = {}
+        self._unsub: Optional[Callable[[], None]] = None
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.topic = datasource or props.get("topic", "")
+        self.key = props.get("key", "")
+
+    def open(self) -> None:
+        def on_msg(topic: str, payload: Any) -> None:
+            rows = payload if isinstance(payload, list) else [payload]
+            for row in rows:
+                if isinstance(row, dict) and self.key in row:
+                    self._table[row[self.key]] = row
+
+        self._unsub = subscribe(self.topic, on_msg)
+
+    def lookup(self, fields, keys, values) -> List[Dict[str, Any]]:
+        if len(keys) == 1 and keys[0] == self.key:
+            row = self._table.get(values[0])
+            return [row] if row is not None else []
+        out = []
+        for row in self._table.values():
+            if all(row.get(k) == v for k, v in zip(keys, values)):
+                out.append(row)
+        return out
+
+    def close(self) -> None:
+        if self._unsub is not None:
+            self._unsub()

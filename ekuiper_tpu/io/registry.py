@@ -1,0 +1,86 @@
+"""IO registry — analogue of the binder io factories
+(internal/binder/io/builtin.go:36-61): maps connector type names to
+source/sink/lookup constructors. Extension connectors register here too
+(plugins, later rounds).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+_sources: Dict[str, Callable[[], Any]] = {}
+_sinks: Dict[str, Callable[[], Any]] = {}
+_lookups: Dict[str, Callable[[], Any]] = {}
+
+
+def register_source(name: str, factory: Callable[[], Any]) -> None:
+    _sources[name.lower()] = factory
+
+
+def register_sink(name: str, factory: Callable[[], Any]) -> None:
+    _sinks[name.lower()] = factory
+
+
+def register_lookup(name: str, factory: Callable[[], Any]) -> None:
+    _lookups[name.lower()] = factory
+
+
+def create_source(name: str):
+    _ensure()
+    f = _sources.get(name.lower())
+    if f is None:
+        raise ValueError(f"unknown source type {name!r}")
+    return f()
+
+
+def create_sink(name: str):
+    _ensure()
+    f = _sinks.get(name.lower())
+    if f is None:
+        raise ValueError(f"unknown sink type {name!r}")
+    return f()
+
+
+def create_lookup(name: str):
+    _ensure()
+    f = _lookups.get(name.lower())
+    if f is None:
+        raise ValueError(f"unknown lookup source type {name!r}")
+    return f()
+
+
+def source_types():
+    _ensure()
+    return sorted(_sources.keys())
+
+
+def sink_types():
+    _ensure()
+    return sorted(_sinks.keys())
+
+
+_loaded = False
+
+
+def _ensure() -> None:
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    from .memory import MemoryLookupSource, MemorySink, MemorySource
+    from .simulator import SimulatorSource
+    from .sinks import LogSink, NopSink
+
+    register_source("memory", MemorySource)
+    register_source("simulator", SimulatorSource)
+    register_sink("memory", MemorySink)
+    register_sink("log", LogSink)
+    register_sink("nop", NopSink)
+    register_lookup("memory", MemoryLookupSource)
+    # file/http/mqtt register on import when available (see io/file.py etc.)
+    try:
+        from .file import FileSink, FileSource
+
+        register_source("file", FileSource)
+        register_sink("file", FileSink)
+    except ImportError:
+        pass

@@ -1,0 +1,55 @@
+"""Simulator source — analogue of internal/io/simulator: replays canned
+payloads at a configured interval (or as fast as possible with interval=0),
+optionally looping. The load generator for benches and trials.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..utils import timex
+from .contract import Source
+
+
+class SimulatorSource(Source):
+    def __init__(self) -> None:
+        self.data: List[Dict[str, Any]] = []
+        self.interval_ms = 1000
+        self.loop = True
+        self.batch_size = 1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.data = props.get("data", [])
+        self.interval_ms = int(props.get("interval", 1000))
+        self.loop = bool(props.get("loop", True))
+        self.batch_size = int(props.get("batch_size", 1))
+
+    def open(self, ingest) -> None:
+        self._stop.clear()
+
+        def run() -> None:
+            idx = 0
+            while not self._stop.is_set() and self.data:
+                batch = []
+                for _ in range(self.batch_size):
+                    if idx >= len(self.data):
+                        if not self.loop:
+                            break
+                        idx = 0
+                    batch.append(self.data[idx])
+                    idx += 1
+                if not batch:
+                    break
+                ingest(batch if len(batch) > 1 else batch[0])
+                if idx >= len(self.data) and not self.loop:
+                    break
+                if self.interval_ms > 0:
+                    timex.sleep(self.interval_ms)
+
+        self._thread = threading.Thread(target=run, daemon=True, name="simulator")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()

@@ -1,0 +1,31 @@
+"""Basic sinks — log and nop (analogue internal/io/sink/log_sink.go, nop)."""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+from ..utils.infra import logger
+from .contract import Sink
+
+
+class LogSink(Sink):
+    def __init__(self) -> None:
+        self.prefix = "sink result"
+
+    def configure(self, props: Dict[str, Any]) -> None:
+        self.prefix = props.get("prefix", self.prefix)
+
+    def collect(self, item: Any) -> None:
+        logger.info("%s: %s", self.prefix, json.dumps(item, default=str))
+
+
+class NopSink(Sink):
+    def __init__(self) -> None:
+        self.log = False
+
+    def configure(self, props: Dict[str, Any]) -> None:
+        self.log = bool(props.get("log", False))
+
+    def collect(self, item: Any) -> None:
+        if self.log:
+            logger.debug("nop sink: %s", item)

@@ -1,0 +1,441 @@
+"""Rule planner — analogue of eKuiper's planner.Plan (internal/topo/planner/
+planner.go:39): parse SQL, load stream definitions, build the logical chain
+(DataSource → AnalyticFuncs? → Window? → Filter → Join? → Aggregate → Having →
+WindowFuncs? → Order → ProjectSet? → Project → sinks), then choose the
+physical form:
+
+**Fused device path** (the incremental-agg rewrite taken to its conclusion,
+reference planner.go:910-999): processing-time TUMBLING/HOPPING/COUNT window
+whose aggregates, WHERE and dimensions all compile to the device kernel →
+SourceNode → FusedWindowAggNode → [Having] → [Order] → Project → sinks.
+
+**Host path**: everything else, with the full operator chain and vectorized
+filtering where expressions allow.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..data.types import Field as SchemaField, Schema
+from ..functions import registry
+from ..io import registry as io_registry
+from ..ops.aggspec import extract_kernel_plan
+from ..runtime.nodes_fused import FusedWindowAggNode
+from ..runtime.nodes_join import JoinNode
+from ..runtime.nodes_ops import (
+    AggregateNode, AnalyticNode, FilterNode, HavingNode, OrderNode,
+    ProjectNode, ProjectSetNode, WindowFuncNode,
+)
+from ..runtime.nodes_sink import SinkNode
+from ..runtime.nodes_source import SourceNode
+from ..runtime.nodes_window import WatermarkNode, WindowNode
+from ..runtime.topo import Topo
+from ..sql import ast
+from ..sql.parser import parse_select
+from ..utils.config import RuleOptionConfig, get_config
+from ..utils.infra import PlanError
+
+
+@dataclass
+class RuleDef:
+    """Rule definition JSON shape (reference: internal/pkg/def/rule.go)."""
+
+    id: str
+    sql: str
+    actions: List[Dict[str, Dict[str, Any]]] = field(default_factory=list)
+    options: Dict[str, Any] = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "RuleDef":
+        return RuleDef(
+            id=d.get("id", ""),
+            sql=d.get("sql", ""),
+            actions=d.get("actions", []),
+            options=d.get("options", {}),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "id": self.id, "sql": self.sql,
+            "actions": self.actions, "options": self.options,
+        }
+
+
+def merged_options(rule: RuleDef) -> RuleOptionConfig:
+    base = get_config().rule
+    opts = RuleOptionConfig(**{**base.__dict__})
+    alias = {
+        "isEventTime": "is_event_time",
+        "lateTolerance": "late_tolerance_ms",
+        "bufferLength": "buffer_length",
+        "sendError": "send_error",
+        "checkpointInterval": "checkpoint_interval_ms",
+        "qos": "qos",
+        "concurrency": "concurrency",
+        "debug": "debug",
+    }
+    for k, v in rule.options.items():
+        key = alias.get(k, k)
+        if hasattr(opts, key):
+            setattr(opts, key, v)
+    return opts
+
+
+def load_stream_def(name: str, store) -> ast.StreamStmt:
+    from ..sql.parser import parse
+
+    table = store.kv("stream")
+    raw, ok = table.get_ok(name)
+    if not ok:
+        table = store.kv("table")
+        raw, ok = table.get_ok(name)
+    if not ok:
+        raise PlanError(f"stream {name} not found")
+    stmt = parse(raw["sql"] if isinstance(raw, dict) else raw)
+    if not isinstance(stmt, ast.StreamStmt):
+        raise PlanError(f"definition of {name} is not a stream/table")
+    return stmt
+
+
+def schema_of(stream: ast.StreamStmt) -> Schema:
+    return Schema(fields=[
+        SchemaField(name=f.name, type=f.type, elem_type=f.elem_type)
+        for f in stream.fields
+    ])
+
+
+# ---------------------------------------------------------------- analysis
+def _analytic_calls(stmt: ast.SelectStatement) -> List[ast.Call]:
+    out, seen = [], set()
+    for root in stmt.expressions():
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call) and registry.is_analytic(node.name):
+                if node.func_id not in seen:
+                    seen.add(node.func_id)
+                    out.append(node)
+    return out
+
+
+def _window_func_calls(stmt: ast.SelectStatement) -> List[ast.Call]:
+    out = []
+    for root in stmt.expressions():
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call):
+                fd = registry.lookup(node.name)
+                if fd is not None and fd.ftype == registry.WINDOW_FUNC:
+                    out.append(node)
+    return out
+
+
+def _srf_field(stmt: ast.SelectStatement) -> Optional[ast.Field]:
+    for f in stmt.fields:
+        if isinstance(f.expr, ast.Call) and registry.is_srf(f.expr.name):
+            return f
+    return None
+
+
+def _has_aggregates(stmt: ast.SelectStatement) -> bool:
+    for root in stmt.expressions():
+        if ast.has_aggregate(root):
+            return True
+    return False
+
+
+def device_path_eligible(
+    stmt: ast.SelectStatement, opts: RuleOptionConfig
+) -> Optional[Any]:
+    """Returns the KernelPlan if the rule can take the fused device path."""
+    if not opts.use_device_kernel:
+        return None
+    w = stmt.window
+    if w is None or opts.is_event_time:
+        return None
+    if w.window_type not in (
+        ast.WindowType.TUMBLING_WINDOW,
+        ast.WindowType.HOPPING_WINDOW,
+        ast.WindowType.COUNT_WINDOW,
+    ):
+        return None
+    if w.window_type == ast.WindowType.COUNT_WINDOW:
+        if w.interval:
+            return None  # overlapping count windows -> host buffering
+        if stmt.condition is not None:
+            # count-window length counts post-WHERE rows (host path filters
+            # before the window); the kernel can't know the filtered count
+            # per batch without a sync, so keep these on the host path
+            return None
+    if w.window_type == ast.WindowType.HOPPING_WINDOW:
+        iv, ln = w.interval or 0, w.length or 0
+        if iv <= 0 or iv > ln or ln % iv != 0:
+            # pane decomposition requires interval | length; otherwise merged
+            # panes would span more time than the window
+            return None
+    if w.filter is not None or w.trigger_condition is not None:
+        return None
+    if stmt.joins or _srf_field(stmt) or _analytic_calls(stmt) or _window_func_calls(stmt):
+        return None
+    dims: List[ast.FieldRef] = []
+    for d in stmt.dimensions:
+        if not isinstance(d.expr, ast.FieldRef):
+            return None
+        dims.append(d.expr)
+    dim_names = {d.name for d in dims}
+    allowed_scalars = {"window_start", "window_end", "window_trigger"}
+    for f in stmt.fields:
+        if isinstance(f.expr, ast.Wildcard):
+            return None
+        for node in ast.walk(f.expr):
+            if isinstance(node, ast.FieldRef) and not _under_agg(f.expr, node):
+                if node.name not in dim_names:
+                    return None
+            if isinstance(node, ast.Call) and not registry.is_aggregate(node.name):
+                fd = registry.lookup(node.name)
+                if fd is None:
+                    return None
+                if fd.ftype != registry.SCALAR or fd.stateful:
+                    if node.name not in allowed_scalars:
+                        return None
+    if stmt.having is not None:
+        for node in ast.walk(stmt.having):
+            if isinstance(node, ast.FieldRef) and not _under_agg(stmt.having, node):
+                if node.name not in dim_names:
+                    return None
+    # ORDER BY exprs must read only dims or kernel aggregates — groups carry
+    # a single synthetic representative row
+    for sf in stmt.sorts:
+        expr = sf.expr if sf.expr is not None else ast.FieldRef(sf.name, sf.stream)
+        for node in ast.walk(expr):
+            if isinstance(node, ast.FieldRef) and not _under_agg(expr, node):
+                if node.name not in dim_names:
+                    return None
+    plan = extract_kernel_plan(stmt)
+    return plan
+
+
+def _under_agg(root: ast.Expr, target: ast.Expr) -> bool:
+    """Is `target` inside an aggregate call within `root`?"""
+    found = [False]
+
+    def walk_in(e: ast.Expr, in_agg: bool) -> None:
+        if e is target and in_agg:
+            found[0] = True
+            return
+        child_in_agg = in_agg or (
+            isinstance(e, ast.Call) and registry.is_aggregate(e.name)
+        )
+        for c in e.children():
+            walk_in(c, child_in_agg)
+
+    walk_in(root, False)
+    return found[0]
+
+
+# ------------------------------------------------------------------- build
+def plan_rule(rule: RuleDef, store) -> Topo:
+    if not rule.sql:
+        raise PlanError("rule has no sql")
+    stmt = parse_select(rule.sql)
+    opts = merged_options(rule)
+    topo = Topo(
+        rule.id, qos=opts.qos, checkpoint_interval_ms=opts.checkpoint_interval_ms
+    )
+
+    # sources
+    source_nodes: List[SourceNode] = []
+    for tbl in stmt.sources:
+        stream = load_stream_def(tbl.name, store)
+        sschema = schema_of(stream)
+        stype = stream.options.type or "memory"
+        connector = io_registry.create_source(stype)
+        props = _source_props(stream, store)
+        connector.configure(stream.options.datasource, props)
+        src = SourceNode(
+            tbl.ref_name if len(stmt.sources) > 1 or stmt.joins else tbl.name,
+            connector,
+            schema=sschema,
+            timestamp_field=stream.options.timestamp if opts.is_event_time else "",
+            strict_validation=stream.options.strict_validation,
+            micro_batch_rows=opts.micro_batch_rows,
+            linger_ms=opts.micro_batch_linger_ms,
+            buffer_length=opts.buffer_length,
+        )
+        topo.add_source(src)
+        source_nodes.append(src)
+
+    kernel_plan = device_path_eligible(stmt, opts)
+    if kernel_plan is not None and len(source_nodes) == 1:
+        tail = _build_device_chain(
+            topo, stmt, kernel_plan, source_nodes[0], opts, rule.id
+        )
+    else:
+        tail = _build_host_chain(topo, stmt, source_nodes, opts, rule.id)
+
+    # sinks
+    actions = rule.actions or [{"log": {}}]
+    for i, action in enumerate(actions):
+        for sink_type, props in action.items():
+            sink = io_registry.create_sink(sink_type)
+            sink.configure(props or {})
+            node = SinkNode(
+                f"{sink_type}_{i}",
+                sink,
+                send_single=bool((props or {}).get("sendSingle", False)),
+                fields=(props or {}).get("fields"),
+                exclude_fields=(props or {}).get("excludeFields"),
+                data_template=(props or {}).get("dataTemplate", ""),
+                omit_if_empty=bool((props or {}).get("omitIfEmpty", False)),
+                retry_count=int((props or {}).get("retryCount", 0)),
+                retry_interval_ms=int((props or {}).get("retryInterval", 1000)),
+                buffer_length=opts.buffer_length,
+            )
+            topo.add_sink(node)
+            tail.connect(node)
+    return topo
+
+
+def _source_props(stream: ast.StreamStmt, store) -> Dict[str, Any]:
+    """Source props from conf_key profiles stored in the config KV
+    (reference: internal/conf/yaml_config_ops.go)."""
+    props: Dict[str, Any] = {}
+    if stream.options.conf_key:
+        conf = store.kv("source_conf")
+        stored, ok = conf.get_ok(
+            f"{stream.options.type or 'memory'}:{stream.options.conf_key}"
+        )
+        if ok and isinstance(stored, dict):
+            props.update(stored)
+    return props
+
+
+def _build_device_chain(
+    topo: Topo, stmt, kernel_plan, src: SourceNode, opts: RuleOptionConfig,
+    rule_id: str,
+):
+    dims = [d.expr for d in stmt.dimensions]
+    fused = FusedWindowAggNode(
+        "window_agg", stmt.window, kernel_plan, dims,
+        capacity=opts.key_slots, micro_batch=opts.micro_batch_rows,
+        rule_id=rule_id, buffer_length=opts.buffer_length,
+    )
+    topo.add_op(fused)
+    src.connect(fused)
+    tail = fused
+    if stmt.having is not None:
+        hv = HavingNode("having", stmt.having, rule_id=rule_id,
+                        buffer_length=opts.buffer_length)
+        topo.add_op(hv)
+        tail = tail.connect(hv)
+    if stmt.sorts:
+        on = OrderNode("order", stmt.sorts, buffer_length=opts.buffer_length)
+        topo.add_op(on)
+        tail = tail.connect(on)
+    proj = ProjectNode("project", stmt.fields, rule_id=rule_id,
+                       limit=stmt.limit, buffer_length=opts.buffer_length)
+    topo.add_op(proj)
+    return tail.connect(proj)
+
+
+def _build_host_chain(
+    topo: Topo, stmt, source_nodes: List[SourceNode], opts: RuleOptionConfig,
+    rule_id: str,
+):
+    tail_of_sources = source_nodes
+    # event-time: watermark generation + late drop
+    if opts.is_event_time:
+        wm = WatermarkNode("watermark", late_tolerance_ms=opts.late_tolerance_ms,
+                           buffer_length=opts.buffer_length)
+        topo.add_op(wm)
+        for s in tail_of_sources:
+            s.connect(wm)
+        chain = [wm]
+    else:
+        chain = list(tail_of_sources)
+
+    def attach(node):
+        topo.add_op(node)
+        for t in chain:
+            t.connect(node)
+        chain.clear()
+        chain.append(node)
+        return node
+
+    analytic = _analytic_calls(stmt)
+    if analytic:
+        attach(AnalyticNode("analytic", analytic, rule_id=rule_id,
+                            buffer_length=opts.buffer_length))
+    # predicate pushdown: WHERE before the window when it has no analytic refs
+    where_pushed = False
+    if stmt.condition is not None and not analytic:
+        attach(FilterNode("filter", stmt.condition, buffer_length=opts.buffer_length))
+        where_pushed = True
+    if stmt.window is not None:
+        attach(WindowNode("window", stmt.window,
+                          is_event_time=opts.is_event_time, rule_id=rule_id,
+                          buffer_length=opts.buffer_length))
+    if stmt.condition is not None and not where_pushed:
+        attach(FilterNode("filter", stmt.condition, buffer_length=opts.buffer_length))
+    if stmt.joins:
+        left = stmt.sources[0].ref_name
+        attach(JoinNode("join", stmt.joins, left_name=left,
+                        buffer_length=opts.buffer_length))
+    if stmt.dimensions:
+        attach(AggregateNode("aggregate", [d.expr for d in stmt.dimensions],
+                             buffer_length=opts.buffer_length))
+    if stmt.having is not None:
+        attach(HavingNode("having", stmt.having, rule_id=rule_id,
+                          buffer_length=opts.buffer_length))
+    wf = _window_func_calls(stmt)
+    if wf:
+        attach(WindowFuncNode("window_func", wf, buffer_length=opts.buffer_length))
+    if stmt.sorts:
+        attach(OrderNode("order", stmt.sorts, buffer_length=opts.buffer_length))
+    tail = attach(ProjectNode(
+        "project", stmt.fields, rule_id=rule_id, limit=stmt.limit,
+        is_agg=_has_aggregates(stmt) and not stmt.dimensions,
+        buffer_length=opts.buffer_length,
+    ))
+    srf = _srf_field(stmt)
+    if srf is not None:
+        # project computed the SRF list column; expand it into rows
+        tail = attach(ProjectSetNode(
+            "project_set", srf.output_name or srf.name,
+            buffer_length=opts.buffer_length,
+        ))
+    return tail
+
+
+def explain(rule: RuleDef, store) -> Dict[str, Any]:
+    """Plan explanation (REST /rules/{id}/explain analogue)."""
+    stmt = parse_select(rule.sql)
+    opts = merged_options(rule)
+    kernel_plan = device_path_eligible(stmt, opts)
+    path = "device-fused" if kernel_plan is not None else "host"
+    ops: List[str] = ["source"]
+    if kernel_plan is not None:
+        ops.append("fused_window_groupby_agg[TPU]")
+        if stmt.having is not None:
+            ops.append("having")
+        if stmt.sorts:
+            ops.append("order")
+        ops.append("project")
+    else:
+        if opts.is_event_time:
+            ops.append("watermark")
+        if _analytic_calls(stmt):
+            ops.append("analytic")
+        if stmt.condition is not None:
+            ops.append("filter")
+        if stmt.window is not None:
+            ops.append(f"window[{stmt.window.window_type.value}]")
+        if stmt.joins:
+            ops.append("join")
+        if stmt.dimensions:
+            ops.append("aggregate")
+        if stmt.having is not None:
+            ops.append("having")
+        if stmt.sorts:
+            ops.append("order")
+        ops.append("project")
+    ops.append("sink")
+    return {"path": path, "operators": ops}

@@ -1,0 +1,52 @@
+"""Events flowing between runtime nodes — analogue of the reference's
+BufferOrEvent stream (data + barriers piggybacked on the same channels,
+internal/topo/node/node.go:121-127).
+
+Data travels as ColumnBatch (micro-batched columnar, the TPU-native form) or
+as row collections (WindowTuples/GroupedTuplesSet) after windowing; control
+events (barrier, watermark, EOF, window trigger) interleave in-band so
+alignment semantics match the reference's checkpoint design.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class Barrier:
+    """Checkpoint barrier (Chandy-Lamport aligned snapshot marker,
+    reference: internal/topo/checkpoint/barrier_handler.go)."""
+
+    checkpoint_id: int
+    source_id: str = ""
+
+
+@dataclass
+class Watermark:
+    """Event-time watermark: no further events with ts < `ts` expected
+    (reference: internal/topo/node/watermark_op.go)."""
+
+    ts: int
+
+
+@dataclass
+class EOF:
+    """Stream end (trial runs / bounded sources)."""
+
+    source_id: str = ""
+
+
+@dataclass
+class Trigger:
+    """Window trigger tick (processing-time), enqueued by clock timers into
+    the owning window node's input so handling serializes with data."""
+
+    ts: int
+    tag: Any = None
+
+
+@dataclass
+class ErrorEvent:
+    error: BaseException
+    origin: str = ""

@@ -1,0 +1,163 @@
+"""Runtime node fabric — analogue of eKuiper's defaultNode goroutine/channel
+fabric (internal/topo/node/node.go:113-196) and the UnaryOperator run loop
+(internal/topo/node/operations.go:60-130).
+
+Each node is one worker thread with a bounded input queue. Broadcast to
+multiple downstream nodes enqueues to each; on a full buffer the oldest item
+is dropped unless `disable_buffer_full_discard` — the reference's drop-oldest
+backpressure semantics. All thread bodies run under safe_run so a failing
+operator drains its error to the topo instead of killing the process.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, List, Optional
+
+from ..utils.infra import logger, safe_run
+from ..utils.metrics import StatManager
+from .events import EOF, Barrier, ErrorEvent, Trigger, Watermark
+
+
+class Node:
+    def __init__(
+        self,
+        name: str,
+        op_type: str = "op",
+        buffer_length: int = 1024,
+        disable_buffer_full_discard: bool = False,
+    ) -> None:
+        self.name = name
+        self.op_type = op_type
+        self.inq: "queue.Queue[Any]" = queue.Queue(maxsize=buffer_length)
+        self.outputs: List["Node"] = []
+        self.stats = StatManager(op_type, name)
+        self.disable_buffer_full_discard = disable_buffer_full_discard
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._topo = None  # set by Topo.add
+
+    # ------------------------------------------------------------------ wiring
+    def connect(self, downstream: "Node") -> "Node":
+        self.outputs.append(downstream)
+        return downstream
+
+    # ------------------------------------------------------------------- input
+    def put(self, item: Any) -> None:
+        """Enqueue with drop-oldest on overflow (node.go:140-196)."""
+        if self.disable_buffer_full_discard:
+            self.inq.put(item)
+            return
+        while True:
+            try:
+                self.inq.put_nowait(item)
+                return
+            except queue.Full:
+                try:
+                    dropped = self.inq.get_nowait()
+                    self.stats.inc_exception("buffer full, dropped oldest")
+                    logger.debug("%s: buffer full, dropped %r", self.name, type(dropped))
+                except queue.Empty:
+                    continue
+
+    def broadcast(self, item: Any) -> None:
+        for out in self.outputs:
+            out.put(item)
+
+    # --------------------------------------------------------------- lifecycle
+    def open(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run_safe, name=f"node-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.inq.put(None)  # wake the worker
+
+    def join(self, timeout: float = 5.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def _run_safe(self) -> None:
+        err = safe_run(self._run)
+        if err is not None and self._topo is not None:
+            self._topo.drain_error(err, self.name)
+
+    def _run(self) -> None:
+        self.on_open()
+        try:
+            while not self._stop.is_set():
+                try:
+                    item = self.inq.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                if item is None:
+                    continue
+                self.stats.set_buffer_length(self.inq.qsize())
+                self._dispatch(item)
+        finally:
+            self.on_close()
+
+    def _dispatch(self, item: Any) -> None:
+        self.stats.inc_in()
+        self.stats.process_begin()
+        try:
+            if isinstance(item, Barrier):
+                self.on_barrier(item)
+            elif isinstance(item, Watermark):
+                self.on_watermark(item)
+            elif isinstance(item, EOF):
+                self.on_eof(item)
+            elif isinstance(item, Trigger):
+                self.on_trigger(item)
+            else:
+                self.process(item)
+        except Exception as exc:  # per-item containment: skip poisoned items
+            self.stats.inc_exception(str(exc))
+            logger.warning("%s error: %s", self.name, exc)
+            self.on_error(exc, item)
+        finally:
+            self.stats.process_end()
+
+    # ------------------------------------------------------------- overridables
+    def on_open(self) -> None:
+        pass
+
+    def on_close(self) -> None:
+        pass
+
+    def process(self, item: Any) -> None:
+        """Data item (ColumnBatch / collection / row)."""
+        self.emit(item)
+
+    def on_barrier(self, barrier: Barrier) -> None:
+        """Default: snapshot own state then forward (at-least-once tracker)."""
+        if self._topo is not None:
+            self._topo.checkpoint_ack(self.name, barrier, self.snapshot_state())
+        self.broadcast(barrier)
+
+    def on_watermark(self, wm: Watermark) -> None:
+        self.broadcast(wm)
+
+    def on_eof(self, eof: EOF) -> None:
+        self.broadcast(eof)
+
+    def on_trigger(self, trig: Trigger) -> None:
+        pass
+
+    def on_error(self, exc: Exception, item: Any) -> None:
+        """Per-item error: forwarded downstream as data when send_error."""
+
+    # ------------------------------------------------------------------ output
+    def emit(self, item: Any, count: int = 1) -> None:
+        self.stats.inc_out(count)
+        self.broadcast(item)
+
+    # ------------------------------------------------------------------- state
+    def snapshot_state(self) -> Optional[dict]:
+        return None
+
+    def restore_state(self, state: dict) -> None:
+        pass

@@ -1,0 +1,267 @@
+"""Fused window→GROUP BY→aggregate device node — the TPU-native replacement
+for the reference's WindowIncAggOperator (window_inc_agg_op.go) and the
+window+aggregate+project interpreter chain of the hot path (SURVEY §3.2).
+
+Handles processing-time TUMBLING and HOPPING windows and non-overlapping
+COUNT windows whose aggregates all compile to the device kernel
+(ops/aggspec.py eligibility). Per micro-batch: encode GROUP BY keys to slots
+(host dictionary), fold columns into device partials (one jitted XLA program);
+per trigger: finalize on device, one transfer, emit GroupedTuplesSet whose
+groups carry precomputed agg_values — downstream HAVING/ORDER/PROJECT read
+them without recomputation.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..data.batch import ColumnBatch
+from ..data.rows import GroupedTuples, GroupedTuplesSet, Tuple, WindowRange
+from ..ops.aggspec import KernelPlan, _call_key
+from ..ops.groupby import DeviceGroupBy
+from ..ops.keytable import KeyTable
+from ..sql import ast
+from ..utils import timex
+from ..utils.infra import logger
+from .events import EOF, Trigger
+from .node import Node
+
+
+class FusedWindowAggNode(Node):
+    def __init__(
+        self,
+        name: str,
+        window: ast.Window,
+        plan: KernelPlan,
+        dims: List[ast.FieldRef],
+        capacity: int = 16384,
+        micro_batch: int = 4096,
+        rule_id: str = "",
+        **kw,
+    ) -> None:
+        super().__init__(name, op_type="op", **kw)
+        self.window = window
+        self.plan = plan
+        self.dims = dims
+        self.wt = window.window_type
+        self.length_ms = window.length_ms()
+        self.interval_ms = window.interval_ms()
+        if self.wt == ast.WindowType.HOPPING_WINDOW:
+            iv = max(self.interval_ms, 1)
+            self.n_panes = max((self.length_ms + iv - 1) // iv, 1)
+        else:
+            self.n_panes = 1
+        self.gb = DeviceGroupBy(
+            plan, capacity=capacity, n_panes=int(self.n_panes),
+            micro_batch=micro_batch,
+        )
+        self.kt = KeyTable(capacity)
+        self.state = None
+        self.cur_pane = 0
+        self._timer = None
+        # count window
+        self.count_len = window.length or 0
+        self._rows_in_window = 0
+        self._spec_keys = [_call_key(s.call) for s in plan.specs]
+        self._dtypes_seen = False
+
+    # --------------------------------------------------------------- lifecycle
+    def on_open(self) -> None:
+        if self.state is None:  # keep checkpoint-restored partials
+            self.state = self.gb.init_state()
+        # register the trigger timer BEFORE the (slow) warmup compile so the
+        # first window boundary is anchored at open time, not compile-end
+        if self.wt in (ast.WindowType.TUMBLING_WINDOW, ast.WindowType.HOPPING_WINDOW):
+            self._schedule_next_tick()
+        self._warmup()
+
+    def _warmup(self) -> None:
+        """Compile fold+finalize before data arrives so the first window
+        doesn't pay 1-40s of jit latency."""
+        try:
+            # no valid masks: matches the common typed-schema batch pytree so
+            # the compiled executable is the one real folds will hit
+            cols = {
+                name: np.zeros(1, dtype=np.float32) for name in self.plan.columns
+            }
+            slots = np.zeros(1, dtype=np.int32)
+            self.state = self.gb.fold(self.state, cols, slots,
+                                      pane_idx=self.cur_pane)
+            self.gb.finalize(self.state, 1)
+            self.state = self.gb.reset_pane(self.state, self.cur_pane)
+        except Exception as exc:
+            logger.debug("fused warmup failed (non-fatal): %s", exc)
+
+    def on_close(self) -> None:
+        if self._timer is not None:
+            self._timer.stop()
+
+    def _tick_interval(self) -> int:
+        if self.wt == ast.WindowType.TUMBLING_WINDOW:
+            return self.length_ms
+        return self.interval_ms or self.length_ms
+
+    def _schedule_next_tick(self) -> None:
+        now = timex.now_ms()
+        interval = self._tick_interval()
+        next_end = timex.align_to_window(now + 1, interval)
+        self._timer = timex.after(
+            next_end - now, lambda ts: self.inq.put(Trigger(ts=ts))
+        )
+
+    # ------------------------------------------------------------------- data
+    def process(self, item: Any) -> None:
+        if not isinstance(item, ColumnBatch):
+            if isinstance(item, Tuple):
+                # stray row path: wrap into a single-row batch
+                from ..data.batch import from_tuples
+
+                item = from_tuples([item], emitter=item.emitter)
+            else:
+                self.emit(item)
+                return
+        if item.n == 0:
+            return
+        if self.wt == ast.WindowType.COUNT_WINDOW:
+            self._fold_count_window(item)
+        else:
+            self._fold(item)
+
+    def _fold(self, batch: ColumnBatch, start: int = 0, end: Optional[int] = None) -> int:
+        """Fold rows [start:end) of the batch; returns rows folded."""
+        end = batch.n if end is None else end
+        if end <= start:
+            return 0
+        idx = np.arange(start, end)
+        sub = batch if (start == 0 and end == batch.n) else batch.take(idx)
+        # encode group key
+        key_cols = []
+        for d in self.dims:
+            col = sub.columns.get(d.name)
+            if col is None:
+                col = np.full(sub.n, None, dtype=np.object_)
+            key_cols.append(col)
+        if key_cols:
+            slots, grew = self.kt.encode_multi(key_cols)
+            if grew:
+                self.state = self.gb.grow(self.state, self.kt.capacity)
+        else:
+            slots = np.zeros(sub.n, dtype=np.int32)
+            if self.kt.n_keys == 0:
+                self.kt.encode_column(np.array(["__all__"], dtype=np.object_))
+        cols: Dict[str, np.ndarray] = {}
+        valid: Dict[str, np.ndarray] = {}
+        for name in self.plan.columns:
+            col = sub.columns.get(name)
+            if col is None:
+                cols[name] = np.full(sub.n, np.nan, dtype=np.float32)
+                continue
+            if col.dtype == np.object_:
+                # mixed/object numeric column: coerce with NaN for bad rows
+                coerced = np.full(sub.n, np.nan, dtype=np.float32)
+                for i, v in enumerate(col):
+                    if isinstance(v, (int, float)) and not isinstance(v, bool):
+                        coerced[i] = v
+                cols[name] = coerced
+            else:
+                cols[name] = col
+            v = sub.valid.get(name)
+            if v is not None:
+                valid[name] = v
+        if not self._dtypes_seen:
+            self.gb.observe_dtypes(cols)
+            self._dtypes_seen = True
+        self.state = self.gb.fold(self.state, cols, slots, valid, self.cur_pane)
+        return sub.n
+
+    def _fold_count_window(self, batch: ColumnBatch) -> None:
+        pos = 0
+        while pos < batch.n:
+            room = self.count_len - self._rows_in_window
+            take = min(room, batch.n - pos)
+            self._fold(batch, pos, pos + take)
+            self._rows_in_window += take
+            pos += take
+            if self._rows_in_window >= self.count_len:
+                self._emit(WindowRange(0, timex.now_ms()))
+                self.state = self.gb.reset_pane(self.state, 0)
+                self._rows_in_window = 0
+
+    # ---------------------------------------------------------------- trigger
+    def on_trigger(self, trig: Trigger) -> None:
+        end = trig.ts
+        self._emit(WindowRange(end - self.length_ms, end))
+        if self.wt == ast.WindowType.TUMBLING_WINDOW:
+            self.state = self.gb.reset_pane(self.state, 0)
+        else:
+            # advance to the next pane; expire it (it held the oldest slice)
+            self.cur_pane = (self.cur_pane + 1) % self.n_panes
+            self.state = self.gb.reset_pane(self.state, self.cur_pane)
+        self._schedule_next_tick()
+
+    def on_eof(self, eof: EOF) -> None:
+        now = timex.now_ms()
+        self._emit(WindowRange(now - self.length_ms, now))
+        if self.wt == ast.WindowType.TUMBLING_WINDOW:
+            self.state = self.gb.reset_pane(self.state, 0)
+        self.broadcast(eof)
+
+    # ------------------------------------------------------------------- emit
+    def _emit(self, wr: WindowRange) -> None:
+        n_keys = self.kt.n_keys
+        if n_keys == 0:
+            return
+        outs, act = self.gb.finalize(self.state, n_keys)
+        active = np.nonzero(act > 0)[0]
+        if len(active) == 0:
+            return
+        groups: List[GroupedTuples] = []
+        dim_names = [d.name for d in self.dims]
+        for slot in active:
+            key = self.kt.decode(int(slot))
+            msg: Dict[str, Any] = {}
+            if dim_names:
+                if len(dim_names) == 1:
+                    msg[dim_names[0]] = key
+                else:
+                    for dn, kv in zip(dim_names, key):
+                        msg[dn] = kv
+            agg_values: Dict[str, Any] = {}
+            for spec_key, col in zip(self._spec_keys, outs):
+                v = col[slot]
+                if isinstance(v, np.floating) and np.isnan(v):
+                    agg_values[spec_key] = None
+                else:
+                    agg_values[spec_key] = v.item() if isinstance(v, np.generic) else v
+            rep = Tuple(emitter="", message=msg, timestamp=wr.window_end)
+            groups.append(
+                GroupedTuples(
+                    content=[rep], group_key=str(key), window_range=wr,
+                    agg_values=agg_values,
+                )
+            )
+        self.emit(GroupedTuplesSet(groups=groups, window_range=wr))
+
+    # ------------------------------------------------------------------ state
+    def snapshot_state(self) -> Optional[dict]:
+        host = self.gb.state_to_host(self.state)
+        return {
+            "keys": self.kt.decode_all(),
+            "partials": {k: v.tolist() for k, v in host.items()},
+            "cur_pane": self.cur_pane,
+            "rows_in_window": self._rows_in_window,
+        }
+
+    def restore_state(self, state: dict) -> None:
+        keys = state.get("keys", [])
+        self.kt.restore([tuple(k) if isinstance(k, list) else k for k in keys])
+        partials = state.get("partials")
+        if partials:
+            host = {k: np.asarray(v, dtype=np.float32) for k, v in partials.items()}
+            cap = next(iter(host.values())).shape[1]
+            self.gb.capacity = cap
+            self.kt.capacity = max(self.kt.capacity, cap)
+            self.state = self.gb.state_from_host(host)
+        self.cur_pane = state.get("cur_pane", 0)
+        self._rows_in_window = state.get("rows_in_window", 0)

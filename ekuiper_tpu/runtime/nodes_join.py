@@ -1,0 +1,132 @@
+"""Join node — analogue of eKuiper's JoinOp nested-loop join over window
+collections (internal/topo/operator/join_operator.go) plus the stream-lookup
+join of LookupNode (internal/topo/node/lookup_node.go) with TTL cache
+(internal/topo/lookup/cache/cache.go:31-103).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple as PyTuple
+
+from ..data.batch import ColumnBatch
+from ..data.rows import JoinTuple, Row, Tuple, WindowTuples
+from ..sql import ast
+from ..sql.eval import Evaluator
+from ..utils import timex
+from .node import Node
+
+
+class JoinNode(Node):
+    """Nested-loop join over a window's mixed-emitter rows."""
+
+    def __init__(self, name: str, joins: List[ast.Join], left_name: str, **kw) -> None:
+        super().__init__(name, op_type="op", **kw)
+        self.joins = joins
+        self.left_name = left_name
+        self.ev = Evaluator()
+
+    def process(self, item: Any) -> None:
+        if not isinstance(item, WindowTuples):
+            self.emit(item)
+            return
+        by_emitter: Dict[str, List[Tuple]] = {}
+        for r in item.rows():
+            if isinstance(r, Tuple):
+                by_emitter.setdefault(r.emitter, []).append(r)
+        current: List[JoinTuple] = [
+            JoinTuple(tuples=[t]) for t in by_emitter.get(self.left_name, [])
+        ]
+        for join in self.joins:
+            right_rows = by_emitter.get(join.table.ref_name, [])
+            current = self._join_step(current, right_rows, join)
+        if current:
+            self.emit(WindowTuples(content=list(current), window_range=item.window_range))
+
+    def _join_step(
+        self, left: List[JoinTuple], right: List[Tuple], join: ast.Join
+    ) -> List[JoinTuple]:
+        out: List[JoinTuple] = []
+        jt = join.join_type
+        matched_right: set = set()
+        for lt in left:
+            matched = False
+            for ri, rt in enumerate(right):
+                if jt == ast.JoinType.CROSS:
+                    ok = True
+                else:
+                    probe = JoinTuple(tuples=list(lt.tuples) + [rt])
+                    ok = self.ev.eval_condition(join.on, probe)
+                if ok:
+                    matched = True
+                    matched_right.add(ri)
+                    out.append(JoinTuple(tuples=list(lt.tuples) + [rt]))
+            if not matched and jt in (ast.JoinType.LEFT, ast.JoinType.FULL):
+                out.append(JoinTuple(tuples=list(lt.tuples)))
+        if jt in (ast.JoinType.RIGHT, ast.JoinType.FULL):
+            for ri, rt in enumerate(right):
+                if ri not in matched_right:
+                    out.append(JoinTuple(tuples=[rt]))
+        return out
+
+
+class LookupJoinNode(Node):
+    """Stream-to-lookup-table join with per-key TTL cache."""
+
+    def __init__(
+        self, name: str, lookup_source, join: ast.Join,
+        key_fields: List[PyTuple[str, str]],  # (stream_field, table_field)
+        cache_ttl_ms: int = 60_000, **kw,
+    ) -> None:
+        super().__init__(name, op_type="op", **kw)
+        self.lookup = lookup_source
+        self.join = join
+        self.key_fields = key_fields
+        self.cache_ttl = cache_ttl_ms
+        self._cache: Dict[Any, PyTuple[int, List[Dict[str, Any]]]] = {}
+        self.ev = Evaluator()
+
+    def on_open(self) -> None:
+        self.lookup.open()
+
+    def on_close(self) -> None:
+        self.lookup.close()
+
+    def process(self, item: Any) -> None:
+        rows: List[Row]
+        if isinstance(item, ColumnBatch):
+            rows = item.to_tuples()
+        elif isinstance(item, WindowTuples):
+            rows = item.rows()
+        elif isinstance(item, Row):
+            rows = [item]
+        else:
+            self.emit(item)
+            return
+        out: List[JoinTuple] = []
+        table = self.join.table.ref_name
+        for r in rows:
+            values = []
+            for sf, _tf in self.key_fields:
+                v, _ = r.value(sf)
+                values.append(v)
+            key = tuple(values)
+            hit = self._cache.get(key)
+            now = timex.now_ms()
+            if hit is not None and now - hit[0] < self.cache_ttl:
+                matches = hit[1]
+            else:
+                matches = self.lookup.lookup(
+                    [], [tf for _sf, tf in self.key_fields], values
+                )
+                self._cache[key] = (now, matches)
+            if matches:
+                for m in matches:
+                    out.append(JoinTuple(tuples=[
+                        r if isinstance(r, Tuple) else Tuple(message=r.all_values()),
+                        Tuple(emitter=table, message=m),
+                    ]))
+            elif self.join.join_type == ast.JoinType.LEFT:
+                out.append(JoinTuple(tuples=[
+                    r if isinstance(r, Tuple) else Tuple(message=r.all_values())
+                ]))
+        for jt in out:
+            self.emit(jt)

@@ -1,0 +1,115 @@
+"""Sink node — analogue of eKuiper's sink chain (planner_sink.go:36-253:
+transform → batch → encode → cache → sink node) with SinkNode retry
+(sink_node.go:197-255) folded in.
+
+Transforms supported: field picking, dataTemplate (a pragmatic subset of Go
+templates: {{.field}} substitution), sendSingle splitting, omitIfEmpty.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+from ..data.batch import ColumnBatch
+from ..data.rows import GroupedTuplesSet, Row, Tuple, WindowTuples
+from ..utils import timex
+from ..utils.infra import logger
+from .node import Node
+
+_TMPL_RE = re.compile(r"\{\{\s*\.(\w+)\s*\}\}")
+
+
+class SinkNode(Node):
+    def __init__(
+        self,
+        name: str,
+        sink,  # io.Sink
+        send_single: bool = False,
+        fields: Optional[List[str]] = None,
+        exclude_fields: Optional[List[str]] = None,
+        data_template: str = "",
+        omit_if_empty: bool = False,
+        retry_count: int = 0,
+        retry_interval_ms: int = 1000,
+        **kw,
+    ) -> None:
+        super().__init__(name, op_type="sink", **kw)
+        self.sink = sink
+        self.send_single = send_single
+        self.fields = fields
+        self.exclude_fields = exclude_fields
+        self.data_template = data_template
+        self.omit_if_empty = omit_if_empty
+        self.retry_count = retry_count
+        self.retry_interval_ms = retry_interval_ms
+        self.results: List[Any] = []  # test/trial access
+
+    def on_open(self) -> None:
+        self.sink.connect()
+
+    def on_close(self) -> None:
+        try:
+            self.sink.close()
+        except Exception as exc:
+            logger.debug("sink %s close error: %s", self.name, exc)
+
+    # ------------------------------------------------------------------ data
+    def process(self, item: Any) -> None:
+        msgs = self._to_messages(item)
+        if not msgs and self.omit_if_empty:
+            return
+        msgs = [self._transform(m) for m in msgs]
+        if self.send_single:
+            for m in msgs:
+                self._collect(m)
+        else:
+            self._collect(msgs if len(msgs) != 1 else msgs[0])
+
+    def _to_messages(self, item: Any) -> List[Dict[str, Any]]:
+        if isinstance(item, list):
+            out: List[Dict[str, Any]] = []
+            for x in item:
+                out.extend(self._to_messages(x))
+            return out
+        if isinstance(item, Tuple):
+            return [item.all_values()]
+        if isinstance(item, GroupedTuplesSet):
+            return [g.all_values() for g in item.groups]
+        if isinstance(item, (WindowTuples,)):
+            return [r.all_values() for r in item.rows()]
+        if isinstance(item, ColumnBatch):
+            return [t.message for t in item.to_tuples()]
+        if isinstance(item, dict):
+            return [item]
+        if isinstance(item, Row):
+            return [item.all_values()]
+        return []
+
+    def _transform(self, msg: Dict[str, Any]) -> Any:
+        if self.fields:
+            msg = {k: msg.get(k) for k in self.fields}
+        if self.exclude_fields:
+            msg = {k: v for k, v in msg.items() if k not in self.exclude_fields}
+        if self.data_template:
+            return _TMPL_RE.sub(
+                lambda m: str(msg.get(m.group(1), "")), self.data_template
+            )
+        return msg
+
+    def _collect(self, payload: Any) -> None:
+        attempts = 0
+        delay = self.retry_interval_ms
+        while True:
+            try:
+                self.sink.collect(payload)
+                self.results.append(payload)
+                if len(self.results) > 10000:
+                    del self.results[:5000]
+                return
+            except Exception as exc:
+                attempts += 1
+                self.stats.inc_exception(str(exc))
+                if attempts > self.retry_count:
+                    raise
+                timex.sleep(delay)
+                delay = min(delay * 2, 30_000)

@@ -1,0 +1,141 @@
+"""Source pipeline node — the fused analogue of the reference's source split
+(connector → rate-limit → decode → preprocessor, planner_source.go:35-197).
+
+A SourceNode owns a connector (io registry), decodes payloads via the
+converter, coerces to the stream schema (preprocessor semantics incl.
+event-time extraction from the TIMESTAMP option), accumulates rows into
+columnar micro-batches (size/linger bounded), and emits ColumnBatch — the
+TPU-native ingest form. Micro-batching here is what turns the reference's
+per-tuple goroutine hops into whole-batch device work.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..data import cast
+from ..data.batch import ColumnBatch, from_tuples
+from ..data.rows import Tuple
+from ..data.types import Schema
+from ..utils import timex
+from ..utils.infra import logger
+from .events import EOF
+from .node import Node
+
+
+class SourceNode(Node):
+    def __init__(
+        self,
+        name: str,
+        connector,  # io.Source instance
+        schema: Optional[Schema] = None,
+        timestamp_field: str = "",
+        strict_validation: bool = False,
+        micro_batch_rows: int = 4096,
+        linger_ms: int = 10,
+        buffer_length: int = 1024,
+        emit_batches: bool = True,
+    ) -> None:
+        super().__init__(name, op_type="source", buffer_length=buffer_length)
+        self.connector = connector
+        self.schema = schema
+        self.timestamp_field = timestamp_field
+        self.strict = cast.STRICT if strict_validation else cast.CONVERT_ALL
+        self.micro_batch_rows = micro_batch_rows
+        self.linger_ms = linger_ms
+        self.emit_batches = emit_batches
+        self._pending: List[Tuple] = []
+        self._pending_lock = threading.Lock()
+        self._linger_timer = None
+
+    # ------------------------------------------------------------------ ingest
+    def on_open(self) -> None:
+        self.connector.open(self.ingest)
+
+    def on_close(self) -> None:
+        try:
+            self.connector.close()
+        except Exception as exc:
+            logger.debug("source %s close error: %s", self.name, exc)
+        self._flush()
+
+    def ingest(self, payload: Any, metadata: Optional[Dict[str, Any]] = None) -> None:
+        """Connector callback: bytes (decoded via converter upstream of this
+        call), dict, list of dicts, or Tuple."""
+        now = timex.now_ms()
+        rows: List[Tuple] = []
+        if isinstance(payload, Tuple):
+            rows = [payload]
+        elif isinstance(payload, dict):
+            rows = [Tuple(emitter=self.name, message=payload, timestamp=now,
+                          metadata=metadata or {})]
+        elif isinstance(payload, list):
+            rows = [
+                Tuple(emitter=self.name, message=m, timestamp=now,
+                      metadata=metadata or {})
+                for m in payload if isinstance(m, dict)
+            ]
+        elif payload is None:
+            return
+        else:
+            self.stats.inc_exception(f"unsupported payload {type(payload)}")
+            return
+        self.stats.inc_in(len(rows))
+        rows = [self._preprocess(t) for t in rows]
+        rows = [t for t in rows if t is not None]
+        if not rows:
+            return
+        if not self.emit_batches:
+            for t in rows:
+                self.emit(t)
+            return
+        with self._pending_lock:
+            self._pending.extend(rows)
+            full = len(self._pending) >= self.micro_batch_rows
+        if full:
+            self._flush()
+        elif self._linger_timer is None or self._linger_timer.fired or self._linger_timer.stopped:
+            self._linger_timer = timex.after(self.linger_ms, lambda ts: self._flush())
+
+    def _preprocess(self, t: Tuple) -> Optional[Tuple]:
+        """Schema validation/coercion + event-time extraction
+        (reference: internal/topo/operator/preprocessor.go)."""
+        if self.schema is not None and not self.schema.schemaless:
+            msg = {}
+            for f in self.schema.fields:
+                if f.name in t.message:
+                    try:
+                        msg[f.name] = cast.to_typed(t.message[f.name], f, self.strict)
+                    except cast.CastError as exc:
+                        self.stats.inc_exception(str(exc))
+                        return None
+            t.message = msg
+        if self.timestamp_field:
+            v = t.message.get(self.timestamp_field)
+            if v is None:
+                self.stats.inc_exception(
+                    f"missing timestamp field {self.timestamp_field}"
+                )
+                return None
+            try:
+                t.timestamp = cast.to_datetime_ms(v)
+            except cast.CastError as exc:
+                self.stats.inc_exception(str(exc))
+                return None
+        return t
+
+    def _flush(self) -> None:
+        with self._pending_lock:
+            if not self._pending:
+                return
+            rows, self._pending = self._pending, []
+        batch = from_tuples(rows, schema=self.schema, emitter=self.name)
+        self.emit(batch, count=batch.n)
+
+    def on_eof(self, eof: EOF) -> None:
+        self._flush()
+        self.broadcast(eof)
+
+    # source node's queue is only used for barriers/EOF injection
+    def process(self, item: Any) -> None:
+        self.ingest(item)

@@ -1,0 +1,340 @@
+"""Host-path window operators — analogue of eKuiper's WindowOperator v1/v2
+(internal/topo/node/window_op.go:235 execProcessingWindow,
+event_window_trigger.go:112 execEventWindow) and WatermarkOp
+(watermark_op.go:33-170).
+
+These buffer rows and emit WindowTuples at triggers. They serve the window
+types / options the fused device kernel doesn't take (sliding, session,
+state, event-time, trigger conditions); the aggregation over their output
+is still batch-vectorized downstream where possible.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..data.batch import ColumnBatch
+from ..data.rows import Row, Tuple, WindowRange, WindowTuples
+from ..sql import ast
+from ..sql.eval import Evaluator
+from ..utils import timex
+from .events import EOF, Trigger, Watermark
+from .node import Node
+
+
+class WatermarkNode(Node):
+    """Generates watermarks from event timestamps, drops late events
+    (reference: watermark_op.go — lateTolerance drop + ordered release)."""
+
+    def __init__(self, name: str, late_tolerance_ms: int = 0, **kw) -> None:
+        super().__init__(name, op_type="op", **kw)
+        self.late_tolerance = late_tolerance_ms
+        self.max_ts = 0
+        self.dropped = 0
+
+    def process(self, item: Any) -> None:
+        if isinstance(item, ColumnBatch):
+            rows = item.to_tuples()
+        elif isinstance(item, Row):
+            rows = [item]
+        else:
+            self.emit(item)
+            return
+        wm = self.max_ts - self.late_tolerance
+        out = []
+        for r in rows:
+            if r.timestamp < wm:
+                self.dropped += 1
+                self.stats.inc_exception("late event dropped")
+                continue
+            self.max_ts = max(self.max_ts, r.timestamp)
+            out.append(r)
+        for r in sorted(out, key=lambda t: t.timestamp):
+            self.emit(r)
+        new_wm = self.max_ts - self.late_tolerance
+        if new_wm > 0:
+            self.broadcast(Watermark(ts=new_wm))
+
+    def snapshot_state(self) -> Optional[dict]:
+        return {"max_ts": self.max_ts}
+
+    def restore_state(self, state: dict) -> None:
+        self.max_ts = state.get("max_ts", 0)
+
+
+class WindowNode(Node):
+    """Buffering window operator, all types, processing- or event-time."""
+
+    def __init__(
+        self,
+        name: str,
+        window: ast.Window,
+        is_event_time: bool = False,
+        rule_id: str = "",
+        **kw,
+    ) -> None:
+        super().__init__(name, op_type="op", **kw)
+        self.window = window
+        self.is_event_time = is_event_time
+        self.ev = Evaluator(rule_id=rule_id)
+        self.buffer: List[Row] = []
+        self.length_ms = window.length_ms()
+        self.interval_ms = window.interval_ms()
+        self.delay_ms = window.delay_ms()
+        self.wt = window.window_type
+        # count window
+        self.count_len = window.length or 0
+        self.count_interval = window.interval or self.count_len
+        self._rows_since_emit = 0
+        # session
+        self._session_start: Optional[int] = None
+        self._session_timer = None
+        self._session_cap_timer = None
+        # state window
+        self._state_open = False
+        # event-time bookkeeping
+        self._next_emit_end: Optional[int] = None
+        self._timer = None
+
+    # ----------------------------------------------------------------- open
+    def on_open(self) -> None:
+        if self.is_event_time:
+            return
+        if self.wt in (ast.WindowType.TUMBLING_WINDOW, ast.WindowType.HOPPING_WINDOW):
+            self._schedule_next_tick()
+
+    def on_close(self) -> None:
+        for t in (self._timer, self._session_timer, self._session_cap_timer):
+            if t is not None:
+                t.stop()
+
+    def _tick_interval(self) -> int:
+        if self.wt == ast.WindowType.TUMBLING_WINDOW:
+            return self.length_ms
+        return self.interval_ms or self.length_ms
+
+    def _schedule_next_tick(self) -> None:
+        now = timex.now_ms()
+        interval = self._tick_interval()
+        # epoch-aligned boundaries like the reference's getAlignedWindowEndTime
+        next_end = timex.align_to_window(now + 1, interval)
+        self._timer = timex.after(
+            next_end - now, lambda ts: self.inq.put(Trigger(ts=ts))
+        )
+
+    # --------------------------------------------------------------- ingest
+    def process(self, item: Any) -> None:
+        if isinstance(item, ColumnBatch):
+            rows: List[Row] = item.to_tuples()
+        elif isinstance(item, Row):
+            rows = [item]
+        else:
+            self.emit(item)
+            return
+        if self.window.filter is not None:
+            rows = [r for r in rows if self.ev.eval_condition(self.window.filter, r)]
+        for r in rows:
+            self._ingest_row(r)
+
+    def _ingest_row(self, r: Row) -> None:
+        wt = self.wt
+        if wt == ast.WindowType.COUNT_WINDOW:
+            self.buffer.append(r)
+            if len(self.buffer) > self.count_len:
+                del self.buffer[: len(self.buffer) - self.count_len]
+            self._rows_since_emit += 1
+            if self._rows_since_emit >= self.count_interval:
+                self._rows_since_emit = 0
+                self._emit_window(list(self.buffer), WindowRange(0, timex.now_ms()))
+            return
+        if wt == ast.WindowType.STATE_WINDOW:
+            if not self._state_open:
+                if self.ev.eval_condition(self.window.begin_condition, r):
+                    self._state_open = True
+                    self.buffer = [r]
+                return
+            self.buffer.append(r)
+            if self.ev.eval_condition(self.window.emit_condition, r):
+                self._emit_window(self.buffer, WindowRange(0, timex.now_ms()))
+                self.buffer = []
+                self._state_open = False
+            return
+        if wt == ast.WindowType.SESSION_WINDOW and not self.is_event_time:
+            now = timex.now_ms()
+            if not self.buffer:
+                self._session_start = now
+                if self.length_ms > 0:
+                    self._session_cap_timer = timex.after(
+                        self.length_ms, lambda ts: self.inq.put(Trigger(ts=ts, tag="cap"))
+                    )
+            self.buffer.append(r)
+            if self._session_timer is not None:
+                self._session_timer.stop()
+            timeout = self.interval_ms or self.length_ms
+            self._session_timer = timex.after(
+                timeout, lambda ts: self.inq.put(Trigger(ts=ts, tag="gap"))
+            )
+            return
+        if wt == ast.WindowType.SLIDING_WINDOW and not self.is_event_time:
+            now = timex.now_ms()
+            self.buffer.append(r)
+            self._evict_before(now - self.length_ms - self.delay_ms)
+            should = True
+            if self.window.trigger_condition is not None:
+                should = self.ev.eval_condition(self.window.trigger_condition, r)
+            if should:
+                if self.delay_ms > 0:
+                    t0 = now
+                    timex.after(
+                        self.delay_ms,
+                        lambda ts: self.inq.put(Trigger(ts=ts, tag=("delayed", t0))),
+                    )
+                else:
+                    self._emit_window(
+                        [x for x in self.buffer if x.timestamp > now - self.length_ms],
+                        WindowRange(now - self.length_ms, now),
+                    )
+            return
+        # tumbling/hopping (processing or event time), event-time session/sliding
+        self.buffer.append(r)
+        if self.is_event_time:
+            return
+
+    # -------------------------------------------------------------- triggers
+    def on_trigger(self, trig: Trigger) -> None:
+        wt = self.wt
+        if wt in (ast.WindowType.TUMBLING_WINDOW, ast.WindowType.HOPPING_WINDOW):
+            end = trig.ts
+            start = end - self.length_ms
+            if wt == ast.WindowType.TUMBLING_WINDOW:
+                rows, self.buffer = self.buffer, []
+            else:
+                rows = [r for r in self.buffer if r.timestamp > start]
+                self._evict_before(end - self.length_ms + (self.interval_ms or 0))
+            self._emit_window(rows, WindowRange(start, end))
+            self._schedule_next_tick()
+            return
+        if wt == ast.WindowType.SESSION_WINDOW:
+            if trig.tag == "gap" or trig.tag == "cap":
+                if self.buffer:
+                    self._emit_window(
+                        self.buffer,
+                        WindowRange(self._session_start or 0, trig.ts),
+                    )
+                    self.buffer = []
+                if self._session_cap_timer is not None:
+                    self._session_cap_timer.stop()
+            return
+        if wt == ast.WindowType.SLIDING_WINDOW and isinstance(trig.tag, tuple):
+            _, t0 = trig.tag
+            start = t0 - self.length_ms
+            end = t0 + self.delay_ms
+            rows = [x for x in self.buffer if start < x.timestamp <= end]
+            self._emit_window(rows, WindowRange(start, end))
+            self._evict_before(timex.now_ms() - self.length_ms - self.delay_ms)
+            return
+
+    def on_watermark(self, wm: Watermark) -> None:
+        """Event-time triggering (event_window_trigger.go:30-112)."""
+        if not self.is_event_time:
+            self.broadcast(wm)
+            return
+        wt = self.wt
+        if wt in (ast.WindowType.TUMBLING_WINDOW, ast.WindowType.HOPPING_WINDOW):
+            interval = self._tick_interval()
+            if self._next_emit_end is None:
+                # first window end at the next aligned boundary past the
+                # earliest buffered event
+                if not self.buffer:
+                    self.broadcast(wm)
+                    return
+                first_ts = min(r.timestamp for r in self.buffer)
+                self._next_emit_end = timex.align_to_window(first_ts + 1, interval)
+            while self._next_emit_end is not None and wm.ts >= self._next_emit_end:
+                end = self._next_emit_end
+                start = end - self.length_ms
+                rows = [r for r in self.buffer if start < r.timestamp <= end]
+                if wt == ast.WindowType.TUMBLING_WINDOW:
+                    self.buffer = [r for r in self.buffer if r.timestamp > end]
+                else:
+                    self._evict_before(end - self.length_ms + interval)
+                self._emit_window(rows, WindowRange(start, end))
+                self._next_emit_end = end + interval
+        elif wt == ast.WindowType.SLIDING_WINDOW:
+            # trigger one window per event whose (ts + delay) has passed
+            ready = [r for r in self.buffer if r.timestamp + self.delay_ms <= wm.ts
+                     and not getattr(r, "_slid", False)]
+            for r in ready:
+                t0 = r.timestamp
+                rows = [
+                    x for x in self.buffer
+                    if t0 - self.length_ms < x.timestamp <= t0 + self.delay_ms
+                ]
+                if self.window.trigger_condition is None or self.ev.eval_condition(
+                    self.window.trigger_condition, r
+                ):
+                    self._emit_window(
+                        rows, WindowRange(t0 - self.length_ms, t0 + self.delay_ms)
+                    )
+                setattr(r, "_slid", True)
+            self._evict_before(wm.ts - self.length_ms - self.delay_ms)
+        elif wt == ast.WindowType.SESSION_WINDOW:
+            timeout = self.interval_ms or self.length_ms
+            self.buffer.sort(key=lambda r: r.timestamp)
+            while self.buffer:
+                # find a complete session fully below the watermark
+                session: List[Row] = [self.buffer[0]]
+                for r in self.buffer[1:]:
+                    if r.timestamp - session[-1].timestamp > timeout:
+                        break
+                    session.append(r)
+                last = session[-1].timestamp
+                if last + timeout <= wm.ts:
+                    self._emit_window(
+                        session,
+                        WindowRange(session[0].timestamp, last + timeout),
+                    )
+                    self.buffer = self.buffer[len(session):]
+                else:
+                    break
+        self.broadcast(wm)
+
+    def on_eof(self, eof: EOF) -> None:
+        # flush whatever is buffered (trial/bounded runs)
+        if self.buffer:
+            now = timex.now_ms()
+            self._emit_window(
+                list(self.buffer), WindowRange(now - self.length_ms, now)
+            )
+            self.buffer = []
+        self.broadcast(eof)
+
+    # ----------------------------------------------------------------- emit
+    def _emit_window(self, rows: List[Row], wr: WindowRange) -> None:
+        self.emit(WindowTuples(content=list(rows), window_range=wr))
+
+    def _evict_before(self, ts: int) -> None:
+        if ts <= 0:
+            return
+        self.buffer = [r for r in self.buffer if r.timestamp > ts]
+
+    # ----------------------------------------------------------------- state
+    def snapshot_state(self) -> Optional[dict]:
+        return {
+            "buffer": [
+                {"message": r.message, "timestamp": r.timestamp, "emitter": r.emitter}
+                for r in self.buffer if isinstance(r, Tuple)
+            ],
+            "rows_since_emit": self._rows_since_emit,
+            "state_open": self._state_open,
+            "next_emit_end": self._next_emit_end,
+        }
+
+    def restore_state(self, state: dict) -> None:
+        self.buffer = [
+            Tuple(emitter=d.get("emitter", ""), message=d["message"],
+                  timestamp=d["timestamp"])
+            for d in state.get("buffer", [])
+        ]
+        self._rows_since_emit = state.get("rows_since_emit", 0)
+        self._state_open = state.get("state_open", False)
+        self._next_emit_end = state.get("next_emit_end")

@@ -1,0 +1,173 @@
+"""Rule lifecycle FSM — analogue of eKuiper's rule.State
+(internal/topo/rule/state.go:76-575): Starting/Running/Stopping/Stopped
+with a serialized action queue, restart strategy with exponential backoff +
+jitter, and per-rule status/metrics aggregation.
+"""
+from __future__ import annotations
+
+import queue
+import random
+import threading
+from enum import Enum
+from typing import Any, Dict, Optional
+
+from ..planner.planner import RuleDef, plan_rule
+from ..utils import timex
+from ..utils.infra import logger
+from .topo import Topo
+
+
+class RunState(str, Enum):
+    STOPPED = "stopped"
+    STARTING = "starting"
+    RUNNING = "running"
+    STOPPING = "stopping"
+    STOPPED_BY_ERR = "stopped_by_error"
+
+
+class RuleState:
+    def __init__(self, rule: RuleDef, store) -> None:
+        self.rule = rule
+        self.store = store
+        self.state = RunState.STOPPED
+        self.topo: Optional[Topo] = None
+        self.last_error: str = ""
+        self.started_at = 0
+        self._lock = threading.RLock()
+        self._actions: "queue.Queue[str]" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._supervisor: Optional[threading.Thread] = None
+        self._stop_supervision = threading.Event()
+
+    # --------------------------------------------------------------- actions
+    def start(self) -> None:
+        self._enqueue("start")
+
+    def stop(self) -> None:
+        self._enqueue("stop")
+
+    def restart(self) -> None:
+        self._enqueue("stop")
+        self._enqueue("start")
+
+    def _enqueue(self, action: str) -> None:
+        self._actions.put(action)
+        with self._lock:
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._drain_actions, daemon=True,
+                    name=f"rule-{self.rule.id}",
+                )
+                self._worker.start()
+
+    def _drain_actions(self) -> None:
+        while True:
+            try:
+                action = self._actions.get(timeout=0.5)
+            except queue.Empty:
+                return
+            try:
+                if action == "start":
+                    self._do_start()
+                elif action == "stop":
+                    self._do_stop()
+            except Exception as exc:
+                logger.error("rule %s action %s failed: %s", self.rule.id, action, exc)
+                with self._lock:
+                    self.state = RunState.STOPPED_BY_ERR
+                    self.last_error = str(exc)
+
+    # ------------------------------------------------------------- transitions
+    def _do_start(self) -> None:
+        with self._lock:
+            if self.state in (RunState.RUNNING, RunState.STARTING):
+                return
+            self.state = RunState.STARTING
+        topo = plan_rule(self.rule, self.store)
+        topo.open()
+        with self._lock:
+            self.topo = topo
+            self.state = RunState.RUNNING
+            self.started_at = timex.now_ms()
+            self.last_error = ""
+        self._stop_supervision.clear()
+        self._supervisor = threading.Thread(
+            target=self._supervise, daemon=True,
+            name=f"rule-supervisor-{self.rule.id}",
+        )
+        self._supervisor.start()
+
+    def _do_stop(self) -> None:
+        with self._lock:
+            if self.state in (RunState.STOPPED, RunState.STOPPING):
+                if self.state == RunState.STOPPED:
+                    return
+            self.state = RunState.STOPPING
+        self._stop_supervision.set()
+        if self.topo is not None:
+            try:
+                self.topo.save_state_now()
+            except Exception as exc:
+                logger.debug("save state on stop failed: %s", exc)
+            self.topo.close()
+        with self._lock:
+            self.topo = None
+            self.state = RunState.STOPPED
+
+    # ------------------------------------------------------------- supervision
+    def _supervise(self) -> None:
+        """Watch the topo error channel, apply the restart strategy
+        (reference: state.go:498-575 runTopo)."""
+        opts = self.rule.options.get("restartStrategy", {})
+        attempts = int(opts.get("attempts", 0))
+        delay = int(opts.get("delay", 1000))
+        max_delay = int(opts.get("maxDelay", 30_000))
+        multiplier = float(opts.get("multiplier", 2.0))
+        jitter = float(opts.get("jitterFactor", 0.1))
+        tried = 0
+        cur_delay = delay
+        while not self._stop_supervision.is_set():
+            topo = self.topo
+            if topo is None:
+                return
+            err = topo.wait_error(timeout=0.5)
+            if err is None:
+                continue
+            logger.error("rule %s runtime error: %s", self.rule.id, err)
+            with self._lock:
+                self.last_error = str(err)
+            if tried >= attempts:
+                with self._lock:
+                    self.state = RunState.STOPPED_BY_ERR
+                topo.close()
+                with self._lock:
+                    self.topo = None
+                return
+            tried += 1
+            topo.close()
+            sleep_ms = int(cur_delay * (1 + random.uniform(-jitter, jitter)))
+            timex.sleep(max(sleep_ms, 0))
+            cur_delay = min(int(cur_delay * multiplier), max_delay)
+            try:
+                new_topo = plan_rule(self.rule, self.store)
+                new_topo.open()
+                with self._lock:
+                    self.topo = new_topo
+                    self.state = RunState.RUNNING
+            except Exception as exc:
+                with self._lock:
+                    self.state = RunState.STOPPED_BY_ERR
+                    self.last_error = str(exc)
+                return
+
+    # ----------------------------------------------------------------- status
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {
+                "status": self.state.value,
+            }
+            if self.last_error:
+                out["message"] = self.last_error
+            if self.topo is not None and self.state == RunState.RUNNING:
+                out.update(self.topo.status())
+            return out

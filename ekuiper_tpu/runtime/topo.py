@@ -1,0 +1,172 @@
+"""Per-rule topology — analogue of eKuiper's Topo (internal/topo/topo.go:46-318):
+owns the node DAG, opens sinks→ops→sources, drains errors, coordinates
+checkpoints, and persists/restores state through the rule's KV store.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..store import kv
+from ..utils import timex
+from ..utils.infra import logger
+from ..utils.metrics import flatten_status
+from .events import Barrier
+from .node import Node
+
+
+class Topo:
+    def __init__(self, rule_id: str, qos: int = 0, checkpoint_interval_ms: int = 300_000) -> None:
+        self.rule_id = rule_id
+        self.qos = qos
+        self.checkpoint_interval_ms = checkpoint_interval_ms
+        self.sources: List[Node] = []
+        self.ops: List[Node] = []
+        self.sinks: List[Node] = []
+        self.errq: "queue.Queue[BaseException]" = queue.Queue(maxsize=8)
+        self._open = False
+        self._ckpt_timer = None
+        self._ckpt_id = 0
+        self._ckpt_lock = threading.Lock()
+        self._ckpt_pending: Dict[int, Dict[str, Optional[dict]]] = {}
+        self._store = None
+
+    # ------------------------------------------------------------------ wiring
+    def add_source(self, node: Node) -> Node:
+        node._topo = self
+        self.sources.append(node)
+        return node
+
+    def add_op(self, node: Node) -> Node:
+        node._topo = self
+        self.ops.append(node)
+        return node
+
+    def add_sink(self, node: Node) -> Node:
+        node._topo = self
+        self.sinks.append(node)
+        return node
+
+    def all_nodes(self) -> List[Node]:
+        return self.sources + self.ops + self.sinks
+
+    # --------------------------------------------------------------- lifecycle
+    def open(self) -> None:
+        """Start sinks → ops → sources (reference order, topo.go:275-318),
+        restore checkpointed state, then activate checkpointing if QoS>0."""
+        if self.qos > 0:
+            self._store = kv.get_store().kv(f"checkpoint:{self.rule_id}")
+            self._restore()
+        for node in self.sinks + self.ops + self.sources:
+            node.open()
+        self._open = True
+        if self.qos > 0:
+            self._schedule_checkpoint()
+
+    def close(self) -> None:
+        self._open = False
+        if self._ckpt_timer is not None:
+            self._ckpt_timer.stop()
+        for node in self.sources + self.ops + self.sinks:
+            node.close()
+        for node in self.all_nodes():
+            node.join(timeout=2.0)
+
+    def drain_error(self, err: BaseException, origin: str = "") -> None:
+        logger.error("rule %s node %s failed: %s", self.rule_id, origin, err)
+        try:
+            self.errq.put_nowait(err)
+        except queue.Full:
+            pass
+
+    def wait_error(self, timeout: Optional[float] = None) -> Optional[BaseException]:
+        try:
+            return self.errq.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    # ------------------------------------------------------------- status JSON
+    def status(self) -> Dict[str, Any]:
+        stats = {n.name: n.stats for n in self.all_nodes()}
+        return flatten_status(stats)
+
+    def topo_json(self) -> Dict[str, Any]:
+        edges: Dict[str, List[str]] = {}
+        for n in self.all_nodes():
+            edges[n.name] = [o.name for o in n.outputs]
+        return {
+            "sources": [n.name for n in self.sources],
+            "edges": edges,
+        }
+
+    # -------------------------------------------------------------- checkpoint
+    def _schedule_checkpoint(self) -> None:
+        def fire(ts: int) -> None:
+            if not self._open:
+                return
+            self.trigger_checkpoint()
+            self._schedule_checkpoint()
+
+        self._ckpt_timer = timex.after(self.checkpoint_interval_ms, fire)
+
+    def trigger_checkpoint(self) -> int:
+        """Inject barriers at sources (coordinator.go:236-324)."""
+        with self._ckpt_lock:
+            self._ckpt_id += 1
+            cid = self._ckpt_id
+            self._ckpt_pending[cid] = {}
+        barrier = Barrier(checkpoint_id=cid)
+        for src in self.sources:
+            src.put(barrier)
+        return cid
+
+    def checkpoint_ack(self, node_name: str, barrier: Barrier, state: Optional[dict]) -> None:
+        """Task snapshot ack; completes the checkpoint when all stateful
+        nodes have answered (coordinator.go:93-171)."""
+        with self._ckpt_lock:
+            pend = self._ckpt_pending.get(barrier.checkpoint_id)
+            if pend is None:
+                return
+            pend[node_name] = state
+            expected = {n.name for n in self.all_nodes()}
+            if set(pend.keys()) >= expected:
+                states = {k: v for k, v in pend.items() if v is not None}
+                del self._ckpt_pending[barrier.checkpoint_id]
+                if self._store is not None:
+                    self._store.set("latest", {
+                        "checkpoint_id": barrier.checkpoint_id,
+                        "states": states,
+                    })
+                logger.debug(
+                    "rule %s checkpoint %d complete (%d stateful nodes)",
+                    self.rule_id, barrier.checkpoint_id, len(states),
+                )
+
+    def _restore(self) -> None:
+        snap, ok = self._store.get_ok("latest")
+        if not ok or not snap:
+            return
+        states = snap.get("states", {})
+        by_name = {n.name: n for n in self.all_nodes()}
+        for name, state in states.items():
+            node = by_name.get(name)
+            if node is not None:
+                node.restore_state(state)
+        self._ckpt_id = snap.get("checkpoint_id", 0)
+
+    def save_state_now(self) -> None:
+        """Force-save without barriers (EnableSaveStateBeforeStop,
+        topo.go:113-120) — used on graceful stop."""
+        if self._store is None:
+            return
+        states = {}
+        for node in self.all_nodes():
+            s = node.snapshot_state()
+            if s is not None:
+                states[node.name] = s
+        with self._ckpt_lock:
+            self._ckpt_id += 1
+            self._store.set("latest", {
+                "checkpoint_id": self._ckpt_id, "states": states,
+            })

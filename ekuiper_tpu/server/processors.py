@@ -1,0 +1,140 @@
+"""Definition processors — analogue of eKuiper's internal/processor:
+StreamProcessor.ExecStmt (stream.go:73,229) for DDL, RuleProcessor (rule.go)
+for rule defs, RulesetProcessor for import/export.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..planner.planner import RuleDef
+from ..sql import ast
+from ..sql.parser import parse
+from ..store import kv
+from ..utils.infra import ParseError, PlanError
+
+
+class StreamProcessor:
+    def __init__(self, store=None) -> None:
+        self.store = store or kv.get_store()
+
+    def _table_for(self, is_table: bool):
+        return self.store.kv("table" if is_table else "stream")
+
+    def exec_stmt(self, sql: str) -> Any:
+        """Execute a DDL statement; returns a result payload like the
+        reference's CLI/REST responses."""
+        stmt = parse(sql)
+        if isinstance(stmt, ast.StreamStmt):
+            return self.create(stmt, sql)
+        if isinstance(stmt, ast.ShowStmt):
+            return self.show(stmt.target == "TABLES")
+        if isinstance(stmt, ast.DescribeStmt):
+            return self.describe(stmt.name, stmt.target == "TABLE")
+        if isinstance(stmt, ast.DropStmt):
+            return self.drop(stmt.name, stmt.target == "TABLE")
+        raise ParseError("unsupported statement for stream processor")
+
+    def create(self, stmt: ast.StreamStmt, sql: str) -> str:
+        table = self._table_for(stmt.is_table)
+        if not table.setnx(stmt.name, {"sql": sql}):
+            kind = "table" if stmt.is_table else "stream"
+            raise PlanError(f"{kind} {stmt.name} already exists")
+        return f"{'Table' if stmt.is_table else 'Stream'} {stmt.name} is created."
+
+    def show(self, tables: bool = False) -> List[str]:
+        return sorted(self._table_for(tables).keys())
+
+    def describe(self, name: str, is_table: bool = False) -> Dict[str, Any]:
+        raw, ok = self._table_for(is_table).get_ok(name)
+        if not ok:
+            raise PlanError(f"{'table' if is_table else 'stream'} {name} not found")
+        stmt = parse(raw["sql"])
+        return {
+            "name": stmt.name,
+            "fields": [
+                {"name": f.name, "type": f.type.value} for f in stmt.fields
+            ],
+            "options": stmt.options.to_dict(),
+            "sql": raw["sql"],
+        }
+
+    def drop(self, name: str, is_table: bool = False) -> str:
+        if not self._table_for(is_table).delete(name):
+            raise PlanError(f"{'table' if is_table else 'stream'} {name} not found")
+        return f"{'Table' if is_table else 'Stream'} {name} is dropped."
+
+
+class RuleProcessor:
+    def __init__(self, store=None) -> None:
+        self.store = store or kv.get_store()
+
+    def _table(self):
+        return self.store.kv("rule")
+
+    def create(self, rule_json: Dict[str, Any]) -> RuleDef:
+        rule = RuleDef.from_dict(rule_json)
+        if not rule.id:
+            raise PlanError("rule id is required")
+        if not rule.sql:
+            raise PlanError("rule sql is required")
+        if not self._table().setnx(rule.id, rule.to_dict()):
+            raise PlanError(f"rule {rule.id} already exists")
+        return rule
+
+    def update(self, rule_json: Dict[str, Any]) -> RuleDef:
+        rule = RuleDef.from_dict(rule_json)
+        _, ok = self._table().get_ok(rule.id)
+        if not ok:
+            raise PlanError(f"rule {rule.id} not found")
+        self._table().set(rule.id, rule.to_dict())
+        return rule
+
+    def get(self, rule_id: str) -> RuleDef:
+        raw, ok = self._table().get_ok(rule_id)
+        if not ok:
+            raise PlanError(f"rule {rule_id} not found")
+        return RuleDef.from_dict(raw)
+
+    def list(self) -> List[str]:
+        return sorted(self._table().keys())
+
+    def drop(self, rule_id: str) -> None:
+        if not self._table().delete(rule_id):
+            raise PlanError(f"rule {rule_id} not found")
+        # drop checkpoint state too
+        self.store.drop(f"checkpoint:{rule_id}")
+
+
+class RulesetProcessor:
+    """Import/export of streams+tables+rules as one JSON document
+    (reference: internal/processor/ruleset.go)."""
+
+    def __init__(self, store=None) -> None:
+        self.store = store or kv.get_store()
+
+    def export(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"streams": {}, "tables": {}, "rules": {}}
+        for name, v in self.store.kv("stream").items():
+            out["streams"][name] = v["sql"]
+        for name, v in self.store.kv("table").items():
+            out["tables"][name] = v["sql"]
+        for rid, v in self.store.kv("rule").items():
+            out["rules"][rid] = v
+        return out
+
+    def import_ruleset(self, doc: Dict[str, Any]) -> Dict[str, int]:
+        counts = {"streams": 0, "tables": 0, "rules": 0}
+        for name, sql in doc.get("streams", {}).items():
+            self.store.kv("stream").set(name, {"sql": sql})
+            counts["streams"] += 1
+        for name, sql in doc.get("tables", {}).items():
+            self.store.kv("table").set(name, {"sql": sql})
+            counts["tables"] += 1
+        for rid, rule in doc.get("rules", {}).items():
+            if isinstance(rule, str):
+                rule = json.loads(rule)
+            rule.setdefault("id", rid)
+            self.store.kv("rule").set(rid, rule)
+            counts["rules"] += 1
+        return counts

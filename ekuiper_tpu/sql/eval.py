@@ -297,6 +297,15 @@ class Evaluator:
         ctx = self._ctx_for(e, row)
         if fd.ftype == registry.AGGREGATE:
             return self._eval_agg_call(e, fd, row, ctx)
+        if fd.ftype in (registry.ANALYTIC, registry.WINDOW_FUNC):
+            # AnalyticNode/WindowFuncNode pre-compute and cache on the row
+            if row is not None:
+                cached, ok = row.value(f"__analytic_{e.func_id}")
+                if ok:
+                    return cached
+        if fd.ftype == registry.WINDOW_FUNC:
+            args = [self.eval(a, row) for a in e.args]
+            return fd.exec(args, ctx)
         if fd.ftype == registry.ANALYTIC:
             partition = ""
             if e.partition:
@@ -334,6 +343,13 @@ class Evaluator:
         """Aggregate call: collect arg values over the group's rows.
         `row` must be a GroupedTuples/Collection; a bare Row means we're in a
         non-grouped agg context (whole collection = the row's group)."""
+        pre = getattr(row, "agg_values", None)
+        if pre:
+            from ..ops.aggspec import _call_key
+
+            key = _call_key(e)
+            if key in pre:
+                return pre[key]
         rows: List[Row]
         if isinstance(row, GroupedTuples):
             rows = row.rows()

@@ -7,13 +7,20 @@ under `go test` (pkg/timex): every test starts with a fresh mock clock.
 """
 import os
 
-# Must happen before jax import anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must happen before jax import anywhere. Force CPU even when the outer
+# environment selects a TPU platform (axon) — tests must not need a chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# The env var alone does not win over an installed TPU platform plugin
+# (axon); the config update does.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

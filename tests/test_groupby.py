@@ -1,0 +1,268 @@
+"""Device group-by kernel tests: fold/finalize vs the row interpreter."""
+import numpy as np
+import pytest
+
+from ekuiper_tpu.data.rows import GroupedTuples, Tuple
+from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+from ekuiper_tpu.ops.groupby import DeviceGroupBy
+from ekuiper_tpu.ops.keytable import KeyTable
+from ekuiper_tpu.sql.eval import Evaluator
+from ekuiper_tpu.sql.parser import parse_select
+
+
+class TestKeyTable:
+    def test_encode_stable(self):
+        kt = KeyTable()
+        col = np.array(["b", "a", "b", "c"], dtype=np.object_)
+        slots, grew = kt.encode_column(col)
+        assert not grew
+        assert slots[0] == slots[2]
+        assert len(set(slots.tolist())) == 3
+        # same keys later -> same slots
+        slots2, _ = kt.encode_column(np.array(["a", "c"], dtype=np.object_))
+        assert slots2[0] == slots[1] and slots2[1] == slots[3]
+        assert kt.decode(int(slots[0])) == "b"
+
+    def test_growth_signal(self):
+        kt = KeyTable(initial_capacity=2)
+        slots, grew = kt.encode_column(np.array(["a", "b", "c"], dtype=np.object_))
+        assert grew and kt.capacity == 4
+
+    def test_multi_column_key(self):
+        kt = KeyTable()
+        a = np.array(["x", "x", "y"], dtype=np.object_)
+        b = np.array([1, 2, 1])
+        slots, _ = kt.encode_multi([a, b])
+        assert len(set(slots.tolist())) == 3
+        assert kt.decode(int(slots[0])) == ("x", 1)
+
+
+def _plan(sql):
+    stmt = parse_select(sql)
+    plan = extract_kernel_plan(stmt)
+    assert plan is not None, "expected device-eligible plan"
+    return stmt, plan
+
+
+class TestKernelPlan:
+    def test_eligible(self):
+        _, plan = _plan(
+            "SELECT avg(temp), count(*), min(temp), max(hum), stddev(temp) "
+            "FROM demo WHERE temp > 0 GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"
+        )
+        assert len(plan.specs) == 5
+        assert plan.columns == {"temp", "hum"}
+        assert plan.filter is not None
+
+    def test_dedup_having_reuses_field_agg(self):
+        _, plan = _plan(
+            "SELECT avg(temp) FROM demo GROUP BY deviceId, TUMBLINGWINDOW(ss, 10) "
+            "HAVING avg(temp) > 20"
+        )
+        assert len(plan.specs) == 1
+
+    def test_not_eligible_string_agg(self):
+        stmt = parse_select("SELECT collect(name) FROM demo GROUP BY TUMBLINGWINDOW(ss, 10)")
+        assert extract_kernel_plan(stmt) is None
+
+    def test_not_eligible_no_aggs(self):
+        stmt = parse_select("SELECT a FROM demo")
+        assert extract_kernel_plan(stmt) is None
+
+
+def _fold_rows(gb, state, kt, rows, key="dev"):
+    devs = np.array([r[key] for r in rows], dtype=np.object_)
+    slots, grew = kt.encode_column(devs)
+    if grew:
+        state = gb.grow(state, kt.capacity)
+    cols = {}
+    for name in gb.plan.columns:
+        cols[name] = np.array(
+            [r.get(name, np.nan) for r in rows], dtype=np.float32
+        )
+    gb.observe_dtypes(cols)
+    return gb.fold(state, cols, slots)
+
+
+class TestDeviceGroupBy:
+    def test_tumbling_avg_matches_interpreter(self):
+        stmt, plan = _plan(
+            "SELECT avg(temp), count(*), min(temp), max(temp), stddev(temp) "
+            "FROM demo GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"
+        )
+        rng = np.random.default_rng(0)
+        rows = [
+            {"dev": f"d{rng.integers(5)}", "temp": float(rng.normal(20, 5))}
+            for _ in range(500)
+        ]
+        gb = DeviceGroupBy(plan, capacity=64, micro_batch=128)
+        kt = KeyTable(64)
+        state = _fold_rows(gb, gb.init_state(), kt, rows)
+        outs, act = gb.finalize(state, kt.n_keys)
+
+        # reference result via the interpreter over per-key groups
+        ev = Evaluator()
+        by_key = {}
+        for r in rows:
+            by_key.setdefault(r["dev"], []).append(
+                Tuple(message={"temp": r["temp"]})
+            )
+        for slot in range(kt.n_keys):
+            key = kt.decode(slot)
+            g = GroupedTuples(content=by_key[key])
+            for i, (call, col) in enumerate(zip(plan.specs, outs)):
+                exp = ev.eval(call.call, g)
+                got = float(col[slot])
+                assert abs(got - float(exp)) < 1e-2, (
+                    f"{call.kind} key={key}: {got} vs {exp}"
+                )
+            assert act[slot] == len(by_key[key])
+
+    def test_where_filter_on_device(self):
+        stmt, plan = _plan(
+            "SELECT count(*) FROM demo WHERE temp > 25 "
+            "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"
+        )
+        rows = [
+            {"dev": "a", "temp": 20.0}, {"dev": "a", "temp": 30.0},
+            {"dev": "b", "temp": 26.0}, {"dev": "b", "temp": 27.0},
+        ]
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=8)
+        kt = KeyTable(8)
+        state = _fold_rows(gb, gb.init_state(), kt, rows)
+        outs, act = gb.finalize(state, kt.n_keys)
+        assert outs[0][kt._ids["a"]] == 1
+        assert outs[0][kt._ids["b"]] == 2
+        # a group with zero post-filter rows must not emit
+        rows2 = [{"dev": "c", "temp": 10.0}]
+        state = _fold_rows(gb, state, kt, rows2)
+        outs, act = gb.finalize(state, kt.n_keys)
+        assert act[kt._ids["c"]] == 0
+
+    def test_nan_null_excluded(self):
+        stmt, plan = _plan(
+            "SELECT count(temp), sum(temp) FROM demo GROUP BY dev, TUMBLINGWINDOW(ss, 10)"
+        )
+        rows = [
+            {"dev": "a", "temp": 1.0}, {"dev": "a"},  # missing temp -> NaN
+            {"dev": "a", "temp": 2.0},
+        ]
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=8)
+        kt = KeyTable(8)
+        state = _fold_rows(gb, gb.init_state(), kt, rows)
+        outs, act = gb.finalize(state, kt.n_keys)
+        assert outs[0][0] == 2  # count skips null
+        assert outs[1][0] == 3.0
+        assert act[0] == 3  # group still has 3 rows
+
+    def test_empty_group_nan(self):
+        stmt, plan = _plan(
+            "SELECT avg(temp) FROM demo GROUP BY dev, TUMBLINGWINDOW(ss, 10)"
+        )
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=8)
+        kt = KeyTable(8)
+        rows = [{"dev": "a"}]  # row with null temp
+        state = _fold_rows(gb, gb.init_state(), kt, rows)
+        outs, act = gb.finalize(state, kt.n_keys)
+        assert np.isnan(outs[0][0])  # NULL avg
+        assert act[0] == 1  # but the group exists
+
+    def test_reset_between_windows(self):
+        stmt, plan = _plan(
+            "SELECT count(*) FROM demo GROUP BY dev, TUMBLINGWINDOW(ss, 10)"
+        )
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=8)
+        kt = KeyTable(8)
+        state = _fold_rows(gb, gb.init_state(), kt, [{"dev": "a"}] * 3)
+        outs, _ = gb.finalize(state, kt.n_keys)
+        assert outs[0][0] == 3
+        state = gb.reset_pane(state, 0)
+        outs, act = gb.finalize(state, kt.n_keys)
+        assert outs[0][0] == 0 and act[0] == 0
+        state = _fold_rows(gb, state, kt, [{"dev": "a"}] * 2)
+        outs, _ = gb.finalize(state, kt.n_keys)
+        assert outs[0][0] == 2
+
+    def test_hopping_panes(self):
+        # hopping window length=4 interval=2 -> 2 panes; emit merges both
+        stmt, plan = _plan(
+            "SELECT sum(v) FROM demo GROUP BY dev, HOPPINGWINDOW(ss, 4, 2)"
+        )
+        gb = DeviceGroupBy(plan, capacity=8, n_panes=2, micro_batch=8)
+        kt = KeyTable(8)
+        state = gb.init_state()
+        devs = np.array(["a", "a"], dtype=np.object_)
+        slots, _ = kt.encode_column(devs)
+        # pane 0: v=1,2 ; pane 1: v=10,20
+        state = gb.fold(state, {"v": np.array([1.0, 2.0], np.float32)}, slots, pane_idx=0)
+        state = gb.fold(state, {"v": np.array([10.0, 20.0], np.float32)}, slots, pane_idx=1)
+        outs, _ = gb.finalize(state, kt.n_keys)  # both panes
+        assert outs[0][0] == 33.0
+        outs, _ = gb.finalize(state, kt.n_keys, panes=[1])
+        assert outs[0][0] == 30.0
+        # expire pane 0, fold new data into it
+        state = gb.reset_pane(state, 0)
+        state = gb.fold(state, {"v": np.array([5.0, 5.0], np.float32)}, slots, pane_idx=0)
+        outs, _ = gb.finalize(state, kt.n_keys)
+        assert outs[0][0] == 40.0
+
+    def test_capacity_growth_preserves_state(self):
+        stmt, plan = _plan(
+            "SELECT count(*) FROM demo GROUP BY dev, TUMBLINGWINDOW(ss, 10)"
+        )
+        gb = DeviceGroupBy(plan, capacity=2, micro_batch=4)
+        kt = KeyTable(2)
+        state = _fold_rows(gb, gb.init_state(), kt, [{"dev": "a"}, {"dev": "b"}])
+        # force growth
+        state = _fold_rows(gb, state, kt, [{"dev": "c"}, {"dev": "a"}])
+        assert gb.capacity == 4
+        outs, _ = gb.finalize(state, kt.n_keys)
+        assert outs[0][kt._ids["a"]] == 2
+        assert outs[0][kt._ids["c"]] == 1
+
+    def test_int_input_semantics(self):
+        stmt, plan = _plan(
+            "SELECT avg(n), sum(n) FROM demo GROUP BY dev, TUMBLINGWINDOW(ss, 10)"
+        )
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=8)
+        kt = KeyTable(8)
+        rows = [{"dev": "a", "n": 1}, {"dev": "a", "n": 2}]
+        devs = np.array(["a", "a"], dtype=np.object_)
+        slots, _ = kt.encode_column(devs)
+        cols = {"n": np.array([1, 2], dtype=np.int64)}
+        gb.observe_dtypes(cols)
+        state = gb.fold(gb.init_state(), cols, slots)
+        outs, _ = gb.finalize(state, kt.n_keys)
+        assert outs[0][0] == 1.0  # truncating int avg: (1+2)//2
+        assert outs[1][0] == 3.0
+
+    def test_agg_filter_clause(self):
+        stmt, plan = _plan(
+            "SELECT sum(v) FILTER (WHERE v > 1.0) FROM demo "
+            "GROUP BY dev, TUMBLINGWINDOW(ss, 10)"
+        )
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=8)
+        kt = KeyTable(8)
+        slots, _ = kt.encode_column(np.array(["a"] * 3, dtype=np.object_))
+        state = gb.fold(
+            gb.init_state(), {"v": np.array([0.5, 2.0, 3.0], np.float32)}, slots
+        )
+        outs, _ = gb.finalize(state, kt.n_keys)
+        assert outs[0][0] == 5.0
+
+    def test_large_batch_chunks(self):
+        stmt, plan = _plan(
+            "SELECT count(*), sum(v) FROM demo GROUP BY dev, TUMBLINGWINDOW(ss, 10)"
+        )
+        gb = DeviceGroupBy(plan, capacity=16, micro_batch=64)
+        kt = KeyTable(16)
+        n = 1000  # > micro_batch -> multiple chunks + padding
+        slots, _ = kt.encode_column(
+            np.array([f"d{i % 10}" for i in range(n)], dtype=np.object_)
+        )
+        state = gb.fold(
+            gb.init_state(), {"v": np.ones(n, np.float32)}, slots
+        )
+        outs, act = gb.finalize(state, kt.n_keys)
+        assert outs[0].sum() == n
+        assert outs[1].sum() == float(n)
