@@ -77,8 +77,11 @@ class KeyTable:
         n = len(cols[0])
         combo = np.empty(n, dtype=np.object_)
         for i in range(n):
+            # None elements normalize to "" (nil-key rule, see encode_column)
             combo[i] = tuple(
-                c[i].item() if isinstance(c[i], np.generic) else c[i] for c in cols
+                "" if c[i] is None
+                else (c[i].item() if isinstance(c[i], np.generic) else c[i])
+                for c in cols
             )
         return self.encode_column(combo)
 

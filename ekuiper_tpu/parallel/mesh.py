@@ -1,0 +1,38 @@
+"""Device mesh construction — the scale-out substrate.
+
+The reference scales by process-level fan-out (N rules × M goroutines, plugin
+worker processes over nanomsg IPC — SURVEY §5); the TPU-native equivalent is
+a jax.sharding.Mesh with two logical axes:
+
+- "rows": data parallelism over incoming event batches (the analogue of the
+  reference's shared-source fan-out);
+- "keys": GROUP BY key-axis sharding — each device owns a contiguous slot
+  range of the per-key aggregation state (the analogue obligation SURVEY §5
+  names "sequence parallel" for this workload).
+
+Collectives ride ICI: per-batch partial folds merge with psum over "rows";
+emits all_gather over "keys" only at window triggers.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def make_mesh(
+    rows: int = 1, keys: Optional[int] = None, devices: Optional[Sequence] = None,
+):
+    """Build a Mesh with axes ("rows", "keys"). Defaults to putting all
+    devices on the keys axis (state capacity is usually the scale limit)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if keys is None:
+        keys = n // rows
+    if rows * keys != n:
+        raise ValueError(f"mesh {rows}x{keys} != {n} devices")
+    arr = np.asarray(devs).reshape(rows, keys)
+    return Mesh(arr, ("rows", "keys"))

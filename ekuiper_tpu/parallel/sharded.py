@@ -1,0 +1,268 @@
+"""Sharded GROUP BY aggregation step — the multi-chip form of ops/groupby.py.
+
+SPMD layout over a Mesh(("rows", "keys")):
+
+- event batch columns + slot ids: sharded over "rows" (data parallel);
+- per-key partial state (capacity axis): sharded over "keys" — each device
+  owns capacity/K contiguous slots;
+- fold (shard_map): every device folds ITS row shard into a local partial
+  for ITS key range (rows whose slot falls outside the local range mask
+  out), then `psum` over "rows" merges the row-shards. No gather of raw
+  events ever happens — only the (capacity/K, n_specs) partials move, and
+  only across the rows axis;
+- finalize: local finalize per key shard, `all_gather` over "keys" at
+  window triggers only.
+
+This mirrors the scaling-book recipe: pick the mesh, shard the state/batch,
+let XLA insert the collectives, keep them on ICI.
+
+The same code drives the 256-rule fan-out config: rules are batched on a
+leading axis and vmapped, so one compiled program serves all homogeneous
+rules per step (reference analogue: subtopo shared-source fan-out,
+internal/topo/subtopo_pool.go:34).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ops.aggspec import KernelPlan
+from ..ops.groupby import _INIT
+
+COMPONENTS = ("n", "s1", "s2", "mn", "mx")
+
+
+class ShardedGroupBy:
+    """Multi-chip group-by aggregation over a ("rows", "keys") mesh.
+
+    State layout: {comp: (capacity, n_specs_for_comp)} with capacity sharded
+    over "keys". Batch layout: cols (N,), slots (N,) sharded over "rows".
+    """
+
+    def __init__(
+        self, plan: KernelPlan, mesh, capacity: int = 16384,
+        micro_batch: int = 4096,
+    ) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.plan = plan
+        self.mesh = mesh
+        self.capacity = capacity
+        self.micro_batch = micro_batch
+        self.n_keys_shards = mesh.shape["keys"]
+        self.n_row_shards = mesh.shape["rows"]
+        if capacity % self.n_keys_shards != 0:
+            raise ValueError("capacity must divide evenly across the keys axis")
+        self.comp_specs: Dict[str, List[int]] = {}
+        for i, spec in enumerate(plan.specs):
+            for comp in spec.components:
+                self.comp_specs.setdefault(comp, []).append(i)
+
+        self.state_sharding = {
+            comp: NamedSharding(mesh, P("keys", None)) for comp in self.comp_specs
+        }
+        self.state_sharding["act"] = NamedSharding(mesh, P("keys"))
+        self.batch_sharding = NamedSharding(mesh, P("rows"))
+
+        self._fold = self._build_fold()
+        self._finalize = self._build_finalize()
+
+    # ------------------------------------------------------------------ state
+    def init_state(self):
+        import jax
+        import jax.numpy as jnp
+
+        def mk(comp):
+            shape = (
+                (self.capacity,) if comp == "act"
+                else (self.capacity, len(self.comp_specs[comp]))
+            )
+            return jax.device_put(
+                jnp.full(shape, _INIT[comp], dtype=jnp.float32),
+                self.state_sharding[comp],
+            )
+
+        state = {comp: mk(comp) for comp in self.comp_specs}
+        state["act"] = mk("act")
+        return state
+
+    # ------------------------------------------------------------------- fold
+    def _build_fold(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map
+
+        comp_specs = self.comp_specs
+        plan = self.plan
+        cap_per_shard = self.capacity // self.n_keys_shards
+
+        def local_fold(state, cols, slots, row_valid):
+            """Runs per device: fold my row shard into my key range, then
+            psum partials across the rows axis."""
+            kidx = jax.lax.axis_index("keys")
+            offset = kidx * cap_per_shard
+            local = slots - offset
+            in_range = jnp.logical_and(local >= 0, local < cap_per_shard)
+            base = jnp.logical_and(row_valid, in_range)
+            if plan.filter is not None:
+                base = jnp.logical_and(base, plan.filter(cols))
+            local = jnp.clip(local, 0, cap_per_shard - 1)
+
+            per_spec = []
+            for spec in plan.specs:
+                if spec.arg is None:
+                    v = jnp.ones_like(base, dtype=jnp.float32)
+                    m = base
+                else:
+                    v = spec.arg(cols).astype(jnp.float32)
+                    m = jnp.logical_and(base, jnp.logical_not(jnp.isnan(v)))
+                if spec.filter is not None:
+                    m = jnp.logical_and(m, spec.filter(cols))
+                per_spec.append((v, m))
+
+            out = {}
+            act_add = jnp.zeros((cap_per_shard,), jnp.float32).at[local].add(
+                base.astype(jnp.float32)
+            )
+            out["act"] = state["act"] + jax.lax.psum(act_add, "rows")
+            for comp, spec_idxs in comp_specs.items():
+                arr = state[comp]
+                adds = []
+                for k, si in enumerate(spec_idxs):
+                    v, m = per_spec[si]
+                    mf = m.astype(jnp.float32)
+                    if comp == "n":
+                        col = jnp.zeros((cap_per_shard,), jnp.float32).at[local].add(mf)
+                        col = jax.lax.psum(col, "rows")
+                        adds.append(arr[:, k] + col)
+                    elif comp == "s1":
+                        col = jnp.zeros((cap_per_shard,), jnp.float32).at[local].add(
+                            jnp.where(m, v, 0.0)
+                        )
+                        adds.append(arr[:, k] + jax.lax.psum(col, "rows"))
+                    elif comp == "s2":
+                        col = jnp.zeros((cap_per_shard,), jnp.float32).at[local].add(
+                            jnp.where(m, v * v, 0.0)
+                        )
+                        adds.append(arr[:, k] + jax.lax.psum(col, "rows"))
+                    elif comp == "mn":
+                        col = jnp.full((cap_per_shard,), jnp.inf, jnp.float32).at[
+                            local
+                        ].min(jnp.where(m, v, jnp.inf))
+                        col = jax.lax.pmin(col, "rows")
+                        adds.append(jnp.minimum(arr[:, k], col))
+                    elif comp == "mx":
+                        col = jnp.full((cap_per_shard,), -jnp.inf, jnp.float32).at[
+                            local
+                        ].max(jnp.where(m, v, -jnp.inf))
+                        col = jax.lax.pmax(col, "rows")
+                        adds.append(jnp.maximum(arr[:, k], col))
+                out[comp] = jnp.stack(adds, axis=1)
+            return out
+
+        state_specs = {comp: P("keys", None) for comp in comp_specs}
+        state_specs["act"] = P("keys")
+
+        def step(state, cols, slots, row_valid):
+            return shard_map(
+                local_fold,
+                mesh=self.mesh,
+                in_specs=(
+                    state_specs,
+                    {name: P("rows") for name in cols},
+                    P("rows"),
+                    P("rows"),
+                ),
+                out_specs=state_specs,
+            )(state, cols, slots, row_valid)
+
+        import jax
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def fold(self, state, cols: Dict[str, np.ndarray], slots: np.ndarray):
+        """Host entry: pad to micro_batch (divisible by row shards), upload
+        with shardings, run the SPMD step."""
+        import jax
+        import jax.numpy as jnp
+
+        n = len(slots)
+        mb = self.micro_batch
+        for start in range(0, max(n, 1), mb):
+            end = min(start + mb, n)
+            cnt = end - start
+            if cnt <= 0:
+                break
+            pad = mb - cnt
+            dev_cols = {}
+            for name in self.plan.columns:
+                arr = np.asarray(cols[name][start:end], dtype=np.float32)
+                if pad:
+                    arr = np.pad(arr, (0, pad))
+                dev_cols[name] = jax.device_put(arr, self.batch_sharding)
+            s = slots[start:end].astype(np.int32)
+            if pad:
+                s = np.pad(s, (0, pad))
+            rv = np.zeros(mb, dtype=np.bool_)
+            rv[:cnt] = True
+            state = self._fold(
+                state,
+                dev_cols,
+                jax.device_put(s, self.batch_sharding),
+                jax.device_put(rv, self.batch_sharding),
+            )
+        return state
+
+    # --------------------------------------------------------------- finalize
+    def _build_finalize(self):
+        import jax
+        import jax.numpy as jnp
+
+        comp_specs = self.comp_specs
+        plan = self.plan
+
+        def fin(state):
+            from ..ops.groupby import DeviceGroupBy
+
+            outs = []
+            for i, spec in enumerate(plan.specs):
+                c = {
+                    comp: state[comp][:, comp_specs[comp].index(i)]
+                    for comp in spec.components
+                }
+                outs.append(DeviceGroupBy._final_value(spec, c))
+            outs.append(state["act"])
+            # stacked single output; XLA all_gathers the sharded capacity axis
+            return jnp.stack(outs, axis=0)
+
+        return jax.jit(fin)
+
+    def finalize(self, state, n_keys: int) -> Tuple[List[np.ndarray], np.ndarray]:
+        stacked = np.asarray(self._finalize(state))
+        outs = [stacked[i][:n_keys] for i in range(len(self.plan.specs))]
+        act = stacked[-1][:n_keys]
+        return outs, act
+
+    def reset(self, state):
+        """Zero the window partials in place (jitted, donated) — no host
+        round trip or re-allocation on the per-trigger hot path."""
+        import jax
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_reset"):
+            def do_reset(st):
+                return {
+                    comp: jnp.full_like(arr, _INIT[comp])
+                    for comp, arr in st.items()
+                }
+
+            self._reset = jax.jit(do_reset, donate_argnums=(0,))
+        return self._reset(state)

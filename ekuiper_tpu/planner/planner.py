@@ -312,14 +312,22 @@ def _build_device_chain(
     topo: Topo, stmt, kernel_plan, src: SourceNode, opts: RuleOptionConfig,
     rule_id: str,
 ):
+    from ..ops.emit import build_direct_emit
+
     dims = [d.expr for d in stmt.dimensions]
+    # full fusion: compile HAVING/ORDER/LIMIT/projection into the vectorized
+    # emit tail when possible — the whole rule becomes fold + direct emit
+    direct = build_direct_emit(stmt, kernel_plan, [d.name for d in dims])
     fused = FusedWindowAggNode(
         "window_agg", stmt.window, kernel_plan, dims,
         capacity=opts.key_slots, micro_batch=opts.micro_batch_rows,
         rule_id=rule_id, buffer_length=opts.buffer_length,
+        direct_emit=direct,
     )
     topo.add_op(fused)
     src.connect(fused)
+    if direct is not None:
+        return fused  # tail ops folded into the vectorized emit
     tail = fused
     if stmt.having is not None:
         hv = HavingNode("having", stmt.having, rule_id=rule_id,

@@ -38,12 +38,14 @@ class FusedWindowAggNode(Node):
         capacity: int = 16384,
         micro_batch: int = 4096,
         rule_id: str = "",
+        direct_emit=None,  # ops.emit.DirectEmitPlan — vectorized tail
         **kw,
     ) -> None:
         super().__init__(name, op_type="op", **kw)
         self.window = window
         self.plan = plan
         self.dims = dims
+        self.direct_emit = direct_emit
         self.wt = window.window_type
         self.length_ms = window.length_ms()
         self.interval_ms = window.interval_ms()
@@ -216,32 +218,66 @@ class FusedWindowAggNode(Node):
         active = np.nonzero(act > 0)[0]
         if len(active) == 0:
             return
+        if self.direct_emit is not None:
+            self._emit_direct(outs, active, wr)
+            return
+        # bulk-convert once (C speed) instead of per-slot numpy scalar access —
+        # emit latency is dominated by this host loop at 10k+ groups
+        active_list = active.tolist()
+        out_lists = []
+        for col in outs:
+            sel = col[active]
+            if np.issubdtype(sel.dtype, np.floating):
+                sel = np.where(np.isnan(sel), None, sel.astype(object))
+            out_lists.append(sel.tolist())
         groups: List[GroupedTuples] = []
         dim_names = [d.name for d in self.dims]
-        for slot in active:
-            key = self.kt.decode(int(slot))
-            msg: Dict[str, Any] = {}
-            if dim_names:
-                if len(dim_names) == 1:
-                    msg[dim_names[0]] = key
-                else:
-                    for dn, kv in zip(dim_names, key):
-                        msg[dn] = kv
-            agg_values: Dict[str, Any] = {}
-            for spec_key, col in zip(self._spec_keys, outs):
-                v = col[slot]
-                if isinstance(v, np.floating) and np.isnan(v):
-                    agg_values[spec_key] = None
-                else:
-                    agg_values[spec_key] = v.item() if isinstance(v, np.generic) else v
-            rep = Tuple(emitter="", message=msg, timestamp=wr.window_end)
+        single_dim = dim_names[0] if len(dim_names) == 1 else None
+        spec_keys = self._spec_keys
+        decode = self.kt.decode
+        ts = wr.window_end
+        for j, slot in enumerate(active_list):
+            key = decode(slot)
+            if single_dim is not None:
+                msg = {single_dim: key}
+            elif dim_names:
+                msg = dict(zip(dim_names, key))
+            else:
+                msg = {}
+            agg_values = {
+                spec_keys[i]: out_lists[i][j] for i in range(len(spec_keys))
+            }
             groups.append(
                 GroupedTuples(
-                    content=[rep], group_key=str(key), window_range=wr,
-                    agg_values=agg_values,
+                    content=[Tuple(emitter="", message=msg, timestamp=ts)],
+                    group_key=str(key), window_range=wr, agg_values=agg_values,
                 )
             )
         self.emit(GroupedTuplesSet(groups=groups, window_range=wr))
+
+    def _emit_direct(self, outs, active: np.ndarray, wr: WindowRange) -> None:
+        """Vectorized tail: HAVING/ORDER/LIMIT/projection computed over the
+        finalize arrays; emits the final output messages directly."""
+        dim_names = [d.name for d in self.dims]
+        dim_cols: Dict[str, np.ndarray] = {}
+        if dim_names:
+            keys = self.kt.decode_all()
+            if len(dim_names) == 1:
+                col = np.empty(len(active), dtype=np.object_)
+                col[:] = [keys[s] for s in active.tolist()]
+                dim_cols[dim_names[0]] = col
+            else:
+                sel = [keys[s] for s in active.tolist()]
+                for i, dn in enumerate(dim_names):
+                    col = np.empty(len(active), dtype=np.object_)
+                    col[:] = [k[i] for k in sel]
+                    dim_cols[dn] = col
+        agg_cols = [col[active] for col in outs]
+        msgs = self.direct_emit.run(
+            dim_cols, agg_cols, wr.window_start, wr.window_end
+        )
+        if msgs:
+            self.emit(msgs if len(msgs) > 1 else msgs[0], count=len(msgs))
 
     # ------------------------------------------------------------------ state
     def snapshot_state(self) -> Optional[dict]:
