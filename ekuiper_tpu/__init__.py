@@ -1,0 +1,11 @@
+"""ekuiper_tpu — a TPU-native streaming-SQL rule engine.
+
+A from-scratch reimplementation of the capabilities of LF Edge eKuiper
+(reference mounted at /root/reference) designed TPU-first: rules whose
+window->GROUP BY->aggregate pipelines compile to fused XLA kernels over
+columnar micro-batches, with key-axis sharding over a jax device mesh for
+scale-out, and a lightweight Python rule runtime (planner, rule FSM, REST
+API, connectors) around the device data plane.
+"""
+
+__version__ = "0.1.0"

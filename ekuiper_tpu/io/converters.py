@@ -1,0 +1,131 @@
+"""Message converters (codecs) — analogue of eKuiper's internal/converter:
+json, binary, delimited, urlencoded built-in; custom/protobuf via the schema
+registry (converter.go:34-43). Symmetric encode/decode used by source decode
+and sink encode stages.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import urllib.parse
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from ..utils.infra import EngineError
+
+
+class Converter:
+    """message.Converter analogue (pkg/message/artifacts.go:37)."""
+
+    def decode(self, payload: bytes) -> Union[Dict[str, Any], List[Dict[str, Any]]]:
+        raise NotImplementedError
+
+    def encode(self, message: Any) -> bytes:
+        raise NotImplementedError
+
+
+class JsonConverter(Converter):
+    def decode(self, payload: bytes):
+        out = json.loads(payload)
+        if not isinstance(out, (dict, list)):
+            raise EngineError(f"json payload must be object or array, got {type(out).__name__}")
+        return out
+
+    def encode(self, message: Any) -> bytes:
+        return json.dumps(message, default=str).encode()
+
+
+class BinaryConverter(Converter):
+    """Raw bytes in a single `self` field (reference binary format)."""
+
+    def decode(self, payload: bytes):
+        return {"self": payload}
+
+    def encode(self, message: Any) -> bytes:
+        if isinstance(message, dict) and "self" in message:
+            v = message["self"]
+            return v if isinstance(v, bytes) else str(v).encode()
+        if isinstance(message, bytes):
+            return message
+        raise EngineError("binary encode requires a 'self' field")
+
+
+class DelimitedConverter(Converter):
+    """CSV-style with configurable delimiter; needs field names from schema
+    or a header line."""
+
+    def __init__(self, delimiter: str = ",", fields: Optional[List[str]] = None) -> None:
+        self.delimiter = delimiter or ","
+        self.fields = fields
+
+    def decode(self, payload: bytes):
+        text = payload.decode().strip("\r\n")
+        parts = text.split(self.delimiter)
+        names = self.fields or [f"col{i}" for i in range(len(parts))]
+        out: Dict[str, Any] = {}
+        for name, raw in zip(names, parts):
+            out[name] = _sniff(raw)
+        return out
+
+    def encode(self, message: Any) -> bytes:
+        if isinstance(message, dict):
+            names = self.fields or list(message.keys())
+            return self.delimiter.join(
+                "" if message.get(n) is None else str(message.get(n)) for n in names
+            ).encode()
+        if isinstance(message, list):
+            return b"\n".join(self.encode(m) for m in message)
+        raise EngineError("delimited encode requires dict or list")
+
+
+class UrlEncodedConverter(Converter):
+    def decode(self, payload: bytes):
+        parsed = urllib.parse.parse_qs(payload.decode(), keep_blank_values=True)
+        return {k: _sniff(v[0]) if len(v) == 1 else v for k, v in parsed.items()}
+
+    def encode(self, message: Any) -> bytes:
+        if not isinstance(message, dict):
+            raise EngineError("urlencoded encode requires dict")
+        return urllib.parse.urlencode(message).encode()
+
+
+def _sniff(raw: str) -> Any:
+    """Best-effort typed parse for text formats."""
+    if raw == "":
+        return ""
+    low = raw.lower()
+    if low == "true":
+        return True
+    if low == "false":
+        return False
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        pass
+    return raw
+
+
+_registry: Dict[str, Callable[..., Converter]] = {
+    "json": lambda **kw: JsonConverter(),
+    "binary": lambda **kw: BinaryConverter(),
+    "delimited": lambda **kw: DelimitedConverter(
+        delimiter=kw.get("delimiter", ","), fields=kw.get("fields")
+    ),
+    "urlencoded": lambda **kw: UrlEncodedConverter(),
+}
+
+
+def register_converter(name: str, factory: Callable[..., Converter]) -> None:
+    """modules.RegisterConverter analogue — protobuf/custom converters from
+    the schema registry plug in here."""
+    _registry[name.lower()] = factory
+
+
+def get_converter(fmt: str, **kwargs) -> Converter:
+    factory = _registry.get((fmt or "json").lower())
+    if factory is None:
+        raise EngineError(f"unknown format {fmt!r}")
+    return factory(**kwargs)

@@ -1,0 +1,159 @@
+"""File source & sink — analogue of eKuiper's internal/io/file: streaming
+reader for json/lines/csv files (optionally watching a directory), and a
+rolling writer sink.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..utils import timex
+from ..utils.infra import EngineError, logger
+from .contract import Sink, Source
+from .converters import get_converter
+
+
+class FileSource(Source):
+    """Reads a file (or every file in a directory) and streams rows.
+
+    props: fileType=json|lines|csv, path, interval (re-read period, 0=once),
+    delimiter, sendInterval.
+    """
+
+    def __init__(self) -> None:
+        self.path = ""
+        self.file_type = "json"
+        self.interval_ms = 0
+        self.delimiter = ","
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.path = props.get("path", datasource)
+        self.file_type = props.get("fileType", "json").lower()
+        self.interval_ms = int(props.get("interval", 0))
+        self.delimiter = props.get("delimiter", ",")
+
+    def open(self, ingest) -> None:
+        self._stop.clear()
+
+        def run() -> None:
+            while not self._stop.is_set():
+                try:
+                    for payload in self._read_all():
+                        if self._stop.is_set():
+                            return
+                        ingest(payload, {"file": self.path})
+                except Exception as exc:
+                    logger.error("file source %s: %s", self.path, exc)
+                if self.interval_ms <= 0:
+                    return
+                timex.sleep(self.interval_ms)
+
+        self._thread = threading.Thread(target=run, daemon=True, name="file-source")
+        self._thread.start()
+
+    def _files(self) -> List[str]:
+        if os.path.isdir(self.path):
+            return sorted(
+                os.path.join(self.path, f) for f in os.listdir(self.path)
+                if not f.startswith(".")
+            )
+        return [self.path]
+
+    def _read_all(self):
+        for fpath in self._files():
+            if self.file_type == "json":
+                with open(fpath, "rb") as f:
+                    data = json.load(f)
+                if isinstance(data, list):
+                    yield data
+                else:
+                    yield data
+            elif self.file_type == "lines":
+                with open(fpath) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            yield json.loads(line)
+            elif self.file_type == "csv":
+                conv = get_converter("delimited", delimiter=self.delimiter)
+                with open(fpath) as f:
+                    header = f.readline().strip().split(self.delimiter)
+                    conv.fields = header
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            yield conv.decode(line.encode())
+            else:
+                raise EngineError(f"unknown fileType {self.file_type}")
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+class FileSink(Sink):
+    """Appends results to a file; rolling by size or interval
+    (reference: rolling writer)."""
+
+    def __init__(self) -> None:
+        self.path = ""
+        self.file_type = "lines"
+        self.roll_size = 0  # bytes; 0 = no rolling
+        self.roll_interval_ms = 0
+        self._fh = None
+        self._written = 0
+        self._opened_at = 0
+        self._lock = threading.Lock()
+        self._roll_index = 0
+
+    def configure(self, props: Dict[str, Any]) -> None:
+        self.path = props.get("path", "sink_out.log")
+        self.file_type = props.get("fileType", "lines").lower()
+        self.roll_size = int(props.get("rollingSize", 0))
+        self.roll_interval_ms = int(props.get("rollingInterval", 0))
+
+    def connect(self) -> None:
+        self._open_file()
+
+    def _open_file(self) -> None:
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._fh = open(self.path, "a", buffering=1)
+        self._written = 0
+        self._opened_at = timex.now_ms()
+
+    def _maybe_roll(self) -> None:
+        roll = False
+        if self.roll_size and self._written >= self.roll_size:
+            roll = True
+        if (
+            self.roll_interval_ms
+            and timex.now_ms() - self._opened_at >= self.roll_interval_ms
+            and self._written > 0
+        ):
+            roll = True
+        if roll:
+            self._fh.close()
+            self._roll_index += 1
+            rolled = f"{self.path}.{self._roll_index}"
+            os.replace(self.path, rolled)
+            self._open_file()
+
+    def collect(self, item: Any) -> None:
+        line = json.dumps(item, default=str)
+        with self._lock:
+            if self._fh is None:
+                self._open_file()
+            self._fh.write(line + "\n")
+            self._written += len(line) + 1
+            self._maybe_roll()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

@@ -1,0 +1,225 @@
+"""HTTP connectors — analogues of eKuiper's httppull/httppush sources and
+rest sink (internal/io/http). httppush endpoints are hosted by one shared
+HTTP data server (internal/io/http/httpserver/data_server.go:36-103).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils import timex
+from ..utils.infra import EngineError, logger
+from .contract import Sink, Source
+
+
+class HttpPullSource(Source):
+    """Polls a URL at an interval (reference httppull)."""
+
+    def __init__(self) -> None:
+        self.url = ""
+        self.method = "GET"
+        self.interval_ms = 10_000
+        self.headers: Dict[str, str] = {}
+        self.body = ""
+        self.incremental = False
+        self._last: Any = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.url = props.get("url", datasource)
+        self.method = props.get("method", "GET").upper()
+        self.interval_ms = int(props.get("interval", 10_000))
+        self.headers = props.get("headers", {})
+        self.body = props.get("body", "")
+        self.incremental = bool(props.get("incremental", False))
+
+    def open(self, ingest) -> None:
+        self._stop.clear()
+
+        def run() -> None:
+            while not self._stop.is_set():
+                try:
+                    data = self.body.encode() if self.body else None
+                    req = urllib.request.Request(
+                        self.url, data=data, method=self.method,
+                        headers={"Content-Type": "application/json", **self.headers},
+                    )
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        payload = json.loads(resp.read().decode())
+                    if not self.incremental or payload != self._last:
+                        self._last = payload
+                        ingest(payload, {"url": self.url})
+                except Exception as exc:
+                    logger.warning("httppull %s: %s", self.url, exc)
+                timex.sleep(self.interval_ms)
+
+        self._thread = threading.Thread(target=run, daemon=True, name="httppull")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+# ---------------------------------------------------------- shared data server
+class _DataServer:
+    """One process-wide HTTP server hosting all httppush endpoints."""
+
+    def __init__(self) -> None:
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._endpoints: Dict[str, Callable[[Any, Dict[str, Any]], None]] = {}
+        self._lock = threading.Lock()
+        self.port = 0
+
+    def ensure_started(self, host: str, port: int) -> None:
+        with self._lock:
+            if self._server is not None:
+                return
+            endpoints = self._endpoints
+
+            class Handler(BaseHTTPRequestHandler):
+                def log_message(self, fmt, *args):
+                    logger.debug("httppush: " + fmt, *args)
+
+                def do_POST(self):
+                    with _data_server._lock:
+                        handler = endpoints.get(self.path)
+                    if handler is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    length = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(length)
+                    try:
+                        payload = json.loads(raw) if raw else {}
+                    except json.JSONDecodeError:
+                        self.send_response(400)
+                        self.end_headers()
+                        return
+                    handler(payload, {"path": self.path})
+                    self.send_response(200)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+
+                do_PUT = do_POST
+
+            self._server = ThreadingHTTPServer((host, port), Handler)
+            self.port = self._server.server_address[1]
+            threading.Thread(
+                target=self._server.serve_forever, daemon=True,
+                name="httppush-server",
+            ).start()
+
+    def register(self, path: str, handler) -> None:
+        with self._lock:
+            self._endpoints[path] = handler
+
+    def unregister(self, path: str) -> None:
+        with self._lock:
+            self._endpoints.pop(path, None)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._server is not None:
+                self._server.shutdown()
+                self._server = None
+
+
+_data_server = _DataServer()
+
+
+def get_data_server() -> _DataServer:
+    return _data_server
+
+
+class HttpPushSource(Source):
+    """Receives events POSTed to a path on the shared data server."""
+
+    def __init__(self) -> None:
+        self.path = "/"
+        self.host = "127.0.0.1"
+        self.port = 10081
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.path = props.get("endpoint", datasource or "/")
+        if not self.path.startswith("/"):
+            self.path = "/" + self.path
+        self.host = props.get("server_ip", "127.0.0.1")
+        self.port = int(props.get("server_port", 10081))
+
+    def open(self, ingest) -> None:
+        _data_server.ensure_started(self.host, self.port)
+        _data_server.register(self.path, lambda payload, meta: ingest(payload, meta))
+
+    def close(self) -> None:
+        _data_server.unregister(self.path)
+
+
+class HttpLookupSource:
+    """Lookup-table over an HTTP endpoint: GET url with key=value query
+    params per lookup (reference: httppull lookup source)."""
+
+    def __init__(self) -> None:
+        self.url = ""
+        self.headers: Dict[str, str] = {}
+        self.timeout_ms = 5000
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.url = props.get("url", datasource)
+        self.headers = props.get("headers", {})
+        self.timeout_ms = int(props.get("timeout", 5000))
+
+    def open(self) -> None:
+        pass
+
+    def lookup(self, fields: List[str], keys: List[str], values: List[Any]) -> List[Dict[str, Any]]:
+        import urllib.parse
+
+        query = urllib.parse.urlencode(
+            {k: v for k, v in zip(keys, values) if v is not None}
+        )
+        url = self.url + ("&" if "?" in self.url else "?") + query if query else self.url
+        req = urllib.request.Request(url, headers=self.headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_ms / 1000.0) as resp:
+                payload = json.loads(resp.read().decode())
+        except Exception as exc:
+            logger.warning("http lookup %s: %s", url, exc)
+            return []
+        if isinstance(payload, list):
+            return [p for p in payload if isinstance(p, dict)]
+        return [payload] if isinstance(payload, dict) else []
+
+    def close(self) -> None:
+        pass
+
+
+class RestSink(Sink):
+    """POSTs results to a URL (reference rest sink)."""
+
+    def __init__(self) -> None:
+        self.url = ""
+        self.method = "POST"
+        self.headers: Dict[str, str] = {}
+        self.timeout_ms = 5000
+
+    def configure(self, props: Dict[str, Any]) -> None:
+        self.url = props.get("url", "")
+        self.method = props.get("method", "POST").upper()
+        self.headers = props.get("headers", {})
+        self.timeout_ms = int(props.get("timeout", 5000))
+        if not self.url:
+            raise EngineError("rest sink requires url")
+
+    def collect(self, item: Any) -> None:
+        data = json.dumps(item, default=str).encode()
+        req = urllib.request.Request(
+            self.url, data=data, method=self.method,
+            headers={"Content-Type": "application/json", **self.headers},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_ms / 1000.0):
+            pass

@@ -1,0 +1,143 @@
+"""MQTT source & sink — analogue of eKuiper's internal/io/mqtt (paho v4/v5
+clients with a refcounted shared connection, pkg/connection/conn.go:28-137).
+
+Requires paho-mqtt; the registry gates registration on its availability,
+mirroring the reference's build-tag gating of optional connectors.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import paho.mqtt.client as mqtt  # gated import — see io/registry.py
+
+from ..utils.infra import EngineError, logger
+from .contract import Sink, Source
+from .converters import get_converter
+
+# shared refcounted connections keyed by (server, client_id) —
+# pkg/connection pool analogue
+_pool: Dict[Tuple[str, str], Tuple[mqtt.Client, int]] = {}
+_pool_lock = threading.Lock()
+
+
+def _acquire(server: str, client_id: str, username: str = "", password: str = "") -> mqtt.Client:
+    key = (server, client_id)
+    with _pool_lock:
+        entry = _pool.get(key)
+        if entry is not None:
+            client, refs = entry
+            _pool[key] = (client, refs + 1)
+            return client
+        client = mqtt.Client(client_id=client_id or None)
+        if username:
+            client.username_pw_set(username, password)
+        host, _, port = server.replace("tcp://", "").partition(":")
+        client.connect(host, int(port or 1883))
+        client.loop_start()
+        _pool[key] = (client, 1)
+        return client
+
+
+def _release(server: str, client_id: str) -> None:
+    key = (server, client_id)
+    with _pool_lock:
+        entry = _pool.get(key)
+        if entry is None:
+            return
+        client, refs = entry
+        if refs <= 1:
+            client.loop_stop()
+            client.disconnect()
+            del _pool[key]
+        else:
+            _pool[key] = (client, refs - 1)
+
+
+class MqttSource(Source):
+    def __init__(self) -> None:
+        self.topic = ""
+        self.server = "tcp://127.0.0.1:1883"
+        self.qos = 1
+        self.client_id = ""
+        self.username = ""
+        self.password = ""
+        self.format = "json"
+        self._client: Optional[mqtt.Client] = None
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.topic = datasource or props.get("topic", "")
+        self.server = props.get("server", self.server)
+        self.qos = int(props.get("qos", 1))
+        self.client_id = props.get("clientid", "")
+        self.username = props.get("username", "")
+        self.password = props.get("password", "")
+        self.format = props.get("format", "json")
+
+    def open(self, ingest) -> None:
+        conv = get_converter(self.format)
+
+        def on_message(client, userdata, msg) -> None:
+            try:
+                payload = conv.decode(msg.payload)
+            except Exception as exc:
+                logger.warning("mqtt decode error on %s: %s", msg.topic, exc)
+                return
+            ingest(payload, {"topic": msg.topic, "qos": msg.qos,
+                             "messageId": getattr(msg, "mid", 0)})
+
+        self._client = _acquire(self.server, self.client_id, self.username,
+                                self.password)
+        self._client.message_callback_add(self.topic, on_message)
+        self._client.subscribe(self.topic, qos=self.qos)
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.message_callback_remove(self.topic)
+            self._client.unsubscribe(self.topic)
+            _release(self.server, self.client_id)
+            self._client = None
+
+
+class MqttSink(Sink):
+    def __init__(self) -> None:
+        self.topic = ""
+        self.server = "tcp://127.0.0.1:1883"
+        self.qos = 1
+        self.retained = False
+        self.client_id = ""
+        self.username = ""
+        self.password = ""
+        self.format = "json"
+        self._client: Optional[mqtt.Client] = None
+
+    def configure(self, props: Dict[str, Any]) -> None:
+        self.topic = props.get("topic", "")
+        self.server = props.get("server", self.server)
+        self.qos = int(props.get("qos", 1))
+        self.retained = bool(props.get("retained", False))
+        self.client_id = props.get("clientid", "")
+        self.username = props.get("username", "")
+        self.password = props.get("password", "")
+        self.format = props.get("format", "json")
+        if not self.topic:
+            raise EngineError("mqtt sink requires topic")
+
+    def connect(self) -> None:
+        self._client = _acquire(self.server, self.client_id, self.username,
+                                self.password)
+
+    def collect(self, item: Any) -> None:
+        conv = get_converter(self.format)
+        payload = item if isinstance(item, (bytes, str)) else conv.encode(item)
+        info = self._client.publish(
+            self.topic, payload, qos=self.qos, retain=self.retained
+        )
+        if info.rc != mqtt.MQTT_ERR_SUCCESS:
+            raise EngineError(f"mqtt publish failed rc={info.rc}")
+
+    def close(self) -> None:
+        if self._client is not None:
+            _release(self.server, self.client_id)
+            self._client = None

@@ -76,11 +76,25 @@ def _ensure() -> None:
     register_sink("log", LogSink)
     register_sink("nop", NopSink)
     register_lookup("memory", MemoryLookupSource)
-    # file/http/mqtt register on import when available (see io/file.py etc.)
-    try:
-        from .file import FileSink, FileSource
 
-        register_source("file", FileSource)
-        register_sink("file", FileSink)
+    from .file import FileSink, FileSource
+    from .http import HttpPullSource, HttpPushSource, RestSink
+
+    register_source("file", FileSource)
+    register_sink("file", FileSink)
+    register_source("httppull", HttpPullSource)
+    register_source("httppush", HttpPushSource)
+    register_sink("rest", RestSink)
+    from .http import HttpLookupSource
+
+    register_lookup("httppull", HttpLookupSource)
+
+    # mqtt needs the paho client — optional, gated like the reference's
+    # build-tag connectors (internal/binder/io/ext_*.go)
+    try:
+        from .mqtt import MqttSink, MqttSource
+
+        register_source("mqtt", MqttSource)
+        register_sink("mqtt", MqttSink)
     except ImportError:
         pass

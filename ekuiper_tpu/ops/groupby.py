@@ -34,6 +34,31 @@ from .aggspec import AggSpec, KernelPlan
 _INIT = {"n": 0.0, "s1": 0.0, "s2": 0.0, "mn": np.inf, "mx": -np.inf, "act": 0.0}
 
 
+def apply_int_semantics(specs, host: List[np.ndarray]) -> List[np.ndarray]:
+    """Reference-exact integer semantics on finalize output: counts are
+    int64; integer-typed inputs get truncating avg / integral sum/min/max.
+    Shared by the single-chip and sharded paths so results are identical
+    regardless of placement."""
+    for i, spec in enumerate(specs):
+        if spec.kind == "count":
+            host[i] = host[i].astype(np.int64)
+        elif spec.int_input and spec.kind in ("sum", "avg", "min", "max"):
+            with np.errstate(invalid="ignore"):
+                trunc = np.trunc(host[i])
+            host[i] = np.where(np.isnan(host[i]), np.nan, trunc)
+    return host
+
+
+def observe_int_inputs(specs, columns: Dict[str, np.ndarray]) -> None:
+    """Record integer-typed agg inputs (drives apply_int_semantics)."""
+    for spec in specs:
+        if spec.arg is not None and len(spec.arg.columns) == 1:
+            (col_name,) = spec.arg.columns
+            col = columns.get(col_name)
+            if col is not None and np.issubdtype(col.dtype, np.integer):
+                spec.int_input = True
+
+
 class DeviceGroupBy:
     """Device-resident group-by aggregation state + jitted fold/finalize."""
 
@@ -273,14 +298,7 @@ class DeviceGroupBy:
         stacked = np.asarray(self._finalize(state, tuple(pane_mask.tolist())))
         host = [stacked[i][:n_keys] for i in range(len(self.plan.specs))]
         act = stacked[-1]
-        # integer-typed inputs keep reference integer semantics (truncating avg)
-        for i, spec in enumerate(self.plan.specs):
-            if spec.kind == "count":
-                host[i] = host[i].astype(np.int64)
-            elif spec.int_input and spec.kind in ("sum", "avg", "min", "max"):
-                with np.errstate(invalid="ignore"):
-                    trunc = np.trunc(host[i])
-                host[i] = np.where(np.isnan(host[i]), np.nan, trunc)
+        host = apply_int_semantics(self.plan.specs, host)
         return host, np.asarray(act[:n_keys])
 
     # ------------------------------------------------------------------ reset
@@ -304,12 +322,7 @@ class DeviceGroupBy:
     # ------------------------------------------------------------- dtype note
     def observe_dtypes(self, columns: Dict[str, np.ndarray]) -> None:
         """Record integer-typed agg inputs for reference-exact finalize."""
-        for spec in self.plan.specs:
-            if spec.arg is not None and len(spec.arg.columns) == 1:
-                (col_name,) = spec.arg.columns
-                col = columns.get(col_name)
-                if col is not None and np.issubdtype(col.dtype, np.integer):
-                    spec.int_input = True
+        observe_int_inputs(self.plan.specs, columns)
 
     # ---------------------------------------------------------- checkpointing
     def state_to_host(self, state: Dict[str, Any]) -> Dict[str, np.ndarray]:

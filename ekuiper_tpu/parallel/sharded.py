@@ -23,7 +23,6 @@ internal/topo/subtopo_pool.go:34).
 """
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -56,6 +55,11 @@ class ShardedGroupBy:
         self.n_row_shards = mesh.shape["rows"]
         if capacity % self.n_keys_shards != 0:
             raise ValueError("capacity must divide evenly across the keys axis")
+        if micro_batch % self.n_row_shards != 0:
+            raise ValueError(
+                f"micro_batch {micro_batch} must divide evenly across the "
+                f"rows axis ({self.n_row_shards} shards)"
+            )
         self.comp_specs: Dict[str, List[int]] = {}
         for i, spec in enumerate(plan.specs):
             for comp in spec.components:
@@ -246,10 +250,18 @@ class ShardedGroupBy:
         return jax.jit(fin)
 
     def finalize(self, state, n_keys: int) -> Tuple[List[np.ndarray], np.ndarray]:
+        from ..ops.groupby import apply_int_semantics
+
         stacked = np.asarray(self._finalize(state))
         outs = [stacked[i][:n_keys] for i in range(len(self.plan.specs))]
         act = stacked[-1][:n_keys]
+        outs = apply_int_semantics(self.plan.specs, outs)
         return outs, act
+
+    def observe_dtypes(self, columns: Dict[str, np.ndarray]) -> None:
+        from ..ops.groupby import observe_int_inputs
+
+        observe_int_inputs(self.plan.specs, columns)
 
     def reset(self, state):
         """Zero the window partials in place (jitted, donated) — no host

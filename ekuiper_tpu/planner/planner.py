@@ -250,9 +250,17 @@ def plan_rule(rule: RuleDef, store) -> Topo:
         connector = io_registry.create_source(stype)
         props = _source_props(stream, store)
         connector.configure(stream.options.datasource, props)
+        from ..io.converters import get_converter
+
+        converter = get_converter(
+            stream.options.format or "json",
+            delimiter=stream.options.delimiter or ",",
+            fields=[f.name for f in stream.fields] or None,
+        )
         src = SourceNode(
             tbl.ref_name if len(stmt.sources) > 1 or stmt.joins else tbl.name,
             connector,
+            converter=converter,
             schema=sschema,
             timestamp_field=stream.options.timestamp if opts.is_event_time else "",
             strict_validation=stream.options.strict_validation,

@@ -35,9 +35,11 @@ class SourceNode(Node):
         linger_ms: int = 10,
         buffer_length: int = 1024,
         emit_batches: bool = True,
+        converter=None,  # io.converters.Converter for bytes payloads
     ) -> None:
         super().__init__(name, op_type="source", buffer_length=buffer_length)
         self.connector = connector
+        self.converter = converter
         self.schema = schema
         self.timestamp_field = timestamp_field
         self.strict = cast.STRICT if strict_validation else cast.CONVERT_ALL
@@ -60,9 +62,18 @@ class SourceNode(Node):
         self._flush()
 
     def ingest(self, payload: Any, metadata: Optional[Dict[str, Any]] = None) -> None:
-        """Connector callback: bytes (decoded via converter upstream of this
-        call), dict, list of dicts, or Tuple."""
+        """Connector callback: raw bytes (decoded here via the stream's
+        FORMAT converter), dict, list of dicts, or Tuple."""
         now = timex.now_ms()
+        if isinstance(payload, (bytes, bytearray)):
+            if self.converter is None:
+                self.stats.inc_exception("bytes payload but no converter")
+                return
+            try:
+                payload = self.converter.decode(bytes(payload))
+            except Exception as exc:
+                self.stats.inc_exception(f"decode error: {exc}")
+                return
         rows: List[Tuple] = []
         if isinstance(payload, Tuple):
             rows = [payload]

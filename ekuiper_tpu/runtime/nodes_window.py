@@ -208,7 +208,10 @@ class WindowNode(Node):
             if wt == ast.WindowType.TUMBLING_WINDOW:
                 rows, self.buffer = self.buffer, []
             else:
-                rows = [r for r in self.buffer if r.timestamp > start]
+                # windows are [start, end); the upper bound matters — a row
+                # landing in the same ms as the tick must count once (in the
+                # next window), not in both
+                rows = [r for r in self.buffer if start <= r.timestamp < end]
                 self._evict_before(end - self.length_ms + (self.interval_ms or 0))
             self._emit_window(rows, WindowRange(start, end))
             self._schedule_next_tick()
@@ -252,9 +255,10 @@ class WindowNode(Node):
             while self._next_emit_end is not None and wm.ts >= self._next_emit_end:
                 end = self._next_emit_end
                 start = end - self.length_ms
-                rows = [r for r in self.buffer if start < r.timestamp <= end]
+                # [start, end): row at exactly `end` opens the next window
+                rows = [r for r in self.buffer if start <= r.timestamp < end]
                 if wt == ast.WindowType.TUMBLING_WINDOW:
-                    self.buffer = [r for r in self.buffer if r.timestamp > end]
+                    self.buffer = [r for r in self.buffer if r.timestamp >= end]
                 else:
                     self._evict_before(end - self.length_ms + interval)
                 self._emit_window(rows, WindowRange(start, end))
@@ -313,9 +317,11 @@ class WindowNode(Node):
         self.emit(WindowTuples(content=list(rows), window_range=wr))
 
     def _evict_before(self, ts: int) -> None:
+        """Drop rows strictly before ts (rows at ts can still belong to a
+        [ts, ...) window)."""
         if ts <= 0:
             return
-        self.buffer = [r for r in self.buffer if r.timestamp > ts]
+        self.buffer = [r for r in self.buffer if r.timestamp >= ts]
 
     # ----------------------------------------------------------------- state
     def snapshot_state(self) -> Optional[dict]:

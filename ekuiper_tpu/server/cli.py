@@ -1,0 +1,137 @@
+"""CLI — analogue of eKuiper's `kuiper` client (cmd/kuiper/main.go:89-660).
+
+Talks to a running server over the REST API (the reference uses JSON-RPC;
+REST carries the same operations here). Commands mirror the reference:
+
+  create stream "CREATE STREAM ..."     show streams     describe stream X
+  drop stream X                         (same for table)
+  create rule <id> '<json>' | -f file   show rules       describe rule X
+  drop rule X    start rule X   stop rule X   restart rule X
+  getstatus rule X    query  (interactive SQL REPL via trial runner)
+
+Run: python -m ekuiper_tpu.server.cli [--host H --port P] <command...>
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+
+class Client:
+    def __init__(self, host: str = "127.0.0.1", port: int = 9081) -> None:
+        self.base = f"http://{host}:{port}"
+
+    def call(self, method: str, path: str, body: Any = None) -> Any:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            payload = exc.read().decode()
+            try:
+                return {"error": json.loads(payload).get("error", payload)}
+            except json.JSONDecodeError:
+                return {"error": payload}
+        except urllib.error.URLError as exc:
+            print(f"cannot connect to server at {self.base}: {exc.reason}",
+                  file=sys.stderr)
+            sys.exit(1)
+
+
+def _print(result: Any) -> None:
+    if isinstance(result, str):
+        print(result)
+    else:
+        print(json.dumps(result, indent=2, default=str))
+
+
+def run_query_repl(client: Client) -> None:
+    """Interactive SQL REPL over the trial runtime (reference `kuiper query`)."""
+    print("Connecting to server... type SQL, or 'exit' to quit.")
+    while True:
+        try:
+            sql = input("kuiper_tpu > ").strip()
+        except (EOFError, KeyboardInterrupt):
+            break
+        if not sql or sql.lower() in ("exit", "quit"):
+            break
+        trial = client.call("POST", "/ruletest", {"sql": sql})
+        if "error" in trial:
+            print("error:", trial["error"])
+            continue
+        tid = trial["id"]
+        client.call("POST", f"/ruletest/{tid}/start")
+        try:
+            print("(collecting for 5s, Ctrl-C to stop early)")
+            time.sleep(5)
+        except KeyboardInterrupt:
+            pass
+        results = client.call("GET", f"/ruletest/{tid}")
+        client.call("DELETE", f"/ruletest/{tid}")
+        for row in results if isinstance(results, list) else [results]:
+            print(json.dumps(row, default=str))
+
+
+def main(argv: Optional[list] = None) -> None:
+    ap = argparse.ArgumentParser(prog="kuiper_tpu", description="ekuiper_tpu CLI")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9081)
+    ap.add_argument("args", nargs="*", help="command, e.g. show streams")
+    ns = ap.parse_args(argv)
+    client = Client(ns.host, ns.port)
+    args = ns.args
+    if not args:
+        ap.print_help()
+        return
+    cmd = args[0].lower()
+
+    if cmd == "query":
+        run_query_repl(client)
+        return
+    if cmd == "show" and len(args) >= 2:
+        target = args[1].lower()
+        _print(client.call("GET", f"/{target if target.endswith('s') else target + 's'}"))
+        return
+    if cmd in ("describe", "desc") and len(args) >= 3:
+        _print(client.call("GET", f"/{args[1].lower()}s/{args[2]}"))
+        return
+    if cmd == "drop" and len(args) >= 3:
+        _print(client.call("DELETE", f"/{args[1].lower()}s/{args[2]}"))
+        return
+    if cmd == "create" and len(args) >= 3:
+        target = args[1].lower()
+        if target in ("stream", "table"):
+            sql = " ".join(args[2:])
+            _print(client.call("POST", f"/{target}s", {"sql": sql}))
+            return
+        if target == "rule":
+            rule_id = args[2]
+            if len(args) >= 4 and args[3] == "-f":
+                with open(args[4]) as f:
+                    body = json.load(f)
+            else:
+                body = json.loads(" ".join(args[3:]))
+            body.setdefault("id", rule_id)
+            _print(client.call("POST", "/rules", body))
+            return
+    if cmd in ("start", "stop", "restart") and len(args) >= 3 and args[1] == "rule":
+        _print(client.call("POST", f"/rules/{args[2]}/{cmd}"))
+        return
+    if cmd == "getstatus" and len(args) >= 3 and args[1] == "rule":
+        _print(client.call("GET", f"/rules/{args[2]}/status"))
+        return
+    print(f"unknown command: {' '.join(args)}", file=sys.stderr)
+    sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

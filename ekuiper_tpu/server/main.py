@@ -1,0 +1,56 @@
+"""Server bootstrap — analogue of eKuiper's StartUp sequence
+(internal/server/server.go:139-330): config → store → keyed state →
+processors → rule recovery → REST server → run until signalled.
+
+Run: python -m ekuiper_tpu.server.main [--config conf.json]
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from ..store import kv
+from ..utils.config import get_config, load_config, set_config
+from ..utils.infra import logger
+from .rest import RestApi, serve
+
+
+def start_up(config_path: str | None = None, block: bool = True):
+    cfg = load_config(config_path)
+    set_config(cfg)
+    logging.basicConfig(
+        level=getattr(logging, cfg.basic.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    store = kv.setup(cfg.store.type, cfg.store.path)
+    api = RestApi(store)
+    api.rules.recover()
+    server = serve(api, cfg.basic.rest_ip, cfg.basic.rest_port)
+
+    stop_event = threading.Event()
+
+    def shutdown(*_args) -> None:
+        logger.info("shutting down")
+        api.rules.stop_all()
+        server.shutdown()
+        stop_event.set()
+
+    if block:
+        signal.signal(signal.SIGINT, shutdown)
+        signal.signal(signal.SIGTERM, shutdown)
+        stop_event.wait()
+        return None
+    return api, server
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="ekuiper_tpu server")
+    ap.add_argument("--config", default=None, help="config json path")
+    args = ap.parse_args()
+    start_up(args.config)
+
+
+if __name__ == "__main__":
+    main()

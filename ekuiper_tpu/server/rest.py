@@ -1,0 +1,202 @@
+"""REST API — analogue of eKuiper's REST server (internal/server/rest.go:177-232).
+
+Routes (matching the reference surface):
+  GET  /                               server info
+  GET  /ping
+  POST /streams            {"sql": "CREATE STREAM ..."}
+  GET  /streams | /tables
+  GET|DELETE /streams/{name}, /tables/{name}
+  GET  /streams/{name}/schema
+  POST /rules              rule def json
+  GET  /rules
+  GET|PUT|DELETE /rules/{id}
+  POST /rules/{id}/start|stop|restart|reset_state
+  GET  /rules/{id}/status|topo|explain
+  POST /rules/validate
+  GET  /ruleset/export    POST /ruleset/import
+  POST /ruletest  GET /ruletest/{id}  DELETE /ruletest/{id}   (trial runs)
+
+Implementation: stdlib ThreadingHTTPServer — no external web framework, same
+zero-dependency stance as the reference's single static binary.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import __version__ as _version
+from ..sql import ast
+from ..sql.parser import parse
+from ..utils.infra import EngineError, ParseError, PlanError, logger
+from .processors import RulesetProcessor, StreamProcessor
+from .rule_manager import RuleRegistry
+from .trial import TrialManager
+
+Route = Tuple[str, re.Pattern, Callable]
+
+
+class RestApi:
+    """Route table + handlers, independent of the HTTP layer (testable)."""
+
+    def __init__(self, store) -> None:
+        self.store = store
+        self.streams = StreamProcessor(store)
+        self.rules = RuleRegistry(store)
+        self.ruleset = RulesetProcessor(store)
+        self.trials = TrialManager(store)
+        self.routes: List[Route] = []
+        r = self._route
+        r("GET", r"^/$", self.info)
+        r("GET", r"^/ping$", lambda m: {"ok": True})
+        r("POST", r"^/streams$", self.create_def)
+        r("POST", r"^/tables$", self.create_def)
+        r("GET", r"^/streams$", lambda m: self.streams.show(False))
+        r("GET", r"^/tables$", lambda m: self.streams.show(True))
+        r("GET", r"^/streams/(?P<name>[^/]+)$",
+          lambda m: self.streams.describe(m["name"], False))
+        r("GET", r"^/tables/(?P<name>[^/]+)$",
+          lambda m: self.streams.describe(m["name"], True))
+        r("GET", r"^/streams/(?P<name>[^/]+)/schema$",
+          lambda m: self.streams.describe(m["name"], False)["fields"])
+        r("DELETE", r"^/streams/(?P<name>[^/]+)$",
+          lambda m: self.streams.drop(m["name"], False))
+        r("DELETE", r"^/tables/(?P<name>[^/]+)$",
+          lambda m: self.streams.drop(m["name"], True))
+        r("POST", r"^/rules$", self.create_rule)
+        r("GET", r"^/rules$", lambda m: self.rules.list())
+        r("POST", r"^/rules/validate$",
+          lambda m, body=None: self.rules.validate(body))
+        r("GET", r"^/rules/(?P<id>[^/]+)$",
+          lambda m: self.rules.processor.get(m["id"]).to_dict())
+        r("PUT", r"^/rules/(?P<id>[^/]+)$", self.update_rule)
+        r("DELETE", r"^/rules/(?P<id>[^/]+)$",
+          lambda m: self.rules.delete(m["id"]) or "Rule %s is dropped." % m["id"])
+        r("POST", r"^/rules/(?P<id>[^/]+)/start$",
+          lambda m: self.rules.start(m["id"]) or "Rule %s was started" % m["id"])
+        r("POST", r"^/rules/(?P<id>[^/]+)/stop$",
+          lambda m: self.rules.stop(m["id"]) or "Rule %s was stopped." % m["id"])
+        r("POST", r"^/rules/(?P<id>[^/]+)/restart$",
+          lambda m: self.rules.restart(m["id"]) or "Rule %s was restarted" % m["id"])
+        r("POST", r"^/rules/(?P<id>[^/]+)/reset_state$",
+          lambda m: self.rules.reset_state(m["id"]) or "Rule %s state was reset" % m["id"])
+        r("GET", r"^/rules/(?P<id>[^/]+)/status$",
+          lambda m: self.rules.status(m["id"]))
+        r("GET", r"^/rules/(?P<id>[^/]+)/topo$",
+          lambda m: self.rules.topo_json(m["id"]))
+        r("GET", r"^/rules/(?P<id>[^/]+)/explain$",
+          lambda m: self.rules.explain(m["id"]))
+        r("GET", r"^/ruleset/export$", lambda m: self.ruleset.export())
+        r("POST", r"^/ruleset/import$",
+          lambda m, body=None: self.ruleset.import_ruleset(body))
+        r("POST", r"^/ruletest$", lambda m, body=None: self.trials.create(body))
+        r("POST", r"^/ruletest/(?P<id>[^/]+)/start$",
+          lambda m: self.trials.start(m["id"]))
+        r("GET", r"^/ruletest/(?P<id>[^/]+)$", lambda m: self.trials.results(m["id"]))
+        r("DELETE", r"^/ruletest/(?P<id>[^/]+)$", lambda m: self.trials.stop(m["id"]))
+
+    def _route(self, method: str, pattern: str, fn: Callable) -> None:
+        self.routes.append((method, re.compile(pattern), fn))
+
+    # ---------------------------------------------------------------- handlers
+    def info(self, m) -> Dict[str, Any]:
+        import jax
+
+        return {
+            "version": _version,
+            "engine": "ekuiper_tpu",
+            "backend": str(jax.devices()[0]) if jax.devices() else "none",
+        }
+
+    def create_def(self, m, body: Optional[dict] = None) -> str:
+        if not body or "sql" not in body:
+            raise ParseError("body must contain a sql field")
+        return self.streams.exec_stmt(body["sql"])
+
+    def create_rule(self, m, body: Optional[dict] = None) -> str:
+        if not body:
+            raise ParseError("rule json body required")
+        rule_id = self.rules.create(body)
+        return f"Rule {rule_id} was created successfully."
+
+    def update_rule(self, m, body: Optional[dict] = None) -> str:
+        if not body:
+            raise ParseError("rule json body required")
+        body.setdefault("id", m["id"])
+        self.rules.update(body)
+        return f"Rule {m['id']} was updated successfully."
+
+    # --------------------------------------------------------------- dispatch
+    def dispatch(self, method: str, path: str, body: Optional[dict]) -> Tuple[int, Any]:
+        for rmethod, pattern, fn in self.routes:
+            if rmethod != method:
+                continue
+            match = pattern.match(path)
+            if match is None:
+                continue
+            kwargs = {}
+            import inspect
+
+            if "body" in inspect.signature(fn).parameters:
+                kwargs["body"] = body
+            try:
+                result = fn(match.groupdict(), **kwargs)
+                code = 201 if method == "POST" and path in ("/streams", "/tables", "/rules") else 200
+                return code, result
+            except (ParseError, PlanError) as exc:
+                return 400, {"error": str(exc)}
+            except EngineError as exc:
+                return 400, {"error": str(exc)}
+            except Exception as exc:  # noqa: BLE001
+                logger.exception("handler error %s %s", method, path)
+                return 500, {"error": str(exc)}
+        return 404, {"error": f"no route {method} {path}"}
+
+
+def serve(api: RestApi, host: str = "127.0.0.1", port: int = 9081):
+    """Start the HTTP server (returns the server; call .shutdown() to stop)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route to engine logger
+            logger.debug("rest: " + fmt, *args)
+
+        def _handle(self, method: str) -> None:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = None
+            if length:
+                try:
+                    body = json.loads(self.rfile.read(length))
+                except json.JSONDecodeError:
+                    self._reply(400, {"error": "invalid json body"})
+                    return
+            code, result = api.dispatch(method, self.path.rstrip("/") or "/", body)
+            self._reply(code, result)
+
+        def _reply(self, code: int, result: Any) -> None:
+            data = json.dumps(result, default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            self._handle("GET")
+
+        def do_POST(self):
+            self._handle("POST")
+
+        def do_PUT(self):
+            self._handle("PUT")
+
+        def do_DELETE(self):
+            self._handle("DELETE")
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="rest-server")
+    thread.start()
+    logger.info("REST server listening on %s:%d", host, port)
+    return server

@@ -1,0 +1,150 @@
+"""Rule registry — analogue of eKuiper's RuleRegistry
+(internal/server/rule_manager.go:112-238): owns the live RuleState machines,
+coordinates create/start/stop/restart/delete, recovers rules at boot.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..planner.planner import RuleDef, explain as plan_explain, plan_rule
+from ..runtime.rule import RuleState, RunState
+from ..utils.infra import PlanError, logger
+from .processors import RuleProcessor
+
+
+class RuleRegistry:
+    def __init__(self, store) -> None:
+        self.store = store
+        self.processor = RuleProcessor(store)
+        self._rules: Dict[str, RuleState] = {}
+        self._lock = threading.RLock()
+
+    # ---------------------------------------------------------------- recovery
+    def recover(self) -> None:
+        """Start rules marked running at last shutdown (boot recovery,
+        reference: server.go rule restore)."""
+        run_table = self.store.kv("rule_run_state")
+        for rule_id in self.processor.list():
+            try:
+                rule = self.processor.get(rule_id)
+                rs = RuleState(rule, self.store)
+                with self._lock:
+                    self._rules[rule_id] = rs
+                started, _ = run_table.get_ok(rule_id)
+                auto_start = rule.options.get("triggered", True)
+                if started if started is not None else auto_start:
+                    rs.start()
+            except Exception as exc:
+                logger.error("recover rule %s failed: %s", rule_id, exc)
+
+    # -------------------------------------------------------------------- CRUD
+    def create(self, rule_json: Dict[str, Any]) -> str:
+        rule = self.processor.create(rule_json)
+        # validate by planning once (reference: NewState -> Validate -> Plan)
+        try:
+            plan_rule(rule, self.store).close()
+        except Exception:
+            self.processor.drop(rule.id)
+            raise
+        rs = RuleState(rule, self.store)
+        with self._lock:
+            self._rules[rule.id] = rs
+        if rule.options.get("triggered", True):
+            rs.start()
+            self.store.kv("rule_run_state").set(rule.id, True)
+        return rule.id
+
+    def update(self, rule_json: Dict[str, Any]) -> None:
+        rule = self.processor.update(rule_json)
+        with self._lock:
+            rs = self._rules.get(rule.id)
+        if rs is not None:
+            was_running = rs.state == RunState.RUNNING
+            rs.stop()
+            new_rs = RuleState(rule, self.store)
+            with self._lock:
+                self._rules[rule.id] = new_rs
+            if was_running:
+                new_rs.start()
+        else:
+            with self._lock:
+                self._rules[rule.id] = RuleState(rule, self.store)
+
+    def delete(self, rule_id: str) -> None:
+        with self._lock:
+            rs = self._rules.pop(rule_id, None)
+        if rs is not None:
+            rs.stop()
+        self.processor.drop(rule_id)
+        self.store.kv("rule_run_state").delete(rule_id)
+
+    # --------------------------------------------------------------- lifecycle
+    def _get(self, rule_id: str) -> RuleState:
+        with self._lock:
+            rs = self._rules.get(rule_id)
+        if rs is None:
+            # definition may exist without a live state (post-restart)
+            rule = self.processor.get(rule_id)
+            rs = RuleState(rule, self.store)
+            with self._lock:
+                self._rules[rule_id] = rs
+        return rs
+
+    def start(self, rule_id: str) -> None:
+        self._get(rule_id).start()
+        self.store.kv("rule_run_state").set(rule_id, True)
+
+    def stop(self, rule_id: str) -> None:
+        self._get(rule_id).stop()
+        self.store.kv("rule_run_state").set(rule_id, False)
+
+    def restart(self, rule_id: str) -> None:
+        self._get(rule_id).restart()
+        self.store.kv("rule_run_state").set(rule_id, True)
+
+    # ------------------------------------------------------------------ query
+    def list(self) -> List[Dict[str, Any]]:
+        out = []
+        for rule_id in self.processor.list():
+            with self._lock:
+                rs = self._rules.get(rule_id)
+            status = rs.state.value if rs is not None else "stopped"
+            out.append({"id": rule_id, "status": status})
+        return out
+
+    def status(self, rule_id: str) -> Dict[str, Any]:
+        return self._get(rule_id).status()
+
+    def explain(self, rule_id: str) -> Dict[str, Any]:
+        rule = self.processor.get(rule_id)
+        return plan_explain(rule, self.store)
+
+    def topo_json(self, rule_id: str) -> Dict[str, Any]:
+        rs = self._get(rule_id)
+        if rs.topo is not None:
+            return rs.topo.topo_json()
+        topo = plan_rule(rs.rule, self.store)
+        out = topo.topo_json()
+        topo.close()
+        return out
+
+    def validate(self, rule_json: Dict[str, Any]) -> Dict[str, Any]:
+        rule = RuleDef.from_dict(rule_json)
+        if not rule.sql:
+            return {"valid": False, "error": "rule sql is required"}
+        try:
+            plan_rule(rule, self.store).close()
+            return {"valid": True}
+        except Exception as exc:
+            return {"valid": False, "error": str(exc)}
+
+    def reset_state(self, rule_id: str) -> None:
+        """Drop checkpointed state (REST /rules/{id}/reset_state)."""
+        self.store.drop(f"checkpoint:{rule_id}")
+
+    def stop_all(self) -> None:
+        with self._lock:
+            rules = list(self._rules.values())
+        for rs in rules:
+            rs.stop()

@@ -1,0 +1,158 @@
+"""Trial (ruletest) runner — analogue of eKuiper's internal/trial
+(manager.go:34-81, run.go): run a rule against mock source data and collect
+the results for inspection without persisting anything.
+
+Divergence from the reference: results are fetched by polling GET
+/ruletest/{id} instead of streaming over a websocket endpoint — same
+capability, pull instead of push.
+"""
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ..planner.planner import RuleDef, plan_rule
+from ..runtime.nodes_sink import SinkNode
+from ..runtime.nodes_source import SourceNode
+from ..sql.parser import parse_select
+from ..utils.infra import PlanError
+from ..utils import timex
+
+
+class _CollectSink:
+    def configure(self, props):
+        pass
+
+    def connect(self):
+        pass
+
+    def collect(self, item):
+        pass
+
+    def close(self):
+        pass
+
+
+class Trial:
+    def __init__(self, trial_id: str, topo, sink: SinkNode) -> None:
+        self.id = trial_id
+        self.topo = topo
+        self.sink = sink
+
+
+class TrialManager:
+    def __init__(self, store) -> None:
+        self.store = store
+        self._trials: Dict[str, Trial] = {}
+        self._lock = threading.Lock()
+
+    def create(self, body: Optional[dict]) -> Dict[str, Any]:
+        """body: {id?, sql, mockSource: {stream: {data: [...], interval, loop}},
+        sinkProps: {...}} (reference: genTrialRule)."""
+        if not body or "sql" not in body:
+            raise PlanError("ruletest body must contain sql")
+        trial_id = str(body.get("id") or uuid.uuid4())
+        stmt = parse_select(body["sql"])
+        mock = body.get("mockSource", {})
+        # override the stream's physical source with a simulator fed by the
+        # mock data; keep decode/schema from the stream definition
+        conf = self.store.kv("source_conf")
+        overridden = []
+        for tbl in stmt.sources:
+            m = mock.get(tbl.name)
+            if m is not None:
+                key = f"simulator:__trial_{trial_id}_{tbl.name}"
+                conf.set(key, {
+                    "data": m.get("data", []),
+                    "interval": int(m.get("interval", 0)),
+                    "loop": bool(m.get("loop", False)),
+                    "batch_size": int(m.get("batch_size", 1)),
+                })
+                overridden.append((tbl.name, key))
+        rule = RuleDef(
+            id=f"__trial_{trial_id}", sql=body["sql"],
+            actions=[{"nop": {}}],
+            options=body.get("options", {}),
+        )
+        store = self.store
+        if overridden:
+            store = _TrialStoreView(self.store, dict(overridden), trial_id)
+        topo = plan_rule(rule, store)
+        sink = topo.sinks[0]
+        trial = Trial(trial_id, topo, sink)
+        with self._lock:
+            self._trials[trial_id] = trial
+        return {"id": trial_id}
+
+    def start(self, trial_id: str) -> str:
+        trial = self._get(trial_id)
+        trial.topo.open()
+        return f"Trial {trial_id} started"
+
+    def results(self, trial_id: str) -> List[Any]:
+        trial = self._get(trial_id)
+        return list(trial.sink.results)
+
+    def stop(self, trial_id: str) -> str:
+        with self._lock:
+            trial = self._trials.pop(trial_id, None)
+        if trial is not None:
+            trial.topo.close()
+        return f"Trial {trial_id} stopped"
+
+    def _get(self, trial_id: str) -> Trial:
+        with self._lock:
+            trial = self._trials.get(trial_id)
+        if trial is None:
+            raise PlanError(f"trial {trial_id} not found")
+        return trial
+
+
+class _TrialStoreView:
+    """Store proxy that rewrites stream defs to the trial's simulator source."""
+
+    def __init__(self, store, overrides: Dict[str, str], trial_id: str) -> None:
+        self._store = store
+        self._overrides = overrides
+        self._trial_id = trial_id
+
+    def kv(self, namespace: str):
+        inner = self._store.kv(namespace)
+        if namespace not in ("stream", "table"):
+            return inner
+        return _StreamKvView(inner, self._overrides, self._trial_id)
+
+    def drop(self, namespace: str) -> None:
+        self._store.drop(namespace)
+
+
+class _StreamKvView:
+    def __init__(self, inner, overrides: Dict[str, str], trial_id: str) -> None:
+        self._inner = inner
+        self._overrides = overrides
+        self._trial_id = trial_id
+
+    def get_ok(self, key: str):
+        raw, ok = self._inner.get_ok(key)
+        if not ok or key not in self._overrides:
+            return raw, ok
+        sql = raw["sql"] if isinstance(raw, dict) else raw
+        from ..sql.parser import parse
+
+        stmt = parse(sql)
+        stmt.options.type = "simulator"
+        conf_key = self._overrides[key].split(":", 1)[1]
+        # rebuild DDL with simulator type/conf_key
+        fields = ", ".join(
+            f"{f.name} {f.type.value.upper()}" for f in stmt.fields
+        )
+        new_sql = (
+            f"CREATE {'TABLE' if stmt.is_table else 'STREAM'} {stmt.name} "
+            f"({fields}) WITH (TYPE=\"simulator\", CONF_KEY=\"{conf_key}\", "
+            f"DATASOURCE=\"trial\")"
+        )
+        return {"sql": new_sql}, True
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

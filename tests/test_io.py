@@ -1,0 +1,195 @@
+"""IO connector & converter tests."""
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from ekuiper_tpu.io import memory as mem
+from ekuiper_tpu.io import registry as io_registry
+from ekuiper_tpu.io.converters import get_converter
+from ekuiper_tpu.io.file import FileSink, FileSource
+from ekuiper_tpu.io.http import HttpPushSource, RestSink, get_data_server
+
+
+class TestConverters:
+    def test_json_roundtrip(self):
+        c = get_converter("json")
+        assert c.decode(b'{"a": 1}') == {"a": 1}
+        assert c.decode(b'[{"a": 1}, {"a": 2}]') == [{"a": 1}, {"a": 2}]
+        assert json.loads(c.encode({"a": 1})) == {"a": 1}
+        with pytest.raises(Exception):
+            c.decode(b'"scalar"')
+
+    def test_binary(self):
+        c = get_converter("binary")
+        assert c.decode(b"\x01\x02") == {"self": b"\x01\x02"}
+        assert c.encode({"self": b"xy"}) == b"xy"
+
+    def test_delimited(self):
+        c = get_converter("delimited", delimiter=",", fields=["a", "b", "c"])
+        assert c.decode(b"1,true,hi") == {"a": 1, "b": True, "c": "hi"}
+        assert c.encode({"a": 1, "b": True, "c": "hi"}) == b"1,True,hi"
+
+    def test_urlencoded(self):
+        c = get_converter("urlencoded")
+        assert c.decode(b"a=1&b=x") == {"a": 1, "b": "x"}
+        assert c.encode({"a": 1}) == b"a=1"
+
+    def test_unknown_format(self):
+        with pytest.raises(Exception):
+            get_converter("bogus")
+
+
+class TestMemoryPubSub:
+    def setup_method(self):
+        mem.reset()
+
+    def teardown_method(self):
+        mem.reset()
+
+    def test_wildcards(self):
+        got = []
+        mem.subscribe("a/+/c", lambda t, p: got.append(("plus", t)))
+        mem.subscribe("a/#", lambda t, p: got.append(("hash", t)))
+        mem.publish("a/b/c", {})
+        mem.publish("a/x", {})
+        mem.publish("z/b/c", {})
+        assert ("plus", "a/b/c") in got
+        assert ("hash", "a/b/c") in got and ("hash", "a/x") in got
+        assert not any(t == "z/b/c" for _, t in got)
+
+    def test_unsubscribe(self):
+        got = []
+        unsub = mem.subscribe("t", lambda t, p: got.append(p))
+        mem.publish("t", 1)
+        unsub()
+        mem.publish("t", 2)
+        assert got == [1]
+
+
+class TestFileIO:
+    def test_json_file_source(self, tmp_path):
+        path = tmp_path / "data.json"
+        path.write_text(json.dumps([{"a": 1}, {"a": 2}]))
+        src = FileSource()
+        src.configure(str(path), {"fileType": "json"})
+        got = []
+        done = threading.Event()
+
+        def ingest(payload, meta=None):
+            got.append(payload)
+            done.set()
+
+        src.open(ingest)
+        assert done.wait(3)
+        src.close()
+        assert got[0] == [{"a": 1}, {"a": 2}]
+
+    def test_csv_file_source(self, tmp_path):
+        path = tmp_path / "d.csv"
+        path.write_text("a,b\n1,x\n2,y\n")
+        src = FileSource()
+        src.configure(str(path), {"fileType": "csv"})
+        got = []
+        src.open(lambda p, meta=None: got.append(p))
+        deadline = time.time() + 3
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        src.close()
+        assert got == [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]
+
+    def test_file_sink_lines(self, tmp_path):
+        path = tmp_path / "out.log"
+        sink = FileSink()
+        sink.configure({"path": str(path)})
+        sink.connect()
+        sink.collect({"x": 1})
+        sink.collect([{"x": 2}])
+        sink.close()
+        lines = path.read_text().strip().split("\n")
+        assert json.loads(lines[0]) == {"x": 1}
+        assert json.loads(lines[1]) == [{"x": 2}]
+
+    def test_file_sink_rolling(self, tmp_path):
+        path = tmp_path / "roll.log"
+        sink = FileSink()
+        sink.configure({"path": str(path), "rollingSize": 10})
+        sink.connect()
+        for i in range(5):
+            sink.collect({"i": i})
+        sink.close()
+        rolled = [f for f in os.listdir(tmp_path) if f.startswith("roll.log.")]
+        assert rolled  # at least one roll happened
+
+
+class TestHttpIO:
+    def test_httppush_roundtrip(self):
+        src = HttpPushSource()
+        src.configure("/push_test", {"server_port": 0})
+        got = []
+        src.open(lambda p, meta=None: got.append(p))
+        port = get_data_server().port
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/push_test",
+                data=json.dumps({"v": 7}).encode(), method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                assert resp.status == 200
+            deadline = time.time() + 3
+            while not got and time.time() < deadline:
+                time.sleep(0.01)
+            assert got == [{"v": 7}]
+            # unknown path -> 404
+            req2 = urllib.request.Request(
+                f"http://127.0.0.1:{port}/nope", data=b"{}", method="POST"
+            )
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(req2, timeout=5)
+        finally:
+            src.close()
+
+    def test_rest_sink(self):
+        received = []
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                received.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            sink = RestSink()
+            sink.configure({"url": f"http://127.0.0.1:{server.server_address[1]}/hook"})
+            sink.collect({"r": 1})
+            assert received == [{"r": 1}]
+        finally:
+            server.shutdown()
+
+
+class TestRegistry:
+    def test_builtin_types(self):
+        srcs = io_registry.source_types()
+        sinks = io_registry.sink_types()
+        for s in ("memory", "simulator", "file", "httppull", "httppush"):
+            assert s in srcs
+        for s in ("memory", "log", "nop", "file", "rest"):
+            assert s in sinks
+
+    def test_unknown(self):
+        with pytest.raises(ValueError):
+            io_registry.create_source("bogus")
+
+
+import urllib.error  # noqa: E402  (used in TestHttpIO)

@@ -1,0 +1,207 @@
+"""REST API + rule registry + trial tests — modeled on the reference's FVT
+suite (fvt/: boots the real server in-process, drives via an HTTP SDK)."""
+import json
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from ekuiper_tpu.io import memory as mem
+from ekuiper_tpu.server.rest import RestApi, serve
+from ekuiper_tpu.store import kv
+
+
+@pytest.fixture
+def api():
+    mem.reset()
+    yield RestApi(kv.get_store())
+    mem.reset()
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+STREAM_SQL = ('CREATE STREAM demo (deviceId STRING, temperature FLOAT) '
+              'WITH (DATASOURCE="t/demo", TYPE="memory")')
+
+
+class TestDispatch:
+    """Route-level tests (no socket)."""
+
+    def test_stream_crud(self, api):
+        code, res = api.dispatch("POST", "/streams", {"sql": STREAM_SQL})
+        assert code == 201 and "created" in res
+        code, res = api.dispatch("GET", "/streams", None)
+        assert res == ["demo"]
+        code, res = api.dispatch("GET", "/streams/demo", None)
+        assert res["fields"][0]["name"] == "deviceId"
+        code, res = api.dispatch("GET", "/streams/demo/schema", None)
+        assert len(res) == 2
+        code, res = api.dispatch("DELETE", "/streams/demo", None)
+        assert code == 200
+        code, res = api.dispatch("GET", "/streams/demo", None)
+        assert code == 400 and "not found" in res["error"]
+
+    def test_duplicate_stream(self, api):
+        api.dispatch("POST", "/streams", {"sql": STREAM_SQL})
+        code, res = api.dispatch("POST", "/streams", {"sql": STREAM_SQL})
+        assert code == 400 and "already exists" in res["error"]
+
+    def test_rule_lifecycle(self, api):
+        api.dispatch("POST", "/streams", {"sql": STREAM_SQL})
+        rule = {"id": "r1", "sql": "SELECT * FROM demo",
+                "actions": [{"nop": {}}], "options": {"triggered": False}}
+        code, res = api.dispatch("POST", "/rules", rule)
+        assert code == 201
+        code, res = api.dispatch("GET", "/rules", None)
+        assert res[0]["id"] == "r1"
+        code, res = api.dispatch("POST", "/rules/r1/start", None)
+        assert code == 200
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            code, res = api.dispatch("GET", "/rules/r1/status", None)
+            if res.get("status") == "running":
+                break
+            time.sleep(0.05)
+        assert res["status"] == "running"
+        code, res = api.dispatch("GET", "/rules/r1/explain", None)
+        assert res["path"] in ("host", "device-fused")
+        code, res = api.dispatch("GET", "/rules/r1/topo", None)
+        assert "sources" in res
+        code, res = api.dispatch("POST", "/rules/r1/stop", None)
+        assert code == 200
+        code, res = api.dispatch("DELETE", "/rules/r1", None)
+        assert code == 200
+        code, res = api.dispatch("GET", "/rules", None)
+        assert res == []
+
+    def test_rule_validate(self, api):
+        api.dispatch("POST", "/streams", {"sql": STREAM_SQL})
+        code, res = api.dispatch("POST", "/rules/validate",
+                                 {"id": "x", "sql": "SELECT * FROM demo"})
+        assert res["valid"] is True
+        code, res = api.dispatch("POST", "/rules/validate",
+                                 {"id": "x", "sql": "SELECT * FROM missing"})
+        assert res["valid"] is False and "not found" in res["error"]
+
+    def test_bad_rule_rolls_back(self, api):
+        # plan failure must not leave the definition behind
+        code, res = api.dispatch("POST", "/rules",
+                                 {"id": "bad", "sql": "SELECT * FROM missing"})
+        assert code == 400
+        code, res = api.dispatch("GET", "/rules", None)
+        assert res == []
+
+    def test_ruleset_roundtrip(self, api):
+        api.dispatch("POST", "/streams", {"sql": STREAM_SQL})
+        api.dispatch("POST", "/rules", {
+            "id": "r1", "sql": "SELECT * FROM demo",
+            "actions": [{"nop": {}}], "options": {"triggered": False},
+        })
+        code, doc = api.dispatch("GET", "/ruleset/export", None)
+        assert "demo" in doc["streams"] and "r1" in doc["rules"]
+        # import into a fresh store
+        api2 = RestApi(kv.Store("memory"))
+        code, res = api2.dispatch("POST", "/ruleset/import", doc)
+        assert res == {"streams": 1, "tables": 0, "rules": 1}
+        code, res = api2.dispatch("GET", "/streams", None)
+        assert res == ["demo"]
+
+    def test_404(self, api):
+        code, res = api.dispatch("GET", "/bogus", None)
+        assert code == 404
+
+
+class TestHttpServer:
+    """Over a real socket."""
+
+    def test_end_to_end_http(self, api, mock_clock):
+        port = free_port()
+        server = serve(api, "127.0.0.1", port)
+        base = f"http://127.0.0.1:{port}"
+
+        def call(method, path, body=None):
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(base + path, data=data, method=method,
+                                         headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.status, json.loads(resp.read().decode())
+            except urllib.error.HTTPError as exc:
+                return exc.code, json.loads(exc.read().decode())
+
+        try:
+            code, info = call("GET", "/")
+            assert code == 200 and info["engine"] == "ekuiper_tpu"
+            code, _ = call("POST", "/streams", {"sql": STREAM_SQL})
+            assert code == 201
+            code, _ = call("POST", "/rules", {
+                "id": "http_rule",
+                "sql": "SELECT deviceId, temperature FROM demo WHERE temperature > 21",
+                "actions": [{"memory": {"topic": "http_res"}}],
+            })
+            assert code == 201
+            got = []
+            mem.subscribe("http_res", lambda t, p: got.append(p))
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                _, status = call("GET", "/rules/http_rule/status")
+                if status.get("status") == "running":
+                    break
+                time.sleep(0.05)
+            mem.publish("t/demo", {"deviceId": "a", "temperature": 25.0})
+            mock_clock.advance(20)
+            deadline = time.time() + 5
+            while not got and time.time() < deadline:
+                time.sleep(0.02)
+            assert got and got[0] == {"deviceId": "a", "temperature": 25.0}
+            code, res = call("DELETE", "/rules/http_rule")
+            assert code == 200
+        finally:
+            server.shutdown()
+
+    def test_trial_over_http(self, api):
+        port = free_port()
+        server = serve(api, "127.0.0.1", port)
+        base = f"http://127.0.0.1:{port}"
+
+        def call(method, path, body=None):
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(base + path, data=data, method=method)
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read().decode())
+
+        try:
+            call("POST", "/streams", {"sql": STREAM_SQL})
+            trial = call("POST", "/ruletest", {
+                "sql": "SELECT deviceId, temperature * 2 AS t2 FROM demo",
+                "mockSource": {"demo": {"data": [
+                    {"deviceId": "a", "temperature": 1.0},
+                    {"deviceId": "b", "temperature": 2.0},
+                ], "interval": 0, "loop": False}},
+            })
+            tid = trial["id"]
+            call("POST", f"/ruletest/{tid}/start")
+            from ekuiper_tpu.utils import timex
+
+            deadline = time.time() + 5
+            results = []
+            while time.time() < deadline:
+                timex.get_mock_clock().advance(20)  # linger flush
+                results = call("GET", f"/ruletest/{tid}")
+                if results:
+                    break
+                time.sleep(0.05)
+            call("DELETE", f"/ruletest/{tid}")
+            flat = []
+            for r in results:
+                flat.extend(r if isinstance(r, list) else [r])
+            assert {"deviceId": "a", "t2": 2.0} in flat
+        finally:
+            server.shutdown()

@@ -1,0 +1,277 @@
+"""Window operator semantics — modeled on the reference's window test suites
+(internal/topo/topotest/window_rule_test.go, 5.9k LoC). Drives WindowNode /
+FusedWindowAggNode directly with the mock clock and asserts emitted windows.
+"""
+import time
+
+import pytest
+
+from ekuiper_tpu.data.rows import Tuple, WindowTuples
+from ekuiper_tpu.runtime.events import Watermark
+from ekuiper_tpu.runtime.nodes_window import WatermarkNode, WindowNode
+from ekuiper_tpu.sql.parser import parse_select
+from ekuiper_tpu.utils import timex
+
+
+def window_of(sql):
+    return parse_select(sql).window
+
+
+class Harness:
+    """Synchronous window-node driver: calls handlers inline, collects emits."""
+
+    def __init__(self, node):
+        self.node = node
+        self.emitted = []
+        node.broadcast = self._capture
+        # triggers enqueue into inq; drain them inline for determinism
+        node.inq.put = self._on_put
+        node.on_open()
+
+    def _capture(self, item):
+        if isinstance(item, WindowTuples):
+            self.emitted.append(item)
+
+    def _on_put(self, item):
+        from ekuiper_tpu.runtime.events import Trigger
+
+        if isinstance(item, Trigger):
+            self.node.on_trigger(item)
+
+    def feed(self, message, ts=None):
+        t = Tuple(emitter="s", message=message,
+                  timestamp=ts if ts is not None else timex.now_ms())
+        self.node.process(t)
+
+    def watermark(self, ts):
+        self.node.on_watermark(Watermark(ts=ts))
+
+    def windows(self):
+        return [[r.message for r in w.rows()] for w in self.emitted]
+
+    def ranges(self):
+        return [(w.window_range.window_start, w.window_range.window_end)
+                for w in self.emitted]
+
+
+class TestTumblingProcessing:
+    def test_basic(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT * FROM s GROUP BY TUMBLINGWINDOW(ss, 10)"))
+        h = Harness(node)
+        h.feed({"v": 1})
+        mock_clock.advance(5000)
+        h.feed({"v": 2})
+        mock_clock.advance(5000)  # t=10000: fire
+        assert h.windows() == [[{"v": 1}, {"v": 2}]]
+        assert h.ranges() == [(0, 10_000)]
+        h.feed({"v": 3})
+        mock_clock.advance(10_000)
+        assert h.windows()[1] == [{"v": 3}]
+
+    def test_empty_window_emits_empty(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT * FROM s GROUP BY TUMBLINGWINDOW(ss, 10)"))
+        h = Harness(node)
+        mock_clock.advance(10_000)
+        # reference emits nothing downstream for empty windows (no rows)
+        assert h.windows() == [[]]
+
+
+class TestHoppingProcessing:
+    def test_overlap(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT * FROM s GROUP BY HOPPINGWINDOW(ss, 10, 5)"))
+        h = Harness(node)
+        h.feed({"v": 1})          # t=0
+        mock_clock.advance(4000)
+        h.feed({"v": 2})          # t=4000
+        mock_clock.advance(1000)  # t=5000: window (-5000, 5000]
+        mock_clock.advance(2000)
+        h.feed({"v": 3})          # t=7000
+        mock_clock.advance(3000)  # t=10000: window (0, 10000]
+        ws = h.windows()
+        assert ws[0] == [{"v": 1}, {"v": 2}]
+        assert ws[1] == [{"v": 1}, {"v": 2}, {"v": 3}]
+        mock_clock.advance(5000)  # t=15000: window (5000,15000] -> only v3
+        assert h.windows()[2] == [{"v": 3}]
+
+
+class TestSlidingProcessing:
+    def test_per_event_trigger(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT * FROM s GROUP BY SLIDINGWINDOW(ss, 10)"))
+        h = Harness(node)
+        h.feed({"v": 1})
+        mock_clock.advance(5000)
+        h.feed({"v": 2})  # window (t-10s, t] includes v1
+        assert h.windows() == [[{"v": 1}], [{"v": 1}, {"v": 2}]]
+        mock_clock.advance(11_000)
+        h.feed({"v": 3})  # v1, v2 expired
+        assert h.windows()[2] == [{"v": 3}]
+
+    def test_trigger_condition(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT * FROM s GROUP BY SLIDINGWINDOW(ss, 10) OVER (WHEN v > 5)"))
+        h = Harness(node)
+        h.feed({"v": 1})
+        assert h.windows() == []  # condition false: no trigger
+        h.feed({"v": 9})
+        assert h.windows() == [[{"v": 1}, {"v": 9}]]
+
+    def test_delay(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT * FROM s GROUP BY SLIDINGWINDOW(ss, 10, 2)"))
+        h = Harness(node)
+        h.feed({"v": 1})
+        assert h.windows() == []  # delayed
+        mock_clock.advance(1000)
+        h.feed({"v": 2})  # lands inside the delay extension
+        mock_clock.advance(1000)  # delay expires for v1's trigger
+        assert h.windows() == [[{"v": 1}, {"v": 2}]]
+
+
+class TestSessionProcessing:
+    def test_gap_timeout(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT * FROM s GROUP BY SESSIONWINDOW(ss, 100, 5)"))
+        h = Harness(node)
+        h.feed({"v": 1})
+        mock_clock.advance(3000)
+        h.feed({"v": 2})
+        mock_clock.advance(5000)  # gap 5s elapses: session closes
+        assert h.windows() == [[{"v": 1}, {"v": 2}]]
+        h.feed({"v": 3})
+        mock_clock.advance(5000)
+        assert h.windows()[1] == [{"v": 3}]
+
+
+class TestCountWindow:
+    def test_simple(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT * FROM s GROUP BY COUNTWINDOW(3)"))
+        h = Harness(node)
+        for i in range(7):
+            h.feed({"v": i})
+        ws = h.windows()
+        assert ws[0] == [{"v": 0}, {"v": 1}, {"v": 2}]
+        assert ws[1] == [{"v": 3}, {"v": 4}, {"v": 5}]
+
+    def test_overlapping(self, mock_clock):
+        # COUNTWINDOW(3, 1): every event, last 3 rows
+        node = WindowNode("w", window_of(
+            "SELECT * FROM s GROUP BY COUNTWINDOW(3, 1)"))
+        h = Harness(node)
+        for i in range(4):
+            h.feed({"v": i})
+        ws = h.windows()
+        assert ws[0] == [{"v": 0}]
+        assert ws[2] == [{"v": 0}, {"v": 1}, {"v": 2}]
+        assert ws[3] == [{"v": 1}, {"v": 2}, {"v": 3}]
+
+
+class TestStateWindow:
+    def test_begin_emit(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT * FROM s GROUP BY STATEWINDOW(st = 'on', st = 'off')"))
+        h = Harness(node)
+        h.feed({"st": "idle"})  # before begin: ignored
+        h.feed({"st": "on"})
+        h.feed({"st": "run"})
+        h.feed({"st": "off"})  # emit
+        h.feed({"st": "stray"})
+        assert h.windows() == [[{"st": "on"}, {"st": "run"}, {"st": "off"}]]
+
+
+class TestEventTime:
+    def test_tumbling_watermark(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT * FROM s GROUP BY TUMBLINGWINDOW(ss, 10)"),
+            is_event_time=True)
+        h = Harness(node)
+        h.feed({"v": 1}, ts=1000)
+        h.feed({"v": 2}, ts=9000)
+        h.feed({"v": 3}, ts=12_000)
+        h.watermark(9500)
+        assert h.windows() == []  # window (0,10000] not complete yet
+        h.watermark(10_500)
+        assert h.windows() == [[{"v": 1}, {"v": 2}]]
+        h.watermark(20_500)
+        assert h.windows()[1] == [{"v": 3}]
+
+    def test_session_event_time(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT * FROM s GROUP BY SESSIONWINDOW(ss, 100, 5)"),
+            is_event_time=True)
+        h = Harness(node)
+        h.feed({"v": 1}, ts=1000)
+        h.feed({"v": 2}, ts=3000)
+        h.feed({"v": 3}, ts=20_000)  # new session (gap > 5s)
+        h.watermark(30_000)
+        ws = h.windows()
+        assert ws[0] == [{"v": 1}, {"v": 2}]
+        assert ws[1] == [{"v": 3}]
+
+    def test_watermark_node_drops_late(self, mock_clock):
+        wm_node = WatermarkNode("wm", late_tolerance_ms=1000)
+        out = []
+        wm_node.broadcast = lambda item: out.append(item)
+        wm_node.emit = lambda item, count=1: out.append(item)
+        wm_node.process(Tuple(message={"v": 1}, timestamp=10_000))
+        wm_node.process(Tuple(message={"v": 2}, timestamp=5_000))  # late
+        rows = [x for x in out if isinstance(x, Tuple)]
+        assert [r.message["v"] for r in rows] == [1]
+        wms = [x for x in out if isinstance(x, Watermark)]
+        assert wms[-1].ts == 9_000
+
+
+class TestFusedHopping:
+    def test_hopping_device_path(self, mock_clock):
+        """Fused hopping window through the e2e rule surface."""
+        from ekuiper_tpu.io import memory as mem
+        from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+        from ekuiper_tpu.server.processors import StreamProcessor
+        from ekuiper_tpu.store import kv
+
+        mem.reset()
+        store = kv.get_store()
+        StreamProcessor(store).exec_stmt(
+            'CREATE STREAM demo (k STRING, v FLOAT) WITH (DATASOURCE="t", TYPE="memory")'
+        )
+        topo = plan_rule(RuleDef(id="hop", sql=(
+            "SELECT k, sum(v) AS s FROM demo GROUP BY k, HOPPINGWINDOW(ss, 10, 5)"
+        ), actions=[{"memory": {"topic": "hop_res"}}]), store)
+        assert any(n.name == "window_agg" for n in topo.ops)
+        got = []
+        mem.subscribe("hop_res", lambda t, p: got.append(p))
+        topo.open()
+        try:
+            mem.publish("t", {"k": "a", "v": 1.0})
+            mock_clock.advance(20)
+            time.sleep(0.4)
+            mock_clock.advance(4980)  # t=5000: first hop fires
+            deadline = time.time() + 5
+            while len(got) < 1 and time.time() < deadline:
+                time.sleep(0.02)
+            mem.publish("t", {"k": "a", "v": 2.0})
+            mock_clock.advance(20)
+            time.sleep(0.4)
+            mock_clock.advance(4980)  # t=10000: window covers both
+            deadline = time.time() + 5
+            while len(got) < 2 and time.time() < deadline:
+                time.sleep(0.02)
+            first = got[0] if isinstance(got[0], dict) else got[0][0]
+            second = got[1] if isinstance(got[1], dict) else got[1][0]
+            assert first == {"k": "a", "s": 1.0}
+            assert second == {"k": "a", "s": 3.0}  # both panes merged
+            # t=15000 and t=20000: v1 pane expires, then v2 pane expires
+            time.sleep(0.1)
+            mock_clock.advance(5000)
+            deadline = time.time() + 5
+            while len(got) < 3 and time.time() < deadline:
+                time.sleep(0.02)
+            third = got[2] if isinstance(got[2], dict) else got[2][0]
+            assert third == {"k": "a", "s": 2.0}
+        finally:
+            topo.close()
+            mem.reset()
