@@ -145,6 +145,8 @@ def f_median(args, ctx):
 
 
 def _percentile(values: List[Any], frac: float, cont: bool) -> Any:
+    if not 0.0 <= frac <= 1.0:
+        raise ValueError(f"percentile fraction must be in [0, 1], got {frac}")
     nums = sorted(_nums(values))
     if not nums:
         return None

@@ -1,0 +1,49 @@
+"""Sketch UDFs — the north-star additions (BASELINE.json): approximate
+distinct count (HyperLogLog), approximate percentile (log-histogram /
+DDSketch-class), and heavy hitters (count-min backed).
+
+On the fused device path these map to wide kernel components
+(ops/sketches.py); the host-path implementations here are used by the
+buffered window operators and compute small-group results (exactly, which is
+a strict accuracy upgrade at host scales).
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, List
+
+from ..data import cast
+from .registry import AGGREGATE, register
+
+
+def _hll_exec(args, ctx):
+    # host groups are small: exact distinct count
+    seen = set()
+    for v in args[0]:
+        if v is not None:
+            seen.add(v if isinstance(v, (int, float, str, bool)) else repr(v))
+    return len(seen)
+
+
+register("hll", AGGREGATE)(_hll_exec)
+register("distinct_count_approx", AGGREGATE)(_hll_exec)
+
+
+# host path: same semantics as percentile_cont (exact at host scales)
+from .funcs_agg import f_percentile_cont  # noqa: E402
+
+register("percentile_approx", AGGREGATE)(f_percentile_cont)
+
+
+@register("heavy_hitters", AGGREGATE)
+def f_heavy_hitters(args, ctx):
+    """heavy_hitters(col, k) — top-k values by frequency as
+    [{value, count}, ...]. Exact at host-window scales; the device
+    CountMinSketch primitive (ops/sketches.py) serves memory-bounded
+    window-level sketching beyond what a buffered window holds."""
+    k_arg = args[1]
+    k = cast.to_int(k_arg[0] if isinstance(k_arg, list) else k_arg)
+    counts = Counter(v for v in args[0] if v is not None)
+    return [
+        {"value": v, "count": c} for v, c in counts.most_common(k)
+    ]

@@ -141,6 +141,7 @@ def _ensure_loaded() -> None:
         funcs_math,
         funcs_misc,
         funcs_obj,
+        funcs_sketch,
         funcs_srf,
         funcs_str,
         funcs_window,

@@ -34,9 +34,15 @@ DEVICE_AGGS: Dict[str, Set[str]] = {
     "inc_max": {"mx", "n"},
     "inc_stddev": {"n", "s1", "s2"},
     "inc_stddevs": {"n", "s1", "s2"},
+    # sketch aggregates (north-star UDFs) — wide device components
+    "hll": {"hll"},
+    "distinct_count_approx": {"hll"},
+    "percentile_approx": {"hist"},
 }
 
 ALL_COMPONENTS = ("n", "s1", "s2", "mn", "mx")
+# components with a trailing register axis (capacity, k, R)
+WIDE_COMPONENTS = {"hll", "hist"}
 
 
 @dataclass
@@ -44,11 +50,12 @@ class AggSpec:
     """One device-foldable aggregate call."""
 
     call: ast.Call
-    kind: str  # count/sum/avg/min/max/stddev/stddevs/var/vars
+    kind: str  # count/sum/avg/min/max/stddev/.../hll/percentile_approx
     components: Set[str]
     arg: Optional[CompiledExpr]  # device closure for the argument (None = count(*))
     filter: Optional[CompiledExpr]  # FILTER(WHERE ...) device closure
     int_input: bool = False  # observed integer input → integer avg/sum results
+    frac: float = 0.5  # percentile_approx quantile (2nd literal arg)
 
     @property
     def is_star(self) -> bool:
@@ -83,9 +90,19 @@ def extract_kernel_plan(
             return None
         if call.partition or call.when is not None:
             return None
+        frac = 0.5
         arg_ce: Optional[CompiledExpr] = None
         if call.args and not isinstance(call.args[0], ast.Wildcard):
-            if len(call.args) != 1:
+            if call.name == "percentile_approx":
+                if len(call.args) != 2 or not isinstance(
+                    call.args[1], (ast.NumberLiteral, ast.IntegerLiteral)
+                ):
+                    return None
+                frac = float(call.args[1].val)
+                if not 0.0 <= frac <= 1.0:
+                    # invalid fraction: host path raises the clear error
+                    return None
+            elif len(call.args) != 1:
                 return None
             arg_ce = try_compile(call.args[0], mode="device")
             if arg_ce is None:
@@ -100,10 +117,11 @@ def extract_kernel_plan(
         specs.append(
             AggSpec(
                 call=call,
-                kind=kind,
+                kind="hll" if kind == "distinct_count_approx" else kind,
                 components=set(DEVICE_AGGS[call.name]),
                 arg=arg_ce,
                 filter=filter_ce,
+                frac=frac,
             )
         )
     where_ce: Optional[CompiledExpr] = None

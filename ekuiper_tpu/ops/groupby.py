@@ -31,7 +31,22 @@ import numpy as np
 
 from .aggspec import AggSpec, KernelPlan
 
-_INIT = {"n": 0.0, "s1": 0.0, "s2": 0.0, "mn": np.inf, "mx": -np.inf, "act": 0.0}
+_INIT = {
+    "n": 0.0, "s1": 0.0, "s2": 0.0, "mn": np.inf, "mx": -np.inf, "act": 0.0,
+    # wide (register-axis) components: HLL registers, log-histogram bins
+    "hll": 0.0, "hist": 0.0,
+}
+
+_WIDE_SIZE = {}  # filled lazily from sketches to avoid import cycle
+
+
+def _wide_size(comp: str) -> int:
+    if not _WIDE_SIZE:
+        from . import sketches
+
+        _WIDE_SIZE["hll"] = sketches.HLL_M
+        _WIDE_SIZE["hist"] = sketches.HIST_BINS
+    return _WIDE_SIZE[comp]
 
 
 def apply_int_semantics(specs, host: List[np.ndarray]) -> List[np.ndarray]:
@@ -40,7 +55,7 @@ def apply_int_semantics(specs, host: List[np.ndarray]) -> List[np.ndarray]:
     Shared by the single-chip and sharded paths so results are identical
     regardless of placement."""
     for i, spec in enumerate(specs):
-        if spec.kind == "count":
+        if spec.kind in ("count", "hll"):
             host[i] = host[i].astype(np.int64)
         elif spec.int_input and spec.kind in ("sum", "avg", "min", "max"):
             with np.errstate(invalid="ignore"):
@@ -92,12 +107,14 @@ class DeviceGroupBy:
     def init_state(self) -> Dict[str, Any]:
         import jax.numpy as jnp
 
+        from .aggspec import WIDE_COMPONENTS
+
         state: Dict[str, Any] = {}
         for comp, spec_idxs in self.comp_specs.items():
-            state[comp] = jnp.full(
-                (self.n_panes, self.capacity, len(spec_idxs)),
-                _INIT[comp], dtype=jnp.float32,
-            )
+            shape = (self.n_panes, self.capacity, len(spec_idxs))
+            if comp in WIDE_COMPONENTS:
+                shape = shape + (_wide_size(comp),)
+            state[comp] = jnp.full(shape, _INIT[comp], dtype=jnp.float32)
         # activity: rows per key per pane (post-WHERE), for group existence
         state["act"] = jnp.zeros((self.n_panes, self.capacity), dtype=jnp.float32)
         return state
@@ -216,6 +233,18 @@ class DeviceGroupBy:
                     arr = arr.at[pane_idx, slots, k].max(
                         jnp.where(m, v, -jnp.inf)
                     )
+                elif comp == "hll":
+                    from .sketches import hll_parts
+
+                    reg, rho = hll_parts(v)
+                    arr = arr.at[pane_idx, slots, k, reg].max(
+                        jnp.where(m, rho, 0.0)
+                    )
+                elif comp == "hist":
+                    from .sketches import hist_bin
+
+                    b = hist_bin(v)
+                    arr = arr.at[pane_idx, slots, k, b].add(mf)
             state[comp] = arr
         return state
 
@@ -228,7 +257,7 @@ class DeviceGroupBy:
         pm = pane_mask.reshape(-1, *([1] * (arr.ndim - 1)))
         if comp == "mn":
             return jnp.min(jnp.where(pm, arr, jnp.inf), axis=0)
-        if comp == "mx":
+        if comp in ("mx", "hll"):  # hll registers merge by max
             return jnp.max(jnp.where(pm, arr, -jnp.inf), axis=0)
         return jnp.sum(jnp.where(pm, arr, 0.0), axis=0)
 
@@ -278,6 +307,16 @@ class DeviceGroupBy:
             )
             out = jnp.sqrt(v) if kind == "stddevs" else v
             return jnp.where(n >= 2, out, jnp.nan)
+        if kind == "hll":
+            from .sketches import hll_estimate
+
+            # pane merge used -inf for masked panes; clamp back to 0
+            regs = jnp.maximum(c["hll"], 0.0)
+            return jnp.round(hll_estimate(regs))
+        if kind == "percentile_approx":
+            from .sketches import hist_quantile
+
+            return hist_quantile(c["hist"], spec.frac)
         raise ValueError(f"unknown device agg kind {kind}")
 
     def finalize(

@@ -65,8 +65,14 @@ class ShardedGroupBy:
             for comp in spec.components:
                 self.comp_specs.setdefault(comp, []).append(i)
 
+        from ..ops.aggspec import WIDE_COMPONENTS
+
         self.state_sharding = {
-            comp: NamedSharding(mesh, P("keys", None)) for comp in self.comp_specs
+            comp: NamedSharding(
+                mesh,
+                P("keys", None, None) if comp in WIDE_COMPONENTS else P("keys", None),
+            )
+            for comp in self.comp_specs
         }
         self.state_sharding["act"] = NamedSharding(mesh, P("keys"))
         self.batch_sharding = NamedSharding(mesh, P("rows"))
@@ -79,11 +85,16 @@ class ShardedGroupBy:
         import jax
         import jax.numpy as jnp
 
+        from ..ops.aggspec import WIDE_COMPONENTS
+        from ..ops.groupby import _wide_size
+
         def mk(comp):
-            shape = (
-                (self.capacity,) if comp == "act"
-                else (self.capacity, len(self.comp_specs[comp]))
-            )
+            if comp == "act":
+                shape = (self.capacity,)
+            else:
+                shape = (self.capacity, len(self.comp_specs[comp]))
+                if comp in WIDE_COMPONENTS:
+                    shape = shape + (_wide_size(comp),)
             return jax.device_put(
                 jnp.full(shape, _INIT[comp], dtype=jnp.float32),
                 self.state_sharding[comp],
@@ -169,10 +180,33 @@ class ShardedGroupBy:
                         ].max(jnp.where(m, v, -jnp.inf))
                         col = jax.lax.pmax(col, "rows")
                         adds.append(jnp.maximum(arr[:, k], col))
+                    elif comp == "hll":
+                        from ..ops.sketches import hll_parts
+
+                        reg, rho = hll_parts(v)
+                        wide = jnp.zeros(
+                            (cap_per_shard, arr.shape[-1]), jnp.float32
+                        ).at[local, reg].max(jnp.where(m, rho, 0.0))
+                        wide = jax.lax.pmax(wide, "rows")
+                        adds.append(jnp.maximum(arr[:, k, :], wide))
+                    elif comp == "hist":
+                        from ..ops.sketches import hist_bin
+
+                        b = hist_bin(v)
+                        wide = jnp.zeros(
+                            (cap_per_shard, arr.shape[-1]), jnp.float32
+                        ).at[local, b].add(mf)
+                        adds.append(arr[:, k, :] + jax.lax.psum(wide, "rows"))
                 out[comp] = jnp.stack(adds, axis=1)
             return out
 
-        state_specs = {comp: P("keys", None) for comp in comp_specs}
+        from ..ops.aggspec import WIDE_COMPONENTS
+
+        state_specs = {
+            comp: P("keys", None, None) if comp in WIDE_COMPONENTS
+            else P("keys", None)
+            for comp in comp_specs
+        }
         state_specs["act"] = P("keys")
 
         def step(state, cols, slots, row_valid):

@@ -28,6 +28,19 @@ from .events import EOF, Trigger
 from .node import Node
 
 
+def _hash_object_column(col: np.ndarray) -> np.ndarray:
+    """Distinct-preserving stable hash of string/object values into float32
+    (for hll over identifier columns). Uses crc32 — stable across processes
+    so checkpointed registers stay consistent after restore."""
+    import zlib
+
+    uniq, inverse = np.unique(col.astype("U"), return_inverse=True)
+    hashes = np.fromiter(
+        (zlib.crc32(u.encode()) for u in uniq), dtype=np.uint32, count=len(uniq)
+    ).astype(np.float32)
+    return hashes[inverse]
+
+
 class FusedWindowAggNode(Node):
     def __init__(
         self,
@@ -67,6 +80,13 @@ class FusedWindowAggNode(Node):
         self._rows_in_window = 0
         self._spec_keys = [_call_key(s.call) for s in plan.specs]
         self._dtypes_seen = False
+        # columns feeding hll specs directly: string values get host-hashed
+        # to float32 (distinct-preserving) instead of coerced to NaN
+        self._hash_cols = {
+            next(iter(s.arg.columns))
+            for s in plan.specs
+            if "hll" in s.components and s.arg is not None and len(s.arg.columns) == 1
+        }
 
     # --------------------------------------------------------------- lifecycle
     def on_open(self) -> None:
@@ -160,12 +180,15 @@ class FusedWindowAggNode(Node):
                 cols[name] = np.full(sub.n, np.nan, dtype=np.float32)
                 continue
             if col.dtype == np.object_:
-                # mixed/object numeric column: coerce with NaN for bad rows
-                coerced = np.full(sub.n, np.nan, dtype=np.float32)
-                for i, v in enumerate(col):
-                    if isinstance(v, (int, float)) and not isinstance(v, bool):
-                        coerced[i] = v
-                cols[name] = coerced
+                if name in self._hash_cols:
+                    cols[name] = _hash_object_column(col)
+                else:
+                    # mixed/object numeric column: coerce, NaN for bad rows
+                    coerced = np.full(sub.n, np.nan, dtype=np.float32)
+                    for i, v in enumerate(col):
+                        if isinstance(v, (int, float)) and not isinstance(v, bool):
+                            coerced[i] = v
+                    cols[name] = coerced
             else:
                 cols[name] = col
             v = sub.valid.get(name)

@@ -1,0 +1,180 @@
+"""Sketch UDF tests: HLL distinct count, approximate percentiles, count-min
+heavy hitters — accuracy bounds and device/host/sharded consistency."""
+import numpy as np
+import pytest
+
+from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+from ekuiper_tpu.ops.groupby import DeviceGroupBy
+from ekuiper_tpu.ops.keytable import KeyTable
+from ekuiper_tpu.ops.sketches import CountMinSketch
+from ekuiper_tpu.sql.parser import parse_select
+
+
+def _plan(sql):
+    plan = extract_kernel_plan(parse_select(sql))
+    assert plan is not None
+    return plan
+
+
+class TestHLL:
+    def test_distinct_count_accuracy(self):
+        plan = _plan(
+            "SELECT hll(v) FROM s GROUP BY k, TUMBLINGWINDOW(ss, 10)"
+        )
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=4096)
+        kt = KeyTable(8)
+        rng = np.random.default_rng(7)
+        true_distinct = 5000
+        vals = rng.permutation(
+            np.repeat(np.arange(true_distinct, dtype=np.float32), 3)
+        )
+        slots, _ = kt.encode_column(np.array(["a"] * len(vals), dtype=np.object_))
+        state = gb.fold(gb.init_state(), {"v": vals}, slots)
+        outs, act = gb.finalize(state, kt.n_keys)
+        est = int(outs[0][0])
+        # m=256 registers -> ~6.5% std error; allow 3 sigma
+        assert abs(est - true_distinct) / true_distinct < 0.20, est
+        assert outs[0].dtype == np.int64
+
+    def test_small_cardinality_exactish(self):
+        plan = _plan("SELECT distinct_count_approx(v) FROM s GROUP BY k, TUMBLINGWINDOW(ss, 10)")
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=64)
+        kt = KeyTable(8)
+        vals = np.array([1.0, 2.0, 3.0, 1.0, 2.0], dtype=np.float32)
+        slots, _ = kt.encode_column(np.array(["a"] * 5, dtype=np.object_))
+        state = gb.fold(gb.init_state(), {"v": vals}, slots)
+        outs, _ = gb.finalize(state, kt.n_keys)
+        assert 2 <= outs[0][0] <= 4  # small-range correction keeps it close
+
+    def test_per_key_isolation(self):
+        plan = _plan("SELECT hll(v) FROM s GROUP BY k, TUMBLINGWINDOW(ss, 10)")
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=256)
+        kt = KeyTable(8)
+        keys = np.array(["a"] * 100 + ["b"] * 10, dtype=np.object_)
+        vals = np.concatenate([
+            np.arange(100, dtype=np.float32),
+            np.arange(10, dtype=np.float32),
+        ])
+        slots, _ = kt.encode_column(keys)
+        state = gb.fold(gb.init_state(), {"v": vals}, slots)
+        outs, _ = gb.finalize(state, kt.n_keys)
+        a, b = outs[0][0], outs[0][1]
+        assert abs(a - 100) / 100 < 0.3 and abs(b - 10) <= 3
+
+    def test_pane_merge(self):
+        # hll over hopping panes merges registers by max (distinct across panes)
+        plan = _plan("SELECT hll(v) FROM s GROUP BY k, HOPPINGWINDOW(ss, 10, 5)")
+        gb = DeviceGroupBy(plan, capacity=8, n_panes=2, micro_batch=64)
+        kt = KeyTable(8)
+        slots, _ = kt.encode_column(np.array(["a"] * 10, dtype=np.object_))
+        v1 = np.arange(10, dtype=np.float32)
+        v2 = np.arange(10, dtype=np.float32)  # same values in pane 2
+        state = gb.init_state()
+        state = gb.fold(state, {"v": v1}, slots, pane_idx=0)
+        state = gb.fold(state, {"v": v2}, slots, pane_idx=1)
+        outs, _ = gb.finalize(state, kt.n_keys)
+        # same 10 distinct values in both panes -> still ~10, not ~20
+        assert outs[0][0] <= 14
+
+
+class TestPercentileApprox:
+    def test_quantiles(self):
+        plan = _plan(
+            "SELECT percentile_approx(v, 0.5), percentile_approx(v, 0.99) "
+            "FROM s GROUP BY k, TUMBLINGWINDOW(ss, 10)"
+        )
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=8192)
+        kt = KeyTable(8)
+        rng = np.random.default_rng(1)
+        vals = rng.lognormal(3.0, 1.0, 8192).astype(np.float32)
+        slots, _ = kt.encode_column(np.array(["a"] * len(vals), dtype=np.object_))
+        state = gb.fold(gb.init_state(), {"v": vals}, slots)
+        outs, _ = gb.finalize(state, kt.n_keys)
+        p50_true = float(np.percentile(vals, 50))
+        p99_true = float(np.percentile(vals, 99))
+        assert abs(outs[0][0] - p50_true) / p50_true < 0.10
+        assert abs(outs[1][0] - p99_true) / p99_true < 0.10
+
+    def test_empty_group_nan(self):
+        plan = _plan("SELECT percentile_approx(v, 0.5) FROM s GROUP BY k, TUMBLINGWINDOW(ss, 10)")
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=8)
+        kt = KeyTable(8)
+        slots, _ = kt.encode_column(np.array(["a"], dtype=np.object_))
+        state = gb.fold(gb.init_state(), {"v": np.array([np.nan], np.float32)}, slots)
+        outs, _ = gb.finalize(state, kt.n_keys)
+        assert np.isnan(outs[0][0])
+
+    def test_non_literal_frac_rejected(self):
+        stmt = parse_select(
+            "SELECT percentile_approx(v, f) FROM s GROUP BY k, TUMBLINGWINDOW(ss, 10)"
+        )
+        assert extract_kernel_plan(stmt) is None
+
+
+class TestCountMin:
+    def test_heavy_hitters(self):
+        cms = CountMinSketch(depth=4, width=2048)
+        rng = np.random.default_rng(2)
+        # zipf-ish: value i appears ~1000/i times
+        vals = []
+        for i in range(1, 50):
+            vals.extend([float(i)] * (1000 // i))
+        vals = np.array(vals, dtype=np.float32)
+        rng.shuffle(vals)
+        for start in range(0, len(vals), 1000):
+            cms.update(vals[start:start + 1000])
+        top = cms.heavy_hitters(3)
+        top_vals = [v for v, _ in top]
+        assert top_vals[0] == 1.0 and set(top_vals) == {1.0, 2.0, 3.0}
+        # estimates within cm error bound (overestimate only)
+        assert top[0][1] >= 1000 and top[0][1] < 1000 * 1.2
+
+    def test_reset(self):
+        cms = CountMinSketch(depth=2, width=64)
+        cms.update(np.array([1.0, 1.0], dtype=np.float32))
+        cms.reset()
+        assert cms.heavy_hitters(1) == []
+
+
+class TestSketchHostFallback:
+    def test_host_exec(self):
+        from ekuiper_tpu.data.rows import GroupedTuples, Tuple
+        from ekuiper_tpu.sql.eval import Evaluator
+
+        rows = [Tuple(message={"v": float(i % 3), "w": i}) for i in range(9)]
+        g = GroupedTuples(content=rows)
+        ev = Evaluator()
+        e = parse_select("SELECT hll(v) FROM t").fields[0].expr
+        assert ev.eval(e, g) == 3
+        e2 = parse_select("SELECT heavy_hitters(v, 1) FROM t").fields[0].expr
+        assert ev.eval(e2, g)[0]["count"] == 3
+        e3 = parse_select("SELECT percentile_approx(w, 0.5) FROM t").fields[0].expr
+        assert ev.eval(e3, g) == 4.0
+
+
+class TestShardedSketch:
+    def test_hll_sharded_matches(self):
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        from ekuiper_tpu.parallel.mesh import make_mesh
+        from ekuiper_tpu.parallel.sharded import ShardedGroupBy
+
+        sql = "SELECT hll(v), count(*) FROM s GROUP BY k, TUMBLINGWINDOW(ss, 10)"
+        plan_s = _plan(sql)
+        plan_d = _plan(sql)
+        mesh = make_mesh(rows=2, keys=4)
+        sgb = ShardedGroupBy(plan_s, mesh, capacity=32, micro_batch=128)
+        gb = DeviceGroupBy(plan_d, capacity=32, micro_batch=128)
+        kt = KeyTable(32)
+        rng = np.random.default_rng(3)
+        keys = np.array([f"k{rng.integers(6)}" for _ in range(600)], dtype=np.object_)
+        vals = rng.integers(0, 200, 600).astype(np.float32)
+        slots, _ = kt.encode_column(keys)
+        s_state = sgb.fold(sgb.init_state(), {"v": vals}, slots)
+        d_state = gb.fold(gb.init_state(), {"v": vals}, slots)
+        s_outs, _ = sgb.finalize(s_state, kt.n_keys)
+        d_outs, _ = gb.finalize(d_state, kt.n_keys)
+        np.testing.assert_array_equal(s_outs[0], d_outs[0])  # same registers -> same estimate
+        np.testing.assert_array_equal(s_outs[1], d_outs[1])
