@@ -35,15 +35,31 @@ from .funcs_agg import f_percentile_cont  # noqa: E402
 register("percentile_approx", AGGREGATE)(f_percentile_cont)
 
 
-@register("heavy_hitters", AGGREGATE)
+def _val_heavy_hitters(args: List[Any]) -> str:
+    if len(args) != 2:
+        return "expects 2 arguments (col, k)"
+    from ..sql import ast
+
+    if isinstance(args[1], ast.IntegerLiteral) and args[1].val <= 0:
+        return "k must be a positive integer"
+    return ""
+
+
+@register("heavy_hitters", AGGREGATE, val=_val_heavy_hitters)
 def f_heavy_hitters(args, ctx):
     """heavy_hitters(col, k) — top-k values by frequency as
     [{value, count}, ...]. Exact at host-window scales; the device
     CountMinSketch primitive (ops/sketches.py) serves memory-bounded
     window-level sketching beyond what a buffered window holds."""
+    if len(args) < 2:
+        raise ValueError("heavy_hitters expects 2 arguments (col, k)")
     k_arg = args[1]
     k = cast.to_int(k_arg[0] if isinstance(k_arg, list) else k_arg)
-    counts = Counter(v for v in args[0] if v is not None)
+    counts = Counter(
+        v if isinstance(v, (int, float, str, bool)) else repr(v)
+        for v in args[0]
+        if v is not None
+    )
     return [
         {"value": v, "count": c} for v, c in counts.most_common(k)
     ]

@@ -12,6 +12,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
+import numpy as np
+
 from ..sql import ast
 from ..sql.compiler import CompiledExpr, try_compile
 
@@ -43,6 +45,101 @@ DEVICE_AGGS: Dict[str, Set[str]] = {
 ALL_COMPONENTS = ("n", "s1", "s2", "mn", "mx")
 # components with a trailing register axis (capacity, k, R)
 WIDE_COMPONENTS = {"hll", "hist"}
+
+# Derived-column prefix: hll over a bare column reads a dedicated hashed
+# copy (strings crc32-hashed, numerics passed through) so the raw column
+# stays numeric for every other spec / WHERE / FILTER sharing it.
+HLL_COL_PREFIX = "__hll__"
+
+
+# values below this are exactly representable in float32 and pass through;
+# larger integral values hash their decimal repr so the float32 cast cannot
+# collapse distinct IDs (e.g. ~1e9-range device ids differing in low bits)
+_HLL_SMALL = 2 ** 24
+
+
+def _hll_encode_value(v) -> float:
+    """Distinct-preserving float32 encoding of one value for hll. The SAME
+    rule applies whether the value arrives in an object, integer, or float
+    batch, so a logical value always folds to the same register."""
+    import zlib
+
+    if isinstance(v, bool):
+        return float(v)
+    if isinstance(v, (int, np.integer)):
+        iv = int(v)
+        if abs(iv) < _HLL_SMALL:
+            return float(iv)
+        return float(zlib.crc32(str(iv).encode()))
+    if isinstance(v, (float, np.floating)):
+        fv = float(v)
+        if np.isfinite(fv) and fv.is_integer() and abs(fv) >= _HLL_SMALL:
+            return float(zlib.crc32(str(int(fv)).encode()))
+        return fv
+    return float(zlib.crc32(str(v).encode()))
+
+
+def hash_column_for_hll(col) -> "np.ndarray":
+    """Distinct-preserving stable encoding of a mixed/object column into
+    float32 for hll (see _hll_encode_value). crc32 is stable across
+    processes so checkpointed registers stay consistent after restore.
+    None -> NaN (masked, matching SQL null-skipping aggregates)."""
+    out = np.empty(len(col), dtype=np.float32)
+    memo: dict = {}
+    for i, v in enumerate(col):
+        if v is None:
+            out[i] = np.nan
+            continue
+        try:
+            h = memo.get(v)
+        except TypeError:  # unhashable (dict/list)
+            out[i] = _hll_encode_value(v)
+            continue
+        if h is None:
+            h = _hll_encode_value(v)
+            memo[v] = h
+        out[i] = h
+    return out
+
+
+def _hll_encode_numeric(raw: "np.ndarray") -> "np.ndarray":
+    """Vectorized hll encoding of a numeric-dtype column: float32 passthrough
+    with the (rare) large integral values deferred to _hll_encode_value so
+    the result matches the object-column path exactly."""
+    if np.issubdtype(raw.dtype, np.integer):
+        arr = raw.astype(np.int64)
+        out = arr.astype(np.float32)
+        big = np.abs(arr) >= _HLL_SMALL
+        for i in np.nonzero(big)[0]:
+            out[i] = _hll_encode_value(int(arr[i]))
+        return out
+    f = np.asarray(raw, dtype=np.float64)
+    out = f.astype(np.float32)
+    with np.errstate(invalid="ignore"):
+        big = np.isfinite(f) & (np.abs(f) >= _HLL_SMALL) & (f == np.floor(f))
+    for i in np.nonzero(big)[0]:
+        out[i] = _hll_encode_value(float(f[i]))
+    return out
+
+
+def materialize_hll_columns(plan_columns, cols: Dict[str, "np.ndarray"], n: int):
+    """Fill in any missing __hll__<col> derived columns from the raw column.
+    Returns a new dict when a derivation was needed; callers that already
+    materialized them (nodes_fused, with validity masks) pass through."""
+    out = None
+    for name in plan_columns:
+        if not name.startswith(HLL_COL_PREFIX) or name in cols:
+            continue
+        if out is None:
+            out = dict(cols)
+        raw = cols.get(name[len(HLL_COL_PREFIX):])
+        if raw is None:
+            out[name] = np.full(n, np.nan, dtype=np.float32)
+        elif getattr(raw, "dtype", None) == np.object_:
+            out[name] = hash_column_for_hll(raw)
+        else:
+            out[name] = _hll_encode_numeric(np.asarray(raw))
+    return out if out is not None else cols
 
 
 @dataclass
@@ -104,9 +201,17 @@ def extract_kernel_plan(
                     return None
             elif len(call.args) != 1:
                 return None
-            arg_ce = try_compile(call.args[0], mode="device")
-            if arg_ce is None:
-                return None
+            if kind in ("hll", "distinct_count_approx") and isinstance(
+                call.args[0], ast.FieldRef
+            ):
+                hcol = HLL_COL_PREFIX + call.args[0].name
+                arg_ce = CompiledExpr(
+                    lambda cols, _h=hcol: cols[_h], {hcol}, "device"
+                )
+            else:
+                arg_ce = try_compile(call.args[0], mode="device")
+                if arg_ce is None:
+                    return None
             columns |= arg_ce.columns
         filter_ce: Optional[CompiledExpr] = None
         if call.filter is not None:

@@ -151,9 +151,12 @@ class DeviceGroupBy:
         """
         import jax.numpy as jnp
 
+        from .aggspec import materialize_hll_columns
+
         n = len(slots)
         mb = self.micro_batch
         valid = valid or {}
+        cols = materialize_hll_columns(self.plan.columns, cols, n)
         for start in range(0, max(n, 1), mb):
             end = min(start + mb, n)
             cnt = end - start

@@ -8,8 +8,9 @@ streaming- and ICI-friendly.
 
 Accuracy notes:
 - HLL with m=256 registers: ~6.5% standard error on distinct counts.
-- log-histogram percentiles with B bins over [1e-9, 1e12): relative error
-  set by gamma = (1e21)^(1/(B-2)); B=1024 → ~4.8%.
+- signed log-histogram percentiles: B bins split into negative/zero/positive
+  ranges over magnitude [1e-9, 1e12); relative error set by
+  gamma = (1e21)^(2/(B-3)); B=1024 → ~4.9% (sqrt(gamma)-1).
 - count-min (d=4): overestimates by at most eps*N with eps = e/w.
 """
 from __future__ import annotations
@@ -76,24 +77,37 @@ def hll_estimate(registers):
 
 
 # --------------------------------------------------------------- log histogram
-_GAMMA = (_HIST_HI / _HIST_LO) ** (1.0 / (HIST_BINS - 2))
+# Signed layout (ascending value order, so cumsum quantiles work directly):
+#   bins [0 .. HALF-1]        negative values, most negative first
+#   bin  [HALF]               zeros
+#   bins [HALF+1 .. 2*HALF]   positive values, ascending
+_HIST_HALF = (HIST_BINS - 1) // 2
+_GAMMA = (_HIST_HI / _HIST_LO) ** (1.0 / (_HIST_HALF - 1))
 _LOG_GAMMA = float(np.log(_GAMMA))
 
 
+def _mag_bin(mag):
+    """Log bin of a magnitude in [0, HALF-1]."""
+    import jax.numpy as jnp
+
+    clamped = jnp.clip(mag, _HIST_LO, _HIST_HI * 0.999)
+    idx = jnp.floor(jnp.log(clamped / _HIST_LO) / _LOG_GAMMA).astype(jnp.int32)
+    return jnp.clip(idx, 0, _HIST_HALF - 1)
+
+
 def hist_bin(values):
-    """Map positive float values to log-spaced bins [1, B-1]; bin 0 holds
-    zeros/negatives (clamped)."""
+    """Map float values (any sign) to the signed log-bin layout above."""
     import jax.numpy as jnp
 
     v = jnp.asarray(values, jnp.float32)
-    clamped = jnp.clip(v, _HIST_LO, _HIST_HI * 0.999)
-    idx = jnp.floor(jnp.log(clamped / _HIST_LO) / _LOG_GAMMA).astype(jnp.int32) + 1
-    idx = jnp.clip(idx, 1, HIST_BINS - 1)
-    return jnp.where(v > 0, idx, 0)
+    mag = _mag_bin(jnp.abs(v))
+    pos = _HIST_HALF + 1 + mag
+    neg = _HIST_HALF - 1 - mag
+    return jnp.where(v > 0, pos, jnp.where(v < 0, neg, _HIST_HALF))
 
 
 def hist_quantile(hist, frac: float):
-    """Vectorized quantile from per-key histograms (..., B)."""
+    """Vectorized quantile from per-key signed histograms (..., B)."""
     import jax.numpy as jnp
 
     total = jnp.sum(hist, axis=-1)
@@ -102,12 +116,15 @@ def hist_quantile(hist, frac: float):
     # first bin where cum >= target
     ge = cum >= jnp.maximum(target, 1e-9)
     idx = jnp.argmax(ge, axis=-1)
-    # bin center (geometric mean of bin edges); bin 0 = nonpositive -> 0
-    lo_edge = _HIST_LO * jnp.exp((idx.astype(jnp.float32) - 1.0) * _LOG_GAMMA)
-    center = lo_edge * float(np.sqrt(_GAMMA))
-    return jnp.where(
-        total > 0, jnp.where(idx > 0, center, 0.0), jnp.nan
+    # bin center (geometric mean of bin edges), sign by layout position
+    mag_idx = jnp.where(
+        idx > _HIST_HALF, idx - _HIST_HALF - 1, _HIST_HALF - 1 - idx
+    ).astype(jnp.float32)
+    center = _HIST_LO * jnp.exp(mag_idx * _LOG_GAMMA) * float(np.sqrt(_GAMMA))
+    val = jnp.where(
+        idx == _HIST_HALF, 0.0, jnp.where(idx > _HIST_HALF, center, -center)
     )
+    return jnp.where(total > 0, val, jnp.nan)
 
 
 # ----------------------------------------------------------------- count-min
@@ -159,12 +176,30 @@ class CountMinSketch:
     def update(self, values: np.ndarray) -> None:
         import jax.numpy as jnp
 
-        v = jnp.asarray(np.asarray(values, dtype=np.float32))
+        arr = np.asarray(values, dtype=np.float32)
+        v = jnp.asarray(arr)
         w = jnp.ones(len(values), dtype=jnp.float32)
         self.counts = self._update(self.counts, v, w)
-        if len(self.candidates) < self.max_candidates:
-            for x in np.unique(np.asarray(values, dtype=np.float32)):
-                self.candidates.setdefault(float(x), True)
+        new = [
+            float(x) for x in np.unique(arr) if float(x) not in self.candidates
+        ]
+        if not new:
+            return
+        if len(self.candidates) + len(new) <= self.max_candidates:
+            for x in new:
+                self.candidates[x] = True
+            return
+        # saturated: keep the max_candidates values with the highest sketch
+        # estimates, so a late-arriving frequent value can displace a rare
+        # incumbent instead of being silently untrackable forever
+        cand = np.concatenate([
+            np.fromiter(self.candidates.keys(), dtype=np.float32,
+                        count=len(self.candidates)),
+            np.asarray(new, dtype=np.float32),
+        ])
+        ests = np.asarray(self._query(self.counts, jnp.asarray(cand)))
+        keep = np.argsort(-ests)[: self.max_candidates]
+        self.candidates = {float(cand[i]): True for i in keep}
 
     def heavy_hitters(self, k: int):
         if not self.candidates:

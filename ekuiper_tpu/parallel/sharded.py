@@ -232,8 +232,11 @@ class ShardedGroupBy:
         import jax
         import jax.numpy as jnp
 
+        from ..ops.aggspec import materialize_hll_columns
+
         n = len(slots)
         mb = self.micro_batch
+        cols = materialize_hll_columns(self.plan.columns, cols, n)
         for start in range(0, max(n, 1), mb):
             end = min(start + mb, n)
             cnt = end - start

@@ -55,6 +55,7 @@ class Node:
             except queue.Full:
                 try:
                     dropped = self.inq.get_nowait()
+                    self.inq.task_done()  # dropped items count as handled
                     self.stats.inc_exception("buffer full, dropped oldest")
                     logger.debug("%s: buffer full, dropped %r", self.name, type(dropped))
                 except queue.Empty:
@@ -66,7 +67,15 @@ class Node:
 
     # --------------------------------------------------------------- lifecycle
     def open(self) -> None:
+        """Synchronous setup (on_open) on the caller thread, then start the
+        worker. Matches the reference where source.Open subscribes before
+        Topo.Open returns — data published right after open() is never lost."""
         self._stop.clear()
+        err = safe_run(self.on_open)
+        if err is not None:
+            if self._topo is not None:
+                self._topo.drain_error(err, self.name)
+            return
         self._thread = threading.Thread(
             target=self._run_safe, name=f"node-{self.name}", daemon=True
         )
@@ -74,7 +83,10 @@ class Node:
 
     def close(self) -> None:
         self._stop.set()
-        self.inq.put(None)  # wake the worker
+        try:
+            self.inq.put_nowait(None)  # wake the worker (it also polls at 0.2s)
+        except queue.Full:
+            pass
 
     def join(self, timeout: float = 5.0) -> None:
         if self._thread is not None:
@@ -86,17 +98,21 @@ class Node:
             self._topo.drain_error(err, self.name)
 
     def _run(self) -> None:
-        self.on_open()
+        self.on_worker_start()
         try:
             while not self._stop.is_set():
                 try:
                     item = self.inq.get(timeout=0.2)
                 except queue.Empty:
                     continue
-                if item is None:
-                    continue
-                self.stats.set_buffer_length(self.inq.qsize())
-                self._dispatch(item)
+                try:
+                    if item is None:
+                        continue
+                    self.stats.set_buffer_length(self.inq.qsize())
+                    self._dispatch(item)
+                finally:
+                    # unfinished_tasks accounting backs Topo.wait_idle()
+                    self.inq.task_done()
         finally:
             self.on_close()
 
@@ -123,7 +139,13 @@ class Node:
 
     # ------------------------------------------------------------- overridables
     def on_open(self) -> None:
-        pass
+        """Synchronous setup on the opener's thread (subscriptions, timers).
+        Must be fast — Topo.open() blocks on it. Slow work (jit warmup)
+        belongs in on_worker_start."""
+
+    def on_worker_start(self) -> None:
+        """First action on the worker thread, before the dispatch loop —
+        e.g. warmup compiles that must not block Topo.open()."""
 
     def on_close(self) -> None:
         pass

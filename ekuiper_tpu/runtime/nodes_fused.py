@@ -18,7 +18,13 @@ import numpy as np
 
 from ..data.batch import ColumnBatch
 from ..data.rows import GroupedTuples, GroupedTuplesSet, Tuple, WindowRange
-from ..ops.aggspec import KernelPlan, _call_key
+from ..ops.aggspec import (
+    HLL_COL_PREFIX,
+    KernelPlan,
+    _call_key,
+    _hll_encode_numeric,
+    hash_column_for_hll,
+)
 from ..ops.groupby import DeviceGroupBy
 from ..ops.keytable import KeyTable
 from ..sql import ast
@@ -26,19 +32,6 @@ from ..utils import timex
 from ..utils.infra import logger
 from .events import EOF, Trigger
 from .node import Node
-
-
-def _hash_object_column(col: np.ndarray) -> np.ndarray:
-    """Distinct-preserving stable hash of string/object values into float32
-    (for hll over identifier columns). Uses crc32 — stable across processes
-    so checkpointed registers stay consistent after restore."""
-    import zlib
-
-    uniq, inverse = np.unique(col.astype("U"), return_inverse=True)
-    hashes = np.fromiter(
-        (zlib.crc32(u.encode()) for u in uniq), dtype=np.uint32, count=len(uniq)
-    ).astype(np.float32)
-    return hashes[inverse]
 
 
 class FusedWindowAggNode(Node):
@@ -80,13 +73,6 @@ class FusedWindowAggNode(Node):
         self._rows_in_window = 0
         self._spec_keys = [_call_key(s.call) for s in plan.specs]
         self._dtypes_seen = False
-        # columns feeding hll specs directly: string values get host-hashed
-        # to float32 (distinct-preserving) instead of coerced to NaN
-        self._hash_cols = {
-            next(iter(s.arg.columns))
-            for s in plan.specs
-            if "hll" in s.components and s.arg is not None and len(s.arg.columns) == 1
-        }
 
     # --------------------------------------------------------------- lifecycle
     def on_open(self) -> None:
@@ -96,6 +82,8 @@ class FusedWindowAggNode(Node):
         # first window boundary is anchored at open time, not compile-end
         if self.wt in (ast.WindowType.TUMBLING_WINDOW, ast.WindowType.HOPPING_WINDOW):
             self._schedule_next_tick()
+
+    def on_worker_start(self) -> None:
         self._warmup()
 
     def _warmup(self) -> None:
@@ -175,20 +163,32 @@ class FusedWindowAggNode(Node):
         cols: Dict[str, np.ndarray] = {}
         valid: Dict[str, np.ndarray] = {}
         for name in self.plan.columns:
+            if name.startswith(HLL_COL_PREFIX):
+                # derived hashed copy for hll; raw column stays numeric for
+                # any other spec / WHERE / FILTER that shares it
+                raw = name[len(HLL_COL_PREFIX):]
+                col = sub.columns.get(raw)
+                if col is None:
+                    cols[name] = np.full(sub.n, np.nan, dtype=np.float32)
+                elif col.dtype == np.object_:
+                    cols[name] = hash_column_for_hll(col)
+                else:
+                    cols[name] = _hll_encode_numeric(col)
+                v = sub.valid.get(raw)
+                if v is not None:
+                    valid[name] = v
+                continue
             col = sub.columns.get(name)
             if col is None:
                 cols[name] = np.full(sub.n, np.nan, dtype=np.float32)
                 continue
             if col.dtype == np.object_:
-                if name in self._hash_cols:
-                    cols[name] = _hash_object_column(col)
-                else:
-                    # mixed/object numeric column: coerce, NaN for bad rows
-                    coerced = np.full(sub.n, np.nan, dtype=np.float32)
-                    for i, v in enumerate(col):
-                        if isinstance(v, (int, float)) and not isinstance(v, bool):
-                            coerced[i] = v
-                    cols[name] = coerced
+                # mixed/object numeric column: coerce, NaN for bad rows
+                coerced = np.full(sub.n, np.nan, dtype=np.float32)
+                for i, v in enumerate(col):
+                    if isinstance(v, (int, float)) and not isinstance(v, bool):
+                        coerced[i] = v
+                cols[name] = coerced
             else:
                 cols[name] = col
             v = sub.valid.get(name)

@@ -73,6 +73,22 @@ class Topo:
         for node in self.all_nodes():
             node.join(timeout=2.0)
 
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        """Block until every node's input queue is drained AND no node is
+        mid-dispatch (queue.unfinished_tasks == 0). Emissions happen while the
+        emitting node's task is still unfinished, so a snapshot where all
+        counts are zero means no data is in flight anywhere in the DAG.
+        Deterministic replacement for sleep()-based settling in tests."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        nodes = self.all_nodes()
+        while _time.monotonic() < deadline:
+            if all(n.inq.unfinished_tasks == 0 for n in nodes):
+                return True
+            _time.sleep(0.002)
+        return False
+
     def drain_error(self, err: BaseException, origin: str = "") -> None:
         logger.error("rule %s node %s failed: %s", self.rule_id, origin, err)
         try:

@@ -432,6 +432,16 @@ class Parser:
         self.ts.expect(OP, ")")
         call = ast.Call(name=lname, args=args, func_id=self._func_id)
         self._func_id += 1
+        # parse-time arg validation against the function registry, mirroring
+        # the reference's parseCall -> binder lookup (parser.go:889)
+        if lname not in WINDOW_FUNCS:
+            from ..functions import registry as _freg
+
+            fd = _freg.lookup(lname)
+            if fd is not None and fd.val is not None:
+                err = fd.val(args)
+                if err:
+                    raise ParseError(f"{lname}: {err}")
         # FILTER ( WHERE expr )
         if self.ts.at_keyword("FILTER"):
             self.ts.next()

@@ -110,7 +110,7 @@ class TestFusedTumbling:
                 {"deviceId": "b", "temperature": 30.0},
             ])
             mock_clock.advance(20)  # flush micro-batch (linger)
-            time.sleep(0.3)  # let the fold thread drain
+            topo.wait_idle()  # deterministic: all in-flight batches folded
             mock_clock.advance(10_000)  # window fires
             results = wait_results(sink, 1)
             assert len(results) == 1
@@ -120,7 +120,7 @@ class TestFusedTumbling:
             # next window: only new data
             feed([{"deviceId": "a", "temperature": 50.0}])
             mock_clock.advance(20)
-            time.sleep(0.3)
+            topo.wait_idle()
             mock_clock.advance(10_000)
             results = wait_results(sink, 2)
             got2 = {r["deviceId"]: r for r in results[1]} if isinstance(results[1], list) else {results[1]["deviceId"]: results[1]}
@@ -142,7 +142,7 @@ class TestFusedTumbling:
                 {"deviceId": "hot", "temperature": 30.0},
             ])
             mock_clock.advance(20)
-            time.sleep(0.3)
+            topo.wait_idle()
             mock_clock.advance(10_000)
             results = wait_results(sink, 1)
             assert len(results) == 1
@@ -198,7 +198,7 @@ class TestHostWindows:
                 {"deviceId": "a", "temperature": 30.0},
             ])
             mock_clock.advance(20)
-            time.sleep(0.2)
+            topo.wait_idle()
             mock_clock.advance(10_000)
             results = wait_results(sink, 1)
             row = results[0] if isinstance(results[0], dict) else results[0][0]

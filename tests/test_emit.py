@@ -130,7 +130,7 @@ class TestDirectEmitE2E:
             for d, t in [("a", 5.0), ("b", 50.0), ("c", 25.0)]:
                 mem.publish("t/d", {"deviceId": d, "temperature": t})
             mock_clock.advance(20)
-            time.sleep(0.3)
+            topo.wait_idle()
             mock_clock.advance(10_000)
             deadline = time.time() + 5
             while not got and time.time() < deadline:

@@ -178,3 +178,104 @@ class TestShardedSketch:
         d_outs, _ = gb.finalize(d_state, kt.n_keys)
         np.testing.assert_array_equal(s_outs[0], d_outs[0])  # same registers -> same estimate
         np.testing.assert_array_equal(s_outs[1], d_outs[1])
+
+
+class TestSketchRegressions:
+    """Regressions from code review: shared-column corruption, cross-batch
+    hash consistency, signed percentiles, heavy_hitters validation."""
+
+    def test_hll_does_not_corrupt_shared_column(self):
+        # avg(v) and hll(v) over the SAME column: avg must see raw numerics
+        # even when the batch dtype is object (mixed stream)
+        plan = _plan(
+            "SELECT hll(v), avg(v) FROM s GROUP BY k, TUMBLINGWINDOW(ss, 10)"
+        )
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=64)
+        kt = KeyTable(8)
+        vals = np.array([10.0, 20.0, 30.0, "oops"], dtype=np.object_)
+        slots, _ = kt.encode_column(np.array(["a"] * 4, dtype=np.object_))
+        # object column reaches fold as in FusedWindowAggNode: raw coerced
+        coerced = np.array([10.0, 20.0, 30.0, np.nan], dtype=np.float32)
+        state = gb.fold(gb.init_state(), {"v": coerced}, slots)
+        outs, _ = gb.finalize(state, kt.n_keys)
+        avg_idx = next(
+            i for i, s in enumerate(plan.specs) if s.kind == "avg"
+        )
+        assert outs[avg_idx][0] == 20.0  # mean of raw values, not hashes
+
+    def test_hll_consistent_across_batch_dtypes(self):
+        # the same numeric value must fold to the same register whether its
+        # micro-batch inferred float32 or object dtype
+        plan = _plan("SELECT hll(v) FROM s GROUP BY k, TUMBLINGWINDOW(ss, 10)")
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=64)
+        kt = KeyTable(8)
+        slots, _ = kt.encode_column(np.array(["a"] * 3, dtype=np.object_))
+        state = gb.init_state()
+        # batch 1: clean float batch
+        state = gb.fold(state, {"v": np.array([1.0, 2.0, 3.0], dtype=np.float32)}, slots)
+        # batch 2: same values but object dtype (one stray string elsewhere)
+        state = gb.fold(state, {"v": np.array([1.0, 2.0, 3.0], dtype=np.object_)}, slots)
+        outs, _ = gb.finalize(state, kt.n_keys)
+        assert 2 <= outs[0][0] <= 4  # ~3 distinct, NOT ~6
+
+    def test_percentile_negative_values(self):
+        plan = _plan(
+            "SELECT percentile_approx(v, 0.5) FROM s GROUP BY k, TUMBLINGWINDOW(ss, 10)"
+        )
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=256)
+        kt = KeyTable(8)
+        vals = np.linspace(-30.0, -5.0, 101).astype(np.float32)
+        slots, _ = kt.encode_column(np.array(["a"] * len(vals), dtype=np.object_))
+        state = gb.fold(gb.init_state(), {"v": vals}, slots)
+        outs, _ = gb.finalize(state, kt.n_keys)
+        med = float(outs[0][0])
+        assert -19.5 <= med <= -15.5, med  # true median -17.5, ~5% bins
+
+    def test_percentile_mixed_sign(self):
+        plan = _plan(
+            "SELECT percentile_approx(v, 0.5) FROM s GROUP BY k, TUMBLINGWINDOW(ss, 10)"
+        )
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=256)
+        kt = KeyTable(8)
+        vals = np.array([-10.0] * 40 + [0.0] * 30 + [10.0] * 40, dtype=np.float32)
+        slots, _ = kt.encode_column(np.array(["a"] * len(vals), dtype=np.object_))
+        state = gb.fold(gb.init_state(), {"v": vals}, slots)
+        outs, _ = gb.finalize(state, kt.n_keys)
+        assert abs(float(outs[0][0])) < 1e-6  # median is the zero bin
+
+    def test_heavy_hitters_arity_rejected_at_parse(self):
+        from ekuiper_tpu.sql.parser import ParseError
+
+        with pytest.raises(ParseError, match="heavy_hitters"):
+            parse_select("SELECT heavy_hitters(v) FROM s GROUP BY COUNTWINDOW(5)")
+
+    def test_heavy_hitters_unhashable_values(self):
+        from ekuiper_tpu.functions.funcs_sketch import f_heavy_hitters
+
+        rows = [{"a": 1}, {"a": 1}, {"b": 2}]
+        out = f_heavy_hitters([rows, 2], None)
+        assert out[0]["count"] == 2
+
+    def test_hll_large_integer_ids(self):
+        # ~1e9-range IDs differ below float32 resolution; encoding must not
+        # collapse them (and int vs object batches must agree)
+        plan = _plan("SELECT hll(v) FROM s GROUP BY k, TUMBLINGWINDOW(ss, 10)")
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=256)
+        kt = KeyTable(8)
+        ids = np.arange(1_000_000_000, 1_000_000_100, dtype=np.int64)
+        slots, _ = kt.encode_column(np.array(["a"] * len(ids), dtype=np.object_))
+        state = gb.init_state()
+        state = gb.fold(state, {"v": ids}, slots)                       # int batch
+        state = gb.fold(state, {"v": ids.astype(np.object_)}, slots)   # object batch
+        outs, _ = gb.finalize(state, kt.n_keys)
+        est = int(outs[0][0])
+        assert 75 <= est <= 130, est  # ~100 distinct, not ~3 or ~200
+
+    def test_countmin_late_heavy_hitter_displaces(self):
+        from ekuiper_tpu.ops.sketches import CountMinSketch
+
+        cms = CountMinSketch(depth=4, width=8192, max_candidates=8)
+        cms.update(np.arange(8, dtype=np.float32))       # fill candidates
+        cms.update(np.full(50, 99.0, dtype=np.float32))  # late frequent value
+        top = cms.heavy_hitters(1)
+        assert top and top[0][0] == 99.0, top
