@@ -79,6 +79,11 @@ def register_def(fd: FunctionDef) -> None:
     _registry[fd.name.lower()] = fd
 
 
+def unregister(name: str) -> None:
+    """Remove a function (plugin uninstall)."""
+    _registry.pop(name.lower(), None)
+
+
 def add_provider(provider: Callable[[str], Optional[FunctionDef]]) -> None:
     """Later-chance providers: plugins, external services, JS — the ordered
     factory chain of the reference binder."""

@@ -24,6 +24,13 @@ def register_lookup(name: str, factory: Callable[[], Any]) -> None:
     _lookups[name.lower()] = factory
 
 
+def unregister(name: str) -> None:
+    """Remove a connector type from all tables (plugin uninstall)."""
+    _sources.pop(name.lower(), None)
+    _sinks.pop(name.lower(), None)
+    _lookups.pop(name.lower(), None)
+
+
 def create_source(name: str):
     _ensure()
     f = _sources.get(name.lower())

@@ -1,0 +1,237 @@
+"""Portable-plugin host manager — analogue of
+internal/plugin/portable/ (manager.go, plugin_ins_manager.go:235).
+
+Responsibilities:
+- plugin registry: name -> {executable, sources, sinks, functions}, persisted
+  in the KV store ("plugin" namespace) like the reference's plugin db
+- process supervision: GetOrStartProcess semantics — spawn the worker,
+  handshake over the control channel, serialize control commands, restart a
+  dead worker on next use, KillAll on shutdown (server.go:329)
+- binder wiring: declared symbols are registered into the io / function
+  registries so rules can reference them like builtins (binder chain,
+  internal/binder/factory.go:58-61)
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..utils.infra import EngineError, logger
+from . import ipc
+
+
+@dataclass
+class PluginMeta:
+    name: str
+    executable: str  # path to the worker entrypoint (python script)
+    language: str = "python"
+    version: str = ""
+    sources: List[str] = field(default_factory=list)
+    sinks: List[str] = field(default_factory=list)
+    functions: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "executable": self.executable,
+            "language": self.language, "version": self.version,
+            "sources": self.sources, "sinks": self.sinks,
+            "functions": self.functions,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PluginMeta":
+        return PluginMeta(
+            name=d["name"], executable=d["executable"],
+            language=d.get("language", "python"), version=d.get("version", ""),
+            sources=list(d.get("sources", [])), sinks=list(d.get("sinks", [])),
+            functions=list(d.get("functions", [])),
+        )
+
+
+class PluginIns:
+    """A running worker process + its control channel.
+
+    Control discipline is strict request/reply under a mutex, matching the
+    reference's per-plugin REQ/REP serialization (connection.go:139-148).
+    """
+
+    def __init__(self, meta: PluginMeta) -> None:
+        self.meta = meta
+        self.proc: Optional[subprocess.Popen] = None
+        self.ctrl = None
+        self._mu = threading.Lock()
+
+    def start(self) -> None:
+        url = ipc.ipc_url(f"plugin_{self.meta.name}")
+        self.ctrl = ipc.Socket(ipc.PAIR)
+        self.ctrl.listen(url)
+        cmd = [sys.executable, self.meta.executable] if self.meta.language == "python" \
+            else [self.meta.executable]
+        env = dict(os.environ)
+        repo_root = os.path.abspath(
+            os.path.join(os.path.dirname(__file__), "..", ".."))
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        self.proc = subprocess.Popen(cmd, env=env)
+        # handshake: worker dials and reports status (plugin_ins_manager.go:263)
+        try:
+            hello = json.loads(self.ctrl.recv(15_000))
+        except Exception as e:
+            self.kill()
+            raise EngineError(f"plugin {self.meta.name} handshake failed: {e}")
+        if hello.get("status") != "ok":
+            self.kill()
+            raise EngineError(f"plugin {self.meta.name} bad handshake: {hello}")
+        logger.info("portable plugin %s started (pid %s)", self.meta.name, self.proc.pid)
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def command(self, cmd: str, ctrl: Dict[str, Any], timeout_ms: int = 10_000) -> Any:
+        with self._mu:
+            if not self.alive():
+                raise EngineError(f"plugin {self.meta.name} process is dead")
+            try:
+                self.ctrl.send(json.dumps({"cmd": cmd, "ctrl": ctrl}).encode(),
+                               timeout_ms)
+                reply = json.loads(self.ctrl.recv(timeout_ms))
+            except Exception:
+                # A timed-out reply would desynchronize the strict req/rep
+                # channel (the late reply answers the NEXT command) — the only
+                # safe recovery is to kill the worker; it respawns on next use.
+                self.kill()
+                raise
+        if reply.get("state") != "ok":
+            raise EngineError(
+                f"plugin {self.meta.name} {cmd} failed: {reply.get('result')}")
+        return reply.get("result")
+
+    def kill(self) -> None:
+        if self.ctrl is not None:
+            try:
+                self.ctrl.close()
+            except Exception:
+                pass
+            self.ctrl = None
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=3)
+        self.proc = None
+
+
+class PortableManager:
+    """Singleton plugin registry + instance supervisor."""
+
+    _instance: Optional["PortableManager"] = None
+
+    def __init__(self, store=None) -> None:
+        self._store_kv = store.kv("plugin") if store is not None else None
+        self._metas: Dict[str, PluginMeta] = {}
+        self._ins: Dict[str, PluginIns] = {}
+        self._mu = threading.Lock()
+        self._start_locks: Dict[str, threading.Lock] = {}  # per-plugin spawn lock
+        if self._store_kv is not None:
+            for name in self._store_kv.keys():
+                try:
+                    meta = PluginMeta.from_dict(json.loads(self._store_kv.get(name)))
+                    self._metas[name] = meta
+                    self._bind(meta)
+                except Exception as e:
+                    logger.warning("plugin %s restore failed: %s", name, e)
+
+    # ---------------------------------------------------------------- registry
+    @classmethod
+    def global_instance(cls) -> "PortableManager":
+        if cls._instance is None:
+            cls._instance = PortableManager()
+        return cls._instance
+
+    @classmethod
+    def set_global(cls, mgr: "PortableManager") -> None:
+        cls._instance = mgr
+
+    def register(self, meta: PluginMeta, overwrite: bool = False) -> None:
+        with self._mu:
+            if meta.name in self._metas and not overwrite:
+                raise EngineError(f"plugin {meta.name} already registered")
+            if not os.path.exists(meta.executable):
+                raise EngineError(f"plugin executable {meta.executable} not found")
+            self._metas[meta.name] = meta
+            if self._store_kv is not None:
+                self._store_kv.set(meta.name, json.dumps(meta.to_dict()))
+        self._bind(meta)
+
+    def _bind(self, meta: PluginMeta) -> None:
+        from .portable import bind_symbols
+
+        bind_symbols(self, meta)
+
+    def get(self, name: str) -> Optional[PluginMeta]:
+        return self._metas.get(name)
+
+    def list(self) -> List[str]:
+        return sorted(self._metas.keys())
+
+    def delete(self, name: str) -> None:
+        with self._mu:
+            meta = self._metas.pop(name, None)
+            if self._store_kv is not None:
+                self._store_kv.delete(name)
+            ins = self._ins.pop(name, None)
+        if meta is not None:
+            from .portable import unbind_symbols
+
+            unbind_symbols(meta)
+        if ins:
+            ins.kill()
+
+    # -------------------------------------------------------------- processes
+    def _start_lock(self, name: str) -> threading.Lock:
+        with self._mu:
+            lock = self._start_locks.get(name)
+            if lock is None:
+                lock = self._start_locks[name] = threading.Lock()
+            return lock
+
+    def get_or_start(self, name: str) -> PluginIns:
+        """GetOrStartProcess (plugin_ins_manager.go:235): reuse a live worker,
+        restart a dead one. Spawns are serialized per plugin so concurrent
+        callers can't kill an instance mid-handshake."""
+        with self._start_lock(name):
+            with self._mu:
+                meta = self._metas.get(name)
+                if meta is None:
+                    raise EngineError(f"plugin {name} not installed")
+                ins = self._ins.get(name)
+            if ins is not None and ins.alive():
+                return ins
+            if ins is not None:
+                ins.kill()
+            ins = PluginIns(meta)
+            ins.start()
+            with self._mu:
+                self._ins[name] = ins
+            return ins
+
+    def get_live(self, name: str) -> Optional[PluginIns]:
+        """Live instance or None — never spawns (used by teardown paths)."""
+        with self._mu:
+            ins = self._ins.get(name)
+        return ins if ins is not None and ins.alive() else None
+
+    def kill_all(self) -> None:
+        with self._mu:
+            ins_list = list(self._ins.values())
+            self._ins.clear()
+        for ins in ins_list:
+            ins.kill()

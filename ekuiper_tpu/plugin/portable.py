@@ -1,0 +1,267 @@
+"""Host-side portable symbol wrappers — analogue of
+internal/plugin/portable/runtime/{function,source,sink}.go.
+
+PortableFunc   SQL function backed by a plugin worker; strict req/rep over a
+               PAIR channel, cached per symbol and hot-restartable
+               (function.go:29-41,106-134)
+PortableSource io.Source: host listens PULL, worker pushes JSON tuples
+               (connection.go:182-200)
+PortableSink   io.Sink: host pushes rows, worker pulls (connection.go:225)
+
+Channel naming matches the SDK side (sdk/runtime.py): the host picks the
+meta (ruleId/opId/instanceId) so both ends derive the same ipc url.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ..utils.infra import EngineError, logger
+from . import ipc
+
+
+class PortableFunc:
+    """Callable façade used by the function registry. One instance per symbol,
+    shared across rules (reference: cached singleton, function.go:29-41)."""
+
+    def __init__(self, manager, plugin_name: str, symbol: str) -> None:
+        self.manager = manager
+        self.plugin_name = plugin_name
+        self.symbol = symbol
+        self._sock = None
+        self._ins = None  # the PluginIns the channel was built against
+        self._mu = threading.Lock()
+
+    def _ensure(self) -> None:
+        ins = self.manager.get_or_start(self.plugin_name)
+        if self._sock is not None and ins is self._ins and ins.alive():
+            return
+        # worker was (re)started — rebuild the data channel and re-announce
+        # the symbol (hot reload semantics, function.go:29-41)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except Exception:
+                pass
+            self._sock = None
+        sock = ipc.Socket(ipc.PAIR)
+        sock.listen(ipc.ipc_url(f"func_{self.symbol}"))
+        try:
+            ins.command("start", {
+                "symbolName": self.symbol, "pluginType": "function", "meta": {},
+            })
+        except Exception:
+            sock.close()
+            raise
+        self._sock = sock
+        self._ins = ins
+
+    def _req(self, func: str, args: List[Any], timeout_ms: int = 10_000) -> Any:
+        with self._mu:
+            payload = json.dumps({"func": func, "args": args},
+                                 default=str).encode()
+            for attempt in (0, 1):
+                self._ensure()
+                try:
+                    self._sock.send(payload, timeout_ms)
+                    reply = json.loads(self._sock.recv(timeout_ms))
+                    break
+                except (ipc.IpcClosed, ipc.IpcTimeout, OSError):
+                    # peer died mid-call: drop the channel; one respawn retry
+                    try:
+                        self._sock.close()
+                    except Exception:
+                        pass
+                    self._sock = None
+                    self._ins = None
+                    if attempt:
+                        raise
+        if reply.get("state") != "ok":
+            raise EngineError(f"portable func {self.symbol}: {reply.get('result')}")
+        return reply.get("result")
+
+    def exec(self, *args: Any) -> Any:
+        return self._req("Exec", list(args) + [{"ruleId": "", "opId": ""}])
+
+    def validate(self, args: List[Any]) -> Any:
+        return self._req("Validate", args)
+
+    def is_aggregate(self) -> bool:
+        return bool(self._req("IsAggregate", []))
+
+    def close(self) -> None:
+        with self._mu:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+
+
+class PortableSource:
+    """io.Source contract over a plugin worker."""
+
+    def __init__(self, manager, plugin_name: str, symbol: str) -> None:
+        self.manager = manager
+        self.plugin_name = plugin_name
+        self.symbol = symbol
+        self.datasource = ""
+        self.props: Dict[str, Any] = {}
+        self._meta = {"ruleId": uuid.uuid4().hex[:8], "opId": self.symbol,
+                      "instanceId": 0}
+        self._sock = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.datasource = datasource or ""
+        self.props = props or {}
+
+    def _announce(self) -> "object":
+        """(Re)start the symbol on the worker; returns the live PluginIns."""
+        ins = self.manager.get_or_start(self.plugin_name)
+        ins.command("start", {
+            "symbolName": self.symbol, "pluginType": "source",
+            "meta": self._meta, "dataSource": self.datasource,
+            "config": self.props,
+        })
+        return ins
+
+    def open(self, ingest) -> None:
+        tag = f"{self._meta['ruleId']}_{self._meta['opId']}_{self._meta['instanceId']}"
+        self._sock = ipc.Socket(ipc.PULL)
+        self._sock.listen(ipc.ipc_url(f"source_{tag}"))
+        ins = self._announce()
+
+        def loop() -> None:
+            worker = ins
+            idle_ms = 0
+            while not self._stop.is_set():
+                try:
+                    raw = self._sock.recv(500)
+                    idle_ms = 0
+                except ipc.IpcTimeout:
+                    idle_ms += 500
+                    # supervise: if the worker died, respawn and re-announce
+                    # (reference restarts plugin processes on demand,
+                    # plugin_ins_manager.go:235)
+                    if idle_ms >= 1000 and not worker.alive():
+                        try:
+                            worker = self._announce()
+                            idle_ms = 0
+                        except Exception as e:
+                            logger.warning("portable source %s respawn failed: %s",
+                                           self.symbol, e)
+                    continue
+                except (ipc.IpcClosed, OSError):
+                    break
+                try:
+                    ingest(json.loads(raw))
+                except Exception as e:
+                    logger.warning("portable source %s ingest error: %s",
+                                   self.symbol, e)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"psrc-{self.symbol}")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        ins = self.manager.get_live(self.plugin_name)  # never spawn on teardown
+        if ins is not None:
+            try:
+                ins.command("stop", {"symbolName": self.symbol,
+                                     "pluginType": "source", "meta": self._meta})
+            except Exception:
+                pass
+        if self._sock is not None:
+            self._sock.close()
+
+
+class PortableSink:
+    """io.Sink contract over a plugin worker."""
+
+    def __init__(self, manager, plugin_name: str, symbol: str) -> None:
+        self.manager = manager
+        self.plugin_name = plugin_name
+        self.symbol = symbol
+        self.props: Dict[str, Any] = {}
+        self._meta = {"ruleId": uuid.uuid4().hex[:8], "opId": self.symbol,
+                      "instanceId": 0}
+        self._sock = None
+
+    def configure(self, props: Dict[str, Any]) -> None:
+        self.props = props or {}
+
+    def connect(self) -> None:
+        tag = f"{self._meta['ruleId']}_{self._meta['opId']}_{self._meta['instanceId']}"
+        self._sock = ipc.Socket(ipc.PUSH)
+        self._sock.listen(ipc.ipc_url(f"sink_{tag}"))
+        ins = self.manager.get_or_start(self.plugin_name)
+        ins.command("start", {
+            "symbolName": self.symbol, "pluginType": "sink",
+            "meta": self._meta, "config": self.props,
+        })
+
+    def collect(self, item: Any) -> None:
+        if self._sock is None:
+            self.connect()
+        self._sock.send(json.dumps(item, default=str).encode(), 5000)
+
+    def close(self) -> None:
+        ins = self.manager.get_live(self.plugin_name)  # never spawn on teardown
+        if ins is not None:
+            try:
+                ins.command("stop", {"symbolName": self.symbol,
+                                     "pluginType": "sink", "meta": self._meta})
+            except Exception:
+                pass
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+
+# symbols each plugin actually bound (builtins shadow plugin names, so this
+# can be a subset of the declared lists) — consulted on uninstall
+_bound: Dict[str, Dict[str, List[str]]] = {}
+
+
+def bind_symbols(manager, meta) -> None:
+    """Register a plugin's declared symbols into the io / function registries
+    (binder chain: builtin first, then portable — factory.go:58-61)."""
+    from ..functions import registry as func_registry
+    from ..io import registry as io_registry
+
+    bound = _bound.setdefault(meta.name, {"functions": [], "io": []})
+    for sym in meta.functions:
+        if func_registry.lookup(sym) is not None:
+            continue  # builtins win, like the weight-ordered binder chain
+        pf = PortableFunc(manager, meta.name, sym)
+        func_registry.register_def(func_registry.FunctionDef(
+            name=sym.lower(), ftype=func_registry.SCALAR,
+            exec=(lambda args, ctx, _pf=pf: _pf.exec(*args)),
+        ))
+        bound["functions"].append(sym.lower())
+    for sym in meta.sources:
+        io_registry.register_source(
+            sym, lambda _m=manager, _p=meta.name, _s=sym: PortableSource(_m, _p, _s))
+        bound["io"].append(sym.lower())
+    for sym in meta.sinks:
+        io_registry.register_sink(
+            sym, lambda _m=manager, _p=meta.name, _s=sym: PortableSink(_m, _p, _s))
+        bound["io"].append(sym.lower())
+
+
+def unbind_symbols(meta) -> None:
+    """Drop a deleted plugin's registry entries so names resolve to 'unknown'
+    again (and a future plugin may re-claim them)."""
+    from ..functions import registry as func_registry
+    from ..io import registry as io_registry
+
+    bound = _bound.pop(meta.name, None)
+    if bound is None:
+        return
+    for sym in bound["functions"]:
+        func_registry.unregister(sym)
+    for sym in bound["io"]:
+        io_registry.unregister(sym)

@@ -1,0 +1,21 @@
+"""Portable-plugin SDK — analogue of the reference Python SDK (sdk/python/ekuiper).
+
+A portable plugin is a separate process in any language that speaks the
+framed-IPC protocol (plugin/ipc.py). This SDK is the Python binding:
+
+    from ekuiper_tpu.sdk import Function, Source, Sink, plugin_main
+
+    class Rev(Function):
+        def exec(self, args, ctx): return args[0][::-1]
+
+    plugin_main({"name": "sample", "functions": {"rev": Rev},
+                 "sources": {...}, "sinks": {...}})
+
+Symbols are served on demand: the host sends start/stop-symbol commands over
+the control channel (reference: internal/plugin/portable/runtime/connection.go:56-122,
+sdk/python/ekuiper/runtime/plugin.py:32-50).
+"""
+from .api import Function, Sink, Source
+from .runtime import plugin_main
+
+__all__ = ["Function", "Source", "Sink", "plugin_main"]

@@ -1,0 +1,49 @@
+"""Plugin-side contract API — mirrors the reference SDK classes
+(sdk/python/ekuiper/function.py:21-37, source.py, sink.py)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+
+class Function:
+    """A SQL function served by this plugin (reference: function.py:21-37)."""
+
+    def validate(self, args: List[Any]) -> str:
+        """Return '' if args are acceptable, else an error message."""
+        return ""
+
+    def exec(self, args: List[Any], ctx: Dict[str, Any]) -> Any:
+        raise NotImplementedError
+
+    def is_aggregate(self) -> bool:
+        return False
+
+
+class Source:
+    """A push source served by this plugin (reference: source.py)."""
+
+    def configure(self, datasource: str, conf: Dict[str, Any]) -> None:
+        pass
+
+    def open(self, emit: Callable[[Any], None], closed: Callable[[], bool]) -> None:
+        """Run the ingest loop; call emit(dict) per tuple; poll closed()."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class Sink:
+    """A collector sink served by this plugin (reference: sink.py)."""
+
+    def configure(self, conf: Dict[str, Any]) -> None:
+        pass
+
+    def open(self) -> None:
+        pass
+
+    def collect(self, data: Any) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,182 @@
+"""Plugin-side runtime loop — analogue of sdk/python/ekuiper/runtime/plugin.py.
+
+Dials the host's control channel, handshakes, then serves start/stop-symbol
+commands. Each started symbol runs in its own thread:
+
+  function  PAIR  dial ipc host endpoint; loop: recv {"func","args"} ->
+            reply {"state","result"}  (reference: runtime/function.py)
+  source    PUSH  dial; run Source.open(emit) pushing JSON tuples
+  sink      PULL  dial; loop recv JSON rows -> Sink.collect
+
+Wire protocol (JSON frames, reference: portable/runtime/function.go:106-134):
+  control command  {"cmd": "start"|"stop", "ctrl": {symbolName, pluginType,
+                    meta:{ruleId,opId,instanceId}, dataSource, config}}
+  control reply    {"state": "ok"} | {"state": "error", "result": msg}
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import traceback
+from typing import Any, Dict
+
+from ..plugin import ipc
+
+
+def _reply_ok(sock) -> None:
+    sock.send(json.dumps({"state": "ok"}).encode())
+
+
+def _reply_err(sock, msg: str) -> None:
+    sock.send(json.dumps({"state": "error", "result": msg}).encode())
+
+
+class _SymbolRunner:
+    def __init__(self, name: str, kind: str, inst: Any, ctrl: Dict[str, Any]) -> None:
+        self.name = name
+        self.kind = kind
+        self.inst = inst
+        self.ctrl = ctrl
+        self.stopped = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True, name=f"sym-{name}")
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def stop(self) -> None:
+        self.stopped.set()
+        try:
+            self.inst.close()
+        except Exception:
+            pass
+
+    def _channel_url(self) -> str:
+        meta = self.ctrl.get("meta") or {}
+        if self.kind == "function":
+            return ipc.ipc_url(f"func_{self.ctrl['symbolName']}")
+        tag = f"{meta.get('ruleId','r')}_{meta.get('opId','o')}_{meta.get('instanceId',0)}"
+        return ipc.ipc_url(f"{self.kind}_{tag}")
+
+    def _run(self) -> None:
+        try:
+            if self.kind == "function":
+                self._run_function()
+            elif self.kind == "source":
+                self._run_source()
+            else:
+                self._run_sink()
+        except (ipc.IpcClosed, ipc.IpcTimeout):
+            pass
+        except Exception:
+            traceback.print_exc()
+
+    def _run_function(self) -> None:
+        sock = ipc.Socket(ipc.PAIR)
+        sock.dial(self._channel_url(), 10_000)
+        try:
+            while not self.stopped.is_set():
+                try:
+                    raw = sock.recv(500)
+                except ipc.IpcTimeout:
+                    continue
+                req = json.loads(raw)
+                fname, fargs = req.get("func"), req.get("args", [])
+                try:
+                    if fname == "Validate":
+                        err = self.inst.validate(fargs)
+                        res = {"state": "ok" if not err else "error", "result": err}
+                    elif fname == "Exec":
+                        ctx = fargs[-1] if fargs else {}
+                        res = {"state": "ok", "result": self.inst.exec(fargs[:-1], ctx)}
+                    elif fname == "IsAggregate":
+                        res = {"state": "ok", "result": self.inst.is_aggregate()}
+                    else:
+                        res = {"state": "error", "result": f"unknown func {fname}"}
+                except Exception as e:
+                    res = {"state": "error", "result": str(e)}
+                sock.send(json.dumps(res, default=str).encode())
+        finally:
+            sock.close()
+
+    def _run_source(self) -> None:
+        sock = ipc.Socket(ipc.PUSH)
+        sock.dial(self._channel_url(), 10_000)
+        self.inst.configure(self.ctrl.get("dataSource", ""), self.ctrl.get("config") or {})
+
+        def emit(data: Any) -> None:
+            sock.send(json.dumps(data, default=str).encode())
+
+        try:
+            self.inst.open(emit, self.stopped.is_set)
+        finally:
+            sock.close()
+
+    def _run_sink(self) -> None:
+        sock = ipc.Socket(ipc.PULL)
+        sock.dial(self._channel_url(), 10_000)
+        self.inst.configure(self.ctrl.get("config") or {})
+        self.inst.open()
+        try:
+            while not self.stopped.is_set():
+                try:
+                    raw = sock.recv(500)
+                except ipc.IpcTimeout:
+                    continue
+                self.inst.collect(json.loads(raw))
+        finally:
+            sock.close()
+
+
+def plugin_main(spec: Dict[str, Any]) -> None:
+    """Serve the plugin until the host closes the control channel.
+
+    spec: {"name": str, "functions": {sym: class}, "sources": {...}, "sinks": {...}}
+    """
+    name = spec["name"]
+    ctrl_sock = ipc.Socket(ipc.PAIR)
+    ctrl_sock.dial(ipc.ipc_url(f"plugin_{name}"), 15_000)
+    # handshake (reference: plugin connects then reports status)
+    ctrl_sock.send(json.dumps({"status": "ok", "name": name}).encode())
+
+    runners: Dict[str, _SymbolRunner] = {}
+    kinds = {"functions": "function", "sources": "source", "sinks": "sink"}
+    try:
+        while True:
+            try:
+                raw = ctrl_sock.recv(1000)
+            except ipc.IpcTimeout:
+                continue
+            cmd = json.loads(raw)
+            op, ctrl = cmd.get("cmd"), cmd.get("ctrl") or {}
+            sym = ctrl.get("symbolName", "")
+            if op == "start":
+                kind_key = ctrl.get("pluginType", "functions")
+                kind = kinds.get(kind_key, kind_key)
+                reg = spec.get(kind_key) or spec.get(kind + "s") or {}
+                cls = reg.get(sym)
+                if cls is None:
+                    _reply_err(ctrl_sock, f"symbol {sym} not found in plugin {name}")
+                    continue
+                key = f"{sym}:{json.dumps(ctrl.get('meta') or {}, sort_keys=True)}"
+                runner = _SymbolRunner(sym, kind, cls(), ctrl)
+                runners[key] = runner
+                runner.start()
+                _reply_ok(ctrl_sock)
+            elif op == "stop":
+                key = f"{sym}:{json.dumps(ctrl.get('meta') or {}, sort_keys=True)}"
+                r = runners.pop(key, None)
+                if r:
+                    r.stop()
+                _reply_ok(ctrl_sock)
+            elif op == "ping":
+                _reply_ok(ctrl_sock)
+            else:
+                _reply_err(ctrl_sock, f"unknown cmd {op}")
+    except (ipc.IpcClosed, KeyboardInterrupt):
+        pass
+    finally:
+        for r in runners.values():
+            r.stop()
+        ctrl_sock.close()
+        sys.exit(0)

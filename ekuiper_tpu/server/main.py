@@ -25,6 +25,11 @@ def start_up(config_path: str | None = None, block: bool = True):
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
     store = kv.setup(cfg.store.type, cfg.store.path)
+    # portable plugin manager (restores installed plugins + binds symbols,
+    # reference: server.go:218-226 binder init)
+    from ..plugin.manager import PortableManager
+
+    PortableManager.set_global(PortableManager(store))
     api = RestApi(store)
     api.rules.recover()
     server = serve(api, cfg.basic.rest_ip, cfg.basic.rest_port)
@@ -34,6 +39,7 @@ def start_up(config_path: str | None = None, block: bool = True):
     def shutdown(*_args) -> None:
         logger.info("shutting down")
         api.rules.stop_all()
+        PortableManager.global_instance().kill_all()  # server.go:329 KillAll
         server.shutdown()
         stop_event.set()
 

@@ -96,6 +96,33 @@ class RestApi:
           lambda m: self.trials.start(m["id"]))
         r("GET", r"^/ruletest/(?P<id>[^/]+)$", lambda m: self.trials.results(m["id"]))
         r("DELETE", r"^/ruletest/(?P<id>[^/]+)$", lambda m: self.trials.stop(m["id"]))
+        # portable plugins (reference: rest.go plugin routes)
+        r("GET", r"^/plugins/portables$", lambda m: self._plugins().list())
+        r("POST", r"^/plugins/portables$", self.install_plugin)
+        r("GET", r"^/plugins/portables/(?P<name>[^/]+)$", self.describe_plugin)
+        r("DELETE", r"^/plugins/portables/(?P<name>[^/]+)$",
+          lambda m: self._plugins().delete(m["name"]) or f"Plugin {m['name']} is deleted.")
+
+    # ---------------------------------------------------------------- plugins
+    @staticmethod
+    def _plugins():
+        from ..plugin.manager import PortableManager
+
+        return PortableManager.global_instance()
+
+    def install_plugin(self, m, body: Optional[dict] = None) -> str:
+        from ..plugin.manager import PluginMeta
+
+        if not body or "name" not in body or "executable" not in body:
+            raise ParseError("body must contain name and executable")
+        self._plugins().register(PluginMeta.from_dict(body))
+        return f"Plugin {body['name']} is created."
+
+    def describe_plugin(self, m) -> Dict[str, Any]:
+        meta = self._plugins().get(m["name"])
+        if meta is None:
+            raise EngineError(f"plugin {m['name']} not found")
+        return meta.to_dict()
 
     def _route(self, method: str, pattern: str, fn: Callable) -> None:
         self.routes.append((method, re.compile(pattern), fn))

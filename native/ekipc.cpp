@@ -1,0 +1,408 @@
+// ekipc — framed message transport over unix-domain / TCP sockets.
+//
+// Native analogue of the reference's nanomsg (NNG) layer
+// (reference: pkg/nng/sock.go:37-148, internal/plugin/portable/runtime/connection.go)
+// re-designed for the TPU build's host<->plugin-worker boundary:
+//   PAIR      bidirectional, single peer (control + function channels)
+//   PUSH/PULL one-way; the PULL side fans-in frames from N dialed peers
+//             (plugin sources push micro-batches into the host)
+//
+// Wire format: 4-byte little-endian length prefix + payload.
+// The host always listens (creates the ipc:// endpoint), workers dial —
+// mirroring CreateSourceChannel / CreateSinkChannel / CreateFunctionChannel
+// (connection.go:182-225).
+//
+// Exported C ABI (ctypes-friendly):
+//   int  eks_new(int proto)                     proto: 0 PAIR, 1 PUSH, 2 PULL
+//   int  eks_listen(int s, const char *url)
+//   int  eks_dial(int s, const char *url, int timeout_ms)
+//   int  eks_send(int s, const void *buf, int len, int timeout_ms)
+//   long eks_recv(int s, unsigned char **out, int timeout_ms)  // malloc'd; free with eks_free_msg
+//   void eks_free_msg(unsigned char *p)
+//   int  eks_close(int s)
+// Return codes: >=0 ok; -1 error; -2 timeout; -3 closed/EOF; -4 bad handle.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <sys/un.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr int EK_OK = 0, EK_ERR = -1, EK_TIMEOUT = -2, EK_CLOSED = -3, EK_BADH = -4;
+constexpr uint32_t MAX_FRAME = 1u << 30;  // 1 GiB sanity bound
+
+enum Proto { PAIR = 0, PUSH = 1, PULL = 2 };
+
+struct Conn {
+  int fd = -1;
+  // partial-frame receive state (a poll may surface only part of a frame)
+  std::string inbuf;
+};
+
+struct Sock {
+  int proto = PAIR;
+  int listen_fd = -1;
+  std::string unlink_path;  // ipc path to remove on close
+  std::vector<Conn> conns;
+  std::mutex mu;        // state: conns vector, fds
+  std::mutex send_mu;   // serialize senders
+  std::mutex recv_mu;   // serialize receivers
+  bool closed = false;
+  int refs = 0;  // in-flight ops holding this Sock (guarded by g_mu)
+};
+
+std::mutex g_mu;
+std::vector<Sock *> g_socks;
+
+Sock *get(int h) {
+  std::lock_guard<std::mutex> l(g_mu);
+  if (h < 0 || h >= (int)g_socks.size()) return nullptr;
+  Sock *s = g_socks[h];
+  if (s) s->refs++;
+  return s;
+}
+
+void put(Sock *s) {
+  std::lock_guard<std::mutex> l(g_mu);
+  s->refs--;
+}
+
+// RAII guard so every exported entry point releases its ref on return.
+struct Ref {
+  Sock *s;
+  explicit Ref(Sock *sock) : s(sock) {}
+  ~Ref() {
+    if (s) put(s);
+  }
+};
+
+int64_t now_ms() {
+  struct timeval tv;
+  gettimeofday(&tv, nullptr);
+  return (int64_t)tv.tv_sec * 1000 + tv.tv_usec / 1000;
+}
+
+// url: "ipc:///tmp/x.ipc" or "tcp://127.0.0.1:5555"
+int parse_url(const char *url, struct sockaddr_storage *ss, socklen_t *slen,
+              int *family, std::string *ipc_path) {
+  std::string u(url ? url : "");
+  if (u.rfind("ipc://", 0) == 0) {
+    std::string path = u.substr(6);
+    auto *sa = (struct sockaddr_un *)ss;
+    if (path.size() + 1 > sizeof(sa->sun_path)) return EK_ERR;
+    memset(sa, 0, sizeof(*sa));
+    sa->sun_family = AF_UNIX;
+    memcpy(sa->sun_path, path.c_str(), path.size() + 1);
+    *slen = sizeof(sa->sun_family) + path.size() + 1;
+    *family = AF_UNIX;
+    *ipc_path = path;
+    return EK_OK;
+  }
+  if (u.rfind("tcp://", 0) == 0) {
+    std::string hp = u.substr(6);
+    auto colon = hp.rfind(':');
+    if (colon == std::string::npos) return EK_ERR;
+    std::string host = hp.substr(0, colon);
+    int port = atoi(hp.c_str() + colon + 1);
+    auto *sa = (struct sockaddr_in *)ss;
+    memset(sa, 0, sizeof(*sa));
+    sa->sin_family = AF_INET;
+    sa->sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host.c_str(), &sa->sin_addr) != 1) return EK_ERR;
+    *slen = sizeof(*sa);
+    *family = AF_INET;
+    return EK_OK;
+  }
+  return EK_ERR;
+}
+
+void set_nonblock(int fd, bool nb) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, nb ? (fl | O_NONBLOCK) : (fl & ~O_NONBLOCK));
+}
+
+// Blocking-with-deadline write of the whole buffer.
+int write_full(int fd, const uint8_t *buf, size_t len, int64_t deadline) {
+  size_t off = 0;
+  while (off < len) {
+    struct pollfd p{fd, POLLOUT, 0};
+    int64_t left = deadline - now_ms();
+    if (deadline >= 0 && left <= 0) return EK_TIMEOUT;
+    int pr = poll(&p, 1, deadline < 0 ? -1 : (int)left);
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return EK_ERR;
+    }
+    if (pr == 0) return EK_TIMEOUT;
+    ssize_t n = send(fd, buf + off, len - off, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      return (errno == EPIPE || errno == ECONNRESET) ? EK_CLOSED : EK_ERR;
+    }
+    off += (size_t)n;
+  }
+  return EK_OK;
+}
+
+// Try to pull whatever bytes are available into c->inbuf (nonblocking fd).
+// Returns EK_OK (made progress or nothing to read), EK_CLOSED on EOF.
+int drain_into(Conn *c) {
+  char tmp[65536];
+  for (;;) {
+    ssize_t n = recv(c->fd, tmp, sizeof(tmp), 0);
+    if (n > 0) {
+      c->inbuf.append(tmp, (size_t)n);
+      if (n < (ssize_t)sizeof(tmp)) return EK_OK;
+      continue;
+    }
+    if (n == 0) return EK_CLOSED;
+    if (errno == EAGAIN || errno == EWOULDBLOCK) return EK_OK;
+    if (errno == EINTR) continue;
+    return EK_CLOSED;
+  }
+}
+
+// If a full frame sits in c->inbuf, pop it into *out/*outlen (malloc'd).
+bool pop_frame(Conn *c, uint8_t **out, int64_t *outlen) {
+  if (c->inbuf.size() < 4) return false;
+  uint32_t len;
+  memcpy(&len, c->inbuf.data(), 4);
+  if (len > MAX_FRAME) {  // corrupt stream — drop connection semantics
+    *outlen = EK_ERR;
+    *out = nullptr;
+    return true;
+  }
+  if (c->inbuf.size() < 4 + (size_t)len) return false;
+  auto *p = (uint8_t *)malloc(len ? len : 1);
+  memcpy(p, c->inbuf.data() + 4, len);
+  c->inbuf.erase(0, 4 + (size_t)len);
+  *out = p;
+  *outlen = len;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+int eks_new(int proto) {
+  if (proto < PAIR || proto > PULL) return EK_ERR;
+  auto *s = new Sock();
+  s->proto = proto;
+  std::lock_guard<std::mutex> l(g_mu);
+  // reclaim a slot whose socket is closed and no longer referenced — keeps
+  // the table bounded under long-lived hosts that churn plugin channels
+  for (size_t i = 0; i < g_socks.size(); ++i) {
+    if (g_socks[i] && g_socks[i]->closed && g_socks[i]->refs == 0) {
+      delete g_socks[i];
+      g_socks[i] = s;
+      return (int)i;
+    }
+  }
+  g_socks.push_back(s);
+  return (int)g_socks.size() - 1;
+}
+
+int eks_listen(int h, const char *url) {
+  Sock *s = get(h);
+  Ref ref(s);
+  if (!s) return EK_BADH;
+  struct sockaddr_storage ss;
+  socklen_t slen;
+  int family;
+  std::string ipc_path;
+  if (parse_url(url, &ss, &slen, &family, &ipc_path) != EK_OK) return EK_ERR;
+  int fd = socket(family, SOCK_STREAM, 0);
+  if (fd < 0) return EK_ERR;
+  if (family == AF_UNIX && !ipc_path.empty()) unlink(ipc_path.c_str());
+  if (family == AF_INET) {
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  }
+  if (bind(fd, (struct sockaddr *)&ss, slen) < 0 || listen(fd, 64) < 0) {
+    close(fd);
+    return EK_ERR;
+  }
+  set_nonblock(fd, true);
+  std::lock_guard<std::mutex> l(s->mu);
+  s->listen_fd = fd;
+  s->unlink_path = ipc_path;
+  return EK_OK;
+}
+
+int eks_dial(int h, const char *url, int timeout_ms) {
+  Sock *s = get(h);
+  Ref ref(s);
+  if (!s) return EK_BADH;
+  struct sockaddr_storage ss;
+  socklen_t slen;
+  int family;
+  std::string ipc_path;
+  if (parse_url(url, &ss, &slen, &family, &ipc_path) != EK_OK) return EK_ERR;
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  // retry loop: the listener may not exist yet (worker started first)
+  for (;;) {
+    int fd = socket(family, SOCK_STREAM, 0);
+    if (fd < 0) return EK_ERR;
+    if (connect(fd, (struct sockaddr *)&ss, slen) == 0) {
+      set_nonblock(fd, true);
+      if (family == AF_INET) {
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      }
+      std::lock_guard<std::mutex> l(s->mu);
+      s->conns.push_back(Conn{fd, {}});
+      return EK_OK;
+    }
+    close(fd);
+    if (deadline >= 0 && now_ms() >= deadline) return EK_TIMEOUT;
+    usleep(20 * 1000);
+  }
+}
+
+static void accept_pending(Sock *s) {
+  if (s->listen_fd < 0) return;
+  for (;;) {
+    int c = accept(s->listen_fd, nullptr, nullptr);
+    if (c < 0) return;
+    set_nonblock(c, true);
+    s->conns.push_back(Conn{c, {}});
+  }
+}
+
+int eks_send(int h, const void *buf, int len, int timeout_ms) {
+  Sock *s = get(h);
+  Ref ref(s);
+  if (!s) return EK_BADH;
+  if (len < 0) return EK_ERR;
+  std::lock_guard<std::mutex> sl(s->send_mu);
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  int fd = -1;
+  for (;;) {
+    {
+      std::lock_guard<std::mutex> l(s->mu);
+      if (s->closed) return EK_CLOSED;
+      accept_pending(s);
+      // send to the most recent live connection (single-peer semantics;
+      // PUSH host->worker and PAIR both have exactly one peer)
+      if (!s->conns.empty()) fd = s->conns.back().fd;
+    }
+    if (fd >= 0) break;
+    if (deadline >= 0 && now_ms() >= deadline) return EK_TIMEOUT;
+    usleep(10 * 1000);
+  }
+  uint32_t hdr = (uint32_t)len;
+  std::string frame;
+  frame.reserve(4 + (size_t)len);
+  frame.append((char *)&hdr, 4);
+  frame.append((const char *)buf, (size_t)len);
+  int rc = write_full(fd, (const uint8_t *)frame.data(), frame.size(), deadline);
+  if (rc == EK_CLOSED) {
+    std::lock_guard<std::mutex> l(s->mu);
+    for (auto it = s->conns.begin(); it != s->conns.end(); ++it)
+      if (it->fd == fd) {
+        close(fd);
+        s->conns.erase(it);
+        break;
+      }
+  }
+  return rc;
+}
+
+int64_t eks_recv(int h, uint8_t **out, int timeout_ms) {
+  Sock *s = get(h);
+  Ref ref(s);
+  if (!s) return EK_BADH;
+  std::lock_guard<std::mutex> rl(s->recv_mu);
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  for (;;) {
+    std::vector<struct pollfd> pfds;
+    {
+      std::lock_guard<std::mutex> l(s->mu);
+      if (s->closed) return EK_CLOSED;
+      accept_pending(s);
+      // fast path: a complete frame may already be buffered
+      for (size_t i = 0; i < s->conns.size();) {
+        int64_t n;
+        uint8_t *p;
+        if (pop_frame(&s->conns[i], &p, &n)) {
+          if (n < 0) {  // corrupt stream — drop the connection, keep going
+            close(s->conns[i].fd);
+            s->conns.erase(s->conns.begin() + i);
+            continue;
+          }
+          *out = p;
+          return n;
+        }
+        ++i;
+      }
+      if (s->listen_fd >= 0) pfds.push_back({s->listen_fd, POLLIN, 0});
+      for (auto &c : s->conns) pfds.push_back({c.fd, POLLIN, 0});
+    }
+    int64_t left = deadline < 0 ? -1 : deadline - now_ms();
+    if (deadline >= 0 && left <= 0) return EK_TIMEOUT;
+    if (pfds.empty()) {
+      usleep(10 * 1000);  // nothing connected yet — wait for a dialer
+      continue;
+    }
+    int pr = poll(pfds.data(), pfds.size(), left < 0 ? 250 : (int)std::min<int64_t>(left, 250));
+    if (pr < 0 && errno != EINTR) return EK_ERR;
+    std::lock_guard<std::mutex> l(s->mu);
+    if (s->closed) return EK_CLOSED;
+    accept_pending(s);
+    for (size_t i = 0; i < s->conns.size();) {
+      Conn &c = s->conns[i];
+      int rc = drain_into(&c);
+      int64_t n;
+      uint8_t *p;
+      if (pop_frame(&c, &p, &n)) {
+        if (n < 0) {  // corrupt frame — kill connection
+          close(c.fd);
+          s->conns.erase(s->conns.begin() + i);
+          continue;
+        }
+        *out = p;
+        return n;
+      }
+      if (rc == EK_CLOSED && c.inbuf.size() < 4) {
+        close(c.fd);
+        s->conns.erase(s->conns.begin() + i);
+        // a PAIR peer hanging up means the channel is done
+        if (s->proto == PAIR && s->conns.empty() && s->listen_fd < 0) return EK_CLOSED;
+        continue;
+      }
+      ++i;
+    }
+  }
+}
+
+void eks_free_msg(uint8_t *p) { free(p); }
+
+int eks_close(int h) {
+  Sock *s = get(h);
+  Ref ref(s);
+  if (!s) return EK_BADH;
+  std::lock_guard<std::mutex> l(s->mu);
+  if (s->closed) return EK_OK;
+  s->closed = true;
+  if (s->listen_fd >= 0) close(s->listen_fd);
+  for (auto &c : s->conns) close(c.fd);
+  s->conns.clear();
+  if (!s->unlink_path.empty()) unlink(s->unlink_path.c_str());
+  return EK_OK;
+}
+
+}  // extern "C"

@@ -1,0 +1,51 @@
+"""Sample portable plugin used by tests — analogue of the reference's
+sdk/python/example/pysam plugin (mirror: revstr function, pyjson source,
+file-writing sink)."""
+import json
+import time
+
+from ekuiper_tpu.sdk import Function, Sink, Source, plugin_main
+
+
+class Rev(Function):
+    def exec(self, args, ctx):
+        return str(args[0])[::-1]
+
+
+class Add(Function):
+    def validate(self, args):
+        return "" if len(args) >= 2 else "add needs 2 args"
+
+    def exec(self, args, ctx):
+        return args[0] + args[1]
+
+
+class CountSource(Source):
+    def configure(self, datasource, conf):
+        self.count = int(conf.get("count", 5))
+        self.interval = float(conf.get("interval", 0.01))
+
+    def open(self, emit, closed):
+        for i in range(self.count):
+            if closed():
+                return
+            emit({"seq": i, "val": i * 10})
+            time.sleep(self.interval)
+
+
+class FileSink(Sink):
+    def configure(self, conf):
+        self.path = conf["path"]
+
+    def collect(self, data):
+        with open(self.path, "a") as f:
+            f.write(json.dumps(data) + "\n")
+
+
+if __name__ == "__main__":
+    plugin_main({
+        "name": "sample",
+        "functions": {"prev": Rev, "padd": Add},
+        "sources": {"pycount": CountSource},
+        "sinks": {"pyfile": FileSink},
+    })

@@ -1,0 +1,168 @@
+"""Portable plugin system tests — modeled on the reference's portable FVT
+(fvt/portable_test.go) and the plugin mock server
+(tools/plugin_server/plugin_test_server.go)."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from ekuiper_tpu.plugin import ipc
+from ekuiper_tpu.plugin.manager import PluginMeta, PortableManager
+from ekuiper_tpu.plugin.portable import PortableSink, PortableSource
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "sample_plugin.py")
+
+
+# ------------------------------------------------------------------ ipc layer
+@pytest.mark.parametrize("force_pure", [False, True])
+def test_ipc_pair_roundtrip(force_pure, monkeypatch, tmp_path):
+    sock_cls = ipc._PySocket if force_pure else None
+    mk = (lambda p: ipc._PySocket(p)) if force_pure else ipc.Socket
+    url = f"ipc://{tmp_path}/pair.ipc"
+    host = mk(ipc.PAIR)
+    host.listen(url)
+    results = []
+
+    def worker():
+        w = mk(ipc.PAIR)
+        w.dial(url, 2000)
+        w.send(b"ping")
+        results.append(w.recv(2000))
+        w.close()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    assert host.recv(2000) == b"ping"
+    host.send(b"pong")
+    t.join(timeout=5)
+    host.close()
+    assert results == [b"pong"]
+
+
+def test_ipc_pull_fan_in(tmp_path):
+    url = f"ipc://{tmp_path}/pull.ipc"
+    pull = ipc.Socket(ipc.PULL)
+    pull.listen(url)
+
+    def pusher(i):
+        p = ipc.Socket(ipc.PUSH)
+        p.dial(url, 2000)
+        for j in range(5):
+            p.send(f"{i}:{j}".encode())
+        time.sleep(0.2)
+        p.close()
+
+    ts = [threading.Thread(target=pusher, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    got = {pull.recv(3000).decode() for _ in range(15)}
+    for t in ts:
+        t.join(timeout=5)
+    pull.close()
+    assert got == {f"{i}:{j}" for i in range(3) for j in range(5)}
+
+
+def test_ipc_recv_timeout(tmp_path):
+    s = ipc.Socket(ipc.PAIR)
+    s.listen(f"ipc://{tmp_path}/t.ipc")
+    with pytest.raises(ipc.IpcTimeout):
+        s.recv(100)
+    s.close()
+
+
+# ---------------------------------------------------------------- full plugin
+@pytest.fixture
+def manager():
+    mgr = PortableManager()
+    PortableManager.set_global(mgr)
+    mgr.register(PluginMeta(
+        name="sample", executable=FIXTURE,
+        sources=["pycount"], sinks=["pyfile"], functions=["prev", "padd"],
+    ))
+    yield mgr
+    mgr.kill_all()
+
+
+def test_portable_function_exec(manager):
+    from ekuiper_tpu.functions import registry as freg
+
+    fd = freg.lookup("prev")
+    assert fd is not None
+    assert fd.exec(["hello"], {}) == "olleh"
+    assert freg.lookup("padd").exec([3, 4], {}) == 7
+
+
+def test_portable_function_worker_restart(manager):
+    from ekuiper_tpu.functions import registry as freg
+
+    assert freg.lookup("prev").exec(["ab"], {}) == "ba"
+    # kill the worker behind its back; next call must respawn it
+    ins = manager.get_or_start("sample")
+    ins.proc.kill()
+    ins.proc.wait(timeout=5)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            assert freg.lookup("prev").exec(["cd"], {}) == "dc"
+            break
+        except Exception:
+            time.sleep(0.2)
+    else:
+        pytest.fail("worker did not restart")
+
+
+def test_portable_source_ingest(manager):
+    src = PortableSource(manager, "sample", "pycount")
+    src.configure("", {"count": 8, "interval": 0.005})
+    got = []
+    src.open(lambda payload, meta=None: got.append(payload))
+    deadline = time.monotonic() + 10
+    while len(got) < 8 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    src.close()
+    assert [t["seq"] for t in got[:8]] == list(range(8))
+
+
+def test_portable_sink_collect(manager, tmp_path):
+    out = tmp_path / "sink.jsonl"
+    sink = PortableSink(manager, "sample", "pyfile")
+    sink.configure({"path": str(out)})
+    sink.connect()
+    for i in range(4):
+        sink.collect({"i": i})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if out.exists() and len(out.read_text().splitlines()) >= 4:
+            break
+        time.sleep(0.05)
+    sink.close()
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert [r["i"] for r in rows] == [0, 1, 2, 3]
+
+
+def test_delete_unbinds_symbols(manager):
+    from ekuiper_tpu.functions import registry as freg
+    from ekuiper_tpu.io import registry as ioreg
+
+    assert freg.lookup("prev") is not None
+    assert "pycount" in ioreg.source_types()
+    manager.delete("sample")
+    assert freg.lookup("prev") is None
+    assert "pycount" not in ioreg.source_types()
+
+
+def test_manager_registry_persistence(tmp_path):
+    from ekuiper_tpu.store.kv import Store
+
+    store = Store("memory", str(tmp_path))
+    mgr = PortableManager(store)
+    mgr.register(PluginMeta(name="p1", executable=FIXTURE, functions=["prev"]))
+    assert mgr.list() == ["p1"]
+    # new manager over same store restores the registry
+    mgr2 = PortableManager(store)
+    assert mgr2.list() == ["p1"]
+    assert mgr2.get("p1").functions == ["prev"]
+    mgr2.delete("p1")
+    assert mgr2.list() == []
