@@ -122,7 +122,7 @@ class FileSink(Sink):
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
-        self._fh = open(self.path, "a", buffering=1)
+        self._fh = open(self.path, "ab")
         self._written = 0
         self._opened_at = timex.now_ms()
 
@@ -144,11 +144,15 @@ class FileSink(Sink):
             self._open_file()
 
     def collect(self, item: Any) -> None:
-        line = json.dumps(item, default=str)
+        if isinstance(item, (bytes, bytearray)):
+            line = bytes(item)  # opaque payload (compressed/encrypted)
+        else:
+            line = json.dumps(item, default=str).encode()
         with self._lock:
             if self._fh is None:
                 self._open_file()
-            self._fh.write(line + "\n")
+            self._fh.write(line + b"\n")
+            self._fh.flush()
             self._written += len(line) + 1
             self._maybe_roll()
 

@@ -24,11 +24,22 @@ def register_lookup(name: str, factory: Callable[[], Any]) -> None:
     _lookups[name.lower()] = factory
 
 
-def unregister(name: str) -> None:
-    """Remove a connector type from all tables (plugin uninstall)."""
+def has_source(name: str) -> bool:
+    _ensure()
+    return name.lower() in _sources
+
+
+def has_sink(name: str) -> bool:
+    _ensure()
+    return name.lower() in _sinks
+
+
+def unregister_source(name: str) -> None:
     _sources.pop(name.lower(), None)
+
+
+def unregister_sink(name: str) -> None:
     _sinks.pop(name.lower(), None)
-    _lookups.pop(name.lower(), None)
 
 
 def create_source(name: str):

@@ -257,6 +257,18 @@ def plan_rule(rule: RuleDef, store) -> Topo:
             delimiter=stream.options.delimiter or ",",
             fields=[f.name for f in stream.fields] or None,
         )
+        if props.get("decompression"):
+            # bytes payloads are decompressed before FORMAT decode
+            # (reference: planner_source.go decompress stage)
+            from ..utils.codecs import get_compressor
+
+            _, decomp = get_compressor(props["decompression"])
+            converter = _DecompressingConverter(converter, decomp)
+        if props.get("decryption"):
+            from ..utils.codecs import get_encryptor
+
+            converter = _DecryptingConverter(
+                converter, get_encryptor(props["decryption"], props))
         src = SourceNode(
             tbl.ref_name if len(stmt.sources) > 1 or stmt.joins else tbl.name,
             connector,
@@ -269,6 +281,17 @@ def plan_rule(rule: RuleDef, store) -> Topo:
             buffer_length=opts.buffer_length,
         )
         topo.add_source(src)
+        # per-interval latest-batch throttle (planner_source.go:146). A
+        # dedicated prop, NOT `interval`: poll sources (file/httppull/
+        # simulator) already use `interval` as their poll period.
+        if props.get("rateLimitInterval"):
+            from ..runtime.nodes_chain import RateLimitNode
+
+            rl = RateLimitNode(f"{src.name}_ratelimit",
+                               interval_ms=int(props["rateLimitInterval"]),
+                               buffer_length=opts.buffer_length)
+            topo.add_op(rl)
+            src = src.connect(rl)
         source_nodes.append(src)
 
     kernel_plan = device_path_eligible(stmt, opts)
@@ -283,23 +306,129 @@ def plan_rule(rule: RuleDef, store) -> Topo:
     actions = rule.actions or [{"log": {}}]
     for i, action in enumerate(actions):
         for sink_type, props in action.items():
-            sink = io_registry.create_sink(sink_type)
-            sink.configure(props or {})
-            node = SinkNode(
-                f"{sink_type}_{i}",
-                sink,
-                send_single=bool((props or {}).get("sendSingle", False)),
-                fields=(props or {}).get("fields"),
-                exclude_fields=(props or {}).get("excludeFields"),
-                data_template=(props or {}).get("dataTemplate", ""),
-                omit_if_empty=bool((props or {}).get("omitIfEmpty", False)),
-                retry_count=int((props or {}).get("retryCount", 0)),
-                retry_interval_ms=int((props or {}).get("retryInterval", 1000)),
-                buffer_length=opts.buffer_length,
-            )
-            topo.add_sink(node)
-            tail.connect(node)
+            _build_sink_chain(topo, tail, sink_type, props or {}, i, opts,
+                              rule.id, store)
     return topo
+
+
+def _build_sink_chain(topo: Topo, tail, sink_type: str, props: Dict[str, Any],
+                      idx: int, opts: RuleOptionConfig, rule_id: str,
+                      store) -> None:
+    """Assemble the per-action sink chain (planner_sink.go:36-253):
+    [batch] → [encode] → [compress] → [encrypt] → [cache] → sink."""
+    from ..io.converters import get_converter
+    from ..runtime.nodes_chain import (
+        BatchNode, CacheNode, CompressNode, EncryptNode,
+    )
+
+    head = tail
+    batch_size = int(props.get("batchSize", 0))
+    linger_ms = int(props.get("lingerInterval", 0))
+    if batch_size > 0 or linger_ms > 0:
+        node = BatchNode(f"{sink_type}_{idx}_batch", size=batch_size,
+                         linger_ms=linger_ms, buffer_length=opts.buffer_length)
+        topo.add_op(node)
+        head = head.connect(node)
+    # bytes stages only make sense for bytes-capable sinks (file/mqtt/...);
+    # FORMAT-encoding for them happens inside the sink itself unless a
+    # compression/encryption stage forces an explicit encode here
+    compression = props.get("compression", "")
+    encryption = props.get("encryption", "")
+    transform_in_chain = bool(compression or encryption)
+    if transform_in_chain:
+        # transform must precede encode so the projected/templated payload is
+        # what gets compressed/encrypted (planner_sink.go chain order); the
+        # terminal SinkNode then passes opaque payloads through untouched
+        from ..runtime.nodes_chain import EncodeNode, TransformNode
+
+        tr = TransformNode(
+            f"{sink_type}_{idx}_transform",
+            send_single=bool(props.get("sendSingle", False)),
+            fields=props.get("fields"),
+            exclude_fields=props.get("excludeFields"),
+            data_template=props.get("dataTemplate", ""),
+            omit_if_empty=bool(props.get("omitIfEmpty", False)),
+            buffer_length=opts.buffer_length,
+        )
+        topo.add_op(tr)
+        head = head.connect(tr)
+        conv = get_converter(props.get("format", "json"))
+        enc = EncodeNode(f"{sink_type}_{idx}_encode", conv,
+                         buffer_length=opts.buffer_length)
+        topo.add_op(enc)
+        head = head.connect(enc)
+    if compression:
+        node = CompressNode(f"{sink_type}_{idx}_compress", compression,
+                            buffer_length=opts.buffer_length)
+        topo.add_op(node)
+        head = head.connect(node)
+    if encryption:
+        node = EncryptNode(f"{sink_type}_{idx}_encrypt", encryption, props,
+                           buffer_length=opts.buffer_length)
+        topo.add_op(node)
+        head = head.connect(node)
+    cache_node = None
+    if props.get("enableCache"):
+        cache_node = CacheNode(
+            f"{sink_type}_{idx}_cache",
+            store_kv=store.kv(f"sinkcache:{rule_id}:{sink_type}_{idx}"),
+            memory_threshold=int(props.get("memoryCacheThreshold", 1024)),
+            max_disk_cache=int(props.get("maxDiskCache", 1024 * 1024)),
+            resend_interval_ms=int(props.get("resendInterval", 100)),
+            buffer_length=opts.buffer_length,
+        )
+        topo.add_op(cache_node)
+        head = head.connect(cache_node)
+    sink = io_registry.create_sink(sink_type)
+    sink.configure(props)
+    node = SinkNode(
+        f"{sink_type}_{idx}",
+        sink,
+        send_single=(not transform_in_chain
+                     and bool(props.get("sendSingle", False))),
+        fields=None if transform_in_chain else props.get("fields"),
+        exclude_fields=(None if transform_in_chain
+                        else props.get("excludeFields")),
+        data_template=("" if transform_in_chain
+                       else props.get("dataTemplate", "")),
+        omit_if_empty=(not transform_in_chain
+                       and bool(props.get("omitIfEmpty", False))),
+        retry_count=int(props.get("retryCount", 0)),
+        retry_interval_ms=int(props.get("retryInterval", 1000)),
+        cache_node=cache_node,
+        buffer_length=opts.buffer_length,
+    )
+    topo.add_sink(node)
+    head.connect(node)
+
+
+class _DecompressingConverter:
+    """Wrap a FORMAT converter so bytes payloads are decompressed first
+    (reference: planner_source.go decompress stage)."""
+
+    def __init__(self, inner, decompress) -> None:
+        self._inner = inner
+        self._decompress = decompress
+
+    def decode(self, raw):
+        return self._inner.decode(self._decompress(bytes(raw)))
+
+    def encode(self, data):
+        return self._inner.encode(data)
+
+
+class _DecryptingConverter:
+    """Wrap a FORMAT converter so bytes payloads are decrypted first."""
+
+    def __init__(self, inner, encryptor) -> None:
+        self._inner = inner
+        self._enc = encryptor
+
+    def decode(self, raw):
+        return self._inner.decode(self._enc.decrypt(bytes(raw)))
+
+    def encode(self, data):
+        return self._inner.encode(data)
 
 
 def _source_props(stream: ast.StreamStmt, store) -> Dict[str, Any]:

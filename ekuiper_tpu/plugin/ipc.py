@@ -48,22 +48,44 @@ _lib_tried = False
 _lib_lock = threading.Lock()
 
 
+def _build_native() -> bool:
+    """Compile libekipc.so (invoked in a background thread via ensure_native,
+    never on a request path)."""
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+            capture_output=True, timeout=120, check=True,
+        )
+        return True
+    except Exception as e:  # toolchain unavailable — fall back
+        logger.warning("ekipc native build failed (%s); using pure-python ipc", e)
+        return False
+
+
+def ensure_native(background: bool = True) -> None:
+    """Kick off (or finish) the native build. Called at manager/server init so
+    the first plugin request never blocks on the compiler."""
+    so = os.path.abspath(os.path.join(_NATIVE_DIR, "build", "libekipc.so"))
+    if os.path.exists(so) or _lib_tried:
+        return
+    if background:
+        threading.Thread(target=_build_native, daemon=True,
+                         name="ekipc-build").start()
+    else:
+        _build_native()
+
+
 def _load_native() -> Optional[ctypes.CDLL]:
     global _lib, _lib_tried
     with _lib_lock:
         if _lib_tried:
             return _lib
-        _lib_tried = True
         so = os.path.abspath(os.path.join(_NATIVE_DIR, "build", "libekipc.so"))
         if not os.path.exists(so):
-            try:
-                subprocess.run(
-                    ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-                    capture_output=True, timeout=120, check=True,
-                )
-            except Exception as e:  # toolchain unavailable — fall back
-                logger.warning("ekipc native build failed (%s); using pure-python ipc", e)
-                return None
+            # not built yet: use the pure fallback for now, but keep probing —
+            # a background ensure_native build may finish later
+            return None
+        _lib_tried = True
         try:
             lib = ctypes.CDLL(so)
             lib.eks_new.restype = ctypes.c_int

@@ -135,6 +135,7 @@ class PortableManager:
     _instance: Optional["PortableManager"] = None
 
     def __init__(self, store=None) -> None:
+        ipc.ensure_native()  # build the C transport off the request path
         self._store_kv = store.kv("plugin") if store is not None else None
         self._metas: Dict[str, PluginMeta] = {}
         self._ins: Dict[str, PluginIns] = {}

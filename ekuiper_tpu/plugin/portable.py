@@ -232,7 +232,8 @@ def bind_symbols(manager, meta) -> None:
     from ..functions import registry as func_registry
     from ..io import registry as io_registry
 
-    bound = _bound.setdefault(meta.name, {"functions": [], "io": []})
+    bound = _bound.setdefault(meta.name, {"functions": [], "sources": [],
+                                          "sinks": []})
     for sym in meta.functions:
         if func_registry.lookup(sym) is not None:
             continue  # builtins win, like the weight-ordered binder chain
@@ -243,18 +244,22 @@ def bind_symbols(manager, meta) -> None:
         ))
         bound["functions"].append(sym.lower())
     for sym in meta.sources:
+        if io_registry.has_source(sym):
+            continue  # builtin connectors win too
         io_registry.register_source(
             sym, lambda _m=manager, _p=meta.name, _s=sym: PortableSource(_m, _p, _s))
-        bound["io"].append(sym.lower())
+        bound["sources"].append(sym.lower())
     for sym in meta.sinks:
+        if io_registry.has_sink(sym):
+            continue
         io_registry.register_sink(
             sym, lambda _m=manager, _p=meta.name, _s=sym: PortableSink(_m, _p, _s))
-        bound["io"].append(sym.lower())
+        bound["sinks"].append(sym.lower())
 
 
 def unbind_symbols(meta) -> None:
-    """Drop a deleted plugin's registry entries so names resolve to 'unknown'
-    again (and a future plugin may re-claim them)."""
+    """Drop exactly the entries this plugin bound (never builtins or another
+    plugin's) so names resolve to 'unknown' again."""
     from ..functions import registry as func_registry
     from ..io import registry as io_registry
 
@@ -263,5 +268,7 @@ def unbind_symbols(meta) -> None:
         return
     for sym in bound["functions"]:
         func_registry.unregister(sym)
-    for sym in bound["io"]:
-        io_registry.unregister(sym)
+    for sym in bound["sources"]:
+        io_registry.unregister_source(sym)
+    for sym in bound["sinks"]:
+        io_registry.unregister_sink(sym)

@@ -19,6 +19,41 @@ from .node import Node
 _TMPL_RE = re.compile(r"\{\{\s*\.(\w+)\s*\}\}")
 
 
+def apply_transform(msg: Dict[str, Any], fields=None, exclude_fields=None,
+                    data_template: str = "") -> Any:
+    """Field projection + dataTemplate rendering (transform_op.go)."""
+    if fields:
+        msg = {k: msg.get(k) for k in fields}
+    if exclude_fields:
+        msg = {k: v for k, v in msg.items() if k not in exclude_fields}
+    if data_template:
+        return _TMPL_RE.sub(lambda m: str(msg.get(m.group(1), "")), data_template)
+    return msg
+
+
+def to_messages(item: Any) -> List[Dict[str, Any]]:
+    """Normalize any runtime data item to a list of plain message dicts
+    (shared by SinkNode and the sink-chain EncodeNode)."""
+    if isinstance(item, list):
+        out: List[Dict[str, Any]] = []
+        for x in item:
+            out.extend(to_messages(x))
+        return out
+    if isinstance(item, Tuple):
+        return [item.all_values()]
+    if isinstance(item, GroupedTuplesSet):
+        return [g.all_values() for g in item.groups]
+    if isinstance(item, (WindowTuples,)):
+        return [r.all_values() for r in item.rows()]
+    if isinstance(item, ColumnBatch):
+        return [t.message for t in item.to_tuples()]
+    if isinstance(item, dict):
+        return [item]
+    if isinstance(item, Row):
+        return [item.all_values()]
+    return []
+
+
 class SinkNode(Node):
     def __init__(
         self,
@@ -31,9 +66,11 @@ class SinkNode(Node):
         omit_if_empty: bool = False,
         retry_count: int = 0,
         retry_interval_ms: int = 1000,
+        cache_node=None,  # upstream CacheNode for at-least-once nack feedback
         **kw,
     ) -> None:
         super().__init__(name, op_type="sink", **kw)
+        self.cache_node = cache_node
         self.sink = sink
         self.send_single = send_single
         self.fields = fields
@@ -42,6 +79,7 @@ class SinkNode(Node):
         self.omit_if_empty = omit_if_empty
         self.retry_count = retry_count
         self.retry_interval_ms = retry_interval_ms
+        self._current: Any = None  # item being processed (cache ack/nack key)
         self.results: List[Any] = []  # test/trial access
 
     def on_open(self) -> None:
@@ -55,6 +93,16 @@ class SinkNode(Node):
 
     # ------------------------------------------------------------------ data
     def process(self, item: Any) -> None:
+        # ack/nack to the cache always reference the PRE-transform item the
+        # cache emitted, so its in-flight tracking matches on resends
+        self._current = item
+        if isinstance(item, (bytes, bytearray, str)):
+            # opaque payloads: post-encode/compress bytes, rendered template
+            # strings — pass through untransformed
+            # (reference: bytes-collector sink variant, sink_node.go:197)
+            self._collect(bytes(item) if isinstance(item, (bytes, bytearray))
+                          else item)
+            return
         msgs = self._to_messages(item)
         if not msgs and self.omit_if_empty:
             return
@@ -66,35 +114,11 @@ class SinkNode(Node):
             self._collect(msgs if len(msgs) != 1 else msgs[0])
 
     def _to_messages(self, item: Any) -> List[Dict[str, Any]]:
-        if isinstance(item, list):
-            out: List[Dict[str, Any]] = []
-            for x in item:
-                out.extend(self._to_messages(x))
-            return out
-        if isinstance(item, Tuple):
-            return [item.all_values()]
-        if isinstance(item, GroupedTuplesSet):
-            return [g.all_values() for g in item.groups]
-        if isinstance(item, (WindowTuples,)):
-            return [r.all_values() for r in item.rows()]
-        if isinstance(item, ColumnBatch):
-            return [t.message for t in item.to_tuples()]
-        if isinstance(item, dict):
-            return [item]
-        if isinstance(item, Row):
-            return [item.all_values()]
-        return []
+        return to_messages(item)
 
     def _transform(self, msg: Dict[str, Any]) -> Any:
-        if self.fields:
-            msg = {k: msg.get(k) for k in self.fields}
-        if self.exclude_fields:
-            msg = {k: v for k, v in msg.items() if k not in self.exclude_fields}
-        if self.data_template:
-            return _TMPL_RE.sub(
-                lambda m: str(msg.get(m.group(1), "")), self.data_template
-            )
-        return msg
+        return apply_transform(msg, self.fields, self.exclude_fields,
+                               self.data_template)
 
     def _collect(self, payload: Any) -> None:
         attempts = 0
@@ -102,6 +126,8 @@ class SinkNode(Node):
         while True:
             try:
                 self.sink.collect(payload)
+                if self.cache_node is not None:
+                    self.cache_node.ack(self._current)  # drop spilled copy
                 self.results.append(payload)
                 if len(self.results) > 10000:
                     del self.results[:5000]
@@ -110,6 +136,11 @@ class SinkNode(Node):
                 attempts += 1
                 self.stats.inc_exception(str(exc))
                 if attempts > self.retry_count:
+                    if self.cache_node is not None:
+                        # at-least-once: park the item in the sink cache; its
+                        # resend loop re-delivers when the sink recovers
+                        self.cache_node.nack(self._current)
+                        return
                     raise
                 timex.sleep(delay)
                 delay = min(delay * 2, 30_000)

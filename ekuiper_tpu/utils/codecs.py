@@ -1,0 +1,102 @@
+"""Compression + encryption codecs — analogue of the reference's
+modules/compressor (gzip/zlib/flate/zstd) and modules/encryptor (aes)
+registries (SURVEY §2.6).
+
+All operate on bytes (they sit after the encode op in the sink chain,
+planner_sink.go:36-253).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import zlib
+from typing import Callable, Dict, Tuple
+
+# ----------------------------------------------------------------- compress
+_compressors: Dict[str, Tuple[Callable[[bytes], bytes], Callable[[bytes], bytes]]] = {}
+
+
+def register_compressor(name: str, compress, decompress) -> None:
+    _compressors[name.lower()] = (compress, decompress)
+
+
+register_compressor("gzip", gzip.compress, gzip.decompress)
+register_compressor("zlib", zlib.compress, zlib.decompress)
+# flate = raw DEFLATE (no zlib header), matching Go's compress/flate
+register_compressor(
+    "flate",
+    lambda b: zlib.compress(b)[2:-4],
+    lambda b: zlib.decompress(b, wbits=-zlib.MAX_WBITS),
+)
+
+try:
+    import zstandard as _zstd
+
+    register_compressor(
+        "zstd",
+        lambda b: _zstd.ZstdCompressor().compress(b),
+        lambda b: _zstd.ZstdDecompressor().decompress(b),
+    )
+except ImportError:  # zstd optional, like the reference's build tag
+    pass
+
+
+def get_compressor(name: str):
+    """-> (compress, decompress) or raises ValueError."""
+    pair = _compressors.get(name.lower())
+    if pair is None:
+        raise ValueError(f"unknown compression algorithm {name!r} "
+                         f"(have {sorted(_compressors)})")
+    return pair
+
+
+def compression_algorithms():
+    return sorted(_compressors)
+
+
+# ------------------------------------------------------------------ encrypt
+class AesEncryptor:
+    """AES encryptor/decryptor — analogue of modules/encryptor/aes.
+
+    Modes: gcm (default, key any of 16/24/32 bytes; output nonce||ct||tag)
+    and cfb (output iv||ct), mirroring the reference's aes modes.
+    """
+
+    def __init__(self, key: bytes, mode: str = "gcm") -> None:
+        if len(key) not in (16, 24, 32):
+            raise ValueError("aes key must be 16/24/32 bytes")
+        self.key = key
+        self.mode = mode.lower()
+        if self.mode not in ("gcm", "cfb"):
+            raise ValueError(f"unknown aes mode {mode!r}")
+
+    def encrypt(self, data: bytes) -> bytes:
+        from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+        if self.mode == "gcm":
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+            nonce = os.urandom(12)
+            return nonce + AESGCM(self.key).encrypt(nonce, data, None)
+        iv = os.urandom(16)
+        enc = Cipher(algorithms.AES(self.key), modes.CFB(iv)).encryptor()
+        return iv + enc.update(data) + enc.finalize()
+
+    def decrypt(self, data: bytes) -> bytes:
+        from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+        if self.mode == "gcm":
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+            return AESGCM(self.key).decrypt(data[:12], data[12:], None)
+        dec = Cipher(algorithms.AES(self.key), modes.CFB(data[:16])).decryptor()
+        return dec.update(data[16:]) + dec.finalize()
+
+
+def get_encryptor(name: str, props: dict) -> AesEncryptor:
+    if name.lower() != "aes":
+        raise ValueError(f"unknown encryption algorithm {name!r}")
+    key = props.get("key", "")
+    if isinstance(key, str):
+        key = key.encode()
+    return AesEncryptor(key, props.get("aesMode", props.get("mode", "gcm")))
