@@ -1,0 +1,370 @@
+"""Graph API planner — analogue of PlanByGraph
+(reference: internal/topo/planner/planner_graph.go:50-443).
+
+Rules defined as a Node-RED-style JSON DAG instead of SQL:
+
+    {"id": "g1", "graph": {
+        "nodes": {
+            "src":  {"type": "source",   "nodeType": "memory",
+                     "props": {"datasource": "t"}},
+            "flt":  {"type": "operator", "nodeType": "filter",
+                     "props": {"expr": "temperature > 20"}},
+            "out":  {"type": "sink",     "nodeType": "memory",
+                     "props": {"topic": "res"}}},
+        "topo": {"sources": ["src"],
+                 "edges": {"src": ["flt"], "flt": ["out"]}}}}
+
+Operator nodeTypes (planner_graph.go:118-240): filter, pick, function,
+aggfunc, window, groupby, orderby, having, join, switch, watermark,
+ratelimit, dedup_trigger. Light IO-kind compatibility checking mirrors
+internal/topo/graph/io.go:69 (row vs collection producers/consumers).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..io import registry as io_registry
+from ..io.converters import get_converter
+from ..runtime.nodes_chain import DedupTriggerNode, RateLimitNode
+from ..runtime.nodes_join import JoinNode
+from ..runtime.nodes_ops import (
+    AggregateNode, FilterNode, HavingNode, OrderNode, ProjectNode,
+)
+from ..runtime.node import Node
+from ..runtime.nodes_source import SourceNode
+from ..runtime.nodes_switch import SwitchNode
+from ..runtime.nodes_window import WatermarkNode, WindowNode
+from ..runtime.topo import Topo
+from ..sql import ast
+from ..sql.eval import Evaluator
+from ..sql.parser import Parser
+from ..utils.infra import PlanError
+from .planner import _build_sink_chain, merged_options
+
+
+# ------------------------------------------------------------ expr helpers
+def _parse_expr(text: str) -> ast.Expr:
+    return Parser(text).parse_expr()
+
+
+def _parse_fields(field_specs: List[str]) -> List[ast.Field]:
+    """Parse pick/function field specs: "expr [AS alias]" each — reuse the
+    SELECT-list grammar."""
+    p = Parser("SELECT " + ", ".join(field_specs) + " FROM __g")
+    stmt = p.parse_select()
+    return stmt.fields
+
+
+class _GraphFuncNode(Node):
+    """function/aggfunc operator: computes "expr as alias" and APPENDS the
+    result to rows (affiliate/cal column), unlike pick which projects
+    (reference: parseFunc, planner_graph.go:131-145)."""
+
+    def __init__(self, name: str, fields: List[ast.Field], is_agg: bool,
+                 **kw) -> None:
+        super().__init__(name, op_type="op", **kw)
+        self.fields = fields
+        self.is_agg = is_agg
+        self.ev = Evaluator()
+
+    def process(self, item: Any) -> None:
+        from ..data.batch import ColumnBatch
+        from ..data.rows import GroupedTuplesSet, Row, WindowTuples
+
+        if self.is_agg:
+            if isinstance(item, GroupedTuplesSet):
+                for g in item.groups:
+                    for f in self.fields:
+                        val = self.ev.eval(f.expr, g)
+                        for r in g.rows():
+                            r.set_cal_col(f.output_name, val)
+                self.emit(item)
+                return
+            if isinstance(item, WindowTuples):
+                for f in self.fields:
+                    val = self.ev.eval(f.expr, item)
+                    for r in item.rows():
+                        r.set_cal_col(f.output_name, val)
+                self.emit(item)
+                return
+            raise PlanError("aggfunc requires a window/grouped input")
+        rows: List[Row]
+        if isinstance(item, ColumnBatch):
+            rows = item.to_tuples()
+        elif isinstance(item, WindowTuples):
+            rows = list(item.rows())
+        elif isinstance(item, Row):
+            rows = [item]
+        else:
+            self.emit(item)
+            return
+        for r in rows:
+            for f in self.fields:
+                r.set_cal_col(f.output_name, self.ev.eval(f.expr, r))
+        if isinstance(item, ColumnBatch):
+            # cal-cols live on the materialized tuples, not the batch — emit
+            # the rows themselves
+            self.emit(rows, count=len(rows))
+        else:
+            self.emit(item)
+
+
+# ------------------------------------------------------------- IO kinds
+# producers: what flows out; consumers: what must flow in
+# "row" single rows/batches; "collection" windowed/grouped; "any" either
+_OP_IO = {
+    "filter": ("same", "any"),
+    "pick": ("same", "any"),
+    "function": ("same", "any"),
+    "aggfunc": ("collection", "collection"),
+    "window": ("collection", "row"),
+    "groupby": ("collection", "collection"),
+    "orderby": ("same", "collection"),
+    "having": ("collection", "collection"),
+    "join": ("collection", "collection"),
+    "switch": ("same", "any"),
+    "watermark": ("row", "row"),
+    "ratelimit": ("same", "any"),
+    "dedup_trigger": ("same", "any"),
+}
+
+
+def _check_io(graph: Dict[str, Any]) -> None:
+    """Propagate produced kinds along edges, reject impossible links
+    (analogue of graph.Fit, io.go:69)."""
+    nodes = graph["nodes"]
+    edges = graph.get("topo", {}).get("edges", {})
+    produced: Dict[str, str] = {}
+    for name, spec in nodes.items():
+        if spec.get("type") == "source":
+            produced[name] = "row"
+    # simple fixpoint over the DAG (small graphs)
+    for _ in range(len(nodes) + 1):
+        for frm, tos in edges.items():
+            if frm not in produced:
+                continue
+            for to in _flat(tos):
+                spec = nodes.get(to)
+                if spec is None:
+                    raise PlanError(f"edge to undefined node {to}")
+                if spec["type"] == "sink":
+                    continue
+                nt = (spec.get("nodeType") or "").lower()
+                out_kind, in_kind = _OP_IO.get(nt, ("any", "any"))
+                got = produced[frm]
+                if in_kind != "any" and got != "any" and got != in_kind:
+                    raise PlanError(
+                        f"node {to} ({nt}) expects {in_kind} input but "
+                        f"{frm} produces {got}")
+                produced[to] = got if out_kind == "same" else out_kind
+
+
+def _flat(tos: Any) -> List[str]:
+    out: List[str] = []
+    for t in tos:
+        if isinstance(t, list):
+            out.extend(t)
+        else:
+            out.append(t)
+    return out
+
+
+# --------------------------------------------------------------- planning
+def plan_by_graph(rule, store) -> Topo:
+    graph = rule.graph
+    if not graph:
+        raise PlanError("no graph")
+    nodes_spec = graph.get("nodes") or {}
+    topo_spec = graph.get("topo") or {}
+    src_names = topo_spec.get("sources") or []
+    edges = topo_spec.get("edges") or {}
+    if not src_names:
+        raise PlanError("graph has no sources")
+    _check_io(graph)
+
+    opts = merged_options(rule)
+    topo = Topo(rule.id, qos=opts.qos,
+                checkpoint_interval_ms=opts.checkpoint_interval_ms)
+    built: Dict[str, Any] = {}
+    sink_counter = [0]  # per-plan sink chain index
+
+    for name, spec in nodes_spec.items():
+        typ = spec.get("type")
+        nt = (spec.get("nodeType") or "").lower()
+        props = spec.get("props") or {}
+        if typ == "source":
+            if name not in edges:
+                raise PlanError(f"no edge defined for source node {name}")
+            connector = io_registry.create_source(nt)
+            connector.configure(props.get("datasource", ""), props)
+            conv = get_converter(props.get("format", "json"),
+                                 delimiter=props.get("delimiter", ","))
+            built[name] = SourceNode(
+                name, connector, converter=conv,
+                micro_batch_rows=opts.micro_batch_rows,
+                linger_ms=opts.micro_batch_linger_ms,
+                buffer_length=opts.buffer_length,
+            )
+            topo.add_source(built[name])
+        elif typ == "sink":
+            if name in edges:
+                raise PlanError(f"sink {name} has edge")
+            built[name] = ("sink", nt, props)  # assembled at wiring time
+        elif typ == "operator":
+            node = _build_operator(name, nt, props, opts, rule.id, store)
+            built[name] = node
+            topo.add_op(node)
+        else:
+            raise PlanError(f"unknown node type {typ!r} for {name}")
+
+    # wiring
+    for frm, tos in edges.items():
+        src = built.get(frm)
+        if src is None:
+            raise PlanError(f"edge from undefined node {frm}")
+        if isinstance(src, SwitchNode):
+            if not tos or not all(isinstance(t, list) for t in tos):
+                raise PlanError(
+                    f"switch {frm}: edges must be nested per-case lists, "
+                    f"e.g. [[\"a\"],[\"b\"]]")
+            for case_idx, case_tos in enumerate(tos):
+                if case_idx >= len(src.cases):
+                    raise PlanError(
+                        f"switch {frm}: more edge groups than cases")
+                for to in case_tos:
+                    dst = _sink_or_node(topo, built, to, opts, rule.id,
+                                        store, sink_counter)
+                    src.connect_case(case_idx, dst)
+        else:
+            for to in _flat(tos):
+                dst = _sink_or_node(topo, built, to, opts, rule.id, store,
+                                    sink_counter)
+                src.connect(dst)
+    return topo
+
+
+def _sink_or_node(topo, built, to, opts, rule_id, store, counter):
+    entry = built.get(to)
+    if entry is None:
+        raise PlanError(f"edge to undefined node {to}")
+    if isinstance(entry, tuple) and entry[0] == "sink":
+        _, nt, props = entry
+        # the chain is built on first use; later edges reuse its head node
+        tail = _Tail()
+        _build_sink_chain(topo, tail, nt, props, counter[0], opts,
+                          rule_id, store)
+        counter[0] += 1
+        built[to] = tail.head
+        return tail.head
+    return entry
+
+
+class _Tail:
+    """Shim standing in for the upstream of a sink chain: captures the chain's
+    first node so graph edges can connect to it."""
+
+    def __init__(self) -> None:
+        self.head = None
+
+    def connect(self, node):
+        if self.head is None:
+            self.head = node
+        return node
+
+
+def _build_operator(name: str, nt: str, props: Dict[str, Any], opts,
+                    rule_id: str, store):
+    if nt == "filter":
+        return FilterNode(name, _parse_expr(props["expr"]),
+                          buffer_length=opts.buffer_length)
+    if nt == "pick":
+        return ProjectNode(name, _parse_fields(props["fields"]),
+                           rule_id=rule_id, buffer_length=opts.buffer_length)
+    if nt in ("function", "aggfunc"):
+        expr = props.get("expr")
+        specs = [expr] if isinstance(expr, str) else list(expr)
+        return _GraphFuncNode(name, _parse_fields(specs), is_agg=nt == "aggfunc",
+                              buffer_length=opts.buffer_length)
+    if nt == "window":
+        return WindowNode(name, _parse_window(props),
+                          is_event_time=opts.is_event_time, rule_id=rule_id,
+                          buffer_length=opts.buffer_length)
+    if nt == "groupby":
+        dims = [_parse_expr(d) for d in props["dimensions"]]
+        return AggregateNode(name, dims, buffer_length=opts.buffer_length)
+    if nt == "orderby":
+        sorts = [ast.SortField(name=s["field"],
+                               ascending=not s.get("desc", False),
+                               expr=_parse_expr(s["field"]))
+                 for s in props["sorts"]]
+        return OrderNode(name, sorts, buffer_length=opts.buffer_length)
+    if nt == "having":
+        return HavingNode(name, _parse_expr(props["expr"]), rule_id=rule_id,
+                          buffer_length=opts.buffer_length)
+    if nt == "join":
+        stmt = _parse_join(props)
+        return JoinNode(name, stmt.joins, left_name=stmt.sources[0].ref_name,
+                        buffer_length=opts.buffer_length)
+    if nt == "switch":
+        cases = [_parse_expr(c) for c in props["cases"]]
+        return SwitchNode(name, cases,
+                          stop_at_first_match=bool(props.get("stopAtFirstMatch")),
+                          buffer_length=opts.buffer_length)
+    if nt == "watermark":
+        return WatermarkNode(name, late_tolerance_ms=opts.late_tolerance_ms,
+                             buffer_length=opts.buffer_length)
+    if nt == "ratelimit":
+        return RateLimitNode(name, interval_ms=int(props["interval"]),
+                             buffer_length=opts.buffer_length)
+    if nt == "dedup_trigger":
+        return DedupTriggerNode(
+            name, alias=props.get("aliasName", "dedup_trigger"),
+            start_field=props.get("startField", "start"),
+            end_field=props.get("endField", "end"),
+            now_field=props.get("nowField", ""),
+            expire_ms=int(props.get("expire", 3_600_000)),
+            buffer_length=opts.buffer_length)
+    if nt == "script":
+        try:
+            from ..plugin.script import ScriptOpNode
+        except ImportError as exc:
+            raise PlanError(f"script operator unavailable: {exc}")
+        return ScriptOpNode(name, props.get("script", ""),
+                            is_agg=bool(props.get("isAgg")),
+                            buffer_length=opts.buffer_length)
+    raise PlanError(f"unknown operator nodeType {nt!r} for {name}")
+
+
+def _parse_window(props: Dict[str, Any]) -> ast.Window:
+    """Graph window props {type, unit, size, interval} -> ast.Window
+    (reference: parseWindow, planner_graph.go:638-700)."""
+    wt_map = {
+        "tumblingwindow": ast.WindowType.TUMBLING_WINDOW,
+        "hoppingwindow": ast.WindowType.HOPPING_WINDOW,
+        "slidingwindow": ast.WindowType.SLIDING_WINDOW,
+        "sessionwindow": ast.WindowType.SESSION_WINDOW,
+        "countwindow": ast.WindowType.COUNT_WINDOW,
+    }
+    wt = wt_map.get((props.get("type") or "").lower())
+    if wt is None:
+        raise PlanError(f"unknown window type {props.get('type')!r}")
+    unit_map = {"dd": "DD", "hh": "HH", "mi": "MI", "ss": "SS", "ms": "MS"}
+    unit = unit_map.get((props.get("unit") or "ss").lower(), "SS")
+    return ast.Window(
+        window_type=wt,
+        time_unit=None if wt == ast.WindowType.COUNT_WINDOW else unit,
+        length=int(props["size"]),
+        interval=int(props.get("interval", 0)) or None,
+    )
+
+
+def _parse_join(props: Dict[str, Any]) -> ast.SelectStatement:
+    """Graph join props {from, joins:[{name,type,on}]} -> parsed statement
+    fragment (reference: parseJoinAst)."""
+    frm = props["from"]
+    parts = []
+    for j in props.get("joins", []):
+        jt = (j.get("type") or "inner").upper()
+        parts.append(f"{jt} JOIN {j['name']} ON {j['on']}")
+    sql = f"SELECT * FROM {frm} " + " ".join(parts)
+    return Parser(sql).parse_select()

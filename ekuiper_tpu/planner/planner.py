@@ -45,6 +45,7 @@ class RuleDef:
     sql: str
     actions: List[Dict[str, Dict[str, Any]]] = field(default_factory=list)
     options: Dict[str, Any] = field(default_factory=dict)
+    graph: Optional[Dict[str, Any]] = None  # graph-API rule (PlanByGraph)
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "RuleDef":
@@ -53,13 +54,17 @@ class RuleDef:
             sql=d.get("sql", ""),
             actions=d.get("actions", []),
             options=d.get("options", {}),
+            graph=d.get("graph"),
         )
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "id": self.id, "sql": self.sql,
             "actions": self.actions, "options": self.options,
         }
+        if self.graph is not None:
+            out["graph"] = self.graph
+        return out
 
 
 def merged_options(rule: RuleDef) -> RuleOptionConfig:
@@ -233,6 +238,10 @@ def _under_agg(root: ast.Expr, target: ast.Expr) -> bool:
 
 # ------------------------------------------------------------------- build
 def plan_rule(rule: RuleDef, store) -> Topo:
+    if rule.graph is not None:
+        from .graph import plan_by_graph
+
+        return plan_by_graph(rule, store)
     if not rule.sql:
         raise PlanError("rule has no sql")
     stmt = parse_select(rule.sql)

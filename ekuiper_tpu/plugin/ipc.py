@@ -48,14 +48,24 @@ _lib_tried = False
 _lib_lock = threading.Lock()
 
 
+_build_started = False
+
+
 def _build_native() -> bool:
-    """Compile libekipc.so (invoked in a background thread via ensure_native,
-    never on a request path)."""
+    """Compile libekipc.so into a scratch dir, then atomically install it so
+    _load_native never CDLLs a half-written file. Runs in a background thread
+    via ensure_native, never on a request path."""
     try:
+        native = os.path.abspath(_NATIVE_DIR)
+        scratch = f"build.tmp.{os.getpid()}"
         subprocess.run(
-            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+            ["make", "-C", native, f"BUILD={scratch}"],
             capture_output=True, timeout=120, check=True,
         )
+        os.makedirs(os.path.join(native, "build"), exist_ok=True)
+        os.replace(os.path.join(native, scratch, "libekipc.so"),
+                   os.path.join(native, "build", "libekipc.so"))
+        os.rmdir(os.path.join(native, scratch))
         return True
     except Exception as e:  # toolchain unavailable — fall back
         logger.warning("ekipc native build failed (%s); using pure-python ipc", e)
@@ -64,10 +74,14 @@ def _build_native() -> bool:
 
 def ensure_native(background: bool = True) -> None:
     """Kick off (or finish) the native build. Called at manager/server init so
-    the first plugin request never blocks on the compiler."""
+    the first plugin request never blocks on the compiler. Idempotent: only
+    one build is ever started per process."""
+    global _build_started
     so = os.path.abspath(os.path.join(_NATIVE_DIR, "build", "libekipc.so"))
-    if os.path.exists(so) or _lib_tried:
-        return
+    with _lib_lock:
+        if os.path.exists(so) or _lib_tried or _build_started:
+            return
+        _build_started = True
     if background:
         threading.Thread(target=_build_native, daemon=True,
                          name="ekipc-build").start()

@@ -133,6 +133,13 @@ class EncodeNode(Node):
     def process(self, item: Any) -> None:
         from .nodes_sink import to_messages
 
+        if isinstance(item, (bytes, bytearray)):
+            self.emit(bytes(item))  # already encoded upstream
+            return
+        if isinstance(item, str):
+            # rendered dataTemplate output is the final wire payload
+            self.emit(item.encode())
+            return
         msgs = to_messages(item)
         payload = msgs[0] if len(msgs) == 1 else msgs
         self.emit(self.converter.encode(payload))
@@ -254,7 +261,10 @@ class CacheNode(Node):
         with self._mu:
             if front:
                 self._mem.insert(0, item)
-            elif len(self._mem) >= self.memory_threshold and self.kv is not None:
+            elif self.kv is not None and (
+                len(self._mem) >= self.memory_threshold
+                or self._disk_head != self._disk_tail  # FIFO: go behind spill
+            ):
                 if self._disk_tail - self._disk_head < self.max_disk_cache:
                     self.kv.set(str(self._disk_tail), _dumps(item))
                     self._disk_tail += 1

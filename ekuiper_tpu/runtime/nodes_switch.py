@@ -1,0 +1,59 @@
+"""Switch node — graph-API conditional fan-out
+(reference: internal/topo/node/switch_node.go).
+
+Each case expression owns an output port (a list of downstream nodes). A row
+is routed to every case it matches; with `stop_at_first_match` routing stops
+at the first matching case. Control events (barrier/watermark/EOF) broadcast
+to ALL downstreams via the Node defaults so checkpointing still aligns.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+from ..data.batch import ColumnBatch
+from ..data.rows import Row, WindowTuples
+from ..sql import ast
+from ..sql.eval import Evaluator
+from .node import Node
+
+
+class SwitchNode(Node):
+    def __init__(self, name: str, cases: List[ast.Expr],
+                 stop_at_first_match: bool = False, **kw) -> None:
+        super().__init__(name, op_type="op", **kw)
+        self.cases = cases
+        self.stop_at_first_match = stop_at_first_match
+        self.case_outputs: List[List[Node]] = [[] for _ in cases]
+        self.ev = Evaluator()
+
+    def connect_case(self, case_idx: int, downstream: Node) -> Node:
+        """Wire one case port; also registers the downstream for control-event
+        broadcast (checkpoint barriers must reach every branch)."""
+        self.case_outputs[case_idx].append(downstream)
+        if downstream not in self.outputs:
+            self.outputs.append(downstream)
+        return downstream
+
+    def process(self, item: Any) -> None:
+        if isinstance(item, ColumnBatch):
+            rows: List[Any] = item.to_tuples()
+        elif isinstance(item, WindowTuples):
+            rows = [item]  # collections route as a unit (condition on rows())
+        elif isinstance(item, (Row, dict)):
+            rows = [item]
+        else:
+            self.emit(item)
+            return
+        for r in rows:
+            cond_row = r
+            for i, case in enumerate(self.cases):
+                try:
+                    matched = self.ev.eval_condition(case, cond_row)
+                except Exception:
+                    matched = False
+                if matched:
+                    self.stats.inc_out(1)
+                    for out in self.case_outputs[i]:
+                        out.put(r)
+                    if self.stop_at_first_match:
+                        break

@@ -76,8 +76,8 @@ class RuleProcessor:
         rule = RuleDef.from_dict(rule_json)
         if not rule.id:
             raise PlanError("rule id is required")
-        if not rule.sql:
-            raise PlanError("rule sql is required")
+        if not rule.sql and rule.graph is None:
+            raise PlanError("rule sql or graph is required")
         if not self._table().setnx(rule.id, rule.to_dict()):
             raise PlanError(f"rule {rule.id} already exists")
         return rule

@@ -131,8 +131,8 @@ class RuleRegistry:
 
     def validate(self, rule_json: Dict[str, Any]) -> Dict[str, Any]:
         rule = RuleDef.from_dict(rule_json)
-        if not rule.sql:
-            return {"valid": False, "error": "rule sql is required"}
+        if not rule.sql and rule.graph is None:
+            return {"valid": False, "error": "rule sql or graph is required"}
         try:
             plan_rule(rule, self.store).close()
             return {"valid": True}

@@ -1,0 +1,208 @@
+"""Graph-API rule tests — modeled on the reference's planner_graph tests
+(internal/topo/planner/planner_graph_test.go) plus end-to-end runs through
+the memory pubsub, mirroring topotest style."""
+import time
+
+import pytest
+
+from ekuiper_tpu.io.memory import publish, subscribe
+from ekuiper_tpu.planner.graph import plan_by_graph
+from ekuiper_tpu.planner.planner import RuleDef
+from ekuiper_tpu.server.rule_manager import RuleRegistry
+from ekuiper_tpu.store import kv
+from ekuiper_tpu.utils.infra import PlanError
+
+
+def graph_rule(rid, graph):
+    return RuleDef(id=rid, sql="", actions=[], graph=graph)
+
+
+def run_rule(rule, feeds, out_topic, wait=1.0, settle=0.3):
+    """Start a graph rule, publish feeds, gather sink output."""
+    store = kv.get_store()
+    got = []
+    unsub = subscribe(out_topic, lambda t, d: got.append(d))
+    from ekuiper_tpu.utils import timex
+
+    timex.use_real_clock()  # runtime nodes use wall timers here
+    topo = plan_by_graph(rule, store)
+    topo.open()
+    time.sleep(settle)
+    for topic, payload in feeds:
+        publish(topic, payload)
+    deadline = time.monotonic() + wait
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
+    topo.close()
+    unsub()
+    rows = []
+    for g in got:
+        rows.extend(g if isinstance(g, list) else [g])
+    return rows
+
+
+def test_graph_filter_pick_e2e():
+    rule = graph_rule("g1", {
+        "nodes": {
+            "src": {"type": "source", "nodeType": "memory",
+                    "props": {"datasource": "gt1"}},
+            "flt": {"type": "operator", "nodeType": "filter",
+                    "props": {"expr": "temperature > 20"}},
+            "pick": {"type": "operator", "nodeType": "pick",
+                     "props": {"fields": ["temperature as t", "device"]}},
+            "out": {"type": "sink", "nodeType": "memory",
+                    "props": {"topic": "gout1"}},
+        },
+        "topo": {"sources": ["src"],
+                 "edges": {"src": ["flt"], "flt": ["pick"], "pick": ["out"]}},
+    })
+    rows = run_rule(rule, [("gt1", {"temperature": 25, "device": "a"}),
+                           ("gt1", {"temperature": 10, "device": "b"}),
+                           ("gt1", {"temperature": 30, "device": "c"})],
+                    "gout1")
+    assert sorted(r["t"] for r in rows) == [25, 30]
+    assert all(set(r) == {"t", "device"} for r in rows)
+
+
+def test_graph_function_appends_column():
+    rule = graph_rule("g2", {
+        "nodes": {
+            "src": {"type": "source", "nodeType": "memory",
+                    "props": {"datasource": "gt2"}},
+            "fn": {"type": "operator", "nodeType": "function",
+                   "props": {"expr": "upper(name) as uname"}},
+            "pick": {"type": "operator", "nodeType": "pick",
+                     "props": {"fields": ["name", "uname"]}},
+            "out": {"type": "sink", "nodeType": "memory",
+                    "props": {"topic": "gout2"}},
+        },
+        "topo": {"sources": ["src"],
+                 "edges": {"src": ["fn"], "fn": ["pick"], "pick": ["out"]}},
+    })
+    rows = run_rule(rule, [("gt2", {"name": "abc"})], "gout2")
+    assert rows and rows[0] == {"name": "abc", "uname": "ABC"}
+
+
+def test_graph_switch_routes_cases():
+    rule = graph_rule("g3", {
+        "nodes": {
+            "src": {"type": "source", "nodeType": "memory",
+                    "props": {"datasource": "gt3"}},
+            "sw": {"type": "operator", "nodeType": "switch",
+                   "props": {"cases": ["v > 10", "v <= 10"],
+                             "stopAtFirstMatch": True}},
+            "hi": {"type": "sink", "nodeType": "memory",
+                   "props": {"topic": "gout3hi"}},
+            "lo": {"type": "sink", "nodeType": "memory",
+                   "props": {"topic": "gout3lo"}},
+        },
+        "topo": {"sources": ["src"],
+                 "edges": {"src": ["sw"], "sw": [["hi"], ["lo"]]}},
+    })
+    store = kv.get_store()
+    hi, lo = [], []
+    u1 = subscribe("gout3hi", lambda t, d: hi.append(d))
+    u2 = subscribe("gout3lo", lambda t, d: lo.append(d))
+    from ekuiper_tpu.utils import timex
+
+    timex.use_real_clock()
+    topo = plan_by_graph(rule, store)
+    topo.open()
+    time.sleep(0.3)
+    for v in (5, 15, 8, 20):
+        publish("gt3", {"v": v})
+    time.sleep(1.0)
+    topo.close()
+    u1()
+    u2()
+    flat = lambda xs: sorted(  # noqa: E731
+        r["v"] for g in xs for r in (g if isinstance(g, list) else [g]))
+    assert flat(hi) == [15, 20]
+    assert flat(lo) == [5, 8]
+
+
+def test_graph_window_aggfunc_e2e():
+    rule = graph_rule("g4", {
+        "nodes": {
+            "src": {"type": "source", "nodeType": "memory",
+                    "props": {"datasource": "gt4"}},
+            "win": {"type": "operator", "nodeType": "window",
+                    "props": {"type": "countwindow", "size": 3}},
+            "agg": {"type": "operator", "nodeType": "aggfunc",
+                    "props": {"expr": "avg(v) as av"}},
+            "pick": {"type": "operator", "nodeType": "pick",
+                     "props": {"fields": ["av"]}},
+            "out": {"type": "sink", "nodeType": "memory",
+                    "props": {"topic": "gout4"}},
+        },
+        "topo": {"sources": ["src"],
+                 "edges": {"src": ["win"], "win": ["agg"], "agg": ["pick"],
+                           "pick": ["out"]}},
+    })
+    rows = run_rule(rule, [("gt4", {"v": v}) for v in (1, 2, 3)], "gout4",
+                    wait=2.0)
+    assert rows and rows[0]["av"] == 2
+
+
+def test_graph_io_type_mismatch_rejected():
+    rule = graph_rule("gbad", {
+        "nodes": {
+            "src": {"type": "source", "nodeType": "memory",
+                    "props": {"datasource": "x"}},
+            "agg": {"type": "operator", "nodeType": "aggfunc",
+                    "props": {"expr": "avg(v) as av"}},
+            "out": {"type": "sink", "nodeType": "memory",
+                    "props": {"topic": "y"}},
+        },
+        # aggfunc directly on a row source: collection input required
+        "topo": {"sources": ["src"],
+                 "edges": {"src": ["agg"], "agg": ["out"]}},
+    })
+    with pytest.raises(PlanError, match="collection"):
+        plan_by_graph(rule, kv.get_store())
+
+
+def test_graph_undefined_edge_rejected():
+    rule = graph_rule("gbad2", {
+        "nodes": {
+            "src": {"type": "source", "nodeType": "memory",
+                    "props": {"datasource": "x"}},
+        },
+        "topo": {"sources": ["src"], "edges": {"src": ["missing"]}},
+    })
+    with pytest.raises(PlanError):
+        plan_by_graph(rule, kv.get_store())
+
+
+def test_graph_rule_through_registry():
+    """Graph rules flow through the same CRUD/lifecycle as SQL rules."""
+    store = kv.get_store()
+    rr = RuleRegistry(store)
+    got = []
+    unsub = subscribe("gout5", lambda t, d: got.append(d))
+    from ekuiper_tpu.utils import timex
+
+    timex.use_real_clock()
+    rr.create({"id": "g5", "graph": {
+        "nodes": {
+            "src": {"type": "source", "nodeType": "memory",
+                    "props": {"datasource": "gt5"}},
+            "flt": {"type": "operator", "nodeType": "filter",
+                    "props": {"expr": "v > 0"}},
+            "out": {"type": "sink", "nodeType": "memory",
+                    "props": {"topic": "gout5"}},
+        },
+        "topo": {"sources": ["src"],
+                 "edges": {"src": ["flt"], "flt": ["out"]}},
+    }})
+    time.sleep(0.3)
+    publish("gt5", {"v": 1})
+    publish("gt5", {"v": -1})
+    time.sleep(1.0)
+    status = rr.status("g5")
+    rr.stop("g5")
+    rr.delete("g5")
+    unsub()
+    rows = [r for g in got for r in (g if isinstance(g, list) else [g])]
+    assert [r["v"] for r in rows] == [1]
+    assert status["status"] in ("running", "stopped")
