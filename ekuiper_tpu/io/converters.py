@@ -126,6 +126,10 @@ def register_converter(name: str, factory: Callable[..., Converter]) -> None:
 
 def get_converter(fmt: str, **kwargs) -> Converter:
     factory = _registry.get((fmt or "json").lower())
+    if factory is None and (fmt or "").lower() == "protobuf":
+        from . import protobuf_conv  # noqa: F401 — registers on import
+
+        factory = _registry.get("protobuf")
     if factory is None:
         raise EngineError(f"unknown format {fmt!r}")
     return factory(**kwargs)

@@ -1,0 +1,46 @@
+"""Protobuf FORMAT converter — analogue of internal/converter/protobuf.
+
+Streams declare FORMAT="protobuf", SCHEMAID="schemaName.MessageName"; the
+schema registry supplies the compiled message class (schema/registry.go via
+converter.go:34-43).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ..utils.infra import EngineError
+from .converters import Converter, register_converter
+
+
+class ProtobufConverter(Converter):
+    def __init__(self, schema_id: str = "", **_kw) -> None:
+        if "." not in (schema_id or ""):
+            raise EngineError(
+                'protobuf format needs SCHEMAID="schema.Message"')
+        schema_name, message_name = schema_id.split(".", 1)
+        from ..schema.registry import SchemaRegistry
+
+        self._cls = SchemaRegistry.global_instance().message_class(
+            schema_name, message_name)
+
+    def decode(self, raw: bytes) -> Any:
+        from google.protobuf.json_format import MessageToDict
+
+        msg = self._cls()
+        msg.ParseFromString(bytes(raw))
+        return MessageToDict(msg, preserving_proto_field_name=True)
+
+    def encode(self, data: Any) -> bytes:
+        from google.protobuf.json_format import ParseDict
+
+        if isinstance(data, list):
+            # protobuf is record-oriented: encode a single row per message
+            if len(data) != 1:
+                raise EngineError(
+                    "protobuf encode expects one row (use sendSingle)")
+            data = data[0]
+        msg = ParseDict(data, self._cls(), ignore_unknown_fields=True)
+        return msg.SerializeToString()
+
+
+register_converter("protobuf", ProtobufConverter)

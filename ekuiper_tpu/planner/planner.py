@@ -265,6 +265,7 @@ def plan_rule(rule: RuleDef, store) -> Topo:
             stream.options.format or "json",
             delimiter=stream.options.delimiter or ",",
             fields=[f.name for f in stream.fields] or None,
+            schema_id=stream.options.schemaid,
         )
         if props.get("decompression"):
             # bytes payloads are decompressed before FORMAT decode
@@ -361,7 +362,9 @@ def _build_sink_chain(topo: Topo, tail, sink_type: str, props: Dict[str, Any],
         )
         topo.add_op(tr)
         head = head.connect(tr)
-        conv = get_converter(props.get("format", "json"))
+        conv = get_converter(props.get("format", "json"),
+                             delimiter=props.get("delimiter", ","),
+                             schema_id=props.get("schemaId", ""))
         enc = EncodeNode(f"{sink_type}_{idx}_encode", conv,
                          buffer_length=opts.buffer_length)
         topo.add_op(enc)

@@ -1,0 +1,184 @@
+"""Script functions — analogue of the reference's embedded JavaScript UDFs
+(internal/plugin/js/function.go:21-40, managed via rpc_script.go; scripts
+stored in KV and hot-loaded per call).
+
+Divergence note: the reference embeds goja (a Go JS interpreter). This host
+is Python, so runtime-defined scripts are Python — same capability (define/
+update SQL functions at runtime without recompiling or restarting), same
+management surface. A script must define
+
+    def exec(args, ctx):   # -> value
+        ...
+
+or be a single expression over `args`. Scripts execute in a restricted
+namespace: a curated builtin set, no imports, no file/network access.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..functions import registry as func_registry
+from ..utils.infra import EngineError, logger
+
+_SAFE_BUILTINS = {
+    "abs": abs, "all": all, "any": any, "bool": bool, "dict": dict,
+    "divmod": divmod, "enumerate": enumerate, "filter": filter,
+    "float": float, "format": format, "int": int, "len": len, "list": list,
+    "map": map, "max": max, "min": min, "pow": pow, "range": range,
+    "repr": repr, "reversed": reversed, "round": round, "set": set,
+    "sorted": sorted, "str": str, "sum": sum, "tuple": tuple, "zip": zip,
+    "isinstance": isinstance, "Exception": Exception, "ValueError": ValueError,
+}
+
+
+def _compile_script(name: str, source: str):
+    """-> callable(args, ctx). Accepts a def-exec script or one expression."""
+    env: Dict[str, Any] = {
+        "__builtins__": _SAFE_BUILTINS, "math": math, "json": json,
+    }
+    try:
+        # expression form first: "args[0] * 2" is also a valid statement, so
+        # the order matters — a bare expression must not execute at compile
+        code = compile(source, f"<script:{name}>", "eval")
+        return lambda args, ctx, _c=code, _e=env: eval(_c, _e, {"args": args, "ctx": ctx})  # noqa: S307
+    except SyntaxError:
+        code = compile(source, f"<script:{name}>", "exec")
+        exec(code, env)  # noqa: S102 — sandboxed namespace, curated builtins
+    fn = env.get("exec")
+    if not callable(fn):
+        raise EngineError(f"script {name} must define exec(args, ctx) "
+                          "or be a single expression")
+    return fn
+
+
+class ScriptManager:
+    """CRUD + function-registry binding for scripts (rpc_script.go:27-64)."""
+
+    _instance: Optional["ScriptManager"] = None
+
+    def __init__(self, store=None) -> None:
+        self._kv = store.kv("script") if store is not None else None
+        self._cache: Dict[str, Any] = {}  # name -> compiled fn
+        self._mu = threading.Lock()
+        if self._kv is not None:
+            for name in self._kv.keys():
+                try:
+                    self._bind(name, json.loads(self._kv.get(name)))
+                except Exception as e:
+                    logger.warning("script %s restore failed: %s", name, e)
+
+    @classmethod
+    def global_instance(cls) -> "ScriptManager":
+        if cls._instance is None:
+            cls._instance = ScriptManager()
+        return cls._instance
+
+    @classmethod
+    def set_global(cls, mgr: "ScriptManager") -> None:
+        cls._instance = mgr
+
+    # ----------------------------------------------------------------- CRUD
+    def create(self, spec: Dict[str, Any], overwrite: bool = False) -> None:
+        """spec: {"id": name, "description": ..., "script": source,
+        "isAgg": bool} — the reference's script json shape."""
+        name = spec.get("id", "")
+        if not name or not spec.get("script"):
+            raise EngineError("script needs id and script fields")
+        if not overwrite and self.get(name) is not None:
+            raise EngineError(f"script {name} already exists")
+        _compile_script(name, spec["script"])  # validate before persisting
+        if self._kv is not None:
+            self._kv.set(name, json.dumps(spec))
+        self._bind(name, spec)
+
+    def _bind(self, name: str, spec: Dict[str, Any]) -> None:
+        fn = _compile_script(name, spec["script"])
+        with self._mu:
+            self._cache[name.lower()] = fn
+        ftype = (func_registry.AGGREGATE if spec.get("isAgg")
+                 else func_registry.SCALAR)
+
+        def call(args: List[Any], ctx, _name=name.lower()) -> Any:
+            with self._mu:
+                f = self._cache.get(_name)
+            if f is None:
+                raise EngineError(f"script {_name} dropped")
+            return f(args, ctx)
+
+        func_registry.register_def(func_registry.FunctionDef(
+            name=name.lower(), ftype=ftype, exec=call))
+
+    def get(self, name: str) -> Optional[Dict[str, Any]]:
+        if self._kv is None:
+            return None
+        raw, ok = self._kv.get_ok(name)
+        return json.loads(raw) if ok else None
+
+    def list(self) -> List[str]:
+        return sorted(self._kv.keys()) if self._kv is not None else []
+
+    def update(self, spec: Dict[str, Any]) -> None:
+        self.create(spec, overwrite=True)
+
+    def delete(self, name: str) -> None:
+        if self._kv is not None:
+            self._kv.delete(name)
+        with self._mu:
+            self._cache.pop(name.lower(), None)
+        func_registry.unregister(name)
+
+
+class ScriptOpNode:
+    """Inline script operator for graph rules
+    (reference: internal/topo/operator/script_operator.go).
+
+    The script defines exec(msg, meta) -> dict | list[dict] | None
+    (None drops the message). Implemented lazily to avoid a hard dependency
+    from the planner module."""
+
+    def __new__(cls, name: str, source: str, is_agg: bool = False, **kw):
+        from ..runtime.node import Node
+
+        class _Impl(Node):
+            def __init__(self) -> None:
+                super().__init__(name, op_type="op", **kw)
+                self.fn = _compile_graph_script(name, source)
+
+            def process(self, item: Any) -> None:
+                from ..data.batch import ColumnBatch
+                from ..data.rows import Row
+
+                if isinstance(item, ColumnBatch):
+                    rows = [t.message for t in item.to_tuples()]
+                elif isinstance(item, Row):
+                    rows = [item.all_values()]
+                elif isinstance(item, dict):
+                    rows = [item]
+                else:
+                    self.emit(item)
+                    return
+                out: List[Any] = []
+                for msg in rows:
+                    res = self.fn(msg, {})
+                    if res is None:
+                        continue
+                    out.extend(res if isinstance(res, list) else [res])
+                if out:
+                    self.emit(out if len(out) > 1 else out[0], count=len(out))
+
+        return _Impl()
+
+
+def _compile_graph_script(name: str, source: str):
+    env: Dict[str, Any] = {
+        "__builtins__": _SAFE_BUILTINS, "math": math, "json": json,
+    }
+    code = compile(source, f"<script-op:{name}>", "exec")
+    exec(code, env)  # noqa: S102
+    fn = env.get("exec")
+    if not callable(fn):
+        raise EngineError(f"script op {name} must define exec(msg, meta)")
+    return fn

@@ -1,0 +1,4 @@
+"""Schema registry package — analogue of internal/schema."""
+from .registry import SchemaRegistry
+
+__all__ = ["SchemaRegistry"]

@@ -1,0 +1,162 @@
+"""Schema registry — analogue of internal/schema/registry.go:49-184.
+
+Stores schema files (protobuf .proto sources; "custom" schemas are gated
+out — they are Go .so plugins in the reference) under the data dir, with
+metadata in the KV store. Protobuf schemas are compiled once at registration
+via `protoc --descriptor_set_out` (protoc is part of the base toolchain) and
+loaded through google.protobuf's descriptor pool, so decode/encode never
+shells out on the data path.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..utils.infra import EngineError, logger
+
+
+class SchemaRegistry:
+    _instance: Optional["SchemaRegistry"] = None
+
+    def __init__(self, store=None, etc_dir: str = "data/schemas") -> None:
+        self._kv = store.kv("schema") if store is not None else None
+        self.etc_dir = etc_dir
+        self._pools: Dict[str, Any] = {}  # name -> (pool, factory_cache)
+        self._mu = threading.Lock()
+        if self._kv is not None:
+            for name in self._kv.keys():
+                try:
+                    self._load(json.loads(self._kv.get(name)))
+                except Exception as e:
+                    logger.warning("schema %s restore failed: %s", name, e)
+
+    @classmethod
+    def global_instance(cls) -> "SchemaRegistry":
+        if cls._instance is None:
+            cls._instance = SchemaRegistry()
+        return cls._instance
+
+    @classmethod
+    def set_global(cls, reg: "SchemaRegistry") -> None:
+        cls._instance = reg
+
+    # ------------------------------------------------------------------ CRUD
+    def create(self, spec: Dict[str, Any], overwrite: bool = False) -> None:
+        """spec: {"name": ..., "type": "protobuf", "content": proto source}
+        or {"name", "type", "file": path} (reference: schema json shape)."""
+        name = spec.get("name", "")
+        stype = spec.get("type", "protobuf")
+        if not name:
+            raise EngineError("schema name is required")
+        if stype != "protobuf":
+            raise EngineError(f"schema type {stype!r} not supported "
+                              "(protobuf only; 'custom' is a Go .so concept)")
+        if not overwrite and self.get(name) is not None:
+            raise EngineError(f"schema {name} already exists")
+        content = spec.get("content", "")
+        if not content and spec.get("file"):
+            with open(spec["file"]) as f:
+                content = f.read()
+        if not content:
+            raise EngineError("schema content (or file) is required")
+        os.makedirs(self.etc_dir, exist_ok=True)
+        proto_path = os.path.join(self.etc_dir, f"{name}.proto")
+        with open(proto_path, "w") as f:
+            f.write(content)
+        record = {"name": name, "type": stype, "proto_path": proto_path}
+        self._load(record)  # compiles; raises on bad proto before persisting
+        if self._kv is not None:
+            self._kv.set(name, json.dumps(record))
+
+    def get(self, name: str) -> Optional[Dict[str, Any]]:
+        if self._kv is None:
+            return None
+        raw, ok = self._kv.get_ok(name)
+        if not ok:
+            return None
+        rec = json.loads(raw)
+        try:
+            with open(rec["proto_path"]) as f:
+                rec["content"] = f.read()
+        except OSError:
+            rec["content"] = ""
+        return rec
+
+    def list(self) -> List[str]:
+        return sorted(self._kv.keys()) if self._kv is not None else []
+
+    def delete(self, name: str) -> None:
+        if self._kv is not None:
+            raw, ok = self._kv.get_ok(name)
+            if ok:
+                rec = json.loads(raw)
+                try:
+                    os.unlink(rec["proto_path"])
+                except OSError:
+                    pass
+            self._kv.delete(name)
+        with self._mu:
+            self._pools.pop(name, None)
+
+    # ----------------------------------------------------------- compilation
+    def _load(self, record: Dict[str, Any]) -> None:
+        from google.protobuf import descriptor_pb2, descriptor_pool
+
+        proto_path = record["proto_path"]
+        desc_path = proto_path + ".pb"
+        proto_dir = os.path.dirname(os.path.abspath(proto_path)) or "."
+        res = subprocess.run(
+            ["protoc", f"--proto_path={proto_dir}",
+             f"--descriptor_set_out={desc_path}",
+             os.path.basename(proto_path)],
+            capture_output=True, timeout=30,
+        )
+        if res.returncode != 0:
+            raise EngineError(
+                f"protoc failed for {record['name']}: "
+                f"{res.stderr.decode(errors='replace').strip()}")
+        with open(desc_path, "rb") as f:
+            fds = descriptor_pb2.FileDescriptorSet.FromString(f.read())
+        pool = descriptor_pool.DescriptorPool()
+        for fdp in fds.file:
+            pool.Add(fdp)
+        with self._mu:
+            self._pools[record["name"]] = pool
+
+    def message_class(self, schema_name: str, message_name: str):
+        """-> generated message class for schema.message (SCHEMAID form
+        "schema.message", registry.go GetSchema semantics)."""
+        from google.protobuf import message_factory
+
+        with self._mu:
+            pool = self._pools.get(schema_name)
+        if pool is None:
+            raise EngineError(f"schema {schema_name} not found")
+        # message may be package-qualified inside the proto; try verbatim
+        # first, then scan the pool's files for a suffix match
+        try:
+            desc = pool.FindMessageTypeByName(message_name)
+        except KeyError:
+            desc = None
+            rec = self.get(schema_name) or {}
+            pkg = self._package_of(rec.get("content", ""))
+            if pkg:
+                try:
+                    desc = pool.FindMessageTypeByName(f"{pkg}.{message_name}")
+                except KeyError:
+                    desc = None
+        if desc is None:
+            raise EngineError(
+                f"message {message_name} not found in schema {schema_name}")
+        return message_factory.GetMessageClass(desc)
+
+    @staticmethod
+    def _package_of(content: str) -> str:
+        for line in content.splitlines():
+            line = line.strip()
+            if line.startswith("package ") and line.endswith(";"):
+                return line[len("package "):-1].strip()
+        return ""

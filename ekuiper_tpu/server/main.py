@@ -28,8 +28,14 @@ def start_up(config_path: str | None = None, block: bool = True):
     # portable plugin manager (restores installed plugins + binds symbols,
     # reference: server.go:218-226 binder init)
     from ..plugin.manager import PortableManager
+    from ..plugin.script import ScriptManager
+
+    from ..schema.registry import SchemaRegistry
 
     PortableManager.set_global(PortableManager(store))
+    ScriptManager.set_global(ScriptManager(store))
+    SchemaRegistry.set_global(SchemaRegistry(
+        store, etc_dir=f"{cfg.store.path}/schemas"))
     api = RestApi(store)
     api.rules.recover()
     server = serve(api, cfg.basic.rest_ip, cfg.basic.rest_port)

@@ -96,12 +96,65 @@ class RestApi:
           lambda m: self.trials.start(m["id"]))
         r("GET", r"^/ruletest/(?P<id>[^/]+)$", lambda m: self.trials.results(m["id"]))
         r("DELETE", r"^/ruletest/(?P<id>[^/]+)$", lambda m: self.trials.stop(m["id"]))
+        # schema registry (reference: internal/server/rest.go schema routes,
+        # internal/schema/registry.go:49-184)
+        r("GET", r"^/schemas/protobuf$", lambda m: self._schemas().list())
+        r("POST", r"^/schemas/protobuf$",
+          lambda m, body=None: self._schemas().create(body or {})
+          or f"Schema {(body or {}).get('name')} is created.")
+        r("GET", r"^/schemas/protobuf/(?P<name>[^/]+)$", self.describe_schema)
+        r("PUT", r"^/schemas/protobuf/(?P<name>[^/]+)$",
+          lambda m, body=None: self._schemas().create(
+              {**(body or {}), "name": m["name"]}, overwrite=True)
+          or f"Schema {m['name']} is updated.")
+        r("DELETE", r"^/schemas/protobuf/(?P<name>[^/]+)$",
+          lambda m: self._schemas().delete(m["name"])
+          or f"Schema {m['name']} is dropped.")
+        # script UDFs (reference: rpc_script.go CreateScript/DescScript/...)
+        r("GET", r"^/scripts$", lambda m: self._scripts().list())
+        r("POST", r"^/scripts$",
+          lambda m, body=None: self._scripts().create(body or {})
+          or f"Script {body.get('id')} is created.")
+        r("GET", r"^/scripts/(?P<name>[^/]+)$", self.describe_script)
+        r("PUT", r"^/scripts/(?P<name>[^/]+)$",
+          lambda m, body=None: self._scripts().update(
+              {**(body or {}), "id": m["name"]})
+          or f"Script {m['name']} is updated.")
+        r("DELETE", r"^/scripts/(?P<name>[^/]+)$",
+          lambda m: self._scripts().delete(m["name"])
+          or f"Script {m['name']} is dropped.")
         # portable plugins (reference: rest.go plugin routes)
         r("GET", r"^/plugins/portables$", lambda m: self._plugins().list())
         r("POST", r"^/plugins/portables$", self.install_plugin)
         r("GET", r"^/plugins/portables/(?P<name>[^/]+)$", self.describe_plugin)
         r("DELETE", r"^/plugins/portables/(?P<name>[^/]+)$",
           lambda m: self._plugins().delete(m["name"]) or f"Plugin {m['name']} is deleted.")
+
+    # ---------------------------------------------------------------- schemas
+    @staticmethod
+    def _schemas():
+        from ..schema.registry import SchemaRegistry
+
+        return SchemaRegistry.global_instance()
+
+    def describe_schema(self, m) -> Dict[str, Any]:
+        spec = self._schemas().get(m["name"])
+        if spec is None:
+            raise EngineError(f"schema {m['name']} not found")
+        return spec
+
+    # ---------------------------------------------------------------- scripts
+    @staticmethod
+    def _scripts():
+        from ..plugin.script import ScriptManager
+
+        return ScriptManager.global_instance()
+
+    def describe_script(self, m) -> Dict[str, Any]:
+        spec = self._scripts().get(m["name"])
+        if spec is None:
+            raise EngineError(f"script {m['name']} not found")
+        return spec
 
     # ---------------------------------------------------------------- plugins
     @staticmethod

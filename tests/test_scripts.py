@@ -1,0 +1,139 @@
+"""Script UDF tests — modeled on the reference's JS function tests
+(internal/plugin/js/function_test.go) and script management
+(rpc_script.go)."""
+import time
+
+import pytest
+
+from ekuiper_tpu.functions import registry as freg
+from ekuiper_tpu.plugin.script import ScriptManager, ScriptOpNode, _compile_script
+from ekuiper_tpu.store import kv
+from ekuiper_tpu.utils.infra import EngineError
+
+
+@pytest.fixture
+def mgr():
+    m = ScriptManager(kv.get_store())
+    ScriptManager.set_global(m)
+    yield m
+    for name in list(m.list()):
+        m.delete(name)
+
+
+def test_script_expression_form(mgr):
+    mgr.create({"id": "double", "script": "args[0] * 2"})
+    assert freg.lookup("double").exec([21], {}) == 42
+
+
+def test_script_def_form(mgr):
+    mgr.create({"id": "area", "script":
+                "def exec(args, ctx):\n    return args[0] * args[1]\n"})
+    assert freg.lookup("area").exec([6, 7], {}) == 42
+
+
+def test_script_in_sql_rule(mgr):
+    mgr.create({"id": "fahrenheit", "script": "args[0] * 9 / 5 + 32"})
+    from ekuiper_tpu.io.memory import publish, subscribe
+    from ekuiper_tpu.server.processors import StreamProcessor
+    from ekuiper_tpu.server.rule_manager import RuleRegistry
+    from ekuiper_tpu.utils import timex
+
+    store = kv.get_store()
+    StreamProcessor(store).exec_stmt(
+        'CREATE STREAM sc (t float) WITH (TYPE="memory", DATASOURCE="sct")')
+    got = []
+    unsub = subscribe("scout", lambda t, d: got.append(d))
+    timex.use_real_clock()
+    rr = RuleRegistry(store)
+    rr.create({"id": "rsc", "sql": "SELECT fahrenheit(t) AS f FROM sc",
+               "actions": [{"memory": {"topic": "scout"}}]})
+    time.sleep(0.3)
+    publish("sct", {"t": 100.0})
+    time.sleep(1.0)
+    rr.stop("rsc")
+    rr.delete("rsc")
+    unsub()
+    rows = [r for g in got for r in (g if isinstance(g, list) else [g])]
+    assert rows and rows[0]["f"] == 212.0
+
+
+def test_script_update_hot_reload(mgr):
+    mgr.create({"id": "v", "script": "args[0] + 1"})
+    assert freg.lookup("v").exec([1], {}) == 2
+    mgr.update({"id": "v", "script": "args[0] + 100"})
+    assert freg.lookup("v").exec([1], {}) == 101
+
+
+def test_script_delete_unregisters(mgr):
+    mgr.create({"id": "gone", "script": "args[0]"})
+    assert freg.lookup("gone") is not None
+    mgr.delete("gone")
+    assert freg.lookup("gone") is None
+
+
+def test_script_persistence_across_managers():
+    store = kv.get_store()
+    m1 = ScriptManager(store)
+    m1.create({"id": "persisted", "script": "args[0] * 3"})
+    m2 = ScriptManager(store)
+    assert m2.list() == ["persisted"]
+    assert freg.lookup("persisted").exec([5], {}) == 15
+    m2.delete("persisted")
+
+
+def test_script_sandbox_blocks_imports(mgr):
+    with pytest.raises(Exception):
+        mgr.create({"id": "evil", "script":
+                    "def exec(args, ctx):\n    import os\n    return 1\n"})
+        freg.lookup("evil").exec([], {})
+
+
+def test_script_sandbox_no_open(mgr):
+    mgr.create({"id": "evil2", "script":
+                "def exec(args, ctx):\n    return open('/etc/passwd')\n"})
+    with pytest.raises(Exception):
+        freg.lookup("evil2").exec([], {})
+
+
+def test_script_validation_rejects_bad_source(mgr):
+    with pytest.raises(EngineError):
+        mgr.create({"id": "bad", "script": "x = 1"})  # no exec, not an expr
+
+
+def test_script_op_node_in_graph():
+    from ekuiper_tpu.planner.graph import plan_by_graph
+    from ekuiper_tpu.planner.planner import RuleDef
+    from ekuiper_tpu.io.memory import publish, subscribe
+    from ekuiper_tpu.utils import timex
+
+    rule = RuleDef(id="gsc", sql="", graph={
+        "nodes": {
+            "src": {"type": "source", "nodeType": "memory",
+                    "props": {"datasource": "gsct"}},
+            "sc": {"type": "operator", "nodeType": "script",
+                   "props": {"script":
+                             "def exec(msg, meta):\n"
+                             "    if msg['v'] < 0:\n"
+                             "        return None\n"
+                             "    msg['v2'] = msg['v'] ** 2\n"
+                             "    return msg\n"}},
+            "out": {"type": "sink", "nodeType": "memory",
+                    "props": {"topic": "gscout"}},
+        },
+        "topo": {"sources": ["src"],
+                 "edges": {"src": ["sc"], "sc": ["out"]}},
+    })
+    got = []
+    unsub = subscribe("gscout", lambda t, d: got.append(d))
+    timex.use_real_clock()
+    topo = plan_by_graph(rule, kv.get_store())
+    topo.open()
+    time.sleep(0.3)
+    publish("gsct", {"v": 3})
+    publish("gsct", {"v": -1})
+    publish("gsct", {"v": 4})
+    time.sleep(1.0)
+    topo.close()
+    unsub()
+    rows = [r for g in got for r in (g if isinstance(g, list) else [g])]
+    assert sorted(r["v2"] for r in rows) == [9, 16]
