@@ -103,8 +103,9 @@ class _GraphFuncNode(Node):
                 r.set_cal_col(f.output_name, self.ev.eval(f.expr, r))
         if isinstance(item, ColumnBatch):
             # cal-cols live on the materialized tuples, not the batch — emit
-            # the rows themselves
-            self.emit(rows, count=len(rows))
+            # rows one by one so downstream operator nodes process each
+            for r in rows:
+                self.emit(r)
         else:
             self.emit(item)
 

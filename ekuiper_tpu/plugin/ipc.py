@@ -332,7 +332,28 @@ def Socket(proto: int):
 _IPC_NS = os.environ.setdefault("EKUIPER_TPU_IPC_NS", str(os.getpid()))
 
 
+def _ipc_dir() -> str:
+    """Mode-0700 per-instance runtime dir: unix sockets under it are only
+    dialable by the engine's own uid (unlike the reference's world-readable
+    ipc:///tmp/plugin_*.ipc endpoints)."""
+    base = os.environ.get("EKUIPER_TPU_RUNTIME_DIR") or os.path.join(
+        "/tmp", f"ektpu_{_IPC_NS}")
+    os.makedirs(base, mode=0o700, exist_ok=True)
+    # A pre-created/symlinked dir (pids are predictable) would hand the
+    # endpoint to an attacker — verify rather than trust: must be a real
+    # directory, owned by us, no group/other access.
+    st = os.lstat(base)
+    import stat as _stat
+    if not _stat.S_ISDIR(st.st_mode):
+        raise RuntimeError(f"ipc runtime dir {base} is not a directory")
+    if st.st_uid != os.getuid():
+        raise RuntimeError(f"ipc runtime dir {base} owned by uid {st.st_uid}")
+    if st.st_mode & 0o077:
+        os.chmod(base, 0o700)  # raises on failure — do not fall through
+    return base
+
+
 def ipc_url(name: str) -> str:
-    """ipc:///tmp/ektpu_{ns}_{name}.ipc — reference url scheme (connection.go:56)
-    plus the per-instance namespace."""
-    return f"ipc:///tmp/ektpu_{_IPC_NS}_{name}.ipc"
+    """ipc://{runtime_dir}/{name}.ipc — reference url scheme (connection.go:56)
+    with a per-instance 0700 directory instead of bare /tmp."""
+    return f"ipc://{os.path.join(_ipc_dir(), name + '.ipc')}"

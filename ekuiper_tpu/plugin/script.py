@@ -10,8 +10,15 @@ management surface. A script must define
     def exec(args, ctx):   # -> value
         ...
 
-or be a single expression over `args`. Scripts execute in a restricted
-namespace: a curated builtin set, no imports, no file/network access.
+or be a single expression over `args`.
+
+SECURITY NOTE: scripts are TRUSTED CODE, exactly like plugins. They run
+in-process with a curated builtin namespace for hygiene (to catch honest
+mistakes), but CPython offers no real sandbox — a malicious script can
+escape via attribute traversal. The reference's goja JS runtime is actually
+isolated; this host is not. Only expose the /scripts management surface to
+operators who are already trusted to install plugins. For untrusted code,
+run it out-of-process via the portable-plugin worker path (plugin/manager.py).
 """
 from __future__ import annotations
 
@@ -46,7 +53,7 @@ def _compile_script(name: str, source: str):
         return lambda args, ctx, _c=code, _e=env: eval(_c, _e, {"args": args, "ctx": ctx})  # noqa: S307
     except SyntaxError:
         code = compile(source, f"<script:{name}>", "exec")
-        exec(code, env)  # noqa: S102 — sandboxed namespace, curated builtins
+        exec(code, env)  # noqa: S102 — trusted code; curated builtins only for hygiene
     fn = env.get("exec")
     if not callable(fn):
         raise EngineError(f"script {name} must define exec(args, ctx) "
@@ -149,7 +156,7 @@ class ScriptOpNode:
 
             def process(self, item: Any) -> None:
                 from ..data.batch import ColumnBatch
-                from ..data.rows import Row
+                from ..data.rows import Row, Tuple as RowTuple
 
                 if isinstance(item, ColumnBatch):
                     rows = [t.message for t in item.to_tuples()]
@@ -166,8 +173,13 @@ class ScriptOpNode:
                     if res is None:
                         continue
                     out.extend(res if isinstance(res, list) else [res])
-                if out:
-                    self.emit(out if len(out) > 1 else out[0], count=len(out))
+                for msg_out in out:
+                    # wrap dicts as Rows so downstream operator nodes
+                    # (filter/pick/switch) process them instead of passing
+                    # an unknown type through
+                    if isinstance(msg_out, dict):
+                        msg_out = RowTuple(message=msg_out)
+                    self.emit(msg_out)
 
         return _Impl()
 

@@ -108,8 +108,14 @@ class SinkNode(Node):
             return
         msgs = [self._transform(m) for m in msgs]
         if self.send_single:
+            # the cache tracks the PRE-split item: ack only after every
+            # message lands, and stop on the first nack so the whole item is
+            # parked exactly once (resend replays it from the start)
             for m in msgs:
-                self._collect(m)
+                if not self._collect(m, ack=False):
+                    return
+            if self.cache_node is not None:
+                self.cache_node.ack(self._current)
         else:
             self._collect(msgs if len(msgs) != 1 else msgs[0])
 
@@ -120,18 +126,18 @@ class SinkNode(Node):
         return apply_transform(msg, self.fields, self.exclude_fields,
                                self.data_template)
 
-    def _collect(self, payload: Any) -> None:
+    def _collect(self, payload: Any, ack: bool = True) -> bool:
         attempts = 0
         delay = self.retry_interval_ms
         while True:
             try:
                 self.sink.collect(payload)
-                if self.cache_node is not None:
+                if ack and self.cache_node is not None:
                     self.cache_node.ack(self._current)  # drop spilled copy
                 self.results.append(payload)
                 if len(self.results) > 10000:
                     del self.results[:5000]
-                return
+                return True
             except Exception as exc:
                 attempts += 1
                 self.stats.inc_exception(str(exc))
@@ -140,7 +146,7 @@ class SinkNode(Node):
                         # at-least-once: park the item in the sink cache; its
                         # resend loop re-delivers when the sink recovers
                         self.cache_node.nack(self._current)
-                        return
+                        return False
                     raise
                 timex.sleep(delay)
                 delay = min(delay * 2, 30_000)

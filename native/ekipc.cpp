@@ -377,7 +377,10 @@ int64_t eks_recv(int h, uint8_t **out, int timeout_ms) {
         *out = p;
         return n;
       }
-      if (rc == EK_CLOSED && c.inbuf.size() < 4) {
+      // peer hung up and no complete frame is buffered (pop_frame above
+      // returned false) — a partial frame can never complete, so drop the
+      // conn now; keeping it would busy-spin on a dead POLLIN fd
+      if (rc == EK_CLOSED) {
         close(c.fd);
         s->conns.erase(s->conns.begin() + i);
         // a PAIR peer hanging up means the channel is done

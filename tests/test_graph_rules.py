@@ -206,3 +206,34 @@ def test_graph_rule_through_registry():
     rows = [r for g in got for r in (g if isinstance(g, list) else [g])]
     assert [r["v"] for r in rows] == [1]
     assert status["status"] in ("running", "stopped")
+
+
+def test_graph_function_then_filter_batch():
+    """Regression: a function node fed a multi-row ColumnBatch must emit rows
+    that downstream filter/pick nodes actually process (they ignored bare
+    Python lists), so filtering applies per row."""
+    from ekuiper_tpu.data.batch import from_tuples
+    from ekuiper_tpu.data.rows import Tuple
+    from ekuiper_tpu.planner.graph import _GraphFuncNode, _parse_fields
+    from ekuiper_tpu.runtime.nodes_ops import FilterNode
+    from ekuiper_tpu.sql.parser import Parser
+
+    fn = _GraphFuncNode("fn", _parse_fields(["v * 2 as dbl"]), is_agg=False)
+    flt = FilterNode("flt", Parser("dbl > 4").parse_expr())
+    out = []
+
+    class _Cap:
+        name = "cap"
+
+        def put(self, item):
+            out.append(item)
+
+    fn.outputs.append(flt)
+    flt.outputs.append(_Cap())
+    batch = from_tuples([Tuple(message={"v": v}) for v in (1, 2, 3, 4)])
+    fn.process(batch)
+    # drain the filter's input queue synchronously (no worker threads here)
+    while not flt.inq.empty():
+        flt.process(flt.inq.get_nowait())
+    vals = sorted(r.value("dbl")[0] for r in out)
+    assert vals == [6, 8]
