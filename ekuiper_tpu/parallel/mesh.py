@@ -36,3 +36,77 @@ def make_mesh(
         raise ValueError(f"mesh {rows}x{keys} != {n} devices")
     arr = np.asarray(devs).reshape(rows, keys)
     return Mesh(arr, ("rows", "keys"))
+
+
+def ensure_devices(n: int, allow_backend_reset: bool = False):
+    """Return at least n jax devices, provisioning virtual CPU devices when
+    the host has fewer physical chips.
+
+    Order of preference: real devices of the default platform; an existing
+    CPU backend with >= n devices; a fresh CPU backend forced to n devices
+    via the jax_num_cpu_devices config. The sharded path takes explicit
+    devices everywhere, so the default platform does not need to change —
+    a mesh of CPU devices runs on CPU even while the TPU stays default.
+
+    allow_backend_reset: when the CPU device count is already locked in,
+    provisioning requires clearing ALL initialized jax backends — which
+    invalidates every live device array process-wide. Only standalone
+    entry points (the driver dryrun) may do that; the planner must never
+    (a running rule's state lives on those backends)."""
+    import jax
+
+    if n < 1:
+        raise ValueError(f"need a positive device count, got {n}")
+    devs = jax.devices()
+    if len(devs) >= n:
+        return devs[:n]
+    try:
+        cpus = jax.devices("cpu")
+        if len(cpus) >= n:
+            return cpus[:n]
+    except RuntimeError:
+        pass
+
+    def _reset_backends():
+        from jax._src import xla_bridge as xb
+
+        xb._clear_backends()
+        # get_backend memoizes clients independently of _backends; without
+        # this the old 1-device CPU client survives the clear
+        if hasattr(xb.get_backend, "cache_clear"):
+            xb.get_backend.cache_clear()
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except RuntimeError:
+        # CPU count already locked in by an initialized backend
+        if not allow_backend_reset:
+            raise RuntimeError(
+                f"host has {len(devs)} devices and the jax backend is "
+                f"already initialized; cannot provision {n} virtual CPU "
+                "devices without resetting live backends"
+            )
+        _reset_backends()
+        jax.config.update("jax_num_cpu_devices", n)
+    cpus = jax.devices("cpu")
+    if len(cpus) < n and allow_backend_reset:
+        _reset_backends()
+        cpus = jax.devices("cpu")
+    if len(cpus) < n:
+        raise RuntimeError(
+            f"could not provision {n} devices (got {len(cpus)} cpu)"
+        )
+    return cpus[:n]
+
+
+def mesh_from_options(mesh_cfg: dict):
+    """Build a mesh from a rule's planOptimizeStrategy.mesh option, e.g.
+    {"rows": 2, "keys": 4}. Uses existing devices only (real chips, or the
+    virtual CPU mesh the test/dryrun environment pre-provisions) — planning
+    a rule never resets jax backends out from under running rules."""
+    rows = int(mesh_cfg.get("rows", 1))
+    keys = int(mesh_cfg.get("keys", 1))
+    if rows < 1 or keys < 1:
+        raise ValueError(f"mesh axes must be positive, got {rows}x{keys}")
+    devices = ensure_devices(rows * keys)
+    return make_mesh(rows=rows, keys=keys, devices=devices)

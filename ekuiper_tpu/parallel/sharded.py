@@ -1,25 +1,31 @@
-"""Sharded GROUP BY aggregation step — the multi-chip form of ops/groupby.py.
+"""Sharded GROUP BY aggregation — the multi-chip form of ops/groupby.py.
 
 SPMD layout over a Mesh(("rows", "keys")):
 
-- event batch columns + slot ids: sharded over "rows" (data parallel);
-- per-key partial state (capacity axis): sharded over "keys" — each device
-  owns capacity/K contiguous slots;
+- event batch columns + slot ids + validity masks: sharded over "rows"
+  (data parallel);
+- per-key partial state (n_panes, capacity, k): capacity axis sharded over
+  "keys" — each device owns capacity/K contiguous slots;
 - fold (shard_map): every device folds ITS row shard into a local partial
   for ITS key range (rows whose slot falls outside the local range mask
-  out), then `psum` over "rows" merges the row-shards. No gather of raw
-  events ever happens — only the (capacity/K, n_specs) partials move, and
-  only across the rows axis;
-- finalize: local finalize per key shard, `all_gather` over "keys" at
-  window triggers only.
+  out), then one `psum`/`pmin`/`pmax` per state component merges the
+  row-shards. No gather of raw events ever happens — only the
+  (capacity/K, k) partials move, and only across the rows axis;
+- finalize: inherited from DeviceGroupBy (pane-mask merge + final values);
+  XLA all_gathers the sharded capacity axis only at window triggers.
+
+ShardedGroupBy subclasses DeviceGroupBy so pane semantics (hopping
+windows), per-column validity masks, grow(), checkpointing, and the
+finalize math are all the *same code* as the single-chip path — parity by
+construction. Only state placement and the fold step differ.
 
 This mirrors the scaling-book recipe: pick the mesh, shard the state/batch,
 let XLA insert the collectives, keep them on ICI.
 
-The same code drives the 256-rule fan-out config: rules are batched on a
-leading axis and vmapped, so one compiled program serves all homogeneous
-rules per step (reference analogue: subtopo shared-source fan-out,
-internal/topo/subtopo_pool.go:34).
+Reference analogue: the process-level scale-out of
+internal/topo/subtopo_pool.go:34 (N rules sharing source fan-out) becomes a
+device mesh here; the cross-worker merge the reference never needs (each Go
+rule is single-process) is the psum over "rows".
 """
 from __future__ import annotations
 
@@ -27,82 +33,82 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..ops.aggspec import KernelPlan
-from ..ops.groupby import _INIT
-
-COMPONENTS = ("n", "s1", "s2", "mn", "mx")
+from ..ops.aggspec import KernelPlan, WIDE_COMPONENTS
+from ..ops.groupby import DeviceGroupBy, _INIT
 
 
-class ShardedGroupBy:
+class ShardedGroupBy(DeviceGroupBy):
     """Multi-chip group-by aggregation over a ("rows", "keys") mesh.
 
-    State layout: {comp: (capacity, n_specs_for_comp)} with capacity sharded
-    over "keys". Batch layout: cols (N,), slots (N,) sharded over "rows".
+    State layout matches DeviceGroupBy: {comp: (n_panes, capacity, k[, R])},
+    act (n_panes, capacity), with capacity sharded over "keys". Batch
+    layout: cols/valid/slots (N,) sharded over "rows".
     """
 
     def __init__(
         self, plan: KernelPlan, mesh, capacity: int = 16384,
-        micro_batch: int = 4096,
+        n_panes: int = 1, micro_batch: int = 4096,
     ) -> None:
-        import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        self.plan = plan
         self.mesh = mesh
-        self.capacity = capacity
-        self.micro_batch = micro_batch
-        self.n_keys_shards = mesh.shape["keys"]
-        self.n_row_shards = mesh.shape["rows"]
-        if capacity % self.n_keys_shards != 0:
-            raise ValueError("capacity must divide evenly across the keys axis")
-        if micro_batch % self.n_row_shards != 0:
-            raise ValueError(
-                f"micro_batch {micro_batch} must divide evenly across the "
-                f"rows axis ({self.n_row_shards} shards)"
-            )
-        self.comp_specs: Dict[str, List[int]] = {}
-        for i, spec in enumerate(plan.specs):
-            for comp in spec.components:
-                self.comp_specs.setdefault(comp, []).append(i)
-
-        from ..ops.aggspec import WIDE_COMPONENTS
-
+        self.n_keys_shards = int(mesh.shape["keys"])
+        self.n_row_shards = int(mesh.shape["rows"])
+        # round capacity / micro_batch up to even divisibility across shards
+        K, R = self.n_keys_shards, self.n_row_shards
+        capacity = -(-int(capacity) // K) * K
+        micro_batch = -(-int(micro_batch) // R) * R
+        super().__init__(plan, capacity=capacity, n_panes=n_panes,
+                         micro_batch=micro_batch)
         self.state_sharding = {
             comp: NamedSharding(
                 mesh,
-                P("keys", None, None) if comp in WIDE_COMPONENTS else P("keys", None),
+                P(None, "keys", None, None) if comp in WIDE_COMPONENTS
+                else P(None, "keys", None),
             )
             for comp in self.comp_specs
         }
-        self.state_sharding["act"] = NamedSharding(mesh, P("keys"))
+        self.state_sharding["act"] = NamedSharding(mesh, P(None, "keys"))
         self.batch_sharding = NamedSharding(mesh, P("rows"))
-
-        self._fold = self._build_fold()
-        self._finalize = self._build_finalize()
+        self.scalar_sharding = NamedSharding(mesh, P())
+        self._fold = self._build_fold()  # replaces the single-chip jit
+        self._all_true = None  # cached device ones-mask (common no-null case)
 
     # ------------------------------------------------------------------ state
-    def init_state(self):
+    def init_state(self) -> Dict[str, Any]:
         import jax
-        import jax.numpy as jnp
 
-        from ..ops.aggspec import WIDE_COMPONENTS
-        from ..ops.groupby import _wide_size
+        return {
+            comp: jax.device_put(arr, self.state_sharding[comp])
+            for comp, arr in super().init_state().items()
+        }
 
-        def mk(comp):
-            if comp == "act":
-                shape = (self.capacity,)
-            else:
-                shape = (self.capacity, len(self.comp_specs[comp]))
-                if comp in WIDE_COMPONENTS:
-                    shape = shape + (_wide_size(comp),)
-            return jax.device_put(
-                jnp.full(shape, _INIT[comp], dtype=jnp.float32),
-                self.state_sharding[comp],
+    def grow(self, state: Dict[str, Any], new_capacity: int) -> Dict[str, Any]:
+        """Double the key capacity, preserving partials. The host roundtrip
+        re-distributes slots to their new owner shard (global slot s lives on
+        shard s // (capacity/K), so ranges shift when capacity grows)."""
+        import jax
+
+        new_capacity = -(-int(new_capacity) // self.n_keys_shards) * self.n_keys_shards
+        out: Dict[str, Any] = {}
+        for comp, arr in state.items():
+            np_arr = np.asarray(arr)
+            pad_shape = list(np_arr.shape)
+            pad_shape[1] = new_capacity - np_arr.shape[1]
+            pad = np.full(pad_shape, _INIT[comp], dtype=np_arr.dtype)
+            out[comp] = jax.device_put(
+                np.concatenate([np_arr, pad], axis=1), self.state_sharding[comp]
             )
+        self.capacity = new_capacity
+        return out
 
-        state = {comp: mk(comp) for comp in self.comp_specs}
-        state["act"] = mk("act")
-        return state
+    def state_from_host(self, host: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        import jax
+
+        return {
+            k: jax.device_put(np.asarray(v), self.state_sharding[k])
+            for k, v in host.items()
+        }
 
     # ------------------------------------------------------------------- fold
     def _build_fold(self):
@@ -117,13 +123,14 @@ class ShardedGroupBy:
 
         comp_specs = self.comp_specs
         plan = self.plan
-        cap_per_shard = self.capacity // self.n_keys_shards
 
-        def local_fold(state, cols, slots, row_valid):
+        def local_fold(state, cols, slots, row_valid, pane_idx):
             """Runs per device: fold my row shard into my key range, then
-            psum partials across the rows axis."""
+            merge partials across the rows axis with one collective per
+            state component."""
+            cap_per_shard = state["act"].shape[1]
             kidx = jax.lax.axis_index("keys")
-            offset = kidx * cap_per_shard
+            offset = (kidx * cap_per_shard).astype(slots.dtype)
             local = slots - offset
             in_range = jnp.logical_and(local >= 0, local < cap_per_shard)
             base = jnp.logical_and(row_valid, in_range)
@@ -131,14 +138,21 @@ class ShardedGroupBy:
                 base = jnp.logical_and(base, plan.filter(cols))
             local = jnp.clip(local, 0, cap_per_shard - 1)
 
-            per_spec = []
+            # same per-spec value/mask derivation as the single-chip fold:
+            # per-column validity masks compose into per-spec masks
+            per_spec: List[Tuple[Any, Any]] = []
             for spec in plan.specs:
                 if spec.arg is None:
                     v = jnp.ones_like(base, dtype=jnp.float32)
                     m = base
                 else:
                     v = spec.arg(cols).astype(jnp.float32)
-                    m = jnp.logical_and(base, jnp.logical_not(jnp.isnan(v)))
+                    m = base
+                    for col in spec.arg.columns:
+                        vm = cols.get("__valid_" + col)
+                        if vm is not None:
+                            m = jnp.logical_and(m, vm)
+                    m = jnp.logical_and(m, jnp.logical_not(jnp.isnan(v)))
                 if spec.filter is not None:
                     m = jnp.logical_and(m, spec.filter(cols))
                 per_spec.append((v, m))
@@ -147,88 +161,100 @@ class ShardedGroupBy:
             act_add = jnp.zeros((cap_per_shard,), jnp.float32).at[local].add(
                 base.astype(jnp.float32)
             )
-            out["act"] = state["act"] + jax.lax.psum(act_add, "rows")
+            out["act"] = state["act"].at[pane_idx].add(
+                jax.lax.psum(act_add, "rows")
+            )
             for comp, spec_idxs in comp_specs.items():
                 arr = state[comp]
-                adds = []
-                for k, si in enumerate(spec_idxs):
+                parts = []
+                for si in spec_idxs:
                     v, m = per_spec[si]
                     mf = m.astype(jnp.float32)
                     if comp == "n":
-                        col = jnp.zeros((cap_per_shard,), jnp.float32).at[local].add(mf)
-                        col = jax.lax.psum(col, "rows")
-                        adds.append(arr[:, k] + col)
+                        parts.append(
+                            jnp.zeros((cap_per_shard,), jnp.float32)
+                            .at[local].add(mf)
+                        )
                     elif comp == "s1":
-                        col = jnp.zeros((cap_per_shard,), jnp.float32).at[local].add(
-                            jnp.where(m, v, 0.0)
+                        parts.append(
+                            jnp.zeros((cap_per_shard,), jnp.float32)
+                            .at[local].add(jnp.where(m, v, 0.0))
                         )
-                        adds.append(arr[:, k] + jax.lax.psum(col, "rows"))
                     elif comp == "s2":
-                        col = jnp.zeros((cap_per_shard,), jnp.float32).at[local].add(
-                            jnp.where(m, v * v, 0.0)
+                        parts.append(
+                            jnp.zeros((cap_per_shard,), jnp.float32)
+                            .at[local].add(jnp.where(m, v * v, 0.0))
                         )
-                        adds.append(arr[:, k] + jax.lax.psum(col, "rows"))
                     elif comp == "mn":
-                        col = jnp.full((cap_per_shard,), jnp.inf, jnp.float32).at[
-                            local
-                        ].min(jnp.where(m, v, jnp.inf))
-                        col = jax.lax.pmin(col, "rows")
-                        adds.append(jnp.minimum(arr[:, k], col))
+                        parts.append(
+                            jnp.full((cap_per_shard,), jnp.inf, jnp.float32)
+                            .at[local].min(jnp.where(m, v, jnp.inf))
+                        )
                     elif comp == "mx":
-                        col = jnp.full((cap_per_shard,), -jnp.inf, jnp.float32).at[
-                            local
-                        ].max(jnp.where(m, v, -jnp.inf))
-                        col = jax.lax.pmax(col, "rows")
-                        adds.append(jnp.maximum(arr[:, k], col))
+                        parts.append(
+                            jnp.full((cap_per_shard,), -jnp.inf, jnp.float32)
+                            .at[local].max(jnp.where(m, v, -jnp.inf))
+                        )
                     elif comp == "hll":
                         from ..ops.sketches import hll_parts
 
                         reg, rho = hll_parts(v)
-                        wide = jnp.zeros(
-                            (cap_per_shard, arr.shape[-1]), jnp.float32
-                        ).at[local, reg].max(jnp.where(m, rho, 0.0))
-                        wide = jax.lax.pmax(wide, "rows")
-                        adds.append(jnp.maximum(arr[:, k, :], wide))
+                        parts.append(
+                            jnp.zeros((cap_per_shard, arr.shape[-1]), jnp.float32)
+                            .at[local, reg].max(jnp.where(m, rho, 0.0))
+                        )
                     elif comp == "hist":
                         from ..ops.sketches import hist_bin
 
                         b = hist_bin(v)
-                        wide = jnp.zeros(
-                            (cap_per_shard, arr.shape[-1]), jnp.float32
-                        ).at[local, b].add(mf)
-                        adds.append(arr[:, k, :] + jax.lax.psum(wide, "rows"))
-                out[comp] = jnp.stack(adds, axis=1)
+                        parts.append(
+                            jnp.zeros((cap_per_shard, arr.shape[-1]), jnp.float32)
+                            .at[local, b].add(mf)
+                        )
+                stacked = jnp.stack(parts, axis=1)  # (cap, k[, R])
+                if comp in ("n", "s1", "s2", "hist"):
+                    merged = jax.lax.psum(stacked, "rows")
+                    out[comp] = arr.at[pane_idx].add(merged)
+                elif comp == "mn":
+                    merged = jax.lax.pmin(stacked, "rows")
+                    out[comp] = arr.at[pane_idx].min(merged)
+                else:  # mx, hll merge by max
+                    merged = jax.lax.pmax(stacked, "rows")
+                    out[comp] = arr.at[pane_idx].max(merged)
             return out
 
-        from ..ops.aggspec import WIDE_COMPONENTS
-
         state_specs = {
-            comp: P("keys", None, None) if comp in WIDE_COMPONENTS
-            else P("keys", None)
+            comp: P(None, "keys", None, None) if comp in WIDE_COMPONENTS
+            else P(None, "keys", None)
             for comp in comp_specs
         }
-        state_specs["act"] = P("keys")
+        state_specs["act"] = P(None, "keys")
+        cols_specs: Dict[str, Any] = {}
+        for name in plan.columns:
+            cols_specs[name] = P("rows")
+            cols_specs["__valid_" + name] = P("rows")
 
-        def step(state, cols, slots, row_valid):
+        def step(state, cols, slots, row_valid, pane_idx):
             return shard_map(
                 local_fold,
                 mesh=self.mesh,
-                in_specs=(
-                    state_specs,
-                    {name: P("rows") for name in cols},
-                    P("rows"),
-                    P("rows"),
-                ),
+                in_specs=(state_specs, cols_specs, P("rows"), P("rows"), P()),
                 out_specs=state_specs,
-            )(state, cols, slots, row_valid)
-
-        import jax
+            )(state, cols, slots, row_valid, pane_idx)
 
         return jax.jit(step, donate_argnums=(0,))
 
-    def fold(self, state, cols: Dict[str, np.ndarray], slots: np.ndarray):
-        """Host entry: pad to micro_batch (divisible by row shards), upload
-        with shardings, run the SPMD step."""
+    def fold(
+        self,
+        state: Dict[str, Any],
+        cols: Dict[str, np.ndarray],
+        slots: np.ndarray,
+        valid: Optional[Dict[str, np.ndarray]] = None,
+        pane_idx: int = 0,
+    ) -> Dict[str, Any]:
+        """Host entry: chunk/pad to the static micro_batch, upload with
+        row shardings, run the SPMD step. Signature matches DeviceGroupBy
+        so FusedWindowAggNode drives either interchangeably."""
         import jax
         import jax.numpy as jnp
 
@@ -236,7 +262,11 @@ class ShardedGroupBy:
 
         n = len(slots)
         mb = self.micro_batch
+        valid = valid or {}
         cols = materialize_hll_columns(self.plan.columns, cols, n)
+        pane = jax.device_put(
+            jnp.asarray(pane_idx, dtype=jnp.int32), self.scalar_sharding
+        )
         for start in range(0, max(n, 1), mb):
             end = min(start + mb, n)
             cnt = end - start
@@ -249,7 +279,25 @@ class ShardedGroupBy:
                 if pad:
                     arr = np.pad(arr, (0, pad))
                 dev_cols[name] = jax.device_put(arr, self.batch_sharding)
-            s = slots[start:end].astype(np.int32)
+                # masks are always materialized (all-true when absent) so the
+                # shard_map pytree structure is static across batches; the
+                # all-true mask is one cached device buffer, not a per-batch
+                # host allocation + upload
+                vmask = valid.get(name)
+                if vmask is not None:
+                    vm = np.asarray(vmask[start:end], dtype=np.bool_)
+                    if pad:
+                        vm = np.pad(vm, (0, pad))
+                    dev_cols["__valid_" + name] = jax.device_put(
+                        vm, self.batch_sharding
+                    )
+                else:
+                    if self._all_true is None:
+                        self._all_true = jax.device_put(
+                            np.ones(mb, dtype=np.bool_), self.batch_sharding
+                        )
+                    dev_cols["__valid_" + name] = self._all_true
+            s = np.asarray(slots[start:end], dtype=np.int32)
             if pad:
                 s = np.pad(s, (0, pad))
             rv = np.zeros(mb, dtype=np.bool_)
@@ -259,59 +307,11 @@ class ShardedGroupBy:
                 dev_cols,
                 jax.device_put(s, self.batch_sharding),
                 jax.device_put(rv, self.batch_sharding),
+                pane,
             )
         return state
 
-    # --------------------------------------------------------------- finalize
-    def _build_finalize(self):
-        import jax
-        import jax.numpy as jnp
-
-        comp_specs = self.comp_specs
-        plan = self.plan
-
-        def fin(state):
-            from ..ops.groupby import DeviceGroupBy
-
-            outs = []
-            for i, spec in enumerate(plan.specs):
-                c = {
-                    comp: state[comp][:, comp_specs[comp].index(i)]
-                    for comp in spec.components
-                }
-                outs.append(DeviceGroupBy._final_value(spec, c))
-            outs.append(state["act"])
-            # stacked single output; XLA all_gathers the sharded capacity axis
-            return jnp.stack(outs, axis=0)
-
-        return jax.jit(fin)
-
-    def finalize(self, state, n_keys: int) -> Tuple[List[np.ndarray], np.ndarray]:
-        from ..ops.groupby import apply_int_semantics
-
-        stacked = np.asarray(self._finalize(state))
-        outs = [stacked[i][:n_keys] for i in range(len(self.plan.specs))]
-        act = stacked[-1][:n_keys]
-        outs = apply_int_semantics(self.plan.specs, outs)
-        return outs, act
-
-    def observe_dtypes(self, columns: Dict[str, np.ndarray]) -> None:
-        from ..ops.groupby import observe_int_inputs
-
-        observe_int_inputs(self.plan.specs, columns)
-
-    def reset(self, state):
-        """Zero the window partials in place (jitted, donated) — no host
-        round trip or re-allocation on the per-trigger hot path."""
-        import jax
-        import jax.numpy as jnp
-
-        if not hasattr(self, "_reset"):
-            def do_reset(st):
-                return {
-                    comp: jnp.full_like(arr, _INIT[comp])
-                    for comp, arr in st.items()
-                }
-
-            self._reset = jax.jit(do_reset, donate_argnums=(0,))
-        return self._reset(state)
+    # finalize / reset_pane / state_to_host / observe_dtypes inherited from
+    # DeviceGroupBy: they are plain jit over the (sharded) state arrays, so
+    # XLA keeps the capacity axis sharded and gathers only at the final
+    # np.asarray device->host transfer.

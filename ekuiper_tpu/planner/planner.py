@@ -79,6 +79,7 @@ def merged_options(rule: RuleDef) -> RuleOptionConfig:
         "qos": "qos",
         "concurrency": "concurrency",
         "debug": "debug",
+        "planOptimizeStrategy": "plan_optimize_strategy",
     }
     for k, v in rule.options.items():
         key = alias.get(k, k)
@@ -467,11 +468,20 @@ def _build_device_chain(
     # full fusion: compile HAVING/ORDER/LIMIT/projection into the vectorized
     # emit tail when possible — the whole rule becomes fold + direct emit
     direct = build_direct_emit(stmt, kernel_plan, [d.name for d in dims])
+    mesh = None
+    mesh_cfg = (opts.plan_optimize_strategy or {}).get("mesh")
+    if mesh_cfg:
+        from ..parallel.mesh import mesh_from_options
+
+        try:
+            mesh = mesh_from_options(mesh_cfg)
+        except Exception as exc:
+            raise PlanError(f"cannot build device mesh {mesh_cfg}: {exc}")
     fused = FusedWindowAggNode(
         "window_agg", stmt.window, kernel_plan, dims,
         capacity=opts.key_slots, micro_batch=opts.micro_batch_rows,
         rule_id=rule_id, buffer_length=opts.buffer_length,
-        direct_emit=direct,
+        direct_emit=direct, mesh=mesh,
     )
     topo.add_op(fused)
     src.connect(fused)

@@ -159,27 +159,34 @@ class ScriptOpNode:
                 from ..data.rows import Row, Tuple as RowTuple
 
                 if isinstance(item, ColumnBatch):
-                    rows = [t.message for t in item.to_tuples()]
+                    srcs = item.to_tuples()
                 elif isinstance(item, Row):
-                    rows = [item.all_values()]
+                    srcs = [item]
                 elif isinstance(item, dict):
-                    rows = [item]
+                    srcs = [RowTuple(message=item)]
                 else:
                     self.emit(item)
                     return
-                out: List[Any] = []
-                for msg in rows:
-                    res = self.fn(msg, {})
+                for src in srcs:
+                    meta = getattr(src, "metadata", None) or {}
+                    res = self.fn(src.message if isinstance(src, RowTuple)
+                                  else src.all_values(), dict(meta))
                     if res is None:
                         continue
-                    out.extend(res if isinstance(res, list) else [res])
-                for msg_out in out:
-                    # wrap dicts as Rows so downstream operator nodes
-                    # (filter/pick/switch) process them instead of passing
-                    # an unknown type through
-                    if isinstance(msg_out, dict):
-                        msg_out = RowTuple(message=msg_out)
-                    self.emit(msg_out)
+                    for msg_out in res if isinstance(res, list) else [res]:
+                        # wrap dicts as Rows so downstream operator nodes
+                        # (filter/pick/switch) process them instead of
+                        # passing an unknown type through; keep the source
+                        # tuple's timestamp/metadata/emitter so event-time
+                        # windows downstream still bucket correctly
+                        if isinstance(msg_out, dict):
+                            msg_out = RowTuple(
+                                message=msg_out,
+                                emitter=getattr(src, "emitter", ""),
+                                timestamp=getattr(src, "timestamp", 0),
+                                metadata=meta,
+                            )
+                        self.emit(msg_out)
 
         return _Impl()
 

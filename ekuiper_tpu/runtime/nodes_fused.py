@@ -45,6 +45,7 @@ class FusedWindowAggNode(Node):
         micro_batch: int = 4096,
         rule_id: str = "",
         direct_emit=None,  # ops.emit.DirectEmitPlan — vectorized tail
+        mesh=None,  # jax.sharding.Mesh — run the kernel sharded (parallel/)
         **kw,
     ) -> None:
         super().__init__(name, op_type="op", **kw)
@@ -60,11 +61,20 @@ class FusedWindowAggNode(Node):
             self.n_panes = max((self.length_ms + iv - 1) // iv, 1)
         else:
             self.n_panes = 1
-        self.gb = DeviceGroupBy(
-            plan, capacity=capacity, n_panes=int(self.n_panes),
-            micro_batch=micro_batch,
-        )
-        self.kt = KeyTable(capacity)
+        if mesh is not None:
+            from ..parallel.sharded import ShardedGroupBy
+
+            self.gb = ShardedGroupBy(
+                plan, mesh, capacity=capacity, n_panes=int(self.n_panes),
+                micro_batch=micro_batch,
+            )
+        else:
+            self.gb = DeviceGroupBy(
+                plan, capacity=capacity, n_panes=int(self.n_panes),
+                micro_batch=micro_batch,
+            )
+        # sharded path may round capacity up for even shard division
+        self.kt = KeyTable(self.gb.capacity)
         self.state = None
         self.cur_pane = 0
         self._timer = None

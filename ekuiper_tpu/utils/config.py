@@ -41,6 +41,10 @@ class RuleOptionConfig:
     micro_batch_linger_ms: int = 10
     key_slots: int = 16384  # group-by hash-slot table size per rule
     use_device_kernel: bool = True  # fuse window+agg into a jitted kernel when possible
+    # planOptimizeStrategy analogue (reference: internal/pkg/def/rule.go:55-66);
+    # {"mesh": {"rows": R, "keys": K}} runs the fused kernel sharded over an
+    # R x K device mesh (parallel/sharded.py)
+    plan_optimize_strategy: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass
