@@ -22,8 +22,16 @@ N_DEVICES = 10_000
 BATCH_ROWS = 65_536
 KEY_SLOTS = 16_384
 WARMUP_BATCHES = 3
-MEASURE_SECONDS = 8.0
-WINDOW_EVERY_BATCHES = 16  # emit cadence during the run
+MEASURE_SECONDS = 10.0
+MAX_SECONDS = 75.0  # run past MEASURE_SECONDS until >=50 emit samples
+# ~0.9s windows: the fused node folds the first half on device, pre-issues
+# the finalize at mid-window (~400ms runway for the tunnel round trip), and
+# host-shadows the dying tail (ops/prefinalize.py). At the rule's real 10s
+# cadence the same mechanism gives the device ~95% of rows; the compressed
+# cadence here is only to collect >=50 latency samples.
+WINDOW_EVERY_BATCHES = 96
+PRE_ISSUE_AT = (48, 64, 80)  # retries are no-ops once a fetch lands
+MIN_EMIT_SAMPLES = 50
 BASELINE_MSG_S = 12_000.0
 
 SQL = (
@@ -37,6 +45,7 @@ def main() -> None:
     from ekuiper_tpu.data.batch import ColumnBatch
     from ekuiper_tpu.ops.aggspec import extract_kernel_plan
     from ekuiper_tpu.ops.emit import build_direct_emit
+    from ekuiper_tpu.runtime.events import PreTrigger
     from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
     from ekuiper_tpu.data.rows import WindowRange
     from ekuiper_tpu.sql.parser import parse_select
@@ -51,6 +60,7 @@ def main() -> None:
     node = FusedWindowAggNode(
         "bench", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
         capacity=KEY_SLOTS, micro_batch=BATCH_ROWS, direct_emit=direct,
+        emit_columnar=True,
     )
     node.state = node.gb.init_state()
     emitted = []
@@ -72,26 +82,44 @@ def main() -> None:
                         emitter="demo")
         )
 
-    # warmup: compile fold + finalize
+    # warmup: compile fold + sync finalize + prefinalize components
+    assert node._prefinalize_ok, "bench rule must take the latency-hiding emit"
     for i in range(WARMUP_BATCHES):
         node.process(batches[i % len(batches)])
-    node._emit(WindowRange(0, 10_000))
+    node._emit(WindowRange(0, 10_000))  # sync path (compiles finalize)
+    node.on_pre_trigger(PreTrigger(ts=10_000))
+    node.process(batches[3])
+    node._emit(WindowRange(0, 10_000))  # merged path (compiles components)
+    node.state = node.gb.reset_pane(node.state, 0)
+    node.begin_window_backstop()  # first measured window is covered too
     jax.block_until_ready(node.state)
 
-    # measured run
+    # measured run: the window "closes" right after the last pre-boundary
+    # batch is folded; emit latency = that point -> output messages emitted.
+    # The device finalize was pre-issued PRE_LEAD_BATCHES earlier
+    # (ops/prefinalize.py), so the round trip overlaps the stream.
     emit_latencies = []
     rows_done = 0
     n_batches = 0
+    storm_windows = 0
     t0 = time.time()
-    while time.time() - t0 < MEASURE_SECONDS:
+    while (time.time() - t0 < MEASURE_SECONDS
+           or len(emit_latencies) < MIN_EMIT_SAMPLES):
+        if time.time() - t0 > MAX_SECONDS:
+            break
         node.process(batches[n_batches % len(batches)])
         rows_done += BATCH_ROWS
         n_batches += 1
-        if n_batches % WINDOW_EVERY_BATCHES == 0:
+        m = n_batches % WINDOW_EVERY_BATCHES
+        if m in PRE_ISSUE_AT:
+            node.on_pre_trigger(PreTrigger(ts=0))
+        elif m == 0:
             t_emit = time.time()
             node._emit(WindowRange(0, 10_000))
             emit_latencies.append((time.time() - t_emit) * 1000)
             node.state = node.gb.reset_pane(node.state, 0)
+            node.begin_window_backstop()
+            storm_windows += 1 if node._storm else 0
     jax.block_until_ready(node.state)
     elapsed = time.time() - t0
 
@@ -99,9 +127,9 @@ def main() -> None:
     p99 = float(np.percentile(emit_latencies, 99)) if emit_latencies else 0.0
     p50 = float(np.percentile(emit_latencies, 50)) if emit_latencies else 0.0
 
-    # decompose emit latency: device finalize+transfer vs host tail — on a
-    # tunneled chip the former is dominated by RTT, not compute
-    fin_ms, tail_ms = [], []
+    # decompose emit latency: sync device finalize+transfer (what a naive
+    # emit would pay, dominated by tunnel RTT) vs the merged path's pieces
+    fin_ms, merge_ms, tail_ms = [], [], []
     for b in batches:  # repopulate: decomposition needs a live window
         node.process(b)
     outs, act = node.gb.finalize(node.state, node.kt.n_keys)
@@ -111,15 +139,22 @@ def main() -> None:
         t = time.time()
         outs, act = node.gb.finalize(node.state, node.kt.n_keys)
         fin_ms.append((time.time() - t) * 1000)
+        pending = node.gb.prefinalize_begin(node.state)
+        pending.get()
+        t = time.time()
+        node.gb.prefinalize_merge(pending, None, node.kt.n_keys)
+        merge_ms.append((time.time() - t) * 1000)
         t = time.time()
         node._emit_direct(outs, active, WindowRange(0, 10_000))
         tail_ms.append((time.time() - t) * 1000)
 
     print(
         f"# {rows_done:,} rows in {elapsed:.2f}s over {n_batches} batches; "
-        f"emit p50={p50:.1f}ms p99={p99:.1f}ms "
-        f"(finalize/transfer p50={np.percentile(fin_ms, 50):.1f}ms, "
-        f"host tail p50={np.percentile(tail_ms, 50):.1f}ms); "
+        f"emit p50={p50:.1f}ms p99={p99:.1f}ms over {len(emit_latencies)} samples "
+        f"(sync finalize/transfer p50={np.percentile(fin_ms, 50):.1f}ms, "
+        f"prefinalize merge p50={np.percentile(merge_ms, 50):.1f}ms, "
+        f"host tail p50={np.percentile(tail_ms, 50):.1f}ms; "
+        f"storm windows={storm_windows}); "
         f"groups/window={N_DEVICES}; device={jax.devices()[0].device_kind}",
         file=sys.stderr,
     )

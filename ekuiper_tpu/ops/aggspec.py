@@ -153,6 +153,10 @@ class AggSpec:
     filter: Optional[CompiledExpr]  # FILTER(WHERE ...) device closure
     int_input: bool = False  # observed integer input → integer avg/sum results
     frac: float = 0.5  # percentile_approx quantile (2nd literal arg)
+    # numpy twins of arg/filter, used by the latency-hiding tail shadow
+    # (ops/prefinalize.py); None when the expr only compiles for device
+    arg_host: Optional[CompiledExpr] = None
+    filter_host: Optional[CompiledExpr] = None
 
     @property
     def is_star(self) -> bool:
@@ -166,6 +170,20 @@ class KernelPlan:
     specs: List[AggSpec]
     filter: Optional[CompiledExpr]  # WHERE clause (device)
     columns: Set[str] = field(default_factory=set)  # numeric columns to upload
+    filter_host: Optional[CompiledExpr] = None  # numpy twin of `filter`
+
+    @property
+    def host_foldable(self) -> bool:
+        """True when every closure has a numpy twin, so a tail of rows can be
+        folded on host by the pre-finalize emit pipeline."""
+        if self.filter is not None and self.filter_host is None:
+            return False
+        for s in self.specs:
+            if s.arg is not None and s.arg_host is None:
+                return False
+            if s.filter is not None and s.filter_host is None:
+                return False
+        return True
 
 
 def extract_kernel_plan(
@@ -201,6 +219,7 @@ def extract_kernel_plan(
                     return None
             elif len(call.args) != 1:
                 return None
+            arg_host: Optional[CompiledExpr] = None
             if kind in ("hll", "distinct_count_approx") and isinstance(
                 call.args[0], ast.FieldRef
             ):
@@ -208,16 +227,24 @@ def extract_kernel_plan(
                 arg_ce = CompiledExpr(
                     lambda cols, _h=hcol: cols[_h], {hcol}, "device"
                 )
+                arg_host = CompiledExpr(
+                    lambda cols, _h=hcol: cols[_h], {hcol}, "host"
+                )
             else:
                 arg_ce = try_compile(call.args[0], mode="device")
                 if arg_ce is None:
                     return None
+                arg_host = try_compile(call.args[0], mode="host")
             columns |= arg_ce.columns
+        else:
+            arg_host = None
         filter_ce: Optional[CompiledExpr] = None
+        filter_host: Optional[CompiledExpr] = None
         if call.filter is not None:
             filter_ce = try_compile(call.filter, mode="device")
             if filter_ce is None:
                 return None
+            filter_host = try_compile(call.filter, mode="host")
             columns |= filter_ce.columns
         specs.append(
             AggSpec(
@@ -227,15 +254,20 @@ def extract_kernel_plan(
                 arg=arg_ce,
                 filter=filter_ce,
                 frac=frac,
+                arg_host=arg_host,
+                filter_host=filter_host,
             )
         )
     where_ce: Optional[CompiledExpr] = None
+    where_host: Optional[CompiledExpr] = None
     if stmt.condition is not None and where_on_device:
         where_ce = try_compile(stmt.condition, mode="device")
         if where_ce is None:
             return None  # caller may retry with host-side where
+        where_host = try_compile(stmt.condition, mode="host")
         columns |= where_ce.columns
-    return KernelPlan(specs=specs, filter=where_ce, columns=columns)
+    return KernelPlan(specs=specs, filter=where_ce, columns=columns,
+                      filter_host=where_host)
 
 
 def _collect_agg_calls(stmt: ast.SelectStatement) -> List[ast.Call]:

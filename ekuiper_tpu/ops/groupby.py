@@ -101,7 +101,12 @@ class DeviceGroupBy:
         # stacked array -> a single device->host transfer per window emit
         # (sync round trips cost 10-90ms on tunneled TPU; see bench notes)
         self._finalize = jax.jit(self._finalize_impl, static_argnums=(1,))
+        self._components = jax.jit(self._components_impl, static_argnums=(1,))
         self._reset_pane = jax.jit(self._reset_pane_impl, donate_argnums=(0,))
+
+    #: the latency-hiding emit pipeline (ops/prefinalize.py) works here;
+    #: the sharded subclass opts out (its finalize runs collective gathers)
+    supports_prefinalize = True
 
     # ------------------------------------------------------------------ state
     def init_state(self) -> Dict[str, Any]:
@@ -183,18 +188,25 @@ class DeviceGroupBy:
             s = slots[start:end]
             if pad:
                 s = np.pad(s, (0, pad))
-            row_valid = np.zeros(mb, dtype=np.bool_)
-            row_valid[:cnt] = True
+            # tunnel-byte diet: slots ship as uint16 when capacity allows
+            # (halves the largest upload), and row validity ships as ONE
+            # scalar count compared against an iota on device instead of an
+            # mb-byte bool mask — HBM/link bandwidth is the bottleneck, not
+            # device compute
+            if self.capacity <= 65535:
+                s = s.astype(np.uint16)
             state = self._fold(
-                state, dev_cols, jnp.asarray(s), jnp.asarray(row_valid),
+                state, dev_cols, jnp.asarray(s),
+                jnp.asarray(cnt, dtype=jnp.int32),
                 jnp.asarray(pane_idx, dtype=jnp.int32),
             )
         return state
 
-    def _fold_impl(self, state, cols, slots, row_valid, pane_idx):
+    def _fold_impl(self, state, cols, slots, n_valid, pane_idx):
         import jax.numpy as jnp
 
-        base = row_valid
+        slots = slots.astype(jnp.int32)
+        base = jnp.arange(self.micro_batch, dtype=jnp.int32) < n_valid
         if self.plan.filter is not None:
             base = jnp.logical_and(base, self.plan.filter(cols))
         # per-column validity composes into per-spec masks below
@@ -322,6 +334,90 @@ class DeviceGroupBy:
             return hist_quantile(c["hist"], spec.frac)
         raise ValueError(f"unknown device agg kind {kind}")
 
+    def _components_layout(self):
+        """(comp, col_start, width, per-key shape) for the stacked
+        components array; one flat (capacity, W) f32 array means ONE device
+        leaf -> one transfer/wait round trip on a tunneled chip (per-leaf
+        waits cost ~an RTT each)."""
+        from .aggspec import WIDE_COMPONENTS
+
+        layout = []
+        col = 0
+        for comp in sorted(self.comp_specs):
+            shape: Tuple[int, ...] = (len(self.comp_specs[comp]),)
+            if comp in WIDE_COMPONENTS:
+                shape = shape + (_wide_size(comp),)
+            w = int(np.prod(shape))
+            layout.append((comp, col, w, shape))
+            col += w
+        layout.append(("act", col, 1, ()))
+        return layout
+
+    def _components_impl(self, state, pane_mask_tuple):
+        """Pane-merged raw components (not final values), stacked into one
+        (capacity, W) array — the device half of the latency-hiding emit
+        (ops/prefinalize.py). Final values are computed on host after the
+        tail shadow is merged in."""
+        import jax.numpy as jnp
+
+        pane_mask = np.array(pane_mask_tuple, dtype=np.bool_)
+        parts = []
+        for comp in sorted(self.comp_specs):
+            m = self._merged(state, comp, pane_mask)
+            parts.append(m.reshape(m.shape[0], -1))
+        act = self._merged(state, "act", pane_mask)
+        parts.append(act.reshape(-1, 1))
+        return jnp.concatenate(parts, axis=1)
+
+    def _pane_mask(self, panes: Optional[List[int]]) -> Tuple[bool, ...]:
+        pane_mask = np.zeros(self.n_panes, dtype=np.bool_)
+        if panes is None:
+            pane_mask[:] = True
+        else:
+            pane_mask[panes] = True
+        return tuple(pane_mask.tolist())
+
+    def prefinalize_begin(self, state: Dict[str, Any],
+                          panes: Optional[List[int]] = None):
+        """Dispatch the components computation and start the async
+        device→host copy; returns a PendingFinalize. Non-blocking: the jax
+        program sees an immutable snapshot of `state`, so subsequent folds
+        don't disturb it."""
+        import jax
+
+        from .prefinalize import PendingFinalize
+
+        out = self._components(state, self._pane_mask(panes))
+        try:
+            out.copy_to_host_async()
+        except AttributeError:
+            pass
+        return PendingFinalize(out, self.capacity, self._components_layout())
+
+    def prefinalize_merge(
+        self, pending, shadow, n_keys: int,
+    ) -> Tuple[List[np.ndarray], np.ndarray]:
+        """Complete a pre-issued finalize: fetch device components (usually
+        already on host), merge the tail shadow, compute final values in
+        numpy. Same (outs, act) contract as finalize()."""
+        from .prefinalize import final_value_np, merge_components
+
+        # capacity may have grown during a frozen tail (new keys live only in
+        # the shadow) — merge at the widest extent so no slot is truncated
+        cap = max(self.capacity,
+                  shadow.capacity if shadow is not None else 0)
+        comb = merge_components(pending.get(), shadow, cap)
+        act = comb["act"]
+        outs: List[np.ndarray] = []
+        for i, spec in enumerate(self.plan.specs):
+            c = {
+                comp: comb[comp][:, self.comp_specs[comp].index(i)]
+                for comp in spec.components
+            }
+            outs.append(np.asarray(final_value_np(spec, c))[:n_keys])
+        outs = apply_int_semantics(self.plan.specs, outs)
+        return outs, np.asarray(act[:n_keys])
+
     def finalize(
         self, state: Dict[str, Any], n_keys: int,
         panes: Optional[List[int]] = None,
@@ -342,6 +438,33 @@ class DeviceGroupBy:
         act = stacked[-1]
         host = apply_int_semantics(self.plan.specs, host)
         return host, np.asarray(act[:n_keys])
+
+    # ----------------------------------------------------------------- absorb
+    def _absorb_impl(self, state, sh, pane_idx):
+        for comp in list(state.keys()):
+            arr = state[comp]
+            u = sh[comp]
+            if comp == "mn":
+                state[comp] = arr.at[pane_idx].min(u)
+            elif comp in ("mx", "hll"):
+                state[comp] = arr.at[pane_idx].max(u)
+            else:
+                state[comp] = arr.at[pane_idx].add(u)
+        return state
+
+    def absorb(self, state: Dict[str, Any], shadow_data: Dict[str, np.ndarray],
+               pane_idx: int) -> Dict[str, Any]:
+        """Merge host-shadow components into one pane of the device state.
+        Used when a checkpoint barrier lands during a host-only window tail
+        (runtime/nodes_fused.py): the shadowed rows are flushed to the device
+        so the snapshot stays complete."""
+        import jax
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_absorb"):
+            self._absorb = jax.jit(self._absorb_impl, donate_argnums=(0,))
+        sh = {k: jnp.asarray(v) for k, v in shadow_data.items()}
+        return self._absorb(state, sh, jnp.asarray(pane_idx, dtype=jnp.int32))
 
     # ------------------------------------------------------------------ reset
     def _reset_pane_impl(self, state, pane_idx):

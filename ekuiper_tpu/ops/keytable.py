@@ -3,9 +3,10 @@
 The reference builds a string group key per row and hashes into a Go map
 (internal/topo/operator/aggregate_operator.go:34-74). On TPU the per-key
 state lives in dense device arrays, so keys must become stable integer slots.
-The key table is the host-side dictionary: batch-vectorized encode via
-np.unique (one dict lookup per *distinct* key per batch, not per row) and a
-reverse list for decoding emitted slots back to key values.
+The key table is the host-side dictionary: a C-level dict map per batch in
+steady state (no sort once all keys are known), a sort-based np.unique path
+for numeric/unicode and unhashable keys, and a reverse list for decoding
+emitted slots back to key values.
 """
 from __future__ import annotations
 
@@ -31,9 +32,66 @@ class KeyTable:
         """Encode a key column to int32 slots. Returns (slots, grew) where
         `grew` signals the device state must be re-allocated (capacity x2).
 
-        np.unique on object arrays does python-level compares (~2M rows/s);
-        numeric keys sort at ~30M rows/s and fixed-width unicode at ~3M, so
-        convert when the column allows it."""
+        Steady-state fast path: one C-level dict lookup per row
+        (map(dict.__getitem__) + np.fromiter ≈ 10M rows/s) — after warmup
+        every key already has a slot, so no sort is needed at all. A KeyError
+        (new key) drops to the insertion loop; unhashable values drop to the
+        sort-based legacy path below."""
+        if col.dtype == np.object_ and len(col):
+            try:
+                return self._encode_hashed(col.tolist())
+            except TypeError:
+                pass  # unhashable elements — legacy sort path
+        return self._encode_sorted(col)
+
+    def _encode_hashed(self, lst: list) -> Tuple[np.ndarray, bool]:
+        """Dict-encode a list of hashable keys. Raises TypeError on
+        unhashable elements (caller falls back to the sort path)."""
+        ids = self._ids
+        n = len(lst)
+        try:
+            return (
+                np.fromiter(map(ids.__getitem__, lst), dtype=np.int32, count=n),
+                False,
+            )
+        except KeyError:
+            pass
+        # miss path: insert new keys. None normalizes to "" (nil-key rule:
+        # null dimensions group under the empty key, reference behavior) but
+        # the raw form is aliased to the same slot so the NEXT batch takes
+        # the zero-miss fast path again.
+        keys = self._keys
+        out = np.empty(n, dtype=np.int32)
+        for i, k in enumerate(lst):
+            slot = ids.get(k)
+            if slot is None:
+                norm = self._normalize(k)
+                slot = ids.get(norm)
+                if slot is None:
+                    slot = len(keys)
+                    ids[norm] = slot
+                    keys.append(norm)
+                if norm is not k:
+                    ids[k] = slot  # alias raw form (None / un-normalized tuple)
+            out[i] = slot
+        grew = False
+        while len(keys) > self.capacity:
+            self.capacity *= 2
+            grew = True
+        return out, grew
+
+    @staticmethod
+    def _normalize(k: Any) -> Any:
+        if k is None:
+            return ""
+        if isinstance(k, tuple):
+            return tuple("" if v is None else v for v in k)
+        return k
+
+    def _encode_sorted(self, col: np.ndarray) -> Tuple[np.ndarray, bool]:
+        """Sort-based encode for numeric/unicode columns and object columns
+        holding unhashable values: np.unique sorts (numeric ~30M rows/s,
+        fixed-width unicode ~3M), then one dict lookup per distinct key."""
         if col.dtype == np.object_ and len(col):
             none_mask = col == None  # noqa: E711 — elementwise None test
             if none_mask.any():
@@ -58,7 +116,13 @@ class KeyTable:
         keys = self._keys
         for i, k in enumerate(uniq):
             k = k.item() if isinstance(k, np.generic) else k
-            slot = ids.get(k)
+            try:
+                slot = ids.get(k)
+            except TypeError:
+                # unhashable key (list/dict): stringify, like the reference's
+                # string group keys (aggregate_operator.go builds a string)
+                k = repr(k)
+                slot = ids.get(k)
             if slot is None:
                 slot = len(keys)
                 ids[k] = slot
@@ -71,19 +135,31 @@ class KeyTable:
         return uids[inverse].astype(np.int32), grew
 
     def encode_multi(self, cols: Sequence[np.ndarray]) -> Tuple[np.ndarray, bool]:
-        """Composite key: tuple of column values per row."""
+        """Composite key: tuple of column values per row. tolist() converts
+        numpy scalars to Python values, zip builds the tuples at C speed, and
+        the hashed path aliases raw (None-bearing) tuples to their normalized
+        slot — so steady state is still one dict lookup per row."""
         if len(cols) == 1:
             return self.encode_column(cols[0])
-        n = len(cols[0])
-        combo = np.empty(n, dtype=np.object_)
-        for i in range(n):
-            # None elements normalize to "" (nil-key rule, see encode_column)
-            combo[i] = tuple(
-                "" if c[i] is None
-                else (c[i].item() if isinstance(c[i], np.generic) else c[i])
-                for c in cols
-            )
-        return self.encode_column(combo)
+        try:
+            combos = list(zip(*(c.tolist() for c in cols)))
+            return self._encode_hashed(combos)
+        except TypeError:
+            pass
+        # unhashable element inside a tuple (list/dict group key): stringify
+        # just those elements so the key stays a per-dim tuple for decode
+        def _h(v):
+            if v is None:
+                return ""
+            try:
+                hash(v)
+                return v
+            except TypeError:
+                return repr(v)
+
+        combos = [tuple(_h(v) for v in row)
+                  for row in zip(*(c.tolist() for c in cols))]
+        return self._encode_hashed(combos)
 
     def decode(self, slot: int) -> Any:
         return self._keys[slot]

@@ -1,0 +1,342 @@
+"""Latency-hiding window emit — pre-issued device finalize + host tail shadow.
+
+Why: on a tunneled TPU one dispatch→result round trip costs 50-90ms, so any
+emit path that *starts* a device round trip at the window boundary can never
+hit the <50ms p99 emit-latency target (BASELINE.md north-star row 2). The
+reference never faces this (its aggregation state lives in process memory,
+internal/topo/node/window_inc_agg_op.go); a TPU-resident design needs an
+explicit latency plan.
+
+The plan, exploiting that tumbling/hopping boundaries are known in advance
+(timex.align_to_window) and that jax arrays are immutable (a dispatched
+program sees a snapshot — no double buffering needed):
+
+  1. One RTT before the boundary, dispatch `components()` on the current
+     state and start an async device→host copy (PendingFinalize). The fold
+     stream continues uninterrupted.
+  2. Rows arriving in the tail window keep folding into the device state
+     (so hopping panes / checkpoints stay complete) AND into a HostShadow —
+     a numpy mirror of the fold kernel over just those rows (~1-2ms per
+     64k-row batch; the tail is a few batches at most).
+  3. At the boundary, merge: device components (already on host or in
+     flight) ⊕ shadow components, then compute final values in numpy.
+     Emit latency = merge + message build, no device round trip.
+
+The shadow folds through the SAME compiled expressions as the device kernel
+(host-mode twins from sql/compiler.py) and mirrors its masking rules
+(ops/groupby.py _fold_impl), so sync and pre-finalized emits agree to float32
+accumulation order.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .aggspec import AggSpec, KernelPlan, WIDE_COMPONENTS
+# identity values / wide register sizes are THE kernel's tables — shared so
+# the host shadow can never drift from the device state layout
+from .groupby import _INIT, _wide_size
+from .sketches import HIST_BINS, HLL_M, _HIST_HALF, _HIST_HI, _HIST_LO, _LOG_GAMMA, _GAMMA
+
+
+def _comp_shape(comp: str, spec_idxs: List[int]):
+    shape = (len(spec_idxs),)
+    if comp in WIDE_COMPONENTS:
+        shape = shape + (_wide_size(comp),)
+    return shape
+
+
+# ------------------------------------------------------- numpy sketch mirrors
+def _splitmix32_np(x: np.ndarray, c1: int, c2: int) -> np.ndarray:
+    x = x.astype(np.uint32)
+    with np.errstate(over="ignore"):
+        x = x ^ (x >> np.uint32(16))
+        x = x * np.uint32(c1)
+        x = x ^ (x >> np.uint32(15))
+        x = x * np.uint32(c2)
+        x = x ^ (x >> np.uint32(16))
+    return x
+
+
+def hash_f32_np(v: np.ndarray, salt: int = 0) -> np.ndarray:
+    bits = np.ascontiguousarray(np.asarray(v, np.float32)).view(np.uint32)
+    bits = bits ^ np.uint32((0x9E3779B9 * (salt + 1)) & 0xFFFFFFFF)
+    return _splitmix32_np(bits, 0x7FEB352D, 0x846CA68B)
+
+
+def hll_parts_np(values: np.ndarray):
+    """Numpy twin of sketches.hll_parts (same float32 rho derivation)."""
+    h1 = hash_f32_np(values, salt=0)
+    h2 = hash_f32_np(values, salt=1)
+    reg = (h1 & np.uint32(HLL_M - 1)).astype(np.int32)
+    hv = np.maximum(h2, np.uint32(1)).astype(np.float32)
+    nbits = np.floor(np.log2(hv)) + np.float32(1.0)
+    rho = (np.float32(33.0) - nbits).astype(np.float32)
+    return reg, rho
+
+
+def hll_estimate_np(registers: np.ndarray) -> np.ndarray:
+    m = registers.shape[-1]
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    z = np.sum(2.0 ** (-registers), axis=-1)
+    raw = alpha * m * m / z
+    zeros = np.sum(registers == 0.0, axis=-1)
+    small = m * np.log(m / np.maximum(zeros, 1).astype(np.float32))
+    return np.where((raw < 2.5 * m) & (zeros > 0), small, raw)
+
+
+def hist_bin_np(values: np.ndarray) -> np.ndarray:
+    v = np.asarray(values, np.float32)
+    clamped = np.clip(np.abs(v), _HIST_LO, _HIST_HI * 0.999)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        mag = np.floor(np.log(clamped / _HIST_LO) / _LOG_GAMMA).astype(np.int32)
+    mag = np.clip(mag, 0, _HIST_HALF - 1)
+    pos = _HIST_HALF + 1 + mag
+    neg = _HIST_HALF - 1 - mag
+    return np.where(v > 0, pos, np.where(v < 0, neg, _HIST_HALF)).astype(np.int32)
+
+
+def hist_quantile_np(hist: np.ndarray, frac: float) -> np.ndarray:
+    total = np.sum(hist, axis=-1)
+    cum = np.cumsum(hist, axis=-1)
+    target = frac * total[..., None]
+    ge = cum >= np.maximum(target, 1e-9)
+    idx = np.argmax(ge, axis=-1)
+    mag_idx = np.where(
+        idx > _HIST_HALF, idx - _HIST_HALF - 1, _HIST_HALF - 1 - idx
+    ).astype(np.float32)
+    center = _HIST_LO * np.exp(mag_idx * _LOG_GAMMA) * float(np.sqrt(_GAMMA))
+    val = np.where(
+        idx == _HIST_HALF, 0.0, np.where(idx > _HIST_HALF, center, -center)
+    )
+    return np.where(total > 0, val, np.nan)
+
+
+# -------------------------------------------------------- numpy final values
+def final_value_np(spec: AggSpec, c: Dict[str, np.ndarray]) -> np.ndarray:
+    """Numpy twin of DeviceGroupBy._final_value."""
+    kind = spec.kind
+    if kind == "count":
+        return c["n"]
+    n = c.get("n")
+    with np.errstate(invalid="ignore", divide="ignore"):
+        if kind == "sum":
+            return np.where(n > 0, c["s1"], np.nan)
+        if kind == "avg":
+            return np.where(n > 0, c["s1"] / np.maximum(n, 1.0), np.nan)
+        if kind == "min":
+            return np.where(n > 0, c["mn"], np.nan)
+        if kind == "max":
+            return np.where(n > 0, c["mx"], np.nan)
+        if kind in ("stddev", "var"):
+            mean = c["s1"] / np.maximum(n, 1.0)
+            v = np.maximum(c["s2"] / np.maximum(n, 1.0) - mean * mean, 0.0)
+            out = np.sqrt(v) if kind == "stddev" else v
+            return np.where(n > 0, out, np.nan)
+        if kind in ("stddevs", "vars"):
+            mean = c["s1"] / np.maximum(n, 1.0)
+            v = np.maximum(
+                (c["s2"] - c["s1"] * mean) / np.maximum(n - 1.0, 1.0), 0.0
+            )
+            out = np.sqrt(v) if kind == "stddevs" else v
+            return np.where(n >= 2, out, np.nan)
+        if kind == "hll":
+            regs = np.maximum(c["hll"], 0.0)
+            return np.round(hll_estimate_np(regs))
+        if kind == "percentile_approx":
+            return hist_quantile_np(c["hist"], spec.frac)
+    raise ValueError(f"unknown device agg kind {kind}")
+
+
+# ------------------------------------------------------------- host shadow
+class HostShadow:
+    """Numpy mirror of the device fold over the tail rows of a closing
+    window. Accumulates the same (n, s1, s2, mn, mx, hll, hist, act)
+    components the device kernel keeps, merged into the pre-issued device
+    result at emit time."""
+
+    def __init__(self, plan: KernelPlan, comp_specs: Dict[str, List[int]],
+                 capacity: int) -> None:
+        self.plan = plan
+        self.comp_specs = comp_specs
+        self.capacity = capacity
+        self.data: Dict[str, np.ndarray] = {}
+        self.n_rows = 0
+        for comp, spec_idxs in comp_specs.items():
+            shape = (capacity,) + _comp_shape(comp, spec_idxs)
+            self.data[comp] = np.full(shape, _INIT[comp], dtype=np.float32)
+        self.data["act"] = np.zeros(capacity, dtype=np.float32)
+
+    def _ensure(self, max_slot: int) -> None:
+        while max_slot >= self.capacity:
+            for comp, arr in self.data.items():
+                pad_shape = (self.capacity,) + arr.shape[1:]
+                pad = np.full(pad_shape, _INIT[comp], dtype=np.float32)
+                self.data[comp] = np.concatenate([arr, pad], axis=0)
+            self.capacity *= 2
+
+    def fold(self, cols: Dict[str, np.ndarray], slots: np.ndarray,
+             valid: Optional[Dict[str, np.ndarray]] = None) -> None:
+        n = len(slots)
+        if n == 0:
+            return
+        self.n_rows += n
+        self._ensure(int(slots.max()) if n else 0)
+        valid = valid or {}
+        cap = self.capacity
+        base = np.ones(n, dtype=np.bool_)
+        if self.plan.filter_host is not None:
+            base &= np.broadcast_to(
+                np.asarray(self.plan.filter_host(cols), dtype=np.bool_), (n,)
+            )
+        self.data["act"] += np.bincount(
+            slots, weights=base.astype(np.float32), minlength=cap
+        )[:cap].astype(np.float32)
+        for i, spec in enumerate(self.plan.specs):
+            if spec.arg is None:
+                v = np.ones(n, dtype=np.float32)
+                m = base
+            else:
+                v = np.broadcast_to(
+                    np.asarray(spec.arg_host(cols), dtype=np.float32), (n,)
+                )
+                m = base
+                for col in spec.arg.columns:
+                    vm = valid.get(col)
+                    if vm is not None:
+                        m = np.logical_and(m, vm)
+                m = np.logical_and(m, ~np.isnan(v))
+            if spec.filter_host is not None:
+                m = np.logical_and(m, np.broadcast_to(
+                    np.asarray(spec.filter_host(cols), dtype=np.bool_), (n,)
+                ))
+            mf = m.astype(np.float32)
+            for comp in spec.components:
+                k = self.comp_specs[comp].index(i)
+                arr = self.data[comp]
+                if comp == "n":
+                    arr[:, k] += np.bincount(slots, weights=mf, minlength=cap)[:cap]
+                elif comp == "s1":
+                    arr[:, k] += np.bincount(
+                        slots, weights=np.where(m, v, 0.0), minlength=cap
+                    )[:cap]
+                elif comp == "s2":
+                    arr[:, k] += np.bincount(
+                        slots, weights=np.where(m, v * v, 0.0), minlength=cap
+                    )[:cap]
+                elif comp == "mn":
+                    if m.any():
+                        np.minimum.at(arr[:, k], slots[m], v[m])
+                elif comp == "mx":
+                    if m.any():
+                        np.maximum.at(arr[:, k], slots[m], v[m])
+                elif comp == "hll":
+                    if m.any():
+                        reg, rho = hll_parts_np(v)
+                        kk = np.full(int(m.sum()), k)
+                        np.maximum.at(arr, (slots[m], kk, reg[m]), rho[m])
+                elif comp == "hist":
+                    if m.any():
+                        b = hist_bin_np(v)
+                        kk = np.full(int(m.sum()), k)
+                        np.add.at(arr, (slots[m], kk, b[m]), 1.0)
+
+
+_MERGE_MAX = {"mn": False, "mx": True, "hll": True}
+
+
+def merge_components(
+    dev: Dict[str, np.ndarray], shadow: Optional[HostShadow], capacity: int,
+) -> Dict[str, np.ndarray]:
+    """Device components ⊕ shadow components. Pads the device result when
+    the key table grew during the tail (new keys exist only in the shadow)."""
+    out: Dict[str, np.ndarray] = {}
+    if shadow is not None and shadow.n_rows:
+        shadow._ensure(capacity - 1)
+    for comp, d in dev.items():
+        if d.shape[0] < capacity:
+            pad_shape = (capacity - d.shape[0],) + d.shape[1:]
+            d = np.concatenate(
+                [d, np.full(pad_shape, _INIT[comp], dtype=d.dtype)], axis=0
+            )
+        if shadow is not None and shadow.n_rows:
+            s = shadow.data[comp][: d.shape[0]]
+            if comp == "mn":
+                d = np.minimum(d, s)
+            elif comp in ("mx", "hll"):
+                d = np.maximum(d, s)
+            else:
+                d = d + s
+        out[comp] = d
+    return out
+
+
+class IdentityFinalize:
+    """Always-ready stand-in for a device components fetch whose state
+    snapshot is EMPTY (identity values). Used by storm mode
+    (runtime/nodes_fused.py): when the device link is stalling, a window
+    runs fully host-shadowed and merges against this identity — emit
+    latency stays bounded while real fetches probe for recovery."""
+
+    def __init__(self, comp_specs: Dict[str, List[int]], capacity: int) -> None:
+        self.capacity = capacity
+        self._comps: Dict[str, np.ndarray] = {}
+        for comp, spec_idxs in comp_specs.items():
+            shape = (capacity,) + _comp_shape(comp, spec_idxs)
+            self._comps[comp] = np.full(shape, _INIT[comp], dtype=np.float32)
+        self._comps["act"] = np.zeros(capacity, dtype=np.float32)
+
+    def ready(self) -> bool:
+        return True
+
+    def get(self) -> Dict[str, np.ndarray]:
+        return self._comps
+
+
+class PendingFinalize:
+    """Handle for an in-flight device components fetch, created one RTT
+    before the window boundary.
+
+    The fetch runs on its own thread from the moment of creation: on a
+    tunneled device the wait-until-ready control call queues FIFO behind
+    subsequently dispatched work, so registering the wait EARLY (before the
+    tail's fold dispatches flood the link) is what makes the result be on
+    host by the time the boundary fires. .get() then just joins the thread.
+    """
+
+    def __init__(self, stacked: Any, capacity: int, layout) -> None:
+        import threading
+
+        self.stacked = stacked  # one (capacity, W) device array = one leaf
+        self.capacity = capacity
+        self.layout = layout  # [(comp, col, width, per-key shape)]
+        self._result: Optional[Dict[str, np.ndarray]] = None
+        self._exc: Optional[BaseException] = None
+        self._done = threading.Event()
+        threading.Thread(
+            target=self._fetch, name="prefinalize-fetch", daemon=True
+        ).start()
+
+    def _fetch(self) -> None:
+        try:
+            arr = np.asarray(self.stacked)
+            cap = arr.shape[0]
+            self._result = {
+                comp: arr[:, col] if shape == () else
+                arr[:, col:col + w].reshape((cap,) + shape)
+                for comp, col, w, shape in self.layout
+            }
+        except BaseException as exc:  # surfaced to the emit thread
+            self._exc = exc
+        finally:
+            self._done.set()
+
+    def ready(self) -> bool:
+        return self._done.is_set()
+
+    def get(self) -> Dict[str, np.ndarray]:
+        self._done.wait()
+        if self._exc is not None:
+            raise self._exc
+        return self._result

@@ -45,6 +45,10 @@ class ShardedGroupBy(DeviceGroupBy):
     layout: cols/valid/slots (N,) sharded over "rows".
     """
 
+    # finalize runs collective gathers across the mesh; the pre-issued
+    # emit pipeline (ops/prefinalize.py) is single-chip only for now
+    supports_prefinalize = False
+
     def __init__(
         self, plan: KernelPlan, mesh, capacity: int = 16384,
         n_panes: int = 1, micro_batch: int = 4096,

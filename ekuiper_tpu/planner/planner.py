@@ -482,6 +482,8 @@ def _build_device_chain(
         capacity=opts.key_slots, micro_batch=opts.micro_batch_rows,
         rule_id=rule_id, buffer_length=opts.buffer_length,
         direct_emit=direct, mesh=mesh,
+        prefinalize_lead_ms=opts.prefinalize_lead_ms,
+        emit_columnar=opts.emit_columnar,
     )
     topo.add_op(fused)
     src.connect(fused)

@@ -47,6 +47,15 @@ class Trigger:
 
 
 @dataclass
+class PreTrigger:
+    """Advance notice of an upcoming window boundary, enqueued ~1 device RTT
+    early so the fused agg node can pre-issue its finalize + async transfer
+    (ops/prefinalize.py). ts = the boundary the notice is for."""
+
+    ts: int
+
+
+@dataclass
 class ErrorEvent:
     error: BaseException
     origin: str = ""

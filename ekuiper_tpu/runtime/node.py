@@ -16,7 +16,7 @@ from typing import Any, Callable, List, Optional
 
 from ..utils.infra import logger, safe_run
 from ..utils.metrics import StatManager
-from .events import EOF, Barrier, ErrorEvent, Trigger, Watermark
+from .events import EOF, Barrier, ErrorEvent, PreTrigger, Trigger, Watermark
 
 
 class Node:
@@ -128,6 +128,8 @@ class Node:
                 self.on_eof(item)
             elif isinstance(item, Trigger):
                 self.on_trigger(item)
+            elif isinstance(item, PreTrigger):
+                self.on_pre_trigger(item)
             else:
                 self.process(item)
         except Exception as exc:  # per-item containment: skip poisoned items
@@ -167,6 +169,9 @@ class Node:
         self.broadcast(eof)
 
     def on_trigger(self, trig: Trigger) -> None:
+        pass
+
+    def on_pre_trigger(self, pre: PreTrigger) -> None:
         pass
 
     def on_error(self, exc: Exception, item: Any) -> None:

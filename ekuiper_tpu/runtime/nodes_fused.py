@@ -30,7 +30,7 @@ from ..ops.keytable import KeyTable
 from ..sql import ast
 from ..utils import timex
 from ..utils.infra import logger
-from .events import EOF, Trigger
+from .events import EOF, PreTrigger, Trigger
 from .node import Node
 
 
@@ -46,6 +46,9 @@ class FusedWindowAggNode(Node):
         rule_id: str = "",
         direct_emit=None,  # ops.emit.DirectEmitPlan — vectorized tail
         mesh=None,  # jax.sharding.Mesh — run the kernel sharded (parallel/)
+        prefinalize_lead_ms: int = 250,  # latency-hiding emit (prefinalize.py)
+        emit_columnar: bool = False,  # window result stays a ColumnBatch
+        prefinalize_backstop: bool = True,  # host backstop: boundaries never block
         **kw,
     ) -> None:
         super().__init__(name, op_type="op", **kw)
@@ -53,6 +56,7 @@ class FusedWindowAggNode(Node):
         self.plan = plan
         self.dims = dims
         self.direct_emit = direct_emit
+        self.emit_columnar = emit_columnar
         self.wt = window.window_type
         self.length_ms = window.length_ms()
         self.interval_ms = window.interval_ms()
@@ -83,6 +87,42 @@ class FusedWindowAggNode(Node):
         self._rows_in_window = 0
         self._spec_keys = [_call_key(s.call) for s in plan.specs]
         self._dtypes_seen = False
+        # latency-hiding emit: pre-issued device finalize + host tail shadow.
+        # Only for timer-driven windows (boundary known in advance), plans
+        # whose expressions have numpy twins, and non-collective kernels.
+        # _pipeline holds up to 3 (PendingFinalize, HostShadow) pairs: a
+        # fresher pre-issue is stacked when an earlier fetch is still in
+        # flight at the next pre-trigger (tunnel jitter), and the boundary
+        # uses the newest READY one — emit latency decouples from device
+        # round-trip variance.
+        self._pipeline = []
+        self._pre_timers = []
+        self.prefinalize_lead_ms = int(prefinalize_lead_ms)
+        self._prefinalize_ok = (
+            self.prefinalize_lead_ms > 0
+            and self.gb.supports_prefinalize
+            and plan.host_foldable
+            and self.wt in (ast.WindowType.TUMBLING_WINDOW,
+                            ast.WindowType.HOPPING_WINDOW)
+            and self.prefinalize_lead_ms < self._tick_interval()
+        )
+        # tumbling tail rows die at the boundary reset, so once a pre-issue
+        # freezes the device snapshot they fold into host shadows ONLY —
+        # zero upload traffic competing with the result fetch on a tunneled
+        # link. A checkpoint barrier in the frozen span flushes the frozen
+        # span's shadow back to the device (absorb).
+        self._tail_host_only = (
+            self._prefinalize_ok and self.wt == ast.WindowType.TUMBLING_WINDOW
+        )
+        self._device_frozen = False  # set at the first real pre-issue
+        # backstop: every window opens with an always-ready identity entry
+        # plus a window-spanning shadow, so a boundary NEVER blocks on the
+        # device link — the device result is preferred whenever its fetch
+        # lands (steady state), the backstop serves link-stall windows.
+        self._backstop = bool(prefinalize_backstop) and self._tail_host_only
+        # telemetry: the last boundary found no landed device fetch
+        self._storm = False
+        self._identity = None  # cached IdentityFinalize (immutable, per capacity)
 
     # --------------------------------------------------------------- lifecycle
     def on_open(self) -> None:
@@ -109,6 +149,15 @@ class FusedWindowAggNode(Node):
             self.state = self.gb.fold(self.state, cols, slots,
                                       pane_idx=self.cur_pane)
             self.gb.finalize(self.state, 1)
+            if self._prefinalize_ok:
+                pending = self.gb.prefinalize_begin(self.state)
+                self.gb.prefinalize_merge(pending, None, 1)
+            if self._tail_host_only:
+                # compile absorb with an identity (empty) shadow
+                from ..ops.prefinalize import HostShadow
+
+                hs = HostShadow(self.plan, self.gb.comp_specs, self.gb.capacity)
+                self.state = self.gb.absorb(self.state, hs.data, 0)
             self.state = self.gb.reset_pane(self.state, self.cur_pane)
         except Exception as exc:
             logger.debug("fused warmup failed (non-fatal): %s", exc)
@@ -116,6 +165,8 @@ class FusedWindowAggNode(Node):
     def on_close(self) -> None:
         if self._timer is not None:
             self._timer.stop()
+        for t in self._pre_timers:
+            t.stop()
 
     def _tick_interval(self) -> int:
         if self.wt == ast.WindowType.TUMBLING_WINDOW:
@@ -129,6 +180,17 @@ class FusedWindowAggNode(Node):
         self._timer = timex.after(
             next_end - now, lambda ts: self.inq.put(Trigger(ts=ts))
         )
+        if self._prefinalize_ok:
+            # two chances per boundary: the 2x-lead pre-issue covers tunnel
+            # jitter, the 1x-lead one refreshes if the first already landed
+            self._pre_timers = []
+            lead = self.prefinalize_lead_ms
+            for k in (2, 1):
+                if next_end - now > k * lead:
+                    self._pre_timers.append(timex.after(
+                        next_end - now - k * lead,
+                        lambda ts, end=next_end: self.inq.put(PreTrigger(ts=end)),
+                    ))
 
     # ------------------------------------------------------------------- data
     def process(self, item: Any) -> None:
@@ -162,9 +224,10 @@ class FusedWindowAggNode(Node):
             if col is None:
                 col = np.full(sub.n, None, dtype=np.object_)
             key_cols.append(col)
+        frozen = self._device_frozen and bool(self._pipeline)
         if key_cols:
             slots, grew = self.kt.encode_multi(key_cols)
-            if grew:
+            if grew and not frozen:
                 self.state = self.gb.grow(self.state, self.kt.capacity)
         else:
             slots = np.zeros(sub.n, dtype=np.int32)
@@ -207,7 +270,19 @@ class FusedWindowAggNode(Node):
         if not self._dtypes_seen:
             self.gb.observe_dtypes(cols)
             self._dtypes_seen = True
-        self.state = self.gb.fold(self.state, cols, slots, valid, self.cur_pane)
+        if not frozen:
+            if self.gb.capacity < self.kt.capacity:
+                # deferred grow (keys first seen in an earlier frozen span)
+                self.state = self.gb.grow(self.state, self.kt.capacity)
+            self.state = self.gb.fold(self.state, cols, slots, valid,
+                                      self.cur_pane)
+        # every live shadow mirrors the fold (dedup: frozen-span retries and
+        # the backstop may share shadow objects)
+        seen = set()
+        for _, shadow in self._pipeline:
+            if id(shadow) not in seen:
+                seen.add(id(shadow))
+                shadow.fold(cols, slots, valid)
         return sub.n
 
     def _fold_count_window(self, batch: ColumnBatch) -> None:
@@ -224,6 +299,39 @@ class FusedWindowAggNode(Node):
                 self._rows_in_window = 0
 
     # ---------------------------------------------------------------- trigger
+    def on_pre_trigger(self, pre: PreTrigger) -> None:
+        """Ahead of the window boundary: dispatch finalize on the state
+        snapshot (jax immutability = free double buffer) and start shadowing
+        tail rows on host. If an earlier pre-issue for this boundary has
+        already landed, this refresh is unnecessary and skipped; if it's
+        still in flight (tunnel jitter), stack a fresher one. See
+        ops/prefinalize.py."""
+        if not self._prefinalize_ok or self.kt.n_keys == 0:
+            return
+        from ..ops.prefinalize import HostShadow, IdentityFinalize
+
+        real = [e for e in self._pipeline
+                if not isinstance(e[0], IdentityFinalize)]
+        # a landed REAL fetch serves the boundary — no refresh needed; the
+        # backstop identity never suppresses probes
+        if real and real[-1][0].ready():
+            return
+        if len(self._pipeline) >= 4:
+            return
+        if real and self._device_frozen:
+            # device state unchanged since the first real pre-issue (frozen
+            # span rows are host-only): retry the fetch on the same
+            # snapshot, sharing that span's shadow
+            self._pipeline.append((
+                self.gb.prefinalize_begin(self.state), real[0][1],
+            ))
+            return
+        self._pipeline.append((
+            self.gb.prefinalize_begin(self.state),
+            HostShadow(self.plan, self.gb.comp_specs, self.kt.capacity),
+        ))
+        self._device_frozen = self._tail_host_only
+
     def on_trigger(self, trig: Trigger) -> None:
         end = trig.ts
         self._emit(WindowRange(end - self.length_ms, end))
@@ -233,7 +341,31 @@ class FusedWindowAggNode(Node):
             # advance to the next pane; expire it (it held the oldest slice)
             self.cur_pane = (self.cur_pane + 1) % self.n_panes
             self.state = self.gb.reset_pane(self.state, self.cur_pane)
+        self.begin_window_backstop()
         self._schedule_next_tick()
+
+    def begin_window_backstop(self) -> None:
+        """Open the next window with an always-ready identity entry plus a
+        window-spanning host shadow, so its boundary can never block on the
+        device link. Active for every window when the backstop is enabled;
+        otherwise only after a boundary whose fetches all missed (storm).
+        Real pre-issues still run and are preferred when they land."""
+        if not (self._tail_host_only and self.kt.n_keys):
+            return
+        if not (self._backstop or self._storm):
+            return
+        from ..ops.prefinalize import HostShadow, IdentityFinalize
+
+        if self._identity is None or self._identity.capacity != self.kt.capacity:
+            # immutable (merge never writes into it) -> safe to reuse; wide
+            # sketch components make a fresh one per boundary real churn
+            self._identity = IdentityFinalize(self.gb.comp_specs,
+                                              self.kt.capacity)
+        self._pipeline = [(
+            self._identity,
+            HostShadow(self.plan, self.gb.comp_specs, self.kt.capacity),
+        )]
+        self._device_frozen = False
 
     def on_eof(self, eof: EOF) -> None:
         now = timex.now_ms()
@@ -244,10 +376,38 @@ class FusedWindowAggNode(Node):
 
     # ------------------------------------------------------------------- emit
     def _emit(self, wr: WindowRange) -> None:
+        pipeline, self._pipeline = self._pipeline, []
+        frozen, self._device_frozen = self._device_frozen, False
         n_keys = self.kt.n_keys
         if n_keys == 0:
             return
-        outs, act = self.gb.finalize(self.state, n_keys)
+        if pipeline:
+            from ..ops.prefinalize import IdentityFinalize
+
+            # newest READY pre-issue wins (prefer real device fetches over
+            # the backstop identity); if nothing is ready, wait on the
+            # oldest (its fetch was registered first, it completes first)
+            real = [e for e in pipeline
+                    if not isinstance(e[0], IdentityFinalize)]
+            chosen = next(
+                ((p, s) for p, s in reversed(real) if p.ready()), None,
+            ) or next(
+                ((p, s) for p, s in reversed(pipeline) if p.ready()),
+                pipeline[0],
+            )
+            self._storm = self._tail_host_only and bool(real) and not any(
+                p.ready() for p, _ in real
+            )
+            try:
+                outs, act = self.gb.prefinalize_merge(
+                    chosen[0], chosen[1], n_keys)
+            except Exception as exc:
+                logger.warning("prefinalize merge failed, sync fallback: %s", exc)
+                if frozen and real:
+                    self._flush_shadow(real[0][1])
+                outs, act = self.gb.finalize(self.state, n_keys)
+        else:
+            outs, act = self.gb.finalize(self.state, n_keys)
         active = np.nonzero(act > 0)[0]
         if len(active) == 0:
             return
@@ -306,14 +466,46 @@ class FusedWindowAggNode(Node):
                     col[:] = [k[i] for k in sel]
                     dim_cols[dn] = col
         agg_cols = [col[active] for col in outs]
+        if self.emit_columnar:
+            cb = self.direct_emit.run_columnar(
+                dim_cols, agg_cols, wr.window_start, wr.window_end
+            )
+            if cb is not None and cb.n:
+                self.emit(cb, count=cb.n)
+            return
         msgs = self.direct_emit.run(
             dim_cols, agg_cols, wr.window_start, wr.window_end
         )
         if msgs:
             self.emit(msgs if len(msgs) > 1 else msgs[0], count=len(msgs))
 
+    def _flush_shadow(self, shadow) -> None:
+        """Fold frozen-span (host-only) rows back into the device state
+        (tumbling only — hopping shadows duplicate device content)."""
+        if not self._tail_host_only or shadow is None or not shadow.n_rows:
+            return
+        if self.gb.capacity < shadow.capacity:
+            self.state = self.gb.grow(self.state, shadow.capacity)
+        self.state = self.gb.absorb(self.state, shadow.data, 0)
+
+    def _flush_tail(self) -> None:
+        """Make the device state complete before a checkpoint snapshot or
+        any sync finalize; drops the pre-issue pipeline. Only the frozen
+        span's shadow is device-missing (the backstop's window-spanning
+        shadow duplicates rows the device already folded)."""
+        from ..ops.prefinalize import IdentityFinalize
+
+        pipeline, self._pipeline = self._pipeline, []
+        frozen, self._device_frozen = self._device_frozen, False
+        if not (frozen and pipeline):
+            return
+        real = [e for e in pipeline if not isinstance(e[0], IdentityFinalize)]
+        if real:
+            self._flush_shadow(real[0][1])
+
     # ------------------------------------------------------------------ state
     def snapshot_state(self) -> Optional[dict]:
+        self._flush_tail()
         host = self.gb.state_to_host(self.state)
         return {
             "keys": self.kt.decode_all(),

@@ -41,6 +41,12 @@ class RuleOptionConfig:
     micro_batch_linger_ms: int = 10
     key_slots: int = 16384  # group-by hash-slot table size per rule
     use_device_kernel: bool = True  # fuse window+agg into a jitted kernel when possible
+    # pre-issue the window finalize this long before the boundary so the
+    # device round trip overlaps the stream (ops/prefinalize.py); 0 disables
+    prefinalize_lead_ms: int = 250
+    # fused window results stay columnar (ColumnBatch) end-to-end; sinks
+    # convert to per-message dicts at the edge
+    emit_columnar: bool = True
     # planOptimizeStrategy analogue (reference: internal/pkg/def/rule.go:55-66);
     # {"mesh": {"rows": R, "keys": K}} runs the fused kernel sharded over an
     # R x K device mesh (parallel/sharded.py)

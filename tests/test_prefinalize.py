@@ -1,0 +1,354 @@
+"""Latency-hiding emit pipeline (ops/prefinalize.py): the pre-issued device
+finalize + host tail shadow must agree with the synchronous device finalize
+bit-for-bit in structure and to float32 accumulation order in values.
+
+Scenario mirrors the real node sequence: fold head batches → prefinalize_begin
+(snapshot dispatched) → fold tail batches into device state AND HostShadow →
+prefinalize_merge vs a plain finalize over everything.
+"""
+import numpy as np
+import pytest
+
+from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+from ekuiper_tpu.ops.groupby import DeviceGroupBy
+from ekuiper_tpu.ops.keytable import KeyTable
+from ekuiper_tpu.ops.prefinalize import HostShadow
+from ekuiper_tpu.sql.parser import parse_select
+
+
+def _plan(sql):
+    stmt = parse_select(sql)
+    plan = extract_kernel_plan(stmt)
+    assert plan is not None
+    return plan
+
+
+def _cols_for(plan, cols, n):
+    """Materialize kernel columns (incl. derived __hll__ copies) the way
+    FusedWindowAggNode._fold does."""
+    from ekuiper_tpu.ops.aggspec import (
+        HLL_COL_PREFIX, _hll_encode_numeric, hash_column_for_hll)
+
+    out = {}
+    for name in plan.columns:
+        if name.startswith(HLL_COL_PREFIX):
+            raw = cols[name[len(HLL_COL_PREFIX):]]
+            if raw.dtype == np.object_:
+                out[name] = hash_column_for_hll(raw)
+            else:
+                out[name] = _hll_encode_numeric(raw)
+        else:
+            out[name] = np.asarray(cols[name], dtype=np.float32)
+    return out
+
+
+def _run_split(plan, head, tail, valid_head=None, valid_tail=None,
+               capacity=64, n_panes=1, pane_head=0, pane_tail=0):
+    """Fold head, pre-issue, fold tail (device + shadow), merge.
+    Returns (merged_outs, merged_act, sync_outs, sync_act, n_keys)."""
+    kt = KeyTable(capacity)
+    gb = DeviceGroupBy(plan, capacity=capacity, n_panes=n_panes, micro_batch=32)
+    state = gb.init_state()
+
+    def fold(state, batch, valid, pane, shadow=None):
+        key_col, cols = batch
+        slots, grew = kt.encode_column(key_col)
+        if grew:
+            state = gb.grow(state, kt.capacity)
+        dev_cols = _cols_for(plan, cols, len(key_col))
+        gb.observe_dtypes(dev_cols)
+        state = gb.fold(state, dev_cols, slots, valid, pane)
+        if shadow is not None:
+            shadow.fold(dev_cols, slots, valid)
+        return state
+
+    state = fold(state, head, valid_head, pane_head)
+    pending = gb.prefinalize_begin(state)
+    shadow = HostShadow(plan, gb.comp_specs, kt.capacity)
+    state = fold(state, tail, valid_tail, pane_tail, shadow)
+
+    n_keys = kt.n_keys
+    merged_outs, merged_act = gb.prefinalize_merge(pending, shadow, n_keys)
+    sync_outs, sync_act = gb.finalize(state, n_keys)
+    return merged_outs, merged_act, sync_outs, sync_act, n_keys
+
+
+def _batch(rng, n, n_keys, extra=None):
+    keys = np.array([f"k{i}" for i in rng.integers(0, n_keys, n)],
+                    dtype=np.object_)
+    cols = {"temp": rng.normal(20, 5, n).astype(np.float32)}
+    if extra:
+        for name in extra:
+            cols[name] = rng.normal(0, 10, n).astype(np.float32)
+    return keys, cols
+
+
+def _assert_parity(mo, ma, so, sa):
+    np.testing.assert_allclose(ma, sa, rtol=1e-5)
+    for m, s in zip(mo, so):
+        np.testing.assert_allclose(
+            np.asarray(m, dtype=np.float64), np.asarray(s, dtype=np.float64),
+            rtol=1e-4, equal_nan=True)
+
+
+class TestPrefinalizeParity:
+    def test_basic_aggs(self):
+        plan = _plan("SELECT avg(temp), count(*), min(temp), max(temp), "
+                     "sum(temp), stddev(temp) FROM s "
+                     "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        rng = np.random.default_rng(1)
+        out = _run_split(plan, _batch(rng, 100, 10), _batch(rng, 60, 10))
+        _assert_parity(*out[:4])
+
+    def test_where_and_filter(self):
+        plan = _plan("SELECT count(*) FILTER (WHERE temp > 22), avg(temp) "
+                     "FROM s WHERE temp > 15 "
+                     "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        assert plan.host_foldable
+        rng = np.random.default_rng(2)
+        out = _run_split(plan, _batch(rng, 80, 8), _batch(rng, 80, 8))
+        _assert_parity(*out[:4])
+
+    def test_validity_masks(self):
+        plan = _plan("SELECT avg(temp), count(temp), min(temp) FROM s "
+                     "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        rng = np.random.default_rng(3)
+        head, tail = _batch(rng, 50, 6), _batch(rng, 50, 6)
+        vh = {"temp": rng.random(50) > 0.3}
+        vt = {"temp": rng.random(50) > 0.3}
+        out = _run_split(plan, head, tail, valid_head=vh, valid_tail=vt)
+        _assert_parity(*out[:4])
+
+    def test_sketches(self):
+        plan = _plan("SELECT distinct_count_approx(temp), "
+                     "percentile_approx(temp, 0.9) FROM s "
+                     "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        assert plan.host_foldable
+        rng = np.random.default_rng(4)
+        out = _run_split(plan, _batch(rng, 200, 4), _batch(rng, 200, 4))
+        _assert_parity(*out[:4])
+
+    def test_grow_during_tail(self):
+        """Keys first seen in the tail exist only in the shadow; the device
+        result must be padded, not truncated."""
+        plan = _plan("SELECT count(*), sum(temp) FROM s "
+                     "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        rng = np.random.default_rng(5)
+        head = _batch(rng, 30, 4)
+        tail_keys = np.array([f"new{i}" for i in range(40)], dtype=np.object_)
+        tail = (tail_keys, {"temp": rng.normal(0, 1, 40).astype(np.float32)})
+        out = _run_split(plan, head, tail, capacity=8)
+        mo, ma, so, sa, n_keys = out
+        assert n_keys == 44
+        _assert_parity(mo, ma, so, sa)
+
+    def test_hopping_panes(self):
+        """Tail rows land in a different pane; pre-issued finalize merged all
+        panes at snapshot, shadow covers the tail regardless of pane."""
+        plan = _plan("SELECT avg(temp), max(temp) FROM s "
+                     "GROUP BY deviceId, HOPPINGWINDOW(ss, 10, 5)")
+        rng = np.random.default_rng(6)
+        out = _run_split(plan, _batch(rng, 60, 5), _batch(rng, 60, 5),
+                         n_panes=2, pane_head=0, pane_tail=1)
+        _assert_parity(*out[:4])
+
+    def test_empty_tail(self):
+        plan = _plan("SELECT avg(temp) FROM s "
+                     "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        rng = np.random.default_rng(7)
+        head = _batch(rng, 50, 5)
+        kt = KeyTable(32)
+        gb = DeviceGroupBy(plan, capacity=32, micro_batch=32)
+        state = gb.init_state()
+        slots, _ = kt.encode_column(head[0])
+        cols = _cols_for(plan, head[1], 50)
+        state = gb.fold(state, cols, slots)
+        pending = gb.prefinalize_begin(state)
+        mo, ma = gb.prefinalize_merge(pending, None, kt.n_keys)
+        so, sa = gb.finalize(state, kt.n_keys)
+        _assert_parity(mo, ma, so, sa)
+
+    def test_int_semantics(self):
+        plan = _plan("SELECT sum(temp), avg(temp), count(*) FROM s "
+                     "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        rng = np.random.default_rng(8)
+        keys = np.array(["a", "a", "b"] * 10, dtype=np.object_)
+        ints = rng.integers(0, 100, 30)
+        kt = KeyTable(32)
+        gb = DeviceGroupBy(plan, capacity=32, micro_batch=16)
+        state = gb.init_state()
+        slots, _ = kt.encode_column(keys[:20])
+        # int input observed -> integral sum/avg on both paths
+        gb.observe_dtypes({"temp": ints[:20]})
+        cols = {"temp": ints[:20].astype(np.float32)}
+        state = gb.fold(state, cols, slots)
+        pending = gb.prefinalize_begin(state)
+        shadow = HostShadow(plan, gb.comp_specs, kt.capacity)
+        slots2, _ = kt.encode_column(keys[20:])
+        cols2 = {"temp": ints[20:].astype(np.float32)}
+        state = gb.fold(state, cols2, slots2)
+        shadow.fold(cols2, slots2, None)
+        mo, ma = gb.prefinalize_merge(pending, shadow, kt.n_keys)
+        so, sa = gb.finalize(state, kt.n_keys)
+        assert mo[2].dtype == np.int64 and so[2].dtype == np.int64
+        _assert_parity(mo, ma, so, sa)
+
+
+class TestFrozenTailGrow:
+    def test_no_truncation_when_device_grow_deferred(self):
+        """Keys first seen during a frozen (host-only) tail grow the key
+        table but NOT the device state; merge must still emit them."""
+        plan = _plan("SELECT count(*) AS c, sum(temp) AS s FROM s "
+                     "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        kt = KeyTable(8)
+        gb = DeviceGroupBy(plan, capacity=8, micro_batch=16)
+        state = gb.init_state()
+        slots, _ = kt.encode_column(np.array(["a", "b"] * 8, dtype=np.object_))
+        state = gb.fold(state, {"temp": np.arange(16, dtype=np.float32)}, slots)
+        pending = gb.prefinalize_begin(state)
+        shadow = HostShadow(plan, gb.comp_specs, kt.capacity)
+        slots2, grew = kt.encode_column(
+            np.array([f"n{i}" for i in range(20)], dtype=np.object_))
+        assert grew  # 8 -> 32
+        shadow.fold({"temp": np.ones(20, dtype=np.float32)}, slots2, None)
+        outs, act = gb.prefinalize_merge(pending, shadow, kt.n_keys)
+        assert kt.n_keys == 22
+        assert len(outs[0]) == 22 and len(act) == 22
+        np.testing.assert_array_equal(outs[0][2:], np.ones(20, dtype=np.int64))
+
+
+class TestColumnarNulls:
+    def test_null_agg_stays_explicit_none(self):
+        """A NULL aggregate (empty group min) must appear as an explicit
+        None in sink messages, exactly like the dict emit path — not as an
+        omitted key."""
+        from ekuiper_tpu.ops.emit import build_direct_emit
+
+        sql = ("SELECT deviceId, min(temp) AS mn FROM s "
+               "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        stmt = parse_select(sql)
+        plan = extract_kernel_plan(stmt)
+        direct = build_direct_emit(stmt, plan, ["deviceId"])
+        dims = {"deviceId": np.array(["a", "b"], dtype=np.object_)}
+        aggs = [np.array([3.5, np.nan], dtype=np.float32)]
+        cb = direct.run_columnar(dims, aggs, 0, 10_000)
+        msgs = [t.message for t in cb.to_tuples()]
+        dict_msgs = direct.run(dims, aggs, 0, 10_000)
+        assert msgs[1]["mn"] is None
+        assert msgs == dict_msgs
+
+
+class TestNodePrefinalize:
+    def test_node_emits_via_pretrigger(self):
+        """Drive FusedWindowAggNode through PreTrigger→data→Trigger and
+        assert the merged emit matches a sync-emit node on the same data."""
+        from ekuiper_tpu.data.batch import ColumnBatch
+        from ekuiper_tpu.ops.emit import build_direct_emit
+        from ekuiper_tpu.runtime.events import PreTrigger, Trigger
+        from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+
+        sql = ("SELECT deviceId, avg(temp) AS a, count(*) AS c FROM s "
+               "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        stmt = parse_select(sql)
+        plan = extract_kernel_plan(stmt)
+        direct = build_direct_emit(stmt, plan, ["deviceId"])
+        rng = np.random.default_rng(9)
+
+        def mkbatch(n):
+            keys = np.array([f"d{i}" for i in rng.integers(0, 5, n)],
+                            dtype=np.object_)
+            return ColumnBatch(
+                n=n, columns={"deviceId": keys,
+                              "temp": rng.normal(20, 5, n).astype(np.float32)},
+                timestamps=np.zeros(n, dtype=np.int64), emitter="s")
+
+        batches = [mkbatch(40) for _ in range(4)]
+
+        def run(prefinalize):
+            node = FusedWindowAggNode(
+                "t", stmt.window, extract_kernel_plan(stmt),
+                dims=[d.expr for d in stmt.dimensions], capacity=64,
+                micro_batch=32, direct_emit=build_direct_emit(
+                    stmt, extract_kernel_plan(stmt), ["deviceId"]),
+                prefinalize_lead_ms=250 if prefinalize else 0,
+            )
+            node.state = node.gb.init_state()
+            got = []
+            node.broadcast = lambda item: got.append(item)
+            node.process(batches[0])
+            node.process(batches[1])
+            if prefinalize:
+                node.on_pre_trigger(PreTrigger(ts=10_000))
+                assert node._pipeline
+            node.process(batches[2])
+            node.process(batches[3])
+            node.on_trigger(Trigger(ts=10_000))
+            return got
+
+        sync = run(False)
+        merged = run(True)
+        assert len(sync) == len(merged) > 0
+
+        def flat(items):
+            out = []
+            for item in items:
+                out.extend(item if isinstance(item, list) else [item])
+            return {(m.message if hasattr(m, "message") else m)["deviceId"]:
+                    (round((m.message if hasattr(m, "message") else m)["a"], 3),
+                     (m.message if hasattr(m, "message") else m)["c"])
+                    for m in out}
+
+        assert flat(sync) == flat(merged)
+
+
+class TestKeyTableFastPath:
+    def test_miss_then_hit(self):
+        kt = KeyTable(16)
+        col = np.array(["a", "b", "a", None], dtype=np.object_)
+        slots, _ = kt.encode_column(col)
+        assert slots[0] == slots[2]
+        # None normalizes to "" and aliases; next batch is a pure fast path
+        slots2, _ = kt.encode_column(col)
+        np.testing.assert_array_equal(slots, slots2)
+        assert kt.decode(int(slots[3])) == ""
+
+    def test_none_and_empty_share_slot(self):
+        kt = KeyTable(16)
+        s1, _ = kt.encode_column(np.array([None], dtype=np.object_))
+        s2, _ = kt.encode_column(np.array([""], dtype=np.object_))
+        assert s1[0] == s2[0]
+
+    def test_multi_none_alias(self):
+        kt = KeyTable(16)
+        a = np.array(["x", None], dtype=np.object_)
+        b = np.array([1, 2])
+        s1, _ = kt.encode_multi([a, b])
+        s2, _ = kt.encode_multi([a, b])
+        np.testing.assert_array_equal(s1, s2)
+        assert kt.decode(int(s1[1])) == ("", 2)
+
+    def test_unhashable_fallback(self):
+        kt = KeyTable(16)
+        col = np.empty(3, dtype=np.object_)
+        col[0] = [1, 2]
+        col[1] = [1, 2]
+        col[2] = [3]
+        slots, _ = kt.encode_column(col)
+        assert slots[0] == slots[1] != slots[2]
+
+    def test_unhashable_in_tuple(self):
+        kt = KeyTable(16)
+        a = np.empty(2, dtype=np.object_)
+        a[0] = {"x": 1}
+        a[1] = {"x": 1}
+        b = np.array(["u", "v"], dtype=np.object_)
+        slots, _ = kt.encode_multi([a, b])
+        assert slots[0] != slots[1]
+        slots2, _ = kt.encode_multi([a, b])
+        np.testing.assert_array_equal(slots, slots2)
+
+    def test_growth_from_hashed_path(self):
+        kt = KeyTable(2)
+        slots, grew = kt.encode_column(
+            np.array(["a", "b", "c"], dtype=np.object_))
+        assert grew and kt.capacity == 4
