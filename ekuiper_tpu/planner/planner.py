@@ -251,14 +251,97 @@ def plan_rule(rule: RuleDef, store) -> Topo:
         rule.id, qos=opts.qos, checkpoint_interval_ms=opts.checkpoint_interval_ms
     )
 
-    # sources
+    # sources — shared via the subtopo pool (one ingest+decode pipeline per
+    # stream config, reference subtopo_pool.go:34) when the rule is qos=0;
+    # checkpointed rules keep a private source so barriers stay rule-scoped
     source_nodes: List[SourceNode] = []
     for tbl in stmt.sources:
-        stream = load_stream_def(tbl.name, store)
-        sschema = schema_of(stream)
+        src_name = (tbl.ref_name if len(stmt.sources) > 1 or stmt.joins
+                    else tbl.name)
+        source_nodes.append(
+            _plan_stream_source(tbl.name, src_name, opts, store, topo))
+
+    kernel_plan = device_path_eligible(stmt, opts)
+    if kernel_plan is not None and len(source_nodes) == 1:
+        tail = _build_device_chain(
+            topo, stmt, kernel_plan, source_nodes[0], opts, rule_id=rule.id
+        )
+    else:
+        tail = _build_host_chain(topo, stmt, source_nodes, opts, rule.id)
+
+    # sinks
+    actions = rule.actions or [{"log": {}}]
+    for i, action in enumerate(actions):
+        for sink_type, props in action.items():
+            _build_sink_chain(topo, tail, sink_type, props or {}, i, opts,
+                              rule.id, store)
+    return topo
+
+
+def plan_rule_group(group_id: str, rules: List[RuleDef], store) -> Topo:
+    """Plan N homogeneous rules as ONE topology: shared ingest, one
+    vmapped device program (parallel/multirule.py), per-rule sink chains.
+    The rules must share a single source and be identical up to numeric
+    literals in WHERE; all run at qos=0 (the group is a fan-out optimization,
+    reference test/benchmark/multiple_rules)."""
+    from ..ops.emit import build_direct_emit
+    from ..parallel.multirule import build_rule_batch
+    from ..runtime.nodes_multirule import MultiRuleFusedNode
+    from ..runtime.subtopo import SharedEntryNode
+
+    if not rules:
+        raise PlanError("empty rule group")
+    stmts = [parse_select(r.sql) for r in rules]
+    srcs = {tuple(t.name for t in s.sources) for s in stmts}
+    if len(srcs) != 1 or len(stmts[0].sources) != 1:
+        raise PlanError("rule group must share exactly one source stream")
+    try:
+        spec = build_rule_batch([r.id for r in rules], stmts)
+    except ValueError as exc:
+        raise PlanError(str(exc))
+    stmt = spec.stmt
+    opts = merged_options(rules[0])
+    opts.qos = 0
+    topo = Topo(group_id, qos=0)
+    src = _plan_stream_source(stmt.sources[0].name, stmt.sources[0].name,
+                              opts, store, topo)
+    dims = [d.expr for d in stmt.dimensions]
+    direct = build_direct_emit(stmt, spec.plan, [d.name for d in dims])
+    if direct is None:
+        raise PlanError("rule group tail is not vectorizable")
+    node = MultiRuleFusedNode(
+        "group_agg", stmt.window, spec, dims=dims,
+        capacity=opts.key_slots, micro_batch=opts.micro_batch_rows,
+        direct_emit=direct, emit_columnar=opts.emit_columnar,
+        buffer_length=opts.buffer_length,
+    )
+    topo.add_op(node)
+    src.connect(node)
+    for r in rules:
+        entry = SharedEntryNode(f"{r.id}_out", buffer_length=opts.buffer_length)
+        topo.add_op(entry)
+        node.add_rule_output(r.id, entry)
+        actions = r.actions or [{"log": {}}]
+        for i, action in enumerate(actions):
+            for sink_type, props in action.items():
+                _build_sink_chain(topo, entry, sink_type, props or {}, i,
+                                  opts, r.id, store)
+    return topo
+
+
+def _plan_stream_source(stream_name: str, src_name: str, opts, store,
+                        topo: Topo):
+    """Build (or ride) the ingest+decode pipeline for one stream: a pooled
+    shared subtopo for qos=0 rules, a topo-private SourceNode otherwise.
+    Returns the node rule chains connect to."""
+    stream = load_stream_def(stream_name, store)
+    props = _source_props(stream, store)
+    ts_field = stream.options.timestamp if opts.is_event_time else ""
+
+    def build_nodes(name=src_name):
+        nodes = []
         stype = stream.options.type or "memory"
         connector = io_registry.create_source(stype)
-        props = _source_props(stream, store)
         connector.configure(stream.options.datasource, props)
         from ..io.converters import get_converter
 
@@ -280,46 +363,60 @@ def plan_rule(rule: RuleDef, store) -> Topo:
 
             converter = _DecryptingConverter(
                 converter, get_encryptor(props["decryption"], props))
-        src = SourceNode(
-            tbl.ref_name if len(stmt.sources) > 1 or stmt.joins else tbl.name,
-            connector,
-            converter=converter,
-            schema=sschema,
-            timestamp_field=stream.options.timestamp if opts.is_event_time else "",
+        node = SourceNode(
+            name, connector, converter=converter,
+            schema=schema_of(stream),
+            timestamp_field=ts_field,
             strict_validation=stream.options.strict_validation,
             micro_batch_rows=opts.micro_batch_rows,
             linger_ms=opts.micro_batch_linger_ms,
             buffer_length=opts.buffer_length,
         )
-        topo.add_source(src)
+        nodes.append(node)
         # per-interval latest-batch throttle (planner_source.go:146). A
         # dedicated prop, NOT `interval`: poll sources (file/httppull/
         # simulator) already use `interval` as their poll period.
         if props.get("rateLimitInterval"):
             from ..runtime.nodes_chain import RateLimitNode
 
-            rl = RateLimitNode(f"{src.name}_ratelimit",
+            rl = RateLimitNode(f"{name}_ratelimit",
                                interval_ms=int(props["rateLimitInterval"]),
                                buffer_length=opts.buffer_length)
-            topo.add_op(rl)
-            src = src.connect(rl)
-        source_nodes.append(src)
+            node.connect(rl)
+            nodes.append(rl)
+        return nodes
 
-    kernel_plan = device_path_eligible(stmt, opts)
-    if kernel_plan is not None and len(source_nodes) == 1:
-        tail = _build_device_chain(
-            topo, stmt, kernel_plan, source_nodes[0], opts, rule.id
-        )
-    else:
-        tail = _build_host_chain(topo, stmt, source_nodes, opts, rule.id)
+    if opts.share_source and opts.qos == 0:
+        from ..runtime import subtopo as subtopo_pool
+        from ..runtime.subtopo import SharedEntryNode, SubTopoRef
 
-    # sinks
-    actions = rule.actions or [{"log": {}}]
-    for i, action in enumerate(actions):
-        for sink_type, props in action.items():
-            _build_sink_chain(topo, tail, sink_type, props or {}, i, opts,
-                              rule.id, store)
-    return topo
+        key = subtopo_pool.subtopo_key(stream_name, {
+            # everything that changes what the pipeline emits, including the
+            # emitter name (join rules match rows by emitter == alias) and
+            # the connector identity (type/datasource can change across
+            # DROP/CREATE STREAM between plans)
+            "name": src_name,
+            "type": stream.options.type or "memory",
+            "datasource": stream.options.datasource,
+            "props": props,
+            "format": stream.options.format or "json",
+            "fields": [f.name for f in stream.fields],
+            "ts": ts_field,
+            "strict": stream.options.strict_validation,
+            "mb": opts.micro_batch_rows,
+            "linger": opts.micro_batch_linger_ms,
+        })
+        entry = SharedEntryNode(f"{src_name}_shared",
+                                buffer_length=opts.buffer_length)
+        topo.add_op(entry)
+        topo.add_shared_source(SubTopoRef(key, build_nodes), entry)
+        return entry
+
+    nodes = build_nodes()
+    topo.add_source(nodes[0])
+    for extra in nodes[1:]:
+        topo.add_op(extra)
+    return nodes[-1]
 
 
 def _build_sink_chain(topo: Topo, tail, sink_type: str, props: Dict[str, Any],

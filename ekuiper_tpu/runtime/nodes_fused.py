@@ -65,18 +65,7 @@ class FusedWindowAggNode(Node):
             self.n_panes = max((self.length_ms + iv - 1) // iv, 1)
         else:
             self.n_panes = 1
-        if mesh is not None:
-            from ..parallel.sharded import ShardedGroupBy
-
-            self.gb = ShardedGroupBy(
-                plan, mesh, capacity=capacity, n_panes=int(self.n_panes),
-                micro_batch=micro_batch,
-            )
-        else:
-            self.gb = DeviceGroupBy(
-                plan, capacity=capacity, n_panes=int(self.n_panes),
-                micro_batch=micro_batch,
-            )
+        self.gb = self._make_gb(plan, capacity, micro_batch, mesh)
         # sharded path may round capacity up for even shard division
         self.kt = KeyTable(self.gb.capacity)
         self.state = None
@@ -123,6 +112,21 @@ class FusedWindowAggNode(Node):
         # telemetry: the last boundary found no landed device fetch
         self._storm = False
         self._identity = None  # cached IdentityFinalize (immutable, per capacity)
+
+    def _make_gb(self, plan, capacity: int, micro_batch: int, mesh):
+        """Build the group-by kernel; subclasses override (MultiRuleFusedNode
+        builds a BatchedGroupBy with the already-computed self.n_panes)."""
+        if mesh is not None:
+            from ..parallel.sharded import ShardedGroupBy
+
+            return ShardedGroupBy(
+                plan, mesh, capacity=capacity, n_panes=int(self.n_panes),
+                micro_batch=micro_batch,
+            )
+        return DeviceGroupBy(
+            plan, capacity=capacity, n_panes=int(self.n_panes),
+            micro_batch=micro_batch,
+        )
 
     # --------------------------------------------------------------- lifecycle
     def on_open(self) -> None:

@@ -1,0 +1,196 @@
+"""Shared-source subtopology — one source + decode pipeline serving N rules.
+
+The reference refcounts a SrcSubTopo per source so 300 rules over one MQTT
+stream subscribe once and fan out in-process (reference:
+internal/topo/subtopo.go:38-60, subtopo_pool.go:34). Here the shared unit is
+the SourceNode (ingest → decode → schema coercion → micro-batch), whose tail
+broadcasts ColumnBatches to each attached rule's entry node. Attach/detach
+are refcounted; the pipeline opens on the first attach and closes when the
+last rule detaches.
+
+Sharing is restricted to qos=0 rules (the planner enforces it): checkpoint
+barriers are injected at sources, and a shared source cannot carry
+rule-private barriers. This matches the reference's default deployments —
+its fan-out benchmark rules are all at-most-once.
+
+Thread-safety: broadcast iterates the tail's `outputs` list, so attach and
+detach REPLACE the list instead of mutating it (copy-on-write) — a broadcast
+running concurrently keeps iterating its own snapshot.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.infra import logger
+from .node import Node
+
+
+class _FanoutTopoShim:
+    """Stands in as `_topo` for nodes owned by a subtopo: errors fan out to
+    every attached rule's topo (each supervisor decides restart policy)."""
+
+    def __init__(self, subtopo: "SrcSubTopo") -> None:
+        self._subtopo = subtopo
+
+    def drain_error(self, err: BaseException, origin: str = "") -> None:
+        for topo in self._subtopo.attached_topos():
+            topo.drain_error(err, f"shared:{origin}")
+
+    def checkpoint_ack(self, node_name, barrier, state) -> None:
+        # shared subtopos serve qos=0 rules only; no barriers flow here
+        pass
+
+
+class SubTopoRef:
+    """Plan-time handle: the subtopo instance is resolved at Topo.open, not
+    at plan time — a pooled instance may have closed (last rule detached)
+    between planning and opening, and a fresh one must be built then."""
+
+    def __init__(self, key: str, builder: Callable[[], List[Node]]) -> None:
+        self.key = key
+        self.builder = builder
+
+    def resolve_and_attach(self, rule_id: str, entry: Node, topo: Any) -> "SrcSubTopo":
+        # retry: get_or_create may return an instance that loses its last
+        # rule and closes before our attach lands; closed instances refuse
+        # the attach and are already evicted, so the next lookup builds fresh
+        for _ in range(8):
+            st = get_or_create(self.key, self.builder)
+            if st.attach(rule_id, entry, topo):
+                return st
+        raise RuntimeError(f"cannot attach to subtopo {self.key}")
+
+
+class SrcSubTopo:
+    def __init__(self, key: str, nodes: List[Node]) -> None:
+        self.key = key
+        self.nodes = nodes  # [source, *chain]; tail broadcasts to entries
+        self._shim = _FanoutTopoShim(self)
+        for n in nodes:
+            n._topo = self._shim
+        self._lock = threading.RLock()
+        self._attached: Dict[str, Tuple[Node, Any]] = {}
+        self._opened = False
+        self._closed = False
+
+    @property
+    def tail(self) -> Node:
+        return self.nodes[-1]
+
+    @property
+    def source(self) -> Node:
+        return self.nodes[0]
+
+    def attached_topos(self) -> List[Any]:
+        with self._lock:
+            return [t for _, t in self._attached.values()]
+
+    def ref_count(self) -> int:
+        with self._lock:
+            return len(self._attached)
+
+    def attach(self, rule_id: str, entry: Node, topo: Any) -> bool:
+        """Returns False when this instance already closed (caller resolves
+        a fresh one from the pool)."""
+        with self._lock:
+            if self._closed:
+                return False
+            if rule_id in self._attached:
+                raise ValueError(f"rule {rule_id} already attached to {self.key}")
+            self._attached[rule_id] = (entry, topo)
+            self.tail.outputs = self.tail.outputs + [entry]  # copy-on-write
+            if not self._opened:
+                # chain first, source last, so the first payload finds the
+                # downstream queues live (same order Topo.open uses)
+                for n in reversed(self.nodes):
+                    n.open()
+                self._opened = True
+                logger.debug("subtopo %s opened", self.key)
+            return True
+
+    def detach(self, rule_id: str) -> None:
+        close_now = False
+        with self._lock:
+            got = self._attached.pop(rule_id, None)
+            if got is None:
+                return
+            entry, _ = got
+            self.tail.outputs = [o for o in self.tail.outputs if o is not entry]
+            if not self._attached and self._opened:
+                # mark closed + evict BEFORE releasing the lock: a concurrent
+                # attach on this instance now returns False, and a concurrent
+                # get_or_create builds a fresh instance
+                self._closed = True
+                close_now = True
+                _pool_remove(self.key, self)
+        if close_now:
+            for n in self.nodes:
+                n.close()
+            for n in self.nodes:
+                n.join(timeout=2.0)
+            logger.debug("subtopo %s closed (last rule detached)", self.key)
+
+    def status(self) -> Dict[str, Any]:
+        return {n.name: n.stats for n in self.nodes}
+
+
+class SharedEntryNode(Node):
+    """Per-rule entry behind a shared source: a pass-through hop that gives
+    the rule its own queue (backpressure isolation — one slow rule drops its
+    own oldest items, reference subtopo semantics) and its own stats."""
+
+    def __init__(self, name: str, **kw) -> None:
+        super().__init__(name, op_type="op", **kw)
+
+    def process(self, item: Any) -> None:
+        self.emit(item)
+
+
+# ------------------------------------------------------------------- pool
+_pool: Dict[str, SrcSubTopo] = {}
+_pool_lock = threading.Lock()
+
+
+def subtopo_key(stream_name: str, props: Dict[str, Any]) -> str:
+    """Stable identity of a shareable source pipeline: the stream plus every
+    config knob that changes what the pipeline emits."""
+    return stream_name + ":" + json.dumps(props, sort_keys=True, default=str)
+
+
+def get_or_create(key: str, builder: Callable[[], List[Node]]) -> SrcSubTopo:
+    with _pool_lock:
+        st = _pool.get(key)
+    if st is not None:
+        return st
+    # build OUTSIDE the lock: connector construction/configure may do I/O,
+    # and one slow source must not stall planning of unrelated rules
+    candidate = SrcSubTopo(key, builder())
+    with _pool_lock:
+        st = _pool.get(key)
+        if st is None:
+            _pool[key] = candidate
+            return candidate
+    return st  # lost the race; unopened candidate is garbage-collected
+
+
+def _pool_remove(key: str, subtopo: SrcSubTopo) -> None:
+    with _pool_lock:
+        if _pool.get(key) is subtopo:
+            del _pool[key]
+
+
+def pool_size() -> int:
+    with _pool_lock:
+        return len(_pool)
+
+
+def reset() -> None:
+    """Test hook: close and drop every pooled subtopo."""
+    with _pool_lock:
+        topos = list(_pool.values())
+        _pool.clear()
+    for st in topos:
+        for n in st.nodes:
+            n.close()

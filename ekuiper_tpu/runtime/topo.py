@@ -24,6 +24,10 @@ class Topo:
         self.sources: List[Node] = []
         self.ops: List[Node] = []
         self.sinks: List[Node] = []
+        # (SubTopoRef, entry node) pairs — shared sources this rule rides;
+        # the live SrcSubTopo instances are resolved at open() time
+        self.shared: List = []
+        self._live_shared: List = []
         self.errq: "queue.Queue[BaseException]" = queue.Queue(maxsize=8)
         self._open = False
         self._ckpt_timer = None
@@ -48,6 +52,13 @@ class Topo:
         self.sinks.append(node)
         return node
 
+    def add_shared_source(self, ref, entry: Node) -> Node:
+        """Ride a pooled shared source (runtime/subtopo.py SubTopoRef);
+        `entry` is this rule's pass-through attach point (must also be
+        add_op'd). The live instance is resolved when the topo opens."""
+        self.shared.append((ref, entry))
+        return entry
+
     def all_nodes(self) -> List[Node]:
         return self.sources + self.ops + self.sinks
 
@@ -60,6 +71,10 @@ class Topo:
             self._restore()
         for node in self.sinks + self.ops + self.sources:
             node.open()
+        self._live_shared = [
+            (ref.resolve_and_attach(self.rule_id, entry, self), entry)
+            for ref, entry in self.shared
+        ]
         self._open = True
         if self.qos > 0:
             self._schedule_checkpoint()
@@ -68,6 +83,9 @@ class Topo:
         self._open = False
         if self._ckpt_timer is not None:
             self._ckpt_timer.stop()
+        for subtopo, _ in self._live_shared:
+            subtopo.detach(self.rule_id)
+        self._live_shared = []
         for node in self.sources + self.ops + self.sinks:
             node.close()
         for node in self.all_nodes():

@@ -47,6 +47,9 @@ class RuleOptionConfig:
     # fused window results stay columnar (ColumnBatch) end-to-end; sinks
     # convert to per-message dicts at the edge
     emit_columnar: bool = True
+    # one shared ingest+decode pipeline per stream config across qos=0 rules
+    # (reference subtopo_pool); checkpointed rules always get a private source
+    share_source: bool = True
     # planOptimizeStrategy analogue (reference: internal/pkg/def/rule.go:55-66);
     # {"mesh": {"rows": R, "keys": K}} runs the fused kernel sharded over an
     # R x K device mesh (parallel/sharded.py)

@@ -30,10 +30,14 @@ from ekuiper_tpu.store import kv  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def fresh_engine_state():
-    """Fresh mock clock + in-memory store for every test."""
+    """Fresh mock clock + in-memory store + empty subtopo pool per test."""
+    from ekuiper_tpu.runtime import subtopo
+
     clock = timex.set_mock_clock(0)
     kv.setup("memory")
+    subtopo.reset()
     yield clock
+    subtopo.reset()
     timex.use_real_clock()
 
 

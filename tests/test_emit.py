@@ -121,8 +121,8 @@ class TestDirectEmitE2E:
             "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10) "
             "ORDER BY max(temperature) DESC LIMIT 2"
         ), actions=[{"memory": {"topic": "de_res"}}]), store)
-        # tail folded: only the fused node remains
-        assert [n.name for n in topo.ops] == ["window_agg"]
+        # tail folded: only the shared-source entry + fused node remain
+        assert [n.name for n in topo.ops] == ["demo_shared", "window_agg"]
         got = []
         mem.subscribe("de_res", lambda t, p: got.append(p))
         topo.open()

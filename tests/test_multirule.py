@@ -1,0 +1,103 @@
+"""Batched homogeneous rules (parallel/multirule.py): one vmapped program
+must produce exactly what N independent single-rule kernels produce."""
+import numpy as np
+import pytest
+
+from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+from ekuiper_tpu.ops.groupby import DeviceGroupBy
+from ekuiper_tpu.ops.keytable import KeyTable
+from ekuiper_tpu.parallel.multirule import (
+    BatchedGroupBy, build_rule_batch)
+from ekuiper_tpu.sql.parser import parse_select
+
+
+def _sql(thresh, upper=None):
+    where = f"temperature > {thresh}"
+    if upper is not None:
+        where += f" AND temperature < {upper}"
+    return (f"SELECT deviceId, avg(temperature) AS a, count(*) AS c, "
+            f"max(temperature) AS mx FROM demo WHERE {where} "
+            f"GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+
+
+class TestBuildRuleBatch:
+    def test_homogeneous(self):
+        stmts = [parse_select(_sql(t)) for t in (10, 20, 30)]
+        spec = build_rule_batch(["r0", "r1", "r2"], stmts)
+        assert spec.params.shape == (3, 1)
+        np.testing.assert_array_equal(
+            spec.params[:, 0], np.array([10, 20, 30], dtype=np.float32))
+        assert "__param_0" in spec.param_names
+        assert "__param_0" not in spec.plan.columns
+
+    def test_multi_param(self):
+        stmts = [parse_select(_sql(10, 50)), parse_select(_sql(20, 60))]
+        spec = build_rule_batch(["a", "b"], stmts)
+        assert spec.params.shape == (2, 2)
+
+    def test_heterogeneous_rejected(self):
+        stmts = [
+            parse_select(_sql(10)),
+            parse_select("SELECT deviceId, sum(temperature) AS a FROM demo "
+                         "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"),
+        ]
+        with pytest.raises(ValueError, match="not homogeneous"):
+            build_rule_batch(["a", "b"], stmts)
+
+    def test_structurally_different_where_rejected(self):
+        stmts = [parse_select(_sql(10)), parse_select(_sql(10, 99))]
+        with pytest.raises(ValueError, match="not homogeneous"):
+            build_rule_batch(["a", "b"], stmts)
+
+
+class TestBatchedParity:
+    def test_vs_individual_kernels(self):
+        thresholds = [12.0, 18.0, 22.0, 25.0, 30.0, 5.0, 15.0, 28.0]
+        stmts = [parse_select(_sql(t)) for t in thresholds]
+        spec = build_rule_batch([f"r{i}" for i in range(8)], stmts)
+
+        rng = np.random.default_rng(0)
+        n = 500
+        keys = np.array([f"d{i}" for i in rng.integers(0, 20, n)],
+                        dtype=np.object_)
+        temp = rng.normal(20, 8, n).astype(np.float32)
+
+        kt = KeyTable(64)
+        slots, _ = kt.encode_column(keys)
+
+        batched = BatchedGroupBy(spec, capacity=64, micro_batch=128)
+        bstate = batched.init_state()
+        bstate = batched.fold(bstate, {"temperature": temp}, slots)
+        bouts, bact = batched.finalize(bstate, kt.n_keys)
+
+        for r, stmt in enumerate(stmts):
+            plan = extract_kernel_plan(stmt)
+            gb = DeviceGroupBy(plan, capacity=64, micro_batch=128)
+            st = gb.init_state()
+            st = gb.fold(st, {"temperature": temp}, slots)
+            outs, act = gb.finalize(st, kt.n_keys)
+            np.testing.assert_allclose(bact[r], act, rtol=1e-6)
+            for i in range(len(outs)):
+                np.testing.assert_allclose(
+                    np.asarray(bouts[i][r], dtype=np.float64),
+                    np.asarray(outs[i], dtype=np.float64),
+                    rtol=1e-5, equal_nan=True)
+
+    def test_reset_and_grow(self):
+        stmts = [parse_select(_sql(t)) for t in (10.0, 20.0)]
+        spec = build_rule_batch(["a", "b"], stmts)
+        kt = KeyTable(4)
+        batched = BatchedGroupBy(spec, capacity=4, micro_batch=32)
+        state = batched.init_state()
+        keys = np.array([f"k{i}" for i in range(10)], dtype=np.object_)
+        temp = np.full(10, 25.0, dtype=np.float32)
+        slots, grew = kt.encode_column(keys)
+        assert grew
+        state = batched.grow(state, kt.capacity)
+        state = batched.fold(state, {"temperature": temp}, slots)
+        outs, act = batched.finalize(state, kt.n_keys)
+        assert outs[1].shape == (2, 10)
+        np.testing.assert_array_equal(outs[1][0], np.ones(10))  # count
+        state = batched.reset_pane(state, 0)
+        outs2, act2 = batched.finalize(state, kt.n_keys)
+        assert not np.any(act2)

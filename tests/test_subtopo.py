@@ -1,0 +1,148 @@
+"""Shared-source subtopology: N rules over one stream share one ingest +
+decode pipeline (reference: internal/topo/subtopo.go, subtopo_pool.go)."""
+import time
+
+import numpy as np
+
+from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+from ekuiper_tpu.runtime import subtopo
+from ekuiper_tpu.server.processors import StreamProcessor
+from ekuiper_tpu.store import kv
+import ekuiper_tpu.io.memory as mem
+
+
+def _mk_stream(store):
+    StreamProcessor(store).exec_stmt(
+        'CREATE STREAM demo (deviceId STRING, temperature FLOAT) '
+        'WITH (DATASOURCE="t/shared", TYPE="memory", FORMAT="JSON")'
+    )
+
+
+def _rule(rule_id, threshold, qos=0):
+    return RuleDef(
+        id=rule_id,
+        sql=(f"SELECT deviceId, temperature FROM demo "
+             f"WHERE temperature > {threshold}"),
+        actions=[{"memory": {"topic": f"res/{rule_id}"}}],
+        options={"qos": qos} if qos else {},
+    )
+
+
+def _results(sink):
+    out = []
+    for item in list(sink.results):
+        out.extend(item if isinstance(item, list) else [item])
+    return out
+
+
+class TestSubtopoPool:
+    def test_two_rules_one_source(self, mock_clock):
+        store = kv.get_store()
+        _mk_stream(store)
+        t1 = plan_rule(_rule("r1", 25), store)
+        t2 = plan_rule(_rule("r2", 10), store)
+        # both rules rode the pool: no private sources, same subtopo key;
+        # the live instance resolves at open()
+        assert not t1.sources and not t2.sources
+        assert t1.shared[0][0].key == t2.shared[0][0].key
+        t1.open()
+        t2.open()
+        assert subtopo.pool_size() == 1
+        st = t1._live_shared[0][0]
+        assert st is t2._live_shared[0][0]
+        assert st.ref_count() == 2
+        try:
+            mem.publish("t/shared", {"deviceId": "a", "temperature": 30.0})
+            mem.publish("t/shared", {"deviceId": "b", "temperature": 20.0})
+            mock_clock.advance(20)  # linger flush
+            deadline = time.time() + 5
+            while time.time() < deadline and not (
+                t1.sinks[0].results and t2.sinks[0].results
+            ):
+                time.sleep(0.01)
+            r1 = _results(t1.sinks[0])
+            r2 = _results(t2.sinks[0])
+            # one decode, two different filters applied per rule
+            assert [m["deviceId"] for m in r1] == ["a"]
+            assert sorted(m["deviceId"] for m in r2) == ["a", "b"]
+        finally:
+            t1.close()
+            assert st.ref_count() == 1  # r2 still attached, source still live
+            t2.close()
+        assert st.ref_count() == 0
+        assert subtopo.pool_size() == 0  # closed and evicted on last detach
+
+    def test_qos_rule_gets_private_source(self):
+        store = kv.get_store()
+        _mk_stream(store)
+        t1 = plan_rule(_rule("rq", 5, qos=1), store)
+        assert t1.sources and not t1.shared
+        assert subtopo.pool_size() == 0
+
+    def test_different_options_do_not_share(self):
+        store = kv.get_store()
+        _mk_stream(store)
+        t1 = plan_rule(_rule("ra", 5), store)
+        r = _rule("rb", 5)
+        r.options = {"micro_batch_rows": 128}
+        t2 = plan_rule(r, store)
+        assert t1.shared[0][0].key != t2.shared[0][0].key
+        t1.open(); t2.open()
+        try:
+            assert subtopo.pool_size() == 2
+        finally:
+            t1.close(); t2.close()
+
+    def test_reopen_after_pool_close(self, mock_clock):
+        """A rule opened AFTER the pooled subtopo closed (last peer
+        detached) must get a fresh, working pipeline."""
+        store = kv.get_store()
+        _mk_stream(store)
+        t1 = plan_rule(_rule("rr1", 0), store)
+        t2 = plan_rule(_rule("rr2", 0), store)
+        t1.open()
+        t1.close()  # last detach -> subtopo closes and is evicted
+        assert subtopo.pool_size() == 0
+        t2.open()  # must resolve a FRESH subtopo, not the dead one
+        try:
+            assert subtopo.pool_size() == 1
+            mem.publish("t/shared", {"deviceId": "x", "temperature": 1.0})
+            mock_clock.advance(20)
+            deadline = time.time() + 5
+            while time.time() < deadline and not t2.sinks[0].results:
+                time.sleep(0.01)
+            assert any(m["deviceId"] == "x" for m in _results(t2.sinks[0]))
+        finally:
+            t2.close()
+
+    def test_share_source_off(self):
+        store = kv.get_store()
+        _mk_stream(store)
+        r = _rule("rc", 5)
+        r.options = {"share_source": False}
+        t = plan_rule(r, store)
+        assert t.sources and not t.shared
+
+    def test_fanout_survives_detach_during_traffic(self, mock_clock):
+        """Detaching one rule mid-stream must not break the other's feed
+        (copy-on-write outputs)."""
+        store = kv.get_store()
+        _mk_stream(store)
+        t1 = plan_rule(_rule("rd1", 0), store)
+        t2 = plan_rule(_rule("rd2", 0), store)
+        t1.open(); t2.open()
+        try:
+            for i in range(5):
+                mem.publish("t/shared", {"deviceId": f"d{i}", "temperature": 1.0})
+            mock_clock.advance(20)
+            t1.close()  # detach while t2 keeps consuming
+            mem.publish("t/shared", {"deviceId": "after", "temperature": 1.0})
+            mock_clock.advance(20)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if any(m["deviceId"] == "after" for m in _results(t2.sinks[0])):
+                    break
+                time.sleep(0.01)
+            assert any(m["deviceId"] == "after" for m in _results(t2.sinks[0]))
+        finally:
+            t2.close()
