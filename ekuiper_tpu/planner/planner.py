@@ -251,23 +251,44 @@ def plan_rule(rule: RuleDef, store) -> Topo:
         rule.id, qos=opts.qos, checkpoint_interval_ms=opts.checkpoint_interval_ms
     )
 
+    # joined tables that are registered lookup TABLEs get a LookupJoinNode;
+    # joined STREAMs get their own source + the stream-stream JoinNode
+    lookup_joins: List[ast.Join] = []
+    stream_joins: List[ast.Join] = []
+    for j in stmt.joins:
+        if _is_lookup_table(j.table.name, store):
+            lookup_joins.append(j)
+        else:
+            stream_joins.append(j)
+
+    if stream_joins and stmt.window is None:
+        # same contract as the reference: stream-stream joins pair rows
+        # WITHIN a window collection (join_operator.go); without one the
+        # pairing set is undefined
+        raise PlanError("stream-stream JOIN requires a window")
+
     # sources — shared via the subtopo pool (one ingest+decode pipeline per
     # stream config, reference subtopo_pool.go:34) when the rule is qos=0;
     # checkpointed rules keep a private source so barriers stay rule-scoped
+    stream_tbls = list(stmt.sources) + [j.table for j in stream_joins]
+    # alias-qualified refs resolve against the emitter name, so any join
+    # (including lookup-only) keeps ref_name naming
+    multi = len(stream_tbls) > 1 or bool(stmt.joins)
     source_nodes: List[SourceNode] = []
-    for tbl in stmt.sources:
-        src_name = (tbl.ref_name if len(stmt.sources) > 1 or stmt.joins
-                    else tbl.name)
+    for tbl in stream_tbls:
+        src_name = tbl.ref_name if multi else tbl.name
         source_nodes.append(
             _plan_stream_source(tbl.name, src_name, opts, store, topo))
 
     kernel_plan = device_path_eligible(stmt, opts)
-    if kernel_plan is not None and len(source_nodes) == 1:
+    if kernel_plan is not None and len(source_nodes) == 1 and not lookup_joins:
         tail = _build_device_chain(
             topo, stmt, kernel_plan, source_nodes[0], opts, rule_id=rule.id
         )
     else:
-        tail = _build_host_chain(topo, stmt, source_nodes, opts, rule.id)
+        tail = _build_host_chain(topo, stmt, source_nodes, opts, rule.id,
+                                 stream_joins=stream_joins,
+                                 lookup_joins=lookup_joins, store=store)
 
     # sinks
     actions = rule.actions or [{"log": {}}]
@@ -327,6 +348,44 @@ def plan_rule_group(group_id: str, rules: List[RuleDef], store) -> Topo:
                 _build_sink_chain(topo, entry, sink_type, props or {}, i,
                                   opts, r.id, store)
     return topo
+
+
+def _is_lookup_table(name: str, store) -> bool:
+    _, ok = store.kv("table").get_ok(name)
+    return ok
+
+
+def _equality_key_fields(join: ast.Join) -> List:
+    """(stream_field, table_field) pairs from an equality ON clause; exactly
+    one side of each equality must be qualified by the joined table's
+    ref_name (silently guessing would query the wrong column)."""
+    table = join.table.ref_name
+    pairs = []
+
+    def walk(e):
+        if isinstance(e, ast.BinaryExpr):
+            if e.op == "AND":
+                walk(e.lhs)
+                walk(e.rhs)
+                return
+            if e.op == "=" and isinstance(e.lhs, ast.FieldRef) and isinstance(
+                e.rhs, ast.FieldRef
+            ):
+                if e.lhs.stream == table and e.rhs.stream != table:
+                    pairs.append((e.rhs.name, e.lhs.name))
+                    return
+                if e.rhs.stream == table and e.lhs.stream != table:
+                    pairs.append((e.lhs.name, e.rhs.name))
+                    return
+                raise PlanError(
+                    f"lookup join ON equality must qualify exactly one side "
+                    f"with the table alias {table!r}: {e!r}")
+        raise PlanError(
+            f"lookup join ON clause must be equality conditions, got {e!r}")
+
+    if join.on is not None:
+        walk(join.on)
+    return pairs
 
 
 def _plan_stream_source(stream_name: str, src_name: str, opts, store,
@@ -604,8 +663,11 @@ def _build_device_chain(
 
 def _build_host_chain(
     topo: Topo, stmt, source_nodes: List[SourceNode], opts: RuleOptionConfig,
-    rule_id: str,
+    rule_id: str, stream_joins=None, lookup_joins=None, store=None,
 ):
+    if stream_joins is None:
+        stream_joins = stmt.joins
+    lookup_joins = lookup_joins or []
     tail_of_sources = source_nodes
     # event-time: watermark generation + late drop
     if opts.is_event_time:
@@ -630,6 +692,24 @@ def _build_host_chain(
     if analytic:
         attach(AnalyticNode("analytic", analytic, rule_id=rule_id,
                             buffer_length=opts.buffer_length))
+    # lookup joins run on the STREAM, before WHERE and the window (reference
+    # lookup_node.go sits right after decode): WHERE may reference table
+    # columns, and windows must collect already-joined rows
+    for k, lj in enumerate(lookup_joins):
+        from ..runtime.nodes_join import LookupJoinNode
+
+        tdef = load_stream_def(lj.table.name, store)
+        tprops = _source_props(tdef, store)
+        if tdef.options.key:
+            tprops.setdefault("key", tdef.options.key)
+        lookup = io_registry.create_lookup(tdef.options.type or "memory")
+        lookup.configure(tdef.options.datasource, tprops)
+        attach(LookupJoinNode(
+            f"lookup_join_{k}" if k else "lookup_join", lookup, lj,
+            key_fields=_equality_key_fields(lj),
+            cache_ttl_ms=int(tprops.get("cacheTtl", 60_000)),
+            buffer_length=opts.buffer_length,
+        ))
     # predicate pushdown: WHERE before the window when it has no analytic refs
     where_pushed = False
     if stmt.condition is not None and not analytic:
@@ -641,9 +721,9 @@ def _build_host_chain(
                           buffer_length=opts.buffer_length))
     if stmt.condition is not None and not where_pushed:
         attach(FilterNode("filter", stmt.condition, buffer_length=opts.buffer_length))
-    if stmt.joins:
+    if stream_joins:
         left = stmt.sources[0].ref_name
-        attach(JoinNode("join", stmt.joins, left_name=left,
+        attach(JoinNode("join", stream_joins, left_name=left,
                         buffer_length=opts.buffer_length))
     if stmt.dimensions:
         attach(AggregateNode("aggregate", [d.expr for d in stmt.dimensions],

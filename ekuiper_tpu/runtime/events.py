@@ -20,6 +20,7 @@ class Barrier:
 
     checkpoint_id: int
     source_id: str = ""
+    qos: int = 1  # 1 at-least-once (tracker), 2 exactly-once (aligner)
 
 
 @dataclass

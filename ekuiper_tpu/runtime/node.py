@@ -19,6 +19,18 @@ from ..utils.metrics import StatManager
 from .events import EOF, Barrier, ErrorEvent, PreTrigger, Trigger, Watermark
 
 
+class _Tagged:
+    """Envelope recording which upstream enqueued an item — barrier
+    alignment (exactly-once) must distinguish input edges, and the fabric
+    uses one queue per node, not one per edge."""
+
+    __slots__ = ("item", "from_name")
+
+    def __init__(self, item: Any, from_name: Optional[str]) -> None:
+        self.item = item
+        self.from_name = from_name
+
+
 class Node:
     def __init__(
         self,
@@ -36,21 +48,33 @@ class Node:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._topo = None  # set by Topo.add
+        self._input_names: set = set()  # distinct upstream node names
+        # barrier bookkeeping (reference barrier_handler.go):
+        # tracker (qos<=1): checkpoint_id -> barriers seen, snapshot on FIRST
+        # aligner (qos==2): checkpoint_id -> {blocked edges, held-back items}
+        self._barrier_seen: dict = {}
+        self._align: dict = {}
+        # set by Topo.open for qos==2 rules: data items carry their sender so
+        # the aligner can hold back per edge; below that, only barriers are
+        # tagged (skips a per-item envelope allocation on the hot path)
+        self._tag_data = False
 
     # ------------------------------------------------------------------ wiring
     def connect(self, downstream: "Node") -> "Node":
         self.outputs.append(downstream)
+        downstream._input_names.add(self.name)
         return downstream
 
     # ------------------------------------------------------------------- input
-    def put(self, item: Any) -> None:
+    def put(self, item: Any, from_name: Optional[str] = None) -> None:
         """Enqueue with drop-oldest on overflow (node.go:140-196)."""
+        entry = _Tagged(item, from_name) if from_name is not None else item
         if self.disable_buffer_full_discard:
-            self.inq.put(item)
+            self.inq.put(entry)
             return
         while True:
             try:
-                self.inq.put_nowait(item)
+                self.inq.put_nowait(entry)
                 return
             except queue.Full:
                 try:
@@ -63,7 +87,10 @@ class Node:
 
     def broadcast(self, item: Any) -> None:
         for out in self.outputs:
-            out.put(item)
+            if getattr(out, "_tag_data", False) or isinstance(item, Barrier):
+                out.put(item, self.name)
+            else:
+                out.put(item)
 
     # --------------------------------------------------------------- lifecycle
     def open(self) -> None:
@@ -102,27 +129,52 @@ class Node:
         try:
             while not self._stop.is_set():
                 try:
-                    item = self.inq.get(timeout=0.2)
+                    entry = self.inq.get(timeout=0.2)
                 except queue.Empty:
                     continue
                 try:
-                    if item is None:
+                    if entry is None:
                         continue
+                    if isinstance(entry, _Tagged):
+                        item, from_name = entry.item, entry.from_name
+                    else:
+                        item, from_name = entry, None
                     self.stats.set_buffer_length(self.inq.qsize())
-                    self._dispatch(item)
+                    self._dispatch(item, from_name)
                 finally:
                     # unfinished_tasks accounting backs Topo.wait_idle()
                     self.inq.task_done()
         finally:
             self.on_close()
 
-    def _dispatch(self, item: Any) -> None:
+    def _dispatch(self, item: Any, from_name: Optional[str] = None) -> None:
+        if isinstance(item, Barrier):
+            self._handle_barrier(item, from_name)
+            return
+        if self._align and from_name is not None:
+            # exactly-once alignment in progress: items from an edge whose
+            # barrier already arrived are held back until all edges align
+            # (barrier_handler.go BarrierAligner), preserving per-edge order
+            for cid, st in list(self._align.items()):
+                if from_name in st["blocked"]:
+                    st["buffer"].append((item, from_name))
+                    if len(st["buffer"]) > self.ALIGN_BUFFER_CAP:
+                        # a peer edge's barrier was lost (drop-oldest
+                        # backpressure or a dead upstream): force-complete —
+                        # degrade this checkpoint to at-least-once instead of
+                        # stalling the edge and growing the buffer forever
+                        logger.warning(
+                            "%s: alignment %s overflowed, degrading to "
+                            "at-least-once", self.name, cid)
+                        del self._align[cid]
+                        self.on_barrier(Barrier(checkpoint_id=cid, qos=1))
+                        for it, fn in st["buffer"]:
+                            self._dispatch(it, fn)
+                    return
         self.stats.inc_in()
         self.stats.process_begin()
         try:
-            if isinstance(item, Barrier):
-                self.on_barrier(item)
-            elif isinstance(item, Watermark):
+            if isinstance(item, Watermark):
                 self.on_watermark(item)
             elif isinstance(item, EOF):
                 self.on_eof(item)
@@ -156,8 +208,51 @@ class Node:
         """Data item (ColumnBatch / collection / row)."""
         self.emit(item)
 
+    def _handle_barrier(self, barrier: Barrier, from_name: Optional[str]) -> None:
+        """Fan-in-correct barrier handling (barrier_handler.go:23-88).
+
+        qos<=1 (at-least-once) BarrierTracker: snapshot + forward on the
+        FIRST arrival of a checkpoint id, swallow the rest — no duplicate
+        barriers downstream, no multi-snapshot.
+
+        qos==2 (exactly-once) BarrierAligner: after the first arrival, hold
+        back items from edges whose barrier already came, snapshot only when
+        every input edge's barrier arrived (a consistent cut), then replay
+        the held-back items.
+        """
+        cid = barrier.checkpoint_id
+        n = max(len(self._input_names), 1)
+        if barrier.qos >= 2 and n > 1:
+            st = self._align.get(cid)
+            if st is None:
+                st = {"blocked": set(), "buffer": []}
+                self._align[cid] = st
+            st["blocked"].add(from_name)
+            if len(st["blocked"]) >= n:
+                del self._align[cid]
+                self.on_barrier(barrier)
+                for item, fn in st["buffer"]:
+                    self._dispatch(item, fn)
+            return
+        seen = self._barrier_seen.get(cid, 0)
+        if seen == 0:
+            self.on_barrier(barrier)
+        if seen + 1 >= n:
+            self._barrier_seen.pop(cid, None)
+        else:
+            self._barrier_seen[cid] = seen + 1
+            if len(self._barrier_seen) > 64:
+                # stale ids (a peer edge lost its barrier to backpressure):
+                # drop the oldest bookkeeping, the checkpoint already fired
+                oldest = min(self._barrier_seen)
+                del self._barrier_seen[oldest]
+
+    #: held-back items per in-flight alignment before it force-completes
+    ALIGN_BUFFER_CAP = 10_000
+
     def on_barrier(self, barrier: Barrier) -> None:
-        """Default: snapshot own state then forward (at-least-once tracker)."""
+        """Snapshot own state, ack the coordinator, forward downstream.
+        Called exactly once per checkpoint id (see _handle_barrier)."""
         if self._topo is not None:
             self._topo.checkpoint_ack(self.name, barrier, self.snapshot_state())
         self.broadcast(barrier)

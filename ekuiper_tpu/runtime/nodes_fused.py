@@ -141,8 +141,10 @@ class FusedWindowAggNode(Node):
         self._warmup()
 
     def _warmup(self) -> None:
-        """Compile fold+finalize before data arrives so the first window
-        doesn't pay 1-40s of jit latency."""
+        """Compile fold/finalize/prefinalize/absorb/reset on a THROWAWAY
+        state before data arrives, so the first window doesn't pay 1-40s of
+        jit latency. Must never touch self.state — it may hold partials
+        restored from a checkpoint."""
         try:
             # no valid masks: matches the common typed-schema batch pytree so
             # the compiled executable is the one real folds will hit
@@ -150,19 +152,19 @@ class FusedWindowAggNode(Node):
                 name: np.zeros(1, dtype=np.float32) for name in self.plan.columns
             }
             slots = np.zeros(1, dtype=np.int32)
-            self.state = self.gb.fold(self.state, cols, slots,
-                                      pane_idx=self.cur_pane)
-            self.gb.finalize(self.state, 1)
+            dummy = self.gb.init_state()
+            dummy = self.gb.fold(dummy, cols, slots, pane_idx=self.cur_pane)
+            self.gb.finalize(dummy, 1)
             if self._prefinalize_ok:
-                pending = self.gb.prefinalize_begin(self.state)
+                pending = self.gb.prefinalize_begin(dummy)
                 self.gb.prefinalize_merge(pending, None, 1)
             if self._tail_host_only:
                 # compile absorb with an identity (empty) shadow
                 from ..ops.prefinalize import HostShadow
 
                 hs = HostShadow(self.plan, self.gb.comp_specs, self.gb.capacity)
-                self.state = self.gb.absorb(self.state, hs.data, 0)
-            self.state = self.gb.reset_pane(self.state, self.cur_pane)
+                dummy = self.gb.absorb(dummy, hs.data, 0)
+            self.gb.reset_pane(dummy, self.cur_pane)
         except Exception as exc:
             logger.debug("fused warmup failed (non-fatal): %s", exc)
 

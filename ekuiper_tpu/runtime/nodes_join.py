@@ -78,7 +78,7 @@ class LookupJoinNode(Node):
     ) -> None:
         super().__init__(name, op_type="op", **kw)
         self.lookup = lookup_source
-        self.join = join
+        self.join_def = join
         self.key_fields = key_fields
         self.cache_ttl = cache_ttl_ms
         self._cache: Dict[Any, PyTuple[int, List[Dict[str, Any]]]] = {}
@@ -102,7 +102,7 @@ class LookupJoinNode(Node):
             self.emit(item)
             return
         out: List[JoinTuple] = []
-        table = self.join.table.ref_name
+        table = self.join_def.table.ref_name
         for r in rows:
             values = []
             for sf, _tf in self.key_fields:
@@ -124,7 +124,7 @@ class LookupJoinNode(Node):
                         r if isinstance(r, Tuple) else Tuple(message=r.all_values()),
                         Tuple(emitter=table, message=m),
                     ]))
-            elif self.join.join_type == ast.JoinType.LEFT:
+            elif self.join_def.join_type == ast.JoinType.LEFT:
                 out.append(JoinTuple(tuples=[
                     r if isinstance(r, Tuple) else Tuple(message=r.all_values())
                 ]))

@@ -32,6 +32,7 @@ class SwitchNode(Node):
         self.case_outputs[case_idx].append(downstream)
         if downstream not in self.outputs:
             self.outputs.append(downstream)
+        downstream._input_names.add(self.name)  # fan-in count for barriers
         return downstream
 
     def process(self, item: Any) -> None:
@@ -54,6 +55,6 @@ class SwitchNode(Node):
                 if matched:
                     self.stats.inc_out(1)
                     for out in self.case_outputs[i]:
-                        out.put(r)
+                        out.put(r, self.name if getattr(out, "_tag_data", False) else None)
                     if self.stop_at_first_match:
                         break

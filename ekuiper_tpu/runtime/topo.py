@@ -69,6 +69,11 @@ class Topo:
         if self.qos > 0:
             self._store = kv.get_store().kv(f"checkpoint:{self.rule_id}")
             self._restore()
+        if self.qos >= 2:
+            # exactly-once: data items carry their sender so fan-in nodes
+            # can hold back barriered edges (node.py _handle_barrier)
+            for node in self.all_nodes():
+                node._tag_data = True
         for node in self.sinks + self.ops + self.sources:
             node.open()
         self._live_shared = [
@@ -150,7 +155,7 @@ class Topo:
             self._ckpt_id += 1
             cid = self._ckpt_id
             self._ckpt_pending[cid] = {}
-        barrier = Barrier(checkpoint_id=cid)
+        barrier = Barrier(checkpoint_id=cid, qos=self.qos)
         for src in self.sources:
             src.put(barrier)
         return cid

@@ -19,7 +19,7 @@ class Collect:
     def __init__(self):
         self.items = []
 
-    def put(self, item):
+    def put(self, item, from_name=None):
         self.items.append(item)
 
 
@@ -150,7 +150,7 @@ class AckingCollect(Collect):
         super().__init__()
         self.cache = cache
 
-    def put(self, item):
+    def put(self, item, from_name=None):
         super().put(item)
         self.cache.ack(item)
 
@@ -258,7 +258,7 @@ class _Direct:
     def __init__(self, node):
         self.node = node
 
-    def put(self, item):
+    def put(self, item, from_name=None):
         self.node._dispatch(item)
 
 

@@ -225,7 +225,7 @@ def test_graph_function_then_filter_batch():
     class _Cap:
         name = "cap"
 
-        def put(self, item):
+        def put(self, item, from_name=None):
             out.append(item)
 
     fn.outputs.append(flt)
@@ -233,7 +233,9 @@ def test_graph_function_then_filter_batch():
     batch = from_tuples([Tuple(message={"v": v}) for v in (1, 2, 3, 4)])
     fn.process(batch)
     # drain the filter's input queue synchronously (no worker threads here)
+    from ekuiper_tpu.runtime.node import _Tagged
     while not flt.inq.empty():
-        flt.process(flt.inq.get_nowait())
+        entry = flt.inq.get_nowait()
+        flt.process(entry.item if isinstance(entry, _Tagged) else entry)
     vals = sorted(r.value("dbl")[0] for r in out)
     assert vals == [6, 8]

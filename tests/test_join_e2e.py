@@ -1,0 +1,101 @@
+"""Join rules through the full planner/topology: stream-stream joins over a
+window (both sources planned and fed — regression for the missing
+join-table sources) and stream-to-lookup-table joins."""
+import time
+
+import pytest
+
+from ekuiper_tpu.planner.planner import PlanError, RuleDef, plan_rule
+from ekuiper_tpu.server.processors import StreamProcessor
+from ekuiper_tpu.store import kv
+import ekuiper_tpu.io.memory as mem
+
+
+def _streams(store):
+    sp = StreamProcessor(store)
+    sp.exec_stmt('CREATE STREAM ls (id STRING, v FLOAT) '
+                 'WITH (DATASOURCE="j/l", TYPE="memory", FORMAT="JSON")')
+    sp.exec_stmt('CREATE STREAM rs (id STRING, w FLOAT) '
+                 'WITH (DATASOURCE="j/r", TYPE="memory", FORMAT="JSON")')
+
+
+def _flat(got):
+    out = []
+    for p in got:
+        out.extend(p if isinstance(p, list) else [p])
+    return out
+
+
+class TestStreamJoin:
+    def test_windowed_inner_join(self, mock_clock):
+        store = kv.get_store()
+        _streams(store)
+        topo = plan_rule(RuleDef(
+            id="j1", sql=("SELECT ls.id, ls.v, rs.w FROM ls "
+                          "INNER JOIN rs ON ls.id = rs.id "
+                          "GROUP BY TUMBLINGWINDOW(ss, 10)"),
+            actions=[{"memory": {"topic": "j1/out"}}], options={}), store)
+        # both streams got an ingest pipeline
+        src_names = [n.name for n in topo.sources] + [
+            n.name for n in topo.ops if n.name.endswith("_shared")]
+        assert any("ls" in n for n in src_names)
+        assert any("rs" in n for n in src_names)
+        got = []
+        mem.subscribe("j1/out", lambda t, p: got.append(p))
+        topo.open()
+        try:
+            mem.publish("j/l", {"id": "a", "v": 1.0})
+            mem.publish("j/r", {"id": "a", "w": 2.0})
+            mem.publish("j/l", {"id": "only_left", "v": 9.0})
+            mock_clock.advance(20)
+            assert topo.wait_idle(10)
+            mock_clock.advance(10_000)
+            deadline = time.time() + 6
+            while time.time() < deadline and not _flat(got):
+                time.sleep(0.02)
+        finally:
+            topo.close()
+        msgs = _flat(got)
+        assert {m["id"] for m in msgs} == {"a"}  # inner join drops only_left
+        assert msgs[0]["v"] == 1.0 and msgs[0]["w"] == 2.0
+
+    def test_join_without_window_rejected(self):
+        store = kv.get_store()
+        _streams(store)
+        with pytest.raises(PlanError, match="JOIN requires a window"):
+            plan_rule(RuleDef(
+                id="j2", sql=("SELECT ls.id FROM ls "
+                              "INNER JOIN rs ON ls.id = rs.id"),
+                actions=[{"log": {}}], options={}), store)
+
+
+class TestLookupJoin:
+    def test_stream_to_table_join(self, mock_clock):
+        store = kv.get_store()
+        sp = StreamProcessor(store)
+        sp.exec_stmt('CREATE STREAM ev (dev STRING, val FLOAT) '
+                     'WITH (DATASOURCE="lk/ev", TYPE="memory", FORMAT="JSON")')
+        sp.exec_stmt('CREATE TABLE meta (dev STRING, site STRING) '
+                     'WITH (DATASOURCE="lk/meta", TYPE="memory", '
+                     'FORMAT="JSON", KEY="dev")')
+        # seed the lookup table BEFORE the rule starts? Memory lookup
+        # subscribes at open; publish after open.
+        topo = plan_rule(RuleDef(
+            id="lk1", sql=("SELECT ev.dev, ev.val, meta.site FROM ev "
+                           "INNER JOIN meta ON ev.dev = meta.dev"),
+            actions=[{"memory": {"topic": "lk1/out"}}], options={}), store)
+        assert any(n.name.startswith("lookup_join") for n in topo.ops)
+        got = []
+        mem.subscribe("lk1/out", lambda t, p: got.append(p))
+        topo.open()
+        try:
+            mem.publish("lk/meta", {"dev": "d1", "site": "berlin"})
+            mem.publish("lk/ev", {"dev": "d1", "val": 7.0})
+            mock_clock.advance(20)
+            deadline = time.time() + 6
+            while time.time() < deadline and not _flat(got):
+                time.sleep(0.02)
+        finally:
+            topo.close()
+        msgs = _flat(got)
+        assert msgs and msgs[0]["site"] == "berlin" and msgs[0]["val"] == 7.0
