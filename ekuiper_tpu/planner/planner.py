@@ -355,6 +355,19 @@ def _is_lookup_table(name: str, store) -> bool:
     return ok
 
 
+def _stream_side_qualifiers(join: ast.Join) -> set:
+    """Stream aliases referenced by the ON clause's non-table sides — the
+    chains a LookupJoinNode must sit on."""
+    table = join.table.ref_name
+    out = set()
+    if join.on is not None:
+        for node in ast.walk(join.on):
+            if isinstance(node, ast.FieldRef) and node.stream and \
+                    node.stream != table:
+                out.add(node.stream)
+    return out
+
+
 def _equality_key_fields(join: ast.Join) -> List:
     """(stream_field, table_field) pairs from an equality ON clause; exactly
     one side of each equality must be qualified by the joined table's
@@ -694,7 +707,9 @@ def _build_host_chain(
                             buffer_length=opts.buffer_length))
     # lookup joins run on the STREAM, before WHERE and the window (reference
     # lookup_node.go sits right after decode): WHERE may reference table
-    # columns, and windows must collect already-joined rows
+    # columns, and windows must collect already-joined rows. With multiple
+    # source streams, the lookup node sits ONLY on the chain its key fields
+    # reference — other streams' rows must not pass through it.
     for k, lj in enumerate(lookup_joins):
         from ..runtime.nodes_join import LookupJoinNode
 
@@ -704,12 +719,23 @@ def _build_host_chain(
             tprops.setdefault("key", tdef.options.key)
         lookup = io_registry.create_lookup(tdef.options.type or "memory")
         lookup.configure(tdef.options.datasource, tprops)
-        attach(LookupJoinNode(
+        node = LookupJoinNode(
             f"lookup_join_{k}" if k else "lookup_join", lookup, lj,
             key_fields=_equality_key_fields(lj),
             cache_ttl_ms=int(tprops.get("cacheTtl", 60_000)),
             buffer_length=opts.buffer_length,
-        ))
+        )
+        qualifiers = _stream_side_qualifiers(lj)
+        targets = [t for t in chain
+                   if t.name in qualifiers
+                   or any(t.name == q + "_shared" for q in qualifiers)]
+        if len(chain) > 1 and targets:
+            topo.add_op(node)
+            for t in targets:
+                t.connect(node)
+            chain[:] = [c for c in chain if c not in targets] + [node]
+        else:
+            attach(node)
     # predicate pushdown: WHERE before the window when it has no analytic refs
     where_pushed = False
     if stmt.condition is not None and not analytic:

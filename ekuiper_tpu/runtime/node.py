@@ -54,6 +54,7 @@ class Node:
         # aligner (qos==2): checkpoint_id -> {blocked edges, held-back items}
         self._barrier_seen: dict = {}
         self._align: dict = {}
+        self._align_done: dict = {}  # recently completed cids (bounded)
         # set by Topo.open for qos==2 rules: data items carry their sender so
         # the aligner can hold back per edge; below that, only barriers are
         # tagged (skips a per-item envelope allocation on the hot path)
@@ -85,12 +86,18 @@ class Node:
                 except queue.Empty:
                     continue
 
+    def send_to(self, out: "Node", item: Any) -> None:
+        """Single place encoding the sender-tagging contract: barriers are
+        always tagged (alignment identifies edges); data is tagged only when
+        the receiver runs exactly-once (_tag_data)."""
+        if getattr(out, "_tag_data", False) or isinstance(item, Barrier):
+            out.put(item, self.name)
+        else:
+            out.put(item)
+
     def broadcast(self, item: Any) -> None:
         for out in self.outputs:
-            if getattr(out, "_tag_data", False) or isinstance(item, Barrier):
-                out.put(item, self.name)
-            else:
-                out.put(item)
+            self.send_to(out, item)
 
     # --------------------------------------------------------------- lifecycle
     def open(self) -> None:
@@ -148,12 +155,10 @@ class Node:
             self.on_close()
 
     def _dispatch(self, item: Any, from_name: Optional[str] = None) -> None:
-        if isinstance(item, Barrier):
-            self._handle_barrier(item, from_name)
-            return
         if self._align and from_name is not None:
-            # exactly-once alignment in progress: items from an edge whose
-            # barrier already arrived are held back until all edges align
+            # exactly-once alignment in progress: items — INCLUDING later
+            # checkpoints' barriers — from an edge whose barrier already
+            # arrived are held back until all edges align
             # (barrier_handler.go BarrierAligner), preserving per-edge order
             for cid, st in list(self._align.items()):
                 if from_name in st["blocked"]:
@@ -167,10 +172,14 @@ class Node:
                             "%s: alignment %s overflowed, degrading to "
                             "at-least-once", self.name, cid)
                         del self._align[cid]
+                        self._mark_align_done(cid)
                         self.on_barrier(Barrier(checkpoint_id=cid, qos=1))
                         for it, fn in st["buffer"]:
                             self._dispatch(it, fn)
                     return
+        if isinstance(item, Barrier):
+            self._handle_barrier(item, from_name)
+            return
         self.stats.inc_in()
         self.stats.process_begin()
         try:
@@ -223,6 +232,11 @@ class Node:
         cid = barrier.checkpoint_id
         n = max(len(self._input_names), 1)
         if barrier.qos >= 2 and n > 1:
+            if cid in self._align_done:
+                # a peer's late barrier for a checkpoint that already
+                # completed (alignment overflow degraded it) — swallow it,
+                # re-opening alignment would stall that edge forever
+                return
             st = self._align.get(cid)
             if st is None:
                 st = {"blocked": set(), "buffer": []}
@@ -230,6 +244,7 @@ class Node:
             st["blocked"].add(from_name)
             if len(st["blocked"]) >= n:
                 del self._align[cid]
+                self._mark_align_done(cid)
                 self.on_barrier(barrier)
                 for item, fn in st["buffer"]:
                     self._dispatch(item, fn)
@@ -249,6 +264,11 @@ class Node:
 
     #: held-back items per in-flight alignment before it force-completes
     ALIGN_BUFFER_CAP = 10_000
+
+    def _mark_align_done(self, cid: int) -> None:
+        self._align_done[cid] = True
+        while len(self._align_done) > 16:
+            del self._align_done[next(iter(self._align_done))]
 
     def on_barrier(self, barrier: Barrier) -> None:
         """Snapshot own state, ack the coordinator, forward downstream.

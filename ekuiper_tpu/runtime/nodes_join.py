@@ -28,12 +28,18 @@ class JoinNode(Node):
         if not isinstance(item, WindowTuples):
             self.emit(item)
             return
-        by_emitter: Dict[str, List[Tuple]] = {}
+        by_emitter: Dict[str, List[Any]] = {}
         for r in item.rows():
             if isinstance(r, Tuple):
                 by_emitter.setdefault(r.emitter, []).append(r)
+            elif isinstance(r, JoinTuple) and r.tuples:
+                # a lookup join upstream already widened this row; group it
+                # under its stream tuple's emitter
+                by_emitter.setdefault(r.tuples[0].emitter, []).append(r)
         current: List[JoinTuple] = [
-            JoinTuple(tuples=[t]) for t in by_emitter.get(self.left_name, [])
+            JoinTuple(tuples=list(t.tuples)) if isinstance(t, JoinTuple)
+            else JoinTuple(tuples=[t])
+            for t in by_emitter.get(self.left_name, [])
         ]
         for join in self.joins:
             right_rows = by_emitter.get(join.table.ref_name, [])
@@ -47,24 +53,27 @@ class JoinNode(Node):
         out: List[JoinTuple] = []
         jt = join.join_type
         matched_right: set = set()
+        def widen(rt) -> List[Tuple]:
+            return list(rt.tuples) if isinstance(rt, JoinTuple) else [rt]
+
         for lt in left:
             matched = False
             for ri, rt in enumerate(right):
                 if jt == ast.JoinType.CROSS:
                     ok = True
                 else:
-                    probe = JoinTuple(tuples=list(lt.tuples) + [rt])
+                    probe = JoinTuple(tuples=list(lt.tuples) + widen(rt))
                     ok = self.ev.eval_condition(join.on, probe)
                 if ok:
                     matched = True
                     matched_right.add(ri)
-                    out.append(JoinTuple(tuples=list(lt.tuples) + [rt]))
+                    out.append(JoinTuple(tuples=list(lt.tuples) + widen(rt)))
             if not matched and jt in (ast.JoinType.LEFT, ast.JoinType.FULL):
                 out.append(JoinTuple(tuples=list(lt.tuples)))
         if jt in (ast.JoinType.RIGHT, ast.JoinType.FULL):
             for ri, rt in enumerate(right):
                 if ri not in matched_right:
-                    out.append(JoinTuple(tuples=[rt]))
+                    out.append(JoinTuple(tuples=widen(rt)))
         return out
 
 

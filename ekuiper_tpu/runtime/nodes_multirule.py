@@ -81,14 +81,13 @@ class MultiRuleFusedNode(FusedWindowAggNode):
                     dim_cols, agg_cols, wr.window_start, wr.window_end)
                 if cb is not None and cb.n:
                     self.stats.inc_out(cb.n)
-                    out_node.put(cb, self.name if getattr(out_node, "_tag_data", False) else None)
+                    self.send_to(out_node, cb)
             else:
                 msgs = self.direct_emit.run(
                     dim_cols, agg_cols, wr.window_start, wr.window_end)
                 if msgs:
                     self.stats.inc_out(len(msgs))
-                    out_node.put(msgs if len(msgs) > 1 else msgs[0],
-                                 self.name if getattr(out_node, "_tag_data", False) else None)
+                    self.send_to(out_node, msgs if len(msgs) > 1 else msgs[0])
 
     # ------------------------------------------------------------------ state
     def restore_state(self, state: dict) -> None:

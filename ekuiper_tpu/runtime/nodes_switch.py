@@ -55,6 +55,6 @@ class SwitchNode(Node):
                 if matched:
                     self.stats.inc_out(1)
                     for out in self.case_outputs[i]:
-                        out.put(r, self.name if getattr(out, "_tag_data", False) else None)
+                        self.send_to(out, r)
                     if self.stop_at_first_match:
                         break

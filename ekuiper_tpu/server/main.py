@@ -36,6 +36,9 @@ def start_up(config_path: str | None = None, block: bool = True):
     ScriptManager.set_global(ScriptManager(store))
     SchemaRegistry.set_global(SchemaRegistry(
         store, etc_dir=f"{cfg.store.path}/schemas"))
+    from ..services.manager import ServiceManager
+
+    ServiceManager.set_global(ServiceManager(store))
     api = RestApi(store)
     api.rules.recover()
     server = serve(api, cfg.basic.rest_ip, cfg.basic.rest_port)

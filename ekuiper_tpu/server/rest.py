@@ -123,12 +123,41 @@ class RestApi:
         r("DELETE", r"^/scripts/(?P<name>[^/]+)$",
           lambda m: self._scripts().delete(m["name"])
           or f"Script {m['name']} is dropped.")
+        # external services (reference: rest.go service routes,
+        # internal/service/manager.go)
+        r("GET", r"^/services$", lambda m: self._services().list())
+        r("POST", r"^/services$",
+          lambda m, body=None: self._services().create(
+              (body or {}).get("name", ""), (body or {}).get("file")
+              or (body or {}).get("descriptor") or {})
+          or f"Service {(body or {}).get('name')} is created.")
+        r("GET", r"^/services/functions$",
+          lambda m: self._services().list_functions())
+        r("GET", r"^/services/functions/(?P<name>[^/]+)$",
+          lambda m: self._services().describe_function(m["name"]))
+        r("GET", r"^/services/(?P<name>[^/]+)$",
+          lambda m: self._services().describe(m["name"]))
+        r("PUT", r"^/services/(?P<name>[^/]+)$",
+          lambda m, body=None: self._services().create(
+              m["name"], (body or {}).get("descriptor") or body or {},
+              overwrite=True)
+          or f"Service {m['name']} is updated.")
+        r("DELETE", r"^/services/(?P<name>[^/]+)$",
+          lambda m: self._services().delete(m["name"])
+          or f"Service {m['name']} is deleted.")
         # portable plugins (reference: rest.go plugin routes)
         r("GET", r"^/plugins/portables$", lambda m: self._plugins().list())
         r("POST", r"^/plugins/portables$", self.install_plugin)
         r("GET", r"^/plugins/portables/(?P<name>[^/]+)$", self.describe_plugin)
         r("DELETE", r"^/plugins/portables/(?P<name>[^/]+)$",
           lambda m: self._plugins().delete(m["name"]) or f"Plugin {m['name']} is deleted.")
+
+    # --------------------------------------------------------------- services
+    @staticmethod
+    def _services():
+        from ..services.manager import ServiceManager
+
+        return ServiceManager.global_instance()
 
     # ---------------------------------------------------------------- schemas
     @staticmethod

@@ -1,0 +1,3 @@
+"""External services — gRPC/REST/msgpack-rpc endpoints as SQL functions
+(analogue of the reference's internal/service subsystem)."""
+from .manager import ServiceManager  # noqa: F401

@@ -1,0 +1,235 @@
+"""External service manager — descriptor JSON in, SQL functions out
+(analogue of internal/service/manager.go:48-266).
+
+A service descriptor (same shape as the reference's sample.json) declares
+interfaces; each interface has an address, protocol (rest/grpc/msgpack-rpc),
+optional protobuf schema, and function mappings. Every mapped function —
+or, with a protobuf schema and no explicit mapping, every service method —
+becomes callable from SQL through the binder provider chain
+(functions/registry.py): `SELECT myfn(temperature) FROM s`.
+
+Descriptors persist in the KV store and re-register at boot. Executors are
+built lazily on first call and cached per interface.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..functions import registry as fn_registry
+from ..utils.infra import EngineError, logger
+from .executors import new_executor
+from .schema import ProtoServiceSchema
+
+
+class _Interface:
+    def __init__(self, service: str, name: str, spec: Dict[str, Any]) -> None:
+        self.service = service
+        self.name = name
+        self.address = spec.get("address", "")
+        self.protocol = spec.get("protocol", "rest")
+        self.options = spec.get("options") or {}
+        self.schema_type = spec.get("schemaType", "")
+        # reference reads schemaFile from the etc dir; we accept inline
+        # proto source (schemaContent) or a file path (schemaFile)
+        self.schema_content = spec.get("schemaContent", "")
+        self.schema_file = spec.get("schemaFile", "")
+        self.functions = spec.get("functions") or []
+        self._schema: Optional[ProtoServiceSchema] = None
+        self._executor = None
+        self._lock = threading.Lock()
+        if not self.address:
+            raise EngineError(f"interface {name}: address is required")
+
+    def schema(self) -> Optional[ProtoServiceSchema]:
+        if self.schema_type != "protobuf":
+            return None
+        if self._schema is None:
+            content = self.schema_content
+            if not content and self.schema_file:
+                with open(self.schema_file) as f:
+                    content = f.read()
+            if not content:
+                raise EngineError(
+                    f"interface {self.name}: protobuf schema declared but no "
+                    "schemaContent/schemaFile")
+            self._schema = ProtoServiceSchema(content)
+        return self._schema
+
+    def function_map(self) -> Dict[str, str]:
+        """SQL function name -> wire method/serviceName."""
+        out: Dict[str, str] = {}
+        if self.functions:
+            for m in self.functions:
+                out[m.get("name") or m["serviceName"]] = m["serviceName"]
+            return out
+        schema = self.schema()
+        if schema is not None:
+            for method in schema.methods:
+                out[method] = method
+        return out
+
+    def call(self, target: str, args: List[Any]) -> Any:
+        with self._lock:
+            if self._executor is None:
+                self._executor = new_executor(
+                    self.protocol, self.address, self.options, self.schema())
+            ex = self._executor
+        return ex.call(target, args)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._executor is not None:
+                self._executor.close()
+                self._executor = None
+
+
+class ServiceManager:
+    _instance: Optional["ServiceManager"] = None
+    _provider_registered = False
+
+    def __init__(self, store=None) -> None:
+        self._kv = store.kv("service") if store is not None else None
+        self._services: Dict[str, Dict[str, Any]] = {}
+        self._interfaces: Dict[str, _Interface] = {}  # "svc/iface"
+        #: SQL function name -> (interface key, wire target)
+        self._functions: Dict[str, tuple] = {}
+        self._mu = threading.RLock()
+        # one chain-wide provider delegating to the CURRENT global instance
+        # (a fresh manager per test/boot must not stack stale providers)
+        ServiceManager._instance = self
+        if not ServiceManager._provider_registered:
+            fn_registry.add_provider(
+                lambda n: (ServiceManager._instance._provide(n)
+                           if ServiceManager._instance is not None else None))
+            ServiceManager._provider_registered = True
+        if self._kv is not None:
+            for name in self._kv.keys():
+                try:
+                    raw = self._kv.get(name)
+                    self._register(name, json.loads(raw)
+                                   if isinstance(raw, str) else raw)
+                except Exception as exc:
+                    logger.warning("service %s restore failed: %s", name, exc)
+
+    @classmethod
+    def global_instance(cls) -> "ServiceManager":
+        if cls._instance is None:
+            cls._instance = ServiceManager()
+        return cls._instance
+
+    @classmethod
+    def set_global(cls, mgr: "ServiceManager") -> None:
+        cls._instance = mgr
+
+    # ------------------------------------------------------------------ CRUD
+    def create(self, name: str, descriptor: Any,
+               overwrite: bool = False) -> None:
+        if isinstance(descriptor, str):
+            # reference clients send {"name", "file"}: accept a local json
+            # descriptor path; remote zip bundles are not supported
+            import os
+
+            if os.path.isfile(descriptor):
+                with open(descriptor) as f:
+                    descriptor = json.load(f)
+            else:
+                raise EngineError(
+                    "service 'file' must be a local descriptor json path; "
+                    "inline the definition under 'descriptor' otherwise")
+        if not isinstance(descriptor, dict):
+            raise EngineError("service descriptor must be a json object")
+        if not name:
+            raise EngineError("service name is required")
+        with self._mu:
+            if not overwrite and name in self._services:
+                raise EngineError(f"service {name} already exists")
+            if name in self._services:
+                self._unregister(name)
+            self._register(name, descriptor)
+            if self._kv is not None:
+                self._kv.set(name, json.dumps(descriptor))
+
+    def delete(self, name: str) -> None:
+        with self._mu:
+            if name not in self._services:
+                raise EngineError(f"service {name} not found")
+            self._unregister(name)
+            if self._kv is not None:
+                self._kv.delete(name)
+
+    def list(self) -> List[str]:
+        with self._mu:
+            return sorted(self._services)
+
+    def describe(self, name: str) -> Dict[str, Any]:
+        with self._mu:
+            if name not in self._services:
+                raise EngineError(f"service {name} not found")
+            return self._services[name]
+
+    def list_functions(self) -> List[Dict[str, Any]]:
+        with self._mu:
+            return [
+                {"name": fname, "serviceName": target,
+                 "interface": ikey.split("/", 1)[1],
+                 "service": ikey.split("/", 1)[0]}
+                for fname, (ikey, target) in sorted(self._functions.items())
+            ]
+
+    def describe_function(self, fname: str) -> Dict[str, Any]:
+        with self._mu:
+            got = self._functions.get(fname)
+            if got is None:
+                raise EngineError(f"external function {fname} not found")
+            ikey, target = got
+            return {"name": fname, "serviceName": target,
+                    "service": ikey.split("/", 1)[0],
+                    "interface": ikey.split("/", 1)[1]}
+
+    # -------------------------------------------------------------- internal
+    def _register(self, name: str, descriptor: Dict[str, Any]) -> None:
+        interfaces = descriptor.get("interfaces") or {}
+        if not interfaces:
+            raise EngineError("service descriptor has no interfaces")
+        new_ifaces: Dict[str, _Interface] = {}
+        new_fns: Dict[str, tuple] = {}
+        for iname, spec in interfaces.items():
+            iface = _Interface(name, iname, spec)
+            key = f"{name}/{iname}"
+            new_ifaces[key] = iface
+            for fname, target in iface.function_map().items():
+                fname = fname.lower()  # SQL function names are case-insensitive
+                clash = fn_registry.lookup(fname)
+                if clash is not None and fname not in self._functions:
+                    raise EngineError(
+                        f"function {fname} already exists (builtin wins; "
+                        "rename via the functions mapping)")
+                new_fns[fname] = (key, target)
+        self._services[name] = descriptor
+        self._interfaces.update(new_ifaces)
+        self._functions.update(new_fns)
+
+    def _unregister(self, name: str) -> None:
+        self._services.pop(name, None)
+        for key in [k for k in self._interfaces if k.startswith(name + "/")]:
+            self._interfaces.pop(key).close()
+        for fname in [f for f, (k, _) in self._functions.items()
+                      if k.startswith(name + "/")]:
+            del self._functions[fname]
+
+    # ------------------------------------------------- binder provider chain
+    def _provide(self, fname: str):
+        with self._mu:
+            got = self._functions.get(fname)
+            if got is None:
+                return None
+            ikey, target = got
+            iface = self._interfaces[ikey]
+
+        def call(args, ctx=None):  # engine convention: exec(args_list, ctx)
+            return iface.call(target, list(args))
+
+        return fn_registry.FunctionDef(
+            name=fname, ftype=fn_registry.SCALAR, exec=call)

@@ -1,0 +1,107 @@
+"""Protobuf service schemas for external services.
+
+Compiles a .proto source with `protoc` (base toolchain) into a descriptor
+pool and indexes its `service` definitions: method name → (input message
+class, output message class). The reference does the same through
+protoreflect's dynamic messages (internal/service/schema.go); here the
+google.protobuf descriptor pool + message factory play that role.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+from ..utils.infra import EngineError
+
+
+class ProtoServiceSchema:
+    """Parsed proto: message classes + service method index."""
+
+    def __init__(self, content: str) -> None:
+        from google.protobuf import (
+            descriptor_pb2, descriptor_pool, message_factory)
+
+        self.content = content
+        with tempfile.TemporaryDirectory() as td:
+            proto_path = os.path.join(td, "svc.proto")
+            with open(proto_path, "w") as f:
+                f.write(content)
+            desc_path = proto_path + ".pb"
+            res = subprocess.run(
+                ["protoc", f"--proto_path={td}", f"--descriptor_set_out={desc_path}",
+                 "svc.proto"],
+                capture_output=True, timeout=30,
+            )
+            if res.returncode != 0:
+                raise EngineError(
+                    "protoc failed: "
+                    + res.stderr.decode(errors="replace").strip())
+            with open(desc_path, "rb") as f:
+                fds = descriptor_pb2.FileDescriptorSet.FromString(f.read())
+        pool = descriptor_pool.DescriptorPool()
+        self._pool = pool
+        #: method name -> (service full name, input class, output class)
+        self.methods: Dict[str, Tuple[str, Any, Any]] = {}
+        for fdp in fds.file:
+            pool.Add(fdp)
+        for fdp in fds.file:
+            pkg = fdp.package
+            for svc in fdp.service:
+                full = f"{pkg}.{svc.name}" if pkg else svc.name
+                for m in svc.method:
+                    in_desc = pool.FindMessageTypeByName(
+                        m.input_type.lstrip("."))
+                    out_desc = pool.FindMessageTypeByName(
+                        m.output_type.lstrip("."))
+                    self.methods[m.name] = (
+                        full,
+                        message_factory.GetMessageClass(in_desc),
+                        message_factory.GetMessageClass(out_desc),
+                    )
+
+    def method(self, name: str) -> Tuple[str, Any, Any]:
+        try:
+            return self.methods[name]
+        except KeyError:
+            raise EngineError(f"service method {name!r} not in schema")
+
+    # ------------------------------------------------------------- marshaling
+    def build_request(self, method: str, args) -> Any:
+        """Positional args fill the input message's fields in declaration
+        order; a single dict argument fills by name (reference
+        externalFunc.go arg mapping)."""
+        from google.protobuf import json_format
+
+        _, in_cls, _ = self.method(method)
+        msg = in_cls()
+        fields = in_cls.DESCRIPTOR.fields
+        if len(args) == 1 and isinstance(args[0], dict):
+            json_format.ParseDict(args[0], msg, ignore_unknown_fields=True)
+            return msg
+        if len(args) > len(fields):
+            raise EngineError(
+                f"{method} takes at most {len(fields)} args, got {len(args)}")
+        for fd, val in zip(fields, args):
+            if hasattr(val, "item"):  # numpy scalar from a column
+                val = val.item()
+            if fd.label == fd.LABEL_REPEATED:
+                getattr(msg, fd.name).extend(val)
+            elif fd.message_type is not None:
+                json_format.ParseDict(val, getattr(msg, fd.name),
+                                      ignore_unknown_fields=True)
+            else:
+                setattr(msg, fd.name, val)
+        return msg
+
+    def result_to_value(self, method: str, msg) -> Any:
+        """Single-field responses unwrap to the bare value (the reference
+        unwraps single-output messages the same way)."""
+        from google.protobuf import json_format
+
+        d = json_format.MessageToDict(msg, preserving_proto_field_name=True)
+        fields = msg.DESCRIPTOR.fields
+        if len(fields) == 1:
+            return d.get(fields[0].name)
+        return d

@@ -69,6 +69,52 @@ class TestStreamJoin:
                 actions=[{"log": {}}], options={}), store)
 
 
+class TestMixedJoins:
+    def test_lookup_node_only_on_its_stream_chain(self):
+        """With a stream join AND a lookup join, the lookup node must sit
+        only on the chain its ON clause references — other streams' rows
+        must not be filtered through it."""
+        store = kv.get_store()
+        _streams(store)
+        StreamProcessor(store).exec_stmt(
+            'CREATE TABLE meta (id STRING, site STRING) '
+            'WITH (DATASOURCE="mx/meta", TYPE="memory", FORMAT="JSON", '
+            'KEY="id")')
+        topo = plan_rule(RuleDef(
+            id="mx1", sql=(
+                "SELECT ls.id, rs.w, meta.site FROM ls "
+                "INNER JOIN rs ON ls.id = rs.id "
+                "INNER JOIN meta ON ls.id = meta.id "
+                "GROUP BY TUMBLINGWINDOW(ss, 10)"),
+            actions=[{"memory": {"topic": "mx1/out"}}], options={}), store)
+        lookup = next(n for n in topo.ops if n.name.startswith("lookup_join"))
+        # only the ls chain feeds the lookup node
+        feeders = [n.name for n in topo.ops + topo.sources
+                   if lookup in n.outputs]
+        assert feeders == ["ls_shared"], feeders
+        return topo
+
+    def test_mixed_join_values(self, mock_clock):
+        topo = self.test_lookup_node_only_on_its_stream_chain()
+        got = []
+        mem.subscribe("mx1/out", lambda t, p: got.append(p))
+        topo.open()
+        try:
+            mem.publish("mx/meta", {"id": "a", "site": "oslo"})
+            mem.publish("j/l", {"id": "a", "v": 1.0})
+            mem.publish("j/r", {"id": "a", "w": 2.0})
+            mock_clock.advance(20)
+            assert topo.wait_idle(10)
+            mock_clock.advance(10_000)
+            deadline = time.time() + 6
+            while time.time() < deadline and not _flat(got):
+                time.sleep(0.02)
+        finally:
+            topo.close()
+        msgs = _flat(got)
+        assert msgs and msgs[0] == {"id": "a", "w": 2.0, "site": "oslo"}, msgs
+
+
 class TestLookupJoin:
     def test_stream_to_table_join(self, mock_clock):
         store = kv.get_store()

@@ -1,0 +1,230 @@
+"""External services: descriptor JSON → SQL functions over REST/gRPC/
+msgpack-rpc (reference internal/service/manager.go, executors.go)."""
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from ekuiper_tpu.services.manager import ServiceManager
+from ekuiper_tpu.services.schema import ProtoServiceSchema
+from ekuiper_tpu.functions import registry as fn_registry
+from ekuiper_tpu.store import kv
+
+PROTO = """
+syntax = "proto3";
+package sample;
+
+message Req { string text = 1; int32 times = 2; }
+message Resp { string out = 1; }
+
+service Helper {
+  rpc EchoTimes(Req) returns (Resp);
+}
+"""
+
+
+@pytest.fixture
+def rest_stub():
+    calls = []
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            calls.append((self.path, body))
+            if self.path.endswith("/EchoTimes"):
+                out = {"out": body.get("text", "") * int(body.get("times", 1))}
+            elif self.path.endswith("/double"):
+                out = {"value": body * 2 if isinstance(body, (int, float))
+                       else [v * 2 for v in body]}
+            else:
+                out = {"echo": body}
+            data = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}", calls
+    srv.shutdown()
+
+
+class TestProtoServiceSchema:
+    def test_method_index_and_marshal(self):
+        s = ProtoServiceSchema(PROTO)
+        full, in_cls, out_cls = s.method("EchoTimes")
+        assert full == "sample.Helper"
+        msg = s.build_request("EchoTimes", ["ab", 3])
+        assert msg.text == "ab" and msg.times == 3
+        msg2 = s.build_request("EchoTimes", [{"text": "x", "times": 2}])
+        assert msg2.text == "x"
+        resp = out_cls(out="zz")
+        assert s.result_to_value("EchoTimes", resp) == "zz"  # single field unwraps
+
+
+class TestRestService:
+    def test_schemaless_function_call(self, rest_stub):
+        addr, calls = rest_stub
+        mgr = ServiceManager(kv.get_store())
+        mgr.create("mysvc", {"interfaces": {"calc": {
+            "address": addr, "protocol": "rest",
+            "functions": [{"name": "sv_echo", "serviceName": "echoit"}],
+        }}})
+        fd = fn_registry.lookup("sv_echo")
+        assert fd is not None
+        out = fd.exec([{"a": 1}], None)
+        assert out == {"echo": {"a": 1}}
+        assert calls[-1][0] == "/echoit"
+
+    def test_protobuf_rest(self, rest_stub):
+        addr, calls = rest_stub
+        mgr = ServiceManager(kv.get_store())
+        mgr.create("psvc", {"interfaces": {"helper": {
+            "address": addr, "protocol": "rest",
+            "schemaType": "protobuf", "schemaContent": PROTO,
+        }}})
+        # no explicit mapping -> every proto method is a function
+        fd = fn_registry.lookup("echotimes")
+        assert fd is not None
+        assert fd.exec(["ab", 2], None) == "abab"
+        assert calls[-1][1] == {"text": "ab", "times": 2}
+
+    def test_sql_rule_calls_external_function(self, rest_stub, mock_clock):
+        addr, _ = rest_stub
+        from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+        from ekuiper_tpu.server.processors import StreamProcessor
+        import ekuiper_tpu.io.memory as mem
+
+        store = kv.get_store()
+        mgr = ServiceManager(store)
+        mgr.create("s1", {"interfaces": {"helper": {
+            "address": addr, "protocol": "rest",
+            "schemaType": "protobuf", "schemaContent": PROTO,
+            "functions": [{"name": "rep", "serviceName": "EchoTimes"}],
+        }}})
+        StreamProcessor(store).exec_stmt(
+            'CREATE STREAM demo (word STRING, n BIGINT) '
+            'WITH (DATASOURCE="svc/demo", TYPE="memory", FORMAT="JSON")')
+        topo = plan_rule(RuleDef(
+            id="svcr", sql="SELECT rep(word, n) AS out FROM demo",
+            actions=[{"memory": {"topic": "svc/out"}}], options={}), store)
+        got = []
+        mem.subscribe("svc/out", lambda t, p: got.append(p))
+        topo.open()
+        try:
+            mem.publish("svc/demo", {"word": "hi", "n": 3})
+            mock_clock.advance(20)
+            deadline = time.time() + 6
+            while time.time() < deadline and not got:
+                time.sleep(0.02)
+        finally:
+            topo.close()
+        msgs = []
+        for p in got:
+            msgs.extend(p if isinstance(p, list) else [p])
+        assert msgs and msgs[0]["out"] == "hihihi"
+
+
+class TestGrpcService:
+    def test_grpc_roundtrip(self):
+        import grpc
+        from concurrent import futures
+
+        schema = ProtoServiceSchema(PROTO)
+        _, in_cls, out_cls = schema.method("EchoTimes")
+
+        def repeat(request, context):
+            return out_cls(out=request.text * request.times)
+
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        handler = grpc.method_handlers_generic_handler("sample.Helper", {
+            "EchoTimes": grpc.unary_unary_rpc_method_handler(
+                repeat, request_deserializer=in_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString()),
+        })
+        server.add_generic_rpc_handlers((handler,))
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        try:
+            mgr = ServiceManager(kv.get_store())
+            mgr.create("gsvc", {"interfaces": {"helper": {
+                "address": f"127.0.0.1:{port}", "protocol": "grpc",
+                "schemaType": "protobuf", "schemaContent": PROTO,
+                "functions": [{"name": "grepeat", "serviceName": "EchoTimes"}],
+            }}})
+            fd = fn_registry.lookup("grepeat")
+            assert fd.exec(["xy", 2], None) == "xyxy"
+        finally:
+            server.stop(0)
+
+
+class TestMsgpackService:
+    def test_msgpack_rpc_roundtrip(self):
+        import msgpack
+
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def serve():
+            conn, _ = srv.accept()
+            unp = msgpack.Unpacker(raw=False)
+            while True:
+                data = conn.recv(65536)
+                if not data:
+                    return
+                unp.feed(data)
+                for frame in unp:
+                    typ, msgid, method, params = frame
+                    result = sum(params) if method == "add" else None
+                    conn.sendall(msgpack.packb([1, msgid, None, result]))
+
+        threading.Thread(target=serve, daemon=True).start()
+        mgr = ServiceManager(kv.get_store())
+        mgr.create("msvc", {"interfaces": {"m": {
+            "address": f"tcp://127.0.0.1:{port}", "protocol": "msgpack-rpc",
+            "functions": [{"name": "madd", "serviceName": "add"}],
+        }}})
+        fd = fn_registry.lookup("madd")
+        assert fd.exec([1, 2, 3], None) == 6
+        srv.close()
+
+
+class TestManagerCrud:
+    def test_crud_and_persistence(self, rest_stub):
+        addr, _ = rest_stub
+        store = kv.get_store()
+        mgr = ServiceManager(store)
+        desc = {"interfaces": {"i": {
+            "address": addr, "protocol": "rest",
+            "functions": [{"name": "pfn", "serviceName": "echoit"}]}}}
+        mgr.create("crudsvc", desc)
+        assert "crudsvc" in mgr.list()
+        assert mgr.describe("crudsvc") == desc
+        assert any(f["name"] == "pfn" for f in mgr.list_functions())
+        # restore from the store into a FRESH manager (boot path)
+        mgr2 = ServiceManager(store)
+        assert "crudsvc" in mgr2.list()
+        assert fn_registry.lookup("pfn") is not None
+        mgr2.delete("crudsvc")
+        assert "crudsvc" not in mgr2.list()
+        assert fn_registry.lookup("pfn") is None
+
+    def test_builtin_clash_rejected(self, rest_stub):
+        addr, _ = rest_stub
+        mgr = ServiceManager(kv.get_store())
+        with pytest.raises(Exception, match="already exists"):
+            mgr.create("clash", {"interfaces": {"i": {
+                "address": addr, "protocol": "rest",
+                "functions": [{"name": "abs", "serviceName": "x"}]}}})
