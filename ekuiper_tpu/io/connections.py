@@ -1,0 +1,121 @@
+"""Connection management — named, reusable connector configs with
+connectivity probing (analogue of the reference's connection CRUD + ping
+routes, internal/server/rest.go connections handlers and
+internal/pkg/connection registry).
+
+A connection is {"id", "typ", "props"}; sources/sinks reference it through
+a conf-key style profile, and `ping` checks reachability without starting a
+rule."""
+from __future__ import annotations
+
+import json
+import socket
+from typing import Any, Dict, List
+from urllib.parse import urlparse
+
+from ..utils.infra import EngineError
+
+
+class ConnectionManager:
+    def __init__(self, store) -> None:
+        self._kv = store.kv("connection")
+
+    # ------------------------------------------------------------------ CRUD
+    def create(self, spec: Dict[str, Any]) -> None:
+        cid = spec.get("id", "")
+        if not cid:
+            raise EngineError("connection id is required")
+        if not spec.get("typ"):
+            raise EngineError("connection typ is required")
+        _, exists = self._kv.get_ok(cid)
+        if exists:
+            raise EngineError(f"connection {cid} already exists")
+        self._kv.set(cid, json.dumps(spec))
+
+    def update(self, cid: str, spec: Dict[str, Any]) -> None:
+        _, exists = self._kv.get_ok(cid)
+        if not exists:
+            raise EngineError(f"connection {cid} not found")
+        self._kv.set(cid, json.dumps({**spec, "id": cid}))
+
+    def get(self, cid: str) -> Dict[str, Any]:
+        raw, ok = self._kv.get_ok(cid)
+        if not ok:
+            raise EngineError(f"connection {cid} not found")
+        return json.loads(raw) if isinstance(raw, str) else raw
+
+    def list(self) -> List[Dict[str, Any]]:
+        return [self.get(k) for k in sorted(self._kv.keys())]
+
+    def delete(self, cid: str) -> None:
+        _, ok = self._kv.get_ok(cid)
+        if not ok:
+            raise EngineError(f"connection {cid} not found")
+        self._kv.delete(cid)
+
+    # ------------------------------------------------------------------ ping
+    def ping(self, cid: str) -> str:
+        spec = self.get(cid)
+        return ping(spec.get("typ", ""), spec.get("props") or {})
+
+
+def _tcp_probe(host: str, port: int, timeout: float = 3.0) -> None:
+    with socket.create_connection((host, port), timeout=timeout):
+        pass
+
+
+def ping(typ: str, props: Dict[str, Any]) -> str:
+    """Probe connectivity for a connector type; raises EngineError with the
+    reason on failure, returns 'ok' on success."""
+    typ = typ.lower()
+    try:
+        if typ in ("memory", "simulator", "file", "log", "nop"):
+            return "ok"
+        if typ in ("redis", "redissub"):
+            from .redis_io import _client_from_props
+
+            cli = _client_from_props(props)
+            cli.connect()
+            try:
+                if cli.command("PING") not in ("PONG", b"PONG"):
+                    raise EngineError("unexpected PING reply")
+            finally:
+                cli.close()
+            return "ok"
+        if typ == "websocket":
+            addr = props.get("addr", "")
+            if addr:
+                from websockets.sync.client import connect
+
+                connect(addr, open_timeout=3).close()
+            return "ok"
+        if typ in ("httppull", "httppush", "rest"):
+            url = props.get("url", props.get("addr", ""))
+            u = urlparse(url)
+            if not u.hostname:
+                raise EngineError(f"no url to probe in {props}")
+            _tcp_probe(u.hostname, u.port or (443 if u.scheme == "https" else 80))
+            return "ok"
+        if typ == "mqtt":
+            url = props.get("server", props.get("servers", ""))
+            if isinstance(url, list):
+                url = url[0] if url else ""
+            u = urlparse(url if "://" in str(url) else f"tcp://{url}")
+            _tcp_probe(u.hostname or "127.0.0.1", u.port or 1883)
+            return "ok"
+        if typ == "neuron":
+            from ..plugin import ipc
+
+            url = props.get("url", "neuron-ekuiper")
+            s = ipc.Socket(ipc.PAIR)
+            try:
+                s.dial(url if "://" in url else ipc.ipc_url(url),
+                       timeout_ms=3000)
+            finally:
+                s.close()
+            return "ok"
+        raise EngineError(f"ping not supported for connector type {typ!r}")
+    except EngineError:
+        raise
+    except Exception as exc:
+        raise EngineError(f"{typ} ping failed: {exc}")

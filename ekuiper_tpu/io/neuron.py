@@ -1,0 +1,184 @@
+"""Neuron source/sink — bidirectional pair channel to an industrial-gateway
+process (analogue of internal/io/neuron over pkg/nng's PAIR socket,
+sock.go:77-82).
+
+Transport divergence, documented: the reference speaks the NNG pair wire
+protocol on ipc:///tmp/neuron-ekuiper.ipc; this engine speaks its own
+framed pair transport (native/ekipc.cpp, plugin/ipc.py) on a configurable
+ipc:// url. Payload semantics match the reference: the source ingests
+neuron's JSON tag messages ({"group_name","tag_name","values"/...}); the
+sink writes {"group_name","tag_name","tag_value"} commands built from the
+nodeName/groupName/tags props. A shared, refcounted connection serves all
+neuron endpoints in the process (the reference shares one NNG socket the
+same way).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.infra import EngineError, logger
+from .contract import Sink, Source
+
+DEFAULT_URL = "ipc://neuron-ekuiper"
+
+
+class _NeuronConn:
+    """One shared pair connection per url, refcounted across endpoints."""
+
+    _conns: Dict[str, "_NeuronConn"] = {}
+    _glock = threading.Lock()
+
+    @staticmethod
+    def normalize(url: str) -> str:
+        from ..plugin import ipc
+
+        return url if "://" in url else ipc.ipc_url(url)
+
+    def __init__(self, url: str) -> None:
+        from ..plugin import ipc
+
+        self.url = url
+        self.refs = 0
+        self.sources: List[Callable[[Any], None]] = []
+        self._lock = threading.Lock()
+        # the pair socket is NOT safe for concurrent send/recv from
+        # different threads (native transport); all socket IO serializes
+        # through _io_lock, with short recv slices so sends never starve
+        self._io_lock = threading.Lock()
+        self._sock = ipc.Socket(ipc.PAIR)
+        self._sock.dial(self.url, timeout_ms=5000)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._recv_loop, daemon=True, name=f"neuron-{url}")
+        self._thread.start()
+
+    @classmethod
+    def acquire(cls, url: str) -> "_NeuronConn":
+        url = cls.normalize(url)  # key and self.url must agree for release()
+        with cls._glock:
+            conn = cls._conns.get(url)
+            if conn is None:
+                conn = _NeuronConn(url)
+                cls._conns[url] = conn
+            conn.refs += 1
+            return conn
+
+    def release(self) -> None:
+        with _NeuronConn._glock:
+            self.refs -= 1
+            if self.refs <= 0:
+                _NeuronConn._conns.pop(self.url, None)
+                self._stop.set()
+                self._sock.close()
+
+    def _recv_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                with self._io_lock:
+                    raw = self._sock.recv(timeout_ms=50)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                self._stop.wait(0.005)
+                continue
+            if raw is None:
+                continue
+            try:
+                payload = json.loads(raw.decode("utf-8", errors="replace"))
+            except ValueError:
+                payload = {"data": raw.decode("utf-8", errors="replace")}
+            with self._lock:
+                sources = list(self.sources)
+            for ingest in sources:
+                try:
+                    ingest(payload)
+                except Exception as exc:
+                    logger.warning("neuron ingest error: %s", exc)
+
+    def add_source(self, ingest: Callable[[Any], None]) -> None:
+        with self._lock:
+            self.sources.append(ingest)
+
+    def remove_source(self, ingest: Callable[[Any], None]) -> None:
+        with self._lock:
+            if ingest in self.sources:
+                self.sources.remove(ingest)
+
+    def send(self, data: bytes) -> None:
+        with self._io_lock:
+            self._sock.send(data, timeout_ms=5000)
+
+
+class NeuronSource(Source):
+    def __init__(self) -> None:
+        self.url = DEFAULT_URL
+        self._conn: Optional[_NeuronConn] = None
+        self._ingest = None
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.url = props.get("url", datasource or DEFAULT_URL)
+
+    def open(self, ingest) -> None:
+        self._ingest = ingest
+        self._conn = _NeuronConn.acquire(self.url)
+        self._conn.add_source(ingest)
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.remove_source(self._ingest)
+            self._conn.release()
+            self._conn = None
+
+
+class NeuronSink(Sink):
+    """Writes tag commands: for each result row, one message per configured
+    tag (raw=true passes rows through verbatim, reference neuron sink)."""
+
+    def __init__(self) -> None:
+        self.url = DEFAULT_URL
+        self.node = ""
+        self.group = ""
+        self.tags: List[str] = []
+        self.raw = False
+        self._conn: Optional[_NeuronConn] = None
+
+    def configure(self, props: Dict[str, Any]) -> None:
+        self.url = props.get("url", DEFAULT_URL)
+        self.node = props.get("nodeName", "")
+        self.group = props.get("groupName", "")
+        self.tags = props.get("tags") or []
+        self.raw = bool(props.get("raw", False))
+        if not self.raw and not (self.node and self.group):
+            raise EngineError(
+                "neuron sink requires nodeName and groupName (or raw=true)")
+
+    def connect(self) -> None:
+        self._conn = _NeuronConn.acquire(self.url)
+
+    def collect(self, item: Any) -> None:
+        rows = item if isinstance(item, list) else [item]
+        for row in rows:
+            if self.raw:
+                data = row if isinstance(row, (bytes, bytearray)) else \
+                    json.dumps(row).encode()
+                self._conn.send(bytes(data))
+                continue
+            if not isinstance(row, dict):
+                raise EngineError("neuron sink rows must be objects")
+            tags = self.tags or list(row.keys())
+            for tag in tags:
+                if tag not in row:
+                    continue
+                self._conn.send(json.dumps({
+                    "node_name": self.node,
+                    "group_name": self.group,
+                    "tag_name": tag,
+                    "tag_value": row[tag],
+                }).encode())
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.release()
+            self._conn = None

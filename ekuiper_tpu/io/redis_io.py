@@ -1,0 +1,265 @@
+"""Redis source/sink/lookup (analogue of the reference's
+internal/io/redis: redis sink, redisSub pub/sub source, redis lookup).
+
+No redis client library is assumed: a minimal RESP2 client over a TCP
+socket covers the command surface the connectors need (AUTH/SELECT/GET/SET/
+LPUSH/RPUSH/PUBLISH/SUBSCRIBE/HGETALL/PING). Values are JSON-encoded on
+write and JSON-decoded on read, matching the reference's json payloads.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.infra import EngineError, logger
+from .contract import LookupSource, Sink, Source
+
+
+class RespClient:
+    """Minimal RESP2 protocol client."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 password: str = "", db: int = 0, timeout: float = 5.0) -> None:
+        self.host, self.port = host, port
+        self.password, self.db = password, db
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._buf = b""
+        self._lock = threading.Lock()
+
+    def connect(self) -> None:
+        with self._lock:
+            self._connect_locked()
+
+    def _connect_locked(self) -> None:
+        self._sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout)
+        self._buf = b""
+        # AUTH/SELECT inline (command() would re-take the non-reentrant lock)
+        if self.password:
+            self._sock.sendall(self._encode(["AUTH", self.password]))
+            self.read_reply()
+        if self.db:
+            self._sock.sendall(self._encode(["SELECT", str(self.db)]))
+            self.read_reply()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    # ---------------------------------------------------------------- wire
+    @staticmethod
+    def _encode(args) -> bytes:
+        out = [b"*%d\r\n" % len(args)]
+        for a in args:
+            b = a if isinstance(a, bytes) else str(a).encode()
+            out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+        return b"".join(out)
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self._buf:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise EngineError("redis connection closed")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n + 2:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise EngineError("redis connection closed")
+            self._buf += chunk
+        data, self._buf = self._buf[:n], self._buf[n + 2:]
+        return data
+
+    def read_reply(self) -> Any:
+        line = self._read_line()
+        t, rest = line[:1], line[1:]
+        if t == b"+":
+            return rest.decode()
+        if t == b"-":
+            raise EngineError(f"redis error: {rest.decode()}")
+        if t == b":":
+            return int(rest)
+        if t == b"$":
+            n = int(rest)
+            return None if n < 0 else self._read_exact(n)
+        if t == b"*":
+            n = int(rest)
+            return None if n < 0 else [self.read_reply() for _ in range(n)]
+        raise EngineError(f"redis protocol error: {line!r}")
+
+    def command(self, *args) -> Any:
+        with self._lock:
+            if self._sock is None:
+                self._connect_locked()
+            self._sock.sendall(self._encode(args))
+            return self.read_reply()
+
+    def send(self, *args) -> None:
+        """Send without reading a reply (subscribe stream)."""
+        with self._lock:
+            if self._sock is None:
+                self._connect_locked()
+            self._sock.sendall(self._encode(args))
+
+
+def _client_from_props(props: Dict[str, Any]) -> RespClient:
+    addr = props.get("addr", "127.0.0.1:6379")
+    if "://" in addr:
+        addr = addr.split("://", 1)[1]
+    host, _, port = addr.partition(":")
+    return RespClient(
+        host or "127.0.0.1", int(port or 6379),
+        password=props.get("password", ""), db=int(props.get("db", 0)),
+        timeout=float(props.get("timeout", 5000)) / 1000.0,
+    )
+
+
+def _decode_value(raw: Any) -> Any:
+    if isinstance(raw, (bytes, bytearray)):
+        raw = raw.decode("utf-8", errors="replace")
+    try:
+        return json.loads(raw)
+    except (ValueError, TypeError):
+        return {"data": raw}
+
+
+class RedisSubSource(Source):
+    """Pub/sub source: SUBSCRIBE to the datasource channels (comma
+    separated), ingest every published message (reference redisSub)."""
+
+    def __init__(self) -> None:
+        self.channels: List[str] = []
+        self.props: Dict[str, Any] = {}
+        self._cli: Optional[RespClient] = None
+        self._stop = threading.Event()
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        chans = datasource or props.get("channels", "")
+        self.channels = [c.strip() for c in str(chans).split(",") if c.strip()]
+        if not self.channels:
+            raise EngineError("redisSub requires channels (datasource)")
+        self.props = props
+
+    def open(self, ingest) -> None:
+        self._stop.clear()
+        threading.Thread(target=self._loop, args=(ingest,), daemon=True,
+                         name="redis-sub").start()
+
+    def _loop(self, ingest) -> None:
+        while not self._stop.is_set():
+            try:
+                cli = _client_from_props(self.props)
+                cli.connect()
+                # a subscription idles indefinitely between messages — the
+                # command timeout must not tear the connection down
+                cli._sock.settimeout(None)
+                self._cli = cli
+                cli.send("SUBSCRIBE", *self.channels)
+                while not self._stop.is_set():
+                    reply = cli.read_reply()
+                    if isinstance(reply, list) and len(reply) >= 3 and \
+                            reply[0] in (b"message", "message"):
+                        ingest(_decode_value(reply[2]))
+            except Exception as exc:
+                if self._stop.is_set():
+                    return
+                logger.warning("redisSub reconnect: %s", exc)
+                self._stop.wait(1.0)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._cli is not None:
+            self._cli.close()
+
+
+class RedisSink(Sink):
+    """Writes results to redis: datatype string (SET key val) or list
+    (LPUSH/RPUSH), key from a field or a static key; optionally PUBLISH to
+    a channel instead (reference redis sink options)."""
+
+    def __init__(self) -> None:
+        self.props: Dict[str, Any] = {}
+        self._cli: Optional[RespClient] = None
+
+    def configure(self, props: Dict[str, Any]) -> None:
+        self.props = props
+        if not (props.get("key") or props.get("field")
+                or props.get("channel")):
+            raise EngineError("redis sink requires key, field, or channel")
+
+    def connect(self) -> None:
+        self._cli = _client_from_props(self.props)
+        self._cli.connect()
+
+    def collect(self, item: Any) -> None:
+        rows = item if isinstance(item, list) else [item]
+        for row in rows:
+            data = row if isinstance(row, str) else json.dumps(row)
+            channel = self.props.get("channel")
+            if channel:
+                self._cli.command("PUBLISH", channel, data)
+                continue
+            key = self.props.get("key") or (
+                row.get(self.props["field"]) if isinstance(row, dict) else None)
+            if key is None:
+                raise EngineError(
+                    f"redis sink: field {self.props.get('field')!r} missing")
+            if self.props.get("dataType", "string") == "list":
+                cmd = ("RPUSH" if self.props.get("rowkindField") == "append"
+                       else "LPUSH")
+                self._cli.command(cmd, key, data)
+            else:
+                args = ["SET", key, data]
+                if self.props.get("expiration"):
+                    args += ["EX", str(int(self.props["expiration"]))]
+                self._cli.command(*args)
+
+    def close(self) -> None:
+        if self._cli is not None:
+            self._cli.close()
+
+
+class RedisLookupSource(LookupSource):
+    """Lookup by key: GET (json value) or HGETALL per the dataType prop."""
+
+    def __init__(self) -> None:
+        self.props: Dict[str, Any] = {}
+        self._cli: Optional[RespClient] = None
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.props = dict(props)
+        if datasource:
+            self.props.setdefault("db", datasource)
+
+    def open(self) -> None:
+        self._cli = _client_from_props(self.props)
+        self._cli.connect()
+
+    def lookup(self, fields, keys, values) -> List[Dict[str, Any]]:
+        if not values:
+            return []
+        key = str(values[0])
+        if self.props.get("dataType") == "hash":
+            raw = self._cli.command("HGETALL", key)
+            if not raw:
+                return []
+            it = iter(raw)
+            return [{k.decode(): _decode_value(v) for k, v in zip(it, it)}]
+        raw = self._cli.command("GET", key)
+        if raw is None:
+            return []
+        val = _decode_value(raw)
+        return [val if isinstance(val, dict) else {"value": val}]
+
+    def close(self) -> None:
+        if self._cli is not None:
+            self._cli.close()

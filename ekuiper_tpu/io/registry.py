@@ -116,3 +116,21 @@ def _ensure() -> None:
         register_sink("mqtt", MqttSink)
     except ImportError:
         pass
+
+    # websocket needs the `websockets` package — optional, same gating
+    try:
+        from .websocket import WebsocketSink, WebsocketSource
+
+        register_source("websocket", WebsocketSource)
+        register_sink("websocket", WebsocketSink)
+    except ImportError:
+        pass
+
+    from .neuron import NeuronSink, NeuronSource
+    from .redis_io import RedisLookupSource, RedisSink, RedisSubSource
+
+    register_source("redissub", RedisSubSource)
+    register_sink("redis", RedisSink)
+    register_lookup("redis", RedisLookupSource)
+    register_source("neuron", NeuronSource)
+    register_sink("neuron", NeuronSink)

@@ -1,0 +1,233 @@
+"""Websocket source/sink on a shared data server (analogue of the
+reference's internal/io/websocket + the shared httpserver data server,
+internal/io/http/httpserver/data_server.go:36-103).
+
+Server mode (no `addr` prop): endpoints ride ONE process-wide websocket
+server per port — N rules on the same path share the listener, sources
+receive every frame a connected client sends to their path, sinks broadcast
+to every client connected to their path (the reference's
+endpoint-refcounted data server semantics).
+
+Client mode (`addr` prop, e.g. ws://host:port/path): the source dials out
+and ingests received frames; the sink dials out and sends.
+
+Built on the `websockets` sync API — one thread per connection, matching
+the engine's thread-per-node fabric.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from ..utils.infra import logger
+from .contract import Sink, Source
+
+
+class _WsEndpoint:
+    def __init__(self) -> None:
+        self.sources: List[Callable[[Any], None]] = []
+        self.clients: Set[Any] = set()
+        self.lock = threading.Lock()
+
+
+class WsDataServer:
+    """One websocket listener per port, shared by every endpoint
+    (refcounted; closes when the last endpoint detaches)."""
+
+    _servers: Dict[int, "WsDataServer"] = {}
+    _glock = threading.Lock()
+
+    def __init__(self, port: int) -> None:
+        from websockets.sync.server import serve
+
+        self.port = port
+        self.endpoints: Dict[str, _WsEndpoint] = {}
+        self.refs = 0
+        self._lock = threading.Lock()
+        self._server = serve(self._handler, "0.0.0.0", port)
+        self.actual_port = self._server.socket.getsockname()[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"ws-data-server-{port}")
+        self._thread.start()
+
+    @classmethod
+    def acquire(cls, port: int) -> "WsDataServer":
+        with cls._glock:
+            srv = cls._servers.get(port)
+            if srv is None:
+                srv = WsDataServer(port)
+                cls._servers[port] = srv
+            srv.refs += 1
+            return srv
+
+    def release(self) -> None:
+        with WsDataServer._glock:
+            self.refs -= 1
+            if self.refs <= 0:
+                WsDataServer._servers.pop(self.port, None)
+                self._server.shutdown()
+
+    def endpoint(self, path: str) -> _WsEndpoint:
+        with self._lock:
+            ep = self.endpoints.get(path)
+            if ep is None:
+                ep = _WsEndpoint()
+                self.endpoints[path] = ep
+            return ep
+
+    # -------------------------------------------------------------- handling
+    def _handler(self, conn) -> None:
+        path = conn.request.path
+        ep = self.endpoint(path)
+        with ep.lock:
+            ep.clients.add(conn)
+        try:
+            for msg in conn:
+                payload = self._decode(msg)
+                with ep.lock:
+                    sources = list(ep.sources)
+                for ingest in sources:
+                    try:
+                        ingest(payload)
+                    except Exception as exc:
+                        logger.warning("ws ingest error: %s", exc)
+        except Exception:
+            pass
+        finally:
+            with ep.lock:
+                ep.clients.discard(conn)
+
+    @staticmethod
+    def _decode(msg: Any) -> Any:
+        if isinstance(msg, (bytes, bytearray)):
+            msg = msg.decode("utf-8", errors="replace")
+        try:
+            return json.loads(msg)
+        except (ValueError, TypeError):
+            return {"data": msg}
+
+    def broadcast(self, path: str, data: str) -> int:
+        ep = self.endpoint(path)
+        with ep.lock:
+            clients = list(ep.clients)
+        n = 0
+        for c in clients:
+            try:
+                c.send(data)
+                n += 1
+            except Exception:
+                with ep.lock:
+                    ep.clients.discard(c)
+        return n
+
+
+class WebsocketSource(Source):
+    def __init__(self) -> None:
+        self.path = "/"
+        self.addr = ""
+        self.port = 10081
+        self._server: Optional[WsDataServer] = None
+        self._ingest: Optional[Callable] = None
+        self._client = None
+        self._stop = threading.Event()
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.path = datasource or props.get("path", "/")
+        if not self.path.startswith("/"):
+            self.path = "/" + self.path
+        self.addr = props.get("addr", "")
+        self.port = int(props.get("port", 10081))
+
+    def open(self, ingest) -> None:
+        self._ingest = ingest
+        if self.addr:
+            self._stop.clear()
+            t = threading.Thread(target=self._client_loop, daemon=True,
+                                 name=f"ws-src-{self.addr}")
+            t.start()
+            return
+        self._server = WsDataServer.acquire(self.port)
+        ep = self._server.endpoint(self.path)
+        with ep.lock:
+            ep.sources.append(ingest)
+
+    def _client_loop(self) -> None:
+        from websockets.sync.client import connect
+
+        while not self._stop.is_set():
+            try:
+                with connect(self.addr) as ws:
+                    self._client = ws
+                    for msg in ws:
+                        if self._stop.is_set():
+                            return
+                        self._ingest(WsDataServer._decode(msg))
+            except Exception as exc:
+                if self._stop.is_set():
+                    return
+                logger.warning("ws source reconnect (%s): %s", self.addr, exc)
+                self._stop.wait(1.0)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:
+                pass
+        if self._server is not None:
+            ep = self._server.endpoint(self.path)
+            with ep.lock:
+                if self._ingest in ep.sources:
+                    ep.sources.remove(self._ingest)
+            self._server.release()
+            self._server = None
+
+
+class WebsocketSink(Sink):
+    def __init__(self) -> None:
+        self.path = "/"
+        self.addr = ""
+        self.port = 10081
+        self._server: Optional[WsDataServer] = None
+        self._client = None
+        self._lock = threading.Lock()
+
+    def configure(self, props: Dict[str, Any]) -> None:
+        self.path = props.get("path", props.get("datasource", "/"))
+        if not self.path.startswith("/"):
+            self.path = "/" + self.path
+        self.addr = props.get("addr", "")
+        self.port = int(props.get("port", 10081))
+
+    def connect(self) -> None:
+        if self.addr:
+            from websockets.sync.client import connect
+
+            self._client = connect(self.addr)
+        else:
+            self._server = WsDataServer.acquire(self.port)
+
+    def collect(self, item: Any) -> None:
+        if isinstance(item, (str, bytes, bytearray)):
+            data = item  # pre-encoded frames pass through verbatim
+        else:
+            data = json.dumps(item)
+        if self._client is not None:
+            with self._lock:
+                self._client.send(data)
+        elif self._server is not None:
+            self._server.broadcast(self.path, data)
+
+    def close(self) -> None:
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:
+                pass
+            self._client = None
+        if self._server is not None:
+            self._server.release()
+            self._server = None

@@ -355,6 +355,23 @@ def _is_lookup_table(name: str, store) -> bool:
     return ok
 
 
+def _make_lookup_join_node(lj: ast.Join, k: int, opts, store):
+    from ..runtime.nodes_join import LookupJoinNode
+
+    tdef = load_stream_def(lj.table.name, store)
+    tprops = _source_props(tdef, store)
+    if tdef.options.key:
+        tprops.setdefault("key", tdef.options.key)
+    lookup = io_registry.create_lookup(tdef.options.type or "memory")
+    lookup.configure(tdef.options.datasource, tprops)
+    return LookupJoinNode(
+        f"lookup_join_{k}" if k else "lookup_join", lookup, lj,
+        key_fields=_equality_key_fields(lj),
+        cache_ttl_ms=int(tprops.get("cacheTtl", 60_000)),
+        buffer_length=opts.buffer_length,
+    )
+
+
 def _stream_side_qualifiers(join: ast.Join) -> set:
     """Stream aliases referenced by the ON clause's non-table sides — the
     chains a LookupJoinNode must sit on."""
@@ -681,7 +698,25 @@ def _build_host_chain(
     if stream_joins is None:
         stream_joins = stmt.joins
     lookup_joins = lookup_joins or []
-    tail_of_sources = source_nodes
+    tail_of_sources = list(source_nodes)
+    # lookup joins bind per-STREAM, before the watermark merge and before
+    # WHERE/window (reference lookup_node.go sits right after decode): the
+    # node must only see rows of the stream its ON clause references, even
+    # under event time where all chains later merge at the watermark node
+    for k, lj in enumerate(lookup_joins):
+        node = _make_lookup_join_node(lj, k, opts, store)
+        qualifiers = _stream_side_qualifiers(lj)
+        targets = [t for t in tail_of_sources
+                   if t.name in qualifiers
+                   or any(t.name == q + "_shared" for q in qualifiers)]
+        if not targets:
+            targets = list(tail_of_sources)
+        topo.add_op(node)
+        for t in targets:
+            t.connect(node)
+        tail_of_sources = [t for t in tail_of_sources
+                           if t not in targets] + [node]
+
     # event-time: watermark generation + late drop
     if opts.is_event_time:
         wm = WatermarkNode("watermark", late_tolerance_ms=opts.late_tolerance_ms,
@@ -705,37 +740,6 @@ def _build_host_chain(
     if analytic:
         attach(AnalyticNode("analytic", analytic, rule_id=rule_id,
                             buffer_length=opts.buffer_length))
-    # lookup joins run on the STREAM, before WHERE and the window (reference
-    # lookup_node.go sits right after decode): WHERE may reference table
-    # columns, and windows must collect already-joined rows. With multiple
-    # source streams, the lookup node sits ONLY on the chain its key fields
-    # reference — other streams' rows must not pass through it.
-    for k, lj in enumerate(lookup_joins):
-        from ..runtime.nodes_join import LookupJoinNode
-
-        tdef = load_stream_def(lj.table.name, store)
-        tprops = _source_props(tdef, store)
-        if tdef.options.key:
-            tprops.setdefault("key", tdef.options.key)
-        lookup = io_registry.create_lookup(tdef.options.type or "memory")
-        lookup.configure(tdef.options.datasource, tprops)
-        node = LookupJoinNode(
-            f"lookup_join_{k}" if k else "lookup_join", lookup, lj,
-            key_fields=_equality_key_fields(lj),
-            cache_ttl_ms=int(tprops.get("cacheTtl", 60_000)),
-            buffer_length=opts.buffer_length,
-        )
-        qualifiers = _stream_side_qualifiers(lj)
-        targets = [t for t in chain
-                   if t.name in qualifiers
-                   or any(t.name == q + "_shared" for q in qualifiers)]
-        if len(chain) > 1 and targets:
-            topo.add_op(node)
-            for t in targets:
-                t.connect(node)
-            chain[:] = [c for c in chain if c not in targets] + [node]
-        else:
-            attach(node)
     # predicate pushdown: WHERE before the window when it has no analytic refs
     where_pushed = False
     if stmt.condition is not None and not analytic:

@@ -123,6 +123,21 @@ class RestApi:
         r("DELETE", r"^/scripts/(?P<name>[^/]+)$",
           lambda m: self._scripts().delete(m["name"])
           or f"Script {m['name']} is dropped.")
+        # connections CRUD + ping (reference: rest.go connection routes)
+        r("GET", r"^/connections$", lambda m: self._connections().list())
+        r("POST", r"^/connections$",
+          lambda m, body=None: self._connections().create(body or {})
+          or f"Connection {(body or {}).get('id')} is created.")
+        r("GET", r"^/connections/(?P<id>[^/]+)/ping$",
+          lambda m: self._connections().ping(m["id"]))
+        r("GET", r"^/connections/(?P<id>[^/]+)$",
+          lambda m: self._connections().get(m["id"]))
+        r("PUT", r"^/connections/(?P<id>[^/]+)$",
+          lambda m, body=None: self._connections().update(m["id"], body or {})
+          or f"Connection {m['id']} is updated.")
+        r("DELETE", r"^/connections/(?P<id>[^/]+)$",
+          lambda m: self._connections().delete(m["id"])
+          or f"Connection {m['id']} is deleted.")
         # external services (reference: rest.go service routes,
         # internal/service/manager.go)
         r("GET", r"^/services$", lambda m: self._services().list())
@@ -151,6 +166,12 @@ class RestApi:
         r("GET", r"^/plugins/portables/(?P<name>[^/]+)$", self.describe_plugin)
         r("DELETE", r"^/plugins/portables/(?P<name>[^/]+)$",
           lambda m: self._plugins().delete(m["name"]) or f"Plugin {m['name']} is deleted.")
+
+    # ------------------------------------------------------------ connections
+    def _connections(self):
+        from ..io.connections import ConnectionManager
+
+        return ConnectionManager(self.store)
 
     # --------------------------------------------------------------- services
     @staticmethod

@@ -33,9 +33,9 @@ class RestExecutor:
         self.schema = schema
 
     def call(self, service_name: str, args: List[Any]) -> Any:
-        from google.protobuf import json_format
-
         if self.schema is not None:
+            from google.protobuf import json_format
+
             msg = self.schema.build_request(service_name, args)
             body = json_format.MessageToDict(
                 msg, preserving_proto_field_name=True)
@@ -56,6 +56,8 @@ class RestExecutor:
             return None
         out = json.loads(raw)
         if self.schema is not None:
+            from google.protobuf import json_format
+
             _, _, out_cls = self.schema.method(service_name)
             msg = out_cls()
             json_format.ParseDict(out, msg, ignore_unknown_fields=True)

@@ -145,9 +145,17 @@ class ServiceManager:
         with self._mu:
             if not overwrite and name in self._services:
                 raise EngineError(f"service {name} already exists")
+            # validate + build into temporaries FIRST: a bad descriptor on
+            # overwrite must not tear down the running service
+            old_fns = {f for f, (k, _) in self._functions.items()
+                       if k.startswith(name + "/")}
+            new_ifaces, new_fns = self._build(name, descriptor,
+                                              ignore_clash=old_fns)
             if name in self._services:
                 self._unregister(name)
-            self._register(name, descriptor)
+            self._services[name] = descriptor
+            self._interfaces.update(new_ifaces)
+            self._functions.update(new_fns)
             if self._kv is not None:
                 self._kv.set(name, json.dumps(descriptor))
 
@@ -179,6 +187,7 @@ class ServiceManager:
             ]
 
     def describe_function(self, fname: str) -> Dict[str, Any]:
+        fname = fname.lower()  # registered names are lowercased
         with self._mu:
             got = self._functions.get(fname)
             if got is None:
@@ -189,7 +198,10 @@ class ServiceManager:
                     "interface": ikey.split("/", 1)[1]}
 
     # -------------------------------------------------------------- internal
-    def _register(self, name: str, descriptor: Dict[str, Any]) -> None:
+    def _build(self, name: str, descriptor: Dict[str, Any],
+               ignore_clash=frozenset()):
+        """Validate a descriptor and build its interface/function tables
+        without touching live state."""
         interfaces = descriptor.get("interfaces") or {}
         if not interfaces:
             raise EngineError("service descriptor has no interfaces")
@@ -202,11 +214,16 @@ class ServiceManager:
             for fname, target in iface.function_map().items():
                 fname = fname.lower()  # SQL function names are case-insensitive
                 clash = fn_registry.lookup(fname)
-                if clash is not None and fname not in self._functions:
+                if clash is not None and fname not in self._functions \
+                        and fname not in ignore_clash:
                     raise EngineError(
                         f"function {fname} already exists (builtin wins; "
                         "rename via the functions mapping)")
                 new_fns[fname] = (key, target)
+        return new_ifaces, new_fns
+
+    def _register(self, name: str, descriptor: Dict[str, Any]) -> None:
+        new_ifaces, new_fns = self._build(name, descriptor)
         self._services[name] = descriptor
         self._interfaces.update(new_ifaces)
         self._functions.update(new_fns)

@@ -1,0 +1,300 @@
+"""Websocket / redis / neuron connectors + connection CRUD/ping."""
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from ekuiper_tpu.io import registry as io_registry
+from ekuiper_tpu.io.connections import ConnectionManager, ping
+from ekuiper_tpu.io.redis_io import RespClient
+from ekuiper_tpu.store import kv
+
+
+# ------------------------------------------------------------ fake redis
+class FakeRedis:
+    """Tiny RESP2 server: SET/GET/LPUSH/LRANGE/HGETALL/PUBLISH/SUBSCRIBE/
+    PING, enough to exercise the connectors."""
+
+    def __init__(self):
+        self.data = {}
+        self.lists = {}
+        self.hashes = {}
+        self.subs = []  # (conn, channels)
+        self.srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(8)
+        self.port = self.srv.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def close(self):
+        self._stop = True
+        self.srv.close()
+
+    def _accept(self):
+        while not self._stop:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _reply_bulk(v):
+        if v is None:
+            return b"$-1\r\n"
+        b = v if isinstance(v, bytes) else str(v).encode()
+        return b"$%d\r\n%s\r\n" % (len(b), b)
+
+    def _serve(self, conn):
+        buf = b""
+
+        def read_cmd():
+            nonlocal buf
+            while True:
+                if b"\r\n" in buf:
+                    head, rest = buf.split(b"\r\n", 1)
+                    if head.startswith(b"*"):
+                        n = int(head[1:])
+                        args = []
+                        cur = rest
+                        ok = True
+                        for _ in range(n):
+                            if b"\r\n" not in cur:
+                                ok = False
+                                break
+                            ln, cur = cur.split(b"\r\n", 1)
+                            size = int(ln[1:])
+                            if len(cur) < size + 2:
+                                ok = False
+                                break
+                            args.append(cur[:size])
+                            cur = cur[size + 2:]
+                        if ok:
+                            buf = cur
+                            return [a.decode() for a in args]
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return None
+                buf += chunk
+
+        while True:
+            cmd = read_cmd()
+            if cmd is None:
+                return
+            op = cmd[0].upper()
+            if op == "PING":
+                conn.sendall(b"+PONG\r\n")
+            elif op == "SET":
+                self.data[cmd[1]] = cmd[2]
+                conn.sendall(b"+OK\r\n")
+            elif op == "GET":
+                conn.sendall(self._reply_bulk(self.data.get(cmd[1])))
+            elif op in ("LPUSH", "RPUSH"):
+                lst = self.lists.setdefault(cmd[1], [])
+                lst.insert(0, cmd[2]) if op == "LPUSH" else lst.append(cmd[2])
+                conn.sendall(b":%d\r\n" % len(lst))
+            elif op == "HGETALL":
+                h = self.hashes.get(cmd[1], {})
+                out = [b"*%d\r\n" % (len(h) * 2)]
+                for k, v in h.items():
+                    out.append(self._reply_bulk(k))
+                    out.append(self._reply_bulk(v))
+                conn.sendall(b"".join(out))
+            elif op == "SUBSCRIBE":
+                self.subs.append((conn, cmd[1:]))
+                for i, ch in enumerate(cmd[1:]):
+                    conn.sendall(
+                        b"*3\r\n" + self._reply_bulk("subscribe")
+                        + self._reply_bulk(ch) + b":%d\r\n" % (i + 1))
+            elif op == "PUBLISH":
+                n = 0
+                for sconn, chans in self.subs:
+                    if cmd[1] in chans:
+                        sconn.sendall(
+                            b"*3\r\n" + self._reply_bulk("message")
+                            + self._reply_bulk(cmd[1])
+                            + self._reply_bulk(cmd[2]))
+                        n += 1
+                conn.sendall(b":%d\r\n" % n)
+            else:
+                conn.sendall(b"-ERR unknown\r\n")
+
+
+@pytest.fixture
+def fake_redis():
+    srv = FakeRedis()
+    yield srv
+    srv.close()
+
+
+class TestRedis:
+    def test_resp_client(self, fake_redis):
+        cli = RespClient("127.0.0.1", fake_redis.port)
+        cli.connect()
+        assert cli.command("PING") == "PONG"
+        cli.command("SET", "k", "v")
+        assert cli.command("GET", "k") == b"v"
+        cli.close()
+
+    def test_sink_set_and_list(self, fake_redis):
+        sink = io_registry.create_sink("redis")
+        sink.configure({"addr": f"127.0.0.1:{fake_redis.port}",
+                        "field": "deviceId"})
+        sink.connect()
+        sink.collect({"deviceId": "d1", "t": 20})
+        assert json.loads(fake_redis.data["d1"]) == {"deviceId": "d1", "t": 20}
+        lsink = io_registry.create_sink("redis")
+        lsink.configure({"addr": f"127.0.0.1:{fake_redis.port}",
+                         "key": "q", "dataType": "list"})
+        lsink.connect()
+        lsink.collect([{"a": 1}, {"a": 2}])
+        assert len(fake_redis.lists["q"]) == 2
+        sink.close(); lsink.close()
+
+    def test_sub_source_roundtrip(self, fake_redis):
+        src = io_registry.create_source("redissub")
+        src.configure("news", {"addr": f"127.0.0.1:{fake_redis.port}"})
+        got = []
+        src.open(got.append)
+        deadline = time.time() + 5
+        while time.time() < deadline and not fake_redis.subs:
+            time.sleep(0.02)
+        pub = RespClient("127.0.0.1", fake_redis.port)
+        pub.connect()
+        pub.command("PUBLISH", "news", json.dumps({"x": 1}))
+        deadline = time.time() + 5
+        while time.time() < deadline and not got:
+            time.sleep(0.02)
+        src.close(); pub.close()
+        assert got and got[0] == {"x": 1}
+
+    def test_lookup(self, fake_redis):
+        fake_redis.data["dev9"] = json.dumps({"site": "lx"})
+        lk = io_registry.create_lookup("redis")
+        lk.configure("", {"addr": f"127.0.0.1:{fake_redis.port}"})
+        lk.open()
+        assert lk.lookup([], ["id"], ["dev9"]) == [{"site": "lx"}]
+        assert lk.lookup([], ["id"], ["absent"]) == []
+        lk.close()
+
+
+class TestWebsocket:
+    def test_server_mode_source_and_sink(self):
+        from websockets.sync.client import connect
+
+        src = io_registry.create_source("websocket")
+        src.configure("/ws/demo", {"port": 0})
+        got = []
+        src.open(got.append)
+        port = src._server.actual_port
+        sink = io_registry.create_sink("websocket")
+        sink.configure({"path": "/ws/demo", "port": 0})
+        # share the same server instance (port key 0 in the pool)
+        sink.connect()
+        with connect(f"ws://127.0.0.1:{port}/ws/demo") as ws:
+            ws.send(json.dumps({"hello": 1}))
+            deadline = time.time() + 5
+            while time.time() < deadline and not got:
+                time.sleep(0.02)
+            assert got == [{"hello": 1}]
+            sink.collect({"reply": 2})
+            msg = json.loads(ws.recv(timeout=5))
+            assert msg == {"reply": 2}
+        src.close()
+        sink.close()
+
+    def test_client_mode_source(self):
+        from websockets.sync.server import serve
+
+        def handler(conn):
+            conn.send(json.dumps({"from": "server"}))
+            time.sleep(0.5)
+
+        srv = serve(handler, "127.0.0.1", 0)
+        port = srv.socket.getsockname()[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        src = io_registry.create_source("websocket")
+        src.configure("", {"addr": f"ws://127.0.0.1:{port}/x"})
+        got = []
+        src.open(got.append)
+        deadline = time.time() + 5
+        while time.time() < deadline and not got:
+            time.sleep(0.02)
+        src.close()
+        srv.shutdown()
+        assert got and got[0] == {"from": "server"}
+
+
+class TestNeuron:
+    def test_pair_roundtrip(self):
+        from ekuiper_tpu.plugin import ipc
+
+        url = ipc.ipc_url("neuron-test")
+        peer = ipc.Socket(ipc.PAIR)
+        peer.listen(url)
+        recvd = []
+
+        frame = json.dumps(
+            {"group_name": "g1", "values": {"tag1": 9}}).encode()
+        stop = threading.Event()
+
+        def gateway():
+            # the fake neuron gateway: emit the tag frame continuously
+            # (frames sent before a peer dials are dropped by the native
+            # pair transport) and collect written commands until stopped
+            for _ in range(400):
+                if stop.is_set():
+                    return
+                try:
+                    peer.send(frame, timeout_ms=100)
+                except Exception:
+                    pass
+                try:
+                    raw = peer.recv(timeout_ms=50)
+                    if raw:
+                        recvd.append(json.loads(raw.decode()))
+                except Exception:
+                    continue
+
+        threading.Thread(target=gateway, daemon=True).start()
+        src = io_registry.create_source("neuron")
+        src.configure("", {"url": url})
+        got = []
+        src.open(got.append)
+        sink = io_registry.create_sink("neuron")
+        sink.configure({"url": url, "nodeName": "n1", "groupName": "g1",
+                        "tags": ["temperature"]})
+        sink.connect()
+        sink.collect({"temperature": 21.5, "other": 1})
+        deadline = time.time() + 8
+        while time.time() < deadline and not (got and recvd):
+            time.sleep(0.02)
+        stop.set()
+        src.close(); sink.close(); peer.close()
+        assert got and got[0]["values"] == {"tag1": 9}
+        assert recvd and recvd[0] == {
+            "node_name": "n1", "group_name": "g1",
+            "tag_name": "temperature", "tag_value": 21.5}
+
+
+class TestConnections:
+    def test_crud_and_ping(self, fake_redis):
+        mgr = ConnectionManager(kv.get_store())
+        mgr.create({"id": "c1", "typ": "redis",
+                    "props": {"addr": f"127.0.0.1:{fake_redis.port}"}})
+        assert [c["id"] for c in mgr.list()] == ["c1"]
+        assert mgr.ping("c1") == "ok"
+        mgr.update("c1", {"typ": "memory", "props": {}})
+        assert mgr.get("c1")["typ"] == "memory"
+        mgr.delete("c1")
+        with pytest.raises(Exception, match="not found"):
+            mgr.get("c1")
+
+    def test_ping_failure_reports_reason(self):
+        with pytest.raises(Exception, match="ping failed"):
+            ping("redis", {"addr": "127.0.0.1:1", "timeout": 300})
