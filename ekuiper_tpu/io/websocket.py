@@ -29,6 +29,7 @@ class _WsEndpoint:
         self.sources: List[Callable[[Any], None]] = []
         self.clients: Set[Any] = set()
         self.lock = threading.Lock()
+        self.refs = 0  # registered sources+sinks; 0 -> endpoint removed
 
 
 class WsDataServer:
@@ -69,18 +70,41 @@ class WsDataServer:
                 WsDataServer._servers.pop(self.port, None)
                 self._server.shutdown()
 
-    def endpoint(self, path: str) -> _WsEndpoint:
+    def endpoint(self, path: str,
+                 create: bool = False) -> Optional[_WsEndpoint]:
+        """Registered endpoints only: connections to unknown paths are
+        refused, and an endpoint disappears with its last source/sink —
+        arbitrary client paths must not grow state on an open listener."""
         with self._lock:
             ep = self.endpoints.get(path)
-            if ep is None:
+            if ep is None and create:
                 ep = _WsEndpoint()
                 self.endpoints[path] = ep
             return ep
+
+    def acquire_path(self, path: str) -> _WsEndpoint:
+        ep = self.endpoint(path, create=True)
+        with ep.lock:
+            ep.refs += 1
+        return ep
+
+    def release_path(self, path: str) -> None:
+        with self._lock:
+            ep = self.endpoints.get(path)
+            if ep is None:
+                return
+            with ep.lock:
+                ep.refs -= 1
+                if ep.refs <= 0:
+                    del self.endpoints[path]
 
     # -------------------------------------------------------------- handling
     def _handler(self, conn) -> None:
         path = conn.request.path
         ep = self.endpoint(path)
+        if ep is None:
+            conn.close(code=1008, reason="unknown endpoint")
+            return
         with ep.lock:
             ep.clients.add(conn)
         try:
@@ -110,6 +134,8 @@ class WsDataServer:
 
     def broadcast(self, path: str, data: str) -> int:
         ep = self.endpoint(path)
+        if ep is None:
+            return 0
         with ep.lock:
             clients = list(ep.clients)
         n = 0
@@ -149,7 +175,7 @@ class WebsocketSource(Source):
             t.start()
             return
         self._server = WsDataServer.acquire(self.port)
-        ep = self._server.endpoint(self.path)
+        ep = self._server.acquire_path(self.path)
         with ep.lock:
             ep.sources.append(ingest)
 
@@ -158,11 +184,17 @@ class WebsocketSource(Source):
 
         while not self._stop.is_set():
             try:
-                with connect(self.addr) as ws:
+                with connect(self.addr, open_timeout=5) as ws:
+                    if self._stop.is_set():
+                        return  # stopped while dialing
                     self._client = ws
-                    for msg in ws:
-                        if self._stop.is_set():
-                            return
+                    while not self._stop.is_set():
+                        # bounded recv so a silent peer can't pin the thread
+                        # past close()
+                        try:
+                            msg = ws.recv(timeout=1.0)
+                        except TimeoutError:
+                            continue
                         self._ingest(WsDataServer._decode(msg))
             except Exception as exc:
                 if self._stop.is_set():
@@ -179,9 +211,11 @@ class WebsocketSource(Source):
                 pass
         if self._server is not None:
             ep = self._server.endpoint(self.path)
-            with ep.lock:
-                if self._ingest in ep.sources:
-                    ep.sources.remove(self._ingest)
+            if ep is not None:
+                with ep.lock:
+                    if self._ingest in ep.sources:
+                        ep.sources.remove(self._ingest)
+            self._server.release_path(self.path)
             self._server.release()
             self._server = None
 
@@ -209,6 +243,7 @@ class WebsocketSink(Sink):
             self._client = connect(self.addr)
         else:
             self._server = WsDataServer.acquire(self.port)
+            self._server.acquire_path(self.path)
 
     def collect(self, item: Any) -> None:
         if isinstance(item, (str, bytes, bytearray)):
@@ -229,5 +264,6 @@ class WebsocketSink(Sink):
                 pass
             self._client = None
         if self._server is not None:
+            self._server.release_path(self.path)
             self._server.release()
             self._server = None

@@ -1,0 +1,5 @@
+"""Observability plane: Prometheus exposition, per-rule span tracing with a
+queryable local span store, and metrics dumps (analogue of the reference's
+metrics/metrics.go Prometheus registry, pkg/tracer span manager, and
+metrics/metrics_dump.go)."""
+from .tracer import Tracer  # noqa: F401

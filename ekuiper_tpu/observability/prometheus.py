@@ -1,0 +1,74 @@
+"""Prometheus text exposition for engine + per-rule/per-op metrics
+(analogue of metrics/metrics.go:64-88 + internal/server/prome_init.go).
+
+No client library: the text format is lines of
+`name{labels} value` with `# TYPE` headers — rendered directly from the
+rules' StatManagers on each scrape, so there is no second bookkeeping
+system to keep in sync (the reference wires its StatManager into
+promauto gauges the same way)."""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Tuple
+
+_STATE_VALUES = {"running": 1, "stopped": 0}
+
+_COUNTERS = (
+    ("records_in_total", "records_in"),
+    ("records_out_total", "records_out"),
+    ("exceptions_total", "exceptions"),
+)
+_GAUGES = (
+    ("buffer_length", "buffer_length"),
+    ("process_latency_us", "process_latency_us"),
+)
+
+_START_TIME = time.time()
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", " ")
+
+
+def render(rule_registry) -> str:
+    """Scrape callback: rule states + every node's StatManager."""
+    out: List[str] = []
+    out.append("# TYPE kuiper_rule_status gauge")
+    out.append("# HELP kuiper_rule_status 1 running, 0 stopped")
+    rows: List[Tuple[str, Any]] = []
+    for entry in rule_registry.list():
+        rule_id = entry["id"]
+        out.append(
+            f'kuiper_rule_status{{rule="{_esc(rule_id)}"}} '
+            f"{_STATE_VALUES.get(str(entry.get('status', '')).lower(), 0)}")
+        rs = rule_registry.state(rule_id)
+        topo = rs.topo if rs is not None else None
+        if topo is not None:
+            for node in topo.all_nodes():
+                rows.append((rule_id, node))
+            for subtopo, _ in topo._live_shared:
+                for node in subtopo.nodes:
+                    rows.append((rule_id, node))
+    for mname, attr in _COUNTERS:
+        out.append(f"# TYPE kuiper_op_{mname} counter")
+        for rule_id, node in rows:
+            out.append(
+                f'kuiper_op_{mname}{{rule="{_esc(rule_id)}",'
+                f'op="{_esc(node.name)}",type="{_esc(node.op_type)}"}} '
+                f"{getattr(node.stats, attr)}")
+    for mname, attr in _GAUGES:
+        out.append(f"# TYPE kuiper_op_{mname} gauge")
+        for rule_id, node in rows:
+            out.append(
+                f'kuiper_op_{mname}{{rule="{_esc(rule_id)}",'
+                f'op="{_esc(node.name)}",type="{_esc(node.op_type)}"}} '
+                f"{getattr(node.stats, attr)}")
+    out.append("# TYPE kuiper_uptime_seconds gauge")
+    out.append(f"kuiper_uptime_seconds {time.time() - _START_TIME:.1f}")
+    return "\n".join(out) + "\n"
+
+
+class TextResponse(str):
+    """Marker: REST dispatch replies text/plain instead of json."""
+
+    content_type = "text/plain; version=0.0.4; charset=utf-8"

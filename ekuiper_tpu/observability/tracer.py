@@ -1,0 +1,194 @@
+"""Per-rule span tracing with a local, queryable span store (analogue of
+pkg/tracer/manager.go:36-171 and the /trace REST routes).
+
+Tracing is enabled per rule (with an optional strategy: "always" records
+every dispatch, "head" samples the first N spans per second). When a traced
+rule's node dispatches an item, the fabric records a span: rule, op, start,
+duration, item kind, row count. Spans group into traces by ingest batch: a
+trace id is stamped at the source and follows the item chain via thread
+context — the dispatching node annotates its spans with the trace current
+on its worker (one item processed at a time per node, so the context is
+exact for the linear chains the engine builds).
+
+The store is a bounded in-memory ring per rule (the reference's local span
+storage with remote-collector export gated out — zero egress here)."""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+_local = threading.local()
+
+
+class Span:
+    __slots__ = ("trace_id", "span_id", "parent_id", "rule_id", "op",
+                 "start_ms", "duration_us", "kind", "rows")
+
+    def __init__(self, trace_id, span_id, parent_id, rule_id, op, start_ms,
+                 duration_us, kind, rows) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.rule_id = rule_id
+        self.op = op
+        self.start_ms = start_ms
+        self.duration_us = duration_us
+        self.kind = kind
+        self.rows = rows
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "traceId": self.trace_id, "spanId": self.span_id,
+            "parentSpanId": self.parent_id, "rule": self.rule_id,
+            "op": self.op, "startTimeMs": self.start_ms,
+            "durationUs": self.duration_us, "kind": self.kind,
+            "rows": self.rows,
+        }
+
+
+class Tracer:
+    _instance: Optional["Tracer"] = None
+
+    def __init__(self, max_spans_per_rule: int = 2048) -> None:
+        self._enabled: Dict[str, str] = {}  # rule_id -> strategy
+        self._spans: Dict[str, deque] = {}
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self.max_spans = max_spans_per_rule
+        self.any_enabled = False  # hot-path fast check, no lock
+        self._head_window: Dict[str, tuple] = {}  # head sampling buckets
+        # trace propagation across queue hops: emitted items are tagged with
+        # the emitting dispatch's trace id, keyed by id() with a weakref
+        # cleanup (many item types — dataclasses with eq — are unhashable,
+        # so WeakKeyDictionary can't hold them); non-weakref-able items
+        # (plain lists/dicts) fall back to the receiver's current trace
+        self._item_traces: Dict[int, tuple] = {}
+
+    @classmethod
+    def global_instance(cls) -> "Tracer":
+        if cls._instance is None:
+            cls._instance = Tracer()
+        return cls._instance
+
+    # ------------------------------------------------------------- management
+    #: "head" sampling records at most this many spans per rule per second
+    HEAD_SPANS_PER_SEC = 32
+
+    def enable(self, rule_id: str, strategy: str = "always") -> None:
+        if strategy not in ("always", "head"):
+            from ..utils.infra import EngineError
+
+            raise EngineError(
+                f"unknown trace strategy {strategy!r} (want always|head)")
+        with self._lock:
+            self._enabled[rule_id] = strategy
+            self._spans.setdefault(rule_id, deque(maxlen=self.max_spans))
+            self.any_enabled = True
+
+    def disable(self, rule_id: str) -> None:
+        with self._lock:
+            self._enabled.pop(rule_id, None)
+            self.any_enabled = bool(self._enabled)
+
+    def is_enabled(self, rule_id: str) -> bool:
+        return rule_id in self._enabled
+
+    # ------------------------------------------------------------- recording
+    def new_trace(self) -> str:
+        tid = f"t{next(self._ids):08x}"
+        _local.trace_id = tid
+        return tid
+
+    @staticmethod
+    def current_trace() -> Optional[str]:
+        return getattr(_local, "trace_id", None)
+
+    @staticmethod
+    def set_current(trace_id: Optional[str]) -> None:
+        _local.trace_id = trace_id
+
+    def tag(self, item: Any) -> None:
+        tid = self.current_trace()
+        if tid is None:
+            return
+        key = id(item)
+        try:
+            ref = weakref.ref(
+                item, lambda _r, k=key: self._item_traces.pop(k, None))
+        except TypeError:
+            return  # not weakref-able (plain list/dict)
+        self._item_traces[key] = (ref, tid)
+
+    def lookup(self, item: Any) -> Optional[str]:
+        got = self._item_traces.get(id(item))
+        if got is not None and got[0]() is item:
+            return got[1]
+        return None
+
+    def record(self, rule_id: str, op: str, start_ms: int, duration_us: int,
+               kind: str, rows: int) -> None:
+        trace_id = self.current_trace() or self.new_trace()
+        span = Span(trace_id, f"s{next(self._ids):08x}", "", rule_id, op,
+                    start_ms, duration_us, kind, rows)
+        with self._lock:
+            if self._enabled.get(rule_id) == "head":
+                # head sampling: bound recording rate on hot rules
+                sec = int(time.time())
+                wsec, n = getattr(self, "_head_window", {}).get(
+                    rule_id, (sec, 0))
+                if wsec != sec:
+                    wsec, n = sec, 0
+                if n >= self.HEAD_SPANS_PER_SEC:
+                    self._head_window[rule_id] = (wsec, n)
+                    return
+                self._head_window[rule_id] = (wsec, n + 1)
+            ring = self._spans.get(rule_id)
+            if ring is not None:
+                ring.append(span)
+
+    # --------------------------------------------------------------- queries
+    def rule_traces(self, rule_id: str, limit: int = 50) -> List[str]:
+        """Most recent trace ids of a rule (reference /trace/rule/{id})."""
+        with self._lock:
+            ring = self._spans.get(rule_id)
+            if not ring:
+                return []
+            seen: List[str] = []
+            for span in reversed(ring):
+                if span.trace_id not in seen:
+                    seen.append(span.trace_id)
+                if len(seen) >= limit:
+                    break
+            return seen
+
+    def trace(self, trace_id: str) -> List[Dict[str, Any]]:
+        """All spans of one trace (reference /trace/{id})."""
+        with self._lock:
+            out = []
+            for ring in self._spans.values():
+                out.extend(s.to_dict() for s in ring if s.trace_id == trace_id)
+            out.sort(key=lambda s: s["startTimeMs"])
+            return out
+
+    def rule_spans(self, rule_id: str, limit: int = 200) -> List[Dict[str, Any]]:
+        with self._lock:
+            ring = self._spans.get(rule_id)
+            if not ring:
+                return []
+            return [s.to_dict() for s in list(ring)[-limit:]]
+
+
+def item_stats(item: Any) -> tuple:
+    """(kind, row count) of a dispatched item for span annotation."""
+    kind = type(item).__name__
+    n = getattr(item, "n", None)
+    if n is None:
+        if isinstance(item, list):
+            n = len(item)
+        else:
+            n = 1
+    return kind, int(n)

@@ -286,9 +286,11 @@ def plan_rule(rule: RuleDef, store) -> Topo:
             topo, stmt, kernel_plan, source_nodes[0], opts, rule_id=rule.id
         )
     else:
-        tail = _build_host_chain(topo, stmt, source_nodes, opts, rule.id,
-                                 stream_joins=stream_joins,
-                                 lookup_joins=lookup_joins, store=store)
+        tail = _build_host_chain(
+            topo, stmt, source_nodes, opts, rule.id,
+            stream_joins=stream_joins, lookup_joins=lookup_joins, store=store,
+            source_names=[t.ref_name if multi else t.name
+                          for t in stream_tbls])
 
     # sinks
     actions = rule.actions or [{"log": {}}]
@@ -694,28 +696,35 @@ def _build_device_chain(
 def _build_host_chain(
     topo: Topo, stmt, source_nodes: List[SourceNode], opts: RuleOptionConfig,
     rule_id: str, stream_joins=None, lookup_joins=None, store=None,
+    source_names=None,
 ):
     if stream_joins is None:
         stream_joins = stmt.joins
     lookup_joins = lookup_joins or []
-    tail_of_sources = list(source_nodes)
     # lookup joins bind per-STREAM, before the watermark merge and before
     # WHERE/window (reference lookup_node.go sits right after decode): the
     # node must only see rows of the stream its ON clause references, even
-    # under event time where all chains later merge at the watermark node
+    # under event time where all chains later merge at the watermark node.
+    # Targeting tracks each stream's CURRENT tail by stream name (node names
+    # drift through _shared/_ratelimit/lookup hops).
+    names = source_names or [n.name for n in source_nodes]
+    tails = dict(zip(names, source_nodes))
     for k, lj in enumerate(lookup_joins):
         node = _make_lookup_join_node(lj, k, opts, store)
-        qualifiers = _stream_side_qualifiers(lj)
-        targets = [t for t in tail_of_sources
-                   if t.name in qualifiers
-                   or any(t.name == q + "_shared" for q in qualifiers)]
-        if not targets:
-            targets = list(tail_of_sources)
+        qs = sorted(_stream_side_qualifiers(lj) & tails.keys())
+        if not qs:
+            qs = list(tails.keys())
         topo.add_op(node)
-        for t in targets:
+        for t in {id(tails[q]): tails[q] for q in qs}.values():
             t.connect(node)
-        tail_of_sources = [t for t in tail_of_sources
-                           if t not in targets] + [node]
+        for q in qs:
+            tails[q] = node
+    seen_ids: set = set()
+    tail_of_sources = []
+    for t in tails.values():
+        if id(t) not in seen_ids:
+            seen_ids.add(id(t))
+            tail_of_sources.append(t)
 
     # event-time: watermark generation + late drop
     if opts.is_event_time:

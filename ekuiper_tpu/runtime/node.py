@@ -12,10 +12,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _time
 from typing import Any, Callable, List, Optional
 
+from ..observability.tracer import Tracer, item_stats
 from ..utils.infra import logger, safe_run
 from ..utils.metrics import StatManager
+from ..utils.timex import now_ms as timex_now_ms
 from .events import EOF, Barrier, ErrorEvent, PreTrigger, Trigger, Watermark
 
 
@@ -180,6 +183,21 @@ class Node:
         if isinstance(item, Barrier):
             self._handle_barrier(item, from_name)
             return
+        # tracing fast path: one attribute check when disabled
+        tracer = Tracer._instance
+        traced = (
+            tracer is not None and tracer.any_enabled
+            and self._topo is not None
+            and tracer.is_enabled(getattr(self._topo, "rule_id", ""))
+        )
+        if traced:
+            tid = tracer.lookup(item)
+            if tid is not None:
+                tracer.set_current(tid)
+            elif self.op_type == "source" or tracer.current_trace() is None:
+                tracer.new_trace()
+            t0 = _time.monotonic()
+        self._tracing_now = traced
         self.stats.inc_in()
         self.stats.process_begin()
         try:
@@ -199,6 +217,12 @@ class Node:
             self.on_error(exc, item)
         finally:
             self.stats.process_end()
+            if traced:
+                kind, rows = item_stats(item)
+                tracer.record(
+                    self._topo.rule_id, self.name, timex_now_ms(),
+                    int((_time.monotonic() - t0) * 1e6), kind, rows)
+                self._tracing_now = False
 
     # ------------------------------------------------------------- overridables
     def on_open(self) -> None:
@@ -294,6 +318,8 @@ class Node:
 
     # ------------------------------------------------------------------ output
     def emit(self, item: Any, count: int = 1) -> None:
+        if getattr(self, "_tracing_now", False):
+            Tracer.global_instance().tag(item)  # trace follows the item
         self.stats.inc_out(count)
         self.broadcast(item)
 

@@ -123,6 +123,21 @@ class RestApi:
         r("DELETE", r"^/scripts/(?P<name>[^/]+)$",
           lambda m: self._scripts().delete(m["name"])
           or f"Script {m['name']} is dropped.")
+        # observability (reference: prome_init.go /metrics, pkg/tracer
+        # trace routes, metrics/metrics_dump.go)
+        r("GET", r"^/metrics$", lambda m: self.prometheus_metrics())
+        r("GET", r"^/metrics/dump$", lambda m: self.metrics_dump())
+        r("POST", r"^/rules/(?P<id>[^/]+)/trace/start$",
+          lambda m, body=None: self._tracer().enable(
+              m["id"], (body or {}).get("strategy", "always"))
+          or f"Tracing enabled for rule {m['id']}.")
+        r("POST", r"^/rules/(?P<id>[^/]+)/trace/stop$",
+          lambda m: self._tracer().disable(m["id"])
+          or f"Tracing disabled for rule {m['id']}.")
+        r("GET", r"^/trace/rule/(?P<id>[^/]+)$",
+          lambda m: self._tracer().rule_traces(m["id"]))
+        r("GET", r"^/trace/(?P<id>[^/]+)$",
+          lambda m: self._tracer().trace(m["id"]))
         # connections CRUD + ping (reference: rest.go connection routes)
         r("GET", r"^/connections$", lambda m: self._connections().list())
         r("POST", r"^/connections$",
@@ -166,6 +181,40 @@ class RestApi:
         r("GET", r"^/plugins/portables/(?P<name>[^/]+)$", self.describe_plugin)
         r("DELETE", r"^/plugins/portables/(?P<name>[^/]+)$",
           lambda m: self._plugins().delete(m["name"]) or f"Plugin {m['name']} is deleted.")
+
+    # ---------------------------------------------------------- observability
+    @staticmethod
+    def _tracer():
+        from ..observability.tracer import Tracer
+
+        return Tracer.global_instance()
+
+    def prometheus_metrics(self):
+        from ..observability import prometheus
+
+        return prometheus.TextResponse(prometheus.render(self.rules))
+
+    def metrics_dump(self):
+        """Write every rule's status snapshot to the data dir and return the
+        dump (reference metrics/metrics_dump.go:40-85)."""
+        import os
+
+        from ..utils.config import get_config
+
+        lines = []
+        for entry in self.rules.list():
+            rid = entry["id"]
+            try:
+                lines.append(json.dumps(
+                    {"rule": rid, "status": self.rules.status(rid)}))
+            except Exception as exc:
+                lines.append(json.dumps({"rule": rid, "error": str(exc)}))
+        content = "\n".join(lines) + "\n"
+        path = os.path.join(get_config().store.path, "metrics.dump")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            f.write(content)
+        return {"file": path, "rules": len(lines)}
 
     # ------------------------------------------------------------ connections
     def _connections(self):
@@ -305,9 +354,14 @@ def serve(api: RestApi, host: str = "127.0.0.1", port: int = 9081):
             self._reply(code, result)
 
         def _reply(self, code: int, result: Any) -> None:
-            data = json.dumps(result, default=str).encode()
+            ctype = getattr(result, "content_type", None)
+            if ctype is not None:  # raw text payload (e.g. /metrics)
+                data = str(result).encode()
+            else:
+                ctype = "application/json"
+                data = json.dumps(result, default=str).encode()
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)

@@ -113,6 +113,11 @@ class RuleRegistry:
             out.append({"id": rule_id, "status": status})
         return out
 
+    def state(self, rule_id: str) -> Optional[RuleState]:
+        """Live RuleState (None when not instantiated) — observability."""
+        with self._lock:
+            return self._rules.get(rule_id)
+
     def status(self, rule_id: str) -> Dict[str, Any]:
         return self._get(rule_id).status()
 

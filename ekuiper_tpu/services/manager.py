@@ -146,11 +146,10 @@ class ServiceManager:
             if not overwrite and name in self._services:
                 raise EngineError(f"service {name} already exists")
             # validate + build into temporaries FIRST: a bad descriptor on
-            # overwrite must not tear down the running service
-            old_fns = {f for f, (k, _) in self._functions.items()
-                       if k.startswith(name + "/")}
-            new_ifaces, new_fns = self._build(name, descriptor,
-                                              ignore_clash=old_fns)
+            # overwrite must not tear down the running service (functions
+            # still owned by the old registration don't count as clashes —
+            # the `fname not in self._functions` check covers them)
+            new_ifaces, new_fns = self._build(name, descriptor)
             if name in self._services:
                 self._unregister(name)
             self._services[name] = descriptor
@@ -198,8 +197,7 @@ class ServiceManager:
                     "interface": ikey.split("/", 1)[1]}
 
     # -------------------------------------------------------------- internal
-    def _build(self, name: str, descriptor: Dict[str, Any],
-               ignore_clash=frozenset()):
+    def _build(self, name: str, descriptor: Dict[str, Any]):
         """Validate a descriptor and build its interface/function tables
         without touching live state."""
         interfaces = descriptor.get("interfaces") or {}
@@ -214,8 +212,7 @@ class ServiceManager:
             for fname, target in iface.function_map().items():
                 fname = fname.lower()  # SQL function names are case-insensitive
                 clash = fn_registry.lookup(fname)
-                if clash is not None and fname not in self._functions \
-                        and fname not in ignore_clash:
+                if clash is not None and fname not in self._functions:
                     raise EngineError(
                         f"function {fname} already exists (builtin wins; "
                         "rename via the functions mapping)")
