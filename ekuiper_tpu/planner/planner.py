@@ -46,6 +46,7 @@ class RuleDef:
     actions: List[Dict[str, Dict[str, Any]]] = field(default_factory=list)
     options: Dict[str, Any] = field(default_factory=dict)
     graph: Optional[Dict[str, Any]] = None  # graph-API rule (PlanByGraph)
+    tags: List[str] = field(default_factory=list)  # rule.go Tags
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "RuleDef":
@@ -55,6 +56,7 @@ class RuleDef:
             actions=d.get("actions", []),
             options=d.get("options", {}),
             graph=d.get("graph"),
+            tags=list(d.get("tags") or []),
         )
 
     def to_dict(self) -> Dict[str, Any]:
@@ -64,6 +66,8 @@ class RuleDef:
         }
         if self.graph is not None:
             out["graph"] = self.graph
+        if self.tags:
+            out["tags"] = self.tags
         return out
 
 

@@ -23,6 +23,9 @@ class RunState(str, Enum):
     RUNNING = "running"
     STOPPING = "stopping"
     STOPPED_BY_ERR = "stopped_by_error"
+    # cron/duration rules between activations (reference schedule states,
+    # internal/pkg/schedule + def/rule.go:40-42)
+    SCHEDULED = "stopped: waiting for next schedule"
 
 
 class RuleState:
@@ -38,6 +41,21 @@ class RuleState:
         self._worker: Optional[threading.Thread] = None
         self._supervisor: Optional[threading.Thread] = None
         self._stop_supervision = threading.Event()
+        # schedule options (reference def/rule.go Cron/Duration/...Range)
+        from ..utils import cron as cronlib
+
+        self._cron = None
+        self._duration_ms = 0
+        self._ranges = rule.options.get("cronDatetimeRange") or []
+        if rule.options.get("cron"):
+            self._cron = cronlib.Cron(str(rule.options["cron"]))
+        if rule.options.get("duration"):
+            self._duration_ms = cronlib.parse_duration_ms(
+                rule.options["duration"])
+        if self._cron is not None and self._duration_ms <= 0:
+            raise ValueError("cron rules require a duration")
+        self._sched_timer = None
+        self._sched_gen = 0  # invalidates stale timers after a user stop
 
     # --------------------------------------------------------------- actions
     def start(self) -> None:
@@ -71,6 +89,10 @@ class RuleState:
                     self._do_start()
                 elif action == "stop":
                     self._do_stop()
+                elif action.startswith("cron_fire:"):
+                    self._do_cron_fire(int(action.split(":", 1)[1]))
+                elif action.startswith("cron_expire:"):
+                    self._do_cron_expire(int(action.split(":", 1)[1]))
             except Exception as exc:
                 logger.error("rule %s action %s failed: %s", self.rule.id, action, exc)
                 with self._lock:
@@ -81,6 +103,55 @@ class RuleState:
     def _do_start(self) -> None:
         with self._lock:
             if self.state in (RunState.RUNNING, RunState.STARTING):
+                return
+            self.state = RunState.STARTING
+        if self._cron is not None:
+            self._schedule_next_fire()
+            return
+        self._open_topo()
+        if self._duration_ms > 0:
+            # duration-only: run once for the duration, then stop
+            gen = self._sched_gen
+            self._sched_timer = timex.after(
+                self._duration_ms,
+                lambda ts: self._enqueue(f"cron_expire:{gen}"))
+
+    def _schedule_next_fire(self) -> None:
+        now = timex.now_ms()
+        fire_at = self._cron.next_fire_ms(now)
+        gen = self._sched_gen
+        with self._lock:
+            self.state = RunState.SCHEDULED
+        self._sched_timer = timex.after(
+            fire_at - now, lambda ts: self._enqueue(f"cron_fire:{gen}"))
+
+    def _do_cron_fire(self, gen: int) -> None:
+        from ..utils import cron as cronlib
+
+        if gen != self._sched_gen:
+            return  # stale timer from before a user stop
+        if self.state != RunState.SCHEDULED:
+            return
+        if not cronlib.in_ranges(timex.now_ms(), self._ranges):
+            self._schedule_next_fire()
+            return
+        self._open_topo()
+        self._sched_timer = timex.after(
+            self._duration_ms, lambda ts: self._enqueue(f"cron_expire:{gen}"))
+
+    def _do_cron_expire(self, gen: int) -> None:
+        if gen != self._sched_gen:
+            return
+        self._close_topo()
+        if self._cron is not None:
+            self._schedule_next_fire()
+        else:
+            with self._lock:
+                self.state = RunState.STOPPED
+
+    def _open_topo(self) -> None:
+        with self._lock:
+            if self.state == RunState.RUNNING:
                 return
             self.state = RunState.STARTING
         topo = plan_rule(self.rule, self.store)
@@ -97,12 +168,7 @@ class RuleState:
         )
         self._supervisor.start()
 
-    def _do_stop(self) -> None:
-        with self._lock:
-            if self.state in (RunState.STOPPED, RunState.STOPPING):
-                if self.state == RunState.STOPPED:
-                    return
-            self.state = RunState.STOPPING
+    def _close_topo(self) -> None:
         self._stop_supervision.set()
         if self.topo is not None:
             try:
@@ -112,6 +178,18 @@ class RuleState:
             self.topo.close()
         with self._lock:
             self.topo = None
+
+    def _do_stop(self) -> None:
+        with self._lock:
+            if self.state == RunState.STOPPED:
+                return
+            self.state = RunState.STOPPING
+        self._sched_gen += 1  # invalidate in-flight schedule timers
+        if self._sched_timer is not None:
+            self._sched_timer.stop()
+            self._sched_timer = None
+        self._close_topo()
+        with self._lock:
             self.state = RunState.STOPPED
 
     # ------------------------------------------------------------- supervision

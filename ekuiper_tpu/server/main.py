@@ -25,6 +25,9 @@ def start_up(config_path: str | None = None, block: bool = True):
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
     store = kv.setup(cfg.store.type, cfg.store.path)
+    from ..utils.config import apply_config_overlay
+
+    apply_config_overlay(store)  # PATCH /configs overlays survive restarts
     # portable plugin manager (restores installed plugins + binds symbols,
     # reference: server.go:218-226 binder init)
     from ..plugin.manager import PortableManager

@@ -22,9 +22,11 @@ zero-dependency stance as the reference's single static binary.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import __version__ as _version
@@ -47,6 +49,7 @@ class RestApi:
         self.rules = RuleRegistry(store)
         self.ruleset = RulesetProcessor(store)
         self.trials = TrialManager(store)
+        self._import_status: Dict[str, Any] = {"status": "none"}
         self.routes: List[Route] = []
         r = self._route
         r("GET", r"^/$", self.info)
@@ -66,7 +69,18 @@ class RestApi:
         r("DELETE", r"^/tables/(?P<name>[^/]+)$",
           lambda m: self.streams.drop(m["name"], True))
         r("POST", r"^/rules$", self.create_rule)
-        r("GET", r"^/rules$", lambda m: self.rules.list())
+        r("GET", r"^/rules$",
+          lambda m, query=None: self.rules.list(
+              tags=[t for t in (query or {}).get("tags", "").split(",") if t]
+              or None))
+        r("PUT", r"^/rules/(?P<id>[^/]+)/tags$",
+          lambda m, body=None: self.rules.set_tags(
+              m["id"], (body or {}).get("tags") or [], add=True)
+          or f"Rule {m['id']} tags updated.")
+        r("DELETE", r"^/rules/(?P<id>[^/]+)/tags$",
+          lambda m, body=None: self.rules.set_tags(
+              m["id"], (body or {}).get("tags") or [], add=False)
+          or f"Rule {m['id']} tags removed.")
         r("POST", r"^/rules/validate$",
           lambda m, body=None: self.rules.validate(body))
         r("GET", r"^/rules/(?P<id>[^/]+)$",
@@ -91,6 +105,20 @@ class RestApi:
         r("GET", r"^/ruleset/export$", lambda m: self.ruleset.export())
         r("POST", r"^/ruleset/import$",
           lambda m, body=None: self.ruleset.import_ruleset(body))
+        # full-state import/export with async mode (reference rest.go
+        # /data/import /data/export + importStatus)
+        r("GET", r"^/data/export$", lambda m: self.ruleset.export())
+        r("POST", r"^/data/import$", self.data_import)
+        r("GET", r"^/data/import/status$", lambda m: dict(self._import_status))
+        # runtime config overlay (reference PATCH /configs,
+        # internal/server/rest.go configurationPatch)
+        r("PATCH", r"^/configs$", self.patch_configs)
+        r("GET", r"^/configs$", lambda m: self._config_overlay())
+        # file uploads (reference rest.go /config/uploads)
+        r("GET", r"^/config/uploads$", lambda m: self.list_uploads())
+        r("POST", r"^/config/uploads$", self.create_upload)
+        r("DELETE", r"^/config/uploads/(?P<name>[^/]+)$",
+          lambda m: self.delete_upload(m["name"]))
         r("POST", r"^/ruletest$", lambda m, body=None: self.trials.create(body))
         r("POST", r"^/ruletest/(?P<id>[^/]+)/start$",
           lambda m: self.trials.start(m["id"]))
@@ -181,6 +209,122 @@ class RestApi:
         r("GET", r"^/plugins/portables/(?P<name>[^/]+)$", self.describe_plugin)
         r("DELETE", r"^/plugins/portables/(?P<name>[^/]+)$",
           lambda m: self._plugins().delete(m["name"]) or f"Plugin {m['name']} is deleted.")
+
+    # ----------------------------------------------------- data import/export
+    def data_import(self, m, body: Optional[dict] = None,
+                    query: Optional[dict] = None) -> Any:
+        """POST /data/import — ?partial=true merges into the running system;
+        the default (full import) stops every rule first, then imports
+        (reference rest.go importHandler semantics). ?async=true runs in the
+        background with progress at /data/import/status."""
+        doc = (body or {}).get("content") or body or {}
+        if isinstance(doc, str):
+            doc = json.loads(doc)
+        partial = (query or {}).get("partial") in ("true", "1")
+
+        def run():
+            self._import_status.update(status="importing")
+            try:
+                if not partial:
+                    self.rules.stop_all()
+                counts = self.ruleset.import_ruleset(doc)
+                self._import_status.update(status="done", counts=counts)
+            except Exception as exc:
+                self._import_status.update(status="error", error=str(exc))
+
+        if (query or {}).get("async") in ("true", "1"):
+            self._import_status = {"status": "importing"}
+            threading.Thread(target=run, daemon=True,
+                             name="data-import").start()
+            return "Import started; poll /data/import/status."
+        self._import_status = {"status": "importing"}
+        run()
+        if self._import_status.get("status") == "error":
+            raise EngineError(self._import_status.get("error", "import failed"))
+        return self._import_status.get("counts")
+
+    # ----------------------------------------------------------- config patch
+    def patch_configs(self, m, body: Optional[dict] = None) -> str:
+        """PATCH /configs: runtime-adjustable basics (log level, timezone)
+        persisted as an overlay in the KV store."""
+        from ..utils.config import get_config
+
+        body = body or {}
+        cfg = get_config()
+        overlay_kv = self.store.kv("config_overlay")
+        basic = body.get("basic", body)
+        allowed = {"log_level", "time_zone", "ignore_case", "prometheus"}
+        # validate the whole batch BEFORE mutating live config — a rejected
+        # key must not leave a half-applied patch
+        applied = {}
+        for key, val in basic.items():
+            norm = key.replace("logLevel", "log_level").replace(
+                "timezone", "time_zone")
+            if norm not in allowed:
+                raise EngineError(f"config key {key!r} is not patchable")
+            applied[norm] = val
+        for norm, val in applied.items():
+            setattr(cfg.basic, norm, val)
+        if "log_level" in applied:
+            import logging as _logging
+
+            logger.setLevel(getattr(
+                _logging, str(applied["log_level"]).upper(), _logging.INFO))
+        for k, v in applied.items():
+            overlay_kv.set(k, v)
+        return f"Configuration patched: {sorted(applied)}"
+
+    def _config_overlay(self) -> Dict[str, Any]:
+        from ..utils.config import get_config
+
+        cfg = get_config()
+        return {"basic": {
+            "log_level": cfg.basic.log_level,
+            "time_zone": cfg.basic.time_zone,
+            "ignore_case": cfg.basic.ignore_case,
+            "prometheus": cfg.basic.prometheus,
+            "rest_port": cfg.basic.rest_port,
+        }}
+
+    # ---------------------------------------------------------------- uploads
+    def _uploads_dir(self) -> str:
+        from ..utils.config import get_config
+
+        path = os.path.join(get_config().store.path, "uploads")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    @staticmethod
+    def _safe_name(name: str) -> str:
+        base = os.path.basename(name or "")
+        if not base or base != name:
+            raise EngineError(f"invalid upload name {name!r}")
+        return base
+
+    def list_uploads(self) -> List[str]:
+        return sorted(os.listdir(self._uploads_dir()))
+
+    def create_upload(self, m, body: Optional[dict] = None) -> str:
+        body = body or {}
+        name = self._safe_name(body.get("name", ""))
+        path = os.path.join(self._uploads_dir(), name)
+        if "base64" in body:
+            import base64
+
+            data = base64.b64decode(body["base64"])
+            with open(path, "wb") as f:
+                f.write(data)
+        else:
+            with open(path, "w") as f:
+                f.write(str(body.get("content", "")))
+        return path
+
+    def delete_upload(self, name: str) -> str:
+        path = os.path.join(self._uploads_dir(), self._safe_name(name))
+        if not os.path.isfile(path):
+            raise EngineError(f"upload {name} not found")
+        os.remove(path)
+        return f"Upload {name} is deleted."
 
     # ---------------------------------------------------------- observability
     @staticmethod
@@ -308,7 +452,8 @@ class RestApi:
         return f"Rule {m['id']} was updated successfully."
 
     # --------------------------------------------------------------- dispatch
-    def dispatch(self, method: str, path: str, body: Optional[dict]) -> Tuple[int, Any]:
+    def dispatch(self, method: str, path: str, body: Optional[dict],
+                 query: Optional[Dict[str, str]] = None) -> Tuple[int, Any]:
         for rmethod, pattern, fn in self.routes:
             if rmethod != method:
                 continue
@@ -318,8 +463,11 @@ class RestApi:
             kwargs = {}
             import inspect
 
-            if "body" in inspect.signature(fn).parameters:
+            params = inspect.signature(fn).parameters
+            if "body" in params:
                 kwargs["body"] = body
+            if "query" in params:
+                kwargs["query"] = query or {}
             try:
                 result = fn(match.groupdict(), **kwargs)
                 code = 201 if method == "POST" and path in ("/streams", "/tables", "/rules") else 200
@@ -334,6 +482,54 @@ class RestApi:
         return 404, {"error": f"no route {method} {path}"}
 
 
+#: routes reachable without a token when authentication is on (reference
+#: leaves ping-style endpoints open)
+_AUTH_EXEMPT = {"/", "/ping"}
+
+
+def _b64url_decode(s: str) -> bytes:
+    import base64
+
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def _auth_check(headers, path: str) -> Optional[str]:
+    """HS256 JWT bearer validation when basic.authentication is on.
+    Returns an error string or None. Checks signature and exp."""
+    from ..utils.config import get_config
+
+    cfg = get_config().basic
+    if not cfg.authentication or path in _AUTH_EXEMPT:
+        return None
+    if not cfg.jwt_secret:
+        # fail closed: HMAC with an empty key is forgeable by anyone
+        return "authentication enabled but no jwt_secret configured"
+    auth = headers.get("Authorization", "")
+    if not auth.startswith("Bearer "):
+        return "missing bearer token"
+    token = auth[len("Bearer "):].strip()
+    try:
+        import hashlib
+        import hmac
+        import time as _t
+
+        head_b64, payload_b64, sig_b64 = token.split(".")
+        header = json.loads(_b64url_decode(head_b64))
+        if header.get("alg") != "HS256":
+            return f"unsupported jwt alg {header.get('alg')!r}"
+        expect = hmac.new(
+            cfg.jwt_secret.encode(), f"{head_b64}.{payload_b64}".encode(),
+            hashlib.sha256).digest()
+        if not hmac.compare_digest(expect, _b64url_decode(sig_b64)):
+            return "invalid token signature"
+        payload = json.loads(_b64url_decode(payload_b64))
+        if "exp" in payload and _t.time() > float(payload["exp"]):
+            return "token expired"
+        return None
+    except Exception as exc:
+        return f"malformed token: {exc}"
+
+
 def serve(api: RestApi, host: str = "127.0.0.1", port: int = 9081):
     """Start the HTTP server (returns the server; call .shutdown() to stop)."""
 
@@ -342,6 +538,13 @@ def serve(api: RestApi, host: str = "127.0.0.1", port: int = 9081):
             logger.debug("rest: " + fmt, *args)
 
         def _handle(self, method: str) -> None:
+            parsed = urlparse(self.path)
+            path = parsed.path.rstrip("/") or "/"
+            query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+            err = _auth_check(self.headers, path)
+            if err is not None:
+                self._reply(401, {"error": err})
+                return
             length = int(self.headers.get("Content-Length") or 0)
             body = None
             if length:
@@ -350,7 +553,7 @@ def serve(api: RestApi, host: str = "127.0.0.1", port: int = 9081):
                 except json.JSONDecodeError:
                     self._reply(400, {"error": "invalid json body"})
                     return
-            code, result = api.dispatch(method, self.path.rstrip("/") or "/", body)
+            code, result = api.dispatch(method, path, body, query)
             self._reply(code, result)
 
         def _reply(self, code: int, result: Any) -> None:
@@ -377,6 +580,9 @@ def serve(api: RestApi, host: str = "127.0.0.1", port: int = 9081):
 
         def do_DELETE(self):
             self._handle("DELETE")
+
+        def do_PATCH(self):
+            self._handle("PATCH")
 
     server = ThreadingHTTPServer((host, port), Handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True,

@@ -41,13 +41,15 @@ class RuleRegistry:
     # -------------------------------------------------------------------- CRUD
     def create(self, rule_json: Dict[str, Any]) -> str:
         rule = self.processor.create(rule_json)
-        # validate by planning once (reference: NewState -> Validate -> Plan)
+        # validate by planning + constructing the FSM once (schedule options
+        # are parsed there); any failure rolls the definition back so a
+        # corrected re-POST with the same id works
         try:
             plan_rule(rule, self.store).close()
+            rs = RuleState(rule, self.store)
         except Exception:
             self.processor.drop(rule.id)
             raise
-        rs = RuleState(rule, self.store)
         with self._lock:
             self._rules[rule.id] = rs
         if rule.options.get("triggered", True):
@@ -60,7 +62,10 @@ class RuleRegistry:
         with self._lock:
             rs = self._rules.get(rule.id)
         if rs is not None:
-            was_running = rs.state == RunState.RUNNING
+            # cron rules waiting between firings are ACTIVE — an update must
+            # re-arm their schedule, not silently deactivate it
+            was_running = rs.state in (
+                RunState.RUNNING, RunState.STARTING, RunState.SCHEDULED)
             rs.stop()
             new_rs = RuleState(rule, self.store)
             with self._lock:
@@ -104,14 +109,30 @@ class RuleRegistry:
         self.store.kv("rule_run_state").set(rule_id, True)
 
     # ------------------------------------------------------------------ query
-    def list(self) -> List[Dict[str, Any]]:
+    def list(self, tags: Optional[List[str]] = None) -> List[Dict[str, Any]]:
         out = []
         for rule_id in self.processor.list():
             with self._lock:
                 rs = self._rules.get(rule_id)
+            raw, ok = self.processor._table().get_ok(rule_id)
+            rule_tags = list(raw.get("tags") or []) if ok and isinstance(
+                raw, dict) else []
+            if tags and not set(tags) <= set(rule_tags):
+                continue  # reference: tag filter requires ALL given tags
             status = rs.state.value if rs is not None else "stopped"
-            out.append({"id": rule_id, "status": status})
+            entry = {"id": rule_id, "status": status}
+            if rule_tags:
+                entry["tags"] = rule_tags
+            out.append(entry)
         return out
+
+    def set_tags(self, rule_id: str, tags: List[str], add: bool) -> None:
+        rule = self.processor.get(rule_id)
+        if add:
+            rule.tags = sorted(set(rule.tags) | set(tags))
+        else:
+            rule.tags = [t for t in rule.tags if t not in set(tags)]
+        self.processor.update(rule.to_dict())
 
     def state(self, rule_id: str) -> Optional[RuleState]:
         """Live RuleState (None when not instantiated) — observability."""

@@ -71,6 +71,10 @@ class BasicConfig:
     prometheus_port: int = 20499
     ignore_case: bool = False
     time_zone: str = "UTC"
+    # REST JWT auth (reference internal/pkg/jwt — uses registered RSA keys;
+    # here an HS256 shared secret, documented divergence). Off by default.
+    authentication: bool = False
+    jwt_secret: str = ""
 
 
 @dataclass
@@ -151,6 +155,17 @@ def load_config(path: Optional[str] = None) -> Config:
 
 
 _global: Optional[Config] = None
+
+
+def apply_config_overlay(store) -> None:
+    """Re-apply runtime PATCH /configs overlays persisted in the KV store
+    (server/rest.py patch_configs) so patches survive restarts."""
+    cfg = get_config()
+    overlay = store.kv("config_overlay")
+    for key in overlay.keys():
+        val, ok = overlay.get_ok(key)
+        if ok and hasattr(cfg.basic, key):
+            setattr(cfg.basic, key, val)
 
 
 def get_config() -> Config:

@@ -1,0 +1,146 @@
+"""Cron expressions + Go-style durations for scheduled rules
+(analogue of the reference's robfig/cron usage in internal/pkg/schedule).
+
+Standard 5-field cron (minute hour day-of-month month day-of-week) with
+lists, ranges, and steps. Matching follows vixie-cron semantics: when both
+day-of-month and day-of-week are restricted, a date matches if EITHER does.
+All computation is in local time via the engine clock (mock-testable).
+"""
+from __future__ import annotations
+
+import re
+import time
+from typing import List, Optional, Set, Tuple
+
+from .infra import EngineError
+
+_FIELD_RANGES = ((0, 59), (0, 23), (1, 31), (1, 12), (0, 6))
+_MONTH_NAMES = {m: i + 1 for i, m in enumerate(
+    "jan feb mar apr may jun jul aug sep oct nov dec".split())}
+_DOW_NAMES = {d: i for i, d in enumerate(
+    "sun mon tue wed thu fri sat".split())}
+
+
+def _parse_field(spec: str, lo: int, hi: int, names=None) -> Set[int]:
+    out: Set[int] = set()
+    for part in spec.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            step = int(step_s)
+            if step <= 0:
+                raise EngineError(f"bad cron step in {spec!r}")
+        if part in ("*", ""):
+            lo2, hi2 = lo, hi
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            lo2, hi2 = _value(a, names), _value(b, names)
+        else:
+            v = _value(part, names)
+            lo2 = hi2 = v
+            if step > 1:
+                hi2 = hi
+        if not (lo <= lo2 <= hi and lo <= hi2 <= hi and lo2 <= hi2):
+            raise EngineError(f"cron field {spec!r} out of range {lo}-{hi}")
+        out.update(range(lo2, hi2 + 1, step))
+    return out
+
+
+def _value(tok: str, names) -> int:
+    tok = tok.strip().lower()
+    if names and tok in names:
+        return names[tok]
+    return int(tok)
+
+
+class Cron:
+    def __init__(self, expr: str) -> None:
+        fields = expr.split()
+        if len(fields) == 6:
+            # robfig/cron's optional seconds field: accepted, seconds dropped
+            fields = fields[1:]
+        if len(fields) != 5:
+            raise EngineError(
+                f"cron {expr!r} must have 5 fields (min hour dom mon dow)")
+        self.expr = expr
+        (self.minutes, self.hours, self.dom, self.months, self.dow) = (
+            _parse_field(f, lo, hi, names)
+            for f, (lo, hi), names in zip(
+                fields, _FIELD_RANGES,
+                (None, None, None, _MONTH_NAMES, _DOW_NAMES))
+        )
+        self.dom_star = fields[2] == "*"
+        self.dow_star = fields[4] == "*"
+
+    def _day_matches(self, tm: time.struct_time) -> bool:
+        dom_ok = tm.tm_mday in self.dom
+        # struct_time: Monday=0 ... cron: Sunday=0
+        dow_ok = ((tm.tm_wday + 1) % 7) in self.dow
+        if self.dom_star and self.dow_star:
+            return True
+        if self.dom_star:
+            return dow_ok
+        if self.dow_star:
+            return dom_ok
+        return dom_ok or dow_ok  # vixie-cron OR semantics
+
+    def next_fire_ms(self, after_ms: int) -> int:
+        """Earliest fire time strictly after `after_ms` (epoch ms, local)."""
+        t = (after_ms // 60_000 + 1) * 60  # next whole minute, seconds
+        for _ in range(366 * 24 * 60):  # bounded search: one year of minutes
+            tm = time.localtime(t)
+            if (tm.tm_mon in self.months and self._day_matches(tm)
+                    and tm.tm_hour in self.hours
+                    and tm.tm_min in self.minutes):
+                return t * 1000
+            t += 60
+        raise EngineError(f"cron {self.expr!r} never fires")
+
+
+_DUR_RE = re.compile(r"(\d+(?:\.\d+)?)(ms|s|m|h|d)")
+_DUR_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000}
+
+
+def parse_duration_ms(spec) -> int:
+    """Go-style duration ('1h30m', '10s', '500ms') or a bare number of
+    milliseconds."""
+    if isinstance(spec, (int, float)):
+        return int(spec)
+    s = str(spec).strip().lower()
+    if not s:
+        return 0
+    if s.isdigit():
+        return int(s)
+    total = 0.0
+    pos = 0
+    for m in _DUR_RE.finditer(s):
+        if m.start() != pos:
+            raise EngineError(f"bad duration {spec!r}")
+        total += float(m.group(1)) * _DUR_MS[m.group(2)]
+        pos = m.end()
+    if pos != len(s):
+        raise EngineError(f"bad duration {spec!r}")
+    return int(total)
+
+
+def parse_range_ms(r: dict) -> Tuple[int, int]:
+    """A cronDatetimeRange entry: {beginTimestamp,endTimestamp} in ms or
+    {begin,end} as 'YYYY-MM-DD HH:MM:SS' local."""
+    if r.get("beginTimestamp") or r.get("endTimestamp"):
+        return int(r.get("beginTimestamp", 0)), int(r.get("endTimestamp", 0))
+
+    def parse(s: str) -> int:
+        return int(time.mktime(time.strptime(s, "%Y-%m-%d %H:%M:%S")) * 1000)
+
+    return parse(r["begin"]), parse(r["end"])
+
+
+def in_ranges(now_ms: int, ranges: Optional[List[dict]]) -> bool:
+    """IsInScheduleRanges (schedule.go:36-58): no ranges = always in."""
+    if not ranges:
+        return True
+    for r in ranges:
+        begin, end = parse_range_ms(r)
+        if begin <= now_ms <= end:
+            return True
+    return False
