@@ -1,0 +1,57 @@
+"""Logical-plan optimizations (analogue of the reference's
+internal/topo/planner/optimizer.go rules).
+
+Two passes matter for this engine's shape:
+
+- Predicate placement: _build_host_chain already sits WHERE before the
+  window (push-down past windowing), and fused rules compile WHERE into the
+  device fold. What remained was the decode edge:
+- Column pruning (ColumnPruner in the reference): compute the set of
+  columns the statement can ever read and drop everything else right where
+  rows enter the rule — at the private source's micro-batcher or at the
+  rule's shared-source entry (a pooled pipeline serves rules with different
+  needs, so pruning is always per-rule). For wide payloads this shrinks
+  every downstream batch, tuple materialization, and device upload.
+"""
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from ..sql import ast
+
+
+def referenced_columns(stmt: ast.SelectStatement) -> Optional[Set[str]]:
+    """Every column name the statement can reference, or None when pruning
+    is unsafe (wildcard anywhere — projection, count(*) args are fine — or
+    a construct that reads whole rows)."""
+    cols: Set[str] = set()
+    for f in stmt.fields:
+        if isinstance(f.expr, ast.Wildcard):
+            return None
+    roots = list(stmt.expressions())
+    for j in stmt.joins:
+        if j.on is not None:
+            roots.append(j.on)
+    for root in roots:
+        if root is None:
+            continue
+        for node in ast.walk(root):
+            if isinstance(node, ast.Wildcard):
+                # e.g. an SRF/func over *: needs the whole row
+                if not _is_countish_parent(root, node):
+                    return None
+            elif isinstance(node, ast.FieldRef):
+                cols.add(node.name)
+    return cols
+
+
+def _is_countish_parent(root: ast.Expr, wc: ast.Wildcard) -> bool:
+    """count(*)-style wildcards read no columns; any other wildcard does."""
+    for node in ast.walk(root):
+        # identity, not dataclass equality: two bare wildcards compare
+        # equal, and the wrong parent would misattribute the wildcard
+        if isinstance(node, ast.Call) and any(
+            a is wc for a in getattr(node, "args", [])
+        ):
+            return node.name.lower() in ("count", "inc_count")
+    return False

@@ -278,11 +278,17 @@ def plan_rule(rule: RuleDef, store) -> Topo:
     # alias-qualified refs resolve against the emitter name, so any join
     # (including lookup-only) keeps ref_name naming
     multi = len(stream_tbls) > 1 or bool(stmt.joins)
+    # column pruning (optimizer.py ColumnPruner analogue): drop columns the
+    # statement can never read at the rule's ingest edge
+    from .optimizer import referenced_columns
+
+    needed = referenced_columns(stmt)
     source_nodes: List[SourceNode] = []
     for tbl in stream_tbls:
         src_name = tbl.ref_name if multi else tbl.name
         source_nodes.append(
-            _plan_stream_source(tbl.name, src_name, opts, store, topo))
+            _plan_stream_source(tbl.name, src_name, opts, store, topo,
+                                project_columns=needed))
 
     kernel_plan = device_path_eligible(stmt, opts)
     if kernel_plan is not None and len(source_nodes) == 1 and not lookup_joins:
@@ -330,8 +336,14 @@ def plan_rule_group(group_id: str, rules: List[RuleDef], store) -> Topo:
     opts = merged_options(rules[0])
     opts.qos = 0
     topo = Topo(group_id, qos=0)
+    from .optimizer import referenced_columns
+
+    needed = referenced_columns(stmt)
+    if needed is not None:
+        # canonicalized WHERE literals are injected params, not columns
+        needed = {c for c in needed if not c.startswith("__param_")}
     src = _plan_stream_source(stmt.sources[0].name, stmt.sources[0].name,
-                              opts, store, topo)
+                              opts, store, topo, project_columns=needed)
     dims = [d.expr for d in stmt.dimensions]
     direct = build_direct_emit(stmt, spec.plan, [d.name for d in dims])
     if direct is None:
@@ -425,13 +437,15 @@ def _equality_key_fields(join: ast.Join) -> List:
 
 
 def _plan_stream_source(stream_name: str, src_name: str, opts, store,
-                        topo: Topo):
+                        topo: Topo, project_columns=None):
     """Build (or ride) the ingest+decode pipeline for one stream: a pooled
     shared subtopo for qos=0 rules, a topo-private SourceNode otherwise.
     Returns the node rule chains connect to."""
     stream = load_stream_def(stream_name, store)
     props = _source_props(stream, store)
     ts_field = stream.options.timestamp if opts.is_event_time else ""
+    if project_columns is not None and ts_field:
+        project_columns = set(project_columns) | {ts_field}
 
     def build_nodes(name=src_name):
         nodes = []
@@ -466,6 +480,10 @@ def _plan_stream_source(stream_name: str, src_name: str, opts, store,
             micro_batch_rows=opts.micro_batch_rows,
             linger_ms=opts.micro_batch_linger_ms,
             buffer_length=opts.buffer_length,
+            # private pipeline: prune at decode. Shared pipelines must stay
+            # unpruned (other riders need other columns) — see the entry.
+            project_columns=(None if opts.share_source and opts.qos == 0
+                             else project_columns),
         )
         nodes.append(node)
         # per-interval latest-batch throttle (planner_source.go:146). A
@@ -502,6 +520,7 @@ def _plan_stream_source(stream_name: str, src_name: str, opts, store,
             "linger": opts.micro_batch_linger_ms,
         })
         entry = SharedEntryNode(f"{src_name}_shared",
+                                project_columns=project_columns,
                                 buffer_length=opts.buffer_length)
         topo.add_op(entry)
         topo.add_shared_source(SubTopoRef(key, build_nodes), entry)

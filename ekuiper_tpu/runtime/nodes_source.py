@@ -36,6 +36,7 @@ class SourceNode(Node):
         buffer_length: int = 1024,
         emit_batches: bool = True,
         converter=None,  # io.converters.Converter for bytes payloads
+        project_columns=None,  # column-pruning set (planner/optimizer.py)
     ) -> None:
         super().__init__(name, op_type="source", buffer_length=buffer_length)
         self.connector = connector
@@ -45,6 +46,18 @@ class SourceNode(Node):
         self.strict = cast.STRICT if strict_validation else cast.CONVERT_ALL
         self.micro_batch_rows = micro_batch_rows
         self.linger_ms = linger_ms
+        self.project_columns = (set(project_columns)
+                                if project_columns is not None else None)
+        if self.project_columns is not None and self.schema is not None \
+                and not self.schema.schemaless:
+            # restrict the declared schema too: from_tuples materializes a
+            # column per schema field, so pruning must reach it or typed
+            # streams would re-grow zero-filled columns at batch build
+            from ..data.types import Schema
+
+            self.schema = Schema(fields=[
+                f for f in self.schema.fields
+                if f.name in self.project_columns])
         self.emit_batches = emit_batches
         self._pending: List[Tuple] = []
         self._pending_lock = threading.Lock()
@@ -133,6 +146,11 @@ class SourceNode(Node):
             except cast.CastError as exc:
                 self.stats.inc_exception(str(exc))
                 return None
+        if self.project_columns is not None:
+            # column pruning (planner/optimizer.py): drop unreferenced
+            # fields before batching — smaller batches, tuples, uploads
+            t.message = {k: v for k, v in t.message.items()
+                         if k in self.project_columns}
         return t
 
     def _flush(self) -> None:

@@ -33,23 +33,39 @@ class WatermarkNode(Node):
 
     def process(self, item: Any) -> None:
         if isinstance(item, ColumnBatch):
-            rows = item.to_tuples()
+            # columnar path: late-drop by mask, order by timestamp, forward
+            # the batch WITHOUT exploding to rows (the columnar spine
+            # continues into the window operator)
+            import numpy as np
+
+            ts = item.timestamps
+            if ts is None:
+                ts = np.zeros(item.n, dtype=np.int64)
+            wm = self.max_ts - self.late_tolerance
+            keep = ts >= wm
+            n_late = int(item.n - keep.sum())
+            if n_late:
+                self.dropped += n_late
+                self.stats.inc_exception("late event dropped", n=n_late)
+                idx = np.nonzero(keep)[0]
+                item = item.take(idx)
+                ts = ts[idx]
+            if item.n:
+                self.max_ts = max(self.max_ts, int(ts.max()))
+                order = np.argsort(ts, kind="stable")
+                if not np.array_equal(order, np.arange(item.n)):
+                    item = item.take(order)
+                self.emit(item, count=item.n)
         elif isinstance(item, Row):
-            rows = [item]
+            if item.timestamp < self.max_ts - self.late_tolerance:
+                self.dropped += 1
+                self.stats.inc_exception("late event dropped")
+            else:
+                self.max_ts = max(self.max_ts, item.timestamp)
+                self.emit(item)
         else:
             self.emit(item)
             return
-        wm = self.max_ts - self.late_tolerance
-        out = []
-        for r in rows:
-            if r.timestamp < wm:
-                self.dropped += 1
-                self.stats.inc_exception("late event dropped")
-                continue
-            self.max_ts = max(self.max_ts, r.timestamp)
-            out.append(r)
-        for r in sorted(out, key=lambda t: t.timestamp):
-            self.emit(r)
         new_wm = self.max_ts - self.late_tolerance
         if new_wm > 0:
             self.broadcast(Watermark(ts=new_wm))
@@ -94,6 +110,23 @@ class WindowNode(Node):
         # event-time bookkeeping
         self._next_emit_end: Optional[int] = None
         self._timer = None
+        # event-time sliding: rows that already triggered their window
+        # (id-keyed — mutating data objects leaked state, VERDICT weak#7)
+        self._slid_ids: set = set()
+        # columnar spine: tumbling/hopping buffer ColumnBatches whole and
+        # explode to rows only at emit, only for selected rows. A window
+        # FILTER rides along when it compiles to a vectorized host closure;
+        # otherwise the row path below handles everything.
+        self._vfilter = None
+        self._use_bbuf = self.wt in (
+            ast.WindowType.TUMBLING_WINDOW, ast.WindowType.HOPPING_WINDOW)
+        if window.filter is not None and self._use_bbuf:
+            from ..sql.compiler import try_compile
+
+            self._vfilter = try_compile(window.filter, mode="host")
+            if self._vfilter is None:
+                self._use_bbuf = False
+        self.bbuf: List[ColumnBatch] = []
 
     # ----------------------------------------------------------------- open
     def on_open(self) -> None:
@@ -124,8 +157,13 @@ class WindowNode(Node):
     # --------------------------------------------------------------- ingest
     def process(self, item: Any) -> None:
         if isinstance(item, ColumnBatch):
+            if self._use_bbuf:
+                self._ingest_batch(item)
+                return
             rows: List[Row] = item.to_tuples()
         elif isinstance(item, Row):
+            # single rows (incl. JoinTuples from lookup joins) keep the row
+            # buffer; trigger paths merge it with the columnar buffer
             rows = [item]
         else:
             self.emit(item)
@@ -134,6 +172,71 @@ class WindowNode(Node):
             rows = [r for r in rows if self.ev.eval_condition(self.window.filter, r)]
         for r in rows:
             self._ingest_row(r)
+
+    # ------------------------------------------------------- columnar buffer
+    def _ingest_batch(self, batch: ColumnBatch) -> None:
+        """Tumbling/hopping: batches buffer WHOLE; no per-row work at
+        ingest. Selection/eviction happen on the timestamp arrays at
+        trigger time, and rows materialize only when a window emits."""
+        import numpy as np
+
+        if self._vfilter is not None and batch.n:
+            try:
+                mask = np.broadcast_to(np.asarray(
+                    self._vfilter(batch.columns), dtype=np.bool_),
+                    (batch.n,)).copy()
+                for c in self._vfilter.columns:
+                    # null filter columns exclude the row, matching the
+                    # row evaluator and FilterNode (nodes_ops.py)
+                    mask &= batch.is_valid(c)
+            except Exception:
+                mask = np.array([
+                    self.ev.eval_condition(self.window.filter, r)
+                    for r in batch.to_tuples()], dtype=np.bool_)
+            if not mask.all():
+                batch = batch.take(np.nonzero(mask)[0])
+        if batch.n:
+            self.bbuf.append(batch)
+
+    def _bts(self, batch: ColumnBatch):
+        import numpy as np
+
+        if batch.timestamps is None:
+            return np.zeros(batch.n, dtype=np.int64)
+        return batch.timestamps
+
+    def _bbuf_select(self, start: int, end: int) -> List[Row]:
+        """Materialize rows with start <= ts < end (ts-ordered batches)."""
+        import numpy as np
+
+        out: List[Row] = []
+        for batch in self.bbuf:
+            ts = self._bts(batch)
+            mask = (ts >= start) & (ts < end)
+            if mask.all():
+                out.extend(batch.to_tuples())
+            elif mask.any():
+                out.extend(batch.take(np.nonzero(mask)[0]).to_tuples())
+        return out
+
+    def _bbuf_evict_before(self, cutoff: int) -> None:
+        import numpy as np
+
+        kept: List[ColumnBatch] = []
+        for batch in self.bbuf:
+            ts = self._bts(batch)
+            mask = ts >= cutoff
+            if mask.all():
+                kept.append(batch)
+            elif mask.any():
+                kept.append(batch.take(np.nonzero(mask)[0]))
+        self.bbuf = kept
+
+    def _bbuf_all_rows(self) -> List[Row]:
+        out: List[Row] = []
+        for batch in self.bbuf:
+            out.extend(batch.to_tuples())
+        return out
 
     def _ingest_row(self, r: Row) -> None:
         wt = self.wt
@@ -206,13 +309,18 @@ class WindowNode(Node):
             end = trig.ts
             start = end - self.length_ms
             if wt == ast.WindowType.TUMBLING_WINDOW:
-                rows, self.buffer = self.buffer, []
+                rows = self._bbuf_all_rows() + self.buffer
+                self.bbuf = []
+                self.buffer = []
             else:
                 # windows are [start, end); the upper bound matters — a row
                 # landing in the same ms as the tick must count once (in the
                 # next window), not in both
-                rows = [r for r in self.buffer if start <= r.timestamp < end]
-                self._evict_before(end - self.length_ms + (self.interval_ms or 0))
+                rows = self._bbuf_select(start, end) + [
+                    r for r in self.buffer if start <= r.timestamp < end]
+                cutoff = end - self.length_ms + (self.interval_ms or 0)
+                self._bbuf_evict_before(cutoff)
+                self._evict_before(cutoff)
             self._emit_window(rows, WindowRange(start, end))
             self._schedule_next_tick()
             return
@@ -247,26 +355,32 @@ class WindowNode(Node):
             if self._next_emit_end is None:
                 # first window end at the next aligned boundary past the
                 # earliest buffered event
-                if not self.buffer:
+                candidates = [int(self._bts(b).min())
+                              for b in self.bbuf if b.n]
+                candidates += [r.timestamp for r in self.buffer]
+                if not candidates:
                     self.broadcast(wm)
                     return
-                first_ts = min(r.timestamp for r in self.buffer)
-                self._next_emit_end = timex.align_to_window(first_ts + 1, interval)
+                self._next_emit_end = timex.align_to_window(
+                    min(candidates) + 1, interval)
             while self._next_emit_end is not None and wm.ts >= self._next_emit_end:
                 end = self._next_emit_end
                 start = end - self.length_ms
                 # [start, end): row at exactly `end` opens the next window
-                rows = [r for r in self.buffer if start <= r.timestamp < end]
-                if wt == ast.WindowType.TUMBLING_WINDOW:
-                    self.buffer = [r for r in self.buffer if r.timestamp >= end]
-                else:
-                    self._evict_before(end - self.length_ms + interval)
+                rows = self._bbuf_select(start, end) + [
+                    r for r in self.buffer if start <= r.timestamp < end]
+                cutoff = (end if wt == ast.WindowType.TUMBLING_WINDOW
+                          else end - self.length_ms + interval)
+                self._bbuf_evict_before(cutoff)
+                self._evict_before(cutoff)
                 self._emit_window(rows, WindowRange(start, end))
                 self._next_emit_end = end + interval
         elif wt == ast.WindowType.SLIDING_WINDOW:
-            # trigger one window per event whose (ts + delay) has passed
+            # trigger one window per event whose (ts + delay) has passed;
+            # already-triggered rows tracked by identity, not by mutating
+            # the data objects
             ready = [r for r in self.buffer if r.timestamp + self.delay_ms <= wm.ts
-                     and not getattr(r, "_slid", False)]
+                     and id(r) not in self._slid_ids]
             for r in ready:
                 t0 = r.timestamp
                 rows = [
@@ -279,8 +393,9 @@ class WindowNode(Node):
                     self._emit_window(
                         rows, WindowRange(t0 - self.length_ms, t0 + self.delay_ms)
                     )
-                setattr(r, "_slid", True)
+                self._slid_ids.add(id(r))
             self._evict_before(wm.ts - self.length_ms - self.delay_ms)
+            self._slid_ids &= {id(r) for r in self.buffer}
         elif wt == ast.WindowType.SESSION_WINDOW:
             timeout = self.interval_ms or self.length_ms
             self.buffer.sort(key=lambda r: r.timestamp)
@@ -304,12 +419,12 @@ class WindowNode(Node):
 
     def on_eof(self, eof: EOF) -> None:
         # flush whatever is buffered (trial/bounded runs)
-        if self.buffer:
+        rows = list(self.buffer) + self._bbuf_all_rows()
+        if rows:
             now = timex.now_ms()
-            self._emit_window(
-                list(self.buffer), WindowRange(now - self.length_ms, now)
-            )
+            self._emit_window(rows, WindowRange(now - self.length_ms, now))
             self.buffer = []
+            self.bbuf = []
         self.broadcast(eof)
 
     # ----------------------------------------------------------------- emit
@@ -325,10 +440,12 @@ class WindowNode(Node):
 
     # ----------------------------------------------------------------- state
     def snapshot_state(self) -> Optional[dict]:
+        rows = [r for r in self.buffer if isinstance(r, Tuple)]
+        rows += [r for r in self._bbuf_all_rows() if isinstance(r, Tuple)]
         return {
             "buffer": [
                 {"message": r.message, "timestamp": r.timestamp, "emitter": r.emitter}
-                for r in self.buffer if isinstance(r, Tuple)
+                for r in rows
             ],
             "rows_since_emit": self._rows_since_emit,
             "state_open": self._state_open,
@@ -336,11 +453,24 @@ class WindowNode(Node):
         }
 
     def restore_state(self, state: dict) -> None:
-        self.buffer = [
+        restored = [
             Tuple(emitter=d.get("emitter", ""), message=d["message"],
                   timestamp=d["timestamp"])
             for d in state.get("buffer", [])
         ]
+        if self._use_bbuf and restored:
+            from ..data.batch import from_tuples
+
+            # one batch per emitter: joins match rows by emitter, and a
+            # single batch can only stamp one
+            by_emitter: dict = {}
+            for r in restored:
+                by_emitter.setdefault(r.emitter, []).append(r)
+            self.bbuf = [from_tuples(rows, emitter=em)
+                         for em, rows in by_emitter.items()]
+            self.buffer = []
+        else:
+            self.buffer = restored
         self._rows_since_emit = state.get("rows_since_emit", 0)
         self._state_open = state.get("state_open", False)
         self._next_emit_end = state.get("next_emit_end")

@@ -139,12 +139,45 @@ class SrcSubTopo:
 class SharedEntryNode(Node):
     """Per-rule entry behind a shared source: a pass-through hop that gives
     the rule its own queue (backpressure isolation — one slow rule drops its
-    own oldest items, reference subtopo semantics) and its own stats."""
+    own oldest items, reference subtopo semantics) and its own stats.
 
-    def __init__(self, name: str, **kw) -> None:
+    Column pruning happens HERE for shared sources: the pooled pipeline
+    serves rules with different column needs, so each rule prunes its own
+    copy of the stream (planner/optimizer.py)."""
+
+    def __init__(self, name: str, project_columns=None, **kw) -> None:
         super().__init__(name, op_type="op", **kw)
+        self.project_columns = (set(project_columns)
+                                if project_columns is not None else None)
 
     def process(self, item: Any) -> None:
+        cols = self.project_columns
+        if cols is not None:
+            from ..data.batch import ColumnBatch
+            from ..data.rows import Tuple as Row
+
+            if isinstance(item, ColumnBatch) and not (
+                set(item.columns) <= cols
+            ):
+                item = ColumnBatch(
+                    n=item.n,
+                    columns={k: v for k, v in item.columns.items()
+                             if k in cols},
+                    valid={k: v for k, v in item.valid.items() if k in cols},
+                    timestamps=item.timestamps, emitter=item.emitter,
+                )
+            elif isinstance(item, Row) and not (
+                set(item.message) <= cols
+            ):
+                # COPY, never mutate: the shared tail broadcasts the same
+                # object to every rider, each with its own pruning set
+                item = Row(
+                    emitter=item.emitter,
+                    message={k: v for k, v in item.message.items()
+                             if k in cols},
+                    timestamp=item.timestamp,
+                    metadata=getattr(item, "metadata", None) or {},
+                )
         self.emit(item)
 
 

@@ -55,9 +55,9 @@ class StatManager:
         with self._lock:
             self.messages_processed += n
 
-    def inc_exception(self, err: str) -> None:
+    def inc_exception(self, err: str, n: int = 1) -> None:
         with self._lock:
-            self.exceptions += 1
+            self.exceptions += n
             self.last_exception = err
             self.last_exception_time = timex.now_ms()
 

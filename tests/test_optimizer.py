@@ -1,0 +1,81 @@
+"""Column pruning (planner/optimizer.py) through the planner and runtime."""
+import time
+
+from ekuiper_tpu.planner.optimizer import referenced_columns
+from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+from ekuiper_tpu.server.processors import StreamProcessor
+from ekuiper_tpu.sql.parser import parse_select
+from ekuiper_tpu.store import kv
+import ekuiper_tpu.io.memory as mem
+
+
+class TestReferencedColumns:
+    def test_collects_all_clauses(self):
+        stmt = parse_select(
+            "SELECT a, avg(b) AS x FROM s WHERE c > 1 "
+            "GROUP BY a, TUMBLINGWINDOW(ss, 10) HAVING avg(b) > 2 "
+            "ORDER BY d")
+        assert referenced_columns(stmt) == {"a", "b", "c", "d"}
+
+    def test_wildcard_disables(self):
+        assert referenced_columns(parse_select("SELECT * FROM s")) is None
+
+    def test_count_star_is_fine(self):
+        stmt = parse_select(
+            "SELECT count(*) AS c, a FROM s GROUP BY a, TUMBLINGWINDOW(ss, 5)")
+        assert referenced_columns(stmt) == {"a"}
+
+    def test_join_on_included(self):
+        stmt = parse_select(
+            "SELECT l.a FROM l INNER JOIN r ON l.k = r.k2 "
+            "GROUP BY TUMBLINGWINDOW(ss, 5)")
+        assert referenced_columns(stmt) == {"a", "k", "k2"}
+
+
+class TestPruningE2E:
+    def _run(self, sql, row, options=None):
+        store = kv.get_store()
+        StreamProcessor(store).exec_stmt(
+            'CREATE STREAM demo () '
+            'WITH (DATASOURCE="pr/demo", TYPE="memory", FORMAT="JSON")')
+        topo = plan_rule(RuleDef(
+            id="pr1", sql=sql, actions=[{"memory": {"topic": "pr/out"}}],
+            options=options or {}), store)
+        got = []
+        mem.subscribe("pr/out", lambda t, p: got.append(p))
+        topo.open()
+        try:
+            mem.publish("pr/demo", row)
+            from ekuiper_tpu.utils import timex
+
+            timex.get_mock_clock().advance(20)
+            deadline = time.time() + 5
+            while time.time() < deadline and not got:
+                time.sleep(0.02)
+        finally:
+            topo.close()
+        out = []
+        for p in got:
+            out.extend(p if isinstance(p, list) else [p])
+        return out, topo
+
+    def test_shared_entry_prunes(self, mock_clock):
+        row = {"a": 1, "b": 2.5, "noise1": "x" * 100, "noise2": [1, 2, 3]}
+        out, topo = self._run("SELECT a, b FROM demo WHERE b > 1", row)
+        assert out == [{"a": 1, "b": 2.5}]
+        entry = next(n for n in topo.ops if n.name.endswith("_shared"))
+        assert entry.project_columns == {"a", "b"}
+
+    def test_private_source_prunes(self, mock_clock):
+        row = {"a": 7, "junk": "drop me"}
+        out, topo = self._run("SELECT a FROM demo", row,
+                              options={"share_source": False})
+        assert out == [{"a": 7}]
+        assert topo.sources[0].project_columns == {"a"}
+
+    def test_select_star_keeps_everything(self, mock_clock):
+        row = {"a": 1, "keep": "yes"}
+        out, topo = self._run("SELECT * FROM demo", row)
+        assert out and out[0] == row
+        entry = next(n for n in topo.ops if n.name.endswith("_shared"))
+        assert entry.project_columns is None

@@ -275,3 +275,63 @@ class TestFusedHopping:
         finally:
             topo.close()
             mem.reset()
+
+
+class TestColumnarBuffer:
+    """Tumbling/hopping windows keep ColumnBatches whole until emit
+    (columnar spine through the host window path)."""
+
+    def _batch(self, vals, ts0=1000):
+        import numpy as np
+        from ekuiper_tpu.data.batch import ColumnBatch
+
+        n = len(vals)
+        return ColumnBatch(
+            n=n,
+            columns={"v": np.asarray(vals, dtype=np.float32)},
+            timestamps=np.arange(ts0, ts0 + n, dtype=np.int64),
+            emitter="s")
+
+    def test_batches_stay_columnar_until_trigger(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT v FROM s GROUP BY TUMBLINGWINDOW(ss, 10)"))
+        h = Harness(node)
+        node.process(self._batch([1, 2, 3]))
+        node.process(self._batch([4, 5], ts0=2000))
+        assert node._use_bbuf and len(node.bbuf) == 2
+        assert node.buffer == []  # nothing exploded at ingest
+        mock_clock.advance(10_000)
+        assert [r.message["v"] for r in h.emitted[0].rows()] == \
+            [1, 2, 3, 4, 5]
+        assert node.bbuf == []
+
+    def test_vectorized_window_filter(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT v FROM s GROUP BY TUMBLINGWINDOW(ss, 10) "
+            "FILTER (WHERE v > 2)"))
+        assert node._use_bbuf and node._vfilter is not None
+        h = Harness(node)
+        node.process(self._batch([1, 2, 3, 4]))
+        mock_clock.advance(10_000)
+        assert [r.message["v"] for r in h.emitted[0].rows()] == [3, 4]
+
+    def test_hopping_columnar_selection(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT v FROM s GROUP BY HOPPINGWINDOW(ss, 10, 5)"))
+        assert node._use_bbuf
+        h = Harness(node)
+        node.process(self._batch([1, 2], ts0=1000))
+        mock_clock.advance(5_000)   # first hop
+        mock_clock.advance(5_000)   # second hop: rows still in [0,10s)
+        assert len(h.emitted) >= 2
+        assert [r.message["v"] for r in h.emitted[1].rows()] == [1, 2]
+
+    def test_mixed_rows_and_batches_merge(self, mock_clock):
+        node = WindowNode("w", window_of(
+            "SELECT v FROM s GROUP BY TUMBLINGWINDOW(ss, 10)"))
+        h = Harness(node)
+        node.process(self._batch([1]))
+        h.feed({"v": 99}, ts=2000)  # single row -> row buffer
+        assert len(node.bbuf) == 1 and len(node.buffer) == 1
+        mock_clock.advance(10_000)
+        assert sorted(r.message["v"] for r in h.emitted[0].rows()) == [1, 99]
