@@ -1,8 +1,9 @@
 """MQTT source & sink — analogue of eKuiper's internal/io/mqtt (paho v4/v5
 clients with a refcounted shared connection, pkg/connection/conn.go:28-137).
 
-Requires paho-mqtt; the registry gates registration on its availability,
-mirroring the reference's build-tag gating of optional connectors.
+Uses paho-mqtt when installed; otherwise the bundled native MQTT 3.1.1
+client (io/mqtt_native.py, same subset API) — MQTT must work out of the
+box, it is the reference's flagship ingest protocol.
 """
 from __future__ import annotations
 
@@ -10,7 +11,10 @@ import json
 import threading
 from typing import Any, Dict, Optional, Tuple
 
-import paho.mqtt.client as mqtt  # gated import — see io/registry.py
+try:
+    import paho.mqtt.client as mqtt
+except ImportError:
+    from . import mqtt_native as mqtt
 
 from ..utils.infra import EngineError, logger
 from .contract import Sink, Source

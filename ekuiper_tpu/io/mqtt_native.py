@@ -1,0 +1,263 @@
+"""Minimal native MQTT 3.1.1 client — no client library required.
+
+MQTT is the reference's flagship protocol (its headline benchmarks are all
+MQTT ingest), so it must work out of the box; paho is preferred when
+installed (io/mqtt.py), and this module supplies a drop-in subset of paho's
+Client API otherwise (io/registry.py picks whichever imports).
+
+Implements the client side of MQTT 3.1.1 (OASIS spec):
+CONNECT/CONNACK, SUBSCRIBE/SUBACK, UNSUBSCRIBE, PUBLISH qos0/qos1 (incoming
+qos1 is PUBACK'd; outgoing qos1 is fire-and-track), PINGREQ keepalive,
+DISCONNECT. TLS and qos2 are not implemented (the reference's benchmarks use
+qos0/1 plaintext).
+"""
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.infra import logger
+
+MQTT_ERR_SUCCESS = 0
+
+CONNECT, CONNACK = 0x10, 0x20
+PUBLISH, PUBACK = 0x30, 0x40
+SUBSCRIBE, SUBACK = 0x82, 0x90
+UNSUBSCRIBE, UNSUBACK = 0xA2, 0xB0
+PINGREQ, PINGRESP = 0xC0, 0xD0
+DISCONNECT = 0xE0
+
+
+def encode_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n % 128
+        n //= 128
+        out.append(b | 0x80 if n else b)
+        if not n:
+            return bytes(out)
+
+
+def encode_str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack(">H", len(b)) + b
+
+
+def topic_matches(filt: str, topic: str) -> bool:
+    """MQTT topic filter matching (+ single level, # multi level)."""
+    fparts, tparts = filt.split("/"), topic.split("/")
+    for i, fp in enumerate(fparts):
+        if fp == "#":
+            return True
+        if i >= len(tparts):
+            return False
+        if fp != "+" and fp != tparts[i]:
+            return False
+    return len(fparts) == len(tparts)
+
+
+class _Msg:
+    __slots__ = ("topic", "payload", "qos", "mid")
+
+    def __init__(self, topic: str, payload: bytes, qos: int, mid: int) -> None:
+        self.topic = topic
+        self.payload = payload
+        self.qos = qos
+        self.mid = mid
+
+
+class _PublishInfo:
+    def __init__(self, rc: int = MQTT_ERR_SUCCESS) -> None:
+        self.rc = rc
+
+
+class Client:
+    """paho-shaped subset over a raw socket."""
+
+    def __init__(self, client_id: str = "") -> None:
+        self.client_id = client_id or f"ektpu-{int(time.time() * 1000) & 0xFFFFFF:x}"
+        self._user = ""
+        self._pass = ""
+        self._sock: Optional[socket.socket] = None
+        self._wlock = threading.Lock()
+        self._mids = itertools.count(1)
+        self._callbacks: List[Tuple[str, Callable]] = []
+        self._subs: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._connack = threading.Event()
+        self._keepalive = 60
+        self._host, self._port = "127.0.0.1", 1883
+        self.on_message: Optional[Callable] = None
+
+    # ------------------------------------------------------------- paho API
+    def username_pw_set(self, username: str, password: str = "") -> None:
+        self._user, self._pass = username, password or ""
+
+    def connect(self, host: str, port: int = 1883, keepalive: int = 60) -> None:
+        self._host, self._port, self._keepalive = host, port, keepalive
+        self._dial()
+
+    def _dial(self) -> None:
+        self._sock = socket.create_connection((self._host, self._port),
+                                              timeout=10)
+        flags = 0x02  # clean session
+        payload = encode_str(self.client_id)
+        if self._user:
+            flags |= 0x80
+            payload += encode_str(self._user)
+            if self._pass:
+                flags |= 0x40
+                payload += encode_str(self._pass)
+        var = (encode_str("MQTT") + bytes([4, flags])
+               + struct.pack(">H", self._keepalive))
+        self._send_packet(CONNECT, var + payload)
+        # CONNACK read inline (loop thread not started yet on first dial)
+        typ, body = self._read_packet()
+        if typ != CONNACK or len(body) < 2 or body[1] != 0:
+            raise ConnectionError(f"mqtt connect refused: {body!r}")
+        self._connack.set()
+
+    def loop_start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="mqtt-native")
+        self._thread.start()
+
+    def loop_stop(self) -> None:
+        self._stop.set()
+
+    def disconnect(self) -> None:
+        self._stop.set()
+        try:
+            self._send_packet(DISCONNECT, b"")
+        except Exception:
+            pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def subscribe(self, topic: str, qos: int = 0) -> Tuple[int, int]:
+        mid = next(self._mids)
+        self._subs[topic] = qos
+        self._send_packet(SUBSCRIBE,
+                          struct.pack(">H", mid) + encode_str(topic)
+                          + bytes([qos]))
+        return MQTT_ERR_SUCCESS, mid
+
+    def unsubscribe(self, topic: str) -> None:
+        self._subs.pop(topic, None)
+        mid = next(self._mids)
+        self._send_packet(UNSUBSCRIBE,
+                          struct.pack(">H", mid) + encode_str(topic))
+
+    def message_callback_add(self, topic_filter: str, cb: Callable) -> None:
+        self._callbacks.append((topic_filter, cb))
+
+    def message_callback_remove(self, topic_filter: str) -> None:
+        self._callbacks = [(f, c) for f, c in self._callbacks
+                           if f != topic_filter]
+
+    def publish(self, topic: str, payload: Any = b"", qos: int = 0,
+                retain: bool = False) -> _PublishInfo:
+        if isinstance(payload, str):
+            payload = payload.encode()
+        payload = bytes(payload or b"")
+        flags = (qos << 1) | (1 if retain else 0)
+        var = encode_str(topic)
+        if qos > 0:
+            var += struct.pack(">H", next(self._mids) & 0xFFFF or 1)
+        try:
+            self._send_packet(PUBLISH | flags, var + payload)
+            return _PublishInfo(MQTT_ERR_SUCCESS)
+        except Exception as exc:
+            logger.warning("mqtt publish failed: %s", exc)
+            return _PublishInfo(1)
+
+    # ---------------------------------------------------------------- wire
+    def _send_packet(self, first: int, body: bytes) -> None:
+        with self._wlock:
+            if self._sock is None:
+                raise ConnectionError("mqtt not connected")
+            self._sock.sendall(bytes([first]) + encode_varint(len(body)) + body)
+
+    def _read_exact(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self._sock.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("mqtt connection closed")
+            out += chunk
+        return out
+
+    def _read_packet(self) -> Tuple[int, bytes]:
+        first = self._read_exact(1)[0]
+        mult, length = 1, 0
+        while True:
+            b = self._read_exact(1)[0]
+            length += (b & 0x7F) * mult
+            if not (b & 0x80):
+                break
+            mult *= 128
+        return first, self._read_exact(length) if length else b""
+
+    def _loop(self) -> None:
+        last_ping = time.monotonic()
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(1.0)
+                try:
+                    typ, body = self._read_packet()
+                except socket.timeout:
+                    if time.monotonic() - last_ping > self._keepalive / 2:
+                        self._send_packet(PINGREQ, b"")
+                        last_ping = time.monotonic()
+                    continue
+                self._handle(typ, body)
+            except Exception as exc:
+                if self._stop.is_set():
+                    return
+                logger.warning("mqtt reconnect: %s", exc)
+                self._reconnect()
+
+    def _reconnect(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._dial()
+                for topic, qos in list(self._subs.items()):
+                    self.subscribe(topic, qos)
+                return
+            except Exception:
+                self._stop.wait(1.0)
+
+    def _handle(self, typ: int, body: bytes) -> None:
+        kind = typ & 0xF0
+        if kind == PUBLISH:
+            qos = (typ >> 1) & 0x03
+            tlen = struct.unpack(">H", body[:2])[0]
+            topic = body[2:2 + tlen].decode("utf-8", errors="replace")
+            pos = 2 + tlen
+            mid = 0
+            if qos > 0:
+                mid = struct.unpack(">H", body[pos:pos + 2])[0]
+                pos += 2
+                self._send_packet(PUBACK, struct.pack(">H", mid))
+            msg = _Msg(topic, body[pos:], qos, mid)
+            for filt, cb in list(self._callbacks):
+                if topic_matches(filt, topic):
+                    try:
+                        cb(self, None, msg)
+                    except Exception as exc:
+                        logger.warning("mqtt callback error: %s", exc)
+            if self.on_message is not None:
+                try:
+                    self.on_message(self, None, msg)
+                except Exception as exc:
+                    logger.warning("mqtt on_message error: %s", exc)
+        # CONNACK/SUBACK/UNSUBACK/PUBACK/PINGRESP need no action here

@@ -107,15 +107,12 @@ def _ensure() -> None:
 
     register_lookup("httppull", HttpLookupSource)
 
-    # mqtt needs the paho client — optional, gated like the reference's
-    # build-tag connectors (internal/binder/io/ext_*.go)
-    try:
-        from .mqtt import MqttSink, MqttSource
+    # mqtt always registers: paho when installed, else the bundled native
+    # MQTT 3.1.1 client (io/mqtt_native.py)
+    from .mqtt import MqttSink, MqttSource
 
-        register_source("mqtt", MqttSource)
-        register_sink("mqtt", MqttSink)
-    except ImportError:
-        pass
+    register_source("mqtt", MqttSource)
+    register_sink("mqtt", MqttSink)
 
     # websocket needs the `websockets` package — optional, same gating
     try:
@@ -128,9 +125,40 @@ def _ensure() -> None:
 
     from .neuron import NeuronSink, NeuronSource
     from .redis_io import RedisLookupSource, RedisSink, RedisSubSource
+    from .sql_io import SqlLookupSource, SqlSink, SqlSource
 
     register_source("redissub", RedisSubSource)
     register_sink("redis", RedisSink)
     register_lookup("redis", RedisLookupSource)
     register_source("neuron", NeuronSource)
     register_sink("neuron", NeuronSink)
+    register_source("sql", SqlSource)
+    register_sink("sql", SqlSink)
+    register_lookup("sql", SqlLookupSource)
+
+    # connectors whose client libraries are not bundled register a factory
+    # that raises a clear error (the reference gates these behind build
+    # tags; a missing build tag gives the same "not compiled in" experience)
+    from ..utils.infra import EngineError
+
+    def _gated(kind: str, pkg: str):
+        class _Gated:
+            def __init__(self):
+                raise EngineError(
+                    f"{kind} connector requires the {pkg} package, which is "
+                    "not bundled in this image")
+
+        return _Gated
+
+    for kind, pkg, has_src, has_sink in (
+        ("kafka", "kafka-python", True, True),
+        ("influx", "influxdb-client", False, True),
+        ("influx2", "influxdb-client", False, True),
+        ("zmq", "pyzmq", True, True),
+        ("edgex", "edgex message bus client", True, True),
+        ("video", "opencv-python", True, False),
+    ):
+        if has_src:
+            register_source(kind, _gated(kind, pkg))
+        if has_sink:
+            register_sink(kind, _gated(kind, pkg))

@@ -1,0 +1,194 @@
+"""SQL database source/sink/lookup (analogue of the reference's
+extensions/sql plugin family: sqlsource, sqlsink, sql lookup).
+
+The driver seam is DB-API 2.0: any module exposing connect() works. The
+bundled driver is sqlite3 (stdlib) via url "sqlite://<path>"; other
+databases plug in through the `driver` prop naming an importable DB-API
+module plus a `dsn` (the reference gates its many drivers behind build tags
+the same way).
+
+Source: polls `SELECT ... ` every `interval` ms. With a `trackingColumn`
+(indexedField in the reference) only rows beyond the last seen value are
+fetched, and the offset participates in rewind (Rewindable contract).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..utils.infra import EngineError, logger
+from .contract import LookupSource, Sink, Source
+
+
+def _connect(props: Dict[str, Any]):
+    url = props.get("url", "")
+    if url.startswith("sqlite://"):
+        import sqlite3
+
+        conn = sqlite3.connect(url[len("sqlite://"):], check_same_thread=False)
+        conn.row_factory = sqlite3.Row
+        return conn, "?"
+    driver = props.get("driver", "")
+    if not driver:
+        raise EngineError(
+            "sql io requires url 'sqlite://<path>' or a DB-API `driver` "
+            "module name + `dsn`")
+    import importlib
+
+    mod = importlib.import_module(driver)
+    return mod.connect(props.get("dsn", "")), props.get("paramstyle", "%s")
+
+
+def _rows_to_dicts(cur, rows) -> List[Dict[str, Any]]:
+    names = [d[0] for d in cur.description or []]
+    out = []
+    for row in rows:
+        try:
+            out.append(dict(row))  # sqlite3.Row supports mapping
+        except (TypeError, ValueError):
+            out.append(dict(zip(names, row)))
+    return out
+
+
+class SqlSource(Source):
+    """Polling query source with optional incremental tracking column."""
+
+    def __init__(self) -> None:
+        self.props: Dict[str, Any] = {}
+        self.query = ""
+        self.interval_ms = 1000
+        self.tracking: str = ""
+        self._offset: Any = None
+        self._stop = threading.Event()
+        self._conn = None
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.props = props
+        table = datasource or props.get("table", "")
+        self.query = props.get("query") or (f"SELECT * FROM {table}"
+                                            if table else "")
+        if not self.query:
+            raise EngineError("sql source requires a table or query")
+        self.interval_ms = int(props.get("interval", 1000))
+        self.tracking = props.get("trackingColumn", "")
+        self._offset = props.get("startValue")
+
+    def open(self, ingest) -> None:
+        self._stop.clear()
+        threading.Thread(target=self._loop, args=(ingest,), daemon=True,
+                         name="sql-source").start()
+
+    def _loop(self, ingest) -> None:
+        conn, ph = None, "?"
+        while not self._stop.is_set():
+            try:
+                if conn is None:
+                    conn, ph = _connect(self.props)
+                    self._conn = conn
+                q, args = self.query, ()
+                if self.tracking:
+                    order = f" ORDER BY {self.tracking}"
+                    if self._offset is not None:
+                        q += (f" WHERE {self.tracking} > {ph}" + order)
+                        args = (self._offset,)
+                    else:
+                        q += order
+                cur = conn.cursor()
+                cur.execute(q, args)
+                rows = _rows_to_dicts(cur, cur.fetchall())
+                if rows:
+                    if self.tracking:
+                        self._offset = rows[-1].get(self.tracking,
+                                                    self._offset)
+                    ingest(rows)
+            except Exception as exc:
+                if self._stop.is_set():
+                    return
+                logger.warning("sql source poll error: %s", exc)
+                conn = None
+            self._stop.wait(self.interval_ms / 1000.0)
+
+    # Rewindable (io/contract.py)
+    def get_offset(self) -> Any:
+        return self._offset
+
+    def rewind(self, offset: Any) -> None:
+        self._offset = offset
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
+
+
+class SqlSink(Sink):
+    """INSERTs result rows into a table; columns from the row keys (or the
+    `fields` prop for a fixed column list)."""
+
+    def __init__(self) -> None:
+        self.props: Dict[str, Any] = {}
+        self.table = ""
+        self._conn = None
+        self._ph = "?"
+
+    def configure(self, props: Dict[str, Any]) -> None:
+        self.props = props
+        self.table = props.get("table", "")
+        if not self.table:
+            raise EngineError("sql sink requires a table")
+
+    def connect(self) -> None:
+        self._conn, self._ph = _connect(self.props)
+
+    def collect(self, item: Any) -> None:
+        rows = item if isinstance(item, list) else [item]
+        fields = self.props.get("fields")
+        cur = self._conn.cursor()
+        for row in rows:
+            if not isinstance(row, dict):
+                raise EngineError("sql sink rows must be objects")
+            cols = fields or list(row.keys())
+            placeholders = ", ".join([self._ph] * len(cols))
+            cur.execute(
+                f"INSERT INTO {self.table} ({', '.join(cols)}) "
+                f"VALUES ({placeholders})",
+                tuple(row.get(c) for c in cols))
+        self._conn.commit()
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+
+
+class SqlLookupSource(LookupSource):
+    def __init__(self) -> None:
+        self.props: Dict[str, Any] = {}
+        self.table = ""
+        self._conn = None
+        self._ph = "?"
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.props = props
+        self.table = datasource or props.get("table", "")
+        if not self.table:
+            raise EngineError("sql lookup requires a table")
+
+    def open(self) -> None:
+        self._conn, self._ph = _connect(self.props)
+
+    def lookup(self, fields, keys, values) -> List[Dict[str, Any]]:
+        where = " AND ".join(f"{k} = {self._ph}" for k in keys)
+        sel = ", ".join(fields) if fields else "*"
+        cur = self._conn.cursor()
+        cur.execute(
+            f"SELECT {sel} FROM {self.table}"
+            + (f" WHERE {where}" if where else ""),
+            tuple(values))
+        return _rows_to_dicts(cur, cur.fetchall())
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()

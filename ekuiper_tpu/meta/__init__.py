@@ -1,0 +1,7 @@
+"""UI metadata for sources/sinks/functions (analogue of internal/meta —
+the reference serves curated JSON files for its management console; here
+the metadata derives from the live registries plus curated property hints,
+so it can never drift from what the engine actually accepts)."""
+from .catalog import (  # noqa: F401
+    describe_function, describe_sink, describe_source, list_functions,
+    list_sinks, list_sources)

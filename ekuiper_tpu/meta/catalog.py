@@ -1,0 +1,183 @@
+"""Connector + function metadata catalog.
+
+list_* enumerate what the registries can actually build; describe_* add
+curated property documentation (the `about`/`properties` shape the
+reference's meta JSON files use) so a management UI can render config
+forms. Unknown-but-registered connectors still describe with an empty
+property list — metadata presence never gates usage."""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ..utils.infra import EngineError
+
+_COMMON_SOURCE_PROPS = [
+    {"name": "datasource", "type": "string", "hint": "topic/path/table"},
+    {"name": "format", "type": "string", "default": "json",
+     "hint": "json|binary|delimited|urlencoded|protobuf"},
+    {"name": "confKey", "type": "string",
+     "hint": "named config profile (source_conf overlay)"},
+]
+
+_SOURCE_PROPS: Dict[str, List[Dict[str, Any]]] = {
+    "mqtt": [
+        {"name": "server", "type": "string", "default": "tcp://127.0.0.1:1883"},
+        {"name": "qos", "type": "int", "default": 1},
+        {"name": "username", "type": "string"},
+        {"name": "password", "type": "string", "secret": True},
+    ],
+    "httppull": [
+        {"name": "url", "type": "string"},
+        {"name": "interval", "type": "int", "default": 1000},
+        {"name": "method", "type": "string", "default": "GET"},
+    ],
+    "httppush": [
+        {"name": "endpoint", "type": "string"},
+        {"name": "port", "type": "int", "default": 10081},
+    ],
+    "websocket": [
+        {"name": "addr", "type": "string",
+         "hint": "client mode ws://host:port/path; empty = server mode"},
+        {"name": "port", "type": "int", "default": 10081},
+    ],
+    "redissub": [
+        {"name": "addr", "type": "string", "default": "127.0.0.1:6379"},
+        {"name": "channels", "type": "string"},
+        {"name": "password", "type": "string", "secret": True},
+        {"name": "db", "type": "int", "default": 0},
+    ],
+    "neuron": [
+        {"name": "url", "type": "string", "default": "ipc://neuron-ekuiper"},
+    ],
+    "sql": [
+        {"name": "url", "type": "string", "hint": "sqlite://<path>"},
+        {"name": "interval", "type": "int", "default": 1000},
+        {"name": "trackingColumn", "type": "string"},
+    ],
+    "file": [
+        {"name": "path", "type": "string"},
+        {"name": "fileType", "type": "string", "default": "json"},
+        {"name": "interval", "type": "int", "default": 0},
+    ],
+    "memory": [{"name": "datasource", "type": "string", "hint": "topic"}],
+    "simulator": [
+        {"name": "data", "type": "list"},
+        {"name": "interval", "type": "int", "default": 1000},
+        {"name": "loop", "type": "bool", "default": True},
+    ],
+}
+
+_SINK_PROPS: Dict[str, List[Dict[str, Any]]] = {
+    "mqtt": _SOURCE_PROPS["mqtt"] + [{"name": "topic", "type": "string"}],
+    "rest": [
+        {"name": "url", "type": "string"},
+        {"name": "method", "type": "string", "default": "POST"},
+        {"name": "headers", "type": "map"},
+    ],
+    "redis": [
+        {"name": "addr", "type": "string", "default": "127.0.0.1:6379"},
+        {"name": "key", "type": "string"},
+        {"name": "field", "type": "string", "hint": "row field as key"},
+        {"name": "channel", "type": "string", "hint": "PUBLISH instead"},
+        {"name": "dataType", "type": "string", "default": "string"},
+        {"name": "expiration", "type": "int"},
+    ],
+    "websocket": _SOURCE_PROPS["websocket"],
+    "neuron": [
+        {"name": "url", "type": "string", "default": "ipc://neuron-ekuiper"},
+        {"name": "nodeName", "type": "string"},
+        {"name": "groupName", "type": "string"},
+        {"name": "tags", "type": "list"},
+        {"name": "raw", "type": "bool", "default": False},
+    ],
+    "sql": [
+        {"name": "url", "type": "string", "hint": "sqlite://<path>"},
+        {"name": "table", "type": "string"},
+        {"name": "fields", "type": "list"},
+    ],
+    "file": [{"name": "path", "type": "string"}],
+    "memory": [{"name": "topic", "type": "string"}],
+    "log": [],
+    "nop": [],
+}
+
+_COMMON_SINK_PROPS = [
+    {"name": "batchSize", "type": "int", "default": 0},
+    {"name": "lingerInterval", "type": "int", "default": 0},
+    {"name": "dataTemplate", "type": "string"},
+    {"name": "fields", "type": "list"},
+    {"name": "sendSingle", "type": "bool", "default": False},
+    {"name": "format", "type": "string", "default": "json"},
+    {"name": "compression", "type": "string"},
+    {"name": "encryption", "type": "string"},
+    {"name": "enableCache", "type": "bool", "default": False},
+    {"name": "retryCount", "type": "int", "default": 0},
+]
+
+
+def list_sources() -> List[str]:
+    from ..io import registry
+
+    registry._ensure()
+    return sorted(registry._sources.keys())
+
+
+def list_sinks() -> List[str]:
+    from ..io import registry
+
+    registry._ensure()
+    return sorted(registry._sinks.keys())
+
+
+def describe_source(name: str) -> Dict[str, Any]:
+    if name not in list_sources():
+        raise EngineError(f"source {name} not found")
+    return {
+        "name": name,
+        "about": {"description": f"{name} stream source"},
+        "properties": _COMMON_SOURCE_PROPS + _SOURCE_PROPS.get(name, []),
+        "lookup": _has_lookup(name),
+    }
+
+
+def describe_sink(name: str) -> Dict[str, Any]:
+    if name not in list_sinks():
+        raise EngineError(f"sink {name} not found")
+    return {
+        "name": name,
+        "about": {"description": f"{name} sink"},
+        "properties": _SINK_PROPS.get(name, []) + _COMMON_SINK_PROPS,
+    }
+
+
+def _has_lookup(name: str) -> bool:
+    from ..io import registry
+
+    return name in registry._lookups
+
+
+def list_functions() -> Dict[str, List[str]]:
+    """Function names grouped by kind (the reference groups by source file
+    for its UI tabs)."""
+    from ..functions import registry as fn
+
+    fn._ensure_loaded()
+    out: Dict[str, List[str]] = {}
+    for name, fd in sorted(fn._registry.items()):
+        out.setdefault(fd.ftype, []).append(name)
+    return out
+
+
+def describe_function(name: str) -> Dict[str, Any]:
+    from ..functions import registry as fn
+
+    fd = fn.lookup(name)
+    if fd is None:
+        raise EngineError(f"function {name} not found")
+    return {
+        "name": fd.name,
+        "type": fd.ftype,
+        "vectorized": fd.vexec is not None,
+        "incremental": fd.inc_name or None,
+        "stateful": fd.stateful,
+    }

@@ -28,11 +28,8 @@ def referenced_columns(stmt: ast.SelectStatement) -> Optional[Set[str]]:
     for f in stmt.fields:
         if isinstance(f.expr, ast.Wildcard):
             return None
-    roots = list(stmt.expressions())
-    for j in stmt.joins:
-        if j.on is not None:
-            roots.append(j.on)
-    for root in roots:
+    # stmt.expressions() already yields join ON clauses and window exprs
+    for root in stmt.expressions():
         if root is None:
             continue
         for node in ast.walk(root):

@@ -10,6 +10,8 @@ is still batch-vectorized downstream where possible.
 """
 from __future__ import annotations
 
+import numpy as np
+
 from typing import Any, List, Optional
 
 from ..data.batch import ColumnBatch
@@ -36,8 +38,6 @@ class WatermarkNode(Node):
             # columnar path: late-drop by mask, order by timestamp, forward
             # the batch WITHOUT exploding to rows (the columnar spine
             # continues into the window operator)
-            import numpy as np
-
             ts = item.timestamps
             if ts is None:
                 ts = np.zeros(item.n, dtype=np.int64)
@@ -178,8 +178,6 @@ class WindowNode(Node):
         """Tumbling/hopping: batches buffer WHOLE; no per-row work at
         ingest. Selection/eviction happen on the timestamp arrays at
         trigger time, and rows materialize only when a window emits."""
-        import numpy as np
-
         if self._vfilter is not None and batch.n:
             try:
                 mask = np.broadcast_to(np.asarray(
@@ -199,16 +197,12 @@ class WindowNode(Node):
             self.bbuf.append(batch)
 
     def _bts(self, batch: ColumnBatch):
-        import numpy as np
-
         if batch.timestamps is None:
             return np.zeros(batch.n, dtype=np.int64)
         return batch.timestamps
 
     def _bbuf_select(self, start: int, end: int) -> List[Row]:
         """Materialize rows with start <= ts < end (ts-ordered batches)."""
-        import numpy as np
-
         out: List[Row] = []
         for batch in self.bbuf:
             ts = self._bts(batch)
@@ -220,8 +214,6 @@ class WindowNode(Node):
         return out
 
     def _bbuf_evict_before(self, cutoff: int) -> None:
-        import numpy as np
-
         kept: List[ColumnBatch] = []
         for batch in self.bbuf:
             ts = self._bts(batch)

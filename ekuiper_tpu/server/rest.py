@@ -151,6 +151,27 @@ class RestApi:
         r("DELETE", r"^/scripts/(?P<name>[^/]+)$",
           lambda m: self._scripts().delete(m["name"])
           or f"Script {m['name']} is dropped.")
+        # UI metadata + confKey profiles (reference internal/meta routes)
+        r("GET", r"^/metadata/sources$",
+          lambda m: self._meta().list_sources())
+        r("GET", r"^/metadata/sinks$", lambda m: self._meta().list_sinks())
+        r("GET", r"^/metadata/functions$",
+          lambda m: self._meta().list_functions())
+        r("GET", r"^/metadata/functions/(?P<name>[^/]+)$",
+          lambda m: self._meta().describe_function(m["name"]))
+        r("GET", r"^/metadata/sources/(?P<name>[^/]+)$",
+          lambda m: self._meta().describe_source(m["name"]))
+        r("GET", r"^/metadata/sinks/(?P<name>[^/]+)$",
+          lambda m: self._meta().describe_sink(m["name"]))
+        r("GET", r"^/metadata/sources/(?P<typ>[^/]+)/confKeys$",
+          lambda m: self.list_conf_keys(m["typ"]))
+        r("PUT", r"^/metadata/sources/(?P<typ>[^/]+)/confKeys/(?P<key>[^/]+)$",
+          lambda m, body=None: self.set_conf_key(m["typ"], m["key"], body)
+          or f"confKey {m['key']} is saved.")
+        r("DELETE",
+          r"^/metadata/sources/(?P<typ>[^/]+)/confKeys/(?P<key>[^/]+)$",
+          lambda m: self.del_conf_key(m["typ"], m["key"])
+          or f"confKey {m['key']} is dropped.")
         # observability (reference: prome_init.go /metrics, pkg/tracer
         # trace routes, metrics/metrics_dump.go)
         r("GET", r"^/metrics$", lambda m: self.prometheus_metrics())
@@ -325,6 +346,28 @@ class RestApi:
             raise EngineError(f"upload {name} not found")
         os.remove(path)
         return f"Upload {name} is deleted."
+
+    # ------------------------------------------------------------- metadata
+    @staticmethod
+    def _meta():
+        from .. import meta
+
+        return meta
+
+    def list_conf_keys(self, typ: str) -> List[str]:
+        prefix = f"{typ}:"
+        return sorted(k[len(prefix):]
+                      for k in self.store.kv("source_conf").keys()
+                      if k.startswith(prefix))
+
+    def set_conf_key(self, typ: str, key: str, body: Optional[dict]) -> None:
+        if not isinstance(body, dict):
+            raise EngineError("confKey body must be a json object")
+        self.store.kv("source_conf").set(f"{typ}:{key}", body)
+
+    def del_conf_key(self, typ: str, key: str) -> None:
+        if not self.store.kv("source_conf").delete(f"{typ}:{key}"):
+            raise EngineError(f"confKey {typ}:{key} not found")
 
     # ---------------------------------------------------------- observability
     @staticmethod

@@ -138,6 +138,46 @@ class SqliteKV(KV):
             return [r[0] for r in cur.fetchall()]
 
 
+class RedisKV(KV):
+    """Redis-backed namespace (analogue of the reference's redis storage
+    backend, internal/pkg/store/redis) — one redis hash per namespace,
+    values json-encoded, over the engine's own RESP client."""
+
+    def __init__(self, client, namespace: str) -> None:
+        self._cli = client
+        self._ns = f"ekuiper:{namespace}"
+
+    def set(self, key: str, value: Any) -> None:
+        self._cli.command("HSET", self._ns, key, json.dumps(value))
+
+    def setnx(self, key: str, value: Any) -> bool:
+        return bool(self._cli.command(
+            "HSETNX", self._ns, key, json.dumps(value)))
+
+    def get_ok(self, key: str) -> Tuple[Any, bool]:
+        raw = self._cli.command("HGET", self._ns, key)
+        if raw is None:
+            return None, False
+        return json.loads(raw), True
+
+    def delete(self, key: str) -> bool:
+        return bool(self._cli.command("HDEL", self._ns, key))
+
+    def keys(self) -> List[str]:
+        raw = self._cli.command("HKEYS", self._ns) or []
+        return sorted(k.decode() if isinstance(k, bytes) else k for k in raw)
+
+    def items(self):
+        # one HGETALL round trip instead of HKEYS + N HGETs
+        raw = self._cli.command("HGETALL", self._ns) or []
+        it = iter(raw)
+        for k, v in zip(it, it):
+            yield (k.decode() if isinstance(k, bytes) else k, json.loads(v))
+
+    def clean(self) -> None:
+        self._cli.command("DEL", self._ns)
+
+
 class Store:
     """Store root: hands out namespaced KV tables
     (analogue of store.SetupWithConfig, internal/server/server.go:183)."""
@@ -147,13 +187,24 @@ class Store:
         self._lock = threading.RLock()
         self._namespaces: Dict[str, KV] = {}
         self._conn: Optional[sqlite3.Connection] = None
+        self._redis = None
         if kind == "sqlite":
             os.makedirs(path, exist_ok=True)
             self._conn = sqlite3.connect(
                 os.path.join(path, "ekuiper_tpu.db"), check_same_thread=False
             )
+        elif kind == "redis":
+            # path = "host:port[/db]" (reference redis storage backend)
+            from ..io.redis_io import RespClient
+
+            addr, _, db = path.partition("/")
+            host, _, port = addr.partition(":")
+            self._redis = RespClient(host or "127.0.0.1",
+                                     int(port or 6379), db=int(db or 0))
+            self._redis.connect()
         elif kind != "memory":
-            raise ValueError(f"unknown store kind {kind!r} (want sqlite|memory)")
+            raise ValueError(
+                f"unknown store kind {kind!r} (want sqlite|memory|redis)")
 
     def kv(self, namespace: str) -> KV:
         with self._lock:
@@ -161,6 +212,8 @@ class Store:
             if kv is None:
                 if self._conn is not None:
                     kv = SqliteKV(self._conn, self._lock, namespace)
+                elif self._redis is not None:
+                    kv = RedisKV(self._redis, namespace)
                 else:
                     kv = MemoryKV()
                 self._namespaces[namespace] = kv
@@ -179,6 +232,9 @@ class Store:
             if self._conn is not None:
                 self._conn.close()
                 self._conn = None
+            if self._redis is not None:
+                self._redis.close()
+                self._redis = None
 
 
 _store: Optional[Store] = None

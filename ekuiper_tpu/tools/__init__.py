@@ -1,0 +1,1 @@
+"""Developer tools (analogue of the reference's tools/ directory)."""

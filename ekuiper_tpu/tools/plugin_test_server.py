@@ -1,0 +1,109 @@
+"""Portable-plugin test harness (analogue of
+tools/plugin_server/plugin_test_server.go): runs the engine side of the
+plugin wire protocol WITHOUT the engine, so plugin authors can exercise
+their worker standalone.
+
+Usage:
+    python -m ekuiper_tpu.tools.plugin_test_server <plugin.json> \
+        [--invoke symbol arg1 arg2 ...] [--source symbol] [--sink symbol]
+
+plugin.json is the same descriptor the engine installs:
+    {"name": "...", "executable": "path.py", "language": "python",
+     "functions": [...], "sources": [...], "sinks": [...]}
+
+--invoke calls a function symbol once with the given (json-parsed) args.
+--source starts a source symbol and prints everything it emits for 10s.
+--sink starts a sink symbol and feeds it one sample row.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from ..plugin import ipc
+from ..plugin.manager import PluginIns as _Worker, PluginMeta
+
+
+def _parse_arg(a: str):
+    try:
+        return json.loads(a)
+    except ValueError:
+        return a
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("descriptor", help="plugin json descriptor path")
+    p.add_argument("--invoke", nargs="+", metavar=("SYMBOL", "ARG"),
+                   help="call a function symbol with args")
+    p.add_argument("--source", metavar="SYMBOL",
+                   help="start a source symbol, print emissions for --seconds")
+    p.add_argument("--sink", metavar="SYMBOL",
+                   help="start a sink symbol, feed one sample row")
+    p.add_argument("--seconds", type=float, default=10.0)
+    args = p.parse_args(argv)
+
+    with open(args.descriptor) as f:
+        desc = json.load(f)
+    meta = PluginMeta.from_dict(desc)
+    worker = _Worker(meta)
+    print(f"starting plugin {meta.name} ({meta.executable}) ...")
+    worker.start()
+    print("handshake ok")
+    try:
+        if args.invoke:
+            symbol, fn_args = args.invoke[0], [
+                _parse_arg(a) for a in args.invoke[1:]]
+            ctrl = {"symbolName": symbol, "pluginType": "function",
+                    "meta": {}}
+            worker.command("start", ctrl)
+            ch = ipc.Socket(ipc.PAIR)
+            ch.dial(ipc.ipc_url(f"func_{symbol}"), timeout_ms=5000)
+            ch.send(json.dumps({"func": symbol, "args": fn_args}).encode())
+            reply = json.loads(ch.recv(10_000))
+            print("result:", json.dumps(reply, indent=2))
+            ch.close()
+            worker.command("stop", ctrl)
+        elif args.source:
+            meta = {"ruleId": "test", "opId": "op", "instanceId": 0}
+            ctrl = {"symbolName": args.source, "pluginType": "source",
+                    "dataSource": "", "config": {}, "meta": meta}
+            ch = ipc.Socket(ipc.PULL)
+            ch.listen(ipc.ipc_url("source_test_op_0"))
+            worker.command("start", ctrl)
+            deadline = time.time() + args.seconds
+            print(f"listening for {args.seconds}s ...")
+            while time.time() < deadline:
+                try:
+                    data = ch.recv(timeout_ms=500)
+                except Exception:
+                    continue
+                if data:
+                    print("emit:", data.decode(errors="replace"))
+            ch.close()
+            worker.command("stop", ctrl)
+        elif args.sink:
+            meta = {"ruleId": "test", "opId": "op", "instanceId": 0}
+            ctrl = {"symbolName": args.sink, "pluginType": "sink",
+                    "config": {}, "meta": meta}
+            worker.command("start", ctrl)
+            ch = ipc.Socket(ipc.PUSH)
+            ch.dial(ipc.ipc_url("sink_test_op_0"), timeout_ms=5000)
+            sample = {"test": True, "value": 42}
+            ch.send(json.dumps(sample).encode())
+            print("sent sample row:", sample)
+            time.sleep(1.0)
+            ch.close()
+            worker.command("stop", ctrl)
+        else:
+            print("plugin started and handshook; no action requested "
+                  "(--invoke/--source/--sink)")
+        return 0
+    finally:
+        worker.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,293 @@
+"""Long-tail subsystems: meta catalog, sql connectors, redis-backed KV,
+gated extensions, confKey REST routes, plugin test server importability."""
+import json
+import os
+import sqlite3
+import time
+import urllib.request
+
+import pytest
+
+import ekuiper_tpu.meta as meta
+from ekuiper_tpu.io import registry as io_registry
+from ekuiper_tpu.server.rest import RestApi, serve
+from ekuiper_tpu.store import kv
+from ekuiper_tpu.utils.infra import EngineError
+
+
+class TestMeta:
+    def test_catalog(self):
+        assert "mqtt" in meta.list_sources()
+        assert "redis" in meta.list_sinks()
+        src = meta.describe_source("websocket")
+        assert any(p["name"] == "addr" for p in src["properties"])
+        snk = meta.describe_sink("redis")
+        assert any(p["name"] == "dataType" for p in snk["properties"])
+        fns = meta.list_functions()
+        assert "avg" in fns["aggregate"] and "abs" in fns["scalar"]
+        with pytest.raises(EngineError):
+            meta.describe_source("nope")
+
+
+class TestGatedExtensions:
+    def test_kafka_gated_with_clear_error(self):
+        with pytest.raises(EngineError, match="kafka-python"):
+            io_registry.create_source("kafka")
+        with pytest.raises(EngineError, match="pyzmq"):
+            io_registry.create_sink("zmq")
+
+
+class TestSqlIo:
+    def test_source_sink_lookup_roundtrip(self, tmp_path):
+        db = str(tmp_path / "t.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE readings (id INTEGER, dev TEXT, v REAL)")
+        conn.execute("CREATE TABLE outs (dev TEXT, v REAL)")
+        conn.executemany("INSERT INTO readings VALUES (?, ?, ?)",
+                         [(1, "a", 1.5), (2, "b", 2.5)])
+        conn.commit()
+
+        src = io_registry.create_source("sql")
+        src.configure("readings", {
+            "url": f"sqlite://{db}", "interval": 50, "trackingColumn": "id"})
+        got = []
+        src.open(lambda rows: got.extend(rows))
+        deadline = time.time() + 5
+        while time.time() < deadline and len(got) < 2:
+            time.sleep(0.02)
+        # incremental: new row picked up, old not re-fetched
+        conn.execute("INSERT INTO readings VALUES (3, 'c', 3.5)")
+        conn.commit()
+        while time.time() < deadline and len(got) < 3:
+            time.sleep(0.02)
+        src.close()
+        assert [r["dev"] for r in got] == ["a", "b", "c"]
+        assert src.get_offset() == 3
+
+        sink = io_registry.create_sink("sql")
+        sink.configure({"url": f"sqlite://{db}", "table": "outs"})
+        sink.connect()
+        sink.collect([{"dev": "x", "v": 9.0}])
+        sink.close()
+        assert conn.execute("SELECT dev, v FROM outs").fetchall() == \
+            [("x", 9.0)]
+
+        lk = io_registry.create_lookup("sql")
+        lk.configure("readings", {"url": f"sqlite://{db}"})
+        lk.open()
+        assert lk.lookup([], ["dev"], ["b"])[0]["v"] == 2.5
+        lk.close()
+
+
+class TestRedisStore:
+    def test_rediskv_contract_with_stub_client(self):
+        class StubCli:
+            def __init__(self):
+                self.h = {}
+
+            def command(self, *args):
+                op = args[0]
+                if op == "HSET":
+                    self.h[args[2]] = args[3]
+                    return 1
+                if op == "HSETNX":
+                    if args[2] in self.h:
+                        return 0
+                    self.h[args[2]] = args[3]
+                    return 1
+                if op == "HGET":
+                    return self.h.get(args[2])
+                if op == "HDEL":
+                    return 1 if self.h.pop(args[2], None) is not None else 0
+                if op == "HKEYS":
+                    return list(self.h.keys())
+                if op == "DEL":
+                    self.h.clear()
+                    return 1
+
+        from ekuiper_tpu.store.kv import RedisKV
+
+        r = RedisKV(StubCli(), "t")
+        r.set("a", {"x": 1})
+        assert r.get_ok("a") == ({"x": 1}, True)
+        assert not r.setnx("a", 2) and r.setnx("b", 2)
+        assert r.keys() == ["a", "b"]
+        assert r.delete("a") and not r.delete("a")
+        r.clean()
+        assert r.keys() == []
+
+
+class TestConfKeysRest:
+    def test_confkey_crud_feeds_planner(self, mock_clock):
+        store = kv.get_store()
+        api = RestApi(store)
+        srv = serve(api, "127.0.0.1", 0)
+        port = srv.server_address[1]
+
+        def req(method, path, body=None):
+            data = json.dumps(body).encode() if body is not None else None
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", data=data, method=method,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(r, timeout=5) as resp:
+                return json.loads(resp.read() or b"null")
+
+        try:
+            req("PUT", "/metadata/sources/mqtt/confKeys/broker1",
+                {"server": "tcp://h:1883", "qos": 2})
+            assert req("GET", "/metadata/sources/mqtt/confKeys") == ["broker1"]
+            # the planner reads the same table through _source_props
+            got, ok = store.kv("source_conf").get_ok("mqtt:broker1")
+            assert ok and got["qos"] == 2
+            req("DELETE", "/metadata/sources/mqtt/confKeys/broker1")
+            assert req("GET", "/metadata/sources/mqtt/confKeys") == []
+            # metadata endpoints over REST
+            assert "sql" in req("GET", "/metadata/sources")
+            assert req("GET", "/metadata/sinks/redis")["name"] == "redis"
+        finally:
+            srv.shutdown()
+
+
+class TestPluginTestServer:
+    def test_importable_and_help(self):
+        from ekuiper_tpu.tools import plugin_test_server
+
+        with pytest.raises(SystemExit):
+            plugin_test_server.main(["--help"])
+
+
+class FakeBroker:
+    """Tiny MQTT 3.1.1 broker: CONNACK, SUBACK, qos0/1 PUBLISH routing with
+    topic filter matching, PINGRESP."""
+
+    def __init__(self):
+        import socket as _s
+        import threading as _t
+
+        self.srv = _s.socket(_s.AF_INET, _s.SOCK_STREAM)
+        self.srv.setsockopt(_s.SOL_SOCKET, _s.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(8)
+        self.port = self.srv.getsockname()[1]
+        self.subs = []  # (conn, filter, lock)
+        self._stop = False
+        _t.Thread(target=self._accept, daemon=True).start()
+
+    def close(self):
+        self._stop = True
+        self.srv.close()
+
+    def _accept(self):
+        import threading as _t
+
+        while not self._stop:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            _t.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn):
+        import struct
+        import threading as _t
+
+        from ekuiper_tpu.io import mqtt_native as mn
+
+        wlock = _t.Lock()
+
+        def read_exact(n):
+            out = b""
+            while len(out) < n:
+                c = conn.recv(n - len(out))
+                if not c:
+                    raise ConnectionError
+                out += c
+            return out
+
+        def read_packet():
+            first = read_exact(1)[0]
+            mult, length = 1, 0
+            while True:
+                b = read_exact(1)[0]
+                length += (b & 0x7F) * mult
+                if not (b & 0x80):
+                    break
+                mult *= 128
+            return first, read_exact(length) if length else b""
+
+        def send(first, body, lk=wlock):
+            with lk:
+                conn.sendall(bytes([first]) + mn.encode_varint(len(body)) + body)
+
+        try:
+            typ, _ = read_packet()
+            assert typ & 0xF0 == mn.CONNECT
+            send(mn.CONNACK, b"\x00\x00")
+            while True:
+                typ, body = read_packet()
+                kind = typ & 0xF0
+                if kind == 0x80:  # SUBSCRIBE
+                    mid = body[:2]
+                    tlen = struct.unpack(">H", body[2:4])[0]
+                    filt = body[4:4 + tlen].decode()
+                    self.subs.append((send, filt))
+                    send(mn.SUBACK, mid + b"\x00")
+                elif kind == mn.PUBLISH:
+                    qos = (typ >> 1) & 3
+                    tlen = struct.unpack(">H", body[:2])[0]
+                    topic = body[2:2 + tlen].decode()
+                    pos = 2 + tlen
+                    if qos:
+                        mid = body[pos:pos + 2]
+                        pos += 2
+                        send(mn.PUBACK, mid)
+                    payload = body[pos:]
+                    for sub_send, filt in list(self.subs):
+                        if mn.topic_matches(filt, topic):
+                            var = mn.encode_str(topic)
+                            try:
+                                sub_send(mn.PUBLISH, var + payload)
+                            except Exception:
+                                pass
+                elif kind == mn.PINGREQ:
+                    send(mn.PINGRESP, b"")
+                elif kind == mn.DISCONNECT:
+                    return
+        except Exception:
+            pass
+
+
+class TestNativeMqtt:
+    def test_source_sink_roundtrip(self):
+        broker = FakeBroker()
+        try:
+            src = io_registry.create_source("mqtt")
+            src.configure("sensors/+/t", {
+                "server": f"tcp://127.0.0.1:{broker.port}", "qos": 1})
+            got = []
+            src.open(lambda payload, meta=None: got.append((payload, meta)))
+            deadline = time.time() + 5
+            while time.time() < deadline and not broker.subs:
+                time.sleep(0.02)
+            sink = io_registry.create_sink("mqtt")
+            sink.configure({"server": f"tcp://127.0.0.1:{broker.port}",
+                            "topic": "sensors/d1/t", "qos": 0})
+            sink.connect()
+            sink.collect({"v": 3})
+            while time.time() < deadline and not got:
+                time.sleep(0.02)
+            assert got and got[0][0] == {"v": 3}
+            assert got[0][1]["topic"] == "sensors/d1/t"
+            sink.close()
+            src.close()
+        finally:
+            broker.close()
+
+    def test_topic_matching(self):
+        from ekuiper_tpu.io.mqtt_native import topic_matches
+
+        assert topic_matches("a/+/c", "a/b/c")
+        assert topic_matches("a/#", "a/b/c/d")
+        assert not topic_matches("a/+", "a/b/c")
+        assert topic_matches("a/b", "a/b")
+        assert not topic_matches("a/b", "a/x")
