@@ -23,7 +23,7 @@ BATCH_ROWS = 65_536
 KEY_SLOTS = 16_384
 WARMUP_BATCHES = 3
 MEASURE_SECONDS = 10.0
-MAX_SECONDS = 75.0  # run past MEASURE_SECONDS until >=50 emit samples
+MAX_SECONDS = 150.0  # run past MEASURE_SECONDS until >=50 emit samples
 # ~0.9s windows: the fused node folds the first half on device, pre-issues
 # the finalize at mid-window (~400ms runway for the tunnel round trip), and
 # host-shadows the dying tail (ops/prefinalize.py). At the rule's real 10s

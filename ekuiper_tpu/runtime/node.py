@@ -135,6 +135,9 @@ class Node:
             self._topo.drain_error(err, self.name)
 
     def _run(self) -> None:
+        from ..utils.rulelog import set_rule_context
+
+        set_rule_context(getattr(self._topo, "rule_id", None))
         self.on_worker_start()
         try:
             while not self._stop.is_set():

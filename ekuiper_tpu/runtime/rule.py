@@ -79,6 +79,9 @@ class RuleState:
                 self._worker.start()
 
     def _drain_actions(self) -> None:
+        from ..utils.rulelog import set_rule_context
+
+        set_rule_context(self.rule.id)
         while True:
             try:
                 action = self._actions.get(timeout=0.5)
@@ -196,6 +199,9 @@ class RuleState:
     def _supervise(self) -> None:
         """Watch the topo error channel, apply the restart strategy
         (reference: state.go:498-575 runTopo)."""
+        from ..utils.rulelog import set_rule_context
+
+        set_rule_context(self.rule.id)
         opts = self.rule.options.get("restartStrategy", {})
         attempts = int(opts.get("attempts", 0))
         delay = int(opts.get("delay", 1000))

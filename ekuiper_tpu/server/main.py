@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import threading
 
@@ -28,6 +29,10 @@ def start_up(config_path: str | None = None, block: bool = True):
     from ..utils.config import apply_config_overlay
 
     apply_config_overlay(store)  # PATCH /configs overlays survive restarts
+    if cfg.basic.rule_log_enabled:
+        from ..utils import rulelog
+
+        rulelog.install(os.path.join(cfg.store.path, "logs"))
     # portable plugin manager (restores installed plugins + binds symbols,
     # reference: server.go:218-226 binder init)
     from ..plugin.manager import PortableManager

@@ -1,0 +1,113 @@
+"""Kubernetes deployment tool (analogue of tools/kubernetes: a sidecar that
+watches a command directory and replays json command files against the
+engine's REST API — declarative stream/rule provisioning for k8s deploys).
+
+Command file shape is the reference's exactly:
+    {"commands": [{"url": "/streams", "method": "post",
+                   "description": "...", "data": {...}}, ...]}
+
+Processed files are recorded in `.history` (name + loadTime) next to the
+command files; a file re-processes when its mtime passes its recorded load
+time. Run once (--once) or as a watch loop (--interval seconds).
+
+Usage:
+    python -m ekuiper_tpu.tools.kubernetes_tool --dir /commands \
+        --endpoint http://127.0.0.1:9081 [--once] [--interval 5]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List
+
+
+def _history_path(cmd_dir: str) -> str:
+    return os.path.join(cmd_dir, ".history")
+
+
+def load_history(cmd_dir: str) -> Dict[str, float]:
+    try:
+        with open(_history_path(cmd_dir)) as f:
+            return {e["name"]: e["loadTime"] for e in json.load(f)}
+    except (OSError, ValueError):
+        return {}
+
+
+def save_history(cmd_dir: str, hist: Dict[str, float]) -> None:
+    with open(_history_path(cmd_dir), "w") as f:
+        json.dump([{"name": k, "loadTime": v} for k, v in sorted(hist.items())],
+                  f, indent=1)
+
+
+def run_command(endpoint: str, cmd: Dict[str, Any]) -> Any:
+    url = endpoint.rstrip("/") + cmd["url"]
+    method = cmd.get("method", "get").upper()
+    data = cmd.get("data")
+    body = json.dumps(data).encode() if data is not None else None
+    req = urllib.request.Request(
+        url, data=body, method=method,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        raw = resp.read()
+    return json.loads(raw) if raw else None
+
+
+def process_dir(cmd_dir: str, endpoint: str) -> List[str]:
+    """Execute every new/updated command file; returns processed names."""
+    hist = load_history(cmd_dir)
+    done: List[str] = []
+    for name in sorted(os.listdir(cmd_dir)):
+        if not name.endswith(".json") or name.startswith("."):
+            continue
+        path = os.path.join(cmd_dir, name)
+        if hist.get(name, 0) >= os.path.getmtime(path):
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except ValueError as exc:
+            print(f"[kubernetes-tool] {name}: bad json: {exc}", file=sys.stderr)
+            continue
+        ok = True
+        for cmd in doc.get("commands", []):
+            desc = cmd.get("description", cmd.get("url", ""))
+            try:
+                out = run_command(endpoint, cmd)
+                print(f"[kubernetes-tool] {name}: {desc}: {out}")
+            except urllib.error.HTTPError as exc:
+                ok = False
+                print(f"[kubernetes-tool] {name}: {desc} FAILED "
+                      f"({exc.code}): {exc.read().decode(errors='replace')}",
+                      file=sys.stderr)
+            except Exception as exc:
+                ok = False
+                print(f"[kubernetes-tool] {name}: {desc} FAILED: {exc}",
+                      file=sys.stderr)
+        if ok:
+            hist[name] = time.time()
+            done.append(name)
+    save_history(cmd_dir, hist)
+    return done
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dir", required=True, help="command file directory")
+    p.add_argument("--endpoint", default="http://127.0.0.1:9081")
+    p.add_argument("--once", action="store_true")
+    p.add_argument("--interval", type=float, default=5.0)
+    args = p.parse_args(argv)
+    while True:
+        process_dir(args.dir, args.endpoint)
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

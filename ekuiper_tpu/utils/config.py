@@ -75,6 +75,8 @@ class BasicConfig:
     # here an HS256 shared secret, documented divergence). Off by default.
     authentication: bool = False
     jwt_secret: str = ""
+    # per-rule log files under <store.path>/logs (rule logToDisk analogue)
+    rule_log_enabled: bool = False
 
 
 @dataclass

@@ -1,0 +1,92 @@
+"""Per-rule log files (analogue of the reference's rule-scoped loggers,
+conf.Log + rule logToDisk): every engine log record produced while a
+rule-owned thread is running is ALSO appended to data/logs/<rule>.log.
+
+The engine's components log through one shared logger; rule attribution
+rides a thread-local set by the threads a rule owns (node workers, the rule
+FSM worker, supervisors). Opt-in via basic.rule_log_enabled."""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Optional, TextIO
+
+_ctx = threading.local()
+
+
+def set_rule_context(rule_id: Optional[str]) -> None:
+    _ctx.rule_id = rule_id
+
+
+def current_rule() -> Optional[str]:
+    return getattr(_ctx, "rule_id", None)
+
+
+class RuleLogRouter(logging.Handler):
+    def __init__(self, log_dir: str) -> None:
+        super().__init__()
+        self.log_dir = log_dir
+        self._files: Dict[str, TextIO] = {}
+        self._lock = threading.Lock()
+        self.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(message)s"))
+
+    def emit(self, record: logging.LogRecord) -> None:
+        rule_id = current_rule()
+        if not rule_id:
+            return
+        try:
+            line = self.format(record)
+            with self._lock:
+                f = self._files.get(rule_id)
+                if f is None:
+                    os.makedirs(self.log_dir, exist_ok=True)
+                    safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                                   for c in rule_id)
+                    f = open(os.path.join(self.log_dir, f"{safe}.log"), "a")
+                    self._files[rule_id] = f
+                f.write(line + "\n")
+                f.flush()
+        except Exception:
+            self.handleError(record)
+
+    def close(self) -> None:
+        with self._lock:
+            for f in self._files.values():
+                try:
+                    f.close()
+                except Exception:
+                    pass
+            self._files.clear()
+        super().close()
+
+
+_router: Optional[RuleLogRouter] = None
+_install_lock = threading.Lock()
+
+
+def install(log_dir: str) -> RuleLogRouter:
+    """Attach the router to the engine logger (idempotent; re-targets the
+    directory on re-install)."""
+    from .infra import logger
+
+    global _router
+    with _install_lock:
+        if _router is not None:
+            logger.removeHandler(_router)
+            _router.close()
+        _router = RuleLogRouter(log_dir)
+        logger.addHandler(_router)
+        return _router
+
+
+def uninstall() -> None:
+    from .infra import logger
+
+    global _router
+    with _install_lock:
+        if _router is not None:
+            logger.removeHandler(_router)
+            _router.close()
+            _router = None

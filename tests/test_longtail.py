@@ -291,3 +291,61 @@ class TestNativeMqtt:
         assert not topic_matches("a/+", "a/b/c")
         assert topic_matches("a/b", "a/b")
         assert not topic_matches("a/b", "a/x")
+
+
+class TestRuleLogFiles:
+    def test_per_rule_log_routing(self, tmp_path, mock_clock):
+        from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+        from ekuiper_tpu.server.processors import StreamProcessor
+        from ekuiper_tpu.utils import rulelog
+        from ekuiper_tpu.utils.infra import logger
+        import ekuiper_tpu.io.memory as mem
+
+        store = kv.get_store()
+        StreamProcessor(store).exec_stmt(
+            'CREATE STREAM demo (a BIGINT) '
+            'WITH (DATASOURCE="rl/demo", TYPE="memory", FORMAT="JSON")')
+        rulelog.install(str(tmp_path))
+        try:
+            topo = plan_rule(RuleDef(
+                id="rl-1", sql="SELECT bad_fn(a) AS x FROM demo",
+                actions=[{"memory": {"topic": "rl/out"}}], options={}), store)
+            topo.open()
+            try:
+                mem.publish("rl/demo", {"a": 1})
+                mock_clock.advance(20)
+                assert topo.wait_idle(10)
+            finally:
+                topo.close()
+            logfile = tmp_path / "rl-1.log"
+            deadline = time.time() + 5
+            while time.time() < deadline and not logfile.exists():
+                time.sleep(0.05)
+            assert logfile.exists()
+            content = logfile.read_text()
+            assert "bad_fn" in content  # the unknown-function warning landed
+        finally:
+            rulelog.uninstall()
+
+    def test_k8s_tool_processes_commands(self, tmp_path):
+        from ekuiper_tpu.server.rest import RestApi, serve
+        from ekuiper_tpu.tools import kubernetes_tool
+
+        store = kv.get_store()
+        api = RestApi(store)
+        srv = serve(api, "127.0.0.1", 0)
+        endpoint = f"http://127.0.0.1:{srv.server_address[1]}"
+        (tmp_path / "init.json").write_text(json.dumps({"commands": [
+            {"url": "/streams", "method": "post", "description": "s",
+             "data": {"sql": 'CREATE STREAM kst (a BIGINT) WITH '
+                             '(DATASOURCE="k/t", TYPE="memory", '
+                             'FORMAT="JSON")'}},
+        ]}))
+        try:
+            done = kubernetes_tool.process_dir(str(tmp_path), endpoint)
+            assert done == ["init.json"]
+            assert "kst" in api.streams.show()
+            # unchanged file is not re-processed
+            assert kubernetes_tool.process_dir(str(tmp_path), endpoint) == []
+        finally:
+            srv.shutdown()
