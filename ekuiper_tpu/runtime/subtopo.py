@@ -29,7 +29,11 @@ from .node import Node
 
 class _FanoutTopoShim:
     """Stands in as `_topo` for nodes owned by a subtopo: errors fan out to
-    every attached rule's topo (each supervisor decides restart policy)."""
+    every attached rule's topo (each supervisor decides restart policy).
+    Shared pipelines serve many rules at once, so their log records route
+    to one __shared__ file rather than a single rule's (utils/rulelog)."""
+
+    rule_id = "__shared__"
 
     def __init__(self, subtopo: "SrcSubTopo") -> None:
         self._subtopo = subtopo

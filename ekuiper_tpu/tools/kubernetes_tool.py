@@ -26,21 +26,28 @@ import urllib.request
 from typing import Any, Dict, List
 
 
-def _history_path(cmd_dir: str) -> str:
-    return os.path.join(cmd_dir, ".history")
+def _history_path(cmd_dir: str, history: str = "") -> str:
+    # configMap volumes are read-only: the history file must be able to
+    # live elsewhere (--history), default beside the command files
+    return history or os.path.join(cmd_dir, ".history")
 
 
-def load_history(cmd_dir: str) -> Dict[str, float]:
+def load_history(cmd_dir: str, history: str = "") -> Dict[str, dict]:
     try:
-        with open(_history_path(cmd_dir)) as f:
-            return {e["name"]: e["loadTime"] for e in json.load(f)}
+        with open(_history_path(cmd_dir, history)) as f:
+            out = {}
+            for e in json.load(f):
+                out[e["name"]] = {"loadTime": e["loadTime"],
+                                  "failed": e.get("failed", [])}
+            return out
     except (OSError, ValueError):
         return {}
 
 
-def save_history(cmd_dir: str, hist: Dict[str, float]) -> None:
-    with open(_history_path(cmd_dir), "w") as f:
-        json.dump([{"name": k, "loadTime": v} for k, v in sorted(hist.items())],
+def save_history(cmd_dir: str, hist: Dict[str, dict],
+                 history: str = "") -> None:
+    with open(_history_path(cmd_dir, history), "w") as f:
+        json.dump([{"name": k, **v} for k, v in sorted(hist.items())],
                   f, indent=1)
 
 
@@ -57,41 +64,52 @@ def run_command(endpoint: str, cmd: Dict[str, Any]) -> Any:
     return json.loads(raw) if raw else None
 
 
-def process_dir(cmd_dir: str, endpoint: str) -> List[str]:
-    """Execute every new/updated command file; returns processed names."""
-    hist = load_history(cmd_dir)
+def process_dir(cmd_dir: str, endpoint: str, history: str = "") -> List[str]:
+    """Execute new/updated command files; already-succeeded commands of a
+    partially failed file are NOT replayed — only the failed indices retry
+    until they succeed (non-idempotent POSTs must run once). Returns the
+    names that made progress."""
+    hist = load_history(cmd_dir, history)
     done: List[str] = []
     for name in sorted(os.listdir(cmd_dir)):
         if not name.endswith(".json") or name.startswith("."):
             continue
         path = os.path.join(cmd_dir, name)
-        if hist.get(name, 0) >= os.path.getmtime(path):
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue  # atomic configMap swap mid-scan
+        entry = hist.get(name)
+        if entry and entry["loadTime"] >= mtime and not entry["failed"]:
             continue
+        retry_only = (entry["failed"] if entry
+                      and entry["loadTime"] >= mtime else None)
         try:
             with open(path) as f:
                 doc = json.load(f)
-        except ValueError as exc:
+        except (OSError, ValueError) as exc:
             print(f"[kubernetes-tool] {name}: bad json: {exc}", file=sys.stderr)
             continue
-        ok = True
-        for cmd in doc.get("commands", []):
+        failed: List[int] = []
+        for i, cmd in enumerate(doc.get("commands", [])):
+            if retry_only is not None and i not in retry_only:
+                continue
             desc = cmd.get("description", cmd.get("url", ""))
             try:
                 out = run_command(endpoint, cmd)
                 print(f"[kubernetes-tool] {name}: {desc}: {out}")
             except urllib.error.HTTPError as exc:
-                ok = False
+                failed.append(i)
                 print(f"[kubernetes-tool] {name}: {desc} FAILED "
                       f"({exc.code}): {exc.read().decode(errors='replace')}",
                       file=sys.stderr)
             except Exception as exc:
-                ok = False
+                failed.append(i)
                 print(f"[kubernetes-tool] {name}: {desc} FAILED: {exc}",
                       file=sys.stderr)
-        if ok:
-            hist[name] = time.time()
-            done.append(name)
-    save_history(cmd_dir, hist)
+        hist[name] = {"loadTime": time.time(), "failed": failed}
+        done.append(name)
+    save_history(cmd_dir, hist, history)
     return done
 
 
@@ -101,9 +119,14 @@ def main(argv=None) -> int:
     p.add_argument("--endpoint", default="http://127.0.0.1:9081")
     p.add_argument("--once", action="store_true")
     p.add_argument("--interval", type=float, default=5.0)
+    p.add_argument("--history", default="",
+                   help="history file path (outside a read-only command dir)")
     args = p.parse_args(argv)
     while True:
-        process_dir(args.dir, args.endpoint)
+        try:
+            process_dir(args.dir, args.endpoint, history=args.history)
+        except Exception as exc:  # long-running sidecar: never die on a poll
+            print(f"[kubernetes-tool] poll error: {exc}", file=sys.stderr)
         if args.once:
             return 0
         time.sleep(args.interval)

@@ -24,13 +24,28 @@ def current_rule() -> Optional[str]:
 
 
 class RuleLogRouter(logging.Handler):
+    #: open handles kept; beyond this the least-recently-used file closes
+    #: (rule churn must not leak fds)
+    MAX_OPEN_FILES = 32
+
     def __init__(self, log_dir: str) -> None:
         super().__init__()
         self.log_dir = log_dir
-        self._files: Dict[str, TextIO] = {}
+        self._files: Dict[str, TextIO] = {}  # insertion order = LRU
         self._lock = threading.Lock()
         self.setFormatter(logging.Formatter(
             "%(asctime)s %(levelname)s %(message)s"))
+
+    @staticmethod
+    def _filename(rule_id: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in rule_id)
+        if safe != rule_id:
+            # distinct ids must not collide after sanitization
+            import hashlib
+
+            safe += "-" + hashlib.sha1(rule_id.encode()).hexdigest()[:8]
+        return f"{safe}.log"
 
     def emit(self, record: logging.LogRecord) -> None:
         rule_id = current_rule()
@@ -39,13 +54,18 @@ class RuleLogRouter(logging.Handler):
         try:
             line = self.format(record)
             with self._lock:
-                f = self._files.get(rule_id)
+                f = self._files.pop(rule_id, None)
                 if f is None:
                     os.makedirs(self.log_dir, exist_ok=True)
-                    safe = "".join(c if c.isalnum() or c in "-_." else "_"
-                                   for c in rule_id)
-                    f = open(os.path.join(self.log_dir, f"{safe}.log"), "a")
-                    self._files[rule_id] = f
+                    f = open(os.path.join(
+                        self.log_dir, self._filename(rule_id)), "a")
+                self._files[rule_id] = f  # re-insert = most recently used
+                while len(self._files) > self.MAX_OPEN_FILES:
+                    oldest = next(iter(self._files))
+                    try:
+                        self._files.pop(oldest).close()
+                    except Exception:
+                        pass
                 f.write(line + "\n")
                 f.flush()
         except Exception:
