@@ -27,6 +27,7 @@ class FileSource(Source):
         self.file_type = "json"
         self.interval_ms = 0
         self.delimiter = ","
+        self._offset = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -42,18 +43,33 @@ class FileSource(Source):
         def run() -> None:
             while not self._stop.is_set():
                 try:
+                    skip = self._offset  # rewind/resume: replay from here
+                    n = 0
                     for payload in self._read_all():
                         if self._stop.is_set():
                             return
+                        n += 1
+                        if n <= skip:
+                            continue
                         ingest(payload, {"file": self.path})
+                        self._offset = n
                 except Exception as exc:
                     logger.error("file source %s: %s", self.path, exc)
                 if self.interval_ms <= 0:
                     return
+                self._offset = 0  # periodic re-reads restart the cycle
                 timex.sleep(self.interval_ms)
 
         self._thread = threading.Thread(target=run, daemon=True, name="file-source")
         self._thread.start()
+
+    # Rewindable (io/contract.py): offset = payloads emitted this cycle, so
+    # a checkpoint-restored rule resumes a bounded file replay where it was
+    def get_offset(self):
+        return self._offset
+
+    def rewind(self, offset) -> None:
+        self._offset = int(offset or 0)
 
     def _files(self) -> List[str]:
         if os.path.isdir(self.path):

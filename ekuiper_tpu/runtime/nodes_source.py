@@ -153,6 +153,26 @@ class SourceNode(Node):
                          if k in self.project_columns}
         return t
 
+    # ------------------------------------------------------------------ state
+    def snapshot_state(self):
+        """Rewindable sources (io/contract.py) checkpoint their offset so a
+        restored rule resumes the stream where the snapshot cut it."""
+        get_off = getattr(self.connector, "get_offset", None)
+        if get_off is None:
+            return None
+        try:
+            return {"offset": get_off()}
+        except Exception:
+            return None
+
+    def restore_state(self, state: dict) -> None:
+        rew = getattr(self.connector, "rewind", None)
+        if rew is not None and state and "offset" in state:
+            try:
+                rew(state["offset"])
+            except Exception as exc:
+                self.stats.inc_exception(f"rewind failed: {exc}")
+
     def _flush(self) -> None:
         with self._pending_lock:
             if not self._pending:

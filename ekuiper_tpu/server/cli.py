@@ -34,7 +34,11 @@ class Client:
         )
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
-                return json.loads(resp.read().decode())
+                raw = resp.read().decode()
+                ctype = resp.headers.get("Content-Type", "")
+                if "json" not in ctype:
+                    return raw  # text endpoints (/metrics)
+                return json.loads(raw)
         except urllib.error.HTTPError as exc:
             payload = exc.read().decode()
             try:
@@ -128,6 +132,19 @@ def main(argv: Optional[list] = None) -> None:
         return
     if cmd == "getstatus" and len(args) >= 3 and args[1] == "rule":
         _print(client.call("GET", f"/rules/{args[2]}/status"))
+        return
+    if cmd == "ping" and len(args) >= 3 and args[1] == "connection":
+        _print(client.call("GET", f"/connections/{args[2]}/ping"))
+        return
+    if cmd == "trace" and len(args) >= 4 and args[1] in ("start", "stop"):
+        # trace start|stop rule <id>
+        _print(client.call("POST", f"/rules/{args[3]}/trace/{args[1]}"))
+        return
+    if cmd == "trace" and len(args) >= 3 and args[1] == "rule":
+        _print(client.call("GET", f"/trace/rule/{args[2]}"))
+        return
+    if cmd == "metrics":
+        print(client.call("GET", "/metrics"))
         return
     print(f"unknown command: {' '.join(args)}", file=sys.stderr)
     sys.exit(2)

@@ -23,7 +23,7 @@ import sys
 import time
 import urllib.error
 import urllib.request
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 
 def _history_path(cmd_dir: str, history: str = "") -> str:
@@ -64,12 +64,16 @@ def run_command(endpoint: str, cmd: Dict[str, Any]) -> Any:
     return json.loads(raw) if raw else None
 
 
-def process_dir(cmd_dir: str, endpoint: str, history: str = "") -> List[str]:
+def process_dir(cmd_dir: str, endpoint: str, history: str = "",
+                hist: Optional[Dict[str, dict]] = None) -> List[str]:
     """Execute new/updated command files; already-succeeded commands of a
     partially failed file are NOT replayed — only the failed indices retry
-    until they succeed (non-idempotent POSTs must run once). Returns the
-    names that made progress."""
-    hist = load_history(cmd_dir, history)
+    until they succeed (non-idempotent POSTs must run once). Pass a
+    persistent `hist` dict in watch mode so an unwritable history file
+    can't cause replays within the process lifetime. Returns the names
+    where at least one command succeeded this pass."""
+    if hist is None:
+        hist = load_history(cmd_dir, history)
     done: List[str] = []
     for name in sorted(os.listdir(cmd_dir)):
         if not name.endswith(".json") or name.startswith("."):
@@ -91,12 +95,14 @@ def process_dir(cmd_dir: str, endpoint: str, history: str = "") -> List[str]:
             print(f"[kubernetes-tool] {name}: bad json: {exc}", file=sys.stderr)
             continue
         failed: List[int] = []
+        n_ok = 0
         for i, cmd in enumerate(doc.get("commands", [])):
             if retry_only is not None and i not in retry_only:
                 continue
             desc = cmd.get("description", cmd.get("url", ""))
             try:
                 out = run_command(endpoint, cmd)
+                n_ok += 1
                 print(f"[kubernetes-tool] {name}: {desc}: {out}")
             except urllib.error.HTTPError as exc:
                 failed.append(i)
@@ -108,8 +114,15 @@ def process_dir(cmd_dir: str, endpoint: str, history: str = "") -> List[str]:
                 print(f"[kubernetes-tool] {name}: {desc} FAILED: {exc}",
                       file=sys.stderr)
         hist[name] = {"loadTime": time.time(), "failed": failed}
-        done.append(name)
-    save_history(cmd_dir, hist, history)
+        if n_ok:
+            done.append(name)
+    try:
+        save_history(cmd_dir, hist, history)
+    except OSError as exc:
+        # the in-memory hist (watch mode) still prevents replays; warn so
+        # the operator fixes the mount — do NOT fail the successful commands
+        print(f"[kubernetes-tool] cannot persist history: {exc}",
+              file=sys.stderr)
     return done
 
 
@@ -122,13 +135,18 @@ def main(argv=None) -> int:
     p.add_argument("--history", default="",
                    help="history file path (outside a read-only command dir)")
     args = p.parse_args(argv)
+    if args.once:
+        # batch mode (k8s Job / init container): failures must fail the job
+        hist = load_history(args.dir, args.history)
+        process_dir(args.dir, args.endpoint, history=args.history, hist=hist)
+        return 1 if any(e.get("failed") for e in hist.values()) else 0
+    hist = load_history(args.dir, args.history)
     while True:
         try:
-            process_dir(args.dir, args.endpoint, history=args.history)
+            process_dir(args.dir, args.endpoint, history=args.history,
+                        hist=hist)
         except Exception as exc:  # long-running sidecar: never die on a poll
             print(f"[kubernetes-tool] poll error: {exc}", file=sys.stderr)
-        if args.once:
-            return 0
         time.sleep(args.interval)
 
 

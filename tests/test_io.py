@@ -193,3 +193,38 @@ class TestRegistry:
 
 
 import urllib.error  # noqa: E402  (used in TestHttpIO)
+
+
+class TestFileRewind:
+    def test_offset_and_rewind(self, tmp_path):
+        import json as _json
+        import time as _time
+
+        from ekuiper_tpu.io import registry as ior
+
+        p = tmp_path / "d.lines"
+        p.write_text("\n".join(_json.dumps({"i": i}) for i in range(5)))
+        src = ior.create_source("file")
+        src.configure(str(p), {"fileType": "lines", "interval": 0})
+        src.rewind(2)  # resume mid-file, as a checkpoint restore would
+        got = []
+        src.open(lambda payload, meta=None: got.append(payload))
+        deadline = _time.time() + 5
+        while _time.time() < deadline and len(got) < 3:
+            _time.sleep(0.02)
+        while _time.time() < deadline and src.get_offset() < 5:
+            _time.sleep(0.02)
+        src.close()
+        assert [g["i"] for g in got] == [2, 3, 4]
+        assert src.get_offset() == 5
+        # offsets ride SourceNode checkpoints (Rewindable contract)
+        from ekuiper_tpu.runtime.nodes_source import SourceNode
+
+        node = SourceNode("f", src)
+        snap = node.snapshot_state()
+        assert snap == {"offset": 5}
+        src2 = ior.create_source("file")
+        src2.configure(str(p), {"fileType": "lines"})
+        node2 = SourceNode("f2", src2)
+        node2.restore_state(snap)
+        assert src2.get_offset() == 5
