@@ -75,15 +75,33 @@ class ShardedGroupBy(DeviceGroupBy):
         self.state_sharding["act"] = NamedSharding(mesh, P(None, "keys"))
         self.batch_sharding = NamedSharding(mesh, P("rows"))
         self.scalar_sharding = NamedSharding(mesh, P())
+        # meshes spanning processes can't device_put host data onto
+        # non-addressable devices; global arrays assemble from each
+        # process's local slice instead (docs/DISTRIBUTED.md)
+        import jax
+
+        self.multiprocess = any(
+            d.process_index != jax.process_index()
+            for d in np.asarray(mesh.devices).flat)
         self._fold = self._build_fold()  # replaces the single-chip jit
         self._all_true = None  # cached device ones-mask (common no-null case)
+
+    def _put(self, arr, sharding):
+        """Host→mesh placement that also works when the mesh spans
+        processes: each process contributes its local slice of `arr`
+        (callers pass process-local data in multi-host mode)."""
+        import jax
+
+        if self.multiprocess:
+            return jax.make_array_from_process_local_data(sharding, arr)
+        return jax.device_put(arr, sharding)
 
     # ------------------------------------------------------------------ state
     def init_state(self) -> Dict[str, Any]:
         import jax
 
         return {
-            comp: jax.device_put(arr, self.state_sharding[comp])
+            comp: self._put(arr, self.state_sharding[comp])
             for comp, arr in super().init_state().items()
         }
 
@@ -100,7 +118,7 @@ class ShardedGroupBy(DeviceGroupBy):
             pad_shape = list(np_arr.shape)
             pad_shape[1] = new_capacity - np_arr.shape[1]
             pad = np.full(pad_shape, _INIT[comp], dtype=np_arr.dtype)
-            out[comp] = jax.device_put(
+            out[comp] = self._put(
                 np.concatenate([np_arr, pad], axis=1), self.state_sharding[comp]
             )
         self.capacity = new_capacity
@@ -110,7 +128,7 @@ class ShardedGroupBy(DeviceGroupBy):
         import jax
 
         return {
-            k: jax.device_put(np.asarray(v), self.state_sharding[k])
+            k: self._put(np.asarray(v), self.state_sharding[k])
             for k, v in host.items()
         }
 
@@ -268,7 +286,7 @@ class ShardedGroupBy(DeviceGroupBy):
         mb = self.micro_batch
         valid = valid or {}
         cols = materialize_hll_columns(self.plan.columns, cols, n)
-        pane = jax.device_put(
+        pane = self._put(
             jnp.asarray(pane_idx, dtype=jnp.int32), self.scalar_sharding
         )
         for start in range(0, max(n, 1), mb):
@@ -282,7 +300,7 @@ class ShardedGroupBy(DeviceGroupBy):
                 arr = np.asarray(cols[name][start:end], dtype=np.float32)
                 if pad:
                     arr = np.pad(arr, (0, pad))
-                dev_cols[name] = jax.device_put(arr, self.batch_sharding)
+                dev_cols[name] = self._put(arr, self.batch_sharding)
                 # masks are always materialized (all-true when absent) so the
                 # shard_map pytree structure is static across batches; the
                 # all-true mask is one cached device buffer, not a per-batch
@@ -292,12 +310,12 @@ class ShardedGroupBy(DeviceGroupBy):
                     vm = np.asarray(vmask[start:end], dtype=np.bool_)
                     if pad:
                         vm = np.pad(vm, (0, pad))
-                    dev_cols["__valid_" + name] = jax.device_put(
+                    dev_cols["__valid_" + name] = self._put(
                         vm, self.batch_sharding
                     )
                 else:
                     if self._all_true is None:
-                        self._all_true = jax.device_put(
+                        self._all_true = self._put(
                             np.ones(mb, dtype=np.bool_), self.batch_sharding
                         )
                     dev_cols["__valid_" + name] = self._all_true
@@ -309,8 +327,8 @@ class ShardedGroupBy(DeviceGroupBy):
             state = self._fold(
                 state,
                 dev_cols,
-                jax.device_put(s, self.batch_sharding),
-                jax.device_put(rv, self.batch_sharding),
+                self._put(s, self.batch_sharding),
+                self._put(rv, self.batch_sharding),
                 pane,
             )
         return state

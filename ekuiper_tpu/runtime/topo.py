@@ -128,6 +128,12 @@ class Topo:
     # ------------------------------------------------------------- status JSON
     def status(self) -> Dict[str, Any]:
         stats = {n.name: n.stats for n in self.all_nodes()}
+        for subtopo, _ in self._live_shared:
+            # shared ingest pipelines serve this rule too; surface their
+            # metrics under the rule status like the reference does for
+            # shared source instances
+            for name, sm in subtopo.status().items():
+                stats.setdefault(name, sm)
         return flatten_status(stats)
 
     def topo_json(self) -> Dict[str, Any]:

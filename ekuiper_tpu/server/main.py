@@ -25,6 +25,29 @@ def start_up(config_path: str | None = None, block: bool = True):
         level=getattr(logging, cfg.basic.log_level.upper(), logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    if cfg.cluster.enabled:
+        # validate BEFORE the (blocking) init — a half-filled cluster
+        # section must fail loudly, not hang a silent boot
+        cc = cfg.cluster
+        if not cc.coordinator_address:
+            raise ValueError("cluster.coordinator_address is required")
+        if not (0 <= cc.process_id < cc.num_processes):
+            raise ValueError(
+                f"cluster.process_id {cc.process_id} out of range for "
+                f"{cc.num_processes} processes")
+        # must run before anything touches jax: after this, jax.devices()
+        # spans every participating host and meshes shard across them
+        # (collectives ride ICI within a slice, DCN across slices)
+        import jax
+
+        logging.getLogger("ekuiper_tpu").info(
+            "joining cluster %s as process %d/%d",
+            cc.coordinator_address, cc.process_id, cc.num_processes)
+        jax.distributed.initialize(
+            coordinator_address=cc.coordinator_address,
+            num_processes=cc.num_processes,
+            process_id=cc.process_id,
+        )
     store = kv.setup(cfg.store.type, cfg.store.path)
     from ..utils.config import apply_config_overlay
 

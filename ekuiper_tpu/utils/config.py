@@ -101,6 +101,20 @@ class PortableConfig:
 
 
 @dataclass
+class ClusterConfig:
+    """Multi-host mesh participation (jax.distributed). When enabled, every
+    host runs the engine with the same config; meshes built from
+    jax.devices() then span all hosts, kernel collectives ride ICI inside a
+    pod slice and DCN across slices. See docs/DISTRIBUTED.md for the
+    execution model and its constraints."""
+
+    enabled: bool = False
+    coordinator_address: str = ""  # host:port of process 0
+    num_processes: int = 1
+    process_id: int = 0
+
+
+@dataclass
 class Config:
     basic: BasicConfig = field(default_factory=BasicConfig)
     rule: RuleOptionConfig = field(default_factory=RuleOptionConfig)
@@ -108,6 +122,7 @@ class Config:
     sink: SinkConfig = field(default_factory=SinkConfig)
     source: SourceConfig = field(default_factory=SourceConfig)
     portable: PortableConfig = field(default_factory=PortableConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
     data_dir: str = "data"
 
     def to_dict(self) -> Dict[str, Any]:

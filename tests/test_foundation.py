@@ -159,3 +159,20 @@ class TestColumnBatch:
         assert b.n == 2
         assert not b.is_valid("a")[1]
         assert not b.is_valid("b")[0]
+
+
+class TestClusterConfig:
+    def test_cluster_section_parses(self, tmp_path):
+        import json as _json
+
+        from ekuiper_tpu.utils.config import load_config
+
+        p = tmp_path / "cfg.json"
+        p.write_text(_json.dumps({"cluster": {
+            "enabled": True, "coordinator_address": "h0:8476",
+            "num_processes": 4, "process_id": 2}}))
+        cfg = load_config(str(p))
+        assert cfg.cluster.enabled
+        assert cfg.cluster.coordinator_address == "h0:8476"
+        assert cfg.cluster.num_processes == 4 and cfg.cluster.process_id == 2
+        assert not load_config(None).cluster.enabled
