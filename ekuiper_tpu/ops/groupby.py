@@ -101,6 +101,10 @@ class DeviceGroupBy:
         # stacked array -> a single device->host transfer per window emit
         # (sync round trips cost 10-90ms on tunneled TPU; see bench notes)
         self._finalize = jax.jit(self._finalize_impl, static_argnums=(1,))
+        # dynamic-mask variant: event-time windows rotate through per-window
+        # pane subsets; a static mask would compile one executable per
+        # subset (up to n_panes compiles), a traced mask compiles once
+        self._finalize_dyn = jax.jit(self._finalize_dyn_impl)
         self._components = jax.jit(self._components_impl, static_argnums=(1,))
         self._reset_pane = jax.jit(self._reset_pane_impl, donate_argnums=(0,))
 
@@ -146,13 +150,16 @@ class DeviceGroupBy:
         cols: Dict[str, np.ndarray],
         slots: np.ndarray,
         valid: Optional[Dict[str, np.ndarray]] = None,
-        pane_idx: int = 0,
+        pane_idx=0,
     ) -> Dict[str, Any]:
         """Fold a host micro-batch into the device partials.
 
         cols: numeric columns referenced by the kernel plan (numpy).
         slots: int32 key slot per row. valid: optional per-column masks.
-        Rows are chunked/padded to the static micro_batch size.
+        pane_idx: the destination pane — a scalar (processing-time windows)
+        or a per-row array (event-time windows route each row to its
+        bucket's pane). Rows are chunked/padded to the static micro_batch
+        size.
         """
         import jax.numpy as jnp
 
@@ -195,10 +202,17 @@ class DeviceGroupBy:
             # device compute
             if self.capacity <= 65535:
                 s = s.astype(np.uint16)
+            if isinstance(pane_idx, np.ndarray):
+                pv = pane_idx[start:end]
+                if pad:
+                    pv = np.pad(pv, (0, pad))
+                pane_arg = jnp.asarray(pv.astype(np.uint8))  # n_panes <= 255
+            else:
+                pane_arg = jnp.asarray(pane_idx, dtype=jnp.int32)
             state = self._fold(
                 state, dev_cols, jnp.asarray(s),
                 jnp.asarray(cnt, dtype=jnp.int32),
-                jnp.asarray(pane_idx, dtype=jnp.int32),
+                pane_arg,
             )
         return state
 
@@ -206,6 +220,7 @@ class DeviceGroupBy:
         import jax.numpy as jnp
 
         slots = slots.astype(jnp.int32)
+        pane_idx = pane_idx.astype(jnp.int32)  # scalar or per-row vector
         base = jnp.arange(self.micro_batch, dtype=jnp.int32) < n_valid
         if self.plan.filter is not None:
             base = jnp.logical_and(base, self.plan.filter(cols))
@@ -276,10 +291,18 @@ class DeviceGroupBy:
             return jnp.max(jnp.where(pm, arr, -jnp.inf), axis=0)
         return jnp.sum(jnp.where(pm, arr, 0.0), axis=0)
 
+    def _finalize_dyn_impl(self, state, pane_mask):
+        return self._finalize_body(state, pane_mask)
+
     def _finalize_impl(self, state, pane_mask_tuple):
         import jax.numpy as jnp
 
         pane_mask = jnp.asarray(np.array(pane_mask_tuple, dtype=np.bool_))
+        return self._finalize_body(state, pane_mask)
+
+    def _finalize_body(self, state, pane_mask):
+        import jax.numpy as jnp
+
         merged = {
             comp: self._merged(state, comp, pane_mask) for comp in self.comp_specs
         }
@@ -431,9 +454,13 @@ class DeviceGroupBy:
         pane_mask = np.zeros(self.n_panes, dtype=np.bool_)
         if panes is None:
             pane_mask[:] = True
+            stacked = np.asarray(
+                self._finalize(state, tuple(pane_mask.tolist())))
         else:
+            # subset masks rotate per window (event time): traced mask,
+            # single compiled executable
             pane_mask[panes] = True
-        stacked = np.asarray(self._finalize(state, tuple(pane_mask.tolist())))
+            stacked = np.asarray(self._finalize_dyn(state, pane_mask))
         host = [stacked[i][:n_keys] for i in range(len(self.plan.specs))]
         act = stacked[-1]
         host = apply_int_semantics(self.plan.specs, host)

@@ -159,13 +159,19 @@ def device_path_eligible(
     if not opts.use_device_kernel:
         return None
     w = stmt.window
-    if w is None or opts.is_event_time:
+    if w is None:
         return None
     if w.window_type not in (
         ast.WindowType.TUMBLING_WINDOW,
         ast.WindowType.HOPPING_WINDOW,
         ast.WindowType.COUNT_WINDOW,
     ):
+        return None
+    if opts.is_event_time and w.window_type == ast.WindowType.COUNT_WINDOW:
+        return None  # event-time counts stay on the host buffering path
+    if opts.is_event_time and (opts.plan_optimize_strategy or {}).get("mesh"):
+        # the sharded kernel folds one pane per call (replicated scalar);
+        # per-row pane routing is single-chip only — host path for now
         return None
     if w.window_type == ast.WindowType.COUNT_WINDOW:
         if w.interval:
@@ -695,9 +701,21 @@ def _build_device_chain(
         direct_emit=direct, mesh=mesh,
         prefinalize_lead_ms=opts.prefinalize_lead_ms,
         emit_columnar=opts.emit_columnar,
+        is_event_time=opts.is_event_time,
+        late_tolerance_ms=opts.late_tolerance_ms,
     )
     topo.add_op(fused)
-    src.connect(fused)
+    if opts.is_event_time:
+        # event-time: watermark generation + late drop feeds the kernel's
+        # per-row pane routing (columnar all the way)
+        wm = WatermarkNode("watermark",
+                           late_tolerance_ms=opts.late_tolerance_ms,
+                           buffer_length=opts.buffer_length)
+        topo.add_op(wm)
+        src.connect(wm)
+        wm.connect(fused)
+    else:
+        src.connect(fused)
     if direct is not None:
         return fused  # tail ops folded into the vectorized emit
     tail = fused

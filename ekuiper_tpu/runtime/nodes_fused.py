@@ -49,6 +49,8 @@ class FusedWindowAggNode(Node):
         prefinalize_lead_ms: int = 250,  # latency-hiding emit (prefinalize.py)
         emit_columnar: bool = False,  # window result stays a ColumnBatch
         prefinalize_backstop: bool = True,  # host backstop: boundaries never block
+        is_event_time: bool = False,  # watermark-driven panes (see below)
+        late_tolerance_ms: int = 0,
         **kw,
     ) -> None:
         super().__init__(name, op_type="op", **kw)
@@ -60,7 +62,28 @@ class FusedWindowAggNode(Node):
         self.wt = window.window_type
         self.length_ms = window.length_ms()
         self.interval_ms = window.interval_ms()
-        if self.wt == ast.WindowType.HOPPING_WINDOW:
+        self.is_event_time = is_event_time
+        if is_event_time:
+            # event-time tumbling/hopping on device: each row routes to the
+            # pane of its time bucket (bucket = ts // bucket_ms, pane =
+            # bucket % P) and watermarks drive emission — pane count covers
+            # every bucket that can be live at once (window span + late
+            # tolerance + slack), so recycled panes are always emitted+reset
+            # before reuse
+            self.bucket_ms = (self.interval_ms
+                              if self.wt == ast.WindowType.HOPPING_WINDOW
+                              and self.interval_ms else self.length_ms)
+            span = max(self.length_ms // max(self.bucket_ms, 1), 1)
+            slack = -(-max(late_tolerance_ms, 0) // max(self.bucket_ms, 1))
+            self.n_panes = min(max(span + slack + 2, 4), 255)
+            self.window_span = span
+            self._next_emit_bucket: Optional[int] = None
+            self._max_bucket: Optional[int] = None
+            # buckets holding unexpired data — empty windows skip their
+            # device round trip entirely, and time gaps fast-forward in
+            # O(1) instead of emitting per empty bucket
+            self._dirty: set = set()
+        elif self.wt == ast.WindowType.HOPPING_WINDOW:
             iv = max(self.interval_ms, 1)
             self.n_panes = max((self.length_ms + iv - 1) // iv, 1)
         else:
@@ -88,7 +111,8 @@ class FusedWindowAggNode(Node):
         self._pre_timers = []
         self.prefinalize_lead_ms = int(prefinalize_lead_ms)
         self._prefinalize_ok = (
-            self.prefinalize_lead_ms > 0
+            not is_event_time  # watermark boundaries aren't clock-known
+            and self.prefinalize_lead_ms > 0
             and self.gb.supports_prefinalize
             and plan.host_foldable
             and self.wt in (ast.WindowType.TUMBLING_WINDOW,
@@ -134,7 +158,9 @@ class FusedWindowAggNode(Node):
             self.state = self.gb.init_state()
         # register the trigger timer BEFORE the (slow) warmup compile so the
         # first window boundary is anchored at open time, not compile-end
-        if self.wt in (ast.WindowType.TUMBLING_WINDOW, ast.WindowType.HOPPING_WINDOW):
+        if not self.is_event_time and self.wt in (
+            ast.WindowType.TUMBLING_WINDOW, ast.WindowType.HOPPING_WINDOW
+        ):
             self._schedule_next_tick()
 
     def on_worker_start(self) -> None:
@@ -153,8 +179,16 @@ class FusedWindowAggNode(Node):
             }
             slots = np.zeros(1, dtype=np.int32)
             dummy = self.gb.init_state()
-            dummy = self.gb.fold(dummy, cols, slots, pane_idx=self.cur_pane)
-            self.gb.finalize(dummy, 1)
+            if self.is_event_time:
+                # event-time folds ship per-row pane VECTORS and finalize
+                # with traced pane masks — warm those signatures
+                dummy = self.gb.fold(dummy, cols, slots,
+                                     pane_idx=np.zeros(1, dtype=np.int64))
+                self.gb.finalize(dummy, 1, panes=[0])
+            else:
+                dummy = self.gb.fold(dummy, cols, slots,
+                                     pane_idx=self.cur_pane)
+                self.gb.finalize(dummy, 1)
             if self._prefinalize_ok:
                 pending = self.gb.prefinalize_begin(dummy)
                 self.gb.prefinalize_merge(pending, None, 1)
@@ -223,6 +257,13 @@ class FusedWindowAggNode(Node):
             return 0
         idx = np.arange(start, end)
         sub = batch if (start == 0 and end == batch.n) else batch.take(idx)
+        if self.is_event_time:
+            return self._fold_event(sub)
+        return self._fold_rows(sub, self.cur_pane)
+
+    def _fold_rows(self, sub: ColumnBatch, pane_arg) -> int:
+        """Encode keys + build kernel columns + device fold for `sub`,
+        folding into `pane_arg` (scalar pane or per-row pane vector)."""
         # encode group key
         key_cols = []
         for d in self.dims:
@@ -281,7 +322,7 @@ class FusedWindowAggNode(Node):
                 # deferred grow (keys first seen in an earlier frozen span)
                 self.state = self.gb.grow(self.state, self.kt.capacity)
             self.state = self.gb.fold(self.state, cols, slots, valid,
-                                      self.cur_pane)
+                                      pane_arg)
         # every live shadow mirrors the fold (dedup: frozen-span retries and
         # the backstop may share shadow objects)
         seen = set()
@@ -290,6 +331,116 @@ class FusedWindowAggNode(Node):
                 seen.add(id(shadow))
                 shadow.fold(cols, slots, valid)
         return sub.n
+
+    # ------------------------------------------------------------ event time
+    def _fold_event(self, sub: ColumnBatch) -> int:
+        """Per-row pane routing for event-time windows: bucket = ts //
+        bucket_ms, pane = bucket % P. Rows for already-emitted buckets drop
+        (their pane may be recycled). A batch spanning more buckets than the
+        pane budget folds IN ORDER: fold what fits, emit the oldest pending
+        window to free its pane, continue — so a recycled pane is always
+        emitted+reset before new rows land in it."""
+        ts = sub.timestamps
+        if ts is None:
+            ts = np.zeros(sub.n, dtype=np.int64)
+        buckets = ts // self.bucket_ms
+        if self._next_emit_bucket is None:
+            self._next_emit_bucket = int(buckets.min())
+        late = buckets < self._next_emit_bucket
+        if late.any():
+            n_late = int(late.sum())
+            self.stats.inc_exception("late event dropped (bucket emitted)",
+                                     n=n_late)
+            keep = np.nonzero(~late)[0]
+            if len(keep) == 0:
+                return 0
+            sub = sub.take(keep)
+            buckets = buckets[keep]
+        self._max_bucket = max(int(buckets.max()),
+                               self._max_bucket
+                               if self._max_bucket is not None else -1)
+        total = 0
+        while sub.n:
+            # pane-reuse safety: bucket b is foldable once bucket b-P
+            # expired, i.e. b <= next_emit + P - W
+            limit = (self._next_emit_bucket
+                     + self.n_panes - self.window_span)
+            mask = buckets <= limit
+            idx = np.nonzero(mask)[0]
+            if len(idx):
+                seg = buckets[idx]
+                total += self._fold_rows(
+                    sub if mask.all() else sub.take(idx),
+                    (seg % self.n_panes).astype(np.uint8))
+                self._dirty.update(int(b) for b in np.unique(seg))
+            if mask.all():
+                break
+            # make room for the rest: emit data windows in order, jump
+            # over empty stretches without device round trips. NOTE: rows
+            # within late tolerance that arrive AFTER a pane-pressure
+            # forced emission drop (counted) — bounded panes trade the
+            # host path's unbounded buffering for device residence.
+            self._advance_one()
+            rest = np.nonzero(~mask)[0]
+            sub = sub.take(rest)
+            buckets = buckets[rest]
+        return total
+
+    def _advance_one(self) -> None:
+        """Advance the emission cursor: emit the next window when it can
+        contain data, otherwise jump straight past the empty stretch."""
+        nxt = self._next_emit_bucket
+        if not self._dirty:
+            self._next_emit_bucket = nxt + 1
+            return
+        first = min(self._dirty)
+        if nxt < first:
+            # windows ending before `first` see no data
+            self._next_emit_bucket = first
+            return
+        self._emit_event_bucket(nxt)
+
+    def _emit_event_bucket(self, b: int) -> None:
+        """Emit the window ENDING at bucket b's boundary (tumbling: just b;
+        hopping: the window spanning buckets [b-W+1 .. b]), then expire the
+        oldest pane of that window. Windows with no dirty buckets skip the
+        device round trip entirely."""
+        W = self.window_span
+        window_buckets = range(b - W + 1, b + 1)
+        has_data = any(x in self._dirty for x in window_buckets)
+        n_keys = self.kt.n_keys
+        if has_data and n_keys:
+            end_ms = (b + 1) * self.bucket_ms
+            wr = WindowRange(end_ms - self.length_ms, end_ms)
+            panes = sorted({(x % self.n_panes) for x in window_buckets})
+            outs, act = self.gb.finalize(self.state, n_keys, panes=panes)
+            active = np.nonzero(act > 0)[0]
+            if len(active):
+                if self.direct_emit is not None:
+                    self._emit_direct(outs, active, wr)
+                else:
+                    self._emit_grouped(outs, active, wr)
+        expiring = b - W + 1
+        if expiring in self._dirty:
+            self._dirty.discard(expiring)
+            self.state = self.gb.reset_pane(
+                self.state, expiring % self.n_panes)
+        self._next_emit_bucket = b + 1
+
+    def on_watermark(self, wm) -> None:
+        if self.is_event_time and self._next_emit_bucket is not None:
+            floor_b = wm.ts // self.bucket_ms - 1  # buckets fully below wm
+            while self._next_emit_bucket <= floor_b:
+                if not self._dirty:
+                    self._next_emit_bucket = floor_b + 1
+                    break
+                first = min(self._dirty)
+                if self._next_emit_bucket < first:
+                    # nothing can emit before the first dirty bucket
+                    self._next_emit_bucket = min(first, floor_b + 1)
+                    continue
+                self._emit_event_bucket(self._next_emit_bucket)
+        self.broadcast(wm)
 
     def _fold_count_window(self, batch: ColumnBatch) -> None:
         pos = 0
@@ -374,6 +525,14 @@ class FusedWindowAggNode(Node):
         self._device_frozen = False
 
     def on_eof(self, eof: EOF) -> None:
+        if self.is_event_time:
+            # flush every pending bucket (bounded runs / trials)
+            if self._next_emit_bucket is not None and \
+                    self._max_bucket is not None:
+                while self._next_emit_bucket <= self._max_bucket:
+                    self._emit_event_bucket(self._next_emit_bucket)
+            self.broadcast(eof)
+            return
         now = timex.now_ms()
         self._emit(WindowRange(now - self.length_ms, now))
         if self.wt == ast.WindowType.TUMBLING_WINDOW:
@@ -420,6 +579,11 @@ class FusedWindowAggNode(Node):
         if self.direct_emit is not None:
             self._emit_direct(outs, active, wr)
             return
+        self._emit_grouped(outs, active, wr)
+
+    def _emit_grouped(self, outs, active: np.ndarray, wr: WindowRange) -> None:
+        """Row-path emit tail: build GroupedTuplesSet for downstream
+        HAVING/ORDER/PROJECT nodes."""
         # bulk-convert once (C speed) instead of per-slot numpy scalar access —
         # emit latency is dominated by this host loop at 10k+ groups
         active_list = active.tolist()
@@ -513,12 +677,17 @@ class FusedWindowAggNode(Node):
     def snapshot_state(self) -> Optional[dict]:
         self._flush_tail()
         host = self.gb.state_to_host(self.state)
-        return {
+        snap = {
             "keys": self.kt.decode_all(),
             "partials": {k: v.tolist() for k, v in host.items()},
             "cur_pane": self.cur_pane,
             "rows_in_window": self._rows_in_window,
         }
+        if self.is_event_time:
+            snap["next_emit_bucket"] = self._next_emit_bucket
+            snap["max_bucket"] = self._max_bucket
+            snap["dirty_buckets"] = sorted(self._dirty)
+        return snap
 
     def restore_state(self, state: dict) -> None:
         keys = state.get("keys", [])
@@ -532,3 +701,7 @@ class FusedWindowAggNode(Node):
             self.state = self.gb.state_from_host(host)
         self.cur_pane = state.get("cur_pane", 0)
         self._rows_in_window = state.get("rows_in_window", 0)
+        if self.is_event_time:
+            self._next_emit_bucket = state.get("next_emit_bucket")
+            self._max_bucket = state.get("max_bucket")
+            self._dirty = set(state.get("dirty_buckets", []))

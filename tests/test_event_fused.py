@@ -1,0 +1,244 @@
+"""Event-time windows on the device kernel (per-row pane routing +
+watermark-driven emission) — output parity with the host window path."""
+import time
+
+import numpy as np
+import pytest
+
+from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+from ekuiper_tpu.server.processors import StreamProcessor
+from ekuiper_tpu.store import kv
+import ekuiper_tpu.io.memory as mem
+
+
+def _mk_stream(store):
+    StreamProcessor(store).exec_stmt(
+        'CREATE STREAM ed (deviceId STRING, temperature FLOAT, ts BIGINT) '
+        'WITH (DATASOURCE="ev/d", TYPE="memory", FORMAT="JSON", '
+        'TIMESTAMP="ts")')
+
+
+def _run_rule(store, mock_clock, sql, rows, options, rule_id, wm_rows=None):
+    topo = plan_rule(RuleDef(
+        id=rule_id, sql=sql,
+        actions=[{"memory": {"topic": f"ev/{rule_id}"}}],
+        options=options), store)
+    got = []
+    mem.subscribe(f"ev/{rule_id}", lambda t, p: got.append(p))
+    topo.open()
+    try:
+        for r in rows:
+            mem.publish("ev/d", r)
+        mock_clock.advance(20)
+        assert topo.wait_idle(10)
+        for r in (wm_rows or []):  # watermark pushers
+            mem.publish("ev/d", r)
+            mock_clock.advance(20)
+            assert topo.wait_idle(10)
+        deadline = time.time() + 6
+        while time.time() < deadline and not got:
+            time.sleep(0.02)
+        time.sleep(0.2)
+    finally:
+        topo.close()
+    out = []
+    for p in got:
+        out.extend(p if isinstance(p, list) else [p])
+    return out, topo
+
+
+SQL = ("SELECT deviceId, count(*) AS c, avg(temperature) AS a, "
+       "min(temperature) AS mn FROM ed "
+       "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+SQL_HOP = ("SELECT deviceId, count(*) AS c, avg(temperature) AS a FROM ed "
+           "GROUP BY deviceId, HOPPINGWINDOW(ss, 10, 5)")
+
+ROWS = [
+    {"deviceId": "a", "temperature": 10.0, "ts": 1_000},
+    {"deviceId": "a", "temperature": 20.0, "ts": 6_000},
+    {"deviceId": "b", "temperature": 5.0, "ts": 9_000},
+    {"deviceId": "a", "temperature": 30.0, "ts": 12_000},
+    {"deviceId": "b", "temperature": 7.0, "ts": 15_000},
+]
+PUSHER = [{"deviceId": "z", "temperature": 0.0, "ts": 40_000}]
+
+
+def _norm(msgs):
+    def r2(x):
+        return None if x is None else round(x, 4)
+
+    out = {}
+    for m in msgs:
+        if m["deviceId"] == "z":
+            continue
+        key = (m["deviceId"], m.get("window_end") or 0)
+        out.setdefault(key, []).append(
+            tuple(sorted((k, r2(v) if isinstance(v, float) else v)
+                         for k, v in m.items() if k != "deviceId")))
+    return out
+
+
+class TestEventTimeFusedParity:
+    def _both(self, mock_clock, sql):
+        store = kv.get_store()
+        _mk_stream(store)
+        fused_msgs, fused_topo = _run_rule(
+            store, mock_clock, sql, ROWS,
+            {"isEventTime": True, "lateTolerance": 1000}, "ef",
+            wm_rows=PUSHER)
+        assert any(isinstance(n, FusedWindowAggNode) for n in fused_topo.ops), \
+            "event-time rule did not take the device path"
+        host_msgs, host_topo = _run_rule(
+            store, mock_clock, sql, ROWS,
+            {"isEventTime": True, "lateTolerance": 1000,
+             "use_device_kernel": False}, "eh",
+            wm_rows=PUSHER)
+        assert not any(isinstance(n, FusedWindowAggNode)
+                       for n in host_topo.ops)
+        return fused_msgs, host_msgs
+
+    def test_tumbling(self, mock_clock):
+        fused, host = self._both(mock_clock, SQL)
+        fa = {(m["deviceId"]): (m["c"], round(m["a"], 4), m["mn"])
+              for m in fused if m["deviceId"] != "z"}
+        ha = {}
+        for m in host:
+            if m["deviceId"] != "z":
+                ha.setdefault(m["deviceId"], []).append(
+                    (m["c"], round(m["a"], 4), m["mn"]))
+        # every fused (device, window) result appears in the host output
+        for m in fused:
+            if m["deviceId"] == "z":
+                continue
+            assert (m["c"], round(m["a"], 4), m["mn"]) in \
+                ha.get(m["deviceId"], []), (m, host)
+        # same total group-windows emitted
+        n_f = sum(1 for m in fused if m["deviceId"] != "z")
+        n_h = sum(1 for m in host if m["deviceId"] != "z")
+        assert n_f == n_h, (fused, host)
+
+    def test_hopping(self, mock_clock):
+        fused, host = self._both(mock_clock, SQL_HOP)
+
+        def collect(msgs):
+            out = {}
+            for m in msgs:
+                if m["deviceId"] == "z":
+                    continue
+                out.setdefault(m["deviceId"], []).append(
+                    (m["c"], round(m["a"], 4)))
+            return {k: sorted(v) for k, v in out.items()}
+
+        assert collect(fused) == collect(host), (fused, host)
+
+
+class TestEventTimeFusedMechanics:
+    def test_late_rows_dropped_after_emit(self, mock_clock):
+        store = kv.get_store()
+        _mk_stream(store)
+        rows = [
+            {"deviceId": "a", "temperature": 1.0, "ts": 1_000},
+        ]
+        topo = plan_rule(RuleDef(
+            id="lt1", sql=SQL, actions=[{"memory": {"topic": "ev/lt1"}}],
+            options={"isEventTime": True, "lateTolerance": 0}), store)
+        got = []
+        mem.subscribe("ev/lt1", lambda t, p: got.append(p))
+        topo.open()
+        try:
+            mem.publish("ev/d", rows[0])
+            mock_clock.advance(20)
+            assert topo.wait_idle(10)
+            # push watermark past window 1 -> emit (a,c=1)
+            mem.publish("ev/d", {"deviceId": "z", "temperature": 0.0,
+                                 "ts": 25_000})
+            mock_clock.advance(20)
+            assert topo.wait_idle(10)
+            # a very late row for the emitted window must be dropped by the
+            # watermark node / kernel, not corrupt a recycled pane
+            mem.publish("ev/d", {"deviceId": "a", "temperature": 99.0,
+                                 "ts": 1_500})
+            mock_clock.advance(20)
+            assert topo.wait_idle(10)
+            mem.publish("ev/d", {"deviceId": "z", "temperature": 0.0,
+                                 "ts": 60_000})
+            mock_clock.advance(20)
+            assert topo.wait_idle(10)
+            deadline = time.time() + 6
+            while time.time() < deadline and not got:
+                time.sleep(0.02)
+            time.sleep(0.2)
+        finally:
+            topo.close()
+        msgs = []
+        for p in got:
+            msgs.extend(p if isinstance(p, list) else [p])
+        a_msgs = [m for m in msgs if m["deviceId"] == "a"]
+        assert a_msgs == [{"deviceId": "a", "c": 1, "a": 1.0, "mn": 1.0}], msgs
+
+    def test_pane_overflow_forces_emission(self, mock_clock):
+        """A burst spanning more buckets than panes must force-emit the
+        oldest windows rather than corrupt recycled panes."""
+        from ekuiper_tpu.data.batch import from_tuples
+        from ekuiper_tpu.data.rows import Tuple
+        from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+        from ekuiper_tpu.sql.parser import parse_select
+
+        stmt = parse_select(SQL.replace("FROM ed", "FROM s"))
+        plan = extract_kernel_plan(stmt)
+        node = FusedWindowAggNode(
+            "t", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+            capacity=64, micro_batch=32, is_event_time=True,
+            late_tolerance_ms=0)
+        node.state = node.gb.init_state()
+        emitted = []
+        node.broadcast = lambda item: emitted.append(item)
+        # n_panes buckets + 3 more in one stream of batches
+        n = node.n_panes + 3
+        rows = [Tuple(emitter="s",
+                      message={"deviceId": "d", "temperature": float(i)},
+                      timestamp=i * 10_000 + 500)
+                for i in range(n)]
+        node.process(from_tuples(rows, emitter="s"))
+        # forced emissions happened for the overflowed buckets
+        assert len(emitted) >= 3
+        assert node._next_emit_bucket > 0
+
+    def test_time_gap_skips_empty_windows(self, mock_clock):
+        """An overnight gap (or outlier timestamp) must fast-forward, not
+        emit one device round trip per empty bucket."""
+        from ekuiper_tpu.data.batch import from_tuples
+        from ekuiper_tpu.data.rows import Tuple
+        from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+        from ekuiper_tpu.runtime.events import Watermark
+        from ekuiper_tpu.sql.parser import parse_select
+
+        stmt = parse_select(SQL.replace("FROM ed", "FROM s"))
+        plan = extract_kernel_plan(stmt)
+        node = FusedWindowAggNode(
+            "t", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+            capacity=64, micro_batch=32, is_event_time=True,
+            late_tolerance_ms=0)
+        node.state = node.gb.init_state()
+        emitted = []
+        node.broadcast = lambda item: emitted.append(item)
+        calls = {"n": 0}
+        orig = node.gb.finalize
+
+        def counting(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+
+        node.gb.finalize = counting
+        mk = lambda ts: from_tuples([Tuple(
+            emitter="s", message={"deviceId": "d", "temperature": 1.0},
+            timestamp=ts)], emitter="s")
+        node.process(mk(1_000))
+        node.on_watermark(Watermark(ts=15_000))       # emits window 1
+        # 100k buckets later (11+ days at 10s buckets)
+        node.process(mk(1_000_000_000))
+        node.on_watermark(Watermark(ts=1_000_020_000))
+        data_windows = [i for i in emitted if not isinstance(i, Watermark)]
+        assert len(data_windows) == 2
+        assert calls["n"] <= 4, calls  # no per-empty-bucket device calls
