@@ -94,6 +94,56 @@ def bench_rule_group(batches, kt_slots) -> None:
     )
 
 
+def bench_event_time(batches, kt_slots) -> None:
+    """Event-time device path: per-row pane routing + watermark-driven
+    emission. Prints a stderr metric line."""
+    import jax
+    from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+    from ekuiper_tpu.ops.emit import build_direct_emit
+    from ekuiper_tpu.runtime.events import Watermark
+    from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+    from ekuiper_tpu.sql.parser import parse_select
+
+    stmt = parse_select(SQL)
+    plan = extract_kernel_plan(stmt)
+    node = FusedWindowAggNode(
+        "ev", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+        capacity=kt_slots, micro_batch=BATCH_ROWS,
+        direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+        emit_columnar=True, is_event_time=True, late_tolerance_ms=1000)
+    from ekuiper_tpu.data.batch import ColumnBatch
+
+    node.state = node.gb.init_state()
+    emitted = []
+    node.broadcast = lambda item: emitted.append(item)
+
+    def stamped(i):  # event timestamps advance ~1s/batch -> window per ~10
+        b = batches[i % 4]
+        return ColumnBatch(n=b.n, columns=b.columns,
+                           timestamps=np.full(b.n, i * 1000, dtype=np.int64),
+                           emitter=b.emitter)
+
+    node.process(stamped(0))
+    node.on_watermark(Watermark(ts=0))
+    jax.block_until_ready(node.state)
+    rows = 0
+    n = 1
+    t0 = time.time()
+    while time.time() - t0 < 10.0:
+        node.process(stamped(n))
+        node.on_watermark(Watermark(ts=n * 1000 - 1000))
+        rows += BATCH_ROWS
+        n += 1
+    jax.block_until_ready(node.state)
+    elapsed = time.time() - t0
+    n_windows = sum(1 for i in emitted if not isinstance(i, Watermark))
+    print(
+        f"# event-time device path: {rows:,} rows in {elapsed:.2f}s "
+        f"({rows / elapsed:,.0f} rows/s), {n_windows} watermark-driven "
+        f"window emits", file=sys.stderr,
+    )
+
+
 def main() -> None:
     from ekuiper_tpu.data.batch import ColumnBatch
     from ekuiper_tpu.ops.aggspec import extract_kernel_plan
@@ -211,6 +261,7 @@ def main() -> None:
         f"groups/window={N_DEVICES}; device={jax.devices()[0].device_kind}",
         file=sys.stderr,
     )
+    bench_event_time(batches, KEY_SLOTS)
     bench_rule_group(batches, KEY_SLOTS)
 
     print(json.dumps({

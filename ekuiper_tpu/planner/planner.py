@@ -173,6 +173,18 @@ def device_path_eligible(
         # the sharded kernel folds one pane per call (replicated scalar);
         # per-row pane routing is single-chip only — host path for now
         return None
+    if opts.is_event_time and w.window_type in (
+        ast.WindowType.TUMBLING_WINDOW, ast.WindowType.HOPPING_WINDOW
+    ):
+        # pane ids ship as uint8 — shapes needing >255 live panes (window
+        # span + late-tolerance slack) stay on the host buffering path
+        bucket = (w.interval_ms()
+                  if w.window_type == ast.WindowType.HOPPING_WINDOW
+                  and w.interval_ms() else w.length_ms())
+        span = max(w.length_ms() // max(bucket, 1), 1)
+        slack = -(-max(opts.late_tolerance_ms, 0) // max(bucket, 1))
+        if max(span + slack + 2, 4) > 255:
+            return None
     if w.window_type == ast.WindowType.COUNT_WINDOW:
         if w.interval:
             return None  # overlapping count windows -> host buffering

@@ -75,7 +75,15 @@ class FusedWindowAggNode(Node):
                               and self.interval_ms else self.length_ms)
             span = max(self.length_ms // max(self.bucket_ms, 1), 1)
             slack = -(-max(late_tolerance_ms, 0) // max(self.bucket_ms, 1))
-            self.n_panes = min(max(span + slack + 2, 4), 255)
+            self.n_panes = max(span + slack + 2, 4)
+            if self.n_panes > 255:
+                # pane ids ship as uint8; the planner routes such shapes to
+                # the host path (device_path_eligible) — direct construction
+                # fails loudly rather than corrupting pane routing
+                raise ValueError(
+                    f"event-time window needs {self.n_panes} panes "
+                    "(max 255): widen the hop interval or reduce "
+                    "lateTolerance")
             self.window_span = span
             self._next_emit_bucket: Optional[int] = None
             self._max_bucket: Optional[int] = None
@@ -380,18 +388,22 @@ class FusedWindowAggNode(Node):
             # within late tolerance that arrive AFTER a pane-pressure
             # forced emission drop (counted) — bounded panes trade the
             # host path's unbounded buffering for device residence.
-            self._advance_one()
             rest = np.nonzero(~mask)[0]
             sub = sub.take(rest)
             buckets = buckets[rest]
+            self._advance_one(int(buckets.min()))
         return total
 
-    def _advance_one(self) -> None:
-        """Advance the emission cursor: emit the next window when it can
-        contain data, otherwise jump straight past the empty stretch."""
+    def _advance_one(self, needed_bucket: int) -> None:
+        """Advance the emission cursor toward making `needed_bucket`
+        foldable: emit the next window when it can contain data, otherwise
+        JUMP the empty stretch in O(1) (an outlier timestamp must not spin
+        one iteration per empty bucket)."""
         nxt = self._next_emit_bucket
         if not self._dirty:
-            self._next_emit_bucket = nxt + 1
+            self._next_emit_bucket = max(
+                nxt + 1,
+                needed_bucket - (self.n_panes - self.window_span))
             return
         first = min(self._dirty)
         if nxt < first:
@@ -526,11 +538,15 @@ class FusedWindowAggNode(Node):
 
     def on_eof(self, eof: EOF) -> None:
         if self.is_event_time:
-            # flush every pending bucket (bounded runs / trials)
-            if self._next_emit_bucket is not None and \
-                    self._max_bucket is not None:
-                while self._next_emit_bucket <= self._max_bucket:
-                    self._emit_event_bucket(self._next_emit_bucket)
+            # flush every window that can still contain data (bounded
+            # runs / trials) — iterate the dirty set, never bucket-by-bucket
+            # across gaps
+            while self._dirty:
+                first = min(self._dirty)
+                nxt = self._next_emit_bucket
+                self._next_emit_bucket = first if nxt is None else max(nxt,
+                                                                       first)
+                self._emit_event_bucket(self._next_emit_bucket)
             self.broadcast(eof)
             return
         now = timex.now_ms()
