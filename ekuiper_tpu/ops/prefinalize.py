@@ -307,6 +307,7 @@ class PendingFinalize:
 
     def __init__(self, stacked: Any, capacity: int, layout) -> None:
         import threading
+        import time
 
         self.stacked = stacked  # one (capacity, W) device array = one leaf
         self.capacity = capacity
@@ -314,11 +315,16 @@ class PendingFinalize:
         self._result: Optional[Dict[str, np.ndarray]] = None
         self._exc: Optional[BaseException] = None
         self._done = threading.Event()
+        # telemetry for the emit path: when the fetch was issued / landed
+        self.t_created = time.time()
+        self.t_done: Optional[float] = None
         threading.Thread(
             target=self._fetch, name="prefinalize-fetch", daemon=True
         ).start()
 
     def _fetch(self) -> None:
+        import time
+
         try:
             arr = np.asarray(self.stacked)
             cap = arr.shape[0]
@@ -330,10 +336,17 @@ class PendingFinalize:
         except BaseException as exc:  # surfaced to the emit thread
             self._exc = exc
         finally:
+            self.t_done = time.time()
             self._done.set()
 
     def ready(self) -> bool:
         return self._done.is_set()
+
+    def fetch_ms(self) -> float:
+        """Issue→landed latency (telemetry); -1 while still in flight."""
+        if self.t_done is None:
+            return -1.0
+        return (self.t_done - self.t_created) * 1000.0
 
     def get(self) -> Dict[str, np.ndarray]:
         self._done.wait()

@@ -38,21 +38,23 @@ def make_mesh(
     return Mesh(arr, ("rows", "keys"))
 
 
-def ensure_devices(n: int, allow_backend_reset: bool = False):
+def ensure_devices(n: int):
     """Return at least n jax devices, provisioning virtual CPU devices when
     the host has fewer physical chips.
 
     Order of preference: real devices of the default platform; an existing
     CPU backend with >= n devices; a fresh CPU backend forced to n devices
-    via the jax_num_cpu_devices config. The sharded path takes explicit
-    devices everywhere, so the default platform does not need to change —
-    a mesh of CPU devices runs on CPU even while the TPU stays default.
+    via the jax_num_cpu_devices config (only possible before the CPU
+    backend initializes — tests/conftest.py and the dryrun subprocess set
+    JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count up front).
 
-    allow_backend_reset: when the CPU device count is already locked in,
-    provisioning requires clearing ALL initialized jax backends — which
-    invalidates every live device array process-wide. Only standalone
-    entry points (the driver dryrun) may do that; the planner must never
-    (a running rule's state lives on those backends)."""
+    This function NEVER resets initialized backends: a running rule's
+    state lives on those backends, and clearing them invalidates every
+    live device array process-wide (it also broke the driver dryrun twice
+    — a cleared TPU client re-initialized into a libtpu version mismatch).
+    Callers that need an n-device mesh the current process cannot provide
+    must run in a fresh subprocess instead (see __graft_entry__.
+    dryrun_multichip)."""
     import jax
 
     if n < 1:
@@ -66,32 +68,18 @@ def ensure_devices(n: int, allow_backend_reset: bool = False):
             return cpus[:n]
     except RuntimeError:
         pass
-
-    def _reset_backends():
-        from jax._src import xla_bridge as xb
-
-        xb._clear_backends()
-        # get_backend memoizes clients independently of _backends; without
-        # this the old 1-device CPU client survives the clear
-        if hasattr(xb.get_backend, "cache_clear"):
-            xb.get_backend.cache_clear()
-
     try:
         jax.config.update("jax_num_cpu_devices", n)
-    except RuntimeError:
+    except RuntimeError as e:
         # CPU count already locked in by an initialized backend
-        if not allow_backend_reset:
-            raise RuntimeError(
-                f"host has {len(devs)} devices and the jax backend is "
-                f"already initialized; cannot provision {n} virtual CPU "
-                "devices without resetting live backends"
-            )
-        _reset_backends()
-        jax.config.update("jax_num_cpu_devices", n)
+        raise RuntimeError(
+            f"host has {len(devs)} devices and the jax backend is "
+            f"already initialized; cannot provision {n} virtual CPU "
+            "devices in-process — run in a subprocess with "
+            f"JAX_PLATFORMS=cpu and "
+            f"--xla_force_host_platform_device_count={n}"
+        ) from e
     cpus = jax.devices("cpu")
-    if len(cpus) < n and allow_backend_reset:
-        _reset_backends()
-        cpus = jax.devices("cpu")
     if len(cpus) < n:
         raise RuntimeError(
             f"could not provision {n} devices (got {len(cpus)} cpu)"

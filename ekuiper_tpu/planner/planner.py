@@ -84,6 +84,8 @@ def merged_options(rule: RuleDef) -> RuleOptionConfig:
         "concurrency": "concurrency",
         "debug": "debug",
         "planOptimizeStrategy": "plan_optimize_strategy",
+        "tailMode": "tail_mode",
+        "prefinalizeLeadMs": "prefinalize_lead_ms",
     }
     for k, v in rule.options.items():
         key = alias.get(k, k)
@@ -712,6 +714,7 @@ def _build_device_chain(
         rule_id=rule_id, buffer_length=opts.buffer_length,
         direct_emit=direct, mesh=mesh,
         prefinalize_lead_ms=opts.prefinalize_lead_ms,
+        tail_mode=opts.tail_mode,
         emit_columnar=opts.emit_columnar,
         is_event_time=opts.is_event_time,
         late_tolerance_ms=opts.late_tolerance_ms,

@@ -49,6 +49,7 @@ class FusedWindowAggNode(Node):
         prefinalize_lead_ms: int = 250,  # latency-hiding emit (prefinalize.py)
         emit_columnar: bool = False,  # window result stays a ColumnBatch
         prefinalize_backstop: bool = True,  # host backstop: boundaries never block
+        tail_mode: str = "device",  # window-tail rows: "device" | "host"
         is_event_time: bool = False,  # watermark-driven panes (see below)
         late_tolerance_ms: int = 0,
         **kw,
@@ -127,22 +128,48 @@ class FusedWindowAggNode(Node):
                             ast.WindowType.HOPPING_WINDOW)
             and self.prefinalize_lead_ms < self._tick_interval()
         )
-        # tumbling tail rows die at the boundary reset, so once a pre-issue
-        # freezes the device snapshot they fold into host shadows ONLY —
-        # zero upload traffic competing with the result fetch on a tunneled
-        # link. A checkpoint barrier in the frozen span flushes the frozen
-        # span's shadow back to the device (absorb).
+        # Window-tail handling after a pre-issue freezes a snapshot:
+        #
+        # "device" (default): tail rows keep folding into the device state
+        #   AND into the pre-issue's host shadow. The emitted window =
+        #   snapshot ⊕ shadow counts each row exactly once (the snapshot
+        #   excludes tail rows, the shadow holds exactly them); the device
+        #   state stays COMPLETE at all times, so checkpoints need no
+        #   flush-back and hopping panes retain tail rows for later windows.
+        #
+        # "host": tumbling-only. Tail rows die at the boundary reset anyway,
+        #   so once a pre-issue freezes the snapshot they fold into host
+        #   shadows ONLY — zero upload traffic competing with the result
+        #   fetch. Useful when the host→device link is SATURATED (a tunnel
+        #   at full ingest rate): the fetch needs a quiet channel to land.
+        #   A checkpoint barrier in the frozen span flushes the frozen
+        #   span's shadow back to the device (absorb).
+        if tail_mode not in ("device", "host"):
+            raise ValueError(
+                f"tail_mode must be 'device' or 'host', got {tail_mode!r}")
+        self.tail_mode = tail_mode
         self._tail_host_only = (
-            self._prefinalize_ok and self.wt == ast.WindowType.TUMBLING_WINDOW
+            self._prefinalize_ok and tail_mode == "host"
+            and self.wt == ast.WindowType.TUMBLING_WINDOW
         )
         self._device_frozen = False  # set at the first real pre-issue
         # backstop: every window opens with an always-ready identity entry
         # plus a window-spanning shadow, so a boundary NEVER blocks on the
         # device link — the device result is preferred whenever its fetch
         # lands (steady state), the backstop serves link-stall windows.
-        self._backstop = bool(prefinalize_backstop) and self._tail_host_only
+        # Tumbling-only: a hopping window spans panes older than the last
+        # boundary, which a boundary-started shadow cannot represent.
+        self._backstop_ok = (
+            self._prefinalize_ok
+            and self.wt == ast.WindowType.TUMBLING_WINDOW
+        )
+        self._backstop = bool(prefinalize_backstop) and self._backstop_ok
         # telemetry: the last boundary found no landed device fetch
         self._storm = False
+        # per-boundary record: {"source": "device"|"backstop"|"sync",
+        #  "fetch_ms": issue→landed ms of the chosen fetch (-1 in flight),
+        #  "ages_ms": [age of each real pre-issue at the boundary]}
+        self.last_emit_info: Optional[dict] = None
         self._identity = None  # cached IdentityFinalize (immutable, per capacity)
 
     def _make_gb(self, plan, capacity: int, micro_batch: int, mesh):
@@ -485,7 +512,11 @@ class FusedWindowAggNode(Node):
         # backstop identity never suppresses probes
         if real and real[-1][0].ready():
             return
-        if len(self._pipeline) >= 4:
+        # at most 2 un-landed device fetches: each is a full components
+        # download occupying the (serialized, RTT-bound) device link —
+        # stacking more on a congested link compounds the backlog until
+        # fetches lag the stream by whole windows (r02 bench post-mortem)
+        if len(self._pipeline) >= 4 or len(real) >= 2:
             return
         if real and self._device_frozen:
             # device state unchanged since the first real pre-issue (frozen
@@ -519,9 +550,13 @@ class FusedWindowAggNode(Node):
         device link. Active for every window when the backstop is enabled;
         otherwise only after a boundary whose fetches all missed (storm).
         Real pre-issues still run and are preferred when they land."""
-        if not (self._tail_host_only and self.kt.n_keys):
+        if not (self._backstop_ok and self.kt.n_keys):
             return
-        if not (self._backstop or self._storm):
+        if not self._backstop:
+            # prefinalize_backstop=False means strictly synchronous
+            # boundaries: the caller chose to WAIT on the device fetch
+            # (throughput benches, strict device-served accounting) — a
+            # storm must not silently re-arm host-shadow serving
             return
         from ..ops.prefinalize import HostShadow, IdentityFinalize
 
@@ -561,8 +596,11 @@ class FusedWindowAggNode(Node):
         frozen, self._device_frozen = self._device_frozen, False
         n_keys = self.kt.n_keys
         if n_keys == 0:
+            self.last_emit_info = None  # no stale record for empty windows
             return
         if pipeline:
+            import time as _time
+
             from ..ops.prefinalize import IdentityFinalize
 
             # newest READY pre-issue wins (prefer real device fetches over
@@ -576,19 +614,36 @@ class FusedWindowAggNode(Node):
                 ((p, s) for p, s in reversed(pipeline) if p.ready()),
                 pipeline[0],
             )
-            self._storm = self._tail_host_only and bool(real) and not any(
+            self._storm = self._backstop_ok and bool(real) and not any(
                 p.ready() for p, _ in real
             )
+            now = _time.time()
+            self.last_emit_info = {
+                "source": ("backstop"
+                           if isinstance(chosen[0], IdentityFinalize)
+                           else "device"),
+                "fetch_ms": (chosen[0].fetch_ms()
+                             if hasattr(chosen[0], "fetch_ms") else 0.0),
+                "ages_ms": [(now - p.t_created) * 1000.0
+                            for p, _ in real if hasattr(p, "t_created")],
+            }
             try:
                 outs, act = self.gb.prefinalize_merge(
                     chosen[0], chosen[1], n_keys)
+                if hasattr(chosen[0], "fetch_ms"):
+                    # merge may have blocked on an un-landed fetch; record
+                    # the real issue→landed latency, not the -1 sentinel
+                    self.last_emit_info["fetch_ms"] = chosen[0].fetch_ms()
             except Exception as exc:
                 logger.warning("prefinalize merge failed, sync fallback: %s", exc)
                 if frozen and real:
                     self._flush_shadow(real[0][1])
                 outs, act = self.gb.finalize(self.state, n_keys)
+                self.last_emit_info["source"] = "sync"
         else:
             outs, act = self.gb.finalize(self.state, n_keys)
+            self.last_emit_info = {"source": "sync", "fetch_ms": 0.0,
+                                   "ages_ms": []}
         active = np.nonzero(act > 0)[0]
         if len(active) == 0:
             return

@@ -238,43 +238,66 @@ class TestColumnarNulls:
         assert msgs == dict_msgs
 
 
-class TestNodePrefinalize:
-    def test_node_emits_via_pretrigger(self):
-        """Drive FusedWindowAggNode through PreTrigger→data→Trigger and
-        assert the merged emit matches a sync-emit node on the same data."""
-        from ekuiper_tpu.data.batch import ColumnBatch
-        from ekuiper_tpu.ops.emit import build_direct_emit
-        from ekuiper_tpu.runtime.events import PreTrigger, Trigger
-        from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+def _node_bits():
+    from ekuiper_tpu.data.batch import ColumnBatch
+    from ekuiper_tpu.ops.emit import build_direct_emit
+    from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
 
-        sql = ("SELECT deviceId, avg(temp) AS a, count(*) AS c FROM s "
-               "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
-        stmt = parse_select(sql)
+    sql = ("SELECT deviceId, avg(temp) AS a, count(*) AS c FROM s "
+           "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+    stmt = parse_select(sql)
+    rng = np.random.default_rng(9)
+
+    def mkbatch(n):
+        keys = np.array([f"d{i}" for i in rng.integers(0, 5, n)],
+                        dtype=np.object_)
+        return ColumnBatch(
+            n=n, columns={"deviceId": keys,
+                          "temp": rng.normal(20, 5, n).astype(np.float32)},
+            timestamps=np.zeros(n, dtype=np.int64), emitter="s")
+
+    def mknode(prefinalize, tail_mode="device"):
         plan = extract_kernel_plan(stmt)
-        direct = build_direct_emit(stmt, plan, ["deviceId"])
-        rng = np.random.default_rng(9)
+        node = FusedWindowAggNode(
+            "t", stmt.window, plan,
+            dims=[d.expr for d in stmt.dimensions], capacity=64,
+            micro_batch=32,
+            direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+            prefinalize_lead_ms=250 if prefinalize else 0,
+            tail_mode=tail_mode,
+        )
+        node.state = node.gb.init_state()
+        got = []
+        node.broadcast = lambda item: got.append(item)
+        return node, got
 
-        def mkbatch(n):
-            keys = np.array([f"d{i}" for i in rng.integers(0, 5, n)],
-                            dtype=np.object_)
-            return ColumnBatch(
-                n=n, columns={"deviceId": keys,
-                              "temp": rng.normal(20, 5, n).astype(np.float32)},
-                timestamps=np.zeros(n, dtype=np.int64), emitter="s")
+    return stmt, mkbatch, mknode
 
+
+def _flat(items):
+    out = []
+    for item in items:
+        out.extend(item if isinstance(item, list) else [item])
+    return {(m.message if hasattr(m, "message") else m)["deviceId"]:
+            (round((m.message if hasattr(m, "message") else m)["a"], 3),
+             (m.message if hasattr(m, "message") else m)["c"])
+            for m in out}
+
+
+class TestNodePrefinalize:
+    @pytest.mark.parametrize("tail_mode", ["device", "host"])
+    def test_node_emits_via_pretrigger(self, tail_mode):
+        """Drive FusedWindowAggNode through PreTrigger→data→Trigger and
+        assert the merged emit matches a sync-emit node on the same data,
+        for both tail modes (device: tail rows fold to device AND shadow;
+        host: device frozen at pre-issue, tail rows shadow-only)."""
+        from ekuiper_tpu.runtime.events import PreTrigger, Trigger
+
+        _, mkbatch, mknode = _node_bits()
         batches = [mkbatch(40) for _ in range(4)]
 
         def run(prefinalize):
-            node = FusedWindowAggNode(
-                "t", stmt.window, extract_kernel_plan(stmt),
-                dims=[d.expr for d in stmt.dimensions], capacity=64,
-                micro_batch=32, direct_emit=build_direct_emit(
-                    stmt, extract_kernel_plan(stmt), ["deviceId"]),
-                prefinalize_lead_ms=250 if prefinalize else 0,
-            )
-            node.state = node.gb.init_state()
-            got = []
-            node.broadcast = lambda item: got.append(item)
+            node, got = mknode(prefinalize, tail_mode)
             node.process(batches[0])
             node.process(batches[1])
             if prefinalize:
@@ -288,17 +311,54 @@ class TestNodePrefinalize:
         sync = run(False)
         merged = run(True)
         assert len(sync) == len(merged) > 0
+        assert _flat(sync) == _flat(merged)
 
-        def flat(items):
-            out = []
-            for item in items:
-                out.extend(item if isinstance(item, list) else [item])
-            return {(m.message if hasattr(m, "message") else m)["deviceId"]:
-                    (round((m.message if hasattr(m, "message") else m)["a"], 3),
-                     (m.message if hasattr(m, "message") else m)["c"])
-                    for m in out}
+    def test_device_tail_mode_across_windows(self):
+        """Device tail mode: rows arriving after the pre-issue fold into
+        both device state and shadow; the boundary reset must leave the
+        NEXT window counting only its own rows (no loss, no double
+        count), across several consecutive windows."""
+        from ekuiper_tpu.runtime.events import PreTrigger, Trigger
 
-        assert flat(sync) == flat(merged)
+        _, mkbatch, mknode = _node_bits()
+        batches = [mkbatch(40) for _ in range(8)]
+        node, got = mknode(True, "device")
+        sync_node, sync_got = mknode(False, "device")
+        for w in range(4):
+            for i in range(2):
+                node.process(batches[2 * w + i])
+                sync_node.process(batches[2 * w + i])
+                if i == 0:
+                    node.on_pre_trigger(PreTrigger(ts=10_000 * (w + 1)))
+            node.on_trigger(Trigger(ts=10_000 * (w + 1)))
+            sync_node.on_trigger(Trigger(ts=10_000 * (w + 1)))
+        assert len(got) == len(sync_got) == 4
+        for a, b in zip(got, sync_got):
+            assert _flat([a]) == _flat([b])
+
+    def test_inflight_fetch_cap(self):
+        """No more than two un-landed device fetches may stack: each is a
+        full components download on a serialized link (r02 post-mortem)."""
+        from ekuiper_tpu.ops.prefinalize import IdentityFinalize, PendingFinalize
+        from ekuiper_tpu.runtime.events import PreTrigger
+
+        _, mkbatch, mknode = _node_bits()
+        node, _ = mknode(True, "device")
+        node.process(mkbatch(40))
+
+        class NeverReady(PendingFinalize):
+            def ready(self):
+                return False
+
+        orig = node.gb.prefinalize_begin
+        node.gb.prefinalize_begin = lambda state, panes=None: NeverReady(
+            orig(state, panes).stacked, node.gb.capacity,
+            node.gb._components_layout())
+        for _ in range(5):
+            node.on_pre_trigger(PreTrigger(ts=10_000))
+        real = [e for e in node._pipeline
+                if not isinstance(e[0], IdentityFinalize)]
+        assert len(real) == 2
 
 
 class TestKeyTableFastPath:

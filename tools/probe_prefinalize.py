@@ -1,0 +1,140 @@
+"""Diagnostic probe for the prefinalize fetch path (VERDICT r2 weak #1).
+
+Question: why did 50/50 bench windows find NO landed device fetch
+(BENCH_r02 `storm windows=50`) despite 0.44-1.33s of lead time, when a
+sync finalize takes ~160ms with an idle host?
+
+Hypotheses probed, each under (a) idle main thread and (b) a main thread
+spinning the same numpy work the bench does (HostShadow bincounts + key
+encode):
+  1. thread-fetch: the r2 design — a Python thread blocking in
+     np.asarray(stacked). If (b) is much slower than (a), the blocking
+     wait is GIL-starved.
+  2. is_ready-poll: no thread — copy_to_host_async at dispatch, poll
+     jax.Array.is_ready() from the main loop, np.asarray at the boundary.
+     Measures boundary-time asarray cost after is_ready() goes true.
+
+Run on the real TPU: python tools/probe_prefinalize.py
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+BATCH = 65_536
+CAP = 16_384
+
+
+def make_gb():
+    from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+    from ekuiper_tpu.ops.groupby import DeviceGroupBy
+    from ekuiper_tpu.sql.parser import parse_select
+
+    stmt = parse_select(
+        "SELECT deviceId, avg(temperature) AS a, count(*) AS c, "
+        "min(temperature) AS mn, max(temperature) AS mx "
+        "FROM demo GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"
+    )
+    plan = extract_kernel_plan(stmt)
+    return DeviceGroupBy(plan, capacity=CAP, micro_batch=BATCH)
+
+
+def busy_host_work(stop: threading.Event, slots, vals):
+    """Mimic the bench's per-batch host load: shadow bincounts + a dict
+    encode pass. Runs until stop is set; returns iterations."""
+    it = 0
+    acc = np.zeros(CAP, dtype=np.float64)
+    while not stop.is_set():
+        acc += np.bincount(slots, weights=vals, minlength=CAP)[:CAP]
+        acc += np.bincount(slots, weights=vals * vals, minlength=CAP)[:CAP]
+        np.minimum.at(acc, slots[:1024], vals[:1024])
+        d = {}
+        for x in range(3000):
+            d[x] = x
+        it += 1
+    return it
+
+
+def probe(mode: str, busy: bool, gb, state, reps: int = 5):
+    import jax
+
+    rng = np.random.default_rng(0)
+    slots = rng.integers(0, CAP, BATCH).astype(np.int32)
+    vals = rng.normal(20, 5, BATCH).astype(np.float32)
+    out = []
+    for _ in range(reps):
+        stop = threading.Event()
+        worker = None
+        if busy:
+            worker = threading.Thread(
+                target=busy_host_work, args=(stop, slots, vals), daemon=True
+            )
+        t0 = time.time()
+        stacked = gb._components(state, (True,))
+        try:
+            stacked.copy_to_host_async()
+        except AttributeError:
+            pass
+        if mode == "thread":
+            done = threading.Event()
+            res = {}
+
+            def fetch():
+                res["a"] = np.asarray(stacked)
+                done.set()
+
+            threading.Thread(target=fetch, daemon=True).start()
+            if worker:
+                worker.start()
+            while not done.is_set():
+                time.sleep(0.001)
+                if time.time() - t0 > 10:
+                    break
+            t_ready = time.time() - t0
+            t_get = 0.0
+        else:  # is_ready poll
+            if worker:
+                worker.start()
+            while not stacked.is_ready():
+                time.sleep(0.001)
+                if time.time() - t0 > 10:
+                    break
+            t_ready = time.time() - t0
+            t1 = time.time()
+            np.asarray(stacked)
+            t_get = time.time() - t1
+        stop.set()
+        out.append((t_ready * 1000, t_get * 1000))
+    lab = f"{mode:>8} busy={int(busy)}"
+    r = np.array(out)
+    print(
+        f"{lab}: ready p50={np.percentile(r[:, 0], 50):7.1f}ms "
+        f"max={r[:, 0].max():7.1f}ms; boundary-get "
+        f"p50={np.percentile(r[:, 1], 50):6.1f}ms max={r[:, 1].max():6.1f}ms"
+    )
+
+
+def main():
+    import jax
+
+    print(f"device: {jax.devices()[0].device_kind}")
+    gb = make_gb()
+    state = gb.init_state()
+    rng = np.random.default_rng(0)
+    slots = rng.integers(0, CAP, BATCH).astype(np.int32)
+    cols = {"temperature": rng.normal(20, 5, BATCH).astype(np.float32)}
+    state = gb.fold(state, cols, slots)
+    # warm the components program + transfer path
+    np.asarray(gb._components(state, (True,)))
+    for mode in ("thread", "is_ready"):
+        for busy in (False, True):
+            probe(mode, busy, gb, state)
+
+
+if __name__ == "__main__":
+    main()
