@@ -13,11 +13,27 @@ fetched, and the offset participates in rewind (Rewindable contract).
 """
 from __future__ import annotations
 
+import re
 import threading
 from typing import Any, Dict, List, Optional
 
 from ..utils.infra import EngineError, logger
 from .contract import LookupSource, Sink, Source
+
+# SQL identifiers (table/column names) are interpolated into statements —
+# placeholders cannot quote identifiers — so every one of them, including
+# ones derived from UNTRUSTED stream row keys, must match this pattern.
+_IDENT = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+def _check_ident(name: str, what: str) -> str:
+    """Validate a (possibly schema-qualified, e.g. public.readings)
+    identifier; raises EngineError on anything else."""
+    ok = (isinstance(name, str) and name
+          and all(_IDENT.match(p) for p in name.split(".")))
+    if not ok:
+        raise EngineError(f"sql io: invalid {what} identifier {name!r}")
+    return name
 
 
 def _connect(props: Dict[str, Any]):
@@ -65,12 +81,20 @@ class SqlSource(Source):
     def configure(self, datasource: str, props: Dict[str, Any]) -> None:
         self.props = props
         table = datasource or props.get("table", "")
+        if table:
+            _check_ident(table, "table")
         self.query = props.get("query") or (f"SELECT * FROM {table}"
                                             if table else "")
+        # user-supplied queries may end in WHERE/GROUP BY/ORDER BY/LIMIT —
+        # the tracking predicate must wrap them as a subselect to compose;
+        # only the table form we generated ourselves can take a plain append
+        self._wrap_query = bool(props.get("query"))
         if not self.query:
             raise EngineError("sql source requires a table or query")
         self.interval_ms = int(props.get("interval", 1000))
         self.tracking = props.get("trackingColumn", "")
+        if self.tracking:
+            _check_ident(self.tracking, "trackingColumn")
         self._offset = props.get("startValue")
 
     def open(self, ingest) -> None:
@@ -89,8 +113,14 @@ class SqlSource(Source):
                 if self.tracking:
                     order = f" ORDER BY {self.tracking}"
                     if self._offset is not None:
-                        q += (f" WHERE {self.tracking} > {ph}" + order)
+                        if self._wrap_query:
+                            q = (f"SELECT * FROM ({q}) AS __ek_sub "
+                                 f"WHERE {self.tracking} > {ph}" + order)
+                        else:
+                            q += (f" WHERE {self.tracking} > {ph}" + order)
                         args = (self._offset,)
+                    elif self._wrap_query:
+                        q = f"SELECT * FROM ({q}) AS __ek_sub" + order
                     else:
                         q += order
                 cur = conn.cursor()
@@ -139,6 +169,9 @@ class SqlSink(Sink):
         self.table = props.get("table", "")
         if not self.table:
             raise EngineError("sql sink requires a table")
+        _check_ident(self.table, "table")
+        for f in props.get("fields") or []:
+            _check_ident(f, "field")
 
     def connect(self) -> None:
         self._conn, self._ph = _connect(self.props)
@@ -150,7 +183,20 @@ class SqlSink(Sink):
         for row in rows:
             if not isinstance(row, dict):
                 raise EngineError("sql sink rows must be objects")
-            cols = fields or list(row.keys())
+            if fields:
+                cols = fields
+            else:
+                # row keys come off the stream (MQTT/websocket/...): they
+                # are UNTRUSTED and get interpolated as identifiers — drop
+                # any non-conforming key instead of building injectable SQL
+                cols = [k for k in row.keys()
+                        if isinstance(k, str) and _IDENT.match(k)]
+                dropped = len(row) - len(cols)
+                if dropped:
+                    logger.warning(
+                        "sql sink: dropped %d non-identifier row keys", dropped)
+                if not cols:
+                    continue
             placeholders = ", ".join([self._ph] * len(cols))
             cur.execute(
                 f"INSERT INTO {self.table} ({', '.join(cols)}) "
@@ -175,13 +221,16 @@ class SqlLookupSource(LookupSource):
         self.table = datasource or props.get("table", "")
         if not self.table:
             raise EngineError("sql lookup requires a table")
+        _check_ident(self.table, "table")
 
     def open(self) -> None:
         self._conn, self._ph = _connect(self.props)
 
     def lookup(self, fields, keys, values) -> List[Dict[str, Any]]:
-        where = " AND ".join(f"{k} = {self._ph}" for k in keys)
-        sel = ", ".join(fields) if fields else "*"
+        where = " AND ".join(
+            f"{_check_ident(k, 'lookup key')} = {self._ph}" for k in keys)
+        sel = (", ".join(_check_ident(f, "field") for f in fields)
+               if fields else "*")
         cur = self._conn.cursor()
         cur.execute(
             f"SELECT {sel} FROM {self.table}"

@@ -68,23 +68,15 @@ def ensure_devices(n: int):
             return cpus[:n]
     except RuntimeError:
         pass
-    try:
-        jax.config.update("jax_num_cpu_devices", n)
-    except RuntimeError as e:
-        # CPU count already locked in by an initialized backend
-        raise RuntimeError(
-            f"host has {len(devs)} devices and the jax backend is "
-            f"already initialized; cannot provision {n} virtual CPU "
-            "devices in-process — run in a subprocess with "
-            f"JAX_PLATFORMS=cpu and "
-            f"--xla_force_host_platform_device_count={n}"
-        ) from e
-    cpus = jax.devices("cpu")
-    if len(cpus) < n:
-        raise RuntimeError(
-            f"could not provision {n} devices (got {len(cpus)} cpu)"
-        )
-    return cpus[:n]
+    # the probes above initialized the backends, so the CPU device count is
+    # locked in for this process — more devices can only come from a fresh
+    # process configured up front
+    raise RuntimeError(
+        f"host has {len(devs)} devices and the jax backend is already "
+        f"initialized; cannot provision {n} virtual CPU devices in-process "
+        f"— run in a subprocess with JAX_PLATFORMS=cpu and "
+        f"--xla_force_host_platform_device_count={n}"
+    )
 
 
 def mesh_from_options(mesh_cfg: dict):

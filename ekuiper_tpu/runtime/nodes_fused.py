@@ -74,6 +74,14 @@ class FusedWindowAggNode(Node):
             self.bucket_ms = (self.interval_ms
                               if self.wt == ast.WindowType.HOPPING_WINDOW
                               and self.interval_ms else self.length_ms)
+            if self.length_ms % max(self.bucket_ms, 1) != 0:
+                # pane decomposition needs bucket | length; flooring the
+                # span would silently aggregate less than the declared
+                # window (the planner routes such shapes to the exact host
+                # path — direct construction fails loudly instead)
+                raise ValueError(
+                    f"event-time window length {self.length_ms}ms is not a "
+                    f"multiple of the pane bucket {self.bucket_ms}ms")
             span = max(self.length_ms // max(self.bucket_ms, 1), 1)
             slack = -(-max(late_tolerance_ms, 0) // max(self.bucket_ms, 1))
             self.n_panes = max(span + slack + 2, 4)
@@ -646,6 +654,7 @@ class FusedWindowAggNode(Node):
                                    "ages_ms": []}
         active = np.nonzero(act > 0)[0]
         if len(active) == 0:
+            self.last_emit_info = None  # nothing emitted this boundary
             return
         if self.direct_emit is not None:
             self._emit_direct(outs, active, wr)

@@ -436,7 +436,11 @@ class WindowNode(Node):
         rows += [r for r in self._bbuf_all_rows() if isinstance(r, Tuple)]
         return {
             "buffer": [
-                {"message": r.message, "timestamp": r.timestamp, "emitter": r.emitter}
+                {"message": r.message, "timestamp": r.timestamp,
+                 "emitter": r.emitter,
+                 # sliding windows: already-triggered rows must not
+                 # re-trigger (and duplicate their window) after a restore
+                 "slid": id(r) in self._slid_ids}
                 for r in rows
             ],
             "rows_since_emit": self._rows_since_emit,
@@ -445,11 +449,14 @@ class WindowNode(Node):
         }
 
     def restore_state(self, state: dict) -> None:
-        restored = [
-            Tuple(emitter=d.get("emitter", ""), message=d["message"],
-                  timestamp=d["timestamp"])
-            for d in state.get("buffer", [])
-        ]
+        restored = []
+        self._slid_ids = set()
+        for d in state.get("buffer", []):
+            r = Tuple(emitter=d.get("emitter", ""), message=d["message"],
+                      timestamp=d["timestamp"])
+            restored.append(r)
+            if d.get("slid"):
+                self._slid_ids.add(id(r))
         if self._use_bbuf and restored:
             from ..data.batch import from_tuples
 

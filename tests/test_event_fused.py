@@ -242,3 +242,37 @@ class TestEventTimeFusedMechanics:
         data_windows = [i for i in emitted if not isinstance(i, Watermark)]
         assert len(data_windows) == 2
         assert calls["n"] <= 4, calls  # no per-empty-bucket device calls
+
+
+class TestDivisibilityGate:
+    def test_event_hopping_non_divisible_raises_on_node(self):
+        """HOPPINGWINDOW(ss,25,10) under event time: flooring the pane span
+        would silently aggregate only 20s of a declared 25s window — direct
+        node construction must fail loudly (the planner routes these shapes
+        to the exact host path)."""
+        import pytest
+
+        from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+        from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+        from ekuiper_tpu.sql.parser import parse_select
+
+        stmt = parse_select(
+            "SELECT deviceId, count(*) AS c FROM s "
+            "GROUP BY deviceId, HOPPINGWINDOW(ss, 25, 10)")
+        plan = extract_kernel_plan(stmt)
+        with pytest.raises(ValueError, match="not a multiple"):
+            FusedWindowAggNode(
+                "bad", stmt.window, plan,
+                dims=[d.expr for d in stmt.dimensions],
+                capacity=64, micro_batch=32, is_event_time=True)
+
+    def test_planner_routes_non_divisible_to_host(self):
+        from ekuiper_tpu.planner.planner import device_path_eligible
+        from ekuiper_tpu.sql.parser import parse_select
+        from ekuiper_tpu.utils.config import RuleOptionConfig
+
+        stmt = parse_select(
+            "SELECT deviceId, count(*) AS c FROM s "
+            "GROUP BY deviceId, HOPPINGWINDOW(ss, 25, 10)")
+        opts = RuleOptionConfig(is_event_time=True)
+        assert device_path_eligible(stmt, opts) is None
