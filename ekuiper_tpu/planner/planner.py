@@ -167,8 +167,23 @@ def device_path_eligible(
         ast.WindowType.TUMBLING_WINDOW,
         ast.WindowType.HOPPING_WINDOW,
         ast.WindowType.COUNT_WINDOW,
+        ast.WindowType.SLIDING_WINDOW,
     ):
         return None
+    if w.window_type == ast.WindowType.SLIDING_WINDOW:
+        from ..sql.compiler import try_compile
+
+        # device sliding: processing-time, trigger-gated (per-row emission
+        # without a condition belongs on the exact host path), single-chip
+        # (the scratch/ring refold is not sharded yet)
+        if opts.is_event_time:
+            return None
+        if (opts.plan_optimize_strategy or {}).get("mesh"):
+            return None
+        if w.trigger_condition is None or try_compile(
+            w.trigger_condition, mode="host"
+        ) is None:
+            return None
     if opts.is_event_time and w.window_type == ast.WindowType.COUNT_WINDOW:
         return None  # event-time counts stay on the host buffering path
     if opts.is_event_time and (opts.plan_optimize_strategy or {}).get("mesh"):
@@ -201,7 +216,10 @@ def device_path_eligible(
             # pane decomposition requires interval | length; otherwise merged
             # panes would span more time than the window
             return None
-    if w.filter is not None or w.trigger_condition is not None:
+    if w.filter is not None:
+        return None
+    if (w.trigger_condition is not None
+            and w.window_type != ast.WindowType.SLIDING_WINDOW):
         return None
     if stmt.joins or _srf_field(stmt) or _analytic_calls(stmt) or _window_func_calls(stmt):
         return None

@@ -34,6 +34,24 @@ from .events import EOF, PreTrigger, Trigger
 from .node import Node
 
 
+def _enc_arr(a: np.ndarray) -> dict:
+    """Compact checkpoint encoding for a numpy array: raw bytes + dtype."""
+    import base64
+
+    a = np.ascontiguousarray(a)
+    return {"d": str(a.dtype),
+            "b": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def _dec_arr(v) -> np.ndarray:
+    import base64
+
+    if isinstance(v, dict) and "b" in v:
+        return np.frombuffer(base64.b64decode(v["b"]),
+                             dtype=np.dtype(v["d"])).copy()
+    return np.asarray(v)  # legacy list-encoded checkpoints
+
+
 class FusedWindowAggNode(Node):
     def __init__(
         self,
@@ -103,6 +121,53 @@ class FusedWindowAggNode(Node):
         elif self.wt == ast.WindowType.HOPPING_WINDOW:
             iv = max(self.interval_ms, 1)
             self.n_panes = max((self.length_ms + iv - 1) // iv, 1)
+        elif self.wt == ast.WindowType.SLIDING_WINDOW:
+            # Device-path sliding windows (reference:
+            # internal/topo/node/window_op.go:741 row-triggered semantics,
+            # EXACT): rows fold into fine time panes by row timestamp; a
+            # trigger row t emits window (t-L, t+delay] as
+            #   merge(panes fully inside) ⊕ scratch-refold of the two
+            #   partial edge buckets from a host-side columnar row ring.
+            # Positive refolds only — every agg kind stays exact (no
+            # subtraction), min/max/hll included.
+            self.delay_ms = window.delay_ms()
+            # finer buckets shrink the per-trigger edge refolds (≤2 buckets
+            # of rows re-uploaded); bounded by the uint8 pane budget AND by
+            # HBM: wide sketch components (hist=512, hll=64 registers) pay
+            # panes×capacity×width×4B of state, so they get coarser buckets
+            from ..ops.aggspec import WIDE_COMPONENTS
+
+            wide = any(set(s.components) & WIDE_COMPONENTS
+                       for s in plan.specs)
+            target = 48 if wide else 128
+            self.bucket_ms = max(self.length_ms // target, 25,
+                                 -(-(self.length_ms + self.delay_ms) // 250))
+            span = -(-(self.length_ms + self.delay_ms) // self.bucket_ms)
+            self.n_ring_panes = span + 3
+            self.n_panes = self.n_ring_panes + 1  # +1 scratch pane
+            if self.n_panes > 255:
+                raise ValueError(
+                    f"sliding window needs {self.n_panes} panes (max 255)")
+            self._scratch_pane = self.n_ring_panes
+            self._pane_bucket: Dict[int, int] = {}  # pane -> bucket held
+            self._ring: Dict[int, list] = {}  # bucket -> [(cols,valid,slots,ts)]
+            self._ring_max_bucket = -1
+            self._pending_slides: Dict[int, int] = {}  # t -> fire_at_ms
+            self._trigger_host = None
+            if window.trigger_condition is not None:
+                from ..sql.compiler import try_compile as _try_compile
+
+                self._trigger_host = _try_compile(
+                    window.trigger_condition, mode="host")
+                if self._trigger_host is None:
+                    raise ValueError(
+                        "sliding device path needs a vectorizable OVER "
+                        "(WHEN ...) trigger condition")
+            else:
+                raise ValueError(
+                    "sliding device path requires a trigger condition: "
+                    "per-row emission at device batch rates must be gated "
+                    "(the exact host path handles unconditional sliding)")
         else:
             self.n_panes = 1
         self.gb = self._make_gb(plan, capacity, micro_batch, mesh)
@@ -222,11 +287,13 @@ class FusedWindowAggNode(Node):
             }
             slots = np.zeros(1, dtype=np.int32)
             dummy = self.gb.init_state()
-            if self.is_event_time:
-                # event-time folds ship per-row pane VECTORS and finalize
-                # with traced pane masks — warm those signatures
+            if self.is_event_time or self.wt == ast.WindowType.SLIDING_WINDOW:
+                # event-time and sliding folds ship per-row pane VECTORS
+                # (sliding also uses the scalar path for single-bucket
+                # batches) and finalize with traced pane masks — warm both
                 dummy = self.gb.fold(dummy, cols, slots,
                                      pane_idx=np.zeros(1, dtype=np.int64))
+                dummy = self.gb.fold(dummy, cols, slots, pane_idx=0)
                 self.gb.finalize(dummy, 1, panes=[0])
             else:
                 dummy = self.gb.fold(dummy, cols, slots,
@@ -302,19 +369,19 @@ class FusedWindowAggNode(Node):
         sub = batch if (start == 0 and end == batch.n) else batch.take(idx)
         if self.is_event_time:
             return self._fold_event(sub)
+        if self.wt == ast.WindowType.SLIDING_WINDOW:
+            return self._fold_sliding(sub)
         return self._fold_rows(sub, self.cur_pane)
 
-    def _fold_rows(self, sub: ColumnBatch, pane_arg) -> int:
-        """Encode keys + build kernel columns + device fold for `sub`,
-        folding into `pane_arg` (scalar pane or per-row pane vector)."""
-        # encode group key
+    def _build_kernel_inputs(self, sub: ColumnBatch, frozen: bool = False):
+        """Encode group keys + materialize the kernel's numeric columns and
+        validity masks for `sub`. Returns (cols, valid, slots)."""
         key_cols = []
         for d in self.dims:
             col = sub.columns.get(d.name)
             if col is None:
                 col = np.full(sub.n, None, dtype=np.object_)
             key_cols.append(col)
-        frozen = self._device_frozen and bool(self._pipeline)
         if key_cols:
             slots, grew = self.kt.encode_multi(key_cols)
             if grew and not frozen:
@@ -360,6 +427,13 @@ class FusedWindowAggNode(Node):
         if not self._dtypes_seen:
             self.gb.observe_dtypes(cols)
             self._dtypes_seen = True
+        return cols, valid, slots
+
+    def _fold_rows(self, sub: ColumnBatch, pane_arg) -> int:
+        """Encode keys + build kernel columns + device fold for `sub`,
+        folding into `pane_arg` (scalar pane or per-row pane vector)."""
+        frozen = self._device_frozen and bool(self._pipeline)
+        cols, valid, slots = self._build_kernel_inputs(sub, frozen)
         if not frozen:
             if self.gb.capacity < self.kt.capacity:
                 # deferred grow (keys first seen in an earlier frozen span)
@@ -502,6 +576,157 @@ class FusedWindowAggNode(Node):
                 self.state = self.gb.reset_pane(self.state, 0)
                 self._rows_in_window = 0
 
+    # ------------------------------------------------------------- sliding
+    def _fold_sliding(self, sub: ColumnBatch) -> int:
+        """Sliding device path: fold rows into time panes keyed by row
+        timestamp, mirror them into the host ring (for edge-bucket refolds
+        at emission), and fire trigger rows."""
+        ts = sub.timestamps
+        if ts is None:
+            now = timex.now_ms()
+            ts = np.full(sub.n, now, dtype=np.int64)
+        buckets = ts // self.bucket_ms
+        # late guard: a row more than 3 buckets behind the stream would map
+        # onto a pane holding LIVE newer data (folding it would both corrupt
+        # that pane and emit an unreconstructable window) — drop + count,
+        # mirroring the event-time late drop
+        if self._ring_max_bucket >= 0:
+            late = buckets < self._ring_max_bucket - 3
+            if late.any():
+                n_late = int(late.sum())
+                self.stats.inc_exception(
+                    "late row dropped (sliding pane retention)", n=n_late)
+                keep = np.nonzero(~late)[0]
+                if len(keep) == 0:
+                    return 0
+                sub = sub.take(keep)
+                ts = ts[keep]
+                buckets = buckets[keep]
+        # recycle panes: reset any pane about to receive a newer bucket.
+        # The recycled bucket's ROWS stay in the ring a while longer — a
+        # trigger whose window still needs that bucket detects the recycled
+        # pane and refolds the whole window from the ring (exact fallback)
+        for b in np.unique(buckets).tolist():
+            pane = int(b) % self.n_ring_panes
+            held = self._pane_bucket.get(pane)
+            if held is not None and held != int(b):
+                self.state = self.gb.reset_pane(self.state, pane)
+            self._pane_bucket[pane] = int(b)
+        self._ring_max_bucket = max(self._ring_max_bucket,
+                                    int(buckets.max()))
+        # ring outlives panes by a margin so the stale-window fallback can
+        # always reconstruct; beyond that the window is unrecoverable anyway
+        floor_b = self._ring_max_bucket - self.n_ring_panes - 8
+        for b in [b for b in self._ring if b < floor_b]:
+            del self._ring[b]
+        cols, valid, slots = self._build_kernel_inputs(sub)
+        pane_vec = (buckets % self.n_ring_panes).astype(np.uint8)
+        if len(np.unique(pane_vec)) == 1:
+            # single-bucket batch: scalar-pane fast path (the common case —
+            # a batch spans far less time than one pane)
+            self.state = self.gb.fold(self.state, cols, slots, valid,
+                                      int(pane_vec[0]))
+        else:
+            self.state = self.gb.fold(self.state, cols, slots, valid,
+                                      pane_vec)
+        for b in np.unique(buckets).tolist():
+            m = buckets == b
+            sel = np.nonzero(m)[0]
+            seg = (
+                {k: v[sel] for k, v in cols.items()},
+                {k: v[sel] for k, v in valid.items()},
+                slots[sel], ts[sel],
+            ) if not m.all() else (cols, valid, slots, ts)
+            self._ring.setdefault(int(b), []).append(seg)
+        # trigger rows: vectorized OVER(WHEN ...) on the raw batch columns;
+        # a batch missing the trigger column evaluates to no triggers (null
+        # semantics — matches the host row evaluator), not a rule exception
+        try:
+            trig_mask = np.broadcast_to(
+                np.asarray(self._trigger_host(sub.columns), dtype=np.bool_),
+                (sub.n,))
+        except Exception:
+            trig_mask = np.zeros(sub.n, dtype=np.bool_)
+        for i in np.nonzero(trig_mask)[0].tolist():
+            t = int(ts[i])
+            if self.delay_ms > 0:
+                self._schedule_sliding(t, timex.now_ms() + self.delay_ms)
+            else:
+                self._emit_sliding(t)
+        return sub.n
+
+    def _schedule_sliding(self, t: int, fire_at: int) -> None:
+        """Register a delayed sliding emission; tracked in _pending_slides
+        so a checkpoint/restore re-arms it instead of dropping the window."""
+        self._pending_slides[t] = fire_at
+        delay = max(fire_at - timex.now_ms(), 0)
+        timex.after(delay, lambda _ts, t0=t: self.inq.put(
+            Trigger(ts=t0, tag=("sliding", t0))))
+
+    def _emit_sliding(self, t: int) -> None:
+        """Emit the exact window (t-L, t+delay] for trigger time t."""
+        n_keys = self.kt.n_keys
+        if n_keys == 0:
+            return
+        lo = t - self.length_ms  # exclusive
+        hi = t + self.delay_ms  # inclusive
+        b_lo, b_hi = lo // self.bucket_ms, hi // self.bucket_ms
+        full = []
+        stale = False
+        for b in range(b_lo + 1, b_hi):
+            if self._pane_bucket.get(b % self.n_ring_panes) == b:
+                full.append(b)
+            elif b in self._ring:
+                stale = True  # pane recycled but ring rows still present
+        scratch_rows = []
+
+        def ring_rows(b, lo_excl=None, hi_incl=None):
+            for cols, valid, slots, ts in self._ring.get(b, []):
+                m = np.ones(len(ts), dtype=np.bool_)
+                if lo_excl is not None:
+                    m &= ts > lo_excl
+                if hi_incl is not None:
+                    m &= ts <= hi_incl
+                if m.any():
+                    sel = np.nonzero(m)[0]
+                    scratch_rows.append((
+                        {k: v[sel] for k, v in cols.items()},
+                        {k: v[sel] for k, v in valid.items()},
+                        slots[sel]))
+
+        if stale:
+            # fallback: a needed pane was recycled under emission backlog —
+            # refold the WHOLE window from the ring (exact, just slower)
+            full = []
+            for b in range(b_lo, b_hi + 1):
+                ring_rows(b, lo_excl=lo, hi_incl=hi)
+            self.stats.inc_exception("sliding pane recycled; ring refold")
+        else:
+            if b_lo == b_hi:
+                ring_rows(b_lo, lo_excl=lo, hi_incl=hi)
+            else:
+                ring_rows(b_lo, lo_excl=lo)
+                ring_rows(b_hi, hi_incl=hi)
+        used_scratch = False
+        for cols, valid, slots in scratch_rows:
+            self.state = self.gb.fold(self.state, cols, slots, valid,
+                                      self._scratch_pane)
+            used_scratch = True
+        panes = sorted({b % self.n_ring_panes for b in full})
+        if used_scratch:
+            panes.append(self._scratch_pane)
+        if panes:
+            outs, act = self.gb.finalize(self.state, n_keys, panes=panes)
+            active = np.nonzero(act > 0)[0]
+            if len(active):
+                wr = WindowRange(lo, hi)
+                if self.direct_emit is not None:
+                    self._emit_direct(outs, active, wr)
+                else:
+                    self._emit_grouped(outs, active, wr)
+        if used_scratch:
+            self.state = self.gb.reset_pane(self.state, self._scratch_pane)
+
     # ---------------------------------------------------------------- trigger
     def on_pre_trigger(self, pre: PreTrigger) -> None:
         """Ahead of the window boundary: dispatch finalize on the state
@@ -541,6 +766,12 @@ class FusedWindowAggNode(Node):
         self._device_frozen = self._tail_host_only
 
     def on_trigger(self, trig: Trigger) -> None:
+        if self.wt == ast.WindowType.SLIDING_WINDOW:
+            # delayed sliding emission scheduled at trigger-row time + delay
+            if isinstance(trig.tag, tuple) and trig.tag[0] == "sliding":
+                self._pending_slides.pop(trig.tag[1], None)
+                self._emit_sliding(trig.tag[1])
+            return
         end = trig.ts
         self._emit(WindowRange(end - self.length_ms, end))
         if self.wt == ast.WindowType.TUMBLING_WINDOW:
@@ -590,6 +821,10 @@ class FusedWindowAggNode(Node):
                 self._next_emit_bucket = first if nxt is None else max(nxt,
                                                                        first)
                 self._emit_event_bucket(self._next_emit_bucket)
+            self.broadcast(eof)
+            return
+        if self.wt == ast.WindowType.SLIDING_WINDOW:
+            # sliding emits only on trigger rows; nothing to flush
             self.broadcast(eof)
             return
         now = timex.now_ms()
@@ -767,6 +1002,23 @@ class FusedWindowAggNode(Node):
             snap["next_emit_bucket"] = self._next_emit_bucket
             snap["max_bucket"] = self._max_bucket
             snap["dirty_buckets"] = sorted(self._dirty)
+        if self.wt == ast.WindowType.SLIDING_WINDOW:
+            snap["pane_bucket"] = dict(self._pane_bucket)
+            snap["ring_max_bucket"] = self._ring_max_bucket
+            snap["pending_slides"] = dict(self._pending_slides)
+            # the ring is a window's worth of raw rows (same magnitude as
+            # the host path's buffer snapshot) — base64 of the raw array
+            # bytes keeps serialization at memcpy speed instead of building
+            # millions of Python objects via tolist()
+            snap["ring"] = {
+                str(b): [
+                    {"cols": {k: _enc_arr(v) for k, v in cols.items()},
+                     "valid": {k: _enc_arr(v) for k, v in valid.items()},
+                     "slots": _enc_arr(slots), "ts": _enc_arr(ts)}
+                    for cols, valid, slots, ts in segs
+                ]
+                for b, segs in self._ring.items()
+            }
         return snap
 
     def restore_state(self, state: dict) -> None:
@@ -785,3 +1037,22 @@ class FusedWindowAggNode(Node):
             self._next_emit_bucket = state.get("next_emit_bucket")
             self._max_bucket = state.get("max_bucket")
             self._dirty = set(state.get("dirty_buckets", []))
+        if self.wt == ast.WindowType.SLIDING_WINDOW:
+            self._pane_bucket = {int(k): v for k, v in
+                                 state.get("pane_bucket", {}).items()}
+            self._ring_max_bucket = state.get("ring_max_bucket", -1)
+            self._ring = {
+                int(b): [
+                    ({k: _dec_arr(v) for k, v in seg["cols"].items()},
+                     {k: _dec_arr(v) for k, v in seg["valid"].items()},
+                     _dec_arr(seg["slots"]), _dec_arr(seg["ts"]))
+                    for seg in segs
+                ]
+                for b, segs in state.get("ring", {}).items()
+            }
+            # re-arm delayed emissions that were pending at the checkpoint
+            # (past-due ones fire immediately) — without this, windows for
+            # triggers inside the restart gap would silently never emit
+            self._pending_slides = {}
+            for t, fire_at in state.get("pending_slides", {}).items():
+                self._schedule_sliding(int(t), int(fire_at))
