@@ -190,6 +190,186 @@ def bench_sliding_percentile(batches, kt_slots) -> None:
     )
 
 
+def bench_hopping_heavy_hitters(batches, kt_slots) -> None:
+    """BASELINE config #2: HOPPINGWINDOW GROUP BY device_id over 10k
+    sensors with the count-min heavy-hitters UDF on the fused device path
+    (linear group-testing sketch, device-side candidate recovery + top-k).
+    Prints a stderr metric line."""
+    import jax
+
+    from ekuiper_tpu.data.batch import ColumnBatch
+    from ekuiper_tpu.data.rows import WindowRange
+    from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+    from ekuiper_tpu.ops.emit import build_direct_emit
+    from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+    from ekuiper_tpu.sql.parser import parse_select
+
+    sql = ("SELECT deviceId, heavy_hitters(code, 3) AS top, count(*) AS c "
+           "FROM demo GROUP BY deviceId, HOPPINGWINDOW(ss, 10, 5)")
+    stmt = parse_select(sql)
+    plan = extract_kernel_plan(stmt)
+    assert plan is not None, "hh bench rule must be device-eligible"
+    node = FusedWindowAggNode(
+        "hh", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+        capacity=kt_slots, micro_batch=BATCH_ROWS,
+        direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+        emit_columnar=True)
+    node.state = node.gb.init_state()
+    emits = []
+    node.broadcast = lambda item: emits.append(item)
+    # skewed event codes: 3 heavy values + a 2000-distinct tail
+    rng = np.random.default_rng(7)
+    hh_batches = []
+    for b in batches:
+        p = rng.random(b.n)
+        code = np.where(
+            p < 0.35, 7, np.where(p < 0.55, 13, np.where(
+                p < 0.70, 99, rng.integers(100, 2100, b.n)))).astype(np.int64)
+        hh_batches.append(ColumnBatch(
+            n=b.n, columns={"deviceId": b.columns["deviceId"], "code": code},
+            timestamps=b.timestamps, emitter=b.emitter))
+
+    def boundary(end_ms):
+        t0 = time.time()
+        node._emit(WindowRange(end_ms - 10_000, end_ms))
+        ms = (time.time() - t0) * 1000
+        node.cur_pane = (node.cur_pane + 1) % node.n_panes
+        node.state = node.gb.reset_pane(node.state, node.cur_pane)
+        return ms
+
+    node.process(hh_batches[0])  # warm fold
+    boundary(5_000)  # warm compact hh finalize
+    jax.block_until_ready(node.state)
+    emits.clear()
+    rows = 0
+    n = 0
+    emit_ms = []
+    # paced at the north-star load: boundary fetches queue FIFO behind
+    # in-flight folds, so emit latency is only meaningful when the link
+    # has headroom (same methodology as phase L)
+    interval = BATCH_ROWS / 1_100_000
+    t0 = time.time()
+    while time.time() - t0 < 10.0:
+        target = t0 + n * interval
+        delay = target - time.time()
+        if delay > 0:
+            time.sleep(delay)
+        node.process(hh_batches[n % len(hh_batches)])
+        rows += BATCH_ROWS
+        n += 1
+        if n % 16 == 0:  # one hop boundary per ~16 batches (~1s)
+            emit_ms.append(boundary(5_000 * (n // 16 + 1)))
+    jax.block_until_ready(node.state)
+    elapsed = time.time() - t0
+    # sanity: the heaviest value must lead every emitted top list
+    top_col = emits[0].columns["top"]
+    assert top_col[0][0]["value"] == 7, f"bad top list: {top_col[0]}"
+    lat = (f"emit p50={np.percentile(emit_ms, 50):.0f}ms "
+           f"max={max(emit_ms):.0f}ms" if emit_ms else "no boundaries")
+    print(
+        f"# hopping heavy-hitters (10s/5s, 10k keys, count-min device "
+        f"sketch): {rows:,} rows in {elapsed:.2f}s "
+        f"({rows / elapsed:,.0f} rows/s), {len(emit_ms)} window emits, {lat}",
+        file=sys.stderr,
+    )
+
+
+def bench_countwindow_hll_1m(kt_slots) -> None:
+    """BASELINE config #4: COUNTWINDOW HyperLogLog distinct-count with 1M-key
+    GROUP BY cardinality — stresses KeyTable growth to >=1M slots, on-device
+    state doubling, and the wide-register HLL fold at HBM scale.
+    Prints a stderr metric line."""
+    import jax
+
+    from ekuiper_tpu.data.batch import ColumnBatch
+    from ekuiper_tpu.data.rows import WindowRange
+    from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+    from ekuiper_tpu.ops.emit import build_direct_emit
+    from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+    from ekuiper_tpu.sql.parser import parse_select
+
+    n_keys_total = 1_000_000
+    window_rows = 2_097_152  # 32 batches per count window
+    sql = (f"SELECT deviceId, hll(uid) AS uniq FROM demo "
+           f"GROUP BY deviceId, COUNTWINDOW({window_rows})")
+    stmt = parse_select(sql)
+    plan = extract_kernel_plan(stmt)
+    assert plan is not None, "hll bench rule must be device-eligible"
+    # pre-sized hash-slot table (SURVEY §7 hard-part c): growing 16k->1M
+    # re-specializes the fold executable per doubling (~6 recompiles), so a
+    # known-cardinality rule sizes up front; the grow path itself is covered
+    # by tests (test_groupby.py grow + test_heavy_hitters device grows)
+    node = FusedWindowAggNode(
+        "hll1m", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+        capacity=1 << 20, micro_batch=BATCH_ROWS,
+        direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+        emit_columnar=True)
+    node.state = node.gb.init_state()
+    emits = []  # (ColumnBatch, emit_info) pairs from the async worker
+    node.broadcast = lambda item: emits.append((item, node.last_emit_info))
+    rng = np.random.default_rng(11)
+    ids = np.array([f"dev_{i}" for i in range(n_keys_total)], dtype=np.object_)
+    # one full count-window of DISTINCT batches (32 x 64k draws ≈ 878k
+    # distinct keys of the 1M id space) — recycling fewer batches would cap
+    # the key cardinality the bench claims to stress
+    hll_batches = []
+    for _ in range(window_rows // BATCH_ROWS):
+        idx = rng.integers(0, n_keys_total, BATCH_ROWS)
+        hll_batches.append(ColumnBatch(
+            n=BATCH_ROWS,
+            columns={"deviceId": ids[idx],
+                     "uid": rng.integers(0, 5_000_000, BATCH_ROWS)},
+            timestamps=np.zeros(BATCH_ROWS, dtype=np.int64), emitter="demo"))
+    node.process(hll_batches[0])  # warm fold (1M-slot executable)
+    node._emit(WindowRange(0, 0))  # warm finalize + emit tail executables
+    node.state = node.gb.reset_pane(node.state, 0)
+    node.kt.clear()
+    node._rows_in_window = 0
+    jax.block_until_ready(node.state)
+    emits.clear()
+
+    def run_windows(k: int):
+        rows = n = 0
+        marker = None
+        want = len(emits) + k
+        t0 = time.time()
+        while time.time() - t0 < 60.0 and len(emits) < want:
+            node.process(hll_batches[n % len(hll_batches)])
+            rows += BATCH_ROWS
+            n += 1
+            if n % T_BLOCK_EVERY == 0:
+                if marker is not None:
+                    jax.block_until_ready(marker)
+                marker = node.state["act"]
+        node._drain_async_emits()
+        jax.block_until_ready(node.state)
+        return rows, time.time() - t0
+
+    # window 1: cold dictionary — every batch inserts new keys
+    cold_rows, cold_s = run_windows(1)
+    # windows 2-3: steady state — keys known, pure fold + async emit cadence
+    warm_rows, warm_s = run_windows(2)
+    state_gb = sum(
+        np.prod(v.shape) * 4 for v in node.state.values()) / 1e9
+    fetch_ms = [i["fetch_ms"] for _, i in emits if i]
+    lat = (f"async emit issue→delivered p50={np.percentile(fetch_ms, 50):.0f}ms"
+           if fetch_ms else "no window completed")
+    # sanity on the last emit: ~full key coverage, sane per-key estimates
+    if emits:
+        uniq = emits[-1][0].columns["uniq"]
+        assert len(uniq) > 800_000 and 0 < np.median(uniq) < 50, \
+            f"bad hll emit: {len(uniq):,} groups, median {np.median(uniq)}"
+    print(
+        f"# countwindow hll @1M keys: steady {warm_rows:,} rows in "
+        f"{warm_s:.2f}s ({warm_rows / max(warm_s, 1e-9):,.0f} rows/s; "
+        f"cold-dictionary window {cold_rows / max(cold_s, 1e-9):,.0f} "
+        f"rows/s), keys={node.kt.n_keys:,} in {node.gb.capacity:,} device "
+        f"slots, state={state_gb:.2f}GB, {len(emits)} count-window "
+        f"emits (device-async), {lat}",
+        file=sys.stderr,
+    )
+
+
 def bench_event_time(batches, kt_slots) -> None:
     """Event-time device path: per-row pane routing + watermark-driven
     emission. Prints a stderr metric line."""
@@ -434,6 +614,8 @@ def main() -> None:
     rows_per_sec = phase_throughput(batches)
     phase_latency(batches)
     bench_sliding_percentile(batches, KEY_SLOTS)
+    bench_hopping_heavy_hitters(batches, KEY_SLOTS)
+    bench_countwindow_hll_1m(KEY_SLOTS)
     bench_event_time(batches, KEY_SLOTS)
     bench_rule_group(batches, KEY_SLOTS)
 

@@ -40,16 +40,22 @@ DEVICE_AGGS: Dict[str, Set[str]] = {
     "hll": {"hll"},
     "distinct_count_approx": {"hll"},
     "percentile_approx": {"hist"},
+    "heavy_hitters": {"hh"},
 }
 
 ALL_COMPONENTS = ("n", "s1", "s2", "mn", "mx")
 # components with a trailing register axis (capacity, k, R)
-WIDE_COMPONENTS = {"hll", "hist"}
+WIDE_COMPONENTS = {"hll", "hist", "hh"}
 
 # Derived-column prefix: hll over a bare column reads a dedicated hashed
 # copy (strings crc32-hashed, numerics passed through) so the raw column
 # stays numeric for every other spec / WHERE / FILTER sharing it.
 HLL_COL_PREFIX = "__hll__"
+
+# Derived-column prefix for heavy_hitters: the raw column dictionary-encodes
+# to dense integer codes (< sketches.HH_MAX_CODES) that the bit-recovery
+# sketch can reconstruct; codes decode back to the original values at emit.
+HH_COL_PREFIX = "__hhc__"
 
 
 # values below this are exactly representable in float32 and pass through;
@@ -122,6 +128,76 @@ def _hll_encode_numeric(raw: "np.ndarray") -> "np.ndarray":
     return out
 
 
+class ValueDict:
+    """Reversible dictionary encoding for a heavy_hitters column: values map
+    to dense integer codes (< sketches.HH_MAX_CODES) that fit the sketch's
+    bit recovery; codes decode back to the ORIGINAL values (any type,
+    strings included) at emit. Codes only grow, so they stay stable across
+    the window, across panes, and across checkpoint restore (the fused node
+    persists the value list). Values past the code budget encode as NaN
+    (masked — invisible to the sketch); heavy hitters by definition appear
+    early and often, so they claim low codes long before overflow."""
+
+    def __init__(self) -> None:
+        self._ids: Dict[Any, int] = {}
+        self._values: List[Any] = []
+        self.overflowed = False
+
+    def _code(self, v) -> float:
+        from .sketches import HH_MAX_CODES
+
+        ids = self._ids
+        c = ids.get(v)
+        if c is None:
+            if len(self._values) >= HH_MAX_CODES:
+                self.overflowed = True
+                return np.nan
+            c = len(self._values)
+            ids[v] = c
+            self._values.append(v)
+        return float(c)
+
+    def encode(self, col: "np.ndarray") -> "np.ndarray":
+        """Column -> float32 codes (NaN for None/overflow)."""
+        n = len(col)
+        out = np.empty(n, dtype=np.float32)
+        if col.dtype == np.object_:
+            for i, v in enumerate(col.tolist()):
+                if v is None:
+                    out[i] = np.nan
+                    continue
+                try:
+                    out[i] = self._code(v)
+                except TypeError:  # unhashable (list/dict): stringify
+                    out[i] = self._code(repr(v))
+            return out
+        arr = np.asarray(col)
+        if np.issubdtype(arr.dtype, np.floating):
+            nan = np.isnan(arr)
+        else:
+            nan = np.zeros(n, dtype=bool)
+        out = np.full(n, np.nan, dtype=np.float32)
+        clean = arr[~nan] if nan.any() else arr
+        if len(clean):
+            uniq, inverse = np.unique(clean, return_inverse=True)
+            ucodes = np.array(
+                [self._code(u.item()) for u in uniq], dtype=np.float32
+            )
+            out[~nan] = ucodes[inverse]
+        return out
+
+    def decode(self, code: int):
+        return self._values[code] if 0 <= code < len(self._values) else None
+
+    def snapshot(self) -> List[Any]:
+        return list(self._values)
+
+    def restore(self, values: List[Any]) -> None:
+        self._values = list(values)
+        self._ids = {v: i for i, v in enumerate(self._values)
+                     if isinstance(v, (int, float, str, bool, tuple))}
+
+
 def materialize_hll_columns(plan_columns, cols: Dict[str, "np.ndarray"], n: int):
     """Fill in any missing __hll__<col> derived columns from the raw column.
     Returns a new dict when a derivation was needed; callers that already
@@ -153,6 +229,7 @@ class AggSpec:
     filter: Optional[CompiledExpr]  # FILTER(WHERE ...) device closure
     int_input: bool = False  # observed integer input → integer avg/sum results
     frac: float = 0.5  # percentile_approx quantile (2nd literal arg)
+    topk: int = 3  # heavy_hitters k (2nd literal arg)
     # numpy twins of arg/filter, used by the latency-hiding tail shadow
     # (ops/prefinalize.py); None when the expr only compiles for device
     arg_host: Optional[CompiledExpr] = None
@@ -206,9 +283,25 @@ def extract_kernel_plan(
         if call.partition or call.when is not None:
             return None
         frac = 0.5
+        topk = 3
         arg_ce: Optional[CompiledExpr] = None
         if call.args and not isinstance(call.args[0], ast.Wildcard):
-            if call.name == "percentile_approx":
+            if call.name == "heavy_hitters":
+                # heavy_hitters(col, k): bare column + literal k only — the
+                # column dictionary-encodes through a per-node ValueDict.
+                # k is bounded by half the candidate pool (top_k fetches 2k
+                # of HH_DEPTH*HH_WIDTH candidates); larger k → exact host path
+                from .sketches import HH_DEPTH, HH_WIDTH
+
+                if (
+                    len(call.args) != 2
+                    or not isinstance(call.args[0], ast.FieldRef)
+                    or not isinstance(call.args[1], ast.IntegerLiteral)
+                    or not 0 < call.args[1].val <= HH_DEPTH * HH_WIDTH // 2
+                ):
+                    return None
+                topk = int(call.args[1].val)
+            elif call.name == "percentile_approx":
                 if len(call.args) != 2 or not isinstance(
                     call.args[1], (ast.NumberLiteral, ast.IntegerLiteral)
                 ):
@@ -220,7 +313,15 @@ def extract_kernel_plan(
             elif len(call.args) != 1:
                 return None
             arg_host: Optional[CompiledExpr] = None
-            if kind in ("hll", "distinct_count_approx") and isinstance(
+            if kind == "heavy_hitters":
+                hcol = HH_COL_PREFIX + call.args[0].name
+                arg_ce = CompiledExpr(
+                    lambda cols, _h=hcol: cols[_h], {hcol}, "device"
+                )
+                arg_host = CompiledExpr(
+                    lambda cols, _h=hcol: cols[_h], {hcol}, "host"
+                )
+            elif kind in ("hll", "distinct_count_approx") and isinstance(
                 call.args[0], ast.FieldRef
             ):
                 hcol = HLL_COL_PREFIX + call.args[0].name
@@ -254,6 +355,7 @@ def extract_kernel_plan(
                 arg=arg_ce,
                 filter=filter_ce,
                 frac=frac,
+                topk=topk,
                 arg_host=arg_host,
                 filter_host=filter_host,
             )

@@ -33,8 +33,9 @@ from .aggspec import AggSpec, KernelPlan
 
 _INIT = {
     "n": 0.0, "s1": 0.0, "s2": 0.0, "mn": np.inf, "mx": -np.inf, "act": 0.0,
-    # wide (register-axis) components: HLL registers, log-histogram bins
-    "hll": 0.0, "hist": 0.0,
+    # wide (register-axis) components: HLL registers, log-histogram bins,
+    # heavy-hitters group-testing counters
+    "hll": 0.0, "hist": 0.0, "hh": 0.0,
 }
 
 _WIDE_SIZE = {}  # filled lazily from sketches to avoid import cycle
@@ -46,6 +47,7 @@ def _wide_size(comp: str) -> int:
 
         _WIDE_SIZE["hll"] = sketches.HLL_M
         _WIDE_SIZE["hist"] = sketches.HIST_BINS
+        _WIDE_SIZE["hh"] = sketches.HH_SIZE
     return _WIDE_SIZE[comp]
 
 
@@ -106,7 +108,17 @@ class DeviceGroupBy:
         # subset (up to n_panes compiles), a traced mask compiles once
         self._finalize_dyn = jax.jit(self._finalize_dyn_impl)
         self._components = jax.jit(self._components_impl, static_argnums=(1,))
+        self._components_dyn = jax.jit(self._components_dyn_impl)
         self._reset_pane = jax.jit(self._reset_pane_impl, donate_argnums=(0,))
+        # heavy_hitters finalize: candidate recovery + top-k run ON DEVICE
+        # (sketches.hh_candidates) so the emit transfer is 2*k2 floats/key,
+        # not the HH_SIZE-wide raw sketch; dedupe + value decode finish on
+        # host. finalize() routes through _host_finalize for such plans.
+        self._host_finalize_only = any(
+            s.kind == "heavy_hitters" for s in plan.specs
+        )
+        if self._host_finalize_only:
+            self._hh_fin = jax.jit(self._hh_finalize_impl)
 
     #: the latency-hiding emit pipeline (ops/prefinalize.py) works here;
     #: the sharded subclass opts out (its finalize runs collective gathers)
@@ -129,17 +141,23 @@ class DeviceGroupBy:
         return state
 
     def grow(self, state: Dict[str, Any], new_capacity: int) -> Dict[str, Any]:
-        """Double the key capacity, preserving partials (host roundtrip)."""
+        """Double the key capacity, preserving partials. Runs ON DEVICE
+        (jnp.pad) — at 1M-key cardinality a host roundtrip would move GBs
+        through the host↔device link per doubling."""
         import jax.numpy as jnp
 
         out: Dict[str, Any] = {}
         for comp, arr in state.items():
-            np_arr = np.asarray(arr)
-            pad_shape = list(np_arr.shape)
-            pad_shape[1] = new_capacity - np_arr.shape[1]
-            init = _INIT[comp]
-            pad = np.full(pad_shape, init, dtype=np_arr.dtype)
-            out[comp] = jnp.asarray(np.concatenate([np_arr, pad], axis=1))
+            if isinstance(arr, np.ndarray):  # host-restored state
+                pad_shape = list(arr.shape)
+                pad_shape[1] = new_capacity - arr.shape[1]
+                pad = np.full(pad_shape, _INIT[comp], dtype=arr.dtype)
+                out[comp] = jnp.asarray(np.concatenate([arr, pad], axis=1))
+                continue
+            pad_width = [(0, 0)] * arr.ndim
+            pad_width[1] = (0, new_capacity - arr.shape[1])
+            out[comp] = jnp.pad(arr, pad_width,
+                                constant_values=_INIT[comp])
         self.capacity = new_capacity
         return out
 
@@ -275,6 +293,13 @@ class DeviceGroupBy:
 
                     b = hist_bin(v)
                     arr = arr.at[pane_idx, slots, k, b].add(mf)
+                elif comp == "hh":
+                    from .sketches import hh_update_parts
+
+                    idx, wts = hh_update_parts(v, mf)  # (mb, J)
+                    p = (pane_idx[:, None]
+                         if getattr(pane_idx, "ndim", 0) == 1 else pane_idx)
+                    arr = arr.at[p, slots[:, None], k, idx].add(wts)
             state[comp] = arr
         return state
 
@@ -381,9 +406,17 @@ class DeviceGroupBy:
         (capacity, W) array — the device half of the latency-hiding emit
         (ops/prefinalize.py). Final values are computed on host after the
         tail shadow is merged in."""
+        return self._components_body(
+            state, np.array(pane_mask_tuple, dtype=np.bool_))
+
+    def _components_dyn_impl(self, state, pane_mask):
+        """Traced-mask variant: event-time/sliding emits rotate through pane
+        subsets — one compiled executable instead of one per subset."""
+        return self._components_body(state, pane_mask)
+
+    def _components_body(self, state, pane_mask):
         import jax.numpy as jnp
 
-        pane_mask = np.array(pane_mask_tuple, dtype=np.bool_)
         parts = []
         for comp in sorted(self.comp_specs):
             m = self._merged(state, comp, pane_mask)
@@ -417,19 +450,12 @@ class DeviceGroupBy:
             pass
         return PendingFinalize(out, self.capacity, self._components_layout())
 
-    def prefinalize_merge(
-        self, pending, shadow, n_keys: int,
+    def _final_from_components(
+        self, comb: Dict[str, np.ndarray], n_keys: int,
     ) -> Tuple[List[np.ndarray], np.ndarray]:
-        """Complete a pre-issued finalize: fetch device components (usually
-        already on host), merge the tail shadow, compute final values in
-        numpy. Same (outs, act) contract as finalize()."""
-        from .prefinalize import final_value_np, merge_components
+        """Numpy final values from pane-merged host components."""
+        from .prefinalize import final_value_np
 
-        # capacity may have grown during a frozen tail (new keys live only in
-        # the shadow) — merge at the widest extent so no slot is truncated
-        cap = max(self.capacity,
-                  shadow.capacity if shadow is not None else 0)
-        comb = merge_components(pending.get(), shadow, cap)
         act = comb["act"]
         outs: List[np.ndarray] = []
         for i, spec in enumerate(self.plan.specs):
@@ -438,6 +464,86 @@ class DeviceGroupBy:
                 for comp in spec.components
             }
             outs.append(np.asarray(final_value_np(spec, c))[:n_keys])
+        outs = apply_int_semantics(self.plan.specs, outs)
+        return outs, np.asarray(act[:n_keys])
+
+    def prefinalize_merge(
+        self, pending, shadow, n_keys: int,
+    ) -> Tuple[List[np.ndarray], np.ndarray]:
+        """Complete a pre-issued finalize: fetch device components (usually
+        already on host), merge the tail shadow, compute final values in
+        numpy. Same (outs, act) contract as finalize()."""
+        from .prefinalize import merge_components
+
+        # capacity may have grown during a frozen tail (new keys live only in
+        # the shadow) — merge at the widest extent so no slot is truncated
+        cap = max(self.capacity,
+                  shadow.capacity if shadow is not None else 0)
+        comb = merge_components(pending.get(), shadow, cap)
+        return self._final_from_components(comb, n_keys)
+
+    def _hh_finalize_impl(self, state, pane_mask):
+        """Device finalize for plans containing heavy_hitters: non-hh specs
+        produce their final-value row; hh specs produce 2*k2 rows of
+        device-recovered candidate (codes, estimates). One small
+        (R, capacity) transfer regardless of sketch width."""
+        import jax.numpy as jnp
+
+        from .sketches import hh_candidates
+
+        merged = {
+            comp: self._merged(state, comp, pane_mask)
+            for comp in self.comp_specs
+        }
+        act = self._merged(state, "act", pane_mask)
+        rows = []
+        for i, spec in enumerate(self.plan.specs):
+            if spec.kind == "heavy_hitters":
+                hhm = merged["hh"][:, self.comp_specs["hh"].index(i)]
+                codes, est = hh_candidates(hhm, 2 * spec.topk)
+                rows.append(codes.T)  # (k2, cap)
+                rows.append(est.T)
+            else:
+                col = {
+                    comp: merged[comp][:, self.comp_specs[comp].index(i)]
+                    for comp in spec.components
+                }
+                rows.append(self._final_value(spec, col)[None, :])
+        rows.append(act[None, :])
+        return jnp.concatenate(rows, axis=0)
+
+    def _host_finalize(
+        self, state: Dict[str, Any], n_keys: int,
+        panes: Optional[List[int]],
+    ) -> Tuple[List[np.ndarray], np.ndarray]:
+        """Finalize route for heavy_hitters plans: fetch the compact device
+        result, then dedupe candidates (a code can appear once per depth)
+        and trim to top-k on host."""
+        pm = np.zeros(self.n_panes, dtype=np.bool_)
+        if panes is None:
+            pm[:] = True
+        else:
+            pm[panes] = True
+        from .prefinalize import hh_dedupe_topk
+
+        stacked = np.asarray(self._hh_fin(state, pm))
+        outs: List[np.ndarray] = []
+        r = 0
+        for spec in self.plan.specs:
+            if spec.kind == "heavy_hitters":
+                k2 = 2 * spec.topk
+                codes = stacked[r:r + k2, :n_keys]
+                est = stacked[r + k2:r + 2 * k2, :n_keys]
+                r += 2 * k2
+                col = np.empty(n_keys, dtype=np.object_)
+                for j in range(n_keys):
+                    col[j] = hh_dedupe_topk(codes[:, j], est[:, j],
+                                            spec.topk)
+                outs.append(col)
+            else:
+                outs.append(stacked[r, :n_keys].copy())
+                r += 1
+        act = stacked[-1]
         outs = apply_int_semantics(self.plan.specs, outs)
         return outs, np.asarray(act[:n_keys])
 
@@ -451,6 +557,8 @@ class DeviceGroupBy:
         active == 0 did not appear in this window and must not emit a group.
         NaN encodes NULL for empty-group sum/avg/min/max.
         """
+        if self._host_finalize_only:
+            return self._host_finalize(state, n_keys, panes)
         pane_mask = np.zeros(self.n_panes, dtype=np.bool_)
         if panes is None:
             pane_mask[:] = True

@@ -56,24 +56,27 @@ class KeyTable:
             )
         except KeyError:
             pass
-        # miss path: insert new keys. None normalizes to "" (nil-key rule:
-        # null dimensions group under the empty key, reference behavior) but
-        # the raw form is aliased to the same slot so the NEXT batch takes
-        # the zero-miss fast path again.
+        # miss path: insert ONLY the new keys, then re-run the C-speed map.
+        # dict.fromkeys gives ordered-distinct at C speed, so the Python
+        # loop is bounded by the number of distinct keys in the batch — at
+        # 1M-key cardinality (32+ consecutive miss batches) this is the
+        # difference between ~15ms and ~75ms per 64k batch.
+        # None normalizes to "" (nil-key rule: null dimensions group under
+        # the empty key, reference behavior) but the raw form is aliased to
+        # the same slot so the NEXT batch takes the zero-miss fast path.
         keys = self._keys
-        out = np.empty(n, dtype=np.int32)
-        for i, k in enumerate(lst):
-            slot = ids.get(k)
+        for k in dict.fromkeys(lst):
+            if k in ids:
+                continue
+            norm = self._normalize(k)
+            slot = ids.get(norm)
             if slot is None:
-                norm = self._normalize(k)
-                slot = ids.get(norm)
-                if slot is None:
-                    slot = len(keys)
-                    ids[norm] = slot
-                    keys.append(norm)
-                if norm is not k:
-                    ids[k] = slot  # alias raw form (None / un-normalized tuple)
-            out[i] = slot
+                slot = len(keys)
+                ids[norm] = slot
+                keys.append(norm)
+            if norm is not k:
+                ids[k] = slot  # alias raw form (None / un-normalized tuple)
+        out = np.fromiter(map(ids.__getitem__, lst), dtype=np.int32, count=n)
         grew = False
         while len(keys) > self.capacity:
             self.capacity *= 2

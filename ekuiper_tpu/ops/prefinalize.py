@@ -97,6 +97,98 @@ def hist_bin_np(values: np.ndarray) -> np.ndarray:
     return np.where(v > 0, pos, np.where(v < 0, neg, _HIST_HALF)).astype(np.int32)
 
 
+def _hh_slot_np(code: np.ndarray, d: int) -> np.ndarray:
+    """Numpy twin of the per-depth slot hash in sketches.hh_update_parts."""
+    from .sketches import HH_WIDTH, _hh_salt
+
+    h = _splitmix32_np(
+        code.astype(np.uint32) ^ np.uint32(_hh_salt(d)), 0x7FEB352D, 0x846CA68B
+    )
+    return (h % np.uint32(HH_WIDTH)).astype(np.int32)
+
+
+def hh_update_parts_np(codes: np.ndarray, mf: np.ndarray):
+    """Numpy twin of sketches.hh_update_parts (shadow fold)."""
+    from .sketches import HH_BITS, HH_DEPTH, HH_WIDTH
+
+    code = np.nan_to_num(codes, nan=0.0).astype(np.uint32)
+    bits = [
+        ((code >> np.uint32(b)) & np.uint32(1)).astype(np.float32)
+        for b in range(HH_BITS)
+    ]
+    idx_parts, w_parts = [], []
+    for d in range(HH_DEPTH):
+        slot = _hh_slot_np(code, d)
+        base = (d * HH_WIDTH + slot) * (1 + HH_BITS)
+        idx_parts.append(base)
+        w_parts.append(mf)
+        for b in range(HH_BITS):
+            idx_parts.append(base + 1 + b)
+            w_parts.append(mf * bits[b])
+    return np.stack(idx_parts, axis=1), np.stack(w_parts, axis=1)
+
+
+def hh_dedupe_topk(codes_row, est_row, k: int):
+    """Dedupe estimate-descending candidates (a code can appear once per
+    depth) and trim to top-k (code, count) pairs. Shared by the device
+    finalize route (groupby._host_finalize) and the numpy components route
+    (hh_topk_np) so both produce identical top lists."""
+    seen = set()
+    row = []
+    for c, e in zip(codes_row, est_row):
+        if e <= 0:
+            break
+        c = int(c)
+        if c in seen:
+            continue
+        seen.add(c)
+        row.append((c, int(round(e))))
+        if len(row) >= k:
+            break
+    return row
+
+
+def hh_topk_np(hh: np.ndarray, k: int) -> np.ndarray:
+    """Recover per-key top-k (code, count) pairs from the linear
+    heavy-hitters sketch. hh: (capacity, HH_SIZE). Returns an object array
+    of [(code, est_count), ...] lists, count-descending.
+
+    Recovery: per (depth, slot), bit-majority vote reconstructs the code
+    that dominates the slot; a candidate must hash back to its own slot
+    (garbage codes from mixed slots almost never do), and its count is the
+    count-min estimate (min over depth totals at the code's slots)."""
+    from .sketches import HH_BITS, HH_DEPTH, HH_WIDTH
+
+    cap = hh.shape[0]
+    a = hh.reshape(cap, HH_DEPTH, HH_WIDTH, 1 + HH_BITS)
+    tot = a[..., 0]  # (cap, D, W)
+    bits = (a[..., 1:] * 2.0) > tot[..., None]
+    codes = np.zeros((cap, HH_DEPTH, HH_WIDTH), dtype=np.uint32)
+    for b in range(HH_BITS):
+        codes |= bits[..., b].astype(np.uint32) << np.uint32(b)
+    ok = tot > 0
+    wslots = np.arange(HH_WIDTH, dtype=np.int32)[None, :]
+    for d in range(HH_DEPTH):
+        ok[:, d, :] &= _hh_slot_np(codes[:, d, :], d) == wslots
+    est = np.full(codes.shape, np.inf, dtype=np.float32)
+    rows = np.arange(cap)[:, None]
+    flat_codes = codes.reshape(cap, -1)
+    for d2 in range(HH_DEPTH):
+        s = _hh_slot_np(flat_codes, d2)  # (cap, D*W)
+        est = np.minimum(est, tot[rows, d2, s].reshape(codes.shape))
+    est = np.where(ok, est, 0.0)
+    out = np.empty(cap, dtype=np.object_)
+    out[:] = [[] for _ in range(cap)]
+    flat_est = est.reshape(cap, -1)
+    live = np.nonzero(flat_est.max(axis=1) > 0)[0]
+    if len(live):
+        order = np.argsort(-flat_est[live], axis=1)
+        for li, i in enumerate(live.tolist()):
+            out[i] = hh_dedupe_topk(
+                flat_codes[i, order[li]], flat_est[i, order[li]], k)
+    return out
+
+
 def hist_quantile_np(hist: np.ndarray, frac: float) -> np.ndarray:
     total = np.sum(hist, axis=-1)
     cum = np.cumsum(hist, axis=-1)
@@ -146,6 +238,10 @@ def final_value_np(spec: AggSpec, c: Dict[str, np.ndarray]) -> np.ndarray:
             return np.round(hll_estimate_np(regs))
         if kind == "percentile_approx":
             return hist_quantile_np(c["hist"], spec.frac)
+        if kind == "heavy_hitters":
+            # (code, count) pairs — the fused node decodes codes back to the
+            # original values through its per-column ValueDict
+            return hh_topk_np(c["hh"], spec.topk)
     raise ValueError(f"unknown device agg kind {kind}")
 
 
@@ -241,6 +337,23 @@ class HostShadow:
                         b = hist_bin_np(v)
                         kk = np.full(int(m.sum()), k)
                         np.add.at(arr, (slots[m], kk, b[m]), 1.0)
+                elif comp == "hh":
+                    if m.any():
+                        idx, wts = hh_update_parts_np(v[m], mf[m])
+                        sl = slots[m][:, None]
+                        kk = np.full((int(m.sum()), 1), k)
+                        np.add.at(arr, (sl, kk, idx), wts)
+
+
+def unpack_components(arr: np.ndarray, layout) -> Dict[str, np.ndarray]:
+    """Split the stacked (capacity, W) components array back into the
+    per-component dict, per the kernel's _components_layout()."""
+    cap = arr.shape[0]
+    return {
+        comp: arr[:, col] if shape == () else
+        arr[:, col:col + w].reshape((cap,) + shape)
+        for comp, col, w, shape in layout
+    }
 
 
 _MERGE_MAX = {"mn": False, "mx": True, "hll": True}
@@ -326,13 +439,8 @@ class PendingFinalize:
         import time
 
         try:
-            arr = np.asarray(self.stacked)
-            cap = arr.shape[0]
-            self._result = {
-                comp: arr[:, col] if shape == () else
-                arr[:, col:col + w].reshape((cap,) + shape)
-                for comp, col, w, shape in self.layout
-            }
+            self._result = unpack_components(
+                np.asarray(self.stacked), self.layout)
         except BaseException as exc:  # surfaced to the emit thread
             self._exc = exc
         finally:

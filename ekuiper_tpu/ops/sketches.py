@@ -127,6 +127,100 @@ def hist_quantile(hist, frac: float):
     return jnp.where(total > 0, val, jnp.nan)
 
 
+# ------------------------------------------------- heavy hitters (linear)
+# Device-native heavy hitters = count-min totals + group-testing bit
+# recovery (a "deltoid" sketch): values dictionary-encode to integer codes
+# < 2^HH_BITS; each code updates, per depth row d, the slot h_d(code) with
+#   counters[0]     += 1          (count-min total — the estimate table)
+#   counters[1 + b] += bit_b(code)  for every bit b of the code
+# A code that holds the MAJORITY of a slot's traffic is recovered exactly by
+# per-bit majority vote (bit_b = counters[1+b] > counters[0]/2), then
+# validated by hashing back to its slot and estimated by the count-min rule
+# (min of totals across depths). Every counter update is a scatter-add, so
+# the sketch is LINEAR: panes merge by +, shards merge by psum — the same
+# property that makes hll/hist fold into the fused kernel.
+HH_DEPTH = 2
+HH_WIDTH = 64
+HH_BITS = 20  # dictionary codes < 2^20 (~1M distinct values per column)
+HH_SIZE = HH_DEPTH * HH_WIDTH * (1 + HH_BITS)
+HH_MAX_CODES = 1 << HH_BITS
+
+
+def _hh_salt(d: int) -> int:
+    return (0x9E3779B9 * (d + 7)) & 0xFFFFFFFF
+
+
+def hh_update_parts(codes, mf):
+    """Scatter indices + weights for one micro-batch of dictionary codes.
+
+    codes: (mb,) float32 integer codes (NaN rows carry weight 0 via mf).
+    mf: (mb,) float32 row mask. Returns (idx, wts) of shape
+    (mb, HH_DEPTH*(1+HH_BITS)) addressing the flat per-key hh component.
+    """
+    import jax.numpy as jnp
+
+    code = jnp.nan_to_num(codes, nan=0.0).astype(jnp.uint32)
+    bits = [
+        ((code >> jnp.uint32(b)) & jnp.uint32(1)).astype(jnp.float32)
+        for b in range(HH_BITS)
+    ]
+    idx_parts, w_parts = [], []
+    for d in range(HH_DEPTH):
+        h = _splitmix32(code ^ jnp.uint32(_hh_salt(d)), 0x7FEB352D, 0x846CA68B)
+        slot = (h % jnp.uint32(HH_WIDTH)).astype(jnp.int32)
+        base = (jnp.int32(d * HH_WIDTH) + slot) * jnp.int32(1 + HH_BITS)
+        idx_parts.append(base)
+        w_parts.append(mf)
+        for b in range(HH_BITS):
+            idx_parts.append(base + 1 + b)
+            w_parts.append(mf * bits[b])
+    return jnp.stack(idx_parts, axis=1), jnp.stack(w_parts, axis=1)
+
+
+def hh_candidates(hh, k2: int):
+    """Device-side heavy-hitter recovery from the pane-merged sketch.
+
+    hh: (capacity, HH_SIZE) float32. Returns (codes, est) each (cap, k2):
+    the top-k2 bit-majority candidates per key by count-min estimate
+    (pre-dedupe — a code can appear once per depth, so k2 = 2*topk
+    guarantees topk uniques). Keeping recovery on device shrinks the emit
+    transfer from HH_SIZE floats/key (~10.7KB) to 2*k2 floats/key."""
+    import jax
+    import jax.numpy as jnp
+
+    cap = hh.shape[0]
+    a = hh.reshape(cap, HH_DEPTH, HH_WIDTH, 1 + HH_BITS)
+    tot = a[..., 0]  # (cap, D, W)
+    bits = (a[..., 1:] * 2.0) > tot[..., None]
+    shifts = jnp.arange(HH_BITS, dtype=jnp.uint32)
+    codes = jnp.sum(
+        bits.astype(jnp.uint32) << shifts, axis=-1
+    )  # (cap, D, W) uint32
+    # a recovered code must hash back to its own slot (garbage codes from
+    # mixed slots almost never do) and the slot must have traffic
+    wslots = jnp.arange(HH_WIDTH, dtype=jnp.uint32)[None, :]
+    ok = tot > 0
+    ok_parts = []
+    for d in range(HH_DEPTH):
+        h = _splitmix32(
+            codes[:, d, :] ^ jnp.uint32(_hh_salt(d)), 0x7FEB352D, 0x846CA68B
+        ) % jnp.uint32(HH_WIDTH)
+        ok_parts.append(ok[:, d, :] & (h == wslots))
+    ok = jnp.stack(ok_parts, axis=1)
+    # count-min estimate: min over depths of the total at the code's slot
+    flat = codes.reshape(cap, -1)  # (cap, D*W)
+    est = jnp.full(flat.shape, jnp.inf, dtype=jnp.float32)
+    for d2 in range(HH_DEPTH):
+        s = (_splitmix32(
+            flat ^ jnp.uint32(_hh_salt(d2)), 0x7FEB352D, 0x846CA68B
+        ) % jnp.uint32(HH_WIDTH)).astype(jnp.int32)
+        est = jnp.minimum(est, jnp.take_along_axis(tot[:, d2, :], s, axis=1))
+    est = jnp.where(ok.reshape(cap, -1), est, 0.0)
+    top_est, top_idx = jax.lax.top_k(est, k2)
+    top_codes = jnp.take_along_axis(flat, top_idx, axis=1)
+    return top_codes.astype(jnp.float32), top_est
+
+
 # ----------------------------------------------------------------- count-min
 class CountMinSketch:
     """Window-level device count-min sketch with host candidate tracking for

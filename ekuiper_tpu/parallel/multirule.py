@@ -111,6 +111,10 @@ def build_rule_batch(
     plan = extract_kernel_plan(canon_stmt)
     if plan is None:
         raise ValueError("rule group is not device-eligible")
+    if any(s.kind == "heavy_hitters" for s in plan.specs):
+        # hh finalize is a host-side top-k recovery, not part of the vmapped
+        # device finalize program — such rules run as individual fused nodes
+        raise ValueError("heavy_hitters rules do not batch")
     n_params = len(param_rows[0])
     param_names = [f"{PARAM_PREFIX}{i}" for i in range(n_params)]
     # params are injected at fold time, not uploaded as batch columns

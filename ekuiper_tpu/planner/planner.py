@@ -258,6 +258,26 @@ def device_path_eligible(
                 if node.name not in dim_names:
                     return None
     plan = extract_kernel_plan(stmt)
+    if plan is not None and any(
+        s.kind == "heavy_hitters" for s in plan.specs
+    ):
+        # heavy_hitters: the reversible value dictionary lives on the single
+        # fused node (codes are node-local), so the sharded kernel is out;
+        # and the result is a list — it must be a bare SELECT field, not an
+        # operand of HAVING/ORDER/composite expressions
+        if (opts.plan_optimize_strategy or {}).get("mesh"):
+            return None
+        roots = ([stmt.having] if stmt.having is not None else []) + [
+            sf.expr for sf in stmt.sorts if sf.expr is not None
+        ]
+        for f in stmt.fields:
+            if not (isinstance(f.expr, ast.Call)
+                    and f.expr.name == "heavy_hitters"):
+                roots.append(f.expr)
+        for root in roots:
+            for node in ast.walk(root):
+                if isinstance(node, ast.Call) and node.name == "heavy_hitters":
+                    return None
     return plan
 
 

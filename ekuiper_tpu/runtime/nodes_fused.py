@@ -19,8 +19,10 @@ import numpy as np
 from ..data.batch import ColumnBatch
 from ..data.rows import GroupedTuples, GroupedTuplesSet, Tuple, WindowRange
 from ..ops.aggspec import (
+    HH_COL_PREFIX,
     HLL_COL_PREFIX,
     KernelPlan,
+    ValueDict,
     _call_key,
     _hll_encode_numeric,
     hash_column_for_hll,
@@ -170,6 +172,19 @@ class FusedWindowAggNode(Node):
                     "(the exact host path handles unconditional sliding)")
         else:
             self.n_panes = 1
+        # heavy_hitters: per-column reversible dictionaries (codes -> values)
+        # + the spec index -> raw column map for emit-time decoding. The hh
+        # component is wide (sketches.HH_SIZE floats/key), so start small and
+        # grow on demand instead of allocating the full default capacity.
+        self._hh_cols: Dict[int, str] = {
+            i: next(iter(s.arg.columns))[len(HH_COL_PREFIX):]
+            for i, s in enumerate(plan.specs)
+            if s.kind == "heavy_hitters"
+        }
+        self._hh_dicts: Dict[str, ValueDict] = {}
+        self._hh_overflow_warned: set = set()
+        if self._hh_cols and capacity > 2048:
+            capacity = 2048
         self.gb = self._make_gb(plan, capacity, micro_batch, mesh)
         # sharded path may round capacity up for even shard division
         self.kt = KeyTable(self.gb.capacity)
@@ -197,6 +212,9 @@ class FusedWindowAggNode(Node):
             and self.prefinalize_lead_ms > 0
             and self.gb.supports_prefinalize
             and plan.host_foldable
+            # hh boundaries use the compact device-recovery finalize — the
+            # pre-issue would ship the raw HH_SIZE-wide sketch instead
+            and not self._hh_cols
             and self.wt in (ast.WindowType.TUMBLING_WINDOW,
                             ast.WindowType.HOPPING_WINDOW)
             and self.prefinalize_lead_ms < self._tick_interval()
@@ -237,6 +255,20 @@ class FusedWindowAggNode(Node):
             and self.wt == ast.WindowType.TUMBLING_WINDOW
         )
         self._backstop = bool(prefinalize_backstop) and self._backstop_ok
+        # COUNT-window async emission: the boundary dispatches the device
+        # finalize on an immutable state snapshot, resets, and keeps folding;
+        # a worker thread fetches + emits when the result lands. Emission
+        # latency (one device round trip) stops stalling ingest — essential
+        # at 1M-key cardinality where the finalize fetch is MBs. Barriers
+        # and EOF drain the queue first, so ordering contracts hold.
+        self._async_count = (
+            self.wt == ast.WindowType.COUNT_WINDOW
+            and self.gb.supports_prefinalize
+            and not self._hh_cols
+            and prefinalize_lead_ms > 0
+        )
+        self._emit_q = None
+        self._emit_worker = None
         # telemetry: the last boundary found no landed device fetch
         self._storm = False
         # per-boundary record: {"source": "device"|"backstop"|"sync",
@@ -289,11 +321,13 @@ class FusedWindowAggNode(Node):
             dummy = self.gb.init_state()
             if self.is_event_time or self.wt == ast.WindowType.SLIDING_WINDOW:
                 # event-time and sliding folds ship per-row pane VECTORS
-                # (sliding also uses the scalar path for single-bucket
-                # batches) and finalize with traced pane masks — warm both
+                # and finalize with traced pane masks; only sliding also
+                # hits the scalar path (single-bucket batches) — event-time
+                # must not pay that extra compile
                 dummy = self.gb.fold(dummy, cols, slots,
                                      pane_idx=np.zeros(1, dtype=np.int64))
-                dummy = self.gb.fold(dummy, cols, slots, pane_idx=0)
+                if self.wt == ast.WindowType.SLIDING_WINDOW:
+                    dummy = self.gb.fold(dummy, cols, slots, pane_idx=0)
                 self.gb.finalize(dummy, 1, panes=[0])
             else:
                 dummy = self.gb.fold(dummy, cols, slots,
@@ -317,6 +351,11 @@ class FusedWindowAggNode(Node):
             self._timer.stop()
         for t in self._pre_timers:
             t.stop()
+        self._drain_async_emits()
+        if self._emit_q is not None and self._emit_worker is not None \
+                and self._emit_worker.is_alive():
+            self._emit_q.put(None)
+            self._emit_worker.join(timeout=5)
 
     def _tick_interval(self) -> int:
         if self.wt == ast.WindowType.TUMBLING_WINDOW:
@@ -404,6 +443,31 @@ class FusedWindowAggNode(Node):
                     cols[name] = hash_column_for_hll(col)
                 else:
                     cols[name] = _hll_encode_numeric(col)
+                v = sub.valid.get(raw)
+                if v is not None:
+                    valid[name] = v
+                continue
+            if name.startswith(HH_COL_PREFIX):
+                # heavy_hitters: dictionary-encode to dense codes the sketch
+                # can bit-recover; the dict decodes them back at emit
+                raw = name[len(HH_COL_PREFIX):]
+                col = sub.columns.get(raw)
+                vd = self._hh_dicts.setdefault(raw, ValueDict())
+                if col is None:
+                    cols[name] = np.full(sub.n, np.nan, dtype=np.float32)
+                else:
+                    cols[name] = vd.encode(col)
+                    if vd.overflowed and raw not in self._hh_overflow_warned:
+                        self._hh_overflow_warned.add(raw)
+                        self.stats.inc_exception(
+                            f"heavy_hitters dictionary overflow on '{raw}': "
+                            "values past the code budget are no longer "
+                            "counted")
+                        logger.warning(
+                            "heavy_hitters(%s): value dictionary exceeded "
+                            "%d distinct values; new values are invisible "
+                            "to the sketch", raw,
+                            len(vd.snapshot()))
                 v = sub.valid.get(raw)
                 if v is not None:
                     valid[name] = v
@@ -572,9 +636,84 @@ class FusedWindowAggNode(Node):
             self._rows_in_window += take
             pos += take
             if self._rows_in_window >= self.count_len:
-                self._emit(WindowRange(0, timex.now_ms()))
+                wr = WindowRange(0, timex.now_ms())
+                if self._async_count:
+                    self._emit_count_async(wr)
+                else:
+                    self._emit(wr)
                 self.state = self.gb.reset_pane(self.state, 0)
                 self._rows_in_window = 0
+
+    # ------------------------------------------------- async count emission
+    def _emit_count_async(self, wr: WindowRange) -> None:
+        """Dispatch the device finalize on the (immutable) current state and
+        hand the fetch+emit to the worker thread; the fold stream continues
+        without waiting a device round trip."""
+        import time as _time
+
+        n_keys = self.kt.n_keys
+        if n_keys == 0:
+            self.last_emit_info = None
+            return
+        stacked_dev = self.gb._finalize(
+            self.state, (True,) * self.gb.n_panes)
+        try:
+            stacked_dev.copy_to_host_async()
+        except AttributeError:
+            pass
+        self._ensure_emit_worker()
+        self._emit_q.put((stacked_dev, n_keys, wr, _time.time()))
+
+    def _ensure_emit_worker(self) -> None:
+        import queue
+        import threading
+
+        if self._emit_q is None:
+            self._emit_q = queue.Queue()
+        if self._emit_worker is None or not self._emit_worker.is_alive():
+            self._emit_worker = threading.Thread(
+                target=self._emit_worker_loop, name=f"{self.name}-emit",
+                daemon=True)
+            self._emit_worker.start()
+
+    def _emit_worker_loop(self) -> None:
+        import time as _time
+
+        from ..ops.groupby import apply_int_semantics
+
+        while True:
+            item = self._emit_q.get()
+            if item is None:
+                break
+            stacked_dev, n_keys, wr, t_issue = item
+            try:
+                arr = np.asarray(stacked_dev)
+                outs = [arr[i][:n_keys]
+                        for i in range(len(self.plan.specs))]
+                outs = apply_int_semantics(self.plan.specs, outs)
+                act = np.asarray(arr[-1][:n_keys])
+                self.last_emit_info = {
+                    "source": "device-async",
+                    "fetch_ms": (_time.time() - t_issue) * 1000.0,
+                    "ages_ms": [],
+                }
+                active = np.nonzero(act > 0)[0]
+                if len(active):
+                    if self.direct_emit is not None:
+                        self._emit_direct(outs, active, wr)
+                    else:
+                        self._emit_grouped(outs, active, wr)
+            except Exception as exc:
+                logger.error("async count-window emit failed: %s", exc)
+            finally:
+                self._emit_q.task_done()
+
+    def _drain_async_emits(self) -> None:
+        """Block until in-flight async emissions have been delivered —
+        called before checkpoints, EOF flush, and close so ordering and
+        snapshot contracts hold."""
+        if self._emit_q is not None:
+            self._emit_q.join()
 
     # ------------------------------------------------------------- sliding
     def _fold_sliding(self, sub: ColumnBatch) -> int:
@@ -586,13 +725,34 @@ class FusedWindowAggNode(Node):
             now = timex.now_ms()
             ts = np.full(sub.n, now, dtype=np.int64)
         buckets = ts // self.bucket_ms
-        # late guard: a row more than 3 buckets behind the stream would map
-        # onto a pane holding LIVE newer data (folding it would both corrupt
-        # that pane and emit an unreconstructable window) — drop + count,
-        # mirroring the event-time late drop
+        # a single batch spanning >= n_ring_panes buckets would alias two
+        # buckets onto one pane WITHIN one fold call (replay/backfill
+        # bursts); split into alias-free chunks folded in bucket order so
+        # each recycle lands before its pane receives new rows
+        if int(buckets.max() - buckets.min()) >= self.n_ring_panes:
+            order = np.argsort(buckets, kind="stable")
+            sorted_b = buckets[order]
+            start = 0
+            base = int(sorted_b[0])
+            for i in range(1, len(order) + 1):
+                if i == len(order) or int(sorted_b[i]) - base >= self.n_ring_panes:
+                    self._fold_sliding(sub.take(order[start:i]))
+                    if i < len(order):
+                        base = int(sorted_b[i])
+                        start = i
+            return sub.n
+        # late guard: drop a row ONLY when its pane has been recycled past
+        # its bucket (folding it would corrupt newer live data). Rows merely
+        # out of order — pane still holds their bucket, or an older one the
+        # recycle loop will reset — fold exactly like the host path.
         if self._ring_max_bucket >= 0:
-            late = buckets < self._ring_max_bucket - 3
-            if late.any():
+            drop_buckets = []
+            for b in np.unique(buckets).tolist():
+                held = self._pane_bucket.get(int(b) % self.n_ring_panes)
+                if held is not None and held > int(b):
+                    drop_buckets.append(int(b))
+            if drop_buckets:
+                late = np.isin(buckets, drop_buckets)
                 n_late = int(late.sum())
                 self.stats.inc_exception(
                     "late row dropped (sliding pane retention)", n=n_late)
@@ -828,6 +988,7 @@ class FusedWindowAggNode(Node):
             self.broadcast(eof)
             return
         now = timex.now_ms()
+        self._drain_async_emits()  # deliver queued count windows in order
         self._emit(WindowRange(now - self.length_ms, now))
         if self.wt == ast.WindowType.TUMBLING_WINDOW:
             self.state = self.gb.reset_pane(self.state, 0)
@@ -896,9 +1057,27 @@ class FusedWindowAggNode(Node):
             return
         self._emit_grouped(outs, active, wr)
 
+    def _decode_hh(self, outs):
+        """Map heavy_hitters (code, count) pairs back to original values."""
+        if not self._hh_cols:
+            return outs
+        outs = list(outs)
+        for i, raw in self._hh_cols.items():
+            vd = self._hh_dicts.get(raw)
+            col = outs[i]
+            dec = np.empty(len(col), dtype=np.object_)
+            dec[:] = [
+                [{"value": vd.decode(c) if vd else None, "count": n}
+                 for c, n in row]
+                for row in col
+            ]
+            outs[i] = dec
+        return outs
+
     def _emit_grouped(self, outs, active: np.ndarray, wr: WindowRange) -> None:
         """Row-path emit tail: build GroupedTuplesSet for downstream
         HAVING/ORDER/PROJECT nodes."""
+        outs = self._decode_hh(outs)
         # bulk-convert once (C speed) instead of per-slot numpy scalar access —
         # emit latency is dominated by this host loop at 10k+ groups
         active_list = active.tolist()
@@ -936,6 +1115,7 @@ class FusedWindowAggNode(Node):
     def _emit_direct(self, outs, active: np.ndarray, wr: WindowRange) -> None:
         """Vectorized tail: HAVING/ORDER/LIMIT/projection computed over the
         finalize arrays; emits the final output messages directly."""
+        outs = self._decode_hh(outs)
         dim_names = [d.name for d in self.dims]
         dim_cols: Dict[str, np.ndarray] = {}
         if dim_names:
@@ -990,6 +1170,7 @@ class FusedWindowAggNode(Node):
 
     # ------------------------------------------------------------------ state
     def snapshot_state(self) -> Optional[dict]:
+        self._drain_async_emits()
         self._flush_tail()
         host = self.gb.state_to_host(self.state)
         snap = {
@@ -998,6 +1179,11 @@ class FusedWindowAggNode(Node):
             "cur_pane": self.cur_pane,
             "rows_in_window": self._rows_in_window,
         }
+        if self._hh_dicts:
+            # code order indexes the saved sketch counters — must persist
+            snap["hh_dicts"] = {
+                c: vd.snapshot() for c, vd in self._hh_dicts.items()
+            }
         if self.is_event_time:
             snap["next_emit_bucket"] = self._next_emit_bucket
             snap["max_bucket"] = self._max_bucket
@@ -1033,6 +1219,10 @@ class FusedWindowAggNode(Node):
             self.state = self.gb.state_from_host(host)
         self.cur_pane = state.get("cur_pane", 0)
         self._rows_in_window = state.get("rows_in_window", 0)
+        for c, values in state.get("hh_dicts", {}).items():
+            vd = ValueDict()
+            vd.restore(values)
+            self._hh_dicts[c] = vd
         if self.is_event_time:
             self._next_emit_bucket = state.get("next_emit_bucket")
             self._max_bucket = state.get("max_bucket")

@@ -199,10 +199,9 @@ class TestSlidingDeviceParity:
 
 class TestSlidingRobustness:
     def test_late_rows_dropped_not_corrupting(self):
-        """A row far behind the stream must be dropped (counted), not fold
-        into a pane holding live newer data."""
-        rng = np.random.default_rng(13)
-        batches = mkbatches(rng, n_batches=4, rows=32, t0=100_000)
+        """A late row is dropped (counted) ONLY when its pane has been
+        recycled past its bucket; an ancient row landing in an unused pane
+        is accepted harmlessly and never pollutes emitted windows."""
         stmt = parse_select(SQL)
         plan = extract_kernel_plan(stmt)
         node = FusedWindowAggNode(
@@ -210,16 +209,30 @@ class TestSlidingRobustness:
             capacity=64, micro_batch=64,
             direct_emit=build_direct_emit(stmt, plan, ["deviceId"]))
         node.state = node.gb.init_state()
-        node.broadcast = lambda item: None
-        for b in batches:
-            node.process(b)
-        # ancient row (way behind retention)
-        old = ColumnBatch(
-            n=1, columns={"deviceId": np.array(["d0"], dtype=np.object_),
-                          "temp": np.array([50.0], dtype=np.float32)},
-            timestamps=np.array([1_000], dtype=np.int64), emitter="s")
+        got = []
+        node.broadcast = lambda item: got.append(item)
+
+        def b(ts_list, temps):
+            k = len(ts_list)
+            return ColumnBatch(
+                n=k,
+                columns={"deviceId": np.array(["d0"] * k, dtype=np.object_),
+                         "temp": np.asarray(temps, dtype=np.float32)},
+                timestamps=np.asarray(ts_list, dtype=np.int64), emitter="s")
+
+        node.process(b([100_000, 100_200, 100_400], [50.0, 50.0, 50.0]))
+        # ancient row: its pane was never assigned -> accepted, no drop
         before = node.stats.exceptions
-        node.process(old)
+        node.process(b([1_000], [50.0]))
+        assert node.stats.exceptions == before
+        # trigger: the emitted window must NOT include the ancient row
+        node.process(b([100_500], [95.0]))
+        msgs = flat(got)
+        assert len(msgs) == 1 and msgs[0]["c"] == 4
+        # row whose bucket ALIASES the pane of a live newer bucket -> drop
+        head_bucket = 100_500 // node.bucket_ms
+        conflict_ts = (head_bucket - node.n_ring_panes) * node.bucket_ms + 1
+        node.process(b([conflict_ts], [50.0]))
         assert node.stats.exceptions == before + 1
         assert "sliding pane retention" in node.stats.last_exception
 
@@ -287,3 +300,72 @@ class TestSlidingRobustness:
         by = {m["deviceId"]: m["c"] for m in msgs}
         # window (8050, 11050]: all three rows
         assert by == {"x": 2, "y": 1}
+
+
+class TestSlidingBurst:
+    def test_batch_spanning_pane_budget_stays_exact(self):
+        """A replay burst whose single batch spans more buckets than the
+        pane ring must fold in alias-free chunks — the emitted window stays
+        exact (review finding r3: two aliased buckets corrupted one pane)."""
+        stmt = parse_select(SQL)
+        from ekuiper_tpu.ops.aggspec import extract_kernel_plan as _ekp
+        from ekuiper_tpu.ops.emit import build_direct_emit as _bde
+        plan = _ekp(stmt)
+        node = FusedWindowAggNode(
+            "burst", stmt.window, plan,
+            dims=[d.expr for d in stmt.dimensions],
+            capacity=64, micro_batch=512,
+            direct_emit=_bde(stmt, plan, ["deviceId"]))
+        node.state = node.gb.init_state()
+        got = []
+        node.broadcast = lambda item: got.append(item)
+        n = 200
+        span_ms = (node.n_ring_panes + 5) * node.bucket_ms
+        ts = np.sort(np.random.default_rng(3).integers(
+            10_000, 10_000 + span_ms, n)).astype(np.int64)
+        temp = np.full(n, 50.0, dtype=np.float32)
+        temp[-1] = 95.0  # single trigger row at the end
+        batch = ColumnBatch(
+            n=n, columns={"deviceId": np.array(["d0"] * n, dtype=np.object_),
+                          "temp": temp},
+            timestamps=ts, emitter="s")
+        node.process(batch)
+        msgs = flat(got)
+        assert len(msgs) == 1
+        t = int(ts[-1])
+        exact = int(np.sum((ts > t - stmt.window.length_ms()) & (ts <= t)))
+        assert msgs[0]["c"] == exact
+
+    def test_mildly_late_rows_still_fold(self):
+        """Rows a few buckets out of order are NOT dropped when their pane
+        still holds their bucket (review finding r3: over-aggressive late
+        guard)."""
+        stmt = parse_select(SQL)
+        from ekuiper_tpu.ops.aggspec import extract_kernel_plan as _ekp
+        from ekuiper_tpu.ops.emit import build_direct_emit as _bde
+        plan = _ekp(stmt)
+        node = FusedWindowAggNode(
+            "late", stmt.window, plan,
+            dims=[d.expr for d in stmt.dimensions],
+            capacity=64, micro_batch=512,
+            direct_emit=_bde(stmt, plan, ["deviceId"]))
+        node.state = node.gb.init_state()
+        got = []
+        node.broadcast = lambda item: got.append(item)
+
+        def b(ts_list, temps):
+            k = len(ts_list)
+            return ColumnBatch(
+                n=k,
+                columns={"deviceId": np.array(["d0"] * k, dtype=np.object_),
+                         "temp": np.asarray(temps, dtype=np.float32)},
+                timestamps=np.asarray(ts_list, dtype=np.int64), emitter="s")
+
+        node.process(b([10_000, 10_400], [50.0, 50.0]))
+        # 8 buckets (200ms) behind the stream head, pane not recycled
+        node.process(b([10_200], [50.0]))
+        # trigger: window (8410-2000, 8410+0] ... covers all four rows
+        node.process(b([10_410], [95.0]))
+        msgs = flat(got)
+        assert len(msgs) == 1
+        assert msgs[0]["c"] == 4  # the late row counted
