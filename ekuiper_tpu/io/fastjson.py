@@ -1,0 +1,124 @@
+"""Native columnar JSON decode — loader for native/jsoncol.cpp (ekjsoncol).
+
+The ingest hot path hands a broker drain (list of raw JSON object payloads)
+plus the stream's typed schema to the C decoder, which fills numpy columns +
+validity masks in one pass (repeated strings interned). Falls back to the
+Python decode+from_messages chain when the extension is unavailable, the
+schema has non-scalar fields, or the C parser raises Fallback (int64
+overflow, non-bytes payloads).
+
+Reference analogue: the schema-aware fastjson converter
+(/root/reference/internal/converter/json) that feeds SliceTuple columns.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..data.types import DataType, Schema
+from ..utils.infra import logger
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_lock = threading.Lock()
+_mod = None
+_tried = False
+_build_started = False
+
+_FIELD_TYPES = {
+    DataType.FLOAT: 0,
+    DataType.BIGINT: 1,
+    DataType.BOOLEAN: 2,
+    DataType.STRING: 3,
+}
+
+
+def _build() -> bool:
+    try:
+        native = os.path.abspath(_NATIVE_DIR)
+        scratch = f"build.tmp.jc.{os.getpid()}"
+        subprocess.run(
+            ["make", "-C", native, f"BUILD={scratch}",
+             f"{scratch}/ekjsoncol.so"],
+            capture_output=True, timeout=180, check=True,
+        )
+        os.makedirs(os.path.join(native, "build"), exist_ok=True)
+        os.replace(os.path.join(native, scratch, "ekjsoncol.so"),
+                   os.path.join(native, "build", "ekjsoncol.so"))
+        try:
+            os.rmdir(os.path.join(native, scratch))
+        except OSError:
+            pass
+        return True
+    except Exception as e:
+        logger.warning("ekjsoncol build failed (%s); python decode path", e)
+        return False
+
+
+def ensure_native(background: bool = True) -> None:
+    """Kick off the native build once per process; never blocks ingest."""
+    global _build_started
+    so = os.path.abspath(os.path.join(_NATIVE_DIR, "build", "ekjsoncol.so"))
+    with _lock:
+        if os.path.exists(so) or _tried or _build_started:
+            return
+        _build_started = True
+    if background:
+        threading.Thread(target=_build, daemon=True,
+                         name="ekjsoncol-build").start()
+    else:
+        _build()
+
+
+def _load():
+    global _mod, _tried
+    with _lock:
+        if _tried:
+            return _mod
+        so = os.path.abspath(
+            os.path.join(_NATIVE_DIR, "build", "ekjsoncol.so"))
+        if not os.path.exists(so):
+            return None  # keep probing; a background build may land
+        try:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location("ekjsoncol", so)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _mod = mod
+        except Exception as e:
+            logger.warning("ekjsoncol load failed (%s); python decode", e)
+            _mod = None
+        _tried = True
+        return _mod
+
+
+def schema_field_spec(schema: Optional[Schema]):
+    """((name, ctype), ...) when every schema field is C-decodable, else
+    None (caller uses the Python path)."""
+    if schema is None or schema.schemaless or not schema.fields:
+        return None
+    spec = []
+    for f in schema.fields:
+        t = _FIELD_TYPES.get(f.type)
+        if t is None:
+            return None
+        spec.append((f.name, t))
+    return tuple(spec)
+
+
+def decode_columns(
+    payloads: List[bytes], field_spec,
+) -> Optional[Tuple[Dict[str, Any], Dict[str, Any], Any]]:
+    """(columns, valid, bad) via the native decoder, or None to fall back."""
+    mod = _load()
+    if mod is None:
+        return None
+    try:
+        return mod.decode(list(payloads), field_spec)
+    except mod.Fallback:
+        return None
+    except Exception as e:
+        logger.warning("ekjsoncol decode error (%s); python fallback", e)
+        return None

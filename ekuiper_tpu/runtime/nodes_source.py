@@ -59,7 +59,38 @@ class SourceNode(Node):
                 f for f in self.schema.fields
                 if f.name in self.project_columns])
         self.emit_batches = emit_batches
-        self._pending: List[Tuple] = []
+        # batch mode buffers RAW decoded messages; schema coercion +
+        # event-time extraction run COLUMNAR at flush (data/batch.py
+        # from_messages) instead of per-row — the row path (emit_batches=
+        # False) keeps the per-tuple preprocessor
+        self._pending_msgs: List[Dict[str, Any]] = []
+        self._pending_ts: List[int] = []
+        # native fast path: JSON bytes payloads for a fully-scalar typed
+        # schema buffer RAW and decode straight to columns in C at flush
+        # (io/fastjson.py over native/jsoncol.cpp)
+        self._fast_spec = None
+        self._pending_raw: List[bytes] = []
+        self._pending_raw_ts: List[int] = []
+        if converter is not None and schema is not None:
+            from ..io.converters import JsonConverter
+            from ..io.fastjson import ensure_native, schema_field_spec
+
+            if type(converter) is JsonConverter and \
+                    self.strict != cast.STRICT:
+                # STRICT streams keep the python cast path — the C decoder
+                # hard-codes CONVERT_ALL coercion
+                spec = schema_field_spec(self.schema)
+                if spec is not None and timestamp_field:
+                    # event-time via the fast path needs an exact int64
+                    # column; other shapes keep the python extractor
+                    ftypes = {f.name: f.type for f in self.schema.fields}
+                    from ..data.types import DataType
+
+                    if ftypes.get(timestamp_field) != DataType.BIGINT:
+                        spec = None
+                self._fast_spec = spec
+                if spec is not None:
+                    ensure_native()
         self._pending_lock = threading.Lock()
         self._linger_timer = None
 
@@ -76,8 +107,31 @@ class SourceNode(Node):
 
     def ingest(self, payload: Any, metadata: Optional[Dict[str, Any]] = None) -> None:
         """Connector callback: raw bytes (decoded here via the stream's
-        FORMAT converter), dict, list of dicts, or Tuple."""
+        FORMAT converter), a LIST of raw bytes payloads (a broker drain —
+        batch-decoded), dict, list of dicts, or Tuple."""
         now = timex.now_ms()
+        if self._fast_spec is not None and self.emit_batches:
+            raws = None
+            if isinstance(payload, (bytes, bytearray)):
+                raws = [bytes(payload)]
+            elif (isinstance(payload, list) and payload
+                  and all(isinstance(p, (bytes, bytearray))
+                          for p in payload)):
+                raws = [bytes(p) for p in payload]
+            if raws is not None:
+                self.stats.inc_in(len(raws))
+                with self._pending_lock:
+                    self._pending_raw.extend(raws)
+                    self._pending_raw_ts.extend([now] * len(raws))
+                    full = (len(self._pending_raw) + len(self._pending_msgs)
+                            >= self.micro_batch_rows)
+                if full:
+                    self._flush()
+                elif self._linger_timer is None or self._linger_timer.fired \
+                        or self._linger_timer.stopped:
+                    self._linger_timer = timex.after(
+                        self.linger_ms, lambda ts: self._flush())
+                return
         if isinstance(payload, (bytes, bytearray)):
             if self.converter is None:
                 self.stats.inc_exception("bytes payload but no converter")
@@ -87,39 +141,97 @@ class SourceNode(Node):
             except Exception as exc:
                 self.stats.inc_exception(f"decode error: {exc}")
                 return
-        rows: List[Tuple] = []
+        msgs: List[Dict[str, Any]] = []
         if isinstance(payload, Tuple):
-            rows = [payload]
+            if not self.emit_batches:
+                t = self._preprocess(payload)
+                if t is not None:
+                    self.stats.inc_in(1)
+                    self.emit(t)
+                return
+            # preserve the tuple's own (replay/historical) timestamp
+            self.stats.inc_in(1)
+            with self._pending_lock:
+                self._pending_msgs.append(payload.message)
+                self._pending_ts.append(payload.timestamp or now)
+                full = (len(self._pending_msgs) + len(self._pending_raw)
+                        >= self.micro_batch_rows)
+            if full:
+                self._flush()
+            elif self._linger_timer is None or self._linger_timer.fired \
+                    or self._linger_timer.stopped:
+                self._linger_timer = timex.after(
+                    self.linger_ms, lambda ts: self._flush())
+            return
         elif isinstance(payload, dict):
-            rows = [Tuple(emitter=self.name, message=payload, timestamp=now,
-                          metadata=metadata or {})]
+            msgs = [payload]
         elif isinstance(payload, list):
-            rows = [
-                Tuple(emitter=self.name, message=m, timestamp=now,
-                      metadata=metadata or {})
-                for m in payload if isinstance(m, dict)
-            ]
+            if payload and isinstance(payload[0], (bytes, bytearray)):
+                msgs = self._decode_many(payload)
+                if msgs is None:
+                    return
+            else:
+                msgs = [m for m in payload if isinstance(m, dict)]
         elif payload is None:
             return
         else:
             self.stats.inc_exception(f"unsupported payload {type(payload)}")
             return
-        self.stats.inc_in(len(rows))
-        rows = [self._preprocess(t) for t in rows]
-        rows = [t for t in rows if t is not None]
-        if not rows:
+        if not msgs:
             return
+        self.stats.inc_in(len(msgs))
         if not self.emit_batches:
-            for t in rows:
-                self.emit(t)
+            for m in msgs:
+                t = self._preprocess(Tuple(
+                    emitter=self.name, message=m, timestamp=now,
+                    metadata=metadata or {}))
+                if t is not None:
+                    self.emit(t)
             return
         with self._pending_lock:
-            self._pending.extend(rows)
-            full = len(self._pending) >= self.micro_batch_rows
+            self._pending_msgs.extend(msgs)
+            self._pending_ts.extend([now] * len(msgs))
+            full = (len(self._pending_msgs) + len(self._pending_raw)
+                    >= self.micro_batch_rows)
         if full:
             self._flush()
         elif self._linger_timer is None or self._linger_timer.fired or self._linger_timer.stopped:
             self._linger_timer = timex.after(self.linger_ms, lambda ts: self._flush())
+
+    def _decode_many(self, payloads: List[bytes]) -> Optional[List[Dict[str, Any]]]:
+        """Batch-decode a run of raw payloads. For JSON this splices the
+        payloads into ONE array and parses once — one C-level json.loads
+        instead of thousands (≈4x per-object) — falling back to per-payload
+        decode when any payload is itself an array or malformed."""
+        from ..io.converters import JsonConverter
+
+        if self.converter is None:
+            self.stats.inc_exception("bytes payload but no converter")
+            return None
+        if isinstance(self.converter, JsonConverter) and all(
+                isinstance(p, (bytes, bytearray)) for p in payloads):
+            try:
+                spliced = b"[" + b",".join(bytes(p) for p in payloads) + b"]"
+                out = self.converter.decode(spliced)
+                if all(isinstance(m, dict) for m in out):
+                    return out
+            except Exception:
+                pass  # fall through: per-payload decode isolates bad ones
+        msgs: List[Dict[str, Any]] = []
+        for p in payloads:
+            if isinstance(p, dict):  # mixed drains: dicts pass through
+                msgs.append(p)
+                continue
+            try:
+                m = self.converter.decode(bytes(p))
+            except Exception as exc:
+                self.stats.inc_exception(f"decode error: {exc}")
+                continue
+            if isinstance(m, dict):
+                msgs.append(m)
+            elif isinstance(m, list):
+                msgs.extend(x for x in m if isinstance(x, dict))
+        return msgs
 
     def _preprocess(self, t: Tuple) -> Optional[Tuple]:
         """Schema validation/coercion + event-time extraction
@@ -174,11 +286,95 @@ class SourceNode(Node):
                 self.stats.inc_exception(f"rewind failed: {exc}")
 
     def _flush(self) -> None:
+        from ..data.batch import from_messages
+
         with self._pending_lock:
-            if not self._pending:
+            if not self._pending_msgs and not self._pending_raw:
                 return
-            rows, self._pending = self._pending, []
-        batch = from_tuples(rows, schema=self.schema, emitter=self.name)
+            msgs, self._pending_msgs = self._pending_msgs, []
+            tss, self._pending_ts = self._pending_ts, []
+            raws, self._pending_raw = self._pending_raw, []
+            rtss, self._pending_raw_ts = self._pending_raw_ts, []
+        if msgs:
+            batch, n_drop = from_messages(
+                msgs, tss, schema=self.schema, emitter=self.name,
+                strict=self.strict, timestamp_field=self.timestamp_field,
+                on_error=self.stats.inc_exception,
+                project=self.project_columns)
+            if n_drop:
+                logger.debug("source %s dropped %d rows at columnarize",
+                             self.name, n_drop)
+            if batch.n:
+                self.emit(batch, count=batch.n)
+        if raws:
+            self._flush_raw(raws, rtss)
+
+    def _flush_raw(self, raws: List[bytes], rtss: List[int]) -> None:
+        """Native columnar decode of buffered raw JSON payloads
+        (io/fastjson.py); python fallback preserves row↔timestamp pairing."""
+        import numpy as np
+
+        from ..io.fastjson import decode_columns
+
+        out = decode_columns(raws, self._fast_spec)
+        if out is None:
+            from ..data.batch import from_messages
+
+            msgs: List[Dict[str, Any]] = []
+            tss: List[int] = []
+            for p, t in zip(raws, rtss):
+                try:
+                    m = self.converter.decode(p)
+                except Exception as exc:
+                    self.stats.inc_exception(f"decode error: {exc}")
+                    continue
+                if isinstance(m, dict):
+                    msgs.append(m)
+                    tss.append(t)
+                elif isinstance(m, list):
+                    for x in m:
+                        if isinstance(x, dict):
+                            msgs.append(x)
+                            tss.append(t)
+            if not msgs:
+                return
+            batch, _ = from_messages(
+                msgs, tss, schema=self.schema, emitter=self.name,
+                strict=self.strict, timestamp_field=self.timestamp_field,
+                on_error=self.stats.inc_exception,
+                project=self.project_columns)
+            if batch.n:
+                self.emit(batch, count=batch.n)
+            return
+        cols, valid, bad = out
+        keep = ~np.asarray(bad, dtype=np.bool_)
+        n_bad = len(raws) - int(keep.sum())
+        if n_bad:
+            self.stats.inc_exception(
+                "undecodable or uncastable payload", n=n_bad)
+        ts = np.asarray(rtss, dtype=np.int64)
+        if self.timestamp_field:
+            vm = valid[self.timestamp_field]
+            missing = keep & ~vm
+            n_missing = int(missing.sum())
+            if n_missing:
+                self.stats.inc_exception(
+                    f"missing timestamp field {self.timestamp_field}",
+                    n=n_missing)
+                keep &= vm
+            ts = cols[self.timestamp_field]
+        if not keep.any():
+            return
+        all_keep = keep.all()
+        columns = {k: (v if all_keep else v[keep]) for k, v in cols.items()}
+        vout = {}
+        for k, vm in valid.items():
+            vs = vm if all_keep else vm[keep]
+            if not vs.all():
+                vout[k] = vs
+        batch = ColumnBatch(
+            n=int(keep.sum()), columns=columns, valid=vout,
+            timestamps=(ts if all_keep else ts[keep]), emitter=self.name)
         self.emit(batch, count=batch.n)
 
     def on_eof(self, eof: EOF) -> None:

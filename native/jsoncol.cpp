@@ -1,0 +1,577 @@
+// ekjsoncol — native columnar JSON decoder for the ingest hot path.
+//
+// The TPU data plane wants columns, not dicts: the Python chain
+// (json.loads -> list-of-dict -> per-column list comps, ~1.5us/row of
+// GIL-bound work) caps full-pipe ingest far below the fused kernel's rate.
+// This extension parses a run of raw JSON object payloads DIRECTLY into
+// typed numpy columns + validity masks in one C pass:
+//
+//   decode(payloads: list[bytes], fields: ((name, type), ...))
+//     -> (columns: dict[str, ndarray], valid: dict[str, ndarray],
+//         bad: ndarray[bool])
+//
+// field types: 0=FLOAT(f32) 1=BIGINT(i64) 2=BOOLEAN(bool) 3=STRING(object)
+// Semantics mirror data/cast.py CONVERT_ALL coercion (the row-path
+// preprocessor): numeric strings parse, bools in {0,1} accept, numbers
+// stringify with shortest round-trip (to_chars), null/missing -> invalid,
+// uncastable value -> row marked bad (caller drops it). Rows that need
+// semantics C can't reproduce (int64 overflow -> Python bigint) flag the
+// whole batch for Python fallback by raising ekjsoncol.Fallback.
+//
+// Repeated string values (10k device ids over millions of rows) intern
+// through a local hash table, so the object column mostly holds INCREF'd
+// existing PyUnicode objects instead of fresh allocations.
+//
+// Reference analogue: the schema-aware fastjson converter
+// (internal/converter/json) feeding SliceTuple columns.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+
+#include <cctype>
+#include <charconv>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum FieldType { F_FLOAT = 0, F_BIGINT = 1, F_BOOL = 2, F_STRING = 3 };
+
+struct Field {
+  std::string name;
+  int type;
+  // output buffers (borrowed from the numpy arrays)
+  float* f32 = nullptr;
+  int64_t* i64 = nullptr;
+  unsigned char* b8 = nullptr;
+  PyObject** obj = nullptr;
+  unsigned char* valid = nullptr;
+};
+
+struct StrKey {
+  const char* p;
+  size_t n;
+  bool operator==(const StrKey& o) const {
+    return n == o.n && std::memcmp(p, o.p, n) == 0;
+  }
+};
+struct StrKeyHash {
+  size_t operator()(const StrKey& k) const {
+    // FNV-1a
+    size_t h = 1469598103934665603ull;
+    for (size_t i = 0; i < k.n; i++) {
+      h ^= (unsigned char)k.p[i];
+      h *= 1099511628211ull;
+    }
+    return h;
+  }
+};
+
+struct Parser {
+  const char* p;
+  const char* end;
+  bool fallback = false;  // batch needs the Python path
+  std::string scratch;    // unescape buffer
+
+  explicit Parser(const char* b, const char* e) : p(b), end(e) {}
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      p++;
+  }
+  bool lit(const char* s, size_t n) {
+    if ((size_t)(end - p) < n || std::memcmp(p, s, n) != 0) return false;
+    p += n;
+    return true;
+  }
+
+  // Parse a JSON string (after the opening quote). Returns pointer/len of
+  // the decoded content — either a borrowed range of the input (no escapes,
+  // the common case) or `scratch`.
+  bool str_body(const char** out, size_t* out_n) {
+    const char* start = p;
+    while (p < end && *p != '"' && *p != '\\') p++;
+    if (p < end && *p == '"') {  // fast path: no escapes
+      *out = start;
+      *out_n = (size_t)(p - start);
+      p++;
+      return true;
+    }
+    // slow path: unescape into scratch
+    scratch.assign(start, (size_t)(p - start));
+    while (p < end && *p != '"') {
+      if (*p != '\\') {
+        scratch.push_back(*p++);
+        continue;
+      }
+      p++;
+      if (p >= end) return false;
+      char c = *p++;
+      switch (c) {
+        case '"': scratch.push_back('"'); break;
+        case '\\': scratch.push_back('\\'); break;
+        case '/': scratch.push_back('/'); break;
+        case 'b': scratch.push_back('\b'); break;
+        case 'f': scratch.push_back('\f'); break;
+        case 'n': scratch.push_back('\n'); break;
+        case 'r': scratch.push_back('\r'); break;
+        case 't': scratch.push_back('\t'); break;
+        case 'u': {
+          if (end - p < 4) return false;
+          unsigned cp = 0;
+          for (int i = 0; i < 4; i++) {
+            char h = *p++;
+            cp <<= 4;
+            if (h >= '0' && h <= '9') cp |= (unsigned)(h - '0');
+            else if (h >= 'a' && h <= 'f') cp |= (unsigned)(h - 'a' + 10);
+            else if (h >= 'A' && h <= 'F') cp |= (unsigned)(h - 'A' + 10);
+            else return false;
+          }
+          if (cp >= 0xD800 && cp <= 0xDBFF && end - p >= 6 && p[0] == '\\' &&
+              p[1] == 'u') {  // surrogate pair
+            unsigned lo = 0;
+            const char* q = p + 2;
+            bool ok = true;
+            for (int i = 0; i < 4; i++) {
+              char h = q[i];
+              lo <<= 4;
+              if (h >= '0' && h <= '9') lo |= (unsigned)(h - '0');
+              else if (h >= 'a' && h <= 'f') lo |= (unsigned)(h - 'a' + 10);
+              else if (h >= 'A' && h <= 'F') lo |= (unsigned)(h - 'A' + 10);
+              else { ok = false; break; }
+            }
+            if (ok && lo >= 0xDC00 && lo <= 0xDFFF) {
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              p = q + 4;
+            }
+          }
+          // utf-8 encode
+          if (cp < 0x80) scratch.push_back((char)cp);
+          else if (cp < 0x800) {
+            scratch.push_back((char)(0xC0 | (cp >> 6)));
+            scratch.push_back((char)(0x80 | (cp & 0x3F)));
+          } else if (cp < 0x10000) {
+            scratch.push_back((char)(0xE0 | (cp >> 12)));
+            scratch.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+            scratch.push_back((char)(0x80 | (cp & 0x3F)));
+          } else {
+            scratch.push_back((char)(0xF0 | (cp >> 18)));
+            scratch.push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+            scratch.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+            scratch.push_back((char)(0x80 | (cp & 0x3F)));
+          }
+          break;
+        }
+        default: return false;
+      }
+    }
+    if (p >= end) return false;
+    p++;  // closing quote
+    *out = scratch.data();
+    *out_n = scratch.size();
+    return true;
+  }
+
+  // Skip any JSON value (for undeclared keys).
+  bool skip_value() {
+    ws();
+    if (p >= end) return false;
+    char c = *p;
+    if (c == '"') {
+      p++;
+      const char* s;
+      size_t n;
+      return str_body(&s, &n);
+    }
+    if (c == '{' || c == '[') {
+      char open = c, close = (c == '{') ? '}' : ']';
+      int depth = 0;
+      bool in_str = false;
+      while (p < end) {
+        char d = *p++;
+        if (in_str) {
+          if (d == '\\') { if (p < end) p++; }
+          else if (d == '"') in_str = false;
+        } else if (d == '"') in_str = true;
+        else if (d == open) depth++;
+        else if (d == close) {
+          if (--depth == 0) return true;
+        }
+      }
+      return false;
+    }
+    if (lit("true", 4) || lit("false", 5) || lit("null", 4)) return true;
+    // number
+    const char* start = p;
+    if (p < end && (*p == '-' || *p == '+')) p++;
+    while (p < end && (std::isdigit((unsigned char)*p) || *p == '.' ||
+                       *p == 'e' || *p == 'E' || *p == '-' || *p == '+'))
+      p++;
+    return p > start;
+  }
+};
+
+// shortest-round-trip double -> string, matching Python str(float) closely
+void format_double(double v, std::string& out) {
+  char buf[40];
+  auto res = std::to_chars(buf, buf + sizeof(buf), v);
+  out.assign(buf, res.ptr);
+}
+
+struct Interner {
+  std::unordered_map<StrKey, PyObject*, StrKeyHash> map;
+  std::vector<std::string> storage;  // owns key bytes
+
+  ~Interner() {
+    for (auto& kv : map) Py_DECREF(kv.second);
+  }
+  PyObject* get(const char* s, size_t n) {  // returns NEW reference
+    auto it = map.find(StrKey{s, n});
+    if (it != map.end()) {
+      Py_INCREF(it->second);
+      return it->second;
+    }
+    PyObject* u = PyUnicode_DecodeUTF8(s, (Py_ssize_t)n, "replace");
+    if (u == nullptr) return nullptr;
+    if (map.size() < 262144) {  // bound the table
+      storage.emplace_back(s, n);
+      const std::string& owned = storage.back();
+      Py_INCREF(u);
+      map.emplace(StrKey{owned.data(), owned.size()}, u);
+    }
+    return u;
+  }
+};
+
+// Parse one object payload into row r of the field buffers.
+// Returns: 0 ok, 1 bad row (cast/shape error), 2 batch fallback.
+int parse_row(Parser& ps, std::vector<Field>& fields, npy_intp r,
+              Interner& intern, std::string& tmp) {
+  ps.ws();
+  if (ps.p < ps.end && *ps.p == '[')
+    return 2;  // array payload: rows-per-payload is the python path's job
+  if (ps.p >= ps.end || *ps.p != '{') return 1;
+  ps.p++;
+  ps.ws();
+  if (ps.p < ps.end && *ps.p == '}') { ps.p++; return 0; }
+  while (true) {
+    ps.ws();
+    if (ps.p >= ps.end || *ps.p != '"') return 1;
+    ps.p++;
+    const char* key;
+    size_t key_n;
+    {
+      // key may come from scratch; copy before value parsing reuses it
+      const char* k;
+      size_t kn;
+      if (!ps.str_body(&k, &kn)) return 1;
+      if (k == ps.scratch.data()) {
+        tmp.assign(k, kn);
+        key = tmp.data();
+      } else {
+        key = k;
+      }
+      key_n = kn;
+    }
+    ps.ws();
+    if (ps.p >= ps.end || *ps.p != ':') return 1;
+    ps.p++;
+    Field* f = nullptr;
+    for (auto& cand : fields) {
+      if (cand.name.size() == key_n &&
+          std::memcmp(cand.name.data(), key, key_n) == 0) {
+        f = &cand;
+        break;
+      }
+    }
+    if (f == nullptr) {
+      if (!ps.skip_value()) return 1;
+    } else {
+      ps.ws();
+      if (ps.p >= ps.end) return 1;
+      char c = *ps.p;
+      if (c == 'n' && ps.lit("null", 4)) {
+        // null -> invalid (valid[r] stays 0)
+      } else if (c == '{' || c == '[') {
+        return 1;  // nested value for a scalar field: cast error -> drop
+      } else if (c == '"') {
+        ps.p++;
+        const char* s;
+        size_t n;
+        if (!ps.str_body(&s, &n)) return 1;
+        switch (f->type) {
+          case F_STRING: {
+            PyObject* u = intern.get(s, n);
+            if (u == nullptr) return 2;
+            Py_XDECREF(f->obj[r]);
+            f->obj[r] = u;
+            f->valid[r] = 1;
+            break;
+          }
+          case F_FLOAT: case F_BIGINT: {
+            // cast.to_float/to_int accept numeric strings (CONVERT_ALL)
+            tmp.assign(s, n);
+            char* endp = nullptr;
+            double v = std::strtod(tmp.c_str(), &endp);
+            if (endp == tmp.c_str() || *endp != '\0') return 1;
+            if (f->type == F_FLOAT) f->f32[r] = (float)v;
+            else {
+              if (v > 9.2233720368547e18 || v < -9.2233720368547e18)
+                return 2;  // beyond int64: Python bigint semantics
+              f->i64[r] = (int64_t)v;
+            }
+            f->valid[r] = 1;
+            break;
+          }
+          case F_BOOL: {
+            // to_bool(str): lowercase match on true/false/1/0
+            std::string low(s, n);
+            for (auto& ch : low) ch = (char)std::tolower((unsigned char)ch);
+            if (low == "true" || low == "1") f->b8[r] = 1;
+            else if (low == "false" || low == "0") f->b8[r] = 0;
+            else return 1;
+            f->valid[r] = 1;
+            break;
+          }
+        }
+      } else if (c == 't' || c == 'f') {
+        bool v = (c == 't');
+        if (!(v ? ps.lit("true", 4) : ps.lit("false", 5))) return 1;
+        switch (f->type) {
+          case F_BOOL: f->b8[r] = v ? 1 : 0; break;
+          case F_FLOAT: f->f32[r] = v ? 1.0f : 0.0f; break;  // to_float(bool)
+          case F_BIGINT: f->i64[r] = v ? 1 : 0; break;       // to_int(bool)
+          case F_STRING: {
+            PyObject* u = intern.get(v ? "true" : "false", v ? 4 : 5);
+            if (u == nullptr) return 2;
+            Py_XDECREF(f->obj[r]);
+            f->obj[r] = u;
+            break;
+          }
+        }
+        f->valid[r] = 1;
+      } else {
+        // number
+        const char* start = ps.p;
+        if (*ps.p == '-' || *ps.p == '+') ps.p++;
+        bool is_float = false;
+        while (ps.p < ps.end &&
+               (std::isdigit((unsigned char)*ps.p) || *ps.p == '.' ||
+                *ps.p == 'e' || *ps.p == 'E' || *ps.p == '-' || *ps.p == '+')) {
+          if (*ps.p == '.' || *ps.p == 'e' || *ps.p == 'E') is_float = true;
+          ps.p++;
+        }
+        if (ps.p == start) return 1;
+        tmp.assign(start, (size_t)(ps.p - start));
+        switch (f->type) {
+          case F_FLOAT: {
+            char* endp = nullptr;
+            double v = std::strtod(tmp.c_str(), &endp);
+            if (*endp != '\0') return 1;
+            f->f32[r] = (float)v;
+            break;
+          }
+          case F_BIGINT: {
+            if (!is_float) {
+              errno = 0;
+              char* endp = nullptr;
+              long long v = std::strtoll(tmp.c_str(), &endp, 10);
+              if (*endp != '\0') return 1;
+              if (errno == ERANGE) return 2;  // Python bigint territory
+              f->i64[r] = v;
+            } else {
+              char* endp = nullptr;
+              double v = std::strtod(tmp.c_str(), &endp);
+              if (*endp != '\0') return 1;
+              if (v > 9.2233720368547e18 || v < -9.2233720368547e18) return 2;
+              f->i64[r] = (int64_t)v;  // to_int truncates
+            }
+            break;
+          }
+          case F_BOOL: {
+            // to_bool accepts numeric values equal to 0 or 1 only
+            char* endp = nullptr;
+            double v = std::strtod(tmp.c_str(), &endp);
+            if (*endp != '\0' || (v != 0.0 && v != 1.0)) return 1;
+            f->b8[r] = (v == 1.0) ? 1 : 0;
+            break;
+          }
+          case F_STRING: {
+            // to_string: integral floats render as ints, else str(float)
+            std::string sv;
+            if (!is_float) sv = tmp;
+            else {
+              char* endp = nullptr;
+              double v = std::strtod(tmp.c_str(), &endp);
+              if (*endp != '\0') return 1;
+              if (std::isfinite(v) && v == std::floor(v) &&
+                  std::fabs(v) < 9.2e18) {
+                char b[32];
+                auto res = std::to_chars(b, b + sizeof(b), (long long)v);
+                sv.assign(b, res.ptr);
+              } else {
+                format_double(v, sv);
+              }
+            }
+            PyObject* u = intern.get(sv.data(), sv.size());
+            if (u == nullptr) return 2;
+            Py_XDECREF(f->obj[r]);
+            f->obj[r] = u;
+            break;
+          }
+        }
+        f->valid[r] = 1;
+      }
+    }
+    ps.ws();
+    if (ps.p < ps.end && *ps.p == ',') { ps.p++; continue; }
+    if (ps.p < ps.end && *ps.p == '}') { ps.p++; break; }
+    return 1;
+  }
+  ps.ws();
+  return (ps.p == ps.end) ? 0 : 1;  // trailing garbage -> bad row
+}
+
+PyObject* FallbackError = nullptr;
+
+PyObject* jc_decode(PyObject*, PyObject* args) {
+  PyObject* payloads;
+  PyObject* fields_spec;
+  if (!PyArg_ParseTuple(args, "OO", &payloads, &fields_spec)) return nullptr;
+  if (!PyList_Check(payloads) || !PyTuple_Check(fields_spec)) {
+    PyErr_SetString(PyExc_TypeError, "decode(list[bytes], tuple[(name, type)])");
+    return nullptr;
+  }
+  npy_intp n_rows = (npy_intp)PyList_GET_SIZE(payloads);
+  Py_ssize_t n_fields = PyTuple_GET_SIZE(fields_spec);
+
+  std::vector<Field> fields((size_t)n_fields);
+  PyObject* cols = PyDict_New();
+  PyObject* valids = PyDict_New();
+  for (Py_ssize_t i = 0; i < n_fields; i++) {
+    PyObject* spec = PyTuple_GET_ITEM(fields_spec, i);
+    const char* name;
+    int ftype;
+    if (!PyArg_ParseTuple(spec, "si", &name, &ftype)) {
+      Py_DECREF(cols); Py_DECREF(valids);
+      return nullptr;
+    }
+    Field& f = fields[(size_t)i];
+    f.name = name;
+    f.type = ftype;
+    int npy_type;
+    switch (ftype) {
+      case F_FLOAT: npy_type = NPY_FLOAT32; break;
+      case F_BIGINT: npy_type = NPY_INT64; break;
+      case F_BOOL: npy_type = NPY_BOOL; break;
+      case F_STRING: npy_type = NPY_OBJECT; break;
+      default:
+        PyErr_SetString(PyExc_ValueError, "bad field type");
+        Py_DECREF(cols); Py_DECREF(valids);
+        return nullptr;
+    }
+    PyObject* arr = PyArray_ZEROS(1, &n_rows, npy_type, 0);
+    PyObject* va = PyArray_ZEROS(1, &n_rows, NPY_BOOL, 0);
+    if (arr == nullptr || va == nullptr) {
+      Py_XDECREF(arr); Py_XDECREF(va);
+      Py_DECREF(cols); Py_DECREF(valids);
+      return nullptr;
+    }
+    void* data = PyArray_DATA((PyArrayObject*)arr);
+    switch (ftype) {
+      case F_FLOAT: f.f32 = (float*)data; break;
+      case F_BIGINT: f.i64 = (int64_t*)data; break;
+      case F_BOOL: f.b8 = (unsigned char*)data; break;
+      case F_STRING: f.obj = (PyObject**)data; break;
+    }
+    f.valid = (unsigned char*)PyArray_DATA((PyArrayObject*)va);
+    PyDict_SetItemString(cols, name, arr);
+    PyDict_SetItemString(valids, name, va);
+    Py_DECREF(arr);
+    Py_DECREF(va);
+  }
+  PyObject* bad_arr = PyArray_ZEROS(1, &n_rows, NPY_BOOL, 0);
+  if (bad_arr == nullptr) {
+    Py_DECREF(cols); Py_DECREF(valids);
+    return nullptr;
+  }
+  unsigned char* bad = (unsigned char*)PyArray_DATA((PyArrayObject*)bad_arr);
+
+  // NaN-fill float columns (invalid rows must read as NaN, matching
+  // from_messages); object columns pre-fill with None
+  for (auto& f : fields) {
+    if (f.type == F_FLOAT) {
+      for (npy_intp r = 0; r < n_rows; r++) f.f32[r] = NAN;
+    } else if (f.type == F_STRING) {
+      for (npy_intp r = 0; r < n_rows; r++) {
+        Py_INCREF(Py_None);
+        f.obj[r] = Py_None;
+      }
+    }
+  }
+
+  Interner intern;
+  std::string tmp;
+  for (npy_intp r = 0; r < n_rows; r++) {
+    PyObject* pl = PyList_GET_ITEM(payloads, r);
+    char* buf;
+    Py_ssize_t blen;
+    if (PyBytes_Check(pl)) {
+      buf = PyBytes_AS_STRING(pl);
+      blen = PyBytes_GET_SIZE(pl);
+    } else if (PyByteArray_Check(pl)) {
+      buf = PyByteArray_AS_STRING(pl);
+      blen = PyByteArray_GET_SIZE(pl);
+    } else {
+      Py_DECREF(cols); Py_DECREF(valids); Py_DECREF(bad_arr);
+      PyErr_SetString(FallbackError, "non-bytes payload");
+      return nullptr;
+    }
+    Parser ps(buf, buf + blen);
+    int rc = parse_row(ps, fields, r, intern, tmp);
+    if (rc == 2 || (rc != 0 && PyErr_Occurred())) {
+      Py_DECREF(cols); Py_DECREF(valids); Py_DECREF(bad_arr);
+      if (!PyErr_Occurred())
+        PyErr_SetString(FallbackError, "payload needs the python decoder");
+      return nullptr;
+    }
+    if (rc == 1) {
+      bad[r] = 1;
+      for (auto& f : fields) f.valid[r] = 0;
+    }
+  }
+  PyObject* out = PyTuple_Pack(3, cols, valids, bad_arr);
+  Py_DECREF(cols);
+  Py_DECREF(valids);
+  Py_DECREF(bad_arr);
+  return out;
+}
+
+PyMethodDef methods[] = {
+    {"decode", jc_decode, METH_VARARGS,
+     "decode(payloads, fields) -> (columns, valid, bad)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "ekjsoncol",
+    "native columnar JSON decoder", -1, methods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_ekjsoncol(void) {
+  import_array();
+  PyObject* m = PyModule_Create(&moduledef);
+  if (m == nullptr) return nullptr;
+  FallbackError = PyErr_NewException("ekjsoncol.Fallback", nullptr, nullptr);
+  Py_INCREF(FallbackError);
+  PyModule_AddObject(m, "Fallback", FallbackError);
+  return m;
+}
