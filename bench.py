@@ -525,8 +525,15 @@ def bench_event_time(batches, kt_slots) -> None:
     node.process(stamped(0))
     node.on_watermark(Watermark(ts=0))
     jax.block_until_ready(node.state)
-    rows = 0
     n = 1
+    t0 = time.time()
+    while time.time() - t0 < 3.0:  # untimed warm: steady link + executables
+        node.process(stamped(n))
+        node.on_watermark(Watermark(ts=n * 1000 - 1000))
+        n += 1
+    jax.block_until_ready(node.state)
+    emitted.clear()
+    rows = 0
     t0 = time.time()
     while time.time() - t0 < 10.0:
         node.process(stamped(n))

@@ -38,9 +38,11 @@ def _build() -> bool:
     try:
         native = os.path.abspath(_NATIVE_DIR)
         scratch = f"build.tmp.jc.{os.getpid()}"
+        import sys
+
         subprocess.run(
             ["make", "-C", native, f"BUILD={scratch}",
-             f"{scratch}/ekjsoncol.so"],
+             f"PYTHON={sys.executable}", f"{scratch}/ekjsoncol.so"],
             capture_output=True, timeout=180, check=True,
         )
         os.makedirs(os.path.join(native, "build"), exist_ok=True)

@@ -84,6 +84,9 @@ class ShardedGroupBy(DeviceGroupBy):
             d.process_index != jax.process_index()
             for d in np.asarray(mesh.devices).flat)
         self._fold = self._build_fold()  # replaces the single-chip jit
+        # per-row pane-vector variant (event-time multi-bucket batches);
+        # built lazily — most rules never need it
+        self._fold_vec = None
         self._all_true = None  # cached device ones-mask (common no-null case)
 
     def _put(self, arr, sharding):
@@ -266,6 +269,134 @@ class ShardedGroupBy(DeviceGroupBy):
 
         return jax.jit(step, donate_argnums=(0,))
 
+    def _build_fold_vec(self):
+        """Per-row pane-vector fold (event-time multi-bucket batches under
+        the mesh): each device scatters its row shard into (n_panes,
+        local_capacity) partials, one collective per component merges the
+        rows axis, and the full-shape merge folds into the state."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map
+
+        comp_specs = self.comp_specs
+        plan = self.plan
+        n_panes = self.n_panes
+
+        def local_fold(state, cols, slots, row_valid, pane_vec):
+            cap_per_shard = state["act"].shape[1]
+            kidx = jax.lax.axis_index("keys")
+            offset = (kidx * cap_per_shard).astype(slots.dtype)
+            local = slots - offset
+            in_range = jnp.logical_and(local >= 0, local < cap_per_shard)
+            base = jnp.logical_and(row_valid, in_range)
+            if plan.filter is not None:
+                base = jnp.logical_and(base, plan.filter(cols))
+            local = jnp.clip(local, 0, cap_per_shard - 1)
+            pv = pane_vec.astype(jnp.int32)
+
+            per_spec: List[Tuple[Any, Any]] = []
+            for spec in plan.specs:
+                if spec.arg is None:
+                    v = jnp.ones_like(base, dtype=jnp.float32)
+                    m = base
+                else:
+                    v = spec.arg(cols).astype(jnp.float32)
+                    m = base
+                    for col in spec.arg.columns:
+                        vm = cols.get("__valid_" + col)
+                        if vm is not None:
+                            m = jnp.logical_and(m, vm)
+                    m = jnp.logical_and(m, jnp.logical_not(jnp.isnan(v)))
+                if spec.filter is not None:
+                    m = jnp.logical_and(m, spec.filter(cols))
+                per_spec.append((v, m))
+
+            out = {}
+            act_add = (jnp.zeros((n_panes, cap_per_shard), jnp.float32)
+                       .at[pv, local].add(base.astype(jnp.float32)))
+            out["act"] = state["act"] + jax.lax.psum(act_add, "rows")
+            for comp, spec_idxs in comp_specs.items():
+                arr = state[comp]
+                parts = []
+                for si in spec_idxs:
+                    v, m = per_spec[si]
+                    mf = m.astype(jnp.float32)
+                    if comp == "n":
+                        parts.append(
+                            jnp.zeros((n_panes, cap_per_shard), jnp.float32)
+                            .at[pv, local].add(mf))
+                    elif comp == "s1":
+                        parts.append(
+                            jnp.zeros((n_panes, cap_per_shard), jnp.float32)
+                            .at[pv, local].add(jnp.where(m, v, 0.0)))
+                    elif comp == "s2":
+                        parts.append(
+                            jnp.zeros((n_panes, cap_per_shard), jnp.float32)
+                            .at[pv, local].add(jnp.where(m, v * v, 0.0)))
+                    elif comp == "mn":
+                        parts.append(
+                            jnp.full((n_panes, cap_per_shard), jnp.inf,
+                                     jnp.float32)
+                            .at[pv, local].min(jnp.where(m, v, jnp.inf)))
+                    elif comp == "mx":
+                        parts.append(
+                            jnp.full((n_panes, cap_per_shard), -jnp.inf,
+                                     jnp.float32)
+                            .at[pv, local].max(jnp.where(m, v, -jnp.inf)))
+                    elif comp == "hll":
+                        from ..ops.sketches import hll_parts
+
+                        reg, rho = hll_parts(v)
+                        parts.append(
+                            jnp.full((n_panes, cap_per_shard, arr.shape[-1]),
+                                     -jnp.inf, jnp.float32)
+                            .at[pv, local, reg].max(jnp.where(m, rho, 0.0)))
+                    elif comp == "hist":
+                        from ..ops.sketches import hist_bin
+
+                        b = hist_bin(v)
+                        parts.append(
+                            jnp.zeros((n_panes, cap_per_shard, arr.shape[-1]),
+                                      jnp.float32)
+                            .at[pv, local, b].add(mf))
+                stacked = jnp.stack(parts, axis=2)  # (P, cap, k[, R])
+                if comp in ("n", "s1", "s2", "hist"):
+                    out[comp] = arr + jax.lax.psum(stacked, "rows")
+                elif comp == "mn":
+                    out[comp] = jnp.minimum(
+                        arr, jax.lax.pmin(stacked, "rows"))
+                else:  # mx, hll merge by max (-inf fill is identity)
+                    out[comp] = jnp.maximum(
+                        arr, jax.lax.pmax(stacked, "rows"))
+            return out
+
+        state_specs = {
+            comp: P(None, "keys", None, None) if comp in WIDE_COMPONENTS
+            else P(None, "keys", None)
+            for comp in comp_specs
+        }
+        state_specs["act"] = P(None, "keys")
+        cols_specs: Dict[str, Any] = {}
+        for name in plan.columns:
+            cols_specs[name] = P("rows")
+            cols_specs["__valid_" + name] = P("rows")
+
+        def step(state, cols, slots, row_valid, pane_vec):
+            return shard_map(
+                local_fold,
+                mesh=self.mesh,
+                in_specs=(state_specs, cols_specs, P("rows"), P("rows"),
+                          P("rows")),
+                out_specs=state_specs,
+            )(state, cols, slots, row_valid, pane_vec)
+
+        return jax.jit(step, donate_argnums=(0,))
+
     def fold(
         self,
         state: Dict[str, Any],
@@ -286,7 +417,10 @@ class ShardedGroupBy(DeviceGroupBy):
         mb = self.micro_batch
         valid = valid or {}
         cols = materialize_hll_columns(self.plan.columns, cols, n)
-        pane = self._put(
+        pane_vec = pane_idx if isinstance(pane_idx, np.ndarray) else None
+        if pane_vec is not None and self._fold_vec is None:
+            self._fold_vec = self._build_fold_vec()
+        pane = None if pane_vec is not None else self._put(
             jnp.asarray(pane_idx, dtype=jnp.int32), self.scalar_sharding
         )
         for start in range(0, max(n, 1), mb):
@@ -324,13 +458,25 @@ class ShardedGroupBy(DeviceGroupBy):
                 s = np.pad(s, (0, pad))
             rv = np.zeros(mb, dtype=np.bool_)
             rv[:cnt] = True
-            state = self._fold(
-                state,
-                dev_cols,
-                self._put(s, self.batch_sharding),
-                self._put(rv, self.batch_sharding),
-                pane,
-            )
+            if pane_vec is not None:
+                pv = np.asarray(pane_vec[start:end], dtype=np.int32)
+                if pad:
+                    pv = np.pad(pv, (0, pad))  # padded rows masked by rv
+                state = self._fold_vec(
+                    state,
+                    dev_cols,
+                    self._put(s, self.batch_sharding),
+                    self._put(rv, self.batch_sharding),
+                    self._put(pv, self.batch_sharding),
+                )
+            else:
+                state = self._fold(
+                    state,
+                    dev_cols,
+                    self._put(s, self.batch_sharding),
+                    self._put(rv, self.batch_sharding),
+                    pane,
+                )
         return state
 
     # finalize / reset_pane / state_to_host / observe_dtypes inherited from

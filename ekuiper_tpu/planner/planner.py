@@ -186,10 +186,9 @@ def device_path_eligible(
             return None
     if opts.is_event_time and w.window_type == ast.WindowType.COUNT_WINDOW:
         return None  # event-time counts stay on the host buffering path
-    if opts.is_event_time and (opts.plan_optimize_strategy or {}).get("mesh"):
-        # the sharded kernel folds one pane per call (replicated scalar);
-        # per-row pane routing is single-chip only — host path for now
-        return None
+    # event-time × mesh: supported — the sharded kernel routes per-row pane
+    # vectors under shard_map (parallel/sharded.py _build_fold_vec), with
+    # the scalar fast path for single-bucket batches
     if opts.is_event_time and w.window_type in (
         ast.WindowType.TUMBLING_WINDOW, ast.WindowType.HOPPING_WINDOW
     ):

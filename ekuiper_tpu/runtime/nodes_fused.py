@@ -321,13 +321,12 @@ class FusedWindowAggNode(Node):
             dummy = self.gb.init_state()
             if self.is_event_time or self.wt == ast.WindowType.SLIDING_WINDOW:
                 # event-time and sliding folds ship per-row pane VECTORS
-                # and finalize with traced pane masks; only sliding also
-                # hits the scalar path (single-bucket batches) — event-time
-                # must not pay that extra compile
+                # for multi-bucket batches and the SCALAR pane for
+                # single-bucket ones (the in-order common case) — warm both
+                # executables, and the traced-mask finalize
                 dummy = self.gb.fold(dummy, cols, slots,
                                      pane_idx=np.zeros(1, dtype=np.int64))
-                if self.wt == ast.WindowType.SLIDING_WINDOW:
-                    dummy = self.gb.fold(dummy, cols, slots, pane_idx=0)
+                dummy = self.gb.fold(dummy, cols, slots, pane_idx=0)
                 self.gb.finalize(dummy, 1, panes=[0])
             else:
                 dummy = self.gb.fold(dummy, cols, slots,
@@ -550,10 +549,15 @@ class FusedWindowAggNode(Node):
             idx = np.nonzero(mask)[0]
             if len(idx):
                 seg = buckets[idx]
+                ub = np.unique(seg)
+                # single-bucket batch (in-order streams, bucket >> batch
+                # span — the common case): scalar pane, no per-row pane
+                # vector upload, the same fast executable as processing time
+                pane_arg = (int(ub[0]) % self.n_panes if len(ub) == 1
+                            else (seg % self.n_panes).astype(np.uint8))
                 total += self._fold_rows(
-                    sub if mask.all() else sub.take(idx),
-                    (seg % self.n_panes).astype(np.uint8))
-                self._dirty.update(int(b) for b in np.unique(seg))
+                    sub if mask.all() else sub.take(idx), pane_arg)
+                self._dirty.update(int(b) for b in ub)
             if mask.all():
                 break
             # make room for the rest: emit data windows in order, jump

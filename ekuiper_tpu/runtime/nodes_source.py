@@ -120,17 +120,8 @@ class SourceNode(Node):
                 raws = [bytes(p) for p in payload]
             if raws is not None:
                 self.stats.inc_in(len(raws))
-                with self._pending_lock:
-                    self._pending_raw.extend(raws)
-                    self._pending_raw_ts.extend([now] * len(raws))
-                    full = (len(self._pending_raw) + len(self._pending_msgs)
-                            >= self.micro_batch_rows)
-                if full:
-                    self._flush()
-                elif self._linger_timer is None or self._linger_timer.fired \
-                        or self._linger_timer.stopped:
-                    self._linger_timer = timex.after(
-                        self.linger_ms, lambda ts: self._flush())
+                self._buffer(self._pending_raw, self._pending_raw_ts,
+                             raws, [now] * len(raws))
                 return
         if isinstance(payload, (bytes, bytearray)):
             if self.converter is None:
@@ -143,25 +134,15 @@ class SourceNode(Node):
                 return
         msgs: List[Dict[str, Any]] = []
         if isinstance(payload, Tuple):
+            self.stats.inc_in(1)
             if not self.emit_batches:
                 t = self._preprocess(payload)
                 if t is not None:
-                    self.stats.inc_in(1)
                     self.emit(t)
                 return
             # preserve the tuple's own (replay/historical) timestamp
-            self.stats.inc_in(1)
-            with self._pending_lock:
-                self._pending_msgs.append(payload.message)
-                self._pending_ts.append(payload.timestamp or now)
-                full = (len(self._pending_msgs) + len(self._pending_raw)
-                        >= self.micro_batch_rows)
-            if full:
-                self._flush()
-            elif self._linger_timer is None or self._linger_timer.fired \
-                    or self._linger_timer.stopped:
-                self._linger_timer = timex.after(
-                    self.linger_ms, lambda ts: self._flush())
+            self._buffer(self._pending_msgs, self._pending_ts,
+                         [payload.message], [payload.timestamp or now])
             return
         elif isinstance(payload, dict):
             msgs = [payload]
@@ -188,15 +169,25 @@ class SourceNode(Node):
                 if t is not None:
                     self.emit(t)
             return
+        self._buffer(self._pending_msgs, self._pending_ts,
+                     msgs, [now] * len(msgs))
+
+    def _buffer(self, items: list, ts_list: list, new_items: list,
+                new_ts: list) -> None:
+        """Append to a pending buffer under the lock, then flush at the
+        micro-batch threshold or arm the linger timer — the single place
+        holding the batching policy for all three ingest shapes."""
         with self._pending_lock:
-            self._pending_msgs.extend(msgs)
-            self._pending_ts.extend([now] * len(msgs))
+            items.extend(new_items)
+            ts_list.extend(new_ts)
             full = (len(self._pending_msgs) + len(self._pending_raw)
                     >= self.micro_batch_rows)
         if full:
             self._flush()
-        elif self._linger_timer is None or self._linger_timer.fired or self._linger_timer.stopped:
-            self._linger_timer = timex.after(self.linger_ms, lambda ts: self._flush())
+        elif self._linger_timer is None or self._linger_timer.fired \
+                or self._linger_timer.stopped:
+            self._linger_timer = timex.after(
+                self.linger_ms, lambda ts: self._flush())
 
     def _decode_many(self, payloads: List[bytes]) -> Optional[List[Dict[str, Any]]]:
         """Batch-decode a run of raw payloads. For JSON this splices the

@@ -34,6 +34,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -225,7 +226,9 @@ void format_double(double v, std::string& out) {
 
 struct Interner {
   std::unordered_map<StrKey, PyObject*, StrKeyHash> map;
-  std::vector<std::string> storage;  // owns key bytes
+  // owns key bytes — deque: element addresses are STABLE across growth
+  // (a vector reallocation would move SSO strings and dangle StrKey.p)
+  std::deque<std::string> storage;
 
   ~Interner() {
     for (auto& kv : map) Py_DECREF(kv.second);
@@ -258,7 +261,11 @@ int parse_row(Parser& ps, std::vector<Field>& fields, npy_intp r,
   if (ps.p >= ps.end || *ps.p != '{') return 1;
   ps.p++;
   ps.ws();
-  if (ps.p < ps.end && *ps.p == '}') { ps.p++; return 0; }
+  if (ps.p < ps.end && *ps.p == '}') {
+    ps.p++;
+    ps.ws();
+    return (ps.p == ps.end) ? 0 : 1;  // '{} garbage' is NOT a good row
+  }
   while (true) {
     ps.ws();
     if (ps.p >= ps.end || *ps.p != '"') return 1;

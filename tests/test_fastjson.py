@@ -274,3 +274,21 @@ class TestReviewRegressions:
         src.ingest(Row(emitter="s", message={"count": 5}, timestamp=777))
         src._flush()
         assert got[0].timestamps[0] == 777
+
+    def test_empty_object_with_trailing_garbage_is_bad(self, native):
+        src, got = TestSourceFastPath().make_source()
+        src.ingest([b'{} trailing', b'{}', b'{"count": 1}'])
+        src._flush()
+        # '{} trailing' drops; bare '{}' is a legal all-null row
+        assert sum(cb.n for cb in got) == 2
+
+    def test_interner_many_unique_strings_stable(self, native):
+        # regression: storage growth must not dangle intern keys
+        payloads = [json.dumps({"deviceId": f"dev_{i}"}).encode()
+                    for i in range(5000)] * 2
+        spec = fastjson.schema_field_spec(SCHEMA)
+        cols, valid, bad = fastjson.decode_columns(payloads, spec)
+        assert not bad.any()
+        got = cols["deviceId"].tolist()
+        assert got[:5000] == [f"dev_{i}" for i in range(5000)]
+        assert got[5000:] == got[:5000]
