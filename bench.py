@@ -371,28 +371,165 @@ def bench_countwindow_hll_1m(kt_slots) -> None:
     )
 
 
-def bench_full_pipe_ingest() -> None:
-    """Isolated wrapper: the full-pipe bench opens+closes a threaded topo
-    against the tunneled TPU, which can intermittently crash native client
-    teardown at exit — run it in a subprocess so the headline bench process
-    can never be taken down by it."""
+def _run_isolated(func: str, tag: str, timeout: float = 900) -> None:
+    """Run a bench phase in a subprocess: phases that open+close threaded
+    topos against the tunneled TPU can intermittently crash native client
+    teardown at exit — isolation keeps the headline bench process alive."""
     import subprocess
 
     try:
         r = subprocess.run(
-            [sys.executable, "-c",
-             "import bench; bench._full_pipe_main()"],
+            [sys.executable, "-c", f"import bench; bench.{func}()"],
             cwd=os.path.dirname(os.path.abspath(__file__)),
-            capture_output=True, timeout=900, text=True)
+            capture_output=True, timeout=timeout, text=True)
         for line in r.stderr.splitlines():
             if line.startswith("# "):
                 print(line, file=sys.stderr)
-        if not any(line.startswith("# full-pipe")
+        if not any(line.startswith(f"# {tag}")
                    for line in r.stderr.splitlines()):
-            print(f"# full-pipe ingest: subprocess failed rc={r.returncode}",
+            print(f"# {tag}: subprocess failed rc={r.returncode}",
                   file=sys.stderr)
     except Exception as exc:
-        print(f"# full-pipe ingest: {exc}", file=sys.stderr)
+        print(f"# {tag}: {exc}", file=sys.stderr)
+
+
+def bench_full_pipe_ingest() -> None:
+    _run_isolated("_full_pipe_main", "full-pipe")
+
+
+def bench_hetero_rules() -> None:
+    _run_isolated("_hetero_main", "hetero 256-rule", timeout=1200)
+
+
+def _hetero_main() -> None:
+    """256 HETEROGENEOUS rules sharing one source on one chip (the
+    reference's 300-rules-shared-stream benchmark, README.md:144-156, but
+    with rules that do NOT all share a statement shape):
+
+    - 4 rule FAMILIES with different aggregates/columns/comparators; rules
+      within a family differ only in WHERE literals. Each family plans as
+      ONE vmapped device program (plan_rule_group / parallel/multirule.py) —
+      vmapped grouping applies WITHIN a family, never across families.
+    - 4 fully-individual rules plan as their own fused nodes.
+    - All 8 topologies ride ONE shared source+decode subtopo.
+
+    Prints a stderr metric line with rule-rows/s and device state bytes."""
+    import jax
+
+    from ekuiper_tpu.data.batch import ColumnBatch
+    from ekuiper_tpu.io import memory as mem
+    from ekuiper_tpu.planner.planner import RuleDef, plan_rule, plan_rule_group
+    from ekuiper_tpu.server.processors import StreamProcessor
+    from ekuiper_tpu.store import kv
+
+    mem.reset()
+    store = kv.get_store()
+    StreamProcessor(store).exec_stmt(
+        'CREATE STREAM sensors (deviceId STRING, temperature FLOAT, '
+        'pressure FLOAT, humidity FLOAT) '
+        'WITH (DATASOURCE="topic/sensors", TYPE="memory", FORMAT="JSON")')
+    families = [
+        ("fa", "SELECT deviceId, avg(temperature) AS a, count(*) AS c "
+               "FROM sensors WHERE temperature > {x} "
+               "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)", 14.0, 0.05),
+        ("fb", "SELECT deviceId, min(pressure) AS mn, max(pressure) AS mx "
+               "FROM sensors WHERE pressure > {x} "
+               "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)", 0.4, 0.002),
+        ("fc", "SELECT deviceId, sum(humidity) AS s, stddev(humidity) AS sd "
+               "FROM sensors WHERE humidity > {x} "
+               "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)", 30.0, 0.1),
+        ("fd", "SELECT deviceId, count(*) AS c, avg(pressure) AS ap "
+               "FROM sensors WHERE temperature < {x} "
+               "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)", 26.0, 0.05),
+    ]
+    topos = []
+    n_rules = 0
+    for name, sql, base, step in families:
+        rules = [
+            RuleDef(id=f"{name}{i}", sql=sql.format(x=base + step * i),
+                    actions=[{"nop": {}}],
+                    options={"micro_batch_rows": 16384})
+            for i in range(63)
+        ]
+        topos.append(plan_rule_group(name, rules, store))
+        n_rules += 63
+    singles = [
+        "SELECT deviceId, stddev(temperature) AS sd, percentile_approx"
+        "(temperature, 0.9) AS p90 FROM sensors "
+        "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)",
+        "SELECT deviceId, hll(humidity) AS u FROM sensors "
+        "GROUP BY deviceId, HOPPINGWINDOW(ss, 10, 5)",
+        "SELECT deviceId, max(temperature) AS m, count(*) AS c "
+        "FROM sensors GROUP BY deviceId, COUNTWINDOW(262144)",
+        "SELECT deviceId, avg(humidity) AS ah, min(temperature) AS mt "
+        "FROM sensors GROUP BY deviceId, TUMBLINGWINDOW(ss, 5)",
+    ]
+    for i, sql in enumerate(singles):
+        topos.append(plan_rule(
+            RuleDef(id=f"solo{i}", sql=sql, actions=[{"nop": {}}],
+                    options={"micro_batch_rows": 16384}), store))
+        n_rules += 1
+    assert n_rules == 256
+    for t in topos:
+        t.open()
+    try:
+        # ONE physical source is shared by all 8 topologies (subtopo pool)
+        srcs = {id(t._live_shared[0][0]) for t in topos if t._live_shared}
+        assert len(srcs) == 1, f"expected 1 shared subtopo, got {len(srcs)}"
+        src = topos[0]._live_shared[0][0].source
+        rng = np.random.default_rng(31)
+        n_dev = 4096
+        ids = np.array([f"dev_{i}" for i in range(n_dev)], dtype=np.object_)
+        drains = []
+        for _ in range(8):
+            k = 16384
+            drains.append([
+                {"deviceId": d, "temperature": t, "pressure": p,
+                 "humidity": h}
+                for d, t, p, h in zip(
+                    ids[rng.integers(0, n_dev, k)],
+                    rng.normal(20, 5, k).round(2),
+                    rng.random(k).round(3),
+                    rng.normal(50, 15, k).round(2))
+            ])
+        src.ingest(drains[0])
+        deadline = time.time() + 600
+        while time.time() < deadline:  # all 8 programs compile
+            if all(t.wait_idle(5.0) for t in topos):
+                break
+        fused = [n for t in topos for n in t.ops
+                 if "Fused" in type(n).__name__]
+        rows = 0
+        n = 0
+        stall = 0.0
+        t0 = time.time()
+        while time.time() - t0 < 20.0:
+            src.ingest(drains[n % len(drains)])
+            rows += len(drains[0])
+            n += 1
+            ts = time.time()
+            while max(f.inq.qsize() for f in fused) > 6:
+                time.sleep(0.002)
+            stall += time.time() - ts
+        for t in topos:
+            t.wait_idle(timeout=30.0)
+        elapsed = time.time() - t0
+        state_mb = sum(
+            float(np.prod(v.shape)) * 4 for f in fused
+            for v in (f.state or {}).values()) / 1e6
+        print(
+            f"# hetero 256-rule fan-out (4 vmapped families x63 + 4 solo, "
+            f"one shared source): {rows:,} rows x {n_rules} rules in "
+            f"{elapsed:.2f}s = {rows * n_rules / elapsed:,.0f} rule-rows/s "
+            f"({stall:.1f}s backpressure-stalled), device state "
+            f"{state_mb:.0f}MB across {len(fused)} fused nodes "
+            f"(reference fan-out baseline: 150,000 rule-msg/s)",
+            file=sys.stderr,
+        )
+    finally:
+        for t in topos:
+            t.close()
+        mem.reset()
 
 
 def _full_pipe_main() -> None:
@@ -749,6 +886,7 @@ def main() -> None:
     bench_full_pipe_ingest()
     bench_event_time(batches, KEY_SLOTS)
     bench_rule_group(batches, KEY_SLOTS)
+    bench_hetero_rules()
 
     print(json.dumps({
         "metric": "tumbling_groupby_rows_per_sec_10k_devices",

@@ -92,3 +92,75 @@ class TestRuleGroup:
         )
         with pytest.raises(PlanError):
             plan_rule_group("grp2", [_rule("g0", 10.0), bad], store)
+
+
+class TestHeterogeneousFanout:
+    """Heterogeneous fan-out (bench.py _hetero_main shape): families with
+    DIFFERENT statements each plan as their own vmapped group, individual
+    rules as their own fused nodes, all riding ONE shared source subtopo."""
+
+    def test_families_and_solos_share_one_source(self, mock_clock):
+        mem.reset()
+        store = kv.get_store()
+        StreamProcessor(store).exec_stmt(
+            'CREATE STREAM het (deviceId STRING, temperature FLOAT, '
+            'pressure FLOAT) '
+            'WITH (DATASOURCE="t/het", TYPE="memory", FORMAT="JSON")')
+        fam_a = [RuleDef(
+            id=f"a{i}",
+            sql=("SELECT deviceId, count(*) AS c FROM het "
+                 f"WHERE temperature > {10 + i} "
+                 "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"),
+            actions=[{"memory": {"topic": f"het/a{i}"}}], options={})
+            for i in range(3)]
+        fam_b = [RuleDef(
+            id=f"b{i}",
+            sql=("SELECT deviceId, max(pressure) AS mx FROM het "
+                 f"WHERE pressure > {0.1 * i} "
+                 "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"),
+            actions=[{"memory": {"topic": f"het/b{i}"}}], options={})
+            for i in range(3)]
+        solo = RuleDef(
+            id="s0",
+            sql=("SELECT deviceId, avg(temperature) AS a FROM het "
+                 "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"),
+            actions=[{"memory": {"topic": "het/s0"}}], options={})
+        topos = [plan_rule_group("ga", fam_a, store),
+                 plan_rule_group("gb", fam_b, store),
+                 plan_rule(solo, store)]
+        sinks = {t.rule_id: t.sinks for t in topos}
+        for t in topos:
+            t.open()
+        try:
+            shared = {id(t._live_shared[0][0]) for t in topos
+                      if t._live_shared}
+            assert len(shared) == 1  # ONE physical source for all three
+            rows = [{"deviceId": "d1", "temperature": 20.0, "pressure": 0.5},
+                    {"deviceId": "d1", "temperature": 12.0, "pressure": 0.05},
+                    {"deviceId": "d2", "temperature": 30.0, "pressure": 0.9}]
+            for r in rows:
+                mem.publish("t/het", r)
+            mock_clock.advance(20)
+            time.sleep(0.4)
+            mock_clock.advance(10_000)
+            deadline = time.time() + 6
+            while time.time() < deadline and not all(
+                    s.results for ss in sinks.values() for s in ss):
+                time.sleep(0.05)
+        finally:
+            for t in topos:
+                t.close()
+            mem.reset()
+        # family A rule a0 (temp > 10): d1 x2, d2 x1
+        a0 = {m["deviceId"]: m for m in _drain(sinks["ga"][0])}
+        assert a0["d1"]["c"] == 2 and a0["d2"]["c"] == 1
+        # a2 (temp > 12): d1 x1 (20.0), d2 x1
+        a2 = {m["deviceId"]: m for m in _drain(sinks["ga"][2])}
+        assert a2["d1"]["c"] == 1 and a2["d2"]["c"] == 1
+        # family B rule b2 (pressure > 0.2): d1 max 0.5, d2 max 0.9
+        b2 = {m["deviceId"]: m for m in _drain(sinks["gb"][2])}
+        assert b2["d1"]["mx"] == pytest.approx(0.5)
+        assert b2["d2"]["mx"] == pytest.approx(0.9)
+        # solo avg
+        s0 = {m["deviceId"]: m for m in _drain(sinks["s0"][0])}
+        assert s0["d1"]["a"] == pytest.approx(16.0)
