@@ -215,9 +215,22 @@ class CacheNode(Node):
         self._timer = None
         self._inflight = None  # ("mem"|"disk", item) awaiting sink ack/nack
         if self.kv is not None:  # restore spill bounds from a previous run
-            keys = sorted(int(k) for k in self.kv.keys() if str(k).isdigit())
+            keys = []
+            for k in self.kv.keys():
+                try:
+                    keys.append(int(k))  # close-spill prepends: can be < 0
+                except (TypeError, ValueError):
+                    continue
             if keys:
+                keys.sort()
                 self._disk_head, self._disk_tail = keys[0], keys[-1] + 1
+
+    def on_open(self) -> None:
+        # a restart with spilled backlog must resend WITHOUT waiting for new
+        # traffic (a fully-consumed rewindable source may never push again)
+        with self._mu:
+            if self._mem or self._disk_head != self._disk_tail:
+                self._arm_locked()
 
     # pass-through; SinkNode acks successes / nacks failures back to us
     def process(self, item: Any) -> None:
@@ -313,26 +326,57 @@ class CacheNode(Node):
                 n += 1
             return n
 
+    def _spill_page_locked(self) -> int:
+        """Move the memory page (queue FRONT — oldest pending) plus any
+        unconfirmed in-flight delivery INTO the spill KV, prepending BEFORE
+        the disk head (keys may go negative) so replay order stays
+        oldest-first. Caller holds self._mu. Returns items moved."""
+        items = list(self._mem)
+        if self._inflight is not None and self._inflight[0] == "mem":
+            items.insert(0, self._inflight[1])
+            self._inflight = None
+        for item in reversed(items):
+            self._disk_head -= 1
+            self.kv.set(str(self._disk_head), _dumps(item))
+        self._mem.clear()
+        return len(items)
+
     def snapshot_state(self) -> Optional[dict]:
+        # The spill KV is the ONE durable store for pending payloads: at a
+        # barrier the memory page moves into it (immediately durable even
+        # if the checkpoint never completes), and the JSON checkpoint
+        # carries only bookkeeping — no payload double-persist between the
+        # checkpoint and the close-time spill. Memory-only caches (no KV)
+        # still encode the page into the checkpoint itself.
         with self._mu:
-            return {"mem": list(self._mem)}
+            if self.kv is not None:
+                n = self._spill_page_locked()
+                return {"spilled": n}
+            items = list(self._mem)
+            if self._inflight is not None and self._inflight[0] == "mem":
+                items.insert(0, self._inflight[1])
+        return {"mem_enc": [_dumps(i) for i in items]}
 
     def restore_state(self, state: dict) -> None:
         with self._mu:
-            self._mem = list(state.get("mem", []))
+            if "mem_enc" in state:
+                self._mem = [_loads(r) for r in state["mem_enc"]]
+            elif "mem" in state:  # legacy raw-list snapshots
+                self._mem = list(state.get("mem", []))
+            # KV-backed pages were spilled at snapshot time; __init__
+            # already recovered the disk bounds
 
     def on_close(self) -> None:
         with self._mu:
             timer, self._timer = self._timer, None
         if timer is not None:
             timer.stop()
-        # spill remaining memory page so nothing is lost across restarts
+        # spill whatever is still in memory (items nacked after the last
+        # barrier) so nothing is lost across restarts; a disk-sourced
+        # in-flight record was never deleted, so it replays by itself
         if self.kv is not None:
             with self._mu:
-                for item in self._mem:
-                    self.kv.set(str(self._disk_tail), _dumps(item))
-                    self._disk_tail += 1
-                self._mem.clear()
+                self._spill_page_locked()
 
 
 class RateLimitNode(Node):
