@@ -398,7 +398,7 @@ def bench_full_pipe_ingest() -> None:
 
 
 def bench_hetero_rules() -> None:
-    _run_isolated("_hetero_main", "hetero 256-rule", timeout=1200)
+    _run_isolated("_hetero_main", "hetero 256-rule", timeout=1800)
 
 
 def _hetero_main() -> None:
@@ -493,7 +493,7 @@ def _hetero_main() -> None:
                     rng.normal(50, 15, k).round(2))
             ])
         src.ingest(drains[0])
-        deadline = time.time() + 600
+        deadline = time.time() + 420
         while time.time() < deadline:  # all 8 programs compile
             if all(t.wait_idle(5.0) for t in topos):
                 break

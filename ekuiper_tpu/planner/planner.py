@@ -168,7 +168,12 @@ def device_path_eligible(
         ast.WindowType.HOPPING_WINDOW,
         ast.WindowType.COUNT_WINDOW,
         ast.WindowType.SLIDING_WINDOW,
+        ast.WindowType.SESSION_WINDOW,
     ):
+        return None
+    if w.window_type == ast.WindowType.SESSION_WINDOW and opts.is_event_time:
+        # event-time sessions need the exact buffered host path (gap is
+        # measured in event time over reordered rows)
         return None
     if w.window_type == ast.WindowType.SLIDING_WINDOW:
         from ..sql.compiler import try_compile

@@ -330,11 +330,18 @@ class CacheNode(Node):
         """Move the memory page (queue FRONT — oldest pending) plus any
         unconfirmed in-flight delivery INTO the spill KV, prepending BEFORE
         the disk head (keys may go negative) so replay order stays
-        oldest-first. Caller holds self._mu. Returns items moved."""
+        oldest-first. Enforces max_disk_cache like _enqueue: the OLDEST
+        items keep their slots, the newest overflow drops with a stat.
+        Caller holds self._mu. Returns items moved."""
         items = list(self._mem)
         if self._inflight is not None and self._inflight[0] == "mem":
             items.insert(0, self._inflight[1])
             self._inflight = None
+        room = self.max_disk_cache - (self._disk_tail - self._disk_head)
+        if len(items) > max(room, 0):
+            n_drop = len(items) - max(room, 0)
+            self.stats.inc_exception("disk cache full, dropped", n=n_drop)
+            items = items[:max(room, 0)]
         for item in reversed(items):
             self._disk_head -= 1
             self.kv.set(str(self._disk_head), _dumps(item))

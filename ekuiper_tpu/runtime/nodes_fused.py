@@ -172,6 +172,22 @@ class FusedWindowAggNode(Node):
                     "(the exact host path handles unconditional sliding)")
         else:
             self.n_panes = 1
+        if self.wt == ast.WindowType.SESSION_WINDOW:
+            # Processing-time SESSION windows on the device (reference
+            # semantics window_op.go: session is per-STREAM — any row
+            # extends the session; gap silence or the length cap closes
+            # it): rows fold into the single pane exactly like tumbling,
+            # and the gap/cap timers drive emission + reset. Event-time
+            # sessions stay on the exact host buffering path.
+            self.gap_ms = self.interval_ms or self.length_ms
+            self._session_open = False
+            self._session_start = 0
+            self._last_row_ms = 0
+            # stale-trigger guard: gap/cap triggers carry the session id
+            # they were armed for; a trigger for a dead session is ignored
+            self._session_id = 0
+            self._gap_timer = None
+            self._cap_timer = None
         # heavy_hitters: per-column reversible dictionaries (codes -> values)
         # + the spec index -> raw column map for emit-time decoding. The hh
         # component is wide (sketches.HH_SIZE floats/key), so start small and
@@ -350,6 +366,10 @@ class FusedWindowAggNode(Node):
             self._timer.stop()
         for t in self._pre_timers:
             t.stop()
+        if self.wt == ast.WindowType.SESSION_WINDOW:
+            for t in (self._gap_timer, self._cap_timer):
+                if t is not None:
+                    t.stop()
         self._drain_async_emits()
         if self._emit_q is not None and self._emit_worker is not None \
                 and self._emit_worker.is_alive():
@@ -395,6 +415,9 @@ class FusedWindowAggNode(Node):
             return
         if self.wt == ast.WindowType.COUNT_WINDOW:
             self._fold_count_window(item)
+        elif self.wt == ast.WindowType.SESSION_WINDOW:
+            self._fold(item)
+            self._touch_session()
         else:
             self._fold(item)
 
@@ -647,6 +670,76 @@ class FusedWindowAggNode(Node):
                     self._emit(wr)
                 self.state = self.gb.reset_pane(self.state, 0)
                 self._rows_in_window = 0
+
+    # ---------------------------------------------------------- session time
+    def _touch_session(self) -> None:
+        """A batch arrived: open the session if closed (arming the length
+        cap) and record the last-row time. ONE inactivity-check timer per
+        gap window — it re-arms itself against `_last_row_ms` instead of a
+        timer per batch (a timer thread per batch would accumulate
+        batch_rate x gap_seconds sleepers on the hot path)."""
+        now = timex.now_ms()
+        if not self._session_open:
+            self._session_open = True
+            self._session_start = now
+            self._session_id += 1
+            if self.length_ms > 0:
+                sid = self._session_id
+                self._cap_timer = timex.after(
+                    self.length_ms,
+                    lambda ts, _s=sid: self.inq.put(
+                        Trigger(ts=ts, tag=("session_cap", _s))))
+        self._last_row_ms = now
+        if (self._gap_timer is None or self._gap_timer.fired
+                or self._gap_timer.stopped):
+            self._arm_gap_check(self.gap_ms)
+
+    def _arm_gap_check(self, delay_ms: int) -> None:
+        sid = self._session_id
+        self._gap_timer = timex.after(
+            max(delay_ms, 1),
+            lambda ts, _s=sid: self.inq.put(
+                Trigger(ts=ts, tag=("session_gap", _s))))
+
+    def _on_session_trigger(self, trig: Trigger) -> None:
+        kind, sid = trig.tag
+        if not self._session_open or sid != self._session_id:
+            return  # stale trigger for a session that already closed
+        if kind == "session_cap":
+            self._close_session(trig.ts)
+            return
+        # gap check: close only if the session has truly been idle for a
+        # full gap; otherwise re-arm for the remaining quiet time (a row
+        # may have arrived after this timer fired but before it drained)
+        idle = timex.now_ms() - self._last_row_ms
+        if idle >= self.gap_ms:
+            self._close_session(self._last_row_ms + self.gap_ms)
+        else:
+            self._arm_gap_check(self.gap_ms - idle)
+
+    def _touch_session_timers_only(self) -> None:
+        """Arm gap (+ remaining cap) timers for an already-open session
+        (checkpoint restore)."""
+        now = timex.now_ms()
+        self._last_row_ms = now
+        self._session_id += 1
+        if self.length_ms > 0:
+            remaining = max(self._session_start + self.length_ms - now, 1)
+            sid = self._session_id
+            self._cap_timer = timex.after(
+                remaining,
+                lambda ts, _s=sid: self.inq.put(
+                    Trigger(ts=ts, tag=("session_cap", _s))))
+        self._arm_gap_check(self.gap_ms)
+
+    def _close_session(self, end_ts: int) -> None:
+        self._emit(WindowRange(self._session_start, end_ts))
+        self.state = self.gb.reset_pane(self.state, 0)
+        self._session_open = False
+        for t in (self._gap_timer, self._cap_timer):
+            if t is not None:
+                t.stop()
+        self._gap_timer = self._cap_timer = None
 
     # ------------------------------------------------- async count emission
     def _emit_count_async(self, wr: WindowRange) -> None:
@@ -936,6 +1029,11 @@ class FusedWindowAggNode(Node):
                 self._pending_slides.pop(trig.tag[1], None)
                 self._emit_sliding(trig.tag[1])
             return
+        if self.wt == ast.WindowType.SESSION_WINDOW:
+            if isinstance(trig.tag, tuple) and trig.tag[0] in (
+                    "session_gap", "session_cap"):
+                self._on_session_trigger(trig)
+            return
         end = trig.ts
         self._emit(WindowRange(end - self.length_ms, end))
         if self.wt == ast.WindowType.TUMBLING_WINDOW:
@@ -993,6 +1091,11 @@ class FusedWindowAggNode(Node):
             return
         now = timex.now_ms()
         self._drain_async_emits()  # deliver queued count windows in order
+        if self.wt == ast.WindowType.SESSION_WINDOW:
+            if self._session_open:
+                self._close_session(now)
+            self.broadcast(eof)
+            return
         self._emit(WindowRange(now - self.length_ms, now))
         if self.wt == ast.WindowType.TUMBLING_WINDOW:
             self.state = self.gb.reset_pane(self.state, 0)
@@ -1188,6 +1291,9 @@ class FusedWindowAggNode(Node):
             snap["hh_dicts"] = {
                 c: vd.snapshot() for c, vd in self._hh_dicts.items()
             }
+        if self.wt == ast.WindowType.SESSION_WINDOW:
+            snap["session_open"] = self._session_open
+            snap["session_start"] = self._session_start
         if self.is_event_time:
             snap["next_emit_bucket"] = self._next_emit_bucket
             snap["max_bucket"] = self._max_bucket
@@ -1227,6 +1333,13 @@ class FusedWindowAggNode(Node):
             vd = ValueDict()
             vd.restore(values)
             self._hh_dicts[c] = vd
+        if self.wt == ast.WindowType.SESSION_WINDOW \
+                and state.get("session_open"):
+            # re-open with fresh timers: a restored session's rows count,
+            # and the gap restarts from the restore instant
+            self._session_open = True
+            self._session_start = int(state.get("session_start", 0))
+            self._touch_session_timers_only()
         if self.is_event_time:
             self._next_emit_bucket = state.get("next_emit_bucket")
             self._max_bucket = state.get("max_bucket")

@@ -120,8 +120,7 @@ class SourceNode(Node):
                 raws = [bytes(p) for p in payload]
             if raws is not None:
                 self.stats.inc_in(len(raws))
-                self._buffer(self._pending_raw, self._pending_raw_ts,
-                             raws, [now] * len(raws))
+                self._buffer("raw", raws, [now] * len(raws))
                 return
         if isinstance(payload, (bytes, bytearray)):
             if self.converter is None:
@@ -141,8 +140,7 @@ class SourceNode(Node):
                     self.emit(t)
                 return
             # preserve the tuple's own (replay/historical) timestamp
-            self._buffer(self._pending_msgs, self._pending_ts,
-                         [payload.message], [payload.timestamp or now])
+            self._buffer("msgs", [payload.message], [payload.timestamp or now])
             return
         elif isinstance(payload, dict):
             msgs = [payload]
@@ -169,17 +167,22 @@ class SourceNode(Node):
                 if t is not None:
                     self.emit(t)
             return
-        self._buffer(self._pending_msgs, self._pending_ts,
-                     msgs, [now] * len(msgs))
+        self._buffer("msgs", msgs, [now] * len(msgs))
 
-    def _buffer(self, items: list, ts_list: list, new_items: list,
-                new_ts: list) -> None:
+    def _buffer(self, kind: str, new_items: list, new_ts: list) -> None:
         """Append to a pending buffer under the lock, then flush at the
         micro-batch threshold or arm the linger timer — the single place
-        holding the batching policy for all three ingest shapes."""
+        holding the batching policy for all three ingest shapes. The
+        target list is resolved INSIDE the lock: a caller-bound reference
+        could be swapped out by a concurrent flush between the attribute
+        read and the lock, silently losing the whole append."""
         with self._pending_lock:
-            items.extend(new_items)
-            ts_list.extend(new_ts)
+            if kind == "raw":
+                self._pending_raw.extend(new_items)
+                self._pending_raw_ts.extend(new_ts)
+            else:
+                self._pending_msgs.extend(new_items)
+                self._pending_ts.extend(new_ts)
             full = (len(self._pending_msgs) + len(self._pending_raw)
                     >= self.micro_batch_rows)
         if full:
