@@ -169,12 +169,29 @@ def device_path_eligible(
         ast.WindowType.COUNT_WINDOW,
         ast.WindowType.SLIDING_WINDOW,
         ast.WindowType.SESSION_WINDOW,
+        ast.WindowType.STATE_WINDOW,
     ):
         return None
     if w.window_type == ast.WindowType.SESSION_WINDOW and opts.is_event_time:
         # event-time sessions need the exact buffered host path (gap is
         # measured in event time over reordered rows)
         return None
+    if w.window_type == ast.WindowType.STATE_WINDOW:
+        from ..sql.compiler import try_compile
+
+        # device state windows: vectorizable begin/emit conditions,
+        # processing time, single chip (per-emission finalize). A WHERE
+        # clause filters BEFORE the window on the host path — a filtered
+        # row must not toggle the window, so such rules stay host-side
+        # (the same pre/post-WHERE divergence as COUNT windows)
+        if opts.is_event_time or (opts.plan_optimize_strategy or {}).get(
+                "mesh"):
+            return None
+        if stmt.condition is not None:
+            return None
+        if try_compile(w.begin_condition, mode="host") is None or \
+                try_compile(w.emit_condition, mode="host") is None:
+            return None
     if w.window_type == ast.WindowType.SLIDING_WINDOW:
         from ..sql.compiler import try_compile
 

@@ -36,6 +36,16 @@ from .events import EOF, PreTrigger, Trigger
 from .node import Node
 
 
+def _host_mask(ce, columns: Dict[str, np.ndarray], n: int) -> np.ndarray:
+    """Vectorized host condition -> per-row bool mask. A batch missing the
+    referenced column (or with uncoercible types) evaluates to all-false —
+    null semantics, matching the host row evaluator."""
+    try:
+        return np.broadcast_to(np.asarray(ce(columns), dtype=np.bool_), (n,))
+    except Exception:
+        return np.zeros(n, dtype=np.bool_)
+
+
 def _enc_arr(a: np.ndarray) -> dict:
     """Compact checkpoint encoding for a numpy array: raw bytes + dtype."""
     import base64
@@ -172,6 +182,23 @@ class FusedWindowAggNode(Node):
                     "(the exact host path handles unconditional sliding)")
         else:
             self.n_panes = 1
+        if self.wt == ast.WindowType.STATE_WINDOW:
+            # Condition-bounded windows on the device (reference: host
+            # WindowNode STATE semantics — a begin-condition row opens the
+            # window, rows fold until an emit-condition row closes it,
+            # inclusive). Conditions evaluate VECTORIZED on the host
+            # columns; only the open spans upload and fold.
+            from ..sql.compiler import try_compile as _try_compile
+
+            self._begin_host = _try_compile(window.begin_condition,
+                                            mode="host")
+            self._emitc_host = _try_compile(window.emit_condition,
+                                            mode="host")
+            if self._begin_host is None or self._emitc_host is None:
+                raise ValueError(
+                    "state device path needs vectorizable begin/emit "
+                    "conditions (the host path handles the rest)")
+            self._state_open = False
         if self.wt == ast.WindowType.SESSION_WINDOW:
             # Processing-time SESSION windows on the device (reference
             # semantics window_op.go: session is per-STREAM — any row
@@ -418,6 +445,8 @@ class FusedWindowAggNode(Node):
         elif self.wt == ast.WindowType.SESSION_WINDOW:
             self._fold(item)
             self._touch_session()
+        elif self.wt == ast.WindowType.STATE_WINDOW:
+            self._fold_state_window(item)
         else:
             self._fold(item)
 
@@ -671,6 +700,36 @@ class FusedWindowAggNode(Node):
                 self.state = self.gb.reset_pane(self.state, 0)
                 self._rows_in_window = 0
 
+    # ---------------------------------------------------------- state window
+    def _fold_state_window(self, batch: ColumnBatch) -> None:
+        """Walk the batch's begin/emit toggle points (both masks computed
+        in one vectorized pass); fold only open spans, emit + reset at
+        each emit row (inclusive, mirroring the host row path — which does
+        NOT evaluate the emit condition on the row that just opened the
+        window)."""
+        begin_m = _host_mask(self._begin_host, batch.columns, batch.n)
+        emit_m = _host_mask(self._emitc_host, batch.columns, batch.n)
+        pos = 0
+        while pos < batch.n:
+            scan_from = pos
+            if not self._state_open:
+                opens = np.nonzero(begin_m[pos:])[0]
+                if not len(opens):
+                    return  # closed and no begin row in the rest
+                pos += int(opens[0])
+                self._state_open = True
+                scan_from = pos + 1  # opening row can't also close it
+            closes = np.nonzero(emit_m[scan_from:])[0]
+            if not len(closes):
+                self._fold(batch, pos, batch.n)
+                return  # window stays open across batches
+            end = scan_from + int(closes[0]) + 1  # emit row is inclusive
+            self._fold(batch, pos, end)
+            self._emit(WindowRange(0, timex.now_ms()))
+            self.state = self.gb.reset_pane(self.state, 0)
+            self._state_open = False
+            pos = end
+
     # ---------------------------------------------------------- session time
     def _touch_session(self) -> None:
         """A batch arrived: open the session if closed (arming the length
@@ -896,14 +955,7 @@ class FusedWindowAggNode(Node):
             ) if not m.all() else (cols, valid, slots, ts)
             self._ring.setdefault(int(b), []).append(seg)
         # trigger rows: vectorized OVER(WHEN ...) on the raw batch columns;
-        # a batch missing the trigger column evaluates to no triggers (null
-        # semantics — matches the host row evaluator), not a rule exception
-        try:
-            trig_mask = np.broadcast_to(
-                np.asarray(self._trigger_host(sub.columns), dtype=np.bool_),
-                (sub.n,))
-        except Exception:
-            trig_mask = np.zeros(sub.n, dtype=np.bool_)
+        trig_mask = _host_mask(self._trigger_host, sub.columns, sub.n)
         for i in np.nonzero(trig_mask)[0].tolist():
             t = int(ts[i])
             if self.delay_ms > 0:
@@ -1294,6 +1346,8 @@ class FusedWindowAggNode(Node):
         if self.wt == ast.WindowType.SESSION_WINDOW:
             snap["session_open"] = self._session_open
             snap["session_start"] = self._session_start
+        if self.wt == ast.WindowType.STATE_WINDOW:
+            snap["state_open"] = self._state_open
         if self.is_event_time:
             snap["next_emit_bucket"] = self._next_emit_bucket
             snap["max_bucket"] = self._max_bucket
@@ -1333,6 +1387,8 @@ class FusedWindowAggNode(Node):
             vd = ValueDict()
             vd.restore(values)
             self._hh_dicts[c] = vd
+        if self.wt == ast.WindowType.STATE_WINDOW:
+            self._state_open = bool(state.get("state_open", False))
         if self.wt == ast.WindowType.SESSION_WINDOW \
                 and state.get("session_open"):
             # re-open with fresh timers: a restored session's rows count,

@@ -276,7 +276,9 @@ class TestNativeMqtt:
             sink.collect({"v": 3})
             while time.time() < deadline and not got:
                 time.sleep(0.02)
-            assert got and got[0][0] == {"v": 3}
+            # the source delivers RAW bytes — decoding (incl. native
+            # columnar batch decode) belongs to the SourceNode
+            assert got and got[0][0] == b'{"v": 3}'
             assert got[0][1]["topic"] == "sensors/d1/t"
             sink.close()
             src.close()
@@ -349,3 +351,59 @@ class TestRuleLogFiles:
             assert kubernetes_tool.process_dir(str(tmp_path), endpoint) == []
         finally:
             srv.shutdown()
+
+
+class TestMqttFullPipe:
+    def test_mqtt_stream_rule_decodes_in_source_node(self, mock_clock):
+        """Full pipe: mqtt broker bytes → SourceNode decode (native fast
+        path for scalar typed schemas) → rule → sink."""
+        import ekuiper_tpu.io.memory as mem
+        from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+        from ekuiper_tpu.server.processors import StreamProcessor
+        from ekuiper_tpu.store import kv as kvmod
+
+        broker = FakeBroker()
+        mem.reset()
+        pub = None
+        try:
+            store = kvmod.get_store()
+            StreamProcessor(store).exec_stmt(
+                f'CREATE STREAM mq (deviceId STRING, v FLOAT) WITH '
+                f'(DATASOURCE="sensors/t", TYPE="mqtt", FORMAT="JSON", '
+                f'CONF_KEY="fb{broker.port}")')
+            store.kv("source_conf").set(
+                f"mqtt:fb{broker.port}",
+                {"server": f"tcp://127.0.0.1:{broker.port}", "qos": 0})
+            topo = plan_rule(RuleDef(
+                id="mq1", sql="SELECT deviceId, v FROM mq WHERE v > 1",
+                actions=[{"memory": {"topic": "mq/out"}}], options={}),
+                store)
+            sink = topo.sinks[0]
+            topo.open()
+            src = (topo._live_shared[0][0].source if topo._live_shared
+                   else topo.sources[0])
+            assert src._fast_spec is not None  # native decode active
+            deadline = time.time() + 5
+            while time.time() < deadline and not broker.subs:
+                time.sleep(0.02)
+            pub = io_registry.create_sink("mqtt")
+            pub.configure({"server": f"tcp://127.0.0.1:{broker.port}",
+                           "topic": "sensors/t", "qos": 0})
+            pub.connect()
+            pub.collect({"deviceId": "a", "v": 2.5})
+            pub.collect({"deviceId": "b", "v": 0.5})
+            while time.time() < deadline and src.stats.records_in < 2:
+                time.sleep(0.02)
+            mock_clock.advance(20)  # linger flush
+            while time.time() < deadline and not sink.results:
+                time.sleep(0.02)
+            topo.close()
+            assert sink.results
+            msgs = sink.results[0]
+            msgs = msgs if isinstance(msgs, list) else [msgs]
+            assert msgs == [{"deviceId": "a", "v": 2.5}]
+        finally:
+            if pub is not None:
+                pub.close()
+            broker.close()
+            mem.reset()

@@ -1,0 +1,178 @@
+"""Device-path STATE windows: condition-bounded windows fold on the fused
+kernel (vectorized begin/emit masks, segment folds, emit+reset per close),
+with parity against the host buffered path.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from ekuiper_tpu.data.batch import ColumnBatch
+from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+from ekuiper_tpu.ops.emit import build_direct_emit
+from ekuiper_tpu.planner.planner import device_path_eligible
+from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+from ekuiper_tpu.sql.parser import parse_select
+from ekuiper_tpu.utils.config import RuleOptionConfig
+
+SQL = ("SELECT deviceId, count(*) AS c, avg(v) AS a FROM s "
+       "GROUP BY deviceId, STATEWINDOW(st = 1, st = 0)")
+
+
+def make_node():
+    stmt = parse_select(SQL)
+    plan = extract_kernel_plan(stmt)
+    assert plan is not None
+    node = FusedWindowAggNode(
+        "st", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+        capacity=64, micro_batch=128,
+        direct_emit=build_direct_emit(stmt, plan, ["deviceId"]))
+    node.state = node.gb.init_state()
+    got = []
+    node.broadcast = lambda item: got.append(item)
+    return node, got
+
+
+def batch(devs, vs, sts, ts=1000):
+    n = len(devs)
+    return ColumnBatch(
+        n=n,
+        columns={"deviceId": np.array(devs, dtype=np.object_),
+                 "v": np.asarray(vs, dtype=np.float32),
+                 "st": np.asarray(sts, dtype=np.int64)},
+        timestamps=np.full(n, ts, dtype=np.int64), emitter="s")
+
+
+def msgs_of(got):
+    out = []
+    for item in got:
+        out.append(sorted(
+            (m["deviceId"], m["c"], round(m["a"], 4))
+            for m in (item if isinstance(item, list) else [item])))
+    return out
+
+
+class TestStateDevice:
+    def test_eligibility(self):
+        stmt = parse_select(SQL)
+        assert device_path_eligible(stmt, RuleOptionConfig()) is not None
+        opts = RuleOptionConfig(
+            plan_optimize_strategy={"mesh": {"rows": 2, "keys": 4}})
+        assert device_path_eligible(stmt, opts) is None
+
+    def test_open_close_within_one_batch(self):
+        node, got = make_node()
+        # rows: ignored, begin, data, data, close, ignored, begin, close
+        node.process(batch(
+            ["x", "a", "a", "b", "a", "x", "b", "b"],
+            [9.0, 1.0, 2.0, 3.0, 4.0, 9.0, 10.0, 20.0],
+            [5, 1, 5, 5, 0, 5, 1, 0]))
+        assert msgs_of(got) == [
+            [("a", 3, round(7.0 / 3, 4)), ("b", 1, 3.0)],  # rows 1..4
+            [("b", 2, 15.0)],                              # rows 6..7
+        ]
+
+    def test_window_spans_batches(self):
+        node, got = make_node()
+        node.process(batch(["a", "a"], [1.0, 2.0], [1, 5]))  # opens, stays
+        node.process(batch(["a", "b"], [3.0, 4.0], [5, 5]))  # still open
+        assert got == []
+        node.process(batch(["b"], [5.0], [0]))               # closes
+        assert msgs_of(got) == [[("a", 3, 2.0), ("b", 2, 4.5)]]
+
+    def test_rows_outside_window_excluded(self):
+        node, got = make_node()
+        node.process(batch(["a"], [100.0], [0]))  # emit cond while CLOSED
+        node.process(batch(["a"], [200.0], [5]))  # plain row while closed
+        assert got == []
+        node.process(batch(["a", "a"], [1.0, 2.0], [1, 0]))
+        assert msgs_of(got) == [[("a", 2, 1.5)]]
+
+    def test_checkpoint_restores_open_flag(self):
+        node, got = make_node()
+        node.process(batch(["a"], [1.0], [1]))  # open
+        snap = node.snapshot_state()
+        assert snap["state_open"] is True
+        node2, got2 = make_node()
+        node2.restore_state(snap)
+        node2.process(batch(["a"], [3.0], [0]))  # closes restored window
+        assert msgs_of(got2) == [[("a", 2, 2.0)]]
+
+    def test_parity_with_host_path(self, mock_clock):
+        """End-to-end: device and host topologies on the same stream."""
+        import ekuiper_tpu.io.memory as mem
+        from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+        from ekuiper_tpu.server.processors import StreamProcessor
+        from ekuiper_tpu.store import kv
+
+        mem.reset()
+        store = kv.get_store()
+        StreamProcessor(store).exec_stmt(
+            'CREATE STREAM sw (deviceId STRING, v FLOAT, st BIGINT) '
+            'WITH (DATASOURCE="t/sw", TYPE="memory", FORMAT="JSON")')
+        sql = ("SELECT deviceId, count(*) AS c, sum(v) AS sv FROM sw "
+               "GROUP BY deviceId, STATEWINDOW(st = 1, st = 0)")
+        td = plan_rule(RuleDef(id="std", sql=sql,
+                               actions=[{"memory": {"topic": "sw/d"}}],
+                               options={}), store)
+        th = plan_rule(RuleDef(id="sth", sql=sql,
+                               actions=[{"memory": {"topic": "sw/h"}}],
+                               options={"use_device_kernel": False}), store)
+        assert any("Fused" in type(n).__name__ for n in td.ops)
+        sd, sh = td.sinks[0], th.sinks[0]
+        td.open()
+        th.open()
+        try:
+            rows = [
+                {"deviceId": "a", "v": 1.0, "st": 1},
+                {"deviceId": "b", "v": 2.0, "st": 5},
+                {"deviceId": "a", "v": 3.0, "st": 0},
+                {"deviceId": "a", "v": 9.0, "st": 5},  # outside any window
+                {"deviceId": "b", "v": 4.0, "st": 1},
+                {"deviceId": "b", "v": 5.0, "st": 0},
+            ]
+            for r in rows:
+                mem.publish("t/sw", r)
+            mock_clock.advance(20)
+            deadline = time.time() + 8
+            while time.time() < deadline and (
+                    len(sd.results) < 2 or len(sh.results) < 2):
+                time.sleep(0.02)
+        finally:
+            td.close()
+            th.close()
+            mem.reset()
+
+        def norm(res):
+            return [sorted((m["deviceId"], m["c"], m["sv"])
+                           for m in (x if isinstance(x, list) else [x]))
+                    for x in res]
+
+        assert len(sd.results) == 2
+        assert norm(sd.results) == norm(sh.results)
+        assert norm(sd.results)[0] == [("a", 2, 4.0), ("b", 1, 2.0)]
+
+    def test_begin_row_does_not_self_close(self):
+        """A row satisfying BOTH conditions opens the window and stays open
+        (host semantics: emit is not evaluated on the opening row)."""
+        sql = ("SELECT deviceId, count(*) AS c, avg(v) AS a FROM s "
+               "GROUP BY deviceId, STATEWINDOW(st >= 1, st >= 1)")
+        stmt = parse_select(sql)
+        plan = extract_kernel_plan(stmt)
+        node = FusedWindowAggNode(
+            "sc", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+            capacity=64, micro_batch=128,
+            direct_emit=build_direct_emit(stmt, plan, ["deviceId"]))
+        node.state = node.gb.init_state()
+        got = []
+        node.broadcast = lambda item: got.append(item)
+        node.process(batch(["a", "a", "a"], [1.0, 2.0, 3.0], [1, 1, 1]))
+        # row0 opens (no self-close); row1 closes; row2 reopens, stays open
+        assert msgs_of(got) == [[("a", 2, 1.5)]]
+        assert node._state_open
+
+    def test_where_clause_routes_to_host(self):
+        stmt = parse_select(
+            "SELECT deviceId, count(*) AS c FROM s WHERE v > 0 "
+            "GROUP BY deviceId, STATEWINDOW(st = 1, st = 0)")
+        assert device_path_eligible(stmt, RuleOptionConfig()) is None
