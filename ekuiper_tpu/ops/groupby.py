@@ -512,21 +512,15 @@ class DeviceGroupBy:
         rows.append(act[None, :])
         return jnp.concatenate(rows, axis=0)
 
-    def _host_finalize(
-        self, state: Dict[str, Any], n_keys: int,
-        panes: Optional[List[int]],
+    def hh_assemble(
+        self, stacked: np.ndarray, n_keys: int,
     ) -> Tuple[List[np.ndarray], np.ndarray]:
-        """Finalize route for heavy_hitters plans: fetch the compact device
-        result, then dedupe candidates (a code can appear once per depth)
-        and trim to top-k on host."""
-        pm = np.zeros(self.n_panes, dtype=np.bool_)
-        if panes is None:
-            pm[:] = True
-        else:
-            pm[panes] = True
+        """Host tail of the heavy-hitters finalize: dedupe candidates (a
+        code can appear once per depth) and trim to top-k; plain specs read
+        their final-value row. Shared by the sync finalize route and the
+        async emit worker."""
         from .prefinalize import hh_dedupe_topk
 
-        stacked = np.asarray(self._hh_fin(state, pm))
         outs: List[np.ndarray] = []
         r = 0
         for spec in self.plan.specs:
@@ -546,6 +540,20 @@ class DeviceGroupBy:
         act = stacked[-1]
         outs = apply_int_semantics(self.plan.specs, outs)
         return outs, np.asarray(act[:n_keys])
+
+    def _host_finalize(
+        self, state: Dict[str, Any], n_keys: int,
+        panes: Optional[List[int]],
+    ) -> Tuple[List[np.ndarray], np.ndarray]:
+        """Finalize route for heavy_hitters plans: fetch the compact device
+        result, then assemble the top-k lists on host."""
+        pm = np.zeros(self.n_panes, dtype=np.bool_)
+        if panes is None:
+            pm[:] = True
+        else:
+            pm[panes] = True
+        stacked = np.asarray(self._hh_fin(state, pm))
+        return self.hh_assemble(stacked, n_keys)
 
     def finalize(
         self, state: Dict[str, Any], n_keys: int,

@@ -310,6 +310,18 @@ class FusedWindowAggNode(Node):
             and not self._hh_cols
             and prefinalize_lead_ms > 0
         )
+        # heavy_hitters timer boundaries also emit asynchronously: the
+        # compact _hh_fin result is dispatched on the pre-reset snapshot
+        # and delivered by the worker — the boundary never stalls a
+        # sync fetch (2-3 tunnel RTTs) in the fold stream
+        self._async_hh = (
+            bool(self._hh_cols)
+            and self.wt in (ast.WindowType.TUMBLING_WINDOW,
+                            ast.WindowType.HOPPING_WINDOW)
+            and not is_event_time
+            and self.gb.supports_prefinalize
+            and prefinalize_lead_ms > 0
+        )
         self._emit_q = None
         self._emit_worker = None
         # telemetry: the last boundary found no landed device fetch
@@ -807,18 +819,37 @@ class FusedWindowAggNode(Node):
         without waiting a device round trip."""
         import time as _time
 
-        n_keys = self.kt.n_keys
-        if n_keys == 0:
+        if self.kt.n_keys == 0:
             self.last_emit_info = None
             return
-        stacked_dev = self.gb._finalize(
-            self.state, (True,) * self.gb.n_panes)
+        self._emit_async(
+            "count",
+            self.gb._finalize(self.state, (True,) * self.gb.n_panes), wr)
+
+    def _emit_hh_async(self, wr: WindowRange) -> None:
+        """Heavy-hitters boundary: dispatch the compact device recovery on
+        the immutable state and hand delivery to the worker."""
+        if self.kt.n_keys == 0:
+            self.last_emit_info = None
+            return
+        self._emit_async(
+            "hh",
+            self.gb._hh_fin(self.state,
+                            np.ones(self.gb.n_panes, dtype=np.bool_)), wr)
+
+    def _emit_async(self, kind: str, stacked_dev, wr: WindowRange) -> None:
+        """Shared async-emit protocol: start the device→host copy, enqueue
+        for the worker. The dispatched program sees an immutable snapshot,
+        so the caller is free to reset panes immediately after."""
+        import time as _time
+
         try:
             stacked_dev.copy_to_host_async()
         except AttributeError:
             pass
         self._ensure_emit_worker()
-        self._emit_q.put((stacked_dev, n_keys, wr, _time.time()))
+        self._emit_q.put((kind, stacked_dev, self.kt.n_keys, wr,
+                          _time.time()))
 
     def _ensure_emit_worker(self) -> None:
         import queue
@@ -841,13 +872,16 @@ class FusedWindowAggNode(Node):
             item = self._emit_q.get()
             if item is None:
                 break
-            stacked_dev, n_keys, wr, t_issue = item
+            kind, stacked_dev, n_keys, wr, t_issue = item
             try:
                 arr = np.asarray(stacked_dev)
-                outs = [arr[i][:n_keys]
-                        for i in range(len(self.plan.specs))]
-                outs = apply_int_semantics(self.plan.specs, outs)
-                act = np.asarray(arr[-1][:n_keys])
+                if kind == "hh":
+                    outs, act = self.gb.hh_assemble(arr, n_keys)
+                else:
+                    outs = [arr[i][:n_keys]
+                            for i in range(len(self.plan.specs))]
+                    outs = apply_int_semantics(self.plan.specs, outs)
+                    act = np.asarray(arr[-1][:n_keys])
                 self.last_emit_info = {
                     "source": "device-async",
                     "fetch_ms": (_time.time() - t_issue) * 1000.0,
@@ -860,7 +894,8 @@ class FusedWindowAggNode(Node):
                     else:
                         self._emit_grouped(outs, active, wr)
             except Exception as exc:
-                logger.error("async count-window emit failed: %s", exc)
+                logger.error("async %s emit failed on %s: %s",
+                             kind, self.name, exc)
             finally:
                 self._emit_q.task_done()
 
@@ -1087,7 +1122,11 @@ class FusedWindowAggNode(Node):
                 self._on_session_trigger(trig)
             return
         end = trig.ts
-        self._emit(WindowRange(end - self.length_ms, end))
+        wr = WindowRange(end - self.length_ms, end)
+        if self._async_hh:
+            self._emit_hh_async(wr)
+        else:
+            self._emit(wr)
         if self.wt == ast.WindowType.TUMBLING_WINDOW:
             self.state = self.gb.reset_pane(self.state, 0)
         else:

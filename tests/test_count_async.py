@@ -119,6 +119,7 @@ class TestHeavyHittersGrow:
             timestamps=np.full(n, 1000, dtype=np.int64), emitter="s"))
         assert node.gb.capacity >= 100 > 32
         node.on_trigger(Trigger(ts=10_000))
+        node._drain_async_emits()
         msgs = []
         for item in got:
             msgs.extend(item if isinstance(item, list) else [item])

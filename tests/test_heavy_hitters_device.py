@@ -101,6 +101,7 @@ class TestHeavyHittersDevice:
         batch = skewed_batch(rng)
         node.process(batch)
         node.on_trigger(Trigger(ts=10_000))
+        node._drain_async_emits()
         check_parity(node, collect_msgs(got), batch, 3)
 
     def test_tumbling_string_values_decode(self):
@@ -109,6 +110,7 @@ class TestHeavyHittersDevice:
         batch = skewed_batch(rng, values="str")
         node.process(batch)
         node.on_trigger(Trigger(ts=10_000))
+        node._drain_async_emits()
         msgs = collect_msgs(got)
         assert msgs
         for m in msgs:
@@ -124,11 +126,13 @@ class TestHeavyHittersDevice:
         b1 = skewed_batch(rng, n=8000, ts=1000)
         node.process(b1)
         node.on_trigger(Trigger(ts=5_000))
+        node._drain_async_emits()
         node.cur_pane = 1
         b2 = skewed_batch(rng, n=8000, ts=6000)
         node.process(b2)
         got.clear()
         node.on_trigger(Trigger(ts=10_000))
+        node._drain_async_emits()
         msgs = collect_msgs(got)
         assert msgs
         both = ColumnBatch(
@@ -157,6 +161,7 @@ class TestHeavyHittersDevice:
         batch2 = skewed_batch(rng, n=10000, ts=2000)
         node2.process(batch2)
         node2.on_trigger(Trigger(ts=10_000))
+        node2._drain_async_emits()
         both = ColumnBatch(
             n=batch.n + batch2.n,
             columns={k: np.concatenate([batch.columns[k], batch2.columns[k]])
@@ -173,6 +178,7 @@ class TestHeavyHittersDevice:
             n=5, columns={"deviceId": keys, "code": code},
             timestamps=np.full(5, 1000, dtype=np.int64), emitter="s"))
         node.on_trigger(Trigger(ts=10_000))
+        node._drain_async_emits()
         msgs = collect_msgs(got)
         assert len(msgs) == 1
         assert msgs[0]["top"] == [
@@ -186,6 +192,7 @@ class TestHeavyHittersDevice:
             n=2, columns={"deviceId": keys, "code": code},
             timestamps=np.full(2, 1000, dtype=np.int64), emitter="s"))
         node.on_trigger(Trigger(ts=10_000))
+        node._drain_async_emits()
         msgs = collect_msgs(got)
         assert len(msgs) == 1
         assert msgs[0]["top"] == []
