@@ -142,6 +142,27 @@ class RuleRegistry:
     def status(self, rule_id: str) -> Dict[str, Any]:
         return self._get(rule_id).status()
 
+    def cpu_usage(self) -> Dict[str, Any]:
+        """Per-rule cumulative busy time in ms (reference REST
+        /rules/usage/cpu, rest.go:199 — there a sampling CPU profiler;
+        here each node's accumulated in-process time, a documented
+        wall-clock proxy)."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            rules = dict(self._rules)
+        for rule_id, rs in rules.items():
+            topo = rs.topo  # capture: stop/restart may null it concurrently
+            if topo is None:
+                continue
+            raw_us = {n.name: n.stats.process_time_us_total
+                      for n in topo.all_nodes()}
+            out[rule_id] = {
+                "total_ms": round(sum(raw_us.values()) / 1000.0, 1),
+                "nodes": {k: round(v / 1000.0, 1)
+                          for k, v in raw_us.items()},
+            }
+        return out
+
     def explain(self, rule_id: str) -> Dict[str, Any]:
         rule = self.processor.get(rule_id)
         return plan_explain(rule, self.store)

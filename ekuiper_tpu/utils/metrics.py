@@ -8,6 +8,7 @@ aggregates them per node, matching the reference's /rules/{name}/status shape.
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Any, Dict, List, Optional
 
 from . import timex
@@ -39,8 +40,12 @@ class StatManager:
         self.last_exception_time: int = 0
         self.last_invocation: int = 0
         self.process_latency_us: int = 0
+        # cumulative busy time (wall-clock in-process), the engine's
+        # per-rule CPU-usage proxy (reference: /rules/usage/cpu)
+        self.process_time_us_total: int = 0
         self.buffer_length: int = 0
         self._started_at: Optional[int] = None
+        self._started_perf: float = 0.0
 
     def inc_in(self, n: int = 1) -> None:
         with self._lock:
@@ -63,11 +68,18 @@ class StatManager:
 
     def process_begin(self) -> None:
         self._started_at = timex.now_ms()
+        self._started_perf = _time.perf_counter()
 
     def process_end(self) -> None:
         if self._started_at is not None:
             with self._lock:
-                self.process_latency_us = (timex.now_ms() - self._started_at) * 1000
+                # latency follows the engine clock (mock-deterministic in
+                # tests); the cumulative busy total uses a real perf
+                # counter — sub-ms work must still accrue
+                self.process_latency_us = (
+                    timex.now_ms() - self._started_at) * 1000
+                self.process_time_us_total += int(
+                    (_time.perf_counter() - self._started_perf) * 1e6)
             self._started_at = None
 
     def set_buffer_length(self, n: int) -> None:
@@ -81,6 +93,7 @@ class StatManager:
                 "records_out_total": self.records_out,
                 "messages_processed_total": self.messages_processed,
                 "process_latency_us": self.process_latency_us,
+                "process_time_us_total": self.process_time_us_total,
                 "buffer_length": self.buffer_length,
                 "last_invocation": self.last_invocation,
                 "exceptions_total": self.exceptions,

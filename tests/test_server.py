@@ -74,6 +74,18 @@ class TestDispatch:
         assert res["path"] in ("host", "device-fused")
         code, res = api.dispatch("GET", "/rules/r1/topo", None)
         assert "sources" in res
+        # per-rule CPU-usage proxy (reference /rules/usage/cpu)
+        import ekuiper_tpu.io.memory as _mem
+        from ekuiper_tpu.utils import timex as _timex
+        _mem.publish("t/demo", {"deviceId": "a", "temperature": 1.0})
+        _timex.get_mock_clock().advance(20)  # linger flush
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            code, res = api.dispatch("GET", "/rules/usage/cpu", None)
+            if code == 200 and res.get("r1", {}).get("total_ms", 0) > 0:
+                break
+            time.sleep(0.05)
+        assert code == 200 and res["r1"]["total_ms"] > 0, res
         code, res = api.dispatch("POST", "/rules/r1/stop", None)
         assert code == 200
         code, res = api.dispatch("DELETE", "/rules/r1", None)
