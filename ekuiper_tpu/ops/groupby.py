@@ -108,7 +108,6 @@ class DeviceGroupBy:
         # subset (up to n_panes compiles), a traced mask compiles once
         self._finalize_dyn = jax.jit(self._finalize_dyn_impl)
         self._components = jax.jit(self._components_impl, static_argnums=(1,))
-        self._components_dyn = jax.jit(self._components_dyn_impl)
         self._reset_pane = jax.jit(self._reset_pane_impl, donate_argnums=(0,))
         # heavy_hitters finalize: candidate recovery + top-k run ON DEVICE
         # (sketches.hh_candidates) so the emit transfer is 2*k2 floats/key,
@@ -408,11 +407,6 @@ class DeviceGroupBy:
         tail shadow is merged in."""
         return self._components_body(
             state, np.array(pane_mask_tuple, dtype=np.bool_))
-
-    def _components_dyn_impl(self, state, pane_mask):
-        """Traced-mask variant: event-time/sliding emits rotate through pane
-        subsets — one compiled executable instead of one per subset."""
-        return self._components_body(state, pane_mask)
 
     def _components_body(self, state, pane_mask):
         import jax.numpy as jnp
