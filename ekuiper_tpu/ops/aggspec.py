@@ -194,8 +194,12 @@ class ValueDict:
 
     def restore(self, values: List[Any]) -> None:
         self._values = list(values)
-        self._ids = {v: i for i, v in enumerate(self._values)
-                     if isinstance(v, (int, float, str, bool, tuple))}
+        self._ids = {}
+        for i, v in enumerate(self._values):
+            try:
+                self._ids[v] = i
+            except TypeError:
+                pass  # unhashable snapshot value (encode stored repr anyway)
 
 
 def materialize_hll_columns(plan_columns, cols: Dict[str, "np.ndarray"], n: int):

@@ -34,6 +34,7 @@ from ..runtime.topo import Topo
 from ..sql import ast
 from ..sql.parser import parse_select
 from ..utils.config import RuleOptionConfig, get_config
+from ..utils.cron import parse_duration_ms
 from ..utils.infra import PlanError
 
 
@@ -89,8 +90,32 @@ def merged_options(rule: RuleDef) -> RuleOptionConfig:
     }
     for k, v in rule.options.items():
         key = alias.get(k, k)
-        if hasattr(opts, key):
-            setattr(opts, key, v)
+        if not hasattr(opts, key):
+            continue
+        cur = getattr(opts, key)
+        try:
+            if key.endswith("_ms"):
+                # int ms (reference form) or Go-style duration ('1s', '5m');
+                # '' and bools would coerce to degenerate 0/1ms — reject
+                if isinstance(v, bool) or (isinstance(v, str) and not v.strip()):
+                    raise ValueError(f"not a duration: {v!r}")
+                v = parse_duration_ms(v)
+            elif isinstance(cur, bool):
+                if isinstance(v, str):
+                    low = v.strip().lower()
+                    if low in ("true", "1"):
+                        v = True
+                    elif low in ("false", "0"):
+                        v = False
+                    else:
+                        raise ValueError(f"not a boolean: {v!r}")
+                else:
+                    v = bool(v)
+            elif isinstance(cur, int) and not isinstance(v, bool):
+                v = int(v)
+        except Exception as exc:
+            raise PlanError(f"invalid rule option {k}={v!r}: {exc}") from exc
+        setattr(opts, key, v)
     return opts
 
 

@@ -299,9 +299,26 @@ class Node:
 
     def on_barrier(self, barrier: Barrier) -> None:
         """Snapshot own state, ack the coordinator, forward downstream.
-        Called exactly once per checkpoint id (see _handle_barrier)."""
+        Called exactly once per checkpoint id (see _handle_barrier).
+
+        A snapshot failure (e.g. the fused node's bounded async-emit drain
+        timing out on a wedged device fetch) must fail THIS CHECKPOINT, not
+        the rule: skip the ack — the checkpoint never completes and a later
+        one retries — but still forward the barrier so downstream aligners
+        never stall, and keep the worker thread alive."""
         if self._topo is not None:
-            self._topo.checkpoint_ack(self.name, barrier, self.snapshot_state())
+            try:
+                state = self.snapshot_state()
+            except Exception as exc:
+                logger.error(
+                    "%s: snapshot for checkpoint %d failed (%s) — skipping "
+                    "ack; this checkpoint will not commit, a later one "
+                    "retries", self.name, barrier.checkpoint_id, exc)
+                # surface in /rules metrics: a PERSISTENTLY failing snapshot
+                # silently pins recovery to an old checkpoint otherwise
+                self.stats.inc_exception(f"snapshot failed: {exc}")
+            else:
+                self._topo.checkpoint_ack(self.name, barrier, state)
         self.broadcast(barrier)
 
     def on_watermark(self, wm: Watermark) -> None:

@@ -214,6 +214,11 @@ class CacheNode(Node):
         self._mu = threading.Lock()
         self._timer = None
         self._inflight = None  # ("mem"|"disk", item) awaiting sink ack/nack
+        # (disk_key, item) for a mem in-flight delivery whose payload a
+        # barrier spilled to disk while the sink ack was still outstanding;
+        # the late ack must delete that record or the resend timer would
+        # redeliver an already-delivered item (duplicate sink output)
+        self._spilled_inflight = None
         if self.kv is not None:  # restore spill bounds from a previous run
             keys = []
             for k in self.kv.keys():
@@ -248,6 +253,16 @@ class CacheNode(Node):
         with self._mu:
             fl = self._inflight
             if fl is None or fl[1] is not item and fl[1] != item:
+                sp = self._spilled_inflight
+                if sp is not None and (sp[1] is item or sp[1] == item):
+                    # late ack for a delivery whose payload a barrier moved
+                    # to disk — drop the spilled record so it isn't resent
+                    self._spilled_inflight = None
+                    self.kv.delete(str(sp[0]))
+                    if sp[0] == self._disk_head:
+                        self._disk_head += 1
+                    if bool(self._mem) or self._disk_head != self._disk_tail:
+                        self._arm_locked()
                 return  # ack for a pass-through item — nothing tracked
             kind = fl[0]
             self._inflight = None
@@ -266,6 +281,13 @@ class CacheNode(Node):
                 if fl[0] == "mem":
                     self._mem.insert(0, item)
                 # a disk record was never deleted — it will be re-read
+                self._arm_locked()
+                return
+            sp = self._spilled_inflight
+            if sp is not None and (sp[1] is item or sp[1] == item):
+                # failed delivery whose payload a barrier spilled: the disk
+                # record IS the retry copy — re-enqueueing would duplicate
+                self._spilled_inflight = None
                 self._arm_locked()
                 return
         self._enqueue(item, front=True)
@@ -300,8 +322,10 @@ class CacheNode(Node):
     def _resend(self) -> None:
         with self._mu:
             self._timer = None
-            if self._inflight is not None:
+            if self._inflight is not None or self._spilled_inflight is not None:
                 # previous delivery still unconfirmed — wait for ack/nack
+                # (a spilled in-flight is still a live downstream delivery;
+                # resending its disk record now would duplicate it)
                 self._arm_locked()
                 return
             item = None
@@ -334,8 +358,10 @@ class CacheNode(Node):
         items keep their slots, the newest overflow drops with a stat.
         Caller holds self._mu. Returns items moved."""
         items = list(self._mem)
+        inflight_item = None
         if self._inflight is not None and self._inflight[0] == "mem":
-            items.insert(0, self._inflight[1])
+            inflight_item = self._inflight[1]
+            items.insert(0, inflight_item)
             self._inflight = None
         room = self.max_disk_cache - (self._disk_tail - self._disk_head)
         if len(items) > max(room, 0):
@@ -345,6 +371,10 @@ class CacheNode(Node):
         for item in reversed(items):
             self._disk_head -= 1
             self.kv.set(str(self._disk_head), _dumps(item))
+        if inflight_item is not None and items:
+            # items[0] (the in-flight delivery) landed at the new disk head;
+            # remember the key so its still-outstanding ack can delete it
+            self._spilled_inflight = (self._disk_head, inflight_item)
         self._mem.clear()
         return len(items)
 

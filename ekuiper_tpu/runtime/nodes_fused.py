@@ -12,6 +12,7 @@ them without recomputation.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -214,6 +215,7 @@ class FusedWindowAggNode(Node):
             # they were armed for; a trigger for a dead session is ignored
             self._session_id = 0
             self._gap_timer = None
+            self._gap_gen = 0  # arm generation: one live gap check at a time
             self._cap_timer = None
         # heavy_hitters: per-column reversible dictionaries (codes -> values)
         # + the spec index -> raw column map for emit-time decoding. The hh
@@ -766,19 +768,27 @@ class FusedWindowAggNode(Node):
             self._arm_gap_check(self.gap_ms)
 
     def _arm_gap_check(self, delay_ms: int) -> None:
-        sid = self._session_id
+        # a fired-but-undrained previous check may still deliver its trigger;
+        # the generation tag makes that stale trigger a no-op, so re-arming
+        # here can never leave two live gap checks for one session
+        if self._gap_timer is not None:
+            self._gap_timer.stop()
+        self._gap_gen += 1
+        sid, gen = self._session_id, self._gap_gen
         self._gap_timer = timex.after(
             max(delay_ms, 1),
-            lambda ts, _s=sid: self.inq.put(
-                Trigger(ts=ts, tag=("session_gap", _s))))
+            lambda ts, _s=sid, _g=gen: self.inq.put(
+                Trigger(ts=ts, tag=("session_gap", _s, _g))))
 
     def _on_session_trigger(self, trig: Trigger) -> None:
-        kind, sid = trig.tag
+        kind, sid = trig.tag[0], trig.tag[1]
         if not self._session_open or sid != self._session_id:
             return  # stale trigger for a session that already closed
         if kind == "session_cap":
             self._close_session(trig.ts)
             return
+        if trig.tag[2] != self._gap_gen:
+            return  # superseded gap check — a newer one is armed
         # gap check: close only if the session has truly been idle for a
         # full gap; otherwise re-arm for the remaining quiet time (a row
         # may have arrived after this timer fired but before it drained)
@@ -899,12 +909,45 @@ class FusedWindowAggNode(Node):
             finally:
                 self._emit_q.task_done()
 
-    def _drain_async_emits(self) -> None:
+    # bounded drain deadline; tests shrink it to exercise the abort path
+    drain_deadline_s: float = 30.0
+
+    def _drain_async_emits(self, deadline_s: Optional[float] = None,
+                           must_complete: bool = False) -> None:
         """Block until in-flight async emissions have been delivered —
         called before checkpoints, EOF flush, and close so ordering and
-        snapshot contracts hold."""
-        if self._emit_q is not None:
-            self._emit_q.join()
+        snapshot contracts hold. Bounded: a wedged device fetch (stalled
+        tunnel RTT) must not hang checkpoints/EOF/close forever. On
+        timeout: the snapshot path (must_complete=True) RAISES so the
+        checkpoint fails and a later one retries — committing now would
+        advance source offsets past rows whose window output exists only
+        in this process's queue (a crash would lose it). EOF/close paths
+        log and proceed: the worker is still alive and delivers whenever
+        the fetch unwedges."""
+        q = self._emit_q
+        if q is None:
+            return
+        if deadline_s is None:
+            deadline_s = self.drain_deadline_s
+        deadline = time.monotonic() + deadline_s
+        with q.all_tasks_done:
+            while q.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    if must_complete:
+                        raise RuntimeError(
+                            f"{self.name}: async emit drain timed out after "
+                            f"{deadline_s:.0f}s with {q.unfinished_tasks} "
+                            "emission(s) in flight — aborting this "
+                            "checkpoint (a later one will retry)")
+                    logger.error(
+                        "%s: async emit drain timed out after %.0fs with %d "
+                        "emission(s) still in flight; proceeding without "
+                        "waiting (the emit worker delivers them when the "
+                        "device fetch unwedges)",
+                        self.name, deadline_s, q.unfinished_tasks)
+                    return
+                q.all_tasks_done.wait(remaining)
 
     # ------------------------------------------------------------- sliding
     def _fold_sliding(self, sub: ColumnBatch) -> int:
@@ -1368,7 +1411,7 @@ class FusedWindowAggNode(Node):
 
     # ------------------------------------------------------------------ state
     def snapshot_state(self) -> Optional[dict]:
-        self._drain_async_emits()
+        self._drain_async_emits(must_complete=True)
         self._flush_tail()
         host = self.gb.state_to_host(self.state)
         snap = {

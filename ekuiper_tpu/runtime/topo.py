@@ -207,7 +207,17 @@ class Topo:
             return
         states = {}
         for node in self.all_nodes():
-            s = node.snapshot_state()
+            try:
+                s = node.snapshot_state()
+            except Exception as exc:
+                # one wedged node (e.g. bounded async-emit drain timeout)
+                # must not discard every OTHER node's state — notably a
+                # memory-only CacheNode whose pending at-least-once sink
+                # payloads persist only through this snapshot
+                logger.error("%s: stop-time snapshot failed (%s) — saving "
+                             "the other nodes' state", node.name, exc)
+                node.stats.inc_exception(f"stop snapshot failed: {exc}")
+                continue
             if s is not None:
                 states[node.name] = s
         with self._ckpt_lock:

@@ -207,9 +207,10 @@ struct Parser {
       return false;
     }
     if (lit("true", 4) || lit("false", 5) || lit("null", 4)) return true;
-    // number
+    // number ('+'-prefixed forms are not JSON — json.loads rejects them)
+    if (p < end && *p == '+') return false;
     const char* start = p;
-    if (p < end && (*p == '-' || *p == '+')) p++;
+    if (p < end && *p == '-') p++;
     while (p < end && (std::isdigit((unsigned char)*p) || *p == '.' ||
                        *p == 'e' || *p == 'E' || *p == '-' || *p == '+'))
       p++;
@@ -229,6 +230,7 @@ struct Interner {
   // owns key bytes — deque: element addresses are STABLE across growth
   // (a vector reallocation would move SSO strings and dangle StrKey.p)
   std::deque<std::string> storage;
+  bool bad_utf8 = false;  // last get() failed UTF-8 validation (bad row)
 
   ~Interner() {
     for (auto& kv : map) Py_DECREF(kv.second);
@@ -239,8 +241,17 @@ struct Interner {
       Py_INCREF(it->second);
       return it->second;
     }
-    PyObject* u = PyUnicode_DecodeUTF8(s, (Py_ssize_t)n, "replace");
-    if (u == nullptr) return nullptr;
+    // json.loads preserves lone \u-escape surrogates but raises on other
+    // invalid UTF-8; surrogatepass mirrors that so both decode paths
+    // classify the same payloads as bad (the Python path drops the row)
+    PyObject* u = PyUnicode_DecodeUTF8(s, (Py_ssize_t)n, "surrogatepass");
+    if (u == nullptr) {
+      if (PyErr_ExceptionMatches(PyExc_UnicodeDecodeError)) {
+        PyErr_Clear();
+        bad_utf8 = true;
+      }
+      return nullptr;
+    }
     if (map.size() < 262144) {  // bound the table
       storage.emplace_back(s, n);
       const std::string& owned = storage.back();
@@ -314,7 +325,13 @@ int parse_row(Parser& ps, std::vector<Field>& fields, npy_intp r,
         switch (f->type) {
           case F_STRING: {
             PyObject* u = intern.get(s, n);
-            if (u == nullptr) return 2;
+            if (u == nullptr) {
+              if (intern.bad_utf8) {
+                intern.bad_utf8 = false;
+                return 1;  // invalid UTF-8: bad row, same as json.loads
+              }
+              return 2;
+            }
             Py_XDECREF(f->obj[r]);
             f->obj[r] = u;
             f->valid[r] = 1;
@@ -363,9 +380,10 @@ int parse_row(Parser& ps, std::vector<Field>& fields, npy_intp r,
         }
         f->valid[r] = 1;
       } else {
-        // number
+        // number ('+'-prefixed forms are not JSON — json.loads rejects them)
+        if (*ps.p == '+') return 1;
         const char* start = ps.p;
-        if (*ps.p == '-' || *ps.p == '+') ps.p++;
+        if (*ps.p == '-') ps.p++;
         bool is_float = false;
         while (ps.p < ps.end &&
                (std::isdigit((unsigned char)*ps.p) || *ps.p == '.' ||

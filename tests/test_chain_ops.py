@@ -218,6 +218,55 @@ def test_cache_disk_record_survives_until_ack(mock_clock):
     assert len(ns.keys()) == 0  # gone only after confirmed delivery
 
 
+def test_cache_barrier_spill_of_inflight_then_ack_no_duplicate(mock_clock):
+    """A checkpoint that overlaps an unconfirmed in-flight delivery must not
+    produce a duplicate: the barrier spills the in-flight payload to disk,
+    and the LATE ack has to delete that record (and the resend timer must
+    not redeliver it while the original delivery is still outstanding)."""
+    store = kv.get_store()
+    ns = store.kv("t:cache6")
+    c = CacheNode("cache", store_kv=ns, resend_interval_ms=10)
+    sink = Collect()  # acks are driven manually
+    c.outputs.append(sink)
+    c.nack({"i": 1})  # backlog of one
+    mock_clock.advance(10)  # resend: mem in-flight, delivery outstanding
+    assert sink.items == [{"i": 1}]
+    st = c.snapshot_state()  # barrier: spills the in-flight item to disk
+    assert st == {"spilled": 1}
+    assert len(ns.keys()) == 1
+    # delivery still outstanding: resends must hold off, not redeliver
+    for _ in range(3):
+        mock_clock.advance(10)
+    assert sink.items == [{"i": 1}]
+    c.ack({"i": 1})  # the late ack for the pre-barrier delivery
+    assert len(ns.keys()) == 0  # spilled record deleted — no replay
+    for _ in range(3):
+        mock_clock.advance(10)
+    assert sink.items == [{"i": 1}]  # exactly one delivery, no failure → no dup
+    assert c.pending() == 0
+
+
+def test_cache_barrier_spill_of_inflight_then_nack_single_replay(mock_clock):
+    """If the spilled in-flight delivery ultimately FAILS, the disk record is
+    the one retry copy — the nack must not re-enqueue a second copy."""
+    store = kv.get_store()
+    ns = store.kv("t:cache7")
+    c = CacheNode("cache", store_kv=ns, resend_interval_ms=10)
+    sink = Collect()
+    c.outputs.append(sink)
+    c.nack({"i": 2})
+    mock_clock.advance(10)
+    assert sink.items == [{"i": 2}]
+    c.snapshot_state()
+    c.nack({"i": 2})  # delivery failed after the barrier
+    assert c.pending() == 1  # exactly the disk record, not two copies
+    mock_clock.advance(10)  # replay from disk
+    assert sink.items == [{"i": 2}, {"i": 2}]
+    c.ack({"i": 2})
+    assert len(ns.keys()) == 0
+    assert c.pending() == 0
+
+
 def test_cache_resend_delivers_template_strings(mock_clock):
     """Rendered dataTemplate payloads round-trip through nack/resend intact
     (SinkNode treats str as opaque pass-through)."""

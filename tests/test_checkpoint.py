@@ -86,6 +86,26 @@ class TestBarrierTracker:
         assert [c for _, c in acks.acks] == [1, 2]
 
 
+class TestSnapshotFailure:
+    def test_failed_snapshot_skips_ack_forwards_barrier(self):
+        """A snapshot_state exception (e.g. bounded async-emit drain timing
+        out on a wedged device fetch) fails the CHECKPOINT, not the rule:
+        no ack (so it never commits), barrier still forwarded, no raise
+        out of the barrier path (which would kill the worker thread)."""
+        a, b, rec, sink, acks = _fanin_setup()
+        rec.snapshot_state = lambda: (_ for _ in ()).throw(
+            RuntimeError("drain timed out"))
+        rec._dispatch(Barrier(checkpoint_id=3, qos=1), "a")
+        rec._dispatch(Barrier(checkpoint_id=3, qos=1), "b")
+        assert acks.acks == []  # checkpoint 3 never completes
+        assert len([x for x in sink.inq.queue]) == 1  # barrier forwarded
+        # the node is still alive for the next checkpoint
+        del rec.snapshot_state  # restore the class implementation
+        rec._dispatch(Barrier(checkpoint_id=4, qos=1), "a")
+        rec._dispatch(Barrier(checkpoint_id=4, qos=1), "b")
+        assert acks.acks == [("rec", 4)]
+
+
 class TestBarrierAligner:
     def test_exactly_once_holds_back_barriered_edge(self):
         a, b, rec, sink, acks = _fanin_setup()

@@ -88,6 +88,22 @@ class TestAsyncCountEmit:
         node.on_close()
         assert len(got) == 1
 
+    def test_wedged_drain_aborts_snapshot_but_not_close(self):
+        """A stalled device fetch must not let a checkpoint COMMIT without
+        the in-flight emission (offsets would advance past replayable rows):
+        snapshot raises; close logs and proceeds."""
+        import pytest
+        import queue as _q
+
+        node, _ = make_node()
+        node.drain_deadline_s = 0.05
+        node._emit_q = _q.Queue()
+        node._emit_q.put(("wedged",))  # never task_done'd: a stuck fetch
+        with pytest.raises(RuntimeError, match="aborting this checkpoint"):
+            node.snapshot_state()
+        node._drain_async_emits()  # close/EOF path: logs, returns
+        assert node._emit_q.unfinished_tasks == 1  # still owed to the sink
+
 
 class TestHeavyHittersGrow:
     def test_capacity_grow_preserves_sketch(self):

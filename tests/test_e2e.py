@@ -233,3 +233,29 @@ class TestRuleFSM:
         while rs.state != RunState.STOPPED and time.time() < deadline:
             time.sleep(0.01)
         assert rs.state == RunState.STOPPED
+
+
+class TestRuleOptions:
+    def test_duration_options_coerced_and_validated(self):
+        """Rule options accept int ms (reference form: rules/overview.md
+        checkpointInterval int) or Go-style duration strings; bad values
+        fail at plan time with PlanError, not at topo.open."""
+        from ekuiper_tpu.planner.planner import merged_options
+        from ekuiper_tpu.utils.infra import PlanError
+
+        def opts(**o):
+            return merged_options(RuleDef(id="x", sql="", actions=[], options=o))
+
+        assert opts(checkpointInterval=5000).checkpoint_interval_ms == 5000
+        assert opts(checkpointInterval="1s").checkpoint_interval_ms == 1000
+        assert opts(lateTolerance="500ms").late_tolerance_ms == 500
+        assert opts(qos="2").qos == 2
+        with pytest.raises(PlanError, match="checkpointInterval"):
+            opts(checkpointInterval="one second")
+        with pytest.raises(PlanError, match="qos"):
+            opts(qos="high")
+        assert opts(sendError="false").send_error is False
+        assert opts(sendError="true").send_error is True
+        assert opts(sendError=False).send_error is False
+        with pytest.raises(PlanError, match="sendError"):
+            opts(sendError="maybe")

@@ -100,6 +100,33 @@ class TestNativeParity:
         (cols, valid, bad), _ = decode_both(payloads)
         assert cols["deviceId"][0] == s
 
+    def test_invalid_utf8_is_bad_row_like_python(self, native):
+        # json.loads raises on these bytes -> python path drops the row;
+        # the native path must classify them the same (not U+FFFD-replace)
+        payloads = [b'{"deviceId": "ok"}',
+                    b'{"deviceId": "\xff\xfe"}',      # not UTF-8
+                    b'{"deviceId": "\xed\xa0\x80"}']  # raw surrogate bytes OK
+        spec = fastjson.schema_field_spec(SCHEMA)
+        cols, valid, bad = fastjson.decode_columns(payloads, spec)
+        assert not bad[0] and bad[1]
+        assert not bad[2]  # surrogatepass keeps raw-surrogate bytes decodable
+        assert cols["deviceId"][0] == "ok"
+        assert cols["deviceId"][2] == "\ud800"
+
+    def test_lone_surrogate_escape_matches_python(self, native):
+        # valid JSON: json.loads keeps the lone surrogate in the string
+        payloads = [b'{"deviceId": "x\\ud800y"}']
+        (cols, valid, bad), ref = decode_both(payloads)
+        assert not bad.any()
+        assert cols["deviceId"][0] == json.loads(payloads[0])["deviceId"]
+        assert cols["deviceId"][0] == ref.columns["deviceId"][0]
+
+    def test_plus_prefixed_number_is_bad_like_python(self, native):
+        payloads = [b'{"count": +5}', b'{"other": +5}', b'{"count": 5}']
+        (cols, valid, bad), _ = decode_both(payloads)
+        assert bad.tolist() == [True, True, False]
+        assert cols["count"][2] == 5
+
     def test_interning_reuses_objects(self, native):
         payloads = [b'{"deviceId": "dev_1"}'] * 100
         (cols, _, _), _ = decode_both(payloads)
