@@ -262,10 +262,36 @@ struct Interner {
   }
 };
 
+// A string value discovered during the GIL-free parse pass: the row/field
+// it belongs to and a byte span that stays valid until the GIL'd intern
+// pass (either borrowed payload bytes or arena-owned unescaped bytes).
+struct StrRef {
+  npy_intp row;
+  int field;
+  const char* p;
+  size_t n;
+};
+
+// Owns bytes for escaped/converted string values across the two passes.
+// deque keeps element addresses stable under growth.
+struct Arena {
+  std::deque<std::string> items;
+  const char* put(const char* s, size_t n) {
+    items.emplace_back(s, n);
+    return items.back().data();
+  }
+  const char* put(const std::string& s) {
+    items.emplace_back(s);
+    return items.back().data();
+  }
+};
+
 // Parse one object payload into row r of the field buffers.
 // Returns: 0 ok, 1 bad row (cast/shape error), 2 batch fallback.
+// Runs WITHOUT the GIL: string values are recorded as StrRefs (payload
+// spans or arena copies) and materialized in a later GIL'd intern pass.
 int parse_row(Parser& ps, std::vector<Field>& fields, npy_intp r,
-              Interner& intern, std::string& tmp) {
+              std::vector<StrRef>& strs, Arena& arena, std::string& tmp) {
   ps.ws();
   if (ps.p < ps.end && *ps.p == '[')
     return 2;  // array payload: rows-per-payload is the python path's job
@@ -324,16 +350,11 @@ int parse_row(Parser& ps, std::vector<Field>& fields, npy_intp r,
         if (!ps.str_body(&s, &n)) return 1;
         switch (f->type) {
           case F_STRING: {
-            PyObject* u = intern.get(s, n);
-            if (u == nullptr) {
-              if (intern.bad_utf8) {
-                intern.bad_utf8 = false;
-                return 1;  // invalid UTF-8: bad row, same as json.loads
-              }
-              return 2;
-            }
-            Py_XDECREF(f->obj[r]);
-            f->obj[r] = u;
+            // UTF-8 validity is checked at intern time (GIL pass); escaped
+            // content lives in ps.scratch which the next string reuses, so
+            // copy it into the arena now
+            const char* sp = (s == ps.scratch.data()) ? arena.put(s, n) : s;
+            strs.push_back({r, (int)(f - fields.data()), sp, n});
             f->valid[r] = 1;
             break;
           }
@@ -371,10 +392,8 @@ int parse_row(Parser& ps, std::vector<Field>& fields, npy_intp r,
           case F_FLOAT: f->f32[r] = v ? 1.0f : 0.0f; break;  // to_float(bool)
           case F_BIGINT: f->i64[r] = v ? 1 : 0; break;       // to_int(bool)
           case F_STRING: {
-            PyObject* u = intern.get(v ? "true" : "false", v ? 4 : 5);
-            if (u == nullptr) return 2;
-            Py_XDECREF(f->obj[r]);
-            f->obj[r] = u;
+            strs.push_back({r, (int)(f - fields.data()),
+                            v ? "true" : "false", v ? 4u : 5u});
             break;
           }
         }
@@ -443,10 +462,8 @@ int parse_row(Parser& ps, std::vector<Field>& fields, npy_intp r,
                 format_double(v, sv);
               }
             }
-            PyObject* u = intern.get(sv.data(), sv.size());
-            if (u == nullptr) return 2;
-            Py_XDECREF(f->obj[r]);
-            f->obj[r] = u;
+            strs.push_back({r, (int)(f - fields.data()),
+                            arena.put(sv), sv.size()});
             break;
           }
         }
@@ -540,35 +557,79 @@ PyObject* jc_decode(PyObject*, PyObject* args) {
     }
   }
 
-  Interner intern;
-  std::string tmp;
+  // resolve payload buffers under the GIL; the caller owns the list and
+  // must not mutate it during the call (the source's flush list is local).
+  // bytes are immutable so borrowing their buffer across the GIL release
+  // is safe; bytearrays can be resized by another thread (realloc frees
+  // the buffer the parse would read) — copy those now, while we hold it.
+  std::vector<std::pair<const char*, Py_ssize_t>> bufs((size_t)n_rows);
+  Arena payload_copies;
   for (npy_intp r = 0; r < n_rows; r++) {
     PyObject* pl = PyList_GET_ITEM(payloads, r);
-    char* buf;
-    Py_ssize_t blen;
     if (PyBytes_Check(pl)) {
-      buf = PyBytes_AS_STRING(pl);
-      blen = PyBytes_GET_SIZE(pl);
+      bufs[(size_t)r] = {PyBytes_AS_STRING(pl), PyBytes_GET_SIZE(pl)};
     } else if (PyByteArray_Check(pl)) {
-      buf = PyByteArray_AS_STRING(pl);
-      blen = PyByteArray_GET_SIZE(pl);
+      Py_ssize_t bn = PyByteArray_GET_SIZE(pl);
+      bufs[(size_t)r] = {
+          payload_copies.put(PyByteArray_AS_STRING(pl), (size_t)bn), bn};
     } else {
       Py_DECREF(cols); Py_DECREF(valids); Py_DECREF(bad_arr);
       PyErr_SetString(FallbackError, "non-bytes payload");
       return nullptr;
     }
-    Parser ps(buf, buf + blen);
-    int rc = parse_row(ps, fields, r, intern, tmp);
-    if (rc == 2 || (rc != 0 && PyErr_Occurred())) {
-      Py_DECREF(cols); Py_DECREF(valids); Py_DECREF(bad_arr);
-      if (!PyErr_Occurred())
-        PyErr_SetString(FallbackError, "payload needs the python decoder");
-      return nullptr;
+  }
+
+  // pass 1 — parse WITHOUT the GIL: numeric/bool columns fill directly,
+  // string values become StrRefs. This is the bulk of the work and runs
+  // truly parallel to the engine's other Python threads (the fused node
+  // worker, emit workers), which is what lets a byte-fed pipe keep the
+  // device path busy (reference measures bytes-in end-to-end, README.md:98)
+  std::vector<StrRef> strs;
+  strs.reserve((size_t)n_rows);
+  Arena arena;
+  std::string tmp;
+  bool need_fallback = false;
+  Py_BEGIN_ALLOW_THREADS
+  for (npy_intp r = 0; r < n_rows; r++) {
+    Parser ps(bufs[(size_t)r].first,
+              bufs[(size_t)r].first + bufs[(size_t)r].second);
+    int rc = parse_row(ps, fields, r, strs, arena, tmp);
+    if (rc == 2) {
+      need_fallback = true;
+      break;
     }
     if (rc == 1) {
       bad[r] = 1;
       for (auto& f : fields) f.valid[r] = 0;
     }
+  }
+  Py_END_ALLOW_THREADS
+  if (need_fallback) {
+    Py_DECREF(cols); Py_DECREF(valids); Py_DECREF(bad_arr);
+    PyErr_SetString(FallbackError, "payload needs the python decoder");
+    return nullptr;
+  }
+
+  // pass 2 — intern string values under the GIL: hash + incref per value
+  // (hit path), PyUnicode decode only for novel strings. Invalid UTF-8
+  // marks the row bad (json.loads parity), never a batch fallback.
+  Interner intern;
+  for (const StrRef& sr : strs) {
+    if (bad[sr.row]) continue;  // a later field already failed this row
+    PyObject* u = intern.get(sr.p, sr.n);
+    if (u == nullptr) {
+      if (intern.bad_utf8) {
+        intern.bad_utf8 = false;
+        bad[sr.row] = 1;
+        for (auto& f : fields) f.valid[sr.row] = 0;
+        continue;
+      }
+      Py_DECREF(cols); Py_DECREF(valids); Py_DECREF(bad_arr);
+      return nullptr;  // real error (e.g. MemoryError) already set
+    }
+    Field& f = fields[(size_t)sr.field];
+    Py_XDECREF(f.obj[sr.row]);
+    f.obj[sr.row] = u;
   }
   PyObject* out = PyTuple_Pack(3, cols, valids, bad_arr);
   Py_DECREF(cols);

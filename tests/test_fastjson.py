@@ -127,6 +127,17 @@ class TestNativeParity:
         assert bad.tolist() == [True, True, False]
         assert cols["count"][2] == 5
 
+    def test_bytearray_payloads_are_copied_safely(self, native):
+        # bytearrays can be resized by another thread while the GIL-free
+        # parse runs; the decoder must copy them at prefetch time
+        payloads = [bytearray(b'{"deviceId": "ba", "temperature": 1.5}'),
+                    b'{"deviceId": "b2", "temperature": 2.5}']
+        spec = fastjson.schema_field_spec(SCHEMA)
+        cols, valid, bad = fastjson.decode_columns(payloads, spec)
+        assert not bad.any()
+        assert cols["deviceId"].tolist() == ["ba", "b2"]
+        assert cols["temperature"][0] == pytest.approx(1.5)
+
     def test_interning_reuses_objects(self, native):
         payloads = [b'{"deviceId": "dev_1"}'] * 100
         (cols, _, _), _ = decode_both(payloads)
@@ -180,6 +191,29 @@ class TestSourceFastPath:
         assert cb.n == 10
         assert cb.columns["deviceId"][3] == "d0"
         assert cb.columns["count"].dtype == np.int64
+
+    def test_aligned_flush_keeps_remainder_until_linger(self, native,
+                                                        mock_clock):
+        """An over-threshold raw drain flushes micro_batch-aligned slices
+        (the fused kernel pads every chunk to a static micro-batch shape,
+        so misaligned tails would upload ~2x the bytes) and the linger
+        timer drains the remainder without losing rows."""
+        src = SourceNode(
+            "s", connector=type("C", (), {
+                "open": lambda self, cb: None,
+                "close": lambda self: None})(),
+            schema=SCHEMA, converter=JsonConverter(),
+            micro_batch_rows=8, linger_ms=20)
+        got = []
+        src.broadcast = lambda item: got.append(item)
+        drain = [json.dumps({"deviceId": f"d{i}", "count": i}).encode()
+                 for i in range(23)]
+        src.ingest(drain)
+        assert [b.n for b in got] == [16]  # aligned cut, remainder pending
+        mock_clock.advance(20)
+        assert [b.n for b in got] == [16, 7]
+        ids = [d for b in got for d in b.columns["deviceId"].tolist()]
+        assert ids == [f"d{i}" for i in range(23)]  # order, no loss
 
     def test_bad_rows_dropped_and_counted(self, native):
         src, got = self.make_source()

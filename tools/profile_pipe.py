@@ -1,0 +1,228 @@
+"""Stage-by-stage profile of the full-pipe ingest path on the real chip.
+
+Measures, in one process (like _full_pipe_main):
+  A. native decode_columns alone (bytes -> columns)
+  B. KeyTable.encode_column alone (object strings -> slots)
+  C. fused node consumption alone (prebuilt ColumnBatches, same shapes the
+     source emits) -- the single-thread ceiling
+  D. the real topo pipe (source thread + fused worker), with per-stage
+     counters sampled from the nodes
+
+Run: python tools/profile_pipe.py
+"""
+import json as _json
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+N_DEVICES = 10_000
+DRAIN_ROWS = 3072
+
+
+def make_drains(n=12):
+    rng = np.random.default_rng(23)
+    drains = []
+    for _ in range(n):
+        drains.append([
+            _json.dumps({
+                "deviceId": f"dev_{rng.integers(0, N_DEVICES)}",
+                "temperature": round(float(rng.normal(20, 5)), 2),
+            }).encode()
+            for _ in range(DRAIN_ROWS)
+        ])
+    return drains
+
+
+def stage_a_decode(drains):
+    from ekuiper_tpu.data.types import DataType, Field, Schema
+    from ekuiper_tpu.io import fastjson
+
+    fastjson.ensure_native(background=False)
+    schema = Schema(fields=[Field("deviceId", DataType.STRING),
+                            Field("temperature", DataType.FLOAT)])
+    spec = fastjson.schema_field_spec(schema)
+    # warm
+    fastjson.decode_columns(drains[0], spec)
+    t0 = time.time()
+    rows = 0
+    n = 0
+    while time.time() - t0 < 3.0:
+        out = fastjson.decode_columns(drains[n % len(drains)], spec)
+        assert out is not None
+        rows += DRAIN_ROWS
+        n += 1
+    dt = time.time() - t0
+    print(f"A decode_columns: {rows/dt:,.0f} rows/s ({dt/ n*1e3:.2f} ms/drain)")
+    return out
+
+
+def stage_b_keytable(drains):
+    from ekuiper_tpu.data.types import DataType, Field, Schema
+    from ekuiper_tpu.io import fastjson
+    from ekuiper_tpu.ops.keytable import KeyTable
+
+    schema = Schema(fields=[Field("deviceId", DataType.STRING),
+                            Field("temperature", DataType.FLOAT)])
+    spec = fastjson.schema_field_spec(schema)
+    cols, _, _ = fastjson.decode_columns(drains[0], spec)
+    kt = KeyTable(16384)
+    kt.encode_column(cols["deviceId"])  # warm: inserts
+    t0 = time.time()
+    rows = 0
+    while time.time() - t0 < 2.0:
+        kt.encode_column(cols["deviceId"])
+        rows += DRAIN_ROWS
+    dt = time.time() - t0
+    print(f"B keytable encode: {rows/dt:,.0f} rows/s")
+
+
+def make_batches(drains, batch_rows):
+    """Build the ColumnBatches the source WOULD emit at a given flush size."""
+    from ekuiper_tpu.data.batch import ColumnBatch
+    from ekuiper_tpu.data.types import DataType, Field, Schema
+    from ekuiper_tpu.io import fastjson
+
+    schema = Schema(fields=[Field("deviceId", DataType.STRING),
+                            Field("temperature", DataType.FLOAT)])
+    spec = fastjson.schema_field_spec(schema)
+    flat = [p for d in drains for p in d]
+    batches = []
+    for i in range(0, len(flat) - batch_rows + 1, batch_rows):
+        chunk = flat[i:i + batch_rows]
+        cols, valid, bad = fastjson.decode_columns(chunk, spec)
+        ts = np.full(batch_rows, 1000, dtype=np.int64)
+        batches.append(ColumnBatch(
+            n=batch_rows, columns=cols, valid={},
+            timestamps=ts, emitter="pipe"))
+    return batches
+
+
+def stage_c_fused(drains, batch_rows, seconds=8.0):
+    from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+    from ekuiper_tpu.ops.emit import build_direct_emit
+    from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+    from ekuiper_tpu.sql.parser import parse_select
+    import jax
+
+    stmt = parse_select(
+        "SELECT deviceId, avg(temperature) AS a, count(*) AS c "
+        "FROM pipe GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+    plan = extract_kernel_plan(stmt)
+    node = FusedWindowAggNode(
+        "f", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+        capacity=16384, micro_batch=max(batch_rows, 512),
+        direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+        emit_columnar=True)
+    node.state = node.gb.init_state()
+    node.broadcast = lambda item: None
+    batches = make_batches(drains, batch_rows)
+    node.process(batches[0])  # warm compile
+    jax.block_until_ready(node.state)
+    t0 = time.time()
+    rows = 0
+    n = 0
+    t_sub = {}
+    while time.time() - t0 < seconds:
+        node.process(batches[n % len(batches)])
+        rows += batch_rows
+        n += 1
+        if n % 16 == 0:
+            jax.block_until_ready(node.state)
+    jax.block_until_ready(node.state)
+    dt = time.time() - t0
+    print(f"C fused consume (batch={batch_rows}): {rows/dt:,.0f} rows/s "
+          f"({dt/n*1e3:.1f} ms/batch)")
+
+
+def stage_d_topo(flush_rows, linger_ms, seconds=10.0):
+    from ekuiper_tpu.io import memory as mem
+    from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+    from ekuiper_tpu.server.processors import StreamProcessor
+    from ekuiper_tpu.store import kv
+    from ekuiper_tpu.io import fastjson
+
+    mem.reset()
+    fastjson.ensure_native(background=False)
+    store = kv.get_store()
+    try:
+        StreamProcessor(store).exec_stmt(
+            'CREATE STREAM pipe (deviceId STRING, temperature FLOAT) '
+            'WITH (DATASOURCE="topic/pipe", TYPE="memory", FORMAT="JSON")')
+    except Exception:
+        pass
+    rule = RuleDef(
+        id="pipe1", sql=(
+            "SELECT deviceId, avg(temperature) AS a, count(*) AS c "
+            "FROM pipe GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"),
+        actions=[{"nop": {}}],
+        options={"bufferLength": 64, "micro_batch_rows": flush_rows,
+                 "micro_batch_linger_ms": linger_ms, "key_slots": 16384})
+    topo = plan_rule(rule, store)
+    fused = next(n for n in topo.ops
+                 if type(n).__name__ == "FusedWindowAggNode")
+    topo.open()
+    src = (topo.sources[0] if topo.sources
+           else topo._live_shared[0][0].source)
+    drains = make_drains()
+    try:
+        deadline = time.time() + 600
+        for _ in range(2):  # real warm: inline flush + full key coverage
+            for d in drains:
+                src.ingest(d)
+            while time.time() < deadline and not topo.wait_idle(5.0):
+                pass
+        batch_sizes = []
+        orig_process = fused.process
+        t_proc = [0.0]
+
+        def timed_process(item):
+            t = time.time()
+            orig_process(item)
+            t_proc[0] += time.time() - t
+            if hasattr(item, "n"):
+                batch_sizes.append(item.n)
+        fused.process = timed_process
+        t_flush = [0.0]
+        orig_flush = src._flush_raw
+
+        def timed_flush(raws, rtss):
+            t = time.time()
+            orig_flush(raws, rtss)
+            t_flush[0] += time.time() - t
+        src._flush_raw = timed_flush
+
+        rows = 0
+        n = 0
+        t0 = time.time()
+        while time.time() - t0 < seconds:
+            src.ingest(drains[n % len(drains)])
+            rows += DRAIN_ROWS
+            n += 1
+            while fused.inq.qsize() > 8:
+                time.sleep(0.002)
+        topo.wait_idle(timeout=30.0)
+        dt = time.time() - t0
+        bs = np.array(batch_sizes) if batch_sizes else np.array([0])
+        print(f"D topo pipe (flush={flush_rows}, linger={linger_ms}): "
+              f"{rows/dt:,.0f} rows/s | fused.process busy {t_proc[0]:.1f}s "
+              f"({100*t_proc[0]/dt:.0f}%), src._flush_raw busy "
+              f"{t_flush[0]:.1f}s ({100*t_flush[0]/dt:.0f}%) | "
+              f"batches n={len(batch_sizes)} "
+              f"size p50={np.percentile(bs,50):,.0f} "
+              f"p90={np.percentile(bs,90):,.0f} max={bs.max():,}")
+    finally:
+        topo.close()
+        mem.reset()
+
+
+if __name__ == "__main__":
+    drains = make_drains()
+    stage_a_decode(drains)
+    stage_b_keytable(drains)
+    stage_c_fused(drains, 32768)
+    stage_c_fused(drains, 8192)
+    stage_d_topo(32768, 50)
