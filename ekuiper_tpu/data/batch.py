@@ -17,6 +17,8 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+import threading as _threading
+
 from .rows import Tuple
 from .types import DataType, Schema, np_dtype
 
@@ -36,6 +38,44 @@ class ColumnBatch:
     valid: Dict[str, np.ndarray] = field(default_factory=dict)
     timestamps: Optional[np.ndarray] = None
     emitter: str = ""
+    # shared-source fan-out: N consumers of the SAME batch share one key
+    # encode and one device upload per column (see runtime/subtopo.py
+    # SharedPrepCtx). `share()` memoizes per-batch; pruned copies made by
+    # SharedEntryNode carry these references so all riders hit one cache.
+    shared_ctx: Any = None
+    share_state: Optional[Dict[Any, Any]] = None
+
+    # unannotated -> a plain class attribute, not a dataclass field
+    _SHARE_INIT_LOCK = _threading.Lock()
+
+    def ensure_share_state(self) -> Dict[Any, Any]:
+        state = self.share_state
+        if state is None:
+            with ColumnBatch._SHARE_INIT_LOCK:
+                state = self.share_state
+                if state is None:
+                    state = self.share_state = {
+                        "__lock__": _threading.RLock()}
+        return state
+
+    def __getstate__(self) -> dict:
+        # the share cache (lock + device arrays) and subtopo ctx are
+        # per-process ephemera — drop them so batches stay picklable
+        # (sink-cache disk spill pickles parked items)
+        state = self.__dict__.copy()
+        state["shared_ctx"] = None
+        state["share_state"] = None
+        return state
+
+    def share(self, key: Any, factory) -> Any:
+        """Memoize `factory()` under `key` for every consumer of this batch
+        (and its pruned copies). First caller computes; the per-batch lock
+        makes concurrent consumers wait instead of duplicating the work."""
+        state = self.ensure_share_state()
+        with state["__lock__"]:
+            if key not in state:
+                state[key] = factory()
+            return state[key]
 
     def __len__(self) -> int:
         return self.n

@@ -20,6 +20,11 @@ class LogSink(Sink):
 
 
 class NopSink(Sink):
+    # columnar results may be collected as-is: converting a wide window
+    # emission to per-row dicts just to discard it costs seconds of GIL at
+    # high-fan-out boundaries (ref: plugins/sinks/nop discards likewise)
+    accepts_batches = True
+
     def __init__(self) -> None:
         self.log = False
 

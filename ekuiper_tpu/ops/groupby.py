@@ -122,6 +122,10 @@ class DeviceGroupBy:
     #: the latency-hiding emit pipeline (ops/prefinalize.py) works here;
     #: the sharded subclass opts out (its finalize runs collective gathers)
     supports_prefinalize = True
+    #: fold() accepts pre-padded device arrays (shared-source fan-out
+    #: uploads); the sharded subclass opts out — its fold shards HOST
+    #: arrays across the mesh itself
+    accepts_device_inputs = True
 
     # ------------------------------------------------------------------ state
     def init_state(self) -> Dict[str, Any]:
@@ -168,6 +172,7 @@ class DeviceGroupBy:
         slots: np.ndarray,
         valid: Optional[Dict[str, np.ndarray]] = None,
         pane_idx=0,
+        n_rows: Optional[int] = None,
     ) -> Dict[str, Any]:
         """Fold a host micro-batch into the device partials.
 
@@ -178,14 +183,24 @@ class DeviceGroupBy:
         bucket's pane). Rows are chunked/padded to the static micro_batch
         size.
         """
+        import jax
         import jax.numpy as jnp
 
         from .aggspec import materialize_hll_columns
 
-        n = len(slots)
+        # pre-padded device slots are length mb regardless of real rows, so
+        # the true count must come from the caller in that case
+        n = n_rows if n_rows is not None else len(slots)
         mb = self.micro_batch
         valid = valid or {}
         cols = materialize_hll_columns(self.plan.columns, cols, n)
+        # shared-source fan-out hands PRE-PADDED device arrays (length mb,
+        # one upload serving many consumers — nodes_fused.py
+        # _shared_device_inputs). Those are single-chunk by contract.
+        has_dev = isinstance(slots, jax.Array) or any(
+            isinstance(cols[name], jax.Array) for name in self.plan.columns)
+        if has_dev:
+            assert n <= mb, "pre-uploaded device inputs must be one chunk"
         for start in range(0, max(n, 1), mb):
             end = min(start + mb, n)
             cnt = end - start
@@ -195,6 +210,10 @@ class DeviceGroupBy:
             dev_cols = {}
             for name in self.plan.columns:
                 c = cols[name]
+                if isinstance(c, jax.Array):  # pre-padded shared upload
+                    dev_cols[name] = c
+                    dev_cols["__valid_" + name] = valid.get(name)
+                    continue
                 arr = np.asarray(c[start:end], dtype=np.float32)
                 if pad:
                     arr = np.pad(arr, (0, pad))
@@ -209,16 +228,20 @@ class DeviceGroupBy:
                 dev_cols["__valid_" + name] = (
                     jnp.asarray(vm) if vm is not None else None
                 )
-            s = slots[start:end]
-            if pad:
-                s = np.pad(s, (0, pad))
-            # tunnel-byte diet: slots ship as uint16 when capacity allows
-            # (halves the largest upload), and row validity ships as ONE
-            # scalar count compared against an iota on device instead of an
-            # mb-byte bool mask — HBM/link bandwidth is the bottleneck, not
-            # device compute
-            if self.capacity <= 65535:
-                s = s.astype(np.uint16)
+            if isinstance(slots, jax.Array):
+                s_dev = slots  # pre-padded + dtype-chosen by the sharer
+            else:
+                s = slots[start:end]
+                if pad:
+                    s = np.pad(s, (0, pad))
+                # tunnel-byte diet: slots ship as uint16 when capacity
+                # allows (halves the largest upload), and row validity
+                # ships as ONE scalar count compared against an iota on
+                # device instead of an mb-byte bool mask — HBM/link
+                # bandwidth is the bottleneck, not device compute
+                if self.capacity <= 65535:
+                    s = s.astype(np.uint16)
+                s_dev = jnp.asarray(s)
             if isinstance(pane_idx, np.ndarray):
                 pv = pane_idx[start:end]
                 if pad:
@@ -227,7 +250,7 @@ class DeviceGroupBy:
             else:
                 pane_arg = jnp.asarray(pane_idx, dtype=jnp.int32)
             state = self._fold(
-                state, dev_cols, jnp.asarray(s),
+                state, dev_cols, s_dev,
                 jnp.asarray(cnt, dtype=jnp.int32),
                 pane_arg,
             )

@@ -170,6 +170,12 @@ class KeyTable:
     def decode_all(self) -> List[Any]:
         return list(self._keys)
 
+    def keys_slice(self, start: int, end: int) -> List[Any]:
+        """Keys for slots [start, end) in insertion order — slot ids are
+        dense and insertion-ordered, so a second table fed exactly these
+        keys (in order) assigns identical ids (shared-source slot reuse)."""
+        return self._keys[start:end]
+
     def clear(self) -> None:
         self._ids.clear()
         self._keys.clear()

@@ -206,18 +206,39 @@ class BatchedGroupBy(DeviceGroupBy):
             lambda st: DeviceGroupBy._finalize_impl(self, st, pane_mask_tuple)
         )(state)
 
+    def _slice_keys(self, n_keys: int) -> int:
+        """Device-side transfer cut: round the live-key count up to a power
+        of two (floor 1024) so the (R, S+1, K) result ships K≈n_keys floats
+        instead of full capacity — at R=63 rules the full-capacity transfer
+        is 4x the bytes for a quarter-full table — while the rounded shape
+        set stays bounded (one slice executable per power of two)."""
+        if n_keys >= self.capacity:
+            return self.capacity
+        k = 1024
+        while k < n_keys:
+            k <<= 1
+        return min(k, self.capacity)
+
+    def finalize_begin(self, state: Dict[str, Any], n_keys: int,
+                       panes: Optional[List[int]] = None):
+        """Dispatch the stacked finalize and return the (R, S+1, K) DEVICE
+        array (K = rounded n_keys) — the async boundary path hands this to
+        the emit worker, which fetches and slices host-side."""
+        pane_mask = np.zeros(self.n_panes, dtype=np.bool_)
+        if panes is None:
+            pane_mask[:] = True
+        else:
+            pane_mask[panes] = True
+        dev = self._finalize(state, tuple(pane_mask.tolist()))
+        return dev[:, :, : self._slice_keys(n_keys)]
+
     def finalize(
         self, state: Dict[str, Any], n_keys: int,
         panes: Optional[List[int]] = None,
     ) -> Tuple[List[np.ndarray], np.ndarray]:
         """Per-spec value arrays of shape (R, n_keys) + act (R, n_keys) —
         ONE device launch, ONE transfer for the whole rule group."""
-        pane_mask = np.zeros(self.n_panes, dtype=np.bool_)
-        if panes is None:
-            pane_mask[:] = True
-        else:
-            pane_mask[panes] = True
-        stacked = np.asarray(self._finalize(state, tuple(pane_mask.tolist())))
+        stacked = np.asarray(self.finalize_begin(state, n_keys, panes))
         outs = [stacked[:, i, :n_keys] for i in range(len(self.plan.specs))]
         act = stacked[:, -1, :n_keys]
         outs = apply_int_semantics(self.plan.specs, outs)

@@ -48,6 +48,7 @@ class ShardedGroupBy(DeviceGroupBy):
     # finalize runs collective gathers across the mesh; the pre-issued
     # emit pipeline (ops/prefinalize.py) is single-chip only for now
     supports_prefinalize = False
+    accepts_device_inputs = False  # fold shards host arrays over the mesh
 
     def __init__(
         self, plan: KernelPlan, mesh, capacity: int = 16384,

@@ -233,6 +233,9 @@ class FusedWindowAggNode(Node):
         self.gb = self._make_gb(plan, capacity, micro_batch, mesh)
         # sharded path may round capacity up for even shard division
         self.kt = KeyTable(self.gb.capacity)
+        # shared-source fan-out slot reuse: None = undecided, True = our kt
+        # mirrors the subtopo's neutral table, False = self-encode forever
+        self._shared_slots_ok = None
         self.state = None
         self.cur_pane = 0
         self._timer = None
@@ -323,6 +326,22 @@ class FusedWindowAggNode(Node):
             and not is_event_time
             and self.gb.supports_prefinalize
             and prefinalize_lead_ms > 0
+        )
+        # vmapped rule-group boundaries (MultiRuleFusedNode) also emit
+        # asynchronously: one (R, S+1, keys) transfer per family is MBs,
+        # and a sync fetch at the boundary stalls every rider's fold stream
+        self._async_mr = False  # set by MultiRuleFusedNode
+        # deferred boundary emission: when no pre-issue has landed at a
+        # tumbling/hopping boundary (and no host backstop can serve), the
+        # merge wait moves to the emit worker instead of stalling folds —
+        # crucial for wide sketch finalizes (hll components are KBs/key)
+        # on hopping windows, which have no backstop
+        self._emit_late_async = (
+            self.wt in (ast.WindowType.TUMBLING_WINDOW,
+                        ast.WindowType.HOPPING_WINDOW)
+            and not is_event_time
+            and self.gb.supports_prefinalize
+            and not self._hh_cols
         )
         self._emit_q = None
         self._emit_worker = None
@@ -477,6 +496,103 @@ class FusedWindowAggNode(Node):
             return self._fold_sliding(sub)
         return self._fold_rows(sub, self.cur_pane)
 
+    def _shared_encode(self, sub: ColumnBatch,
+                       frozen: bool) -> Optional[np.ndarray]:
+        """Shared-source fan-out: reuse the subtopo's one-per-batch key
+        encode (subtopo.py SharedPrepCtx) instead of re-encoding per rule.
+        The neutral table's slot ids are dense insertion-ordered, so
+        feeding our own table the same key sequence (keys_slice of the
+        new tail) yields identical ids — our table stays self-contained
+        for emit decode and checkpoints. Returns None (caller self-encodes)
+        when no shared ctx rides the batch or our table diverged (e.g.
+        restored from a checkpoint predating the shared pipeline)."""
+        ctx = getattr(sub, "shared_ctx", None)
+        if ctx is None or self._shared_slots_ok is False:
+            return None
+        key_name = getattr(self.dims[0], "name", None)
+        if not key_name:
+            self._shared_slots_ok = False
+            return None
+        try:
+            slots, n_keys, nkt = ctx.encode(sub, key_name)
+        except Exception as exc:
+            logger.debug("%s: shared key encode failed (%s) — self-encoding",
+                         self.name, exc)
+            self._shared_slots_ok = False
+            return None
+        if self._shared_slots_ok is None:  # one-time compatibility check
+            self._shared_slots_ok = self.kt.n_keys == 0 or (
+                self.kt.decode_all() == nkt.keys_slice(0, self.kt.n_keys))
+            if not self._shared_slots_ok:
+                return None
+        if self.kt.n_keys < n_keys:
+            new = np.array(nkt.keys_slice(self.kt.n_keys, n_keys),
+                           dtype=np.object_)
+            _, grew = self.kt.encode_column(new)
+            if grew and not frozen:
+                self.state = self.gb.grow(self.state, self.kt.capacity)
+        if self.kt.n_keys != n_keys:
+            self._shared_slots_ok = False  # diverged: self-encode from now on
+            return None
+        return slots
+
+    def _shared_device_inputs(self, sub: ColumnBatch, cols, valid, slots):
+        """One device upload per column/slot vector for ALL fan-out
+        consumers of this batch: pad to the static micro-batch shape once,
+        device_put once, and let every rider fold from the same HBM
+        buffers. Only plain numeric columns share (hll/hh derivations are
+        node-specific); only single-chunk batches qualify (n <= micro_batch
+        — guaranteed by micro-batch-aligned source flushes). Returns
+        (dev_cols, dev_valid, dev_slots|None) or None."""
+        ctx = getattr(sub, "shared_ctx", None)
+        mb = self.gb.micro_batch
+        if ctx is None or sub.n > mb or \
+                not getattr(self.gb, "accepts_device_inputs", False):
+            return None
+        import jax.numpy as jnp
+
+        dcols: Dict[str, Any] = {}
+        dvalid: Dict[str, Any] = {}
+        for name in self.plan.columns:
+            if name.startswith(HLL_COL_PREFIX) or \
+                    name.startswith(HH_COL_PREFIX):
+                continue
+            src_col = sub.columns.get(name)
+            if src_col is None or src_col.dtype == np.object_:
+                continue
+            host, vm = cols[name], valid.get(name)
+
+            def fac(host=host, vm=vm):
+                arr = np.asarray(host, dtype=np.float32)
+                if len(arr) < mb:
+                    arr = np.pad(arr, (0, mb - len(arr)))
+                dm = None
+                if vm is not None:
+                    m = vm if len(vm) == mb else np.pad(vm, (0, mb - len(vm)))
+                    dm = jnp.asarray(m)
+                return jnp.asarray(arr), dm
+
+            dv, dm = sub.share(("dcol", name, mb), fac)
+            dcols[name] = dv
+            if dm is not None:
+                dvalid[name] = dm
+        dslots = None
+        if slots is not None and self._shared_slots_ok and \
+                len(self.dims) == 1:
+            u16 = self.kt.capacity <= 65535
+
+            def sfac(slots=slots):
+                s = slots
+                if len(s) < mb:
+                    s = np.pad(s, (0, mb - len(s)))
+                return jnp.asarray(s.astype(np.uint16 if u16 else np.int32))
+
+            dslots = sub.share(
+                ("dslots", self.dims[0].name, mb, u16), sfac)
+        if not dcols and dslots is None:
+            return None
+        return dcols, dvalid, dslots
+
     def _build_kernel_inputs(self, sub: ColumnBatch, frozen: bool = False):
         """Encode group keys + materialize the kernel's numeric columns and
         validity masks for `sub`. Returns (cols, valid, slots)."""
@@ -487,9 +603,12 @@ class FusedWindowAggNode(Node):
                 col = np.full(sub.n, None, dtype=np.object_)
             key_cols.append(col)
         if key_cols:
-            slots, grew = self.kt.encode_multi(key_cols)
-            if grew and not frozen:
-                self.state = self.gb.grow(self.state, self.kt.capacity)
+            slots = (self._shared_encode(sub, frozen)
+                     if len(self.dims) == 1 else None)
+            if slots is None:
+                slots, grew = self.kt.encode_multi(key_cols)
+                if grew and not frozen:
+                    self.state = self.gb.grow(self.state, self.kt.capacity)
         else:
             slots = np.zeros(sub.n, dtype=np.int32)
             if self.kt.n_keys == 0:
@@ -567,8 +686,18 @@ class FusedWindowAggNode(Node):
             if self.gb.capacity < self.kt.capacity:
                 # deferred grow (keys first seen in an earlier frozen span)
                 self.state = self.gb.grow(self.state, self.kt.capacity)
-            self.state = self.gb.fold(self.state, cols, slots, valid,
-                                      pane_arg)
+            dev = self._shared_device_inputs(sub, cols, valid, slots)
+            if dev is not None:
+                # shared uploads: device columns/slots computed once serve
+                # every fan-out consumer; host copies still feed the shadows
+                dcols, dvalid, dslots = dev
+                self.state = self.gb.fold(
+                    self.state, {**cols, **dcols},
+                    dslots if dslots is not None else slots,
+                    {**valid, **dvalid}, pane_arg, n_rows=sub.n)
+            else:
+                self.state = self.gb.fold(self.state, cols, slots, valid,
+                                          pane_arg)
         # every live shadow mirrors the fold (dedup: frozen-span retries and
         # the backstop may share shadow objects)
         seen = set()
@@ -884,7 +1013,20 @@ class FusedWindowAggNode(Node):
                 break
             kind, stacked_dev, n_keys, wr, t_issue = item
             try:
+                if kind == "pf":
+                    pipeline, frozen, backup = stacked_dev
+                    self._deliver_pf(pipeline, frozen, backup, n_keys, wr,
+                                     t_issue)
+                    continue
                 arr = np.asarray(stacked_dev)
+                if kind == "mr":
+                    self._deliver_mr(arr, n_keys, wr)
+                    self.last_emit_info = {
+                        "source": "device-async",
+                        "fetch_ms": (_time.time() - t_issue) * 1000.0,
+                        "ages_ms": [],
+                    }
+                    continue
                 if kind == "hh":
                     outs, act = self.gb.hh_assemble(arr, n_keys)
                 else:
@@ -1168,8 +1310,10 @@ class FusedWindowAggNode(Node):
         wr = WindowRange(end - self.length_ms, end)
         if self._async_hh:
             self._emit_hh_async(wr)
+        elif self._async_mr:
+            self._emit_mr_async(wr)
         else:
-            self._emit(wr)
+            self._boundary_emit(wr)
         if self.wt == ast.WindowType.TUMBLING_WINDOW:
             self.state = self.gb.reset_pane(self.state, 0)
         else:
@@ -1236,6 +1380,99 @@ class FusedWindowAggNode(Node):
         self.broadcast(eof)
 
     # ------------------------------------------------------------------- emit
+    def _boundary_emit(self, wr: WindowRange) -> None:
+        """Window-boundary emission that never blocks the fold stream.
+
+        If some pre-issue is ready (a landed device fetch, or the tumbling
+        host backstop), emit synchronously — the fast path, identical to
+        before. Otherwise the merge would WAIT on an un-landed fetch (a
+        wide sketch finalize is tens of MB; on a slow link that stalls
+        ingest for seconds — the reference's window trigger emits inline
+        and has the same stall, window_op.go:235), so hand the wait to the
+        emit worker and keep folding: the pre-issue snapshot is immutable,
+        and the boundary's pane reset cannot disturb it. A worker backlog
+        also defers, so windows always deliver in order."""
+        if not self._emit_late_async:
+            return self._emit(wr)
+        q = self._emit_q
+        backlog = q is not None and q.unfinished_tasks > 0
+        ready_any = any(p.ready() for p, _ in self._pipeline)
+        if not backlog and (ready_any or not self.kt.n_keys):
+            return self._emit(wr)
+        import time as _time
+
+        n_keys = self.kt.n_keys
+        pipeline, self._pipeline = self._pipeline, []
+        frozen, self._device_frozen = self._device_frozen, False
+        self._ensure_emit_worker()
+        if pipeline:
+            # backup finalize dispatched NOW, before on_trigger's
+            # reset_pane donates the state buffers: if the deferred merge
+            # later fails (wedged fetch), the worker recovers from this
+            # snapshot — a device launch whose transfer happens only on
+            # that fallback
+            backup = self.gb._finalize(self.state, (True,) * self.gb.n_panes)
+            self._emit_q.put(("pf", (pipeline, frozen, backup), n_keys, wr,
+                              _time.time()))
+        else:
+            # no pre-issue in flight: dispatch the finalize on the
+            # immutable state and let the worker fetch + deliver
+            self._emit_async(
+                "count",
+                self.gb._finalize(self.state, (True,) * self.gb.n_panes),
+                wr)
+
+    def _deliver_pf(self, pipeline, frozen, backup, n_keys: int,
+                    wr: WindowRange, t_issue: float) -> None:
+        """Emit-worker delivery of a deferred boundary: wait for the best
+        pre-issue to land, merge, emit. Runs off the fold thread; touches
+        only the immutable pre-issue snapshots and the closed window's
+        shadow, never self.state. `backup` is a full finalize dispatched
+        on the pre-reset snapshot — the recovery path when the merge
+        fails, mirroring the sync path's finalize fallback."""
+        import time as _time
+
+        from ..ops.groupby import apply_int_semantics
+        from ..ops.prefinalize import IdentityFinalize
+
+        real = [e for e in pipeline if not isinstance(e[0], IdentityFinalize)]
+        chosen = next(
+            ((p, s) for p, s in reversed(real) if p.ready()), None,
+        ) or (real[0] if real else pipeline[0])
+        try:
+            outs, act = self.gb.prefinalize_merge(chosen[0], chosen[1], n_keys)
+        except Exception as exc:
+            logger.warning("%s: deferred boundary merge failed (%s) — "
+                           "recovering from the backup finalize", self.name,
+                           exc)
+            try:
+                arr = np.asarray(backup)
+                outs = [arr[i][:n_keys]
+                        for i in range(len(self.plan.specs))]
+                outs = apply_int_semantics(self.plan.specs, outs)
+                act = np.asarray(arr[-1][:n_keys])
+            except Exception as exc2:
+                logger.error(
+                    "%s: backup finalize also failed (%s) — window [%s, %s) "
+                    "lost to the sink", self.name, exc2, wr.window_start,
+                    wr.window_end)
+                self.stats.inc_exception(f"deferred emit failed: {exc2}")
+                return
+        self.last_emit_info = {
+            "source": "device-async-late",
+            "fetch_ms": (chosen[0].fetch_ms()
+                         if hasattr(chosen[0], "fetch_ms")
+                         else (_time.time() - t_issue) * 1000.0),
+            "ages_ms": [],
+        }
+        active = np.nonzero(act > 0)[0]
+        if len(active) == 0:
+            return
+        if self.direct_emit is not None:
+            self._emit_direct(outs, active, wr)
+        else:
+            self._emit_grouped(outs, active, wr)
+
     def _emit(self, wr: WindowRange) -> None:
         pipeline, self._pipeline = self._pipeline, []
         frozen, self._device_frozen = self._device_frozen, False

@@ -35,6 +35,10 @@ class MultiRuleFusedNode(FusedWindowAggNode):
         self.spec = spec  # before super().__init__: _make_gb reads it
         super().__init__(name, window, spec.plan, dims, capacity=capacity,
                          micro_batch=micro_batch, **kw)
+        # boundary emits go through the async worker: one stacked (R,S+1,K)
+        # transfer per family is MBs and must not stall the fold stream
+        self._async_mr = (self.wt == ast.WindowType.TUMBLING_WINDOW
+                          and not self.is_event_time)
         #: rule_id -> downstream entry node (per-rule sink chain); also
         #: connect()-ed so control events (EOF, errors) broadcast to all
         self.rule_outputs: Dict[str, Node] = {}
@@ -50,12 +54,40 @@ class MultiRuleFusedNode(FusedWindowAggNode):
 
     # ------------------------------------------------------------------- emit
     def _emit(self, wr: WindowRange) -> None:
+        """Synchronous family emit (EOF flush / non-boundary paths)."""
         n_keys = self.kt.n_keys
         if n_keys == 0:
             return
         outs, act = self.gb.finalize(self.state, n_keys)  # (R, S, K), (R, K)
+        self._emit_rules(outs, act, n_keys, wr)
+
+    def _emit_mr_async(self, wr: WindowRange) -> None:
+        """Window-boundary family emit: dispatch the ONE-launch stacked
+        finalize on the immutable state snapshot and hand the (R, S+1, K)
+        transfer — MBs per family — to the emit worker. The boundary then
+        resets the pane and folding continues; a sync fetch here would
+        stall every rider of the shared source for the transfer duration."""
+        n_keys = self.kt.n_keys
+        if n_keys == 0:
+            self.last_emit_info = None
+            return
+        self._emit_async("mr", self.gb.finalize_begin(self.state, n_keys), wr)
+
+    def _deliver_mr(self, arr: np.ndarray, n_keys: int,
+                    wr: WindowRange) -> None:
+        """Emit-worker delivery: slice the landed stacked array per rule.
+        n_keys was captured at dispatch; keys are append-only so the first
+        n_keys table entries still match the snapshot's slot ids."""
+        from ..ops.groupby import apply_int_semantics
+
+        outs = [arr[:, i, :n_keys] for i in range(len(self.plan.specs))]
+        act = arr[:, -1, :n_keys]
+        outs = apply_int_semantics(self.plan.specs, outs)
+        self._emit_rules(outs, act, n_keys, wr)
+
+    def _emit_rules(self, outs, act, n_keys: int, wr: WindowRange) -> None:
         dim_names = [d.name for d in self.dims]
-        keys = self.kt.decode_all()
+        keys = self.kt.keys_slice(0, n_keys)
         keys_arr = np.empty(len(keys), dtype=np.object_)
         keys_arr[:] = keys
         for r, rid in enumerate(self.gb.rule_ids):

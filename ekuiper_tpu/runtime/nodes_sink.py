@@ -96,6 +96,16 @@ class SinkNode(Node):
         # ack/nack to the cache always reference the PRE-transform item the
         # cache emitted, so its in-flight tracking matches on resends
         self._current = item
+        if (isinstance(item, ColumnBatch) and item.n
+                and getattr(self.sink, "accepts_batches", False)
+                and not (self.send_single or self.fields
+                         or self.exclude_fields or self.data_template)):
+            # columnar fast path: a batch-capable sink takes the window
+            # emission as-is — no per-row dict materialization (at 250+
+            # rules x thousands of keys per boundary that conversion is
+            # seconds of host time)
+            self._collect(item)
+            return
         if isinstance(item, (bytes, bytearray, str)):
             # opaque payloads: post-encode/compress bytes, rendered template
             # strings — pass through untransformed

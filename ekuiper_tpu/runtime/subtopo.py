@@ -67,6 +67,45 @@ class SubTopoRef:
         raise RuntimeError(f"cannot attach to subtopo {self.key}")
 
 
+class SharedPrepCtx:
+    """Per-subtopo shared ingest prep: N fan-out consumers of the same
+    ColumnBatch share ONE group-key encode and ONE device upload per
+    column instead of redoing them per rule (the reference shares only the
+    decoded stream, subtopo.go:38; on a bandwidth-limited accelerator the
+    per-rule re-encode + re-upload is the fan-out ceiling, so the shared
+    unit here extends through key encoding and HBM upload).
+
+    The neutral KeyTable assigns dense insertion-ordered slot ids; a
+    consumer that feeds its own KeyTable the same key sequence (via
+    keys_slice) gets identical ids, so slots computed once are valid for
+    every consumer while each node's table stays self-contained for
+    emit-time decode and checkpoints."""
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.key_tables: Dict[str, Any] = {}
+
+    def encode(self, batch, key_name: str):
+        """(slots int32, n_keys, kt) for `key_name` over `batch`, computed
+        once per batch across all consumers."""
+        def factory():
+            import numpy as np
+
+            from ..ops.keytable import KeyTable
+
+            with self.lock:
+                kt = self.key_tables.get(key_name)
+                if kt is None:
+                    kt = self.key_tables[key_name] = KeyTable()
+                col = batch.columns.get(key_name)
+                if col is None:
+                    col = np.full(batch.n, None, dtype=np.object_)
+                slots, _ = kt.encode_column(col)
+                return slots, kt.n_keys, kt
+
+        return batch.share(("slots", key_name), factory)
+
+
 class SrcSubTopo:
     def __init__(self, key: str, nodes: List[Node]) -> None:
         self.key = key
@@ -78,6 +117,7 @@ class SrcSubTopo:
         self._attached: Dict[str, Tuple[Node, Any]] = {}
         self._opened = False
         self._closed = False
+        self.prep_ctx = SharedPrepCtx()
 
     @property
     def tail(self) -> Node:
@@ -104,6 +144,7 @@ class SrcSubTopo:
             if rule_id in self._attached:
                 raise ValueError(f"rule {rule_id} already attached to {self.key}")
             self._attached[rule_id] = (entry, topo)
+            entry.prep_ctx = self.prep_ctx  # shared fan-out ingest prep
             self.tail.outputs = self.tail.outputs + [entry]  # copy-on-write
             if not self._opened:
                 # chain first, source last, so the first payload finds the
@@ -153,22 +194,32 @@ class SharedEntryNode(Node):
         super().__init__(name, op_type="op", **kw)
         self.project_columns = (set(project_columns)
                                 if project_columns is not None else None)
+        self.prep_ctx = None  # set by SrcSubTopo.attach
 
     def process(self, item: Any) -> None:
         cols = self.project_columns
+        from ..data.batch import ColumnBatch
+
+        if isinstance(item, ColumnBatch) and item.shared_ctx is None:
+            item.ensure_share_state()  # BEFORE any pruned copy forks it
+            item.shared_ctx = self.prep_ctx
         if cols is not None:
-            from ..data.batch import ColumnBatch
             from ..data.rows import Tuple as Row
 
             if isinstance(item, ColumnBatch) and not (
                 set(item.columns) <= cols
             ):
+                # pruned COPY rides the same share cache: the original
+                # column objects are identical, so slots/device uploads
+                # computed by one rider serve every other rider too
                 item = ColumnBatch(
                     n=item.n,
                     columns={k: v for k, v in item.columns.items()
                              if k in cols},
                     valid={k: v for k, v in item.valid.items() if k in cols},
                     timestamps=item.timestamps, emitter=item.emitter,
+                    shared_ctx=item.shared_ctx,
+                    share_state=item.share_state,
                 )
             elif isinstance(item, Row) and not (
                 set(item.message) <= cols

@@ -1,0 +1,153 @@
+"""Shared-source fan-out ingest prep (runtime/subtopo.py SharedPrepCtx +
+nodes_fused.py _shared_encode/_shared_device_inputs): N consumers of one
+batch share ONE key encode and ONE device upload, with bit-parity against
+the self-encoded path and a safe fallback when a consumer's key table
+diverged (e.g. restored from a checkpoint)."""
+import numpy as np
+
+from ekuiper_tpu.data.batch import ColumnBatch
+from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+from ekuiper_tpu.ops.emit import build_direct_emit
+from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+from ekuiper_tpu.runtime.subtopo import SharedPrepCtx
+from ekuiper_tpu.sql.parser import parse_select
+
+SQL = ("SELECT deviceId, avg(temperature) AS a, count(*) AS c, "
+       "min(temperature) AS mn FROM s "
+       "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+
+
+def make_node(name="f"):
+    stmt = parse_select(SQL)
+    plan = extract_kernel_plan(stmt)
+    node = FusedWindowAggNode(
+        name, stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+        capacity=64, micro_batch=128,
+        direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+        emit_columnar=True)
+    node.state = node.gb.init_state()
+    got = []
+    node.broadcast = lambda item: got.append(item)
+    return node, got
+
+
+def batch(n, rng, ctx=None, nulls=False):
+    ids = np.array([f"d{rng.integers(0, 40)}" for _ in range(n)],
+                   dtype=np.object_)
+    temp = rng.normal(20, 5, n).astype(np.float32)
+    valid = {}
+    if nulls:
+        valid["temperature"] = rng.random(n) > 0.15
+    b = ColumnBatch(n=n, columns={"deviceId": ids, "temperature": temp},
+                    valid=valid,
+                    timestamps=np.full(n, 1000, dtype=np.int64), emitter="s")
+    if ctx is not None:
+        b.ensure_share_state()
+        b.shared_ctx = ctx
+    return b
+
+
+def emit_dict(node, got):
+    from ekuiper_tpu.data.rows import WindowRange
+
+    node._emit(WindowRange(0, 10_000))
+    cb = got[-1]
+    return {cb.columns["deviceId"][i]: (
+        round(float(cb.columns["a"][i]), 4),
+        int(cb.columns["c"][i]),
+        round(float(cb.columns["mn"][i]), 4))
+        for i in range(cb.n)}
+
+
+class TestSharedPrepParity:
+    def test_two_consumers_share_and_match_self_encoded(self):
+        ctx = SharedPrepCtx()
+        a, got_a = make_node("a")
+        b, got_b = make_node("b")
+        ref, got_r = make_node("ref")
+        rng = np.random.default_rng(7)
+        batches = [batch(100 + 9 * i, rng, ctx=ctx, nulls=(i % 2 == 0))
+                   for i in range(4)]
+        plain = [ColumnBatch(n=x.n, columns=x.columns, valid=x.valid,
+                             timestamps=x.timestamps, emitter=x.emitter)
+                 for x in batches]
+        for x in batches:
+            a.process(x)
+            b.process(x)
+        for x in plain:
+            ref.process(x)
+        assert a._shared_slots_ok is True and b._shared_slots_ok is True
+        # one shared encode + upload per batch: the share cache holds them
+        for x in batches:
+            assert ("slots", "deviceId") in x.share_state
+            assert any(k[0] == "dcol" for k in x.share_state if k != "__lock__")
+        ra, rb, rr = emit_dict(a, got_a), emit_dict(b, got_b), \
+            emit_dict(ref, got_r)
+        assert ra == rb == rr
+        assert sum(c for _, c, _ in ra.values()) == sum(x.n for x in batches)
+
+    def test_diverged_table_falls_back_to_self_encode(self):
+        ctx = SharedPrepCtx()
+        n, got = make_node("n")
+        # a checkpoint restore pre-populated this node's key table with ids
+        # the neutral table will never reproduce
+        n.kt.encode_column(np.array(["old_x", "old_y"], dtype=np.object_))
+        ref, got_r = make_node("ref")
+        ref.kt.encode_column(np.array(["old_x", "old_y"], dtype=np.object_))
+        rng = np.random.default_rng(8)
+        shared = batch(90, rng, ctx=ctx)
+        plain = ColumnBatch(n=shared.n, columns=shared.columns,
+                            valid=shared.valid,
+                            timestamps=shared.timestamps, emitter="s")
+        n.process(shared)
+        ref.process(plain)
+        assert n._shared_slots_ok is False  # detected, self-encoding
+        assert emit_dict(n, got) == emit_dict(ref, got_r)
+
+    def test_shared_batch_still_pickles(self):
+        """The share cache carries a lock + device arrays; a sink-cache
+        disk spill pickles parked items, so pickling must drop the
+        per-process share state instead of crashing."""
+        import pickle
+
+        ctx = SharedPrepCtx()
+        rng = np.random.default_rng(10)
+        b = batch(50, rng, ctx=ctx)
+        ctx.encode(b, "deviceId")  # populate the share cache
+        b2 = pickle.loads(pickle.dumps(b))
+        assert b2.n == b.n and b2.share_state is None and b2.shared_ctx is None
+        np.testing.assert_array_equal(b2.columns["deviceId"],
+                                      b.columns["deviceId"])
+
+    def test_empty_batch_respects_omit_if_empty_on_batch_sink(self):
+        from ekuiper_tpu.io.sinks import NopSink
+        from ekuiper_tpu.runtime.nodes_sink import SinkNode
+
+        sink = NopSink()
+        sink.configure({})
+        node = SinkNode("snk", sink, omit_if_empty=True)
+        node.process(ColumnBatch(n=0, columns={}, emitter="s"))
+        assert node.results == []  # suppressed, not fast-pathed
+        full = ColumnBatch(
+            n=1, columns={"deviceId": np.array(["a"], dtype=np.object_)},
+            emitter="s")
+        node.process(full)
+        assert node.results == [full]  # columnar fast path, no dict rows
+
+    def test_pruned_copy_rides_same_cache(self):
+        ctx = SharedPrepCtx()
+        rng = np.random.default_rng(9)
+        orig = batch(80, rng, ctx=ctx)
+        pruned = ColumnBatch(
+            n=orig.n,
+            columns={"deviceId": orig.columns["deviceId"],
+                     "temperature": orig.columns["temperature"]},
+            valid=orig.valid, timestamps=orig.timestamps, emitter="s",
+            shared_ctx=orig.shared_ctx, share_state=orig.share_state)
+        a, got_a = make_node("a")
+        b, got_b = make_node("b")
+        a.process(orig)
+        b.process(pruned)
+        assert orig.share_state is pruned.share_state
+        assert ("slots", "deviceId") in orig.share_state
+        assert emit_dict(a, got_a) == emit_dict(b, got_b)
