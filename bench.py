@@ -39,10 +39,12 @@ KEY_SLOTS = 16_384
 WARMUP_BATCHES = 3
 BASELINE_MSG_S = 12_000.0
 
-# Phase T: saturated link; long windows amortize the boundary's device wait
-T_WINDOW_BATCHES = 192
-T_PRE_ISSUE_AT = (160,)
-T_WINDOWS = 4
+# Phase T: saturated link; long windows amortize the boundary's device wait.
+# 20 windows -> >=20 device-served boundary samples (r03 recorded only 4,
+# too thin for a latency claim)
+T_WINDOW_BATCHES = 64
+T_PRE_ISSUE_AT = (48,)
+T_WINDOWS = 20
 T_BLOCK_EVERY = 16  # bound the dispatch queue (client buffers uploads)
 
 # Phase L: paced at north-star load
@@ -112,6 +114,23 @@ def bench_rule_group(batches, kt_slots) -> None:
     )
 
 
+def _delivery_latency_line(issue_ts, deliver_ts) -> str:
+    """issue→delivered stats for FIFO-paired async emissions. A delivery
+    can legitimately be skipped (no active keys / empty projection); a
+    skip would silently shift every later pair, so pairs are only trusted
+    when the counts match — otherwise the skew is reported, not hidden."""
+    k = min(len(issue_ts), len(deliver_ts))
+    if not k:
+        return "no triggers fired"
+    skipped = len(issue_ts) - len(deliver_ts)
+    e2e_ms = [(deliver_ts[i] - issue_ts[i][0]) * 1000 for i in range(k)]
+    line = (f"issue→delivered p50={np.percentile(e2e_ms, 50):.0f}ms "
+            f"p99={np.percentile(e2e_ms, 99):.0f}ms")
+    if skipped > 0:
+        line += f" (UNPAIRED: {skipped} skipped deliveries, stats skewed)"
+    return line
+
+
 def bench_sliding_percentile(batches, kt_slots) -> None:
     """BASELINE config #3: SLIDINGWINDOW percentile_approx over 10k keys on
     the device path — saturated ingest with sparse trigger rows (OVER WHEN),
@@ -139,14 +158,16 @@ def bench_sliding_percentile(batches, kt_slots) -> None:
         emit_columnar=True)
     node.state = node.gb.init_state()
     emits = []
-    node.broadcast = lambda item: emits.append(item)
-    emit_ms = []
+    deliver_ts = []
+    node.broadcast = lambda item: (emits.append(item),
+                                   deliver_ts.append(time.time()))
+    issue_ts = []
     orig_emit = node._emit_sliding
 
     def timed_emit(t):
         t0 = time.time()
         orig_emit(t)
-        emit_ms.append((time.time() - t0) * 1000)
+        issue_ts.append((t0, (time.time() - t0) * 1000))
 
     node._emit_sliding = timed_emit
 
@@ -164,9 +185,11 @@ def bench_sliding_percentile(batches, kt_slots) -> None:
 
     node.process(stamped(0))  # warm (vector+scalar folds, dyn finalize)
     node._emit_sliding(timex.now_ms())  # warm finalize path
+    node._drain_async_emits()
     jax.block_until_ready(node.state)
     emits.clear()
-    emit_ms.clear()
+    deliver_ts.clear()
+    issue_ts.clear()
     rows = 0
     n = 0
     marker = None
@@ -179,14 +202,51 @@ def bench_sliding_percentile(batches, kt_slots) -> None:
             if marker is not None:
                 jax.block_until_ready(marker)
             marker = node.state["act"]
+    node._drain_async_emits()
     jax.block_until_ready(node.state)
     elapsed = time.time() - t0
-    lat = (f"emit p50={np.percentile(emit_ms, 50):.0f}ms "
-           f"max={max(emit_ms):.0f}ms" if emit_ms else "no triggers fired")
+    # trigger emissions deliver via the emit worker: report BOTH the fold
+    # stall (time the trigger spends in the fold stream — the dispatch) and
+    # the issue->delivered latency the sink observes
+    if issue_ts:
+        stall_ms = [d for _, d in issue_ts]
+        lat = (f"fold stall p50={np.percentile(stall_ms, 50):.1f}ms "
+               f"max={max(stall_ms):.0f}ms; "
+               + _delivery_latency_line(issue_ts, deliver_ts))
+    else:
+        lat = "no triggers fired"
     print(
         f"# sliding percentile (10s window, 10k keys, device path): "
         f"{rows:,} rows in {elapsed:.2f}s ({rows / elapsed:,.0f} rows/s), "
-        f"{len(emit_ms)} trigger emissions, {lat}",
+        f"{len(issue_ts)} trigger emissions, {lat}",
+        file=sys.stderr,
+    )
+    # paced segment (phase-L analogue): at sustainable load the delivery
+    # latency is what a sink actually observes — the saturated segment
+    # above queues the finalize behind ~16 in-flight fold dispatches
+    emits.clear()
+    deliver_ts.clear()
+    issue_ts.clear()
+    interval = BATCH_ROWS / 1_000_000  # pace at 1M rows/s
+    rows = 0
+    n = 0
+    t0 = time.time()
+    while time.time() - t0 < 8.0:
+        target = t0 + n * interval
+        delay = target - time.time()
+        if delay > 0:
+            time.sleep(delay)
+        node.process(stamped(n, spike=(n % 5 == 4)))
+        rows += BATCH_ROWS
+        n += 1
+    node._drain_async_emits()
+    jax.block_until_ready(node.state)
+    elapsed = time.time() - t0
+    print(
+        f"# sliding percentile paced (1.0M rows/s): {rows:,} rows in "
+        f"{elapsed:.2f}s ({rows / elapsed:,.0f} rows/s), {len(issue_ts)} "
+        f"trigger emissions, "
+        f"{_delivery_latency_line(issue_ts, deliver_ts)}",
         file=sys.stderr,
     )
 

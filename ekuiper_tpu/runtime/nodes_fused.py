@@ -1048,6 +1048,10 @@ class FusedWindowAggNode(Node):
             except Exception as exc:
                 logger.error("async %s emit failed on %s: %s",
                              kind, self.name, exc)
+                # count it: a window dropped here must show in /rules
+                # metrics, not just a log line (the sync path raised into
+                # the node's normal exception accounting)
+                self.stats.inc_exception(f"async {kind} emit failed: {exc}")
             finally:
                 self._emit_q.task_done()
 
@@ -1244,7 +1248,8 @@ class FusedWindowAggNode(Node):
         panes = sorted({b % self.n_ring_panes for b in full})
         if used_scratch:
             panes.append(self._scratch_pane)
-        if panes:
+        if panes and getattr(self.gb, "_host_finalize_only", False):
+            # host-only components: keep the exact synchronous path
             outs, act = self.gb.finalize(self.state, n_keys, panes=panes)
             active = np.nonzero(act > 0)[0]
             if len(active):
@@ -1253,6 +1258,19 @@ class FusedWindowAggNode(Node):
                     self._emit_direct(outs, active, wr)
                 else:
                     self._emit_grouped(outs, active, wr)
+        elif panes:
+            # dispatch-and-defer: the finalize launches here, IN ORDER on
+            # the device stream (after the scratch folds, before the
+            # scratch reset below), and the emit worker fetches+delivers —
+            # a sync fetch would stall the fold stream ~1+ RTT per trigger
+            # (the r03-recorded 0.3-1s sliding emit latencies were exactly
+            # these blocking fetches). The traced (runtime) pane mask keeps
+            # one compiled executable no matter which panes are live.
+            pane_mask = np.zeros(self.gb.n_panes, dtype=np.bool_)
+            pane_mask[panes] = True
+            self._emit_async(
+                "count", self.gb._finalize_dyn(self.state, pane_mask),
+                WindowRange(lo, hi))
         if used_scratch:
             self.state = self.gb.reset_pane(self.state, self._scratch_pane)
 

@@ -54,6 +54,7 @@ def run_device(sql, batches):
     node.broadcast = lambda item: got.append(item)
     for b in batches:
         node.process(b)
+    node._drain_async_emits()  # trigger emissions deliver via the worker
     return got, node
 
 
@@ -186,6 +187,7 @@ class TestSlidingDeviceParity:
         node2.restore_state(snap)
         for b in batches[4:]:
             node2.process(b)
+        node2._drain_async_emits()
         # ground truth over ALL rows (windows straddle the checkpoint)
         expected = run_host_expected(SQL, batches)
         t_cut = int(batches[3].timestamps[-1])
@@ -227,6 +229,7 @@ class TestSlidingRobustness:
         assert node.stats.exceptions == before
         # trigger: the emitted window must NOT include the ancient row
         node.process(b([100_500], [95.0]))
+        node._drain_async_emits()
         msgs = flat(got)
         assert len(msgs) == 1 and msgs[0]["c"] == 4
         # row whose bucket ALIASES the pane of a live newer bucket -> drop
@@ -296,6 +299,7 @@ class TestSlidingRobustness:
         # (no worker thread in this direct-drive test)
         trig = node2.inq.get(timeout=1)
         node2.on_trigger(trig)
+        node2._drain_async_emits()
         msgs = flat(got2)
         by = {m["deviceId"]: m["c"] for m in msgs}
         # window (8050, 11050]: all three rows
@@ -330,6 +334,7 @@ class TestSlidingBurst:
                           "temp": temp},
             timestamps=ts, emitter="s")
         node.process(batch)
+        node._drain_async_emits()
         msgs = flat(got)
         assert len(msgs) == 1
         t = int(ts[-1])
