@@ -84,10 +84,7 @@ class FileSource(Source):
             if self.file_type == "json":
                 with open(fpath, "rb") as f:
                     data = json.load(f)
-                if isinstance(data, list):
-                    yield data
-                else:
-                    yield data
+                yield data
             elif self.file_type == "lines":
                 with open(fpath) as f:
                     for line in f:
@@ -103,6 +100,8 @@ class FileSource(Source):
                         line = line.strip()
                         if line:
                             yield conv.decode(line.encode())
+            elif self.file_type == "parquet":
+                yield from _read_parquet(fpath)
             else:
                 raise EngineError(f"unknown fileType {self.file_type}")
 
@@ -110,9 +109,33 @@ class FileSource(Source):
         self._stop.set()
 
 
+def _pyarrow():
+    try:
+        import pyarrow  # noqa: F401
+        import pyarrow.parquet as pq
+    except ImportError as exc:  # pragma: no cover - pyarrow is in-image
+        raise EngineError(
+            "parquet fileType requires the pyarrow package") from exc
+    return pq
+
+
+def _read_parquet(fpath: str):
+    """Stream a parquet file row-group by row-group (bounded memory), one
+    list-of-dicts payload per group — the columnar analogue of the
+    reference's parquet reader (internal/io/file, parquet build tag)."""
+    pq = _pyarrow()
+    pf = pq.ParquetFile(fpath)
+    for i in range(pf.num_row_groups):
+        rows = pf.read_row_group(i).to_pylist()
+        if rows:
+            yield rows
+
+
 class FileSink(Sink):
     """Appends results to a file; rolling by size or interval
-    (reference: rolling writer)."""
+    (reference: rolling writer). fileType=parquet writes columnar row
+    groups via pyarrow — the BatchWriterOp analogue: ColumnBatch
+    emissions are written column-wise, never materialized as row dicts."""
 
     def __init__(self) -> None:
         self.path = ""
@@ -124,15 +147,21 @@ class FileSink(Sink):
         self._opened_at = 0
         self._lock = threading.Lock()
         self._roll_index = 0
+        self._pq_writer = None  # parquet: open ParquetWriter
+        self.accepts_batches = False
 
     def configure(self, props: Dict[str, Any]) -> None:
         self.path = props.get("path", "sink_out.log")
         self.file_type = props.get("fileType", "lines").lower()
         self.roll_size = int(props.get("rollingSize", 0))
         self.roll_interval_ms = int(props.get("rollingInterval", 0))
+        if self.file_type == "parquet":
+            _pyarrow()  # fail at configure time when unavailable
+            self.accepts_batches = True  # columnar fast path (nodes_sink)
 
     def connect(self) -> None:
-        self._open_file()
+        if self.file_type != "parquet":
+            self._open_file()
 
     def _open_file(self) -> None:
         d = os.path.dirname(self.path)
@@ -160,6 +189,8 @@ class FileSink(Sink):
             self._open_file()
 
     def collect(self, item: Any) -> None:
+        if self.file_type == "parquet":
+            return self._collect_parquet(item)
         if isinstance(item, (bytes, bytearray)):
             line = bytes(item)  # opaque payload (compressed/encrypted)
         else:
@@ -172,8 +203,77 @@ class FileSink(Sink):
             self._written += len(line) + 1
             self._maybe_roll()
 
+    # ----------------------------------------------------------- parquet
+    def _to_arrow(self, item: Any):
+        import pyarrow as pa
+
+        from ..data.batch import ColumnBatch
+
+        if isinstance(item, ColumnBatch):
+            # columnar write: validity masks become arrow nulls, columns
+            # never round-trip through per-row dicts
+            arrays, names = [], []
+            for name, col in item.columns.items():
+                vm = item.valid.get(name)
+                mask = None if vm is None else ~vm  # arrow: True = null
+                if col.dtype == object:
+                    arrays.append(pa.array(col.tolist(),
+                                           mask=None if mask is None
+                                           else mask))
+                else:
+                    arrays.append(pa.array(col, mask=mask))
+                names.append(name)
+            return pa.table(dict(zip(names, arrays)))
+        rows = item if isinstance(item, list) else [item]
+        rows = [r for r in rows if isinstance(r, dict)]
+        if not rows:
+            return None
+        return pa.Table.from_pylist(rows)
+
+    def _collect_parquet(self, item: Any) -> None:
+        pq = _pyarrow()
+        table = self._to_arrow(item)
+        if table is None or table.num_rows == 0:
+            return
+        with self._lock:
+            if self._pq_writer is None:
+                d = os.path.dirname(self.path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._pq_writer = pq.ParquetWriter(self.path, table.schema)
+                self._written = 0
+                self._opened_at = timex.now_ms()
+            try:
+                self._pq_writer.write_table(table)  # one row group
+            except Exception:
+                # schema drift across emissions: roll to a fresh file with
+                # the new schema (parquet files are single-schema)
+                self._roll_parquet()
+                self._pq_writer = pq.ParquetWriter(self.path, table.schema)
+                self._written = 0
+                self._opened_at = timex.now_ms()
+                self._pq_writer.write_table(table)
+            self._written += table.nbytes
+            roll = (self.roll_size and self._written >= self.roll_size) or (
+                self.roll_interval_ms
+                and timex.now_ms() - self._opened_at >= self.roll_interval_ms
+                and self._written > 0)
+            if roll:
+                self._roll_parquet()
+
+    def _roll_parquet(self) -> None:
+        if self._pq_writer is not None:
+            self._pq_writer.close()
+            self._pq_writer = None
+        if os.path.exists(self.path):
+            self._roll_index += 1
+            os.replace(self.path, f"{self.path}.{self._roll_index}")
+
     def close(self) -> None:
         with self._lock:
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
+            if self._pq_writer is not None:
+                self._pq_writer.close()
+                self._pq_writer = None

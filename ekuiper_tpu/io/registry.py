@@ -136,6 +136,19 @@ def _ensure() -> None:
     register_sink("sql", SqlSink)
     register_lookup("sql", SqlLookupSource)
 
+    # edgex rides the repo's own MQTT/redis clients (io/edgex_io.py) —
+    # no external EdgeX client library needed
+    from .edgex_io import EdgexSink, EdgexSource
+
+    register_source("edgex", EdgexSource)
+    register_sink("edgex", EdgexSink)
+
+    # influx speaks line protocol over plain HTTP (io/influx_io.py)
+    from .influx_io import Influx2Sink, InfluxSink
+
+    register_sink("influx", InfluxSink)
+    register_sink("influx2", Influx2Sink)
+
     # connectors whose client libraries are not bundled register a factory
     # that raises a clear error (the reference gates these behind build
     # tags; a missing build tag gives the same "not compiled in" experience)
@@ -152,10 +165,7 @@ def _ensure() -> None:
 
     for kind, pkg, has_src, has_sink in (
         ("kafka", "kafka-python", True, True),
-        ("influx", "influxdb-client", False, True),
-        ("influx2", "influxdb-client", False, True),
         ("zmq", "pyzmq", True, True),
-        ("edgex", "edgex message bus client", True, True),
         ("video", "opencv-python", True, False),
     ):
         if has_src:

@@ -60,6 +60,17 @@ _SOURCE_PROPS: Dict[str, List[Dict[str, Any]]] = {
         {"name": "interval", "type": "int", "default": 0},
     ],
     "memory": [{"name": "datasource", "type": "string", "hint": "topic"}],
+    "edgex": [
+        {"name": "protocol", "type": "string", "default": "redis",
+         "hint": "message bus: redis | mqtt"},
+        {"name": "addr", "type": "string", "default": "127.0.0.1:6379",
+         "hint": "redis bus address"},
+        {"name": "server", "type": "string",
+         "hint": "mqtt bus, e.g. tcp://127.0.0.1:1883"},
+        {"name": "topic", "type": "string", "default": "rules-events"},
+        {"name": "messageType", "type": "string", "default": "event",
+         "hint": "event | request"},
+    ],
     "simulator": [
         {"name": "data", "type": "list"},
         {"name": "interval", "type": "int", "default": 1000},
@@ -97,6 +108,41 @@ _SINK_PROPS: Dict[str, List[Dict[str, Any]]] = {
     ],
     "file": [{"name": "path", "type": "string"}],
     "memory": [{"name": "topic", "type": "string"}],
+    "edgex": _SOURCE_PROPS["edgex"] + [
+        {"name": "topicPrefix", "type": "string",
+         "hint": "dynamic topic prefix/profile/device/source"},
+        {"name": "contentType", "type": "string",
+         "default": "application/json"},
+        {"name": "deviceName", "type": "string", "default": "ekuiper"},
+        {"name": "profileName", "type": "string",
+         "default": "ekuiperProfile"},
+        {"name": "sourceName", "type": "string"},
+        {"name": "metadata", "type": "string",
+         "hint": "field carrying event/reading meta overrides"},
+        {"name": "dataField", "type": "string"},
+    ],
+    "influx": [
+        {"name": "addr", "type": "string",
+         "default": "http://127.0.0.1:8086"},
+        {"name": "database", "type": "string"},
+        {"name": "measurement", "type": "string"},
+        {"name": "username", "type": "string"},
+        {"name": "password", "type": "string"},
+        {"name": "tags", "type": "map", "hint": "static or {{.field}}"},
+        {"name": "tsFieldName", "type": "string"},
+        {"name": "precision", "type": "string", "default": "ms"},
+    ],
+    "influx2": [
+        {"name": "addr", "type": "string",
+         "default": "http://127.0.0.1:8086"},
+        {"name": "org", "type": "string"},
+        {"name": "bucket", "type": "string"},
+        {"name": "token", "type": "string"},
+        {"name": "measurement", "type": "string"},
+        {"name": "tags", "type": "map", "hint": "static or {{.field}}"},
+        {"name": "tsFieldName", "type": "string"},
+        {"name": "precision", "type": "string", "default": "ms"},
+    ],
     "log": [],
     "nop": [],
 }

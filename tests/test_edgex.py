@@ -1,0 +1,217 @@
+"""EdgeX message-bus connector (io/edgex_io.py): value-type mapping parity
+with the reference (internal/io/edgex/source.go getValue, sink.go
+getValueType), envelope round-trip over the in-repo redis bus, and an
+edgex-format reading driven through a real rule to a sink."""
+import base64
+import json
+import time
+
+import numpy as np
+import pytest
+
+from ekuiper_tpu.io import registry as io_registry
+from ekuiper_tpu.io.edgex_io import (
+    EdgexSink, EdgexSource, decode_reading_value, infer_value_type)
+
+from test_io_connectors import FakeRedis, fake_redis  # noqa: F401
+
+
+class TestValueTypes:
+    def test_simple_round_trip(self):
+        cases = [
+            (True, "Bool"), (False, "Bool"), (7, "Int64"), (-3, "Int64"),
+            (2.5, "Float64"), ("hi", "String"),
+            (b"\x01\x02", "Binary"), ({"a": 1}, "Object"),
+            ([True, False], "BoolArray"), ([1, 2, 3], "Int64Array"),
+            ([1.5, 2.0], "Float64Array"), (["x", "y"], "StringArray"),
+        ]
+        for v, want_vt in cases:
+            vt, formatted = infer_value_type(v)
+            assert vt == want_vt, (v, vt)
+            reading = {"resourceName": "r", "valueType": vt}
+            if vt == "Binary":
+                reading["binaryValue"] = base64.b64encode(formatted).decode()
+            elif vt == "Object":
+                reading["objectValue"] = formatted
+            else:
+                reading["value"] = formatted
+            back = decode_reading_value(reading)
+            if isinstance(v, tuple):
+                v = list(v)
+            assert back == v, (v, back)
+
+    def test_reference_source_forms(self):
+        # string-encoded numerics and float-string arrays, as the reference
+        # parses them (source.go:203-301)
+        assert decode_reading_value(
+            {"valueType": "Uint64", "value": "18446744073709551615"}) == \
+            18446744073709551615
+        assert decode_reading_value(
+            {"valueType": "Float32", "value": "1.5"}) == 1.5
+        assert decode_reading_value(
+            {"valueType": "Float64Array", "value": '["1.1", "2.2"]'}) == \
+            [1.1, 2.2]
+        assert decode_reading_value(
+            {"valueType": "Int32Array", "value": "[1, 2]"}) == [1, 2]
+        # unsupported type degrades to string (warn-and-continue)
+        assert decode_reading_value(
+            {"valueType": "Exotic", "value": "raw"}) == "raw"
+        with pytest.raises(ValueError):
+            decode_reading_value({"valueType": "Bool", "value": "maybe"})
+        with pytest.raises(ValueError):
+            infer_value_type(None)
+
+
+class TestBusRoundTrip:
+    def test_sink_to_source_over_redis(self, fake_redis):  # noqa: F811
+        sink = io_registry.create_sink("edgex")
+        sink.configure({"addr": f"127.0.0.1:{fake_redis.port}",
+                        "protocol": "redis", "topic": "app/events",
+                        "deviceName": "dev7", "sourceName": "ruleX"})
+        sink.connect()
+        src = io_registry.create_source("edgex")
+        src.configure("app/events",
+                      {"addr": f"127.0.0.1:{fake_redis.port}",
+                       "protocol": "redis"})
+        got = []
+        src.open(lambda payload, meta=None: got.append((payload, meta)))
+        deadline = time.time() + 5
+        while time.time() < deadline and not fake_redis.subs:
+            time.sleep(0.01)
+        try:
+            sink.collect({"temperature": 21.5, "count": 3, "ok": True,
+                          "label": "warm"})
+            deadline = time.time() + 5
+            while time.time() < deadline and not got:
+                time.sleep(0.01)
+            assert got, "no event delivered over the bus"
+            payload, meta = got[0]
+            assert payload == {"temperature": 21.5, "count": 3, "ok": True,
+                               "label": "warm"}
+            assert meta["deviceName"] == "dev7"
+            assert meta["sourceName"] == "ruleX"
+            assert meta["temperature"]["valueType"] == "Float64"
+            assert meta["count"]["valueType"] == "Int64"
+        finally:
+            src.close()
+            sink.close()
+
+    def test_request_message_type_and_bare_event(self, fake_redis):  # noqa: F811
+        sink = EdgexSink()
+        sink.configure({"addr": f"127.0.0.1:{fake_redis.port}",
+                        "protocol": "redis", "topic": "req/t",
+                        "messageType": "request",
+                        "contentType": "application/json"})
+        sink.connect()
+        src = EdgexSource()
+        src.configure("req/t", {"addr": f"127.0.0.1:{fake_redis.port}",
+                                "protocol": "redis",
+                                "messageType": "request"})
+        got = []
+        src.open(lambda payload, meta=None: got.append(payload))
+        deadline = time.time() + 5
+        while time.time() < deadline and not fake_redis.subs:
+            time.sleep(0.01)
+        try:
+            sink.collect([{"a": 1}, {"b": "x"}])  # rows merge into ONE event
+            deadline = time.time() + 5
+            while time.time() < deadline and not got:
+                time.sleep(0.01)
+            assert got[0] == {"a": 1, "b": "x"}
+            # bare (non-enveloped) event JSON is also accepted
+            from ekuiper_tpu.io.redis_io import RespClient
+
+            ev = {"deviceName": "d", "readings": [
+                {"resourceName": "x", "valueType": "Int64", "value": "9"}]}
+            pub = RespClient("127.0.0.1", fake_redis.port)
+            pub.connect()
+            pub.command("PUBLISH", "req.t", json.dumps({"event": ev}))
+            pub.close()
+            deadline = time.time() + 5
+            while time.time() < deadline and len(got) < 2:
+                time.sleep(0.01)
+            assert got[1] == {"x": 9}
+        finally:
+            src.close()
+            sink.close()
+
+    def test_topic_prefix_and_metadata_override(self, fake_redis):  # noqa: F811
+        sink = EdgexSink()
+        sink.configure({"addr": f"127.0.0.1:{fake_redis.port}",
+                        "protocol": "redis", "topicPrefix": "edgex/rules",
+                        "metadata": "md"})
+        sink.connect()
+        # capture the published channel via a raw subscriber
+        from ekuiper_tpu.io.redis_io import RespClient
+
+        cli = RespClient("127.0.0.1", fake_redis.port)
+        cli.connect()
+        cli._sock.settimeout(5)
+        cli.send("SUBSCRIBE", "edgex.rules.profZ.devZ.srcZ")
+        cli.read_reply()  # subscribe ack
+        try:
+            sink.collect({"v": 1.0, "md": {
+                "deviceName": "devZ", "profileName": "profZ",
+                "sourceName": "srcZ",
+                "v": {"valueType": "Float64", "origin": 123}}})
+            reply = cli.read_reply()
+            assert reply[0] in (b"message", "message")
+            env = json.loads(reply[2])
+            ev = json.loads(base64.b64decode(env["payload"]))
+            assert ev["deviceName"] == "devZ" and ev["sourceName"] == "srcZ"
+            r = ev["readings"][0]
+            assert r["resourceName"] == "v" and r["origin"] == 123
+            assert "md" not in [x["resourceName"] for x in ev["readings"]]
+        finally:
+            cli.close()
+            sink.close()
+
+
+class TestEdgexRuleE2E:
+    def test_reading_through_rule_to_sink(self, fake_redis, mock_clock):  # noqa: F811
+        """BASELINE config #3 shape: an edgex-format reading stream drives
+        a windowed rule; results land in a sink (VERDICT r3 item 5)."""
+        import ekuiper_tpu.io.memory as mem
+        from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+        from ekuiper_tpu.server.processors import StreamProcessor
+        from ekuiper_tpu.store import kv
+
+        store = kv.get_store()
+        # conf_key profile carries the bus address (ref yaml_config_ops)
+        store.kv("source_conf").set("edgex:default", {
+            "addr": f"127.0.0.1:{fake_redis.port}", "protocol": "redis"})
+        StreamProcessor(store).exec_stmt(
+            'CREATE STREAM edgexdemo (temperature FLOAT, humidity FLOAT) '
+            'WITH (DATASOURCE="rules-events", TYPE="edgex", '
+            'CONF_KEY="default", FORMAT="JSON")')
+        topo = plan_rule(RuleDef(id="ex1", sql=(
+            "SELECT avg(temperature) AS a, count(*) AS c FROM edgexdemo "
+            "WHERE temperature > 20 GROUP BY TUMBLINGWINDOW(ss, 10)"),
+            actions=[{"memory": {"topic": "out/ex1"}}], options={}), store)
+        sink = topo.sinks[0]
+        topo.open()
+        try:
+            deadline = time.time() + 5
+            while time.time() < deadline and not fake_redis.subs:
+                time.sleep(0.01)
+            # publish edgex readings through the sink side of the connector
+            pub = EdgexSink()
+            pub.configure({"addr": f"127.0.0.1:{fake_redis.port}",
+                           "protocol": "redis", "topic": "rules-events"})
+            pub.connect()
+            for t_ in (18.0, 22.0, 30.0):
+                pub.collect({"temperature": t_, "humidity": 40.0})
+            pub.close()
+            time.sleep(0.3)
+            mock_clock.advance(50)   # linger flush
+            time.sleep(0.3)
+            mock_clock.advance(10_000)  # window closes
+            deadline = time.time() + 8
+            while time.time() < deadline and not sink.results:
+                time.sleep(0.02)
+            assert sink.results, "no window emitted from edgex stream"
+            row = sink.results[0]
+            row = row[0] if isinstance(row, list) else row
+            assert row["c"] == 2 and row["a"] == pytest.approx(26.0)
+        finally:
+            topo.close()

@@ -1,0 +1,213 @@
+"""Influx line-protocol sinks (io/influx_io.py) against a fake HTTP
+endpoint, and the parquet file format/columnar batch writer
+(io/file.py) round-trips."""
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pytest
+
+from ekuiper_tpu.data.batch import ColumnBatch
+from ekuiper_tpu.io import registry as io_registry
+from ekuiper_tpu.io.influx_io import to_lines
+from ekuiper_tpu.utils.infra import EngineError
+
+
+# ------------------------------------------------------------ line protocol
+class TestLineProtocol:
+    def test_types_and_escaping(self):
+        rows = [{"t": 21.5, "n": 3, "ok": True, "s": 'say "hi"',
+                 "skip": None, "arr": [1, 2], "ts": 1_700_000_000_000}]
+        out = to_lines(rows, "my m", {"site": "a=b", "dev": "{{.s}}"},
+                       "ts", "ms").decode()
+        assert out.startswith("my\\ m,")
+        assert "site=a\\=b" in out
+        assert 'dev=say\\ "hi"' in out
+        assert "t=21.5" in out and "n=3i" in out and "ok=true" in out
+        assert 's="say \\"hi\\""' in out
+        assert "skip" not in out and "arr" not in out
+        assert out.endswith(" 1700000000000")
+
+    def test_ts_field_used_verbatim(self):
+        # ref getTime: a configured ts field is ALREADY in the precision
+        # unit — no conversion (tspoint/transform.go:121-137)
+        rows = [{"v": 1.0, "ts": 1_000}]
+        assert to_lines(rows, "m", {}, "ts", "s").decode().endswith(" 1000")
+        assert to_lines(rows, "m", {}, "ts", "ns").decode().endswith(" 1000")
+
+    def test_now_timestamp_when_no_ts_field(self, mock_clock):
+        mock_clock.set(5_000)
+        out = to_lines([{"v": 1.0}], "m", {"dev": "{{.missing}}"},
+                       "", "ms").decode()
+        assert out == "m v=1.0 5000"  # empty tag dropped, now() stamped
+        out_s = to_lines([{"v": 1.0}], "m", {}, "", "s").decode()
+        assert out_s == "m v=1.0 5"
+
+
+# ---------------------------------------------------------------- fake http
+class _Recorder(BaseHTTPRequestHandler):
+    requests: list = []
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        type(self).requests.append({
+            "path": self.path,
+            "auth": self.headers.get("Authorization"),
+            "body": self.rfile.read(n).decode(),
+        })
+        self.send_response(204)
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def http_server():
+    _Recorder.requests = []
+    srv = HTTPServer(("127.0.0.1", 0), _Recorder)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv
+    srv.shutdown()
+
+
+class TestInfluxSinks:
+    def test_v1_write(self, http_server):
+        sink = io_registry.create_sink("influx")
+        sink.configure({"addr": f"http://127.0.0.1:{http_server.server_port}",
+                        "database": "mydb", "measurement": "weather",
+                        "username": "u", "password": "p",
+                        "tags": {"deviceId": "{{.deviceId}}"}})
+        sink.connect()
+        sink.collect([{"deviceId": "d1", "temperature": 20.5},
+                      {"deviceId": "d2", "temperature": 21.0}])
+        req = _Recorder.requests[0]
+        assert req["path"].startswith("/write?")
+        assert "db=mydb" in req["path"] and "precision=ms" in req["path"]
+        assert req["auth"].startswith("Basic ")
+        lines = req["body"].splitlines()
+        # tag-source fields stay fields too (ref: Fields=mm); now() stamps
+        assert lines[0].startswith(
+            'weather,deviceId=d1 deviceId="d1",temperature=20.5 ')
+        assert lines[1].startswith(
+            'weather,deviceId=d2 deviceId="d2",temperature=21.0 ')
+
+    def test_v2_write_and_errors(self, http_server):
+        sink = io_registry.create_sink("influx2")
+        sink.configure({"addr": f"http://127.0.0.1:{http_server.server_port}",
+                        "org": "o1", "bucket": "b1", "token": "tk",
+                        "measurement": "m"})
+        sink.connect()
+        sink.collect({"v": 2})
+        req = _Recorder.requests[0]
+        assert req["path"].startswith("/api/v2/write?")
+        assert "org=o1" in req["path"] and "bucket=b1" in req["path"]
+        assert req["auth"] == "Token tk"
+        assert req["body"].startswith("m v=2i ")
+        with pytest.raises(EngineError, match="measurement"):
+            io_registry.create_sink("influx").configure(
+                {"database": "d"})
+        with pytest.raises(EngineError, match="org and bucket"):
+            io_registry.create_sink("influx2").configure(
+                {"measurement": "m"})
+
+
+# ------------------------------------------------------------------ parquet
+class TestParquet:
+    def test_row_round_trip(self, tmp_path):
+        path = str(tmp_path / "out.parquet")
+        sink = io_registry.create_sink("file")
+        sink.configure({"path": path, "fileType": "parquet"})
+        sink.connect()
+        sink.collect([{"deviceId": "a", "t": 1.5}, {"deviceId": "b", "t": 2.5}])
+        sink.collect({"deviceId": "c", "t": 3.5})
+        sink.close()
+        src = io_registry.create_source("file")
+        src.configure(path, {"fileType": "parquet"})
+        got = []
+        done = threading.Event()
+        src.open(lambda payload, meta=None: (got.extend(payload),
+                                             done.set()))
+        deadline = time.time() + 5
+        while time.time() < deadline and len(got) < 3:
+            time.sleep(0.01)
+        src.close()
+        assert [r["deviceId"] for r in got] == ["a", "b", "c"]
+        assert [r["t"] for r in got] == [1.5, 2.5, 3.5]
+
+    def test_columnar_batch_write_with_validity(self, tmp_path):
+        """ColumnBatch emissions write column-wise (BatchWriterOp analogue):
+        validity masks become parquet nulls, no row dicts in between."""
+        import pyarrow.parquet as pq
+
+        path = str(tmp_path / "cb.parquet")
+        sink = io_registry.create_sink("file")
+        sink.configure({"path": path, "fileType": "parquet"})
+        assert sink.accepts_batches  # SinkNode takes the columnar fast path
+        sink.connect()
+        cb = ColumnBatch(
+            n=3,
+            columns={"deviceId": np.array(["a", "b", "c"], dtype=np.object_),
+                     "t": np.array([1.0, 2.0, 3.0], dtype=np.float32)},
+            valid={"t": np.array([True, False, True])},
+            emitter="s")
+        sink.collect(cb)
+        sink.close()
+        table = pq.read_table(path)
+        assert table.column("deviceId").to_pylist() == ["a", "b", "c"]
+        assert table.column("t").to_pylist() == [1.0, None, 3.0]
+
+    def test_schema_drift_rolls_file(self, tmp_path):
+        path = str(tmp_path / "drift.parquet")
+        sink = io_registry.create_sink("file")
+        sink.configure({"path": path, "fileType": "parquet"})
+        sink.connect()
+        sink.collect({"a": 1})
+        sink.collect({"b": "x"})  # different schema -> rolls to .1
+        sink.close()
+        import pyarrow.parquet as pq
+
+        assert pq.read_table(path + ".1").column("a").to_pylist() == [1]
+        assert pq.read_table(path).column("b").to_pylist() == ["x"]
+
+    def test_sink_rule_e2e(self, tmp_path, mock_clock):
+        """Windowed rule results land in a parquet file via the columnar
+        fast path (reference: file sink parquet build tag)."""
+        import ekuiper_tpu.io.memory as mem
+        from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+        from ekuiper_tpu.server.processors import StreamProcessor
+        from ekuiper_tpu.store import kv
+
+        path = str(tmp_path / "rule.parquet")
+        store = kv.get_store()
+        StreamProcessor(store).exec_stmt(
+            'CREATE STREAM pqs (deviceId STRING, temperature FLOAT) '
+            'WITH (DATASOURCE="t/pq", TYPE="memory", FORMAT="JSON")')
+        topo = plan_rule(RuleDef(id="pq1", sql=(
+            "SELECT deviceId, avg(temperature) AS a FROM pqs "
+            "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"),
+            actions=[{"file": {"path": path, "fileType": "parquet"}}],
+            options={}), store)
+        topo.open()
+        try:
+            for t_ in (10.0, 20.0):
+                mem.publish("t/pq", {"deviceId": "a", "temperature": t_})
+            time.sleep(0.2)
+            mock_clock.advance(50)
+            time.sleep(0.3)
+            mock_clock.advance(10_000)
+            deadline = time.time() + 8
+            import os
+
+            while time.time() < deadline and not os.path.exists(path):
+                time.sleep(0.02)
+            time.sleep(0.3)
+        finally:
+            topo.close()
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path)
+        rows = table.to_pylist()
+        assert any(r["deviceId"] == "a" and r["a"] == 15.0 for r in rows)
