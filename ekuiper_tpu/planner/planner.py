@@ -197,9 +197,10 @@ def device_path_eligible(
         ast.WindowType.STATE_WINDOW,
     ):
         return None
-    if w.window_type == ast.WindowType.SESSION_WINDOW and opts.is_event_time:
-        # event-time sessions need the exact buffered host path (gap is
-        # measured in event time over reordered rows)
+    if w.window_type == ast.WindowType.SESSION_WINDOW and opts.is_event_time \
+            and (opts.plan_optimize_strategy or {}).get("mesh"):
+        # event-time sessions fold per-session at watermark time (single
+        # pane, per-emission finalize) — single chip only
         return None
     if w.window_type == ast.WindowType.STATE_WINDOW:
         from ..sql.compiler import try_compile

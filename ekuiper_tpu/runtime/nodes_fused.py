@@ -95,7 +95,15 @@ class FusedWindowAggNode(Node):
         self.length_ms = window.length_ms()
         self.interval_ms = window.interval_ms()
         self.is_event_time = is_event_time
-        if is_event_time:
+        if is_event_time and self.wt == ast.WindowType.SESSION_WINDOW:
+            # event-time sessions: one pane (a pane holds exactly one
+            # complete session at fold time — see _evs_watermark); the
+            # bucket/pane routing below is tumbling/hopping machinery
+            self.n_panes = 1
+            self._next_emit_bucket: Optional[int] = None
+            self._max_bucket: Optional[int] = None
+            self._dirty: set = set()
+        elif is_event_time:
             # event-time tumbling/hopping on device: each row routes to the
             # pane of its time bucket (bucket = ts // bucket_ms, pane =
             # bucket % P) and watermarks drive emission — pane count covers
@@ -205,8 +213,14 @@ class FusedWindowAggNode(Node):
             # semantics window_op.go: session is per-STREAM — any row
             # extends the session; gap silence or the length cap closes
             # it): rows fold into the single pane exactly like tumbling,
-            # and the gap/cap timers drive emission + reset. Event-time
-            # sessions stay on the exact host buffering path.
+            # and the gap/cap timers drive emission + reset.
+            # EVENT-time sessions buffer columnar batches and resolve the
+            # session structure at each watermark with vectorized numpy
+            # timestamp logic (argsort + diff > gap), then fold each
+            # complete session on device and finalize — exact parity with
+            # the host path's sort/scan (nodes_window.py on_watermark),
+            # with the aggregation on the device instead of Python rows
+            # (ref window_inc_agg_op.go:616).
             self.gap_ms = self.interval_ms or self.length_ms
             self._session_open = False
             self._session_start = 0
@@ -217,6 +231,7 @@ class FusedWindowAggNode(Node):
             self._gap_timer = None
             self._gap_gen = 0  # arm generation: one live gap check at a time
             self._cap_timer = None
+            self._evs_batches: List[ColumnBatch] = []  # event-time buffer
         # heavy_hitters: per-column reversible dictionaries (codes -> values)
         # + the spec index -> raw column map for emit-time decoding. The hh
         # component is wide (sketches.HH_SIZE floats/key), so start small and
@@ -476,8 +491,14 @@ class FusedWindowAggNode(Node):
         if self.wt == ast.WindowType.COUNT_WINDOW:
             self._fold_count_window(item)
         elif self.wt == ast.WindowType.SESSION_WINDOW:
-            self._fold(item)
-            self._touch_session()
+            if self.is_event_time:
+                # session structure resolves at watermark time: buffer the
+                # COLUMNAR batch as-is (no device work yet — folds happen
+                # per complete session so pane 0 is always one session)
+                self._evs_batches.append(item)
+            else:
+                self._fold(item)
+                self._touch_session()
         elif self.wt == ast.WindowType.STATE_WINDOW:
             self._fold_state_window(item)
         else:
@@ -812,6 +833,10 @@ class FusedWindowAggNode(Node):
         self._next_emit_bucket = b + 1
 
     def on_watermark(self, wm) -> None:
+        if self.is_event_time and self.wt == ast.WindowType.SESSION_WINDOW:
+            self._evs_watermark(wm.ts)
+            self.broadcast(wm)
+            return
         if self.is_event_time and self._next_emit_bucket is not None:
             floor_b = wm.ts // self.bucket_ms - 1  # buckets fully below wm
             while self._next_emit_bucket <= floor_b:
@@ -872,6 +897,57 @@ class FusedWindowAggNode(Node):
             self.state = self.gb.reset_pane(self.state, 0)
             self._state_open = False
             pos = end
+
+    # ------------------------------------------------- event-time sessions
+    def _evs_watermark(self, wm_ts: int) -> None:
+        """Emit every COMPLETE leading session below the watermark — the
+        vectorized mirror of the host path's sort/scan (nodes_window.py
+        on_watermark SESSION branch): sort buffered rows by event time,
+        split where consecutive gaps exceed the session gap, and emit a
+        session only when last + gap <= wm. Each emitted session folds on
+        device into pane 0 and finalizes through the normal emit tail."""
+        if not self._evs_batches:
+            return
+        timeout = self.gap_ms
+        big = (self._evs_batches[0] if len(self._evs_batches) == 1
+               else ColumnBatch.concat(self._evs_batches))
+        ts = big.timestamps
+        if ts is None:
+            ts = np.zeros(big.n, dtype=np.int64)
+        order = np.argsort(ts, kind="stable")
+        ts_sorted = ts[order]
+        # session boundaries: index i ends a session when the next row is
+        # more than `timeout` later
+        bounds = np.nonzero(np.diff(ts_sorted) > timeout)[0]
+        start = 0
+        for end in [*(bounds + 1).tolist(), len(ts_sorted)]:
+            last = int(ts_sorted[end - 1])
+            if last + timeout > wm_ts:
+                break  # leading incomplete session: stop, like the host
+            sub = big.take(order[start:end])
+            self._fold_rows(sub, 0)
+            self._emit(WindowRange(int(ts_sorted[start]), last + timeout))
+            self.state = self.gb.reset_pane(self.state, 0)
+            start = end
+        if start == 0:
+            self._evs_batches = [big]  # compacted, nothing emitted
+        elif start >= len(ts_sorted):
+            self._evs_batches = []
+        else:
+            self._evs_batches = [big.take(np.sort(order[start:]))]
+
+    def _evs_flush(self) -> None:
+        """EOF flush: all buffered rows as ONE window [now-L, now) — host
+        path parity (nodes_window.py on_eof)."""
+        if not self._evs_batches:
+            return
+        big = (self._evs_batches[0] if len(self._evs_batches) == 1
+               else ColumnBatch.concat(self._evs_batches))
+        self._evs_batches = []
+        now = timex.now_ms()
+        self._fold_rows(big, 0)
+        self._emit(WindowRange(now - self.length_ms, now))
+        self.state = self.gb.reset_pane(self.state, 0)
 
     # ---------------------------------------------------------- session time
     def _touch_session(self) -> None:
@@ -1369,6 +1445,11 @@ class FusedWindowAggNode(Node):
         self._device_frozen = False
 
     def on_eof(self, eof: EOF) -> None:
+        if self.is_event_time and self.wt == ast.WindowType.SESSION_WINDOW:
+            self._drain_async_emits()
+            self._evs_flush()
+            self.broadcast(eof)
+            return
         if self.is_event_time:
             # flush every window that can still contain data (bounded
             # runs / trials) — iterate the dirty set, never bucket-by-bucket
@@ -1689,6 +1770,16 @@ class FusedWindowAggNode(Node):
             snap["next_emit_bucket"] = self._next_emit_bucket
             snap["max_bucket"] = self._max_bucket
             snap["dirty_buckets"] = sorted(self._dirty)
+        if self.wt == ast.WindowType.SESSION_WINDOW and self.is_event_time \
+                and self._evs_batches:
+            snap["evs"] = [
+                {"cols": {k: v.tolist() for k, v in b.columns.items()},
+                 "valid": {k: v.tolist() for k, v in b.valid.items()},
+                 "ts": (b.timestamps.tolist()
+                        if b.timestamps is not None else None),
+                 "emitter": b.emitter, "n": b.n}
+                for b in self._evs_batches
+            ]
         if self.wt == ast.WindowType.SLIDING_WINDOW:
             snap["pane_bucket"] = dict(self._pane_bucket)
             snap["ring_max_bucket"] = self._ring_max_bucket
@@ -1737,6 +1828,22 @@ class FusedWindowAggNode(Node):
             self._next_emit_bucket = state.get("next_emit_bucket")
             self._max_bucket = state.get("max_bucket")
             self._dirty = set(state.get("dirty_buckets", []))
+        if self.wt == ast.WindowType.SESSION_WINDOW and self.is_event_time:
+            self._evs_batches = []
+            for d in state.get("evs", []):
+                cols = {}
+                for k, v in d["cols"].items():
+                    arr = np.asarray(v)
+                    if arr.dtype.kind in ("U", "O"):  # strings stay object
+                        arr = np.array(v, dtype=np.object_)
+                    cols[k] = arr
+                self._evs_batches.append(ColumnBatch(
+                    n=int(d["n"]), columns=cols,
+                    valid={k: np.asarray(v, dtype=np.bool_)
+                           for k, v in d.get("valid", {}).items()},
+                    timestamps=(np.asarray(d["ts"], dtype=np.int64)
+                                if d.get("ts") is not None else None),
+                    emitter=d.get("emitter", "")))
         if self.wt == ast.WindowType.SLIDING_WINDOW:
             self._pane_bucket = {int(k): v for k, v in
                                  state.get("pane_bucket", {}).items()}
