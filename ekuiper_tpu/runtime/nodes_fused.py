@@ -1719,7 +1719,11 @@ class FusedWindowAggNode(Node):
             dim_cols, agg_cols, wr.window_start, wr.window_end
         )
         if msgs:
-            self.emit(msgs if len(msgs) > 1 else msgs[0], count=len(msgs))
+            # Fused direct-emit contract: always a list of message dicts,
+            # never a bare dict, so consumers of this path see one shape per
+            # mode (list here, ColumnBatch when emit_columnar) — ref
+            # internal/xsql/collection.go:70, WindowTuples is one type.
+            self.emit(msgs, count=len(msgs))
 
     def _flush_shadow(self, shadow) -> None:
         """Fold frozen-span (host-only) rows back into the device state

@@ -119,7 +119,9 @@ class MultiRuleFusedNode(FusedWindowAggNode):
                     dim_cols, agg_cols, wr.window_start, wr.window_end)
                 if msgs:
                     self.stats.inc_out(len(msgs))
-                    self.send_to(out_node, msgs if len(msgs) > 1 else msgs[0])
+                    # Always a list (same emission-type contract as
+                    # FusedWindowAggNode._emit_direct).
+                    self.send_to(out_node, msgs)
 
     # ------------------------------------------------------------------ state
     def restore_state(self, state: dict) -> None:
