@@ -1,0 +1,99 @@
+// Package api defines the contract a portable plugin implements to serve
+// functions, sources, and sinks to the ekuiper_tpu engine.
+//
+// Role analogue of the reference SDK's api package
+// (/root/reference/sdk/go/api/api.go); the interface shapes match so plugin
+// code ports with minimal edits, but the transport underneath is this
+// engine's framed unix-socket protocol (docs/PLUGIN_WIRE_PROTOCOL.md), not
+// nanomsg — this SDK has zero third-party dependencies.
+package api
+
+import "context"
+
+// SourceTuple is one record emitted by a Source: a message payload plus
+// out-of-band metadata. On the wire only the message is sent (the engine's
+// decode pipeline attaches its own meta); Meta is available for plugin-side
+// bookkeeping.
+type SourceTuple interface {
+	Message() map[string]interface{}
+	Meta() map[string]interface{}
+}
+
+// DefaultSourceTuple is the plain struct implementation of SourceTuple.
+type DefaultSourceTuple struct {
+	Mess map[string]interface{} `json:"message"`
+	M    map[string]interface{} `json:"meta"`
+}
+
+func NewDefaultSourceTuple(message, meta map[string]interface{}) *DefaultSourceTuple {
+	return &DefaultSourceTuple{Mess: message, M: meta}
+}
+
+func (t *DefaultSourceTuple) Message() map[string]interface{} { return t.Mess }
+func (t *DefaultSourceTuple) Meta() map[string]interface{}    { return t.M }
+
+// Source pushes records into the engine. Open runs the ingest loop
+// synchronously; the runtime calls it on its own goroutine. Emit tuples on
+// consumer; report a fatal ingest failure on errCh (the runtime logs it and
+// tears the symbol down). Return when ctx is done.
+type Source interface {
+	Configure(datasource string, props map[string]interface{}) error
+	Open(ctx StreamContext, consumer chan<- SourceTuple, errCh chan<- error)
+	Closable
+}
+
+// Function serves a SQL scalar or aggregate function. Exec returns the
+// result value and true, or an error value and false (the engine surfaces
+// it as a rule error). For aggregate functions every argument arrives as a
+// slice of the group's values.
+type Function interface {
+	Validate(args []interface{}) error
+	Exec(args []interface{}, ctx FunctionContext) (interface{}, bool)
+	IsAggregate() bool
+}
+
+// Sink receives result rows from the engine. Collect is called once per
+// delivered payload — a map for single rows, []map for window batches.
+type Sink interface {
+	Configure(props map[string]interface{}) error
+	Open(ctx StreamContext) error
+	Collect(ctx StreamContext, data interface{}) error
+	Closable
+}
+
+type Closable interface {
+	Close(ctx StreamContext) error
+}
+
+// Logger is the leveled logger handed to plugin code via the context.
+type Logger interface {
+	Debug(args ...interface{})
+	Info(args ...interface{})
+	Warn(args ...interface{})
+	Error(args ...interface{})
+	Debugf(format string, args ...interface{})
+	Infof(format string, args ...interface{})
+	Warnf(format string, args ...interface{})
+	Errorf(format string, args ...interface{})
+}
+
+// StreamContext carries the rule/op/instance identity of the symbol
+// invocation plus cancellation, mirroring the engine-side operator context
+// (ekuiper_tpu/functions/context.py).
+type StreamContext interface {
+	context.Context
+	GetLogger() Logger
+	GetRuleId() string
+	GetOpId() string
+	GetInstanceId() int
+	WithMeta(ruleId, opId string) StreamContext
+	WithInstance(instanceId int) StreamContext
+	WithCancel() (StreamContext, context.CancelFunc)
+}
+
+// FunctionContext additionally identifies which function call site within
+// the rule is executing.
+type FunctionContext interface {
+	StreamContext
+	GetFuncId() int
+}

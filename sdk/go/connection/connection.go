@@ -1,0 +1,135 @@
+// Package connection implements the worker side of the engine's framed IPC
+// transport (docs/PLUGIN_WIRE_PROTOCOL.md): every message is one frame of
+// uint32 little-endian payload length followed by the payload, carried over
+// a unix domain socket. The engine always LISTENS and the worker always
+// DIALS, for all three channel roles:
+//
+//	PAIR      control + function channels (strict request/reply)
+//	PUSH      source data channel (worker -> engine, send-only)
+//	PULL      sink data channel (engine -> worker, receive-only)
+//
+// Role analogue of the reference SDK's connection package
+// (/root/reference/sdk/go/connection/connection.go), which wraps nanomsg;
+// this transport needs only the stdlib.
+package connection
+
+import (
+	"encoding/binary"
+	"errors"
+	"fmt"
+	"io"
+	"net"
+	"os"
+	"path/filepath"
+	"strings"
+	"time"
+)
+
+// ErrClosed is returned after Close, or when the engine hangs up.
+var ErrClosed = errors.New("ekipc: connection closed")
+
+// RuntimeDir resolves the engine's per-instance socket directory exactly as
+// the engine does (ekuiper_tpu/plugin/ipc.py _ipc_dir): the
+// EKUIPER_TPU_RUNTIME_DIR env var if set, else /tmp/ektpu_<ns> where <ns>
+// is EKUIPER_TPU_IPC_NS (exported to the worker process by the engine).
+func RuntimeDir() string {
+	if d := os.Getenv("EKUIPER_TPU_RUNTIME_DIR"); d != "" {
+		return d
+	}
+	ns := os.Getenv("EKUIPER_TPU_IPC_NS")
+	if ns == "" {
+		ns = fmt.Sprint(os.Getpid())
+	}
+	return filepath.Join("/tmp", "ektpu_"+ns)
+}
+
+// URL builds the channel url for a named channel: ipc://<dir>/<name>.ipc.
+func URL(name string) string {
+	return "ipc://" + filepath.Join(RuntimeDir(), name+".ipc")
+}
+
+// SocketPath extracts the filesystem path from an ipc:// url.
+func SocketPath(url string) string {
+	return strings.TrimPrefix(url, "ipc://")
+}
+
+// Conn is one framed channel. The PAIR/PUSH/PULL discipline is enforced by
+// the caller (runtime package); the frame format is identical for all roles.
+type Conn struct {
+	c net.Conn
+	// partial buffers bytes of an incomplete frame across Recv deadlines —
+	// a read that straddles a timeout must not lose already-consumed bytes
+	// or the stream desyncs (the engine's ipc layer buffers the same way).
+	partial []byte
+}
+
+// Dial connects to an ipc:// url, retrying until timeout so a worker that
+// starts before the engine finishes binding the endpoint still connects.
+func Dial(url string, timeout time.Duration) (*Conn, error) {
+	path := SocketPath(url)
+	deadline := time.Now().Add(timeout)
+	for {
+		c, err := net.DialTimeout("unix", path, time.Second)
+		if err == nil {
+			return &Conn{c: c}, nil
+		}
+		if time.Now().After(deadline) {
+			return nil, fmt.Errorf("ekipc: dial %s: %w", url, err)
+		}
+		time.Sleep(50 * time.Millisecond)
+	}
+}
+
+// Send writes one frame: 4-byte little-endian length, then the payload.
+func (c *Conn) Send(payload []byte) error {
+	var hdr [4]byte
+	binary.LittleEndian.PutUint32(hdr[:], uint32(len(payload)))
+	if _, err := c.c.Write(hdr[:]); err != nil {
+		return c.mapErr(err)
+	}
+	_, err := c.c.Write(payload)
+	return c.mapErr(err)
+}
+
+// Recv reads one frame, blocking up to timeout (0 = block forever).
+// Returns os.ErrDeadlineExceeded on timeout, ErrClosed on engine hangup.
+// A timeout mid-frame is safe: the bytes read so far stay buffered and the
+// next Recv resumes the same frame.
+func (c *Conn) Recv(timeout time.Duration) ([]byte, error) {
+	if timeout > 0 {
+		_ = c.c.SetReadDeadline(time.Now().Add(timeout))
+		defer c.c.SetReadDeadline(time.Time{})
+	}
+	chunk := make([]byte, 64*1024)
+	for {
+		if len(c.partial) >= 4 {
+			n := int(binary.LittleEndian.Uint32(c.partial[:4]))
+			if len(c.partial) >= 4+n {
+				payload := make([]byte, n)
+				copy(payload, c.partial[4:4+n])
+				c.partial = append(c.partial[:0], c.partial[4+n:]...)
+				return payload, nil
+			}
+		}
+		k, err := c.c.Read(chunk)
+		if k > 0 {
+			c.partial = append(c.partial, chunk[:k]...)
+		}
+		if err != nil {
+			return nil, c.mapErr(err)
+		}
+	}
+}
+
+func (c *Conn) mapErr(err error) error {
+	if err == nil {
+		return nil
+	}
+	if errors.Is(err, io.EOF) || errors.Is(err, io.ErrUnexpectedEOF) ||
+		errors.Is(err, net.ErrClosed) {
+		return ErrClosed
+	}
+	return err
+}
+
+func (c *Conn) Close() error { return c.c.Close() }

@@ -1,0 +1,112 @@
+// Package context implements api.StreamContext / api.FunctionContext for
+// the plugin-side runtime (role analogue of the reference SDK's context
+// package; built on the stdlib log package instead of logrus — no deps).
+package context
+
+import (
+	gocontext "context"
+	"fmt"
+	"log"
+	"os"
+
+	"github.com/ekuiper-tpu/sdk-go/api"
+)
+
+// LogLevel gates stdoutLogger output; set from the EKUIPER_TPU_LOG_LEVEL
+// env var ("debug" | "info" | "warn" | "error", default info).
+var LogLevel = func() int {
+	switch os.Getenv("EKUIPER_TPU_LOG_LEVEL") {
+	case "debug":
+		return 0
+	case "warn":
+		return 2
+	case "error":
+		return 3
+	default:
+		return 1
+	}
+}()
+
+type stdoutLogger struct{ prefix string }
+
+func (l *stdoutLogger) out(level int, tag string, args ...interface{}) {
+	if level >= LogLevel {
+		log.Print(tag, " ", l.prefix, " ", fmt.Sprintln(args...))
+	}
+}
+
+func (l *stdoutLogger) outf(level int, tag, format string, args ...interface{}) {
+	if level >= LogLevel {
+		log.Printf("%s %s %s", tag, l.prefix, fmt.Sprintf(format, args...))
+	}
+}
+
+func (l *stdoutLogger) Debug(args ...interface{}) { l.out(0, "DEBUG", args...) }
+func (l *stdoutLogger) Info(args ...interface{})  { l.out(1, "INFO", args...) }
+func (l *stdoutLogger) Warn(args ...interface{})  { l.out(2, "WARN", args...) }
+func (l *stdoutLogger) Error(args ...interface{}) { l.out(3, "ERROR", args...) }
+func (l *stdoutLogger) Debugf(f string, args ...interface{}) {
+	l.outf(0, "DEBUG", f, args...)
+}
+func (l *stdoutLogger) Infof(f string, args ...interface{}) {
+	l.outf(1, "INFO", f, args...)
+}
+func (l *stdoutLogger) Warnf(f string, args ...interface{}) {
+	l.outf(2, "WARN", f, args...)
+}
+func (l *stdoutLogger) Errorf(f string, args ...interface{}) {
+	l.outf(3, "ERROR", f, args...)
+}
+
+type defaultContext struct {
+	gocontext.Context
+	ruleId     string
+	opId       string
+	instanceId int
+	logger     api.Logger
+}
+
+// Background returns the root plugin context.
+func Background() api.StreamContext {
+	return &defaultContext{
+		Context: gocontext.Background(),
+		logger:  &stdoutLogger{prefix: "[plugin]"},
+	}
+}
+
+func (c *defaultContext) GetLogger() api.Logger { return c.logger }
+func (c *defaultContext) GetRuleId() string     { return c.ruleId }
+func (c *defaultContext) GetOpId() string       { return c.opId }
+func (c *defaultContext) GetInstanceId() int    { return c.instanceId }
+
+func (c *defaultContext) WithMeta(ruleId, opId string) api.StreamContext {
+	next := *c
+	next.ruleId, next.opId = ruleId, opId
+	next.logger = &stdoutLogger{prefix: fmt.Sprintf("[%s/%s]", ruleId, opId)}
+	return &next
+}
+
+func (c *defaultContext) WithInstance(instanceId int) api.StreamContext {
+	next := *c
+	next.instanceId = instanceId
+	return &next
+}
+
+func (c *defaultContext) WithCancel() (api.StreamContext, gocontext.CancelFunc) {
+	next := *c
+	inner, cancel := gocontext.WithCancel(c.Context)
+	next.Context = inner
+	return &next, cancel
+}
+
+type funcContext struct {
+	api.StreamContext
+	funcId int
+}
+
+// NewFuncContext wraps a stream context with a function call-site id.
+func NewFuncContext(ctx api.StreamContext, funcId int) api.FunctionContext {
+	return &funcContext{StreamContext: ctx, funcId: funcId}
+}
+
+func (c *funcContext) GetFuncId() int { return c.funcId }

@@ -1,0 +1,29 @@
+package main
+
+import (
+	"errors"
+
+	"github.com/ekuiper-tpu/sdk-go/api"
+)
+
+// echoFunc mirrors its single argument back — the smallest possible
+// function symbol, used by the golden-fixture interop test.
+type echoFunc struct{}
+
+func (f *echoFunc) Validate(args []interface{}) error {
+	if len(args) != 1 {
+		return errors.New("echo takes exactly 1 argument")
+	}
+	return nil
+}
+
+func (f *echoFunc) Exec(args []interface{}, _ api.FunctionContext) (interface{}, bool) {
+	if len(args) != 1 {
+		return "echo takes exactly 1 argument", false
+	}
+	return args[0], true
+}
+
+func (f *echoFunc) IsAggregate() bool { return false }
+
+func (f *echoFunc) Close(_ api.StreamContext) error { return nil }

@@ -1,0 +1,50 @@
+package main
+
+import (
+	"encoding/json"
+	"errors"
+	"os"
+
+	"github.com/ekuiper-tpu/sdk-go/api"
+)
+
+// fileSink appends every collected payload as one JSON line to the file
+// named by the "path" prop.
+type fileSink struct {
+	path string
+	f    *os.File
+}
+
+func (k *fileSink) Configure(props map[string]interface{}) error {
+	p, _ := props["path"].(string)
+	if p == "" {
+		return errors.New("file sink requires a \"path\" property")
+	}
+	k.path = p
+	return nil
+}
+
+func (k *fileSink) Open(_ api.StreamContext) error {
+	f, err := os.OpenFile(k.path, os.O_CREATE|os.O_WRONLY|os.O_APPEND, 0o644)
+	if err != nil {
+		return err
+	}
+	k.f = f
+	return nil
+}
+
+func (k *fileSink) Collect(_ api.StreamContext, data interface{}) error {
+	b, err := json.Marshal(data)
+	if err != nil {
+		return err
+	}
+	_, err = k.f.Write(append(b, '\n'))
+	return err
+}
+
+func (k *fileSink) Close(_ api.StreamContext) error {
+	if k.f != nil {
+		return k.f.Close()
+	}
+	return nil
+}

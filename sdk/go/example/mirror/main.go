@@ -1,0 +1,34 @@
+// Command mirror is the reference example plugin for the Go SDK: an echo
+// function, a ticking random source, and a line-appending file sink — the
+// same symbol set the reference SDK's example ships
+// (/root/reference/sdk/go/example/mirror/), served over this engine's
+// framed unix-socket protocol.
+//
+// Build:   go build -o mirror .
+// Install: descriptor mirror.json with "language": "binary".
+package main
+
+import (
+	"log"
+
+	"github.com/ekuiper-tpu/sdk-go/api"
+	"github.com/ekuiper-tpu/sdk-go/runtime"
+)
+
+func main() {
+	err := runtime.Start(runtime.PluginConfig{
+		Name: "mirror",
+		Functions: map[string]runtime.NewFunctionFunc{
+			"echo": func() api.Function { return &echoFunc{} },
+		},
+		Sources: map[string]runtime.NewSourceFunc{
+			"random": func() api.Source { return &randomSource{} },
+		},
+		Sinks: map[string]runtime.NewSinkFunc{
+			"file": func() api.Sink { return &fileSink{} },
+		},
+	})
+	if err != nil {
+		log.Fatal(err)
+	}
+}

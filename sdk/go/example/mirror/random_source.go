@@ -1,0 +1,47 @@
+package main
+
+import (
+	"math/rand"
+	"time"
+
+	"github.com/ekuiper-tpu/sdk-go/api"
+)
+
+// randomSource emits {"count": n, "value": r} every interval ms
+// (default 1000, prop "interval").
+type randomSource struct {
+	interval time.Duration
+}
+
+func (s *randomSource) Configure(_ string, props map[string]interface{}) error {
+	s.interval = time.Second
+	if v, ok := props["interval"].(float64); ok && v > 0 {
+		s.interval = time.Duration(v) * time.Millisecond
+	}
+	return nil
+}
+
+func (s *randomSource) Open(ctx api.StreamContext, consumer chan<- api.SourceTuple, _ chan<- error) {
+	tick := time.NewTicker(s.interval)
+	defer tick.Stop()
+	count := 0
+	for {
+		select {
+		case <-ctx.Done():
+			return
+		case <-tick.C:
+			count++
+			t := api.NewDefaultSourceTuple(map[string]interface{}{
+				"count": count,
+				"value": rand.Float64(),
+			}, nil)
+			select {
+			case consumer <- t:
+			case <-ctx.Done(): // never block a stopped symbol on a full buffer
+				return
+			}
+		}
+	}
+}
+
+func (s *randomSource) Close(_ api.StreamContext) error { return nil }

@@ -1,0 +1,3 @@
+module github.com/ekuiper-tpu/sdk-go
+
+go 1.21

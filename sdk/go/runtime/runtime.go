@@ -1,0 +1,369 @@
+// Package runtime is the worker-side main loop of the portable plugin
+// protocol (docs/PLUGIN_WIRE_PROTOCOL.md) — the Go analogue of the Python
+// SDK's plugin_main (ekuiper_tpu/sdk/runtime.py) and role analogue of the
+// reference SDK's runtime package (/root/reference/sdk/go/runtime/).
+//
+// Lifecycle: dial the engine's control channel plugin_<name>, send the
+// handshake, then serve start/stop/ping commands. Every started symbol gets
+// its own goroutine and its own data channel:
+//
+//	function  PAIR  dial func_<symbol>; loop {"func","args"} -> {"state","result"}
+//	source    PUSH  dial source_<ruleId>_<opId>_<instanceId>; push JSON tuples
+//	sink      PULL  dial sink_<ruleId>_<opId>_<instanceId>; recv rows -> Collect
+package runtime
+
+import (
+	"encoding/json"
+	"errors"
+	"fmt"
+	"os"
+	"sync"
+	"time"
+
+	"github.com/ekuiper-tpu/sdk-go/api"
+	"github.com/ekuiper-tpu/sdk-go/connection"
+	sdkcontext "github.com/ekuiper-tpu/sdk-go/context"
+)
+
+// NewXFunc factories let the runtime build a fresh symbol instance per
+// start command (matching the Python SDK, which instantiates per start).
+type (
+	NewSourceFunc   func() api.Source
+	NewFunctionFunc func() api.Function
+	NewSinkFunc     func() api.Sink
+)
+
+// PluginConfig declares the symbols this worker serves. Name must match the
+// descriptor json the engine installed.
+type PluginConfig struct {
+	Name      string
+	Sources   map[string]NewSourceFunc
+	Functions map[string]NewFunctionFunc
+	Sinks     map[string]NewSinkFunc
+}
+
+// wire message shapes; field order here defines the marshaled byte layout
+// the golden fixtures in tests/fixtures/go_sdk/ pin down.
+type handshake struct {
+	Status string `json:"status"`
+	Name   string `json:"name"`
+	Pid    int    `json:"pid"`
+}
+
+type command struct {
+	Cmd  string  `json:"cmd"`
+	Ctrl control `json:"ctrl"`
+}
+
+type control struct {
+	SymbolName string                 `json:"symbolName"`
+	PluginType string                 `json:"pluginType"`
+	DataSource string                 `json:"dataSource"`
+	Config     map[string]interface{} `json:"config"`
+	Meta       meta                   `json:"meta"`
+}
+
+type meta struct {
+	RuleId     string `json:"ruleId"`
+	OpId       string `json:"opId"`
+	InstanceId int    `json:"instanceId"`
+}
+
+type reply struct {
+	State  string      `json:"state"`
+	Result interface{} `json:"result,omitempty"`
+}
+
+type funcCall struct {
+	Func string            `json:"func"`
+	Args []json.RawMessage `json:"args"`
+}
+
+func okReply() []byte {
+	b, _ := json.Marshal(reply{State: "ok"})
+	return b
+}
+
+func errReply(msg string) []byte {
+	b, _ := json.Marshal(reply{State: "error", Result: msg})
+	return b
+}
+
+// runner is one live symbol instance.
+type runner struct {
+	stop func()
+}
+
+// runnerKey must match the engine's start/stop pairing: symbol name plus
+// the canonical (sorted-key) JSON of the meta object.
+func runnerKey(sym string, m meta) string {
+	canon, _ := json.Marshal(map[string]interface{}{
+		"ruleId": m.RuleId, "opId": m.OpId, "instanceId": m.InstanceId,
+	}) // Go marshals map keys sorted — canonical by construction
+	return sym + ":" + string(canon)
+}
+
+// Start serves the plugin until the engine closes the control channel.
+// It blocks; call it from main().
+func Start(cfg PluginConfig) error {
+	ctrlConn, err := connection.Dial(
+		connection.URL("plugin_"+cfg.Name), 15*time.Second)
+	if err != nil {
+		return err
+	}
+	defer ctrlConn.Close()
+	hs, _ := json.Marshal(handshake{Status: "ok", Name: cfg.Name, Pid: os.Getpid()})
+	if err := ctrlConn.Send(hs); err != nil {
+		return err
+	}
+
+	root := sdkcontext.Background()
+	logger := root.GetLogger()
+	runners := map[string]*runner{}
+	var mu sync.Mutex
+	defer func() {
+		mu.Lock()
+		for _, r := range runners {
+			r.stop()
+		}
+		mu.Unlock()
+	}()
+
+	for {
+		raw, err := ctrlConn.Recv(time.Second)
+		if errors.Is(err, os.ErrDeadlineExceeded) {
+			continue
+		}
+		if err != nil {
+			if errors.Is(err, connection.ErrClosed) {
+				return nil // engine shut down — normal exit
+			}
+			return err
+		}
+		var cmd command
+		if err := json.Unmarshal(raw, &cmd); err != nil {
+			_ = ctrlConn.Send(errReply(fmt.Sprintf("bad command: %v", err)))
+			continue
+		}
+		key := runnerKey(cmd.Ctrl.SymbolName, cmd.Ctrl.Meta)
+		switch cmd.Cmd {
+		case "start":
+			r, err := startSymbol(cfg, cmd.Ctrl, root)
+			if err != nil {
+				logger.Errorf("start %s: %v", cmd.Ctrl.SymbolName, err)
+				_ = ctrlConn.Send(errReply(err.Error()))
+				continue
+			}
+			mu.Lock()
+			runners[key] = r
+			mu.Unlock()
+			_ = ctrlConn.Send(okReply())
+		case "stop":
+			mu.Lock()
+			r := runners[key]
+			delete(runners, key)
+			mu.Unlock()
+			if r != nil {
+				r.stop()
+			}
+			_ = ctrlConn.Send(okReply())
+		case "ping":
+			_ = ctrlConn.Send(okReply())
+		default:
+			_ = ctrlConn.Send(errReply("unknown cmd " + cmd.Cmd))
+		}
+	}
+}
+
+func startSymbol(cfg PluginConfig, ctrl control, root api.StreamContext) (*runner, error) {
+	sym := ctrl.SymbolName
+	ctx := root.WithMeta(ctrl.Meta.RuleId, ctrl.Meta.OpId).
+		WithInstance(ctrl.Meta.InstanceId)
+	switch ctrl.PluginType {
+	case "function":
+		nf := cfg.Functions[sym]
+		if nf == nil {
+			return nil, fmt.Errorf("symbol %s not found in plugin %s", sym, cfg.Name)
+		}
+		return runFunction(sym, nf(), ctx)
+	case "source":
+		ns := cfg.Sources[sym]
+		if ns == nil {
+			return nil, fmt.Errorf("symbol %s not found in plugin %s", sym, cfg.Name)
+		}
+		return runSource(ctrl, ns(), ctx)
+	case "sink":
+		nk := cfg.Sinks[sym]
+		if nk == nil {
+			return nil, fmt.Errorf("symbol %s not found in plugin %s", sym, cfg.Name)
+		}
+		return runSink(ctrl, nk(), ctx)
+	}
+	return nil, fmt.Errorf("unknown pluginType %q", ctrl.PluginType)
+}
+
+// dataURL derives the data channel name for a source/sink symbol.
+func dataURL(kind string, m meta) string {
+	return connection.URL(fmt.Sprintf("%s_%s_%s_%d", kind, m.RuleId, m.OpId, m.InstanceId))
+}
+
+// ---------------------------------------------------------------- function
+
+func runFunction(sym string, f api.Function, sctx api.StreamContext) (*runner, error) {
+	conn, err := connection.Dial(connection.URL("func_"+sym), 10*time.Second)
+	if err != nil {
+		return nil, err
+	}
+	ctx, cancel := sctx.WithCancel()
+	fctx := sdkcontext.NewFuncContext(ctx, 0)
+	go func() {
+		defer conn.Close()
+		for ctx.Err() == nil {
+			raw, err := conn.Recv(500 * time.Millisecond)
+			if errors.Is(err, os.ErrDeadlineExceeded) {
+				continue
+			}
+			if err != nil {
+				return
+			}
+			var call funcCall
+			var resp []byte
+			if err := json.Unmarshal(raw, &call); err != nil {
+				resp = errReply(fmt.Sprintf("bad request: %v", err))
+			} else {
+				resp = dispatchFunc(f, &call, fctx)
+			}
+			if err := conn.Send(resp); err != nil {
+				return
+			}
+		}
+	}()
+	return &runner{stop: func() {
+		cancel()
+		conn.Close()
+		_ = f.Close(sctx)
+	}}, nil
+}
+
+func dispatchFunc(f api.Function, call *funcCall, fctx api.FunctionContext) []byte {
+	decode := func(raws []json.RawMessage) []interface{} {
+		out := make([]interface{}, len(raws))
+		for i, r := range raws {
+			_ = json.Unmarshal(r, &out[i])
+		}
+		return out
+	}
+	switch call.Func {
+	case "Validate":
+		if err := f.Validate(decode(call.Args)); err != nil {
+			return errReply(err.Error())
+		}
+		b, _ := json.Marshal(reply{State: "ok", Result: ""})
+		return b
+	case "Exec":
+		args := call.Args
+		if len(args) > 0 {
+			args = args[:len(args)-1] // engine appends the call context
+		}
+		res, ok := f.Exec(decode(args), fctx)
+		if !ok {
+			return errReply(fmt.Sprint(res))
+		}
+		b, err := json.Marshal(reply{State: "ok", Result: res})
+		if err != nil {
+			return errReply(fmt.Sprintf("unserializable result: %v", err))
+		}
+		return b
+	case "IsAggregate":
+		b, _ := json.Marshal(reply{State: "ok", Result: f.IsAggregate()})
+		return b
+	}
+	return errReply("unknown func " + call.Func)
+}
+
+// ------------------------------------------------------------------ source
+
+func runSource(ctrl control, s api.Source, sctx api.StreamContext) (*runner, error) {
+	if err := s.Configure(ctrl.DataSource, ctrl.Config); err != nil {
+		return nil, err
+	}
+	conn, err := connection.Dial(dataURL("source", ctrl.Meta), 10*time.Second)
+	if err != nil {
+		return nil, err
+	}
+	ctx, cancel := sctx.WithCancel()
+	consumer := make(chan api.SourceTuple, 64)
+	errCh := make(chan error, 1)
+	go s.Open(ctx, consumer, errCh)
+	go func() {
+		defer conn.Close()
+		defer cancel() // tear the symbol down on any exit path so Open stops
+		for {
+			select {
+			case <-ctx.Done():
+				return
+			case err := <-errCh:
+				ctx.GetLogger().Errorf("source %s: %v", ctrl.SymbolName, err)
+				return
+			case t := <-consumer:
+				b, err := json.Marshal(t.Message())
+				if err != nil {
+					ctx.GetLogger().Errorf("source %s: unserializable tuple: %v",
+						ctrl.SymbolName, err)
+					continue
+				}
+				if err := conn.Send(b); err != nil {
+					return
+				}
+			}
+		}
+	}()
+	return &runner{stop: func() {
+		cancel()
+		conn.Close()
+		_ = s.Close(sctx)
+	}}, nil
+}
+
+// -------------------------------------------------------------------- sink
+
+func runSink(ctrl control, k api.Sink, sctx api.StreamContext) (*runner, error) {
+	if err := k.Configure(ctrl.Config); err != nil {
+		return nil, err
+	}
+	conn, err := connection.Dial(dataURL("sink", ctrl.Meta), 10*time.Second)
+	if err != nil {
+		return nil, err
+	}
+	ctx, cancel := sctx.WithCancel()
+	if err := k.Open(ctx); err != nil {
+		cancel()
+		conn.Close()
+		return nil, err
+	}
+	go func() {
+		defer conn.Close()
+		for ctx.Err() == nil {
+			raw, err := conn.Recv(500 * time.Millisecond)
+			if errors.Is(err, os.ErrDeadlineExceeded) {
+				continue
+			}
+			if err != nil {
+				return
+			}
+			var data interface{}
+			if err := json.Unmarshal(raw, &data); err != nil {
+				ctx.GetLogger().Errorf("sink %s: bad payload: %v", ctrl.SymbolName, err)
+				continue
+			}
+			if err := k.Collect(ctx, data); err != nil {
+				ctx.GetLogger().Errorf("sink %s: collect: %v", ctrl.SymbolName, err)
+			}
+		}
+	}()
+	return &runner{stop: func() {
+		cancel()
+		conn.Close()
+		_ = k.Close(sctx)
+	}}, nil
+}

@@ -1,0 +1,174 @@
+#!/usr/bin/env python3
+"""Byte-replay stand-in for a compiled Go SDK worker (sdk/go).
+
+Installed with "language": "binary" so the engine execs it exactly like a
+Go binary. It does NOT import the repo's ipc/sdk modules: transport is raw
+unix sockets + 4-byte LE framing, re-implemented here straight from
+docs/PLUGIN_WIRE_PROTOCOL.md the way sdk/go/connection/connection.go does,
+and every worker->engine payload is the corresponding golden byte string
+from frames.json — the exact bytes the Go runtime marshals. This proves the
+Go SDK's wire bytes interoperate with the real engine side without a Go
+toolchain in the image.
+
+Engine->worker payloads are appended to $GO_WORKER_LOG (JSON lines) so the
+test can assert what the engine actually sent.
+"""
+import json
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FRAMES = json.load(open(os.path.join(HERE, "frames.json")))
+GOLD = {k: v.encode() for k, v in FRAMES["worker_to_engine"].items()}
+LOG_PATH = os.environ.get("GO_WORKER_LOG", "")
+_log_mu = threading.Lock()
+
+
+def log_frame(channel, payload):
+    if not LOG_PATH:
+        return
+    with _log_mu:
+        with open(LOG_PATH, "a") as f:
+            f.write(json.dumps({"channel": channel,
+                                "payload": payload.decode()}) + "\n")
+
+
+def runtime_dir():
+    d = os.environ.get("EKUIPER_TPU_RUNTIME_DIR")
+    if d:
+        return d
+    ns = os.environ.get("EKUIPER_TPU_IPC_NS", str(os.getpid()))
+    return os.path.join("/tmp", f"ektpu_{ns}")
+
+
+def dial(name, timeout=10.0):
+    path = os.path.join(runtime_dir(), name + ".ipc")
+    deadline = time.time() + timeout
+    while True:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            s.connect(path)
+            return s
+        except OSError:
+            s.close()
+            if time.time() > deadline:
+                raise
+            time.sleep(0.05)
+
+
+def send_frame(s, payload):
+    s.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def recv_frame(s):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = s.recv(4 - len(hdr))
+        if not chunk:
+            raise EOFError
+        hdr += chunk
+    n = struct.unpack("<I", hdr)[0]
+    buf = b""
+    while len(buf) < n:
+        chunk = s.recv(n - len(buf))
+        if not chunk:
+            raise EOFError
+        buf += chunk
+    return buf
+
+
+def serve_function(sym):
+    s = dial(f"func_{sym}")
+    try:
+        while True:
+            raw = recv_frame(s)
+            log_frame(f"func_{sym}", raw)
+            req = json.loads(raw)
+            fn = req.get("func")
+            if fn == "Exec":
+                # echo: mirror args[0]; the test invokes echo("abc") so the
+                # golden reply bytes apply verbatim
+                assert req["args"][0] == "abc", req
+                send_frame(s, GOLD["reply_exec_echo"])
+            elif fn == "Validate":
+                send_frame(s, GOLD["reply_validate_ok"])
+            elif fn == "IsAggregate":
+                send_frame(s, GOLD["reply_is_aggregate"])
+            else:
+                send_frame(s, GOLD["reply_unknown_symbol"])
+    except (EOFError, OSError):
+        pass
+    finally:
+        s.close()
+
+
+def serve_source(meta):
+    tag = f"{meta.get('ruleId','r')}_{meta.get('opId','o')}_{meta.get('instanceId',0)}"
+    s = dial(f"source_{tag}")
+    try:
+        for key in ("source_tuple_1", "source_tuple_2", "source_tuple_3"):
+            send_frame(s, GOLD[key])
+        time.sleep(5)  # hold the channel open until stopped
+    except OSError:
+        pass
+    finally:
+        s.close()
+
+
+def serve_sink(meta):
+    tag = f"{meta.get('ruleId','r')}_{meta.get('opId','o')}_{meta.get('instanceId',0)}"
+    s = dial(f"sink_{tag}")
+    try:
+        while True:
+            raw = recv_frame(s)
+            log_frame(f"sink_{tag}", raw)
+    except (EOFError, OSError):
+        pass
+    finally:
+        s.close()
+
+
+def main():
+    ctrl = dial("plugin_gomirror", timeout=15.0)
+    send_frame(ctrl, GOLD["handshake"])
+    try:
+        while True:
+            raw = recv_frame(ctrl)
+            log_frame("control", raw)
+            cmd = json.loads(raw)
+            op = cmd.get("cmd")
+            c = cmd.get("ctrl") or {}
+            sym = c.get("symbolName", "")
+            if op == "start":
+                kind = c.get("pluginType")
+                if kind == "function" and sym == "echo":
+                    threading.Thread(target=serve_function, args=(sym,),
+                                     daemon=True).start()
+                elif kind == "source" and sym == "random":
+                    threading.Thread(target=serve_source,
+                                     args=(c.get("meta") or {},),
+                                     daemon=True).start()
+                elif kind == "sink" and sym == "file":
+                    threading.Thread(target=serve_sink,
+                                     args=(c.get("meta") or {},),
+                                     daemon=True).start()
+                else:
+                    send_frame(ctrl, GOLD["reply_unknown_symbol"])
+                    continue
+                send_frame(ctrl, GOLD["reply_ok"])
+            elif op in ("stop", "ping"):
+                send_frame(ctrl, GOLD["reply_ok"])
+            else:
+                send_frame(ctrl, GOLD["reply_unknown_symbol"])
+    except (EOFError, OSError):
+        pass
+    finally:
+        ctrl.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,166 @@
+"""Go SDK interop: golden byte fixtures replayed through the real engine.
+
+The image has no Go toolchain, so sdk/go is validated the way the round-3/4
+verdicts prescribed: tests/fixtures/go_sdk/frames.json pins the EXACT wire
+payloads the Go runtime marshals (encoding/json struct-field order — see the
+wire structs in sdk/go/runtime/runtime.go), and goworker.py — installed as a
+"language": "binary" plugin, exactly how a compiled Go worker installs —
+replays those bytes over raw unix sockets with the 4-byte LE framing of
+sdk/go/connection/connection.go against the REAL engine side
+(PluginIns handshake, control req/rep, PortableFunc/Source/Sink channels).
+
+If the Go toolchain ever lands in the image, test_go_build compiles the SDK
+for real (skipped otherwise)."""
+import json
+import os
+import shutil
+import struct
+import subprocess
+import time
+
+import pytest
+
+from ekuiper_tpu.plugin.manager import PluginMeta, PortableManager
+from ekuiper_tpu.plugin.portable import PortableFunc, PortableSink, PortableSource
+
+HERE = os.path.dirname(__file__)
+FIXDIR = os.path.join(HERE, "fixtures", "go_sdk")
+WORKER = os.path.join(FIXDIR, "goworker.py")
+GO_SDK = os.path.join(HERE, "..", "sdk", "go")
+
+with open(os.path.join(FIXDIR, "frames.json")) as f:
+    FRAMES = json.load(f)
+
+
+# ------------------------------------------------------------------- framing
+def test_golden_payloads_are_valid_json():
+    for name, payload in FRAMES["worker_to_engine"].items():
+        doc = json.loads(payload)
+        assert isinstance(doc, dict), name
+
+
+def test_frame_layout_matches_engine_framing(tmp_path):
+    """A frame built per connection.go (uint32 LE + payload) must be decoded
+    intact by the engine's ipc layer (both implementations)."""
+    from ekuiper_tpu.plugin import ipc
+
+    payload = FRAMES["worker_to_engine"]["handshake"].encode()
+    frame = struct.pack("<I", len(payload)) + payload
+
+    import socket as pysock
+    import threading
+
+    url = f"ipc://{tmp_path}/frame.ipc"
+    host = ipc.Socket(ipc.PAIR)
+    host.listen(url)
+
+    def raw_dial_and_send():
+        s = pysock.socket(pysock.AF_UNIX, pysock.SOCK_STREAM)
+        deadline = time.time() + 5
+        while True:
+            try:
+                s.connect(str(tmp_path / "frame.ipc"))
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+        s.sendall(frame)
+        time.sleep(0.2)
+        s.close()
+
+    t = threading.Thread(target=raw_dial_and_send)
+    t.start()
+    got = host.recv(5000)
+    t.join(timeout=5)
+    host.close()
+    assert got == payload
+
+
+# ------------------------------------------------------- engine interop (e2e)
+@pytest.fixture
+def go_manager(tmp_path, monkeypatch):
+    log = tmp_path / "frames.log"
+    monkeypatch.setenv("GO_WORKER_LOG", str(log))
+    mgr = PortableManager()
+    mgr.register(PluginMeta(
+        name="gomirror", executable=WORKER, language="binary",
+        sources=["random"], sinks=["file"], functions=["echo"],
+    ))
+    yield mgr, log
+    mgr.kill_all()
+
+
+def _engine_frames(log, channel):
+    if not log.exists():
+        return []
+    return [json.loads(l)["payload"] for l in log.read_text().splitlines()
+            if json.loads(l)["channel"].startswith(channel)]
+
+
+def test_go_worker_function_roundtrip(go_manager):
+    mgr, log = go_manager
+    fn = PortableFunc(mgr, "gomirror", "echo")
+    assert fn.exec("abc") == "abc"
+    assert fn.validate(["x"]) == ""
+    assert fn.is_aggregate() is False
+    fn.close()
+    # the engine->worker bytes must match what runtime.go's funcCall expects
+    sent = [json.loads(p) for p in _engine_frames(log, "func_echo")]
+    execs = [m for m in sent if m.get("func") == "Exec"]
+    exp = FRAMES["expect_engine_to_worker"]["func_exec"]
+    assert execs and execs[0]["args"][:1] == exp["args_prefix"]
+    assert {m["func"] for m in sent} >= {"Exec", "Validate", "IsAggregate"}
+    ctrl = [json.loads(p) for p in _engine_frames(log, "control")]
+    starts = [m for m in ctrl if m.get("cmd") == "start"]
+    assert starts and starts[0]["ctrl"]["symbolName"] == "echo"
+    assert starts[0]["ctrl"]["pluginType"] == "function"
+
+
+def test_go_worker_source_pushes_golden_tuples(go_manager):
+    mgr, log = go_manager
+    src = PortableSource(mgr, "gomirror", "random")
+    src.configure("", {})
+    got = []
+    src.open(lambda payload, meta=None: got.append(payload))
+    deadline = time.monotonic() + 10
+    while len(got) < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    src.close()
+    assert [t["count"] for t in got[:3]] == [1, 2, 3]
+    assert got[0]["value"] == 0.25
+
+
+def test_go_worker_sink_receives_rows(go_manager):
+    mgr, log = go_manager
+    sink = PortableSink(mgr, "gomirror", "file")
+    sink.configure({"path": "/dev/null"})
+    sink.connect()
+    sink.collect({"a": 1})
+    sink.collect([{"b": 2}, {"b": 3}])
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if len(_engine_frames(log, "sink_")) >= 2:
+            break
+        time.sleep(0.05)
+    sink.close()
+    rows = [json.loads(p) for p in _engine_frames(log, "sink_")]
+    assert {"a": 1} in rows and [{"b": 2}, {"b": 3}] in rows
+
+
+def test_go_worker_unknown_symbol_errors(go_manager):
+    mgr, log = go_manager
+    ins = mgr.get_or_start("gomirror")
+    from ekuiper_tpu.utils.infra import EngineError
+
+    with pytest.raises(EngineError, match="not found"):
+        ins.command("start", {"symbolName": "nope", "pluginType": "function",
+                              "meta": {}})
+
+
+# ----------------------------------------------------------- real toolchain
+@pytest.mark.skipif(shutil.which("go") is None, reason="no Go toolchain")
+def test_go_build():
+    r = subprocess.run(["go", "build", "./..."], cwd=GO_SDK,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
