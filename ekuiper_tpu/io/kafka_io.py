@@ -1,0 +1,203 @@
+"""Kafka source & sink — analogue of the reference's kafka extension
+(extensions/impl/kafka/source.go, sink.go), built on the bundled wire
+client (io/kafka_wire.py) instead of kafka-go.
+
+Divergence (documented, COMPONENTS.md row 53): no consumer-group protocol.
+The reference's source uses a groupID for broker-side offset tracking; this
+engine tracks offsets through its own checkpoint machinery instead — the
+source is Rewindable (io/contract.py), so offsets ride the rule's
+checkpoint barriers and recovery replays from the exact checkpointed
+position (at-least-once, same guarantee the reference gets from committing
+group offsets after processing). A groupID prop is accepted and ignored
+with a warning.
+
+Source props: brokers, partition (int, default all partitions), offset
+("earliest" | "latest" | int, default earliest — matching kafka-go's
+group-less default), maxBytes, pollInterval (ms between empty polls).
+Sink props: brokers, topic, key (static message key), partition (int,
+default round-robin), requiredACKs (-1/0/1), batchSize, format.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.infra import EngineError, logger
+from .contract import Rewindable, Sink, Source
+from .converters import get_converter
+from .kafka_wire import KafkaClient
+
+
+class KafkaSource(Source, Rewindable):
+    def __init__(self) -> None:
+        self.topic = ""
+        self.brokers = ""
+        self.partition: Optional[int] = None
+        self.start = "earliest"
+        self.max_bytes = 1_000_000
+        self.poll_interval = 0.1
+        self._client: Optional[KafkaClient] = None
+        self._offsets: Dict[int, int] = {}  # partition -> next fetch offset
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._mu = threading.Lock()
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.topic = datasource or props.get("topic", "")
+        self.brokers = props.get("brokers", "")
+        if not self.topic:
+            raise EngineError("kafka source requires a topic (datasource)")
+        if not self.brokers:
+            raise EngineError("kafka: brokers can not be empty")
+        if props.get("groupID"):
+            logger.warning(
+                "kafka source: groupID %r ignored — offsets are engine-"
+                "checkpointed (Rewindable), not group-committed",
+                props["groupID"])
+        p = props.get("partition")
+        self.partition = int(p) if p is not None else None
+        self.start = props.get("offset", "earliest")
+        self.max_bytes = int(props.get("maxBytes", 1_000_000))
+        self.poll_interval = float(props.get("pollInterval", 100)) / 1000.0
+
+    def _init_offsets(self, client: KafkaClient) -> None:
+        parts = ([self.partition] if self.partition is not None
+                 else client.partitions(self.topic))
+        with self._mu:
+            for p in parts:
+                if p in self._offsets:
+                    continue  # rewound before open — keep the checkpoint
+                if self.start == "latest":
+                    self._offsets[p] = client.latest_offset(self.topic, p)
+                elif self.start == "earliest":
+                    self._offsets[p] = client.earliest_offset(self.topic, p)
+                else:
+                    self._offsets[p] = int(self.start)
+
+    def open(self, ingest) -> None:
+        self._client = KafkaClient(self.brokers)
+        self._init_offsets(self._client)
+
+        def loop() -> None:
+            client = self._client
+            # per-partition consecutive-failure count: a poison offset (e.g.
+            # a snappy-compressed batch this client can't decode) must not
+            # hot-loop — back off exponentially (1s..30s) and escalate the
+            # log to error so the stall is visible, but never silently skip
+            # data (at-least-once forbids it)
+            fails: Dict[int, int] = {}
+            while not self._stop.is_set():
+                got_any = False
+                with self._mu:
+                    positions = dict(self._offsets)
+                for p, off in positions.items():
+                    if self._stop.is_set():
+                        break
+                    try:
+                        _, msgs = client.fetch(
+                            self.topic, p, off, max_bytes=self.max_bytes,
+                            max_wait_ms=int(self.poll_interval * 1000))
+                        fails.pop(p, None)
+                    except Exception as e:
+                        n = fails.get(p, 0) + 1
+                        fails[p] = n
+                        log = logger.error if n >= 3 else logger.warning
+                        log("kafka fetch %s/%d at offset %d (attempt %d): %s",
+                            self.topic, p, off, n, e)
+                        self._stop.wait(min(2.0 ** (n - 1), 30.0))
+                        continue
+                    for moff, key, value, ts in msgs:
+                        ingest(value, {
+                            "topic": self.topic, "partition": p,
+                            "offset": moff, "timestamp": ts,
+                            "key": key.decode(errors="replace") if key else None,
+                        })
+                        got_any = True
+                    if msgs:
+                        with self._mu:
+                            # a rewind() that raced this batch wins — don't
+                            # advance past it (recovery must replay; extra
+                            # duplicates are fine under at-least-once)
+                            if self._offsets.get(p) == off:
+                                self._offsets[p] = msgs[-1][0] + 1
+                if not got_any:
+                    self._stop.wait(self.poll_interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"kafka-src-{self.topic}")
+        self._thread.start()
+
+    # Rewindable: offsets ride the rule checkpoint (nodes_source.py:284)
+    def get_offset(self) -> Any:
+        with self._mu:
+            return {str(p): o for p, o in self._offsets.items()}
+
+    def rewind(self, offset: Any) -> None:
+        if not isinstance(offset, dict):
+            return
+        with self._mu:
+            for p, o in offset.items():
+                self._offsets[int(p)] = int(o)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+class KafkaSink(Sink):
+    def __init__(self) -> None:
+        self.topic = ""
+        self.brokers = ""
+        self.key: Optional[str] = None
+        self.partition: Optional[int] = None
+        self.acks = 1
+        self.format = "json"
+        self._client: Optional[KafkaClient] = None
+        self._parts: List[int] = []
+        self._rr = 0
+
+    def configure(self, props: Dict[str, Any]) -> None:
+        self.topic = props.get("topic", "")
+        self.brokers = props.get("brokers", "")
+        if not self.topic:
+            raise EngineError("kafka sink requires topic")
+        if not self.brokers:
+            raise EngineError("kafka: brokers can not be empty")
+        self.key = props.get("key") or None
+        p = props.get("partition")
+        self.partition = int(p) if p is not None else None
+        self.acks = int(props.get("requiredACKs", 1))
+        self.format = props.get("format", "json")
+
+    def connect(self) -> None:
+        self._client = KafkaClient(self.brokers)
+        self._parts = ([self.partition] if self.partition is not None
+                       else self._client.partitions(self.topic))
+
+    def collect(self, item: Any) -> None:
+        if self._client is None:
+            self.connect()
+        conv = get_converter(self.format)
+        rows = item if isinstance(item, list) else [item]
+        now = int(time.time() * 1000)
+        key = self.key.encode() if self.key else None
+        msgs = []
+        for row in rows:
+            payload = row if isinstance(row, (bytes, bytearray)) \
+                else conv.encode(row)
+            if isinstance(payload, str):
+                payload = payload.encode()
+            msgs.append((key, bytes(payload), now))
+        part = self._parts[self._rr % len(self._parts)]
+        self._rr += 1
+        self._client.produce(self.topic, part, msgs, acks=self.acks)
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None

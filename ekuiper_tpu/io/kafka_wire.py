@@ -1,0 +1,443 @@
+"""Minimal Kafka wire-protocol client — no kafka-python/librdkafka needed.
+
+The reference treats Kafka as a first-class extension built on segmentio's
+kafka-go (extensions/impl/kafka/source.go, sink.go); this image bundles no
+Kafka client, so the connector speaks the broker protocol directly over the
+engine's own sockets, the same way the MQTT connector bundles a native
+3.1.1 client (io/mqtt_native.py).
+
+Implements the five RPCs a group-less producer/consumer needs, pinned to
+legacy (non-flexible, big-endian) versions every broker since 0.10 serves:
+
+    ApiVersions v0   handshake / liveness
+    Metadata    v1   topic -> partition -> leader routing
+    ListOffsets v1   earliest/latest offset resolution
+    Produce     v2   MessageSet magic=1 (CRC32, timestamps)
+    Fetch       v2   MessageSet magic=1 decode (incl. partial trailing entry)
+
+Offsets are managed by the caller (the engine checkpoints them through the
+Rewindable contract, io/contract.py) — the consumer-group protocol is
+deliberately NOT implemented; see io/kafka_io.py for the divergence note.
+"""
+from __future__ import annotations
+
+import gzip
+import socket
+import struct
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.infra import EngineError
+
+_LATEST, _EARLIEST = -1, -2
+
+
+class KafkaTransportError(EngineError):
+    """Connection-level failure (hangup, desync, truncation): the cached
+    connection must be dropped and redialed. Distinct from broker-reported
+    errors (UNKNOWN_TOPIC, NOT_LEADER, ...), which leave the stream valid."""
+
+
+# ----------------------------------------------------------------- encoding
+def _i16(v: int) -> bytes:
+    return struct.pack(">h", v)
+
+
+def _i32(v: int) -> bytes:
+    return struct.pack(">i", v)
+
+
+def _i64(v: int) -> bytes:
+    return struct.pack(">q", v)
+
+
+def _string(s: Optional[str]) -> bytes:
+    if s is None:
+        return _i16(-1)
+    b = s.encode()
+    return _i16(len(b)) + b
+
+
+def _bytes(b: Optional[bytes]) -> bytes:
+    if b is None:
+        return _i32(-1)
+    return _i32(len(b)) + b
+
+
+def _array(items: List[bytes]) -> bytes:
+    return _i32(len(items)) + b"".join(items)
+
+
+class _Reader:
+    """Cursor over a response body."""
+
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise KafkaTransportError("kafka: truncated response")
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def i8(self) -> int:
+        return struct.unpack(">b", self._take(1))[0]
+
+    def i16(self) -> int:
+        return struct.unpack(">h", self._take(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def string(self) -> Optional[str]:
+        n = self.i16()
+        return None if n < 0 else self._take(n).decode()
+
+    def bytes_(self) -> Optional[bytes]:
+        n = self.i32()
+        return None if n < 0 else self._take(n)
+
+    def remaining(self) -> int:
+        return len(self.data) - self.pos
+
+
+# -------------------------------------------------------------- message set
+def encode_message_set(messages: List[Tuple[Optional[bytes], bytes, int]]) -> bytes:
+    """messages: [(key, value, timestamp_ms)] -> MessageSet magic=1 bytes.
+    Producer-side offsets are placeholders (the broker assigns real ones)."""
+    out = []
+    for i, (key, value, ts) in enumerate(messages):
+        body = (struct.pack(">bb", 1, 0) + _i64(ts) + _bytes(key)
+                + _bytes(value))
+        crc = zlib.crc32(body) & 0xFFFFFFFF
+        msg = struct.pack(">I", crc) + body
+        out.append(_i64(i) + _i32(len(msg)) + msg)
+    return b"".join(out)
+
+
+def decode_message_set(data: bytes) -> List[Tuple[int, Optional[bytes], bytes, int]]:
+    """MessageSet bytes -> [(offset, key, value, timestamp_ms)]. A fetch may
+    end with a partially-transferred entry — it is silently dropped (the
+    next fetch re-reads it), per protocol."""
+    out: List[Tuple[int, Optional[bytes], bytes, int]] = []
+    pos = 0
+    while pos + 12 <= len(data):
+        offset, size = struct.unpack(">qi", data[pos:pos + 12])
+        if pos + 12 + size > len(data):
+            break  # partial trailing message
+        r = _Reader(data[pos + 12:pos + 12 + size])
+        crc = r.i32() & 0xFFFFFFFF
+        body = r.data[r.pos:]
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            raise EngineError(f"kafka: bad message CRC at offset {offset}")
+        magic = r.i8()
+        attrs = r.i8()
+        codec = attrs & 0x07
+        ts = r.i64() if magic >= 1 else -1
+        key = r.bytes_()
+        value = r.bytes_() or b""
+        if codec == 0:
+            out.append((offset, key, value, ts))
+        elif codec == 1:
+            # gzip wrapper message: the value is an inner message set whose
+            # entries carry relative offsets (magic 1) anchored so the LAST
+            # inner message has the wrapper's offset
+            inner = decode_message_set(gzip.decompress(value))
+            if inner:
+                base = offset - inner[-1][0]
+                out.extend((base + o, k, v, t) for o, k, v, t in inner)
+        else:
+            codec_name = {2: "snappy", 3: "lz4", 4: "zstd"}.get(codec, str(codec))
+            raise EngineError(
+                f"kafka: {codec_name}-compressed message set at offset "
+                f"{offset} — only gzip/uncompressed supported; set the "
+                "producer's compression.type accordingly")
+        pos += 12 + size
+    return out
+
+
+# ------------------------------------------------------------------- client
+class _BrokerConn:
+    """One TCP connection to one broker; int32-size-framed req/rep."""
+
+    def __init__(self, host: str, port: int, client_id: str,
+                 timeout: float) -> None:
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.client_id = client_id
+        self.corr = 0
+        self.lock = threading.Lock()
+
+    def request(self, api_key: int, api_version: int, body: bytes,
+                timeout: Optional[float] = None) -> _Reader:
+        with self.lock:
+            self.corr += 1
+            corr = self.corr
+            hdr = (_i16(api_key) + _i16(api_version) + _i32(corr)
+                   + _string(self.client_id))
+            payload = hdr + body
+            if timeout is not None:
+                self.sock.settimeout(timeout)
+            self.sock.sendall(_i32(len(payload)) + payload)
+            raw = self._recv_frame()
+        r = _Reader(raw)
+        got = r.i32()
+        if got != corr:
+            raise KafkaTransportError(
+                f"kafka: correlation mismatch {got} != {corr}")
+        return r
+
+    def _recv_frame(self) -> bytes:
+        hdr = self._recv_n(4)
+        n = struct.unpack(">i", hdr)[0]
+        return self._recv_n(n)
+
+    def _recv_n(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise KafkaTransportError("kafka: broker closed connection")
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+ERRS = {
+    0: "NONE", 1: "OFFSET_OUT_OF_RANGE", 3: "UNKNOWN_TOPIC_OR_PARTITION",
+    5: "LEADER_NOT_AVAILABLE", 6: "NOT_LEADER_FOR_PARTITION",
+    7: "REQUEST_TIMED_OUT",
+}
+
+
+def _check(code: int, what: str) -> None:
+    if code != 0:
+        raise EngineError(
+            f"kafka: {what} failed: {ERRS.get(code, 'error')} ({code})")
+
+
+class KafkaClient:
+    """Partition-leader-aware client over one or more bootstrap brokers."""
+
+    def __init__(self, brokers: str, client_id: str = "ekuiper-tpu",
+                 timeout: float = 10.0) -> None:
+        self.bootstrap = [self._hostport(b) for b in brokers.split(",") if b]
+        if not self.bootstrap:
+            raise EngineError("kafka: brokers can not be empty")
+        self.client_id = client_id
+        self.timeout = timeout
+        self._conns: Dict[Tuple[str, int], _BrokerConn] = {}
+        self._leaders: Dict[Tuple[str, int], Tuple[str, int]] = {}
+        self._mu = threading.Lock()
+
+    @staticmethod
+    def _hostport(b: str) -> Tuple[str, int]:
+        host, _, port = b.strip().partition(":")
+        return host, int(port or 9092)
+
+    def _conn(self, addr: Tuple[str, int]) -> _BrokerConn:
+        with self._mu:
+            c = self._conns.get(addr)
+            if c is None:
+                c = _BrokerConn(addr[0], addr[1], self.client_id, self.timeout)
+                self._conns[addr] = c
+            return c
+
+    def _drop_conn(self, addr: Tuple[str, int]) -> None:
+        with self._mu:
+            c = self._conns.pop(addr, None)
+        if c is not None:
+            c.close()
+
+    def _any_request(self, api_key: int, api_version: int,
+                     body: bytes) -> _Reader:
+        """Serve a cluster-level RPC from any bootstrap broker. A transport
+        failure drops that broker's cached connection (a dead or desynced
+        conn must never poison the client) and tries the next; redial is
+        attempted once per broker."""
+        err: Optional[Exception] = None
+        for addr in self.bootstrap:
+            for _ in (0, 1):
+                try:
+                    return self._conn(addr).request(api_key, api_version, body)
+                except (OSError, KafkaTransportError) as e:
+                    err = e
+                    self._drop_conn(addr)
+        raise EngineError(f"kafka: no bootstrap broker reachable: {err}")
+
+    # ------------------------------------------------------------- metadata
+    def api_versions(self) -> Dict[int, Tuple[int, int]]:
+        r = self._any_request(18, 0, b"")
+        _check(r.i16(), "ApiVersions")
+        out = {}
+        for _ in range(r.i32()):
+            k, lo, hi = r.i16(), r.i16(), r.i16()
+            out[k] = (lo, hi)
+        return out
+
+    def metadata(self, topics: List[str]) -> Dict[str, Dict[int, Tuple[str, int]]]:
+        """topic -> partition -> leader (host, port); refreshes the leader
+        cache used by produce/fetch routing."""
+        body = _array([_string(t) for t in topics])
+        r = self._any_request(3, 1, body)
+        brokers: Dict[int, Tuple[str, int]] = {}
+        for _ in range(r.i32()):
+            node = r.i32()
+            host = r.string() or ""
+            port = r.i32()
+            r.string()  # rack
+            brokers[node] = (host, port)
+        r.i32()  # controller id
+        out: Dict[str, Dict[int, Tuple[str, int]]] = {}
+        for _ in range(r.i32()):
+            terr = r.i16()
+            topic = r.string() or ""
+            r.i8()  # is_internal
+            parts: Dict[int, Tuple[str, int]] = {}
+            for _ in range(r.i32()):
+                perr = r.i16()
+                pid = r.i32()
+                leader = r.i32()
+                for _ in range(r.i32()):
+                    r.i32()  # replicas
+                for _ in range(r.i32()):
+                    r.i32()  # isr
+                if perr == 0 and leader in brokers:
+                    parts[pid] = brokers[leader]
+            _check(terr, f"Metadata({topic})")
+            out[topic] = parts
+            with self._mu:
+                for pid, addr in parts.items():
+                    self._leaders[(topic, pid)] = addr
+        return out
+
+    def partitions(self, topic: str) -> List[int]:
+        md = self.metadata([topic])
+        parts = sorted(md.get(topic, {}))
+        if not parts:
+            raise EngineError(f"kafka: topic {topic} has no available partitions")
+        return parts
+
+    def _leader(self, topic: str, partition: int) -> Tuple[str, int]:
+        with self._mu:
+            addr = self._leaders.get((topic, partition))
+        if addr is None:
+            self.metadata([topic])
+            with self._mu:
+                addr = self._leaders.get((topic, partition))
+        if addr is None:
+            raise EngineError(f"kafka: no leader for {topic}/{partition}")
+        return addr
+
+    def _leader_request(self, topic: str, partition: int, api_key: int,
+                        api_version: int, body: bytes,
+                        timeout: Optional[float] = None) -> _Reader:
+        """Route to the partition leader; on connection failure, drop the
+        cached conn + leader and retry once via fresh metadata."""
+        for attempt in (0, 1):
+            addr = self._leader(topic, partition)
+            try:
+                return self._conn(addr).request(api_key, api_version, body,
+                                                timeout)
+            except (OSError, KafkaTransportError):
+                self._drop_conn(addr)
+                with self._mu:
+                    self._leaders.pop((topic, partition), None)
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
+    # -------------------------------------------------------------- offsets
+    def list_offset(self, topic: str, partition: int, ts: int = _LATEST) -> int:
+        """ts -1 = latest (next offset to be written), -2 = earliest."""
+        body = _i32(-1) + _array([
+            _string(topic) + _array([_i32(partition) + _i64(ts)])])
+        r = self._leader_request(topic, partition, 2, 1, body)
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()  # partition id
+                _check(r.i16(), f"ListOffsets({topic}/{partition})")
+                r.i64()  # timestamp
+                return r.i64()
+        raise EngineError("kafka: empty ListOffsets response")
+
+    def earliest_offset(self, topic: str, partition: int) -> int:
+        return self.list_offset(topic, partition, _EARLIEST)
+
+    def latest_offset(self, topic: str, partition: int) -> int:
+        return self.list_offset(topic, partition, _LATEST)
+
+    # -------------------------------------------------------------- produce
+    def produce(self, topic: str, partition: int,
+                messages: List[Tuple[Optional[bytes], bytes, int]],
+                acks: int = 1, timeout_ms: int = 10_000) -> int:
+        """Returns the base offset the broker assigned (-1 with acks=0)."""
+        mset = encode_message_set(messages)
+        body = (_i16(acks) + _i32(timeout_ms) + _array([
+            _string(topic) + _array([_i32(partition) + _bytes(mset)])]))
+        if acks == 0:
+            # fire-and-forget: broker sends no response
+            addr = self._leader(topic, partition)
+            conn = self._conn(addr)
+            with conn.lock:
+                conn.corr += 1
+                hdr = (_i16(0) + _i16(2) + _i32(conn.corr)
+                       + _string(self.client_id))
+                payload = hdr + body
+                conn.sock.sendall(_i32(len(payload)) + payload)
+            return -1
+        r = self._leader_request(topic, partition, 0, 2, body,
+                                 timeout=max(self.timeout,
+                                             timeout_ms / 1000 + 1))
+        base = -1
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()  # partition id
+                _check(r.i16(), f"Produce({topic}/{partition})")
+                base = r.i64()
+                r.i64()  # log_append_time
+        r.i32()  # throttle_time_ms
+        return base
+
+    # ---------------------------------------------------------------- fetch
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_bytes: int = 1_000_000, max_wait_ms: int = 500,
+              min_bytes: int = 1
+              ) -> Tuple[int, List[Tuple[int, Optional[bytes], bytes, int]]]:
+        """-> (high_watermark, [(offset, key, value, timestamp_ms)])."""
+        body = (_i32(-1) + _i32(max_wait_ms) + _i32(min_bytes) + _array([
+            _string(topic) + _array([
+                _i32(partition) + _i64(offset) + _i32(max_bytes)])]))
+        r = self._leader_request(topic, partition, 1, 2, body,
+                                 timeout=self.timeout + max_wait_ms / 1000)
+        r.i32()  # throttle_time_ms
+        hw, msgs = -1, []
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()  # partition id
+                _check(r.i16(), f"Fetch({topic}/{partition})")
+                hw = r.i64()
+                mset = r.bytes_() or b""
+                msgs = decode_message_set(mset)
+        return hw, msgs
+
+    def close(self) -> None:
+        with self._mu:
+            conns = list(self._conns.values())
+            self._conns.clear()
+            self._leaders.clear()
+        for c in conns:
+            c.close()

@@ -163,8 +163,12 @@ def _ensure() -> None:
 
         return _Gated
 
+    from .kafka_io import KafkaSink, KafkaSource
+
+    register_source("kafka", KafkaSource)
+    register_sink("kafka", KafkaSink)
+
     for kind, pkg, has_src, has_sink in (
-        ("kafka", "kafka-python", True, True),
         ("zmq", "pyzmq", True, True),
         ("video", "opencv-python", True, False),
     ):

@@ -61,6 +61,7 @@ type Conn struct {
 	// a read that straddles a timeout must not lose already-consumed bytes
 	// or the stream desyncs (the engine's ipc layer buffers the same way).
 	partial []byte
+	chunk   []byte // reusable read buffer (Recv polls every 500ms when idle)
 }
 
 // Dial connects to an ipc:// url, retrying until timeout so a worker that
@@ -100,7 +101,6 @@ func (c *Conn) Recv(timeout time.Duration) ([]byte, error) {
 		_ = c.c.SetReadDeadline(time.Now().Add(timeout))
 		defer c.c.SetReadDeadline(time.Time{})
 	}
-	chunk := make([]byte, 64*1024)
 	for {
 		if len(c.partial) >= 4 {
 			n := int(binary.LittleEndian.Uint32(c.partial[:4]))
@@ -111,9 +111,12 @@ func (c *Conn) Recv(timeout time.Duration) ([]byte, error) {
 				return payload, nil
 			}
 		}
-		k, err := c.c.Read(chunk)
+		if c.chunk == nil {
+			c.chunk = make([]byte, 64*1024)
+		}
+		k, err := c.c.Read(c.chunk)
 		if k > 0 {
-			c.partial = append(c.partial, chunk[:k]...)
+			c.partial = append(c.partial, c.chunk[:k]...)
 		}
 		if err != nil {
 			return nil, c.mapErr(err)

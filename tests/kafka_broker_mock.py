@@ -1,0 +1,283 @@
+"""Scripted in-memory Kafka broker for connector tests.
+
+Serves exactly the legacy RPC versions the connector speaks (ApiVersions
+v0, Metadata v1, ListOffsets v1, Produce v2, Fetch v2 — MessageSet magic=1).
+Deliberately does NOT import ekuiper_tpu.io.kafka_wire: every struct layout
+here is hand-coded from the Kafka protocol spec, so the test cross-validates
+the client's encoding against an independent implementation (a shared
+encode/decode bug can't cancel itself out).
+
+Knobs: `fail_produces` makes the next N produce requests return
+NOT_LEADER_FOR_PARTITION (retry-path tests); `log` records every
+(api_key, api_version) served.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+
+def _s(v: Optional[str]) -> bytes:
+    if v is None:
+        return struct.pack(">h", -1)
+    b = v.encode()
+    return struct.pack(">h", len(b)) + b
+
+
+def _b(v: Optional[bytes]) -> bytes:
+    if v is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(v)) + v
+
+
+class _Cur:
+    def __init__(self, data: bytes) -> None:
+        self.d = data
+        self.p = 0
+
+    def take(self, n: int) -> bytes:
+        out = self.d[self.p:self.p + n]
+        self.p += n
+        return out
+
+    def i8(self) -> int:
+        return struct.unpack(">b", self.take(1))[0]
+
+    def i16(self) -> int:
+        return struct.unpack(">h", self.take(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self.take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self.take(8))[0]
+
+    def s(self) -> Optional[str]:
+        n = self.i16()
+        return None if n < 0 else self.take(n).decode()
+
+    def b(self) -> Optional[bytes]:
+        n = self.i32()
+        return None if n < 0 else self.take(n)
+
+
+class MockBroker:
+    """One-node cluster. topics: name -> partition count."""
+
+    def __init__(self, topics: Dict[str, int]) -> None:
+        self.topics = dict(topics)
+        # (topic, partition) -> list of (key, value, ts)
+        self.data: Dict[Tuple[str, int], List[Tuple[Optional[bytes], bytes, int]]] = {
+            (t, p): [] for t, n in self.topics.items() for p in range(n)}
+        self.log: List[Tuple[int, int]] = []
+        self.fail_produces = 0
+        self.node_id = 7
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.host, self.port = self._srv.getsockname()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    @property
+    def bootstrap(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def append(self, topic: str, partition: int, key: Optional[bytes],
+               value: bytes, ts: int = 0) -> int:
+        """Seed a record directly (test setup); returns its offset."""
+        log = self.data[(topic, partition)]
+        log.append((key, value, ts))
+        return len(log) - 1
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- serving
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _recv_n(self, conn: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                size = struct.unpack(">i", self._recv_n(conn, 4))[0]
+                req = _Cur(self._recv_n(conn, size))
+                api_key, api_ver, corr = req.i16(), req.i16(), req.i32()
+                req.s()  # client id
+                self.log.append((api_key, api_ver))
+                handler = {18: self._api_versions, 3: self._metadata,
+                           2: self._list_offsets, 0: self._produce,
+                           1: self._fetch}.get(api_key)
+                if handler is None:
+                    break
+                body = handler(api_ver, req)
+                if body is None:
+                    continue  # acks=0 produce: no response
+                resp = struct.pack(">i", corr) + body
+                conn.sendall(struct.pack(">i", len(resp)) + resp)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _api_versions(self, ver: int, req: _Cur) -> bytes:
+        assert ver == 0
+        supported = [(0, 0, 2), (1, 0, 2), (2, 0, 1), (3, 0, 1), (18, 0, 0)]
+        out = struct.pack(">h", 0) + struct.pack(">i", len(supported))
+        for k, lo, hi in supported:
+            out += struct.pack(">hhh", k, lo, hi)
+        return out
+
+    def _metadata(self, ver: int, req: _Cur) -> bytes:
+        assert ver == 1
+        n = req.i32()
+        names = ([req.s() for _ in range(n)] if n >= 0
+                 else list(self.topics))
+        out = struct.pack(">i", 1)  # one broker
+        out += struct.pack(">i", self.node_id) + _s(self.host) \
+            + struct.pack(">i", self.port) + _s(None)
+        out += struct.pack(">i", self.node_id)  # controller
+        out += struct.pack(">i", len(names))
+        for name in names:
+            known = name in self.topics
+            out += struct.pack(">h", 0 if known else 3)  # UNKNOWN_TOPIC=3
+            out += _s(name) + struct.pack(">b", 0)
+            parts = range(self.topics.get(name, 0))
+            out += struct.pack(">i", len(parts))
+            for p in parts:
+                out += struct.pack(">hii", 0, p, self.node_id)
+                out += struct.pack(">ii", 1, self.node_id)  # replicas [node]
+                out += struct.pack(">ii", 1, self.node_id)  # isr [node]
+        return out
+
+    def _list_offsets(self, ver: int, req: _Cur) -> bytes:
+        assert ver == 1
+        req.i32()  # replica id
+        out_topics = []
+        for _ in range(req.i32()):
+            topic = req.s() or ""
+            parts = []
+            for _ in range(req.i32()):
+                p, ts = req.i32(), req.i64()
+                log = self.data.get((topic, p))
+                if log is None:
+                    parts.append(struct.pack(">ihqq", p, 3, -1, -1))
+                    continue
+                off = 0 if ts == -2 else len(log)
+                parts.append(struct.pack(">ihqq", p, 0, -1, off))
+            out_topics.append(_s(topic) + struct.pack(">i", len(parts))
+                              + b"".join(parts))
+        return struct.pack(">i", len(out_topics)) + b"".join(out_topics)
+
+    def _decode_mset(self, data: bytes) -> List[Tuple[Optional[bytes], bytes, int]]:
+        out = []
+        pos = 0
+        while pos + 12 <= len(data):
+            _, size = struct.unpack(">qi", data[pos:pos + 12])
+            msg = _Cur(data[pos + 12:pos + 12 + size])
+            crc = msg.i32() & 0xFFFFFFFF
+            body = msg.d[msg.p:]
+            assert zlib.crc32(body) & 0xFFFFFFFF == crc, "bad producer CRC"
+            magic, attrs = msg.i8(), msg.i8()
+            ts = msg.i64() if magic >= 1 else 0
+            key = msg.b()
+            value = msg.b() or b""
+            out.append((key, value, ts))
+            pos += 12 + size
+        return out
+
+    def _encode_mset(self, entries: List[Tuple[Optional[bytes], bytes, int]],
+                     base: int) -> bytes:
+        out = b""
+        for i, (key, value, ts) in enumerate(entries):
+            body = struct.pack(">bb", 1, 0) + struct.pack(">q", ts) \
+                + _b(key) + _b(value)
+            crc = zlib.crc32(body) & 0xFFFFFFFF
+            msg = struct.pack(">I", crc) + body
+            out += struct.pack(">qi", base + i, len(msg)) + msg
+        return out
+
+    def _produce(self, ver: int, req: _Cur) -> Optional[bytes]:
+        assert ver == 2
+        acks = req.i16()
+        req.i32()  # timeout
+        out_topics = []
+        for _ in range(req.i32()):
+            topic = req.s() or ""
+            parts = []
+            for _ in range(req.i32()):
+                p = req.i32()
+                mset = req.b() or b""
+                log = self.data.get((topic, p))
+                if log is None:
+                    parts.append(struct.pack(">ihqq", p, 3, -1, -1))
+                    continue
+                if self.fail_produces > 0:
+                    self.fail_produces -= 1
+                    parts.append(struct.pack(">ihqq", p, 6, -1, -1))
+                    continue
+                base = len(log)
+                log.extend(self._decode_mset(mset))
+                parts.append(struct.pack(">ihqq", p, 0, base, -1))
+            out_topics.append(_s(topic) + struct.pack(">i", len(parts))
+                              + b"".join(parts))
+        if acks == 0:
+            return None
+        return (struct.pack(">i", len(out_topics)) + b"".join(out_topics)
+                + struct.pack(">i", 0))  # throttle
+
+    def _fetch(self, ver: int, req: _Cur) -> bytes:
+        assert ver == 2
+        req.i32()  # replica
+        req.i32()  # max wait (mock never long-polls)
+        req.i32()  # min bytes
+        out_topics = []
+        for _ in range(req.i32()):
+            topic = req.s() or ""
+            parts = []
+            for _ in range(req.i32()):
+                p, off = req.i32(), req.i64()
+                req.i32()  # partition max bytes
+                log = self.data.get((topic, p))
+                if log is None:
+                    parts.append(struct.pack(">ihq", p, 3, -1) + _b(b""))
+                    continue
+                if off > len(log):
+                    parts.append(struct.pack(">ihq", p, 1, len(log)) + _b(b""))
+                    continue
+                mset = self._encode_mset(log[off:off + 100], off)
+                parts.append(struct.pack(">ihq", p, 0, len(log)) + _b(mset))
+            out_topics.append(_s(topic) + struct.pack(">i", len(parts))
+                              + b"".join(parts))
+        return (struct.pack(">i", 0)  # throttle
+                + struct.pack(">i", len(out_topics)) + b"".join(out_topics))
