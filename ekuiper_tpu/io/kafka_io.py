@@ -61,6 +61,15 @@ class KafkaSource(Source, Rewindable):
         self.max_bytes = int(props.get("maxBytes", 1_000_000))
         self.poll_interval = float(props.get("pollInterval", 100)) / 1000.0
 
+    def _note_failure(self, fails: Dict[int, int], retry_at: Dict[int, float],
+                      p: int, off: int, e: Exception) -> None:
+        n = fails.get(p, 0) + 1
+        fails[p] = n
+        log = logger.error if n >= 3 else logger.warning
+        log("kafka fetch %s/%d at offset %d (attempt %d): %s",
+            self.topic, p, off, n, e)
+        retry_at[p] = time.monotonic() + min(2.0 ** (n - 1), 30.0)
+
     def _init_offsets(self, client: KafkaClient) -> None:
         parts = ([self.partition] if self.partition is not None
                  else client.partitions(self.topic))
@@ -80,32 +89,53 @@ class KafkaSource(Source, Rewindable):
         self._init_offsets(self._client)
 
         def loop() -> None:
+            from .kafka_wire import OFFSET_OUT_OF_RANGE, KafkaBrokerError
+
             client = self._client
-            # per-partition consecutive-failure count: a poison offset (e.g.
-            # a snappy-compressed batch this client can't decode) must not
-            # hot-loop — back off exponentially (1s..30s) and escalate the
-            # log to error so the stall is visible, but never silently skip
-            # data (at-least-once forbids it)
+            # Failure policy, per partition so one sick partition never
+            # stalls the healthy ones:
+            #  - OFFSET_OUT_OF_RANGE: the checkpointed offset fell off the
+            #    log (retention truncation while the rule was down). It can
+            #    never succeed — clamp to earliest with a LOUD data-loss
+            #    error (the reference's auto.offset.reset behavior).
+            #  - anything else (poison batch, leader down): exponential
+            #    backoff 1s..30s tracked as a per-partition deadline; other
+            #    partitions keep polling at full rate.
             fails: Dict[int, int] = {}
+            retry_at: Dict[int, float] = {}
             while not self._stop.is_set():
                 got_any = False
                 with self._mu:
                     positions = dict(self._offsets)
+                now = time.monotonic()
                 for p, off in positions.items():
                     if self._stop.is_set():
                         break
+                    if retry_at.get(p, 0.0) > now:
+                        continue
                     try:
                         _, msgs = client.fetch(
                             self.topic, p, off, max_bytes=self.max_bytes,
                             max_wait_ms=int(self.poll_interval * 1000))
                         fails.pop(p, None)
+                        retry_at.pop(p, None)
+                    except KafkaBrokerError as e:
+                        if e.code == OFFSET_OUT_OF_RANGE:
+                            earliest = client.earliest_offset(self.topic, p)
+                            logger.error(
+                                "kafka %s/%d: checkpointed offset %d is out "
+                                "of range (log truncated by retention?) — "
+                                "resetting to earliest %d; records in "
+                                "between are LOST", self.topic, p, off,
+                                earliest)
+                            with self._mu:
+                                if self._offsets.get(p) == off:
+                                    self._offsets[p] = earliest
+                            continue
+                        self._note_failure(fails, retry_at, p, off, e)
+                        continue
                     except Exception as e:
-                        n = fails.get(p, 0) + 1
-                        fails[p] = n
-                        log = logger.error if n >= 3 else logger.warning
-                        log("kafka fetch %s/%d at offset %d (attempt %d): %s",
-                            self.topic, p, off, n, e)
-                        self._stop.wait(min(2.0 ** (n - 1), 30.0))
+                        self._note_failure(fails, retry_at, p, off, e)
                         continue
                     for moff, key, value, ts in msgs:
                         ingest(value, {

@@ -39,6 +39,21 @@ class KafkaTransportError(EngineError):
     errors (UNKNOWN_TOPIC, NOT_LEADER, ...), which leave the stream valid."""
 
 
+class KafkaBrokerError(EngineError):
+    """Broker-reported error code. `code` lets callers branch on semantics
+    (NOT_LEADER -> refresh routing, OFFSET_OUT_OF_RANGE -> reset policy)."""
+
+    def __init__(self, msg: str, code: int) -> None:
+        super().__init__(msg)
+        self.code = code
+
+
+#: broker errors that mean "this broker no longer serves the partition" —
+#: the leader cache entry is stale and a metadata refresh can recover
+_RETRIABLE_ROUTING = (3, 5, 6)  # UNKNOWN_TOPIC, LEADER_NOT_AVAIL, NOT_LEADER
+OFFSET_OUT_OF_RANGE = 1
+
+
 # ----------------------------------------------------------------- encoding
 def _i16(v: int) -> bytes:
     return struct.pack(">h", v)
@@ -222,8 +237,8 @@ ERRS = {
 
 def _check(code: int, what: str) -> None:
     if code != 0:
-        raise EngineError(
-            f"kafka: {what} failed: {ERRS.get(code, 'error')} ({code})")
+        raise KafkaBrokerError(
+            f"kafka: {what} failed: {ERRS.get(code, 'error')} ({code})", code)
 
 
 class KafkaClient:
@@ -339,22 +354,31 @@ class KafkaClient:
             raise EngineError(f"kafka: no leader for {topic}/{partition}")
         return addr
 
-    def _leader_request(self, topic: str, partition: int, api_key: int,
-                        api_version: int, body: bytes,
-                        timeout: Optional[float] = None) -> _Reader:
-        """Route to the partition leader; on connection failure, drop the
-        cached conn + leader and retry once via fresh metadata."""
+    def _leader_rpc(self, topic: str, partition: int, api_key: int,
+                    api_version: int, body: bytes, parse,
+                    timeout: Optional[float] = None):
+        """Route to the partition leader and parse the response. Recovers
+        once from either failure class: a transport error drops the cached
+        conn + leader; a retriable broker error (NOT_LEADER etc. after a
+        leader migration — the old broker still answers, so no transport
+        error fires) invalidates the leader cache so the retry re-resolves
+        via fresh metadata."""
         for attempt in (0, 1):
             addr = self._leader(topic, partition)
             try:
-                return self._conn(addr).request(api_key, api_version, body,
-                                                timeout)
+                return parse(self._conn(addr).request(api_key, api_version,
+                                                      body, timeout))
             except (OSError, KafkaTransportError):
                 self._drop_conn(addr)
                 with self._mu:
                     self._leaders.pop((topic, partition), None)
                 if attempt:
                     raise
+            except KafkaBrokerError as e:
+                if e.code not in _RETRIABLE_ROUTING or attempt:
+                    raise
+                with self._mu:
+                    self._leaders.pop((topic, partition), None)
         raise AssertionError("unreachable")
 
     # -------------------------------------------------------------- offsets
@@ -362,15 +386,18 @@ class KafkaClient:
         """ts -1 = latest (next offset to be written), -2 = earliest."""
         body = _i32(-1) + _array([
             _string(topic) + _array([_i32(partition) + _i64(ts)])])
-        r = self._leader_request(topic, partition, 2, 1, body)
-        for _ in range(r.i32()):
-            r.string()
+
+        def parse(r: _Reader) -> int:
             for _ in range(r.i32()):
-                r.i32()  # partition id
-                _check(r.i16(), f"ListOffsets({topic}/{partition})")
-                r.i64()  # timestamp
-                return r.i64()
-        raise EngineError("kafka: empty ListOffsets response")
+                r.string()
+                for _ in range(r.i32()):
+                    r.i32()  # partition id
+                    _check(r.i16(), f"ListOffsets({topic}/{partition})")
+                    r.i64()  # timestamp
+                    return r.i64()
+            raise EngineError("kafka: empty ListOffsets response")
+
+        return self._leader_rpc(topic, partition, 2, 1, body, parse)
 
     def earliest_offset(self, topic: str, partition: int) -> int:
         return self.list_offset(topic, partition, _EARLIEST)
@@ -397,42 +424,65 @@ class KafkaClient:
                 payload = hdr + body
                 conn.sock.sendall(_i32(len(payload)) + payload)
             return -1
-        r = self._leader_request(topic, partition, 0, 2, body,
-                                 timeout=max(self.timeout,
-                                             timeout_ms / 1000 + 1))
-        base = -1
-        for _ in range(r.i32()):
-            r.string()
+        def parse(r: _Reader) -> int:
+            base = -1
             for _ in range(r.i32()):
-                r.i32()  # partition id
-                _check(r.i16(), f"Produce({topic}/{partition})")
-                base = r.i64()
-                r.i64()  # log_append_time
-        r.i32()  # throttle_time_ms
-        return base
+                r.string()
+                for _ in range(r.i32()):
+                    r.i32()  # partition id
+                    _check(r.i16(), f"Produce({topic}/{partition})")
+                    base = r.i64()
+                    r.i64()  # log_append_time
+            r.i32()  # throttle_time_ms
+            return base
+
+        return self._leader_rpc(topic, partition, 0, 2, body, parse,
+                                timeout=max(self.timeout,
+                                            timeout_ms / 1000 + 1))
 
     # ---------------------------------------------------------------- fetch
+    #: fetch auto-grow ceiling — one message larger than this is an error
+    MAX_FETCH_BYTES = 64 * 1024 * 1024
+
     def fetch(self, topic: str, partition: int, offset: int,
               max_bytes: int = 1_000_000, max_wait_ms: int = 500,
               min_bytes: int = 1
               ) -> Tuple[int, List[Tuple[int, Optional[bytes], bytes, int]]]:
-        """-> (high_watermark, [(offset, key, value, timestamp_ms)])."""
-        body = (_i32(-1) + _i32(max_wait_ms) + _i32(min_bytes) + _array([
-            _string(topic) + _array([
-                _i32(partition) + _i64(offset) + _i32(max_bytes)])]))
-        r = self._leader_request(topic, partition, 1, 2, body,
-                                 timeout=self.timeout + max_wait_ms / 1000)
-        r.i32()  # throttle_time_ms
-        hw, msgs = -1, []
-        for _ in range(r.i32()):
-            r.string()
-            for _ in range(r.i32()):
-                r.i32()  # partition id
-                _check(r.i16(), f"Fetch({topic}/{partition})")
-                hw = r.i64()
-                mset = r.bytes_() or b""
-                msgs = decode_message_set(mset)
-        return hw, msgs
+        """-> (high_watermark, [(offset, key, value, timestamp_ms)]).
+
+        Fetch v2 (pre-KIP-74) truncates the first message at max_bytes if
+        it is larger — decoding then yields zero complete messages while
+        the log has more (hw > offset). That would busy-poll the same
+        offset forever, so the request is retried with doubled max_bytes
+        up to MAX_FETCH_BYTES, then errors loudly."""
+        while True:
+            body = (_i32(-1) + _i32(max_wait_ms) + _i32(min_bytes) + _array([
+                _string(topic) + _array([
+                    _i32(partition) + _i64(offset) + _i32(max_bytes)])]))
+
+            def parse(r: _Reader):
+                r.i32()  # throttle_time_ms
+                hw, raw = -1, b""
+                for _ in range(r.i32()):
+                    r.string()
+                    for _ in range(r.i32()):
+                        r.i32()  # partition id
+                        _check(r.i16(), f"Fetch({topic}/{partition})")
+                        hw = r.i64()
+                        raw = r.bytes_() or b""
+                return hw, raw
+
+            hw, raw = self._leader_rpc(
+                topic, partition, 1, 2, body, parse,
+                timeout=self.timeout + max_wait_ms / 1000)
+            msgs = decode_message_set(raw)
+            if msgs or not raw or hw <= offset:
+                return hw, msgs
+            if max_bytes >= self.MAX_FETCH_BYTES:
+                raise EngineError(
+                    f"kafka: message at {topic}/{partition} offset {offset} "
+                    f"exceeds MAX_FETCH_BYTES ({self.MAX_FETCH_BYTES})")
+            max_bytes = min(max_bytes * 2, self.MAX_FETCH_BYTES)
 
     def close(self) -> None:
         with self._mu:

@@ -67,6 +67,10 @@ class Tracer:
         # so WeakKeyDictionary can't hold them); non-weakref-able items
         # (plain lists/dicts) fall back to the receiver's current trace
         self._item_traces: Dict[int, tuple] = {}
+        # optional remote tee (observability/otlp.py) — every span the local
+        # store admits is also handed to the exporter, mirroring the
+        # reference's dual local+OTLP export (pkg/tracer/manager.go:62-76)
+        self.exporter = None
 
     @classmethod
     def global_instance(cls) -> "Tracer":
@@ -96,6 +100,12 @@ class Tracer:
 
     def is_enabled(self, rule_id: str) -> bool:
         return rule_id in self._enabled
+
+    def set_exporter(self, exporter) -> None:
+        """Install (or clear, with None) the remote OTLP tee."""
+        old, self.exporter = self.exporter, exporter
+        if old is not None:
+            old.close()
 
     # ------------------------------------------------------------- recording
     def new_trace(self) -> str:
@@ -149,6 +159,8 @@ class Tracer:
             ring = self._spans.get(rule_id)
             if ring is not None:
                 ring.append(span)
+        if ring is not None and self.exporter is not None:
+            self.exporter.on_span(span)
 
     # --------------------------------------------------------------- queries
     def rule_traces(self, rule_id: str, limit: int = 50) -> List[str]:

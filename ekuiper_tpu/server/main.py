@@ -70,6 +70,14 @@ def start_up(config_path: str | None = None, block: bool = True):
     from ..services.manager import ServiceManager
 
     ServiceManager.set_global(ServiceManager(store))
+    # remote OTLP span tee (off by default; pkg/tracer/manager.go:28-45)
+    from ..observability.otlp import from_config as otlp_from_config
+    from ..observability.tracer import Tracer
+
+    exporter = otlp_from_config(cfg)
+    if exporter is not None:
+        Tracer.global_instance().set_exporter(exporter)
+        logger.info("OTLP span export -> %s", exporter.url)
     api = RestApi(store)
     api.rules.recover()
     server = serve(api, cfg.basic.rest_ip, cfg.basic.rest_port)
@@ -80,6 +88,8 @@ def start_up(config_path: str | None = None, block: bool = True):
         logger.info("shutting down")
         api.rules.stop_all()
         PortableManager.global_instance().kill_all()  # server.go:329 KillAll
+        if exporter is not None:
+            Tracer.global_instance().set_exporter(None)  # closes + final flush
         server.shutdown()
         stop_event.set()
 

@@ -119,6 +119,18 @@ class ClusterConfig:
 
 
 @dataclass
+class OpenTelemetryConfig:
+    """Remote OTLP span export (reference pkg/tracer/manager.go:28-45 —
+    otlptracehttp with WithInsecure). Off by default: zero egress unless
+    explicitly pointed at a collector."""
+
+    enable_remote_collector: bool = False
+    remote_endpoint: str = "localhost:4318"
+    batch_max_spans: int = 512
+    batch_interval_ms: int = 2000
+
+
+@dataclass
 class Config:
     basic: BasicConfig = field(default_factory=BasicConfig)
     rule: RuleOptionConfig = field(default_factory=RuleOptionConfig)
@@ -127,6 +139,8 @@ class Config:
     source: SourceConfig = field(default_factory=SourceConfig)
     portable: PortableConfig = field(default_factory=PortableConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    open_telemetry: OpenTelemetryConfig = field(
+        default_factory=OpenTelemetryConfig)
     data_dir: str = "data"
 
     def to_dict(self) -> Dict[str, Any]:

@@ -267,7 +267,7 @@ class MockBroker:
             parts = []
             for _ in range(req.i32()):
                 p, off = req.i32(), req.i64()
-                req.i32()  # partition max bytes
+                pmax = req.i32()  # partition max bytes
                 log = self.data.get((topic, p))
                 if log is None:
                     parts.append(struct.pack(">ihq", p, 3, -1) + _b(b""))
@@ -276,6 +276,10 @@ class MockBroker:
                     parts.append(struct.pack(">ihq", p, 1, len(log)) + _b(b""))
                     continue
                 mset = self._encode_mset(log[off:off + 100], off)
+                # real brokers truncate the message set at max_bytes (the
+                # pre-KIP-74 oversized-first-message case clients must grow
+                # past) — honor it so that path is testable
+                mset = mset[:pmax]
                 parts.append(struct.pack(">ihq", p, 0, len(log)) + _b(mset))
             out_topics.append(_s(topic) + struct.pack(">i", len(parts))
                               + b"".join(parts))

@@ -67,13 +67,37 @@ class TestWireClient:
         assert c.latest_offset("t2", 0) == 1
         c.close()
 
-    def test_produce_error_surfaces_then_recovers(self, broker):
+    def test_produce_not_leader_refreshes_and_recovers(self, broker):
+        """NOT_LEADER invalidates the leader cache and retries once via
+        fresh metadata (leader-migration recovery); a second consecutive
+        NOT_LEADER surfaces to the SinkNode retry path."""
         c = KafkaClient(broker.bootstrap)
         broker.fail_produces = 1
+        assert c.produce("t2", 0, [(None, b"x", 0)]) >= 0  # in-call retry
+        broker.fail_produces = 2
         with pytest.raises(EngineError, match="NOT_LEADER"):
-            c.produce("t2", 0, [(None, b"x", 0)])
-        # the SinkNode retry path re-collects; next attempt succeeds
-        assert c.produce("t2", 0, [(None, b"x", 0)]) >= 0
+            c.produce("t2", 0, [(None, b"y", 0)])
+        assert c.produce("t2", 0, [(None, b"z", 0)]) >= 0
+        c.close()
+
+    def test_fetch_grows_past_oversized_message(self, broker):
+        """A message bigger than max_bytes truncates the v2 fetch response;
+        the client doubles max_bytes instead of busy-polling forever."""
+        big = b"x" * 4096
+        broker.append("t2", 0, None, big)
+        broker.append("t2", 0, None, b"after")
+        c = KafkaClient(broker.bootstrap)
+        hw, msgs = c.fetch("t2", 0, 0, max_bytes=512)
+        assert hw == 2
+        assert [v for _, _, v, _ in msgs] == [big, b"after"]
+        c.close()
+
+    def test_oversized_beyond_cap_errors(self, broker):
+        broker.append("t2", 0, None, b"y" * 4096)
+        c = KafkaClient(broker.bootstrap)
+        c.MAX_FETCH_BYTES = 1024
+        with pytest.raises(EngineError, match="exceeds MAX_FETCH_BYTES"):
+            c.fetch("t2", 0, 0, max_bytes=512)
         c.close()
 
     def test_gzip_message_set_decode(self):
@@ -187,6 +211,21 @@ class TestKafkaSource:
         self._drain(got, 1)
         src.close()
         assert got == [b"m2"]
+
+    def test_offset_out_of_range_resets_to_earliest(self, broker):
+        """A checkpointed offset past the log (retention truncation / topic
+        recreation) can never succeed — the source clamps to earliest with
+        a loud data-loss error instead of stalling forever."""
+        for i in range(3):
+            broker.append("t2", 0, None, f"m{i}".encode())
+        src = KafkaSource()
+        src.configure("t2", {"brokers": broker.bootstrap, "pollInterval": 20})
+        src.rewind({"0": 999})  # stale checkpoint beyond the log
+        got = []
+        src.open(lambda payload, meta=None: got.append(payload))
+        self._drain(got, 3)
+        src.close()
+        assert got[:3] == [b"m0", b"m1", b"m2"]
 
     def test_groupid_ignored_with_warning(self, broker):
         src = KafkaSource()
