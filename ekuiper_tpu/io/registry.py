@@ -168,8 +168,16 @@ def _ensure() -> None:
     register_source("kafka", KafkaSource)
     register_sink("kafka", KafkaSink)
 
+    from .zmq_io import ZmqSink, ZmqSource
+
+    register_source("zmq", ZmqSource)
+    register_sink("zmq", ZmqSink)
+
+    from .tdengine_io import Tdengine3Sink
+
+    register_sink("tdengine3", Tdengine3Sink)
+
     for kind, pkg, has_src, has_sink in (
-        ("zmq", "pyzmq", True, True),
         ("video", "opencv-python", True, False),
     ):
         if has_src:

@@ -1,0 +1,89 @@
+"""ZeroMQ source & sink — analogue of the reference's zmq extension
+(extensions/impl/zmq/{source,sink,conf}.go) over the bundled ZMTP 3.0
+peer (io/zmq_native.py) instead of pebbe/zmq4 + libzmq.
+
+Reference semantics preserved:
+- sink = PUB that BINDS `server`; with a `topic` prop it sends
+  [topic, payload] multipart, else a single payload frame (sink.go:66-80)
+- source = SUB that CONNECTS and prefix-subscribes its datasource topic;
+  multipart payload frames are concatenated and the topic frame is
+  reported as meta (source.go:72-105)
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..utils.infra import EngineError
+from .contract import Sink, Source
+from .converters import get_converter
+from .zmq_native import PubServer, SubClient
+
+
+class ZmqSource(Source):
+    def __init__(self) -> None:
+        self.topic = ""
+        self.server = ""
+        self._client: Optional[SubClient] = None
+
+    def configure(self, datasource: str, props: Dict[str, Any]) -> None:
+        self.topic = datasource or props.get("topic", "")
+        self.server = props.get("server", "")
+        if not self.server:
+            raise EngineError("zmq source: missing server address")
+
+    def open(self, ingest) -> None:
+        topic = self.topic
+
+        def on_message(parts) -> None:
+            if not parts:
+                return
+            if topic:
+                meta = {"topic": parts[0].decode(errors="replace")}
+                payload = b"".join(parts[1:])
+            else:
+                meta = {}
+                payload = b"".join(parts)
+            ingest(payload, meta)
+
+        self._client = SubClient(self.server, topic, on_message)
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+class ZmqSink(Sink):
+    def __init__(self) -> None:
+        self.server = ""
+        self.topic = ""
+        self.format = "json"
+        self._pub: Optional[PubServer] = None
+
+    def configure(self, props: Dict[str, Any]) -> None:
+        self.server = props.get("server", "")
+        self.topic = props.get("topic", "")
+        self.format = props.get("format", "json")
+        if not self.server:
+            raise EngineError("zmq sink: missing server address")
+
+    def connect(self) -> None:
+        self._pub = PubServer(self.server)
+
+    def collect(self, item: Any) -> None:
+        if self._pub is None:
+            self.connect()
+        conv = get_converter(self.format)
+        payload = item if isinstance(item, (bytes, bytearray)) \
+            else conv.encode(item)
+        if isinstance(payload, str):
+            payload = payload.encode()
+        if self.topic:
+            self._pub.send([self.topic.encode(), bytes(payload)])
+        else:
+            self._pub.send([bytes(payload)])
+
+    def close(self) -> None:
+        if self._pub is not None:
+            self._pub.close()
+            self._pub = None

@@ -1,0 +1,301 @@
+"""Bundled ZMTP 3.0 peer — PUB/SUB over TCP without libzmq/pyzmq, the way
+io/mqtt_native.py bundles MQTT 3.1.1 (the reference links pebbe/zmq4 ->
+libzmq; this image has neither, and the wire protocol is small).
+
+Implements the subset the zmq connector needs (ZMTP/3.0 spec,
+rfc.zeromq.org/spec/23):
+
+- 64-byte greeting (signature / version 3.0 / NULL mechanism)
+- NULL security handshake (READY command with Socket-Type metadata,
+  PUB<->SUB compatibility check)
+- framing: short/long frames, MORE and COMMAND flags, multipart messages
+- SUB subscriptions as 0x01/0x00-prefixed messages (3.0 style), honored
+  PUB-side with prefix matching per peer
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.infra import EngineError, logger
+
+_FLAG_MORE = 0x01
+_FLAG_LONG = 0x02
+_FLAG_CMD = 0x04
+
+_COMPAT = {"PUB": {"SUB"}, "SUB": {"PUB"}}
+
+
+def _greeting() -> bytes:
+    sig = b"\xff" + b"\x00" * 8 + b"\x7f"
+    mechanism = b"NULL" + b"\x00" * 16
+    return sig + bytes([3, 0]) + mechanism + b"\x00" + b"\x00" * 31
+
+
+def _ready(socket_type: str) -> bytes:
+    """READY command frame body: name + metadata (Socket-Type)."""
+    name = b"\x05READY"
+    key = b"Socket-Type"
+    val = socket_type.encode()
+    meta = bytes([len(key)]) + key + struct.pack(">I", len(val)) + val
+    return name + meta
+
+
+class ZmtpPeer:
+    """One handshaked ZMTP connection."""
+
+    def __init__(self, sock: socket.socket, socket_type: str) -> None:
+        self.sock = sock
+        self.socket_type = socket_type
+        self.peer_type = ""
+        self._rbuf = b""
+        self._wlock = threading.Lock()
+
+    # ------------------------------------------------------------ handshake
+    def handshake(self, timeout: float = 10.0) -> None:
+        self.sock.settimeout(timeout)
+        self.sock.sendall(_greeting())
+        g = self._read_n(64)
+        if g[0] != 0xFF or g[9] != 0x7F:
+            raise EngineError("zmq: bad ZMTP signature")
+        if g[10] < 3:
+            raise EngineError(f"zmq: peer speaks ZMTP {g[10]}.x, need >= 3")
+        mech = g[12:32].rstrip(b"\x00").decode()
+        if mech != "NULL":
+            raise EngineError(f"zmq: unsupported mechanism {mech}")
+        self.send_frame(_ready(self.socket_type), cmd=True)
+        flags, body = self.recv_frame()
+        if not flags & _FLAG_CMD or not body.startswith(b"\x05READY"):
+            raise EngineError("zmq: expected READY command")
+        self.peer_type = self._parse_socket_type(body[6:])
+        if self.peer_type not in _COMPAT.get(self.socket_type, set()):
+            raise EngineError(
+                f"zmq: socket types incompatible: {self.socket_type} <-> "
+                f"{self.peer_type or '?'}")
+        self.sock.settimeout(None)
+
+    @staticmethod
+    def _parse_socket_type(meta: bytes) -> str:
+        pos = 0
+        while pos < len(meta):
+            nlen = meta[pos]
+            name = meta[pos + 1:pos + 1 + nlen]
+            pos += 1 + nlen
+            vlen = struct.unpack(">I", meta[pos:pos + 4])[0]
+            val = meta[pos + 4:pos + 4 + vlen]
+            pos += 4 + vlen
+            if name.lower() == b"socket-type":
+                return val.decode()
+        return ""
+
+    # -------------------------------------------------------------- framing
+    def send_frame(self, body: bytes, more: bool = False,
+                   cmd: bool = False) -> None:
+        flags = (_FLAG_MORE if more else 0) | (_FLAG_CMD if cmd else 0)
+        if len(body) > 255:
+            hdr = bytes([flags | _FLAG_LONG]) + struct.pack(">Q", len(body))
+        else:
+            hdr = bytes([flags, len(body)])
+        with self._wlock:
+            self.sock.sendall(hdr + body)
+
+    def send_multipart(self, parts: List[bytes]) -> None:
+        with self._wlock:
+            out = b""
+            for i, p in enumerate(parts):
+                flags = _FLAG_MORE if i < len(parts) - 1 else 0
+                if len(p) > 255:
+                    out += bytes([flags | _FLAG_LONG]) \
+                        + struct.pack(">Q", len(p)) + p
+                else:
+                    out += bytes([flags, len(p)]) + p
+            self.sock.sendall(out)
+
+    def recv_frame(self) -> Tuple[int, bytes]:
+        flags = self._read_n(1)[0]
+        if flags & _FLAG_LONG:
+            size = struct.unpack(">Q", self._read_n(8))[0]
+        else:
+            size = self._read_n(1)[0]
+        if size > 256 * 1024 * 1024:
+            raise EngineError(f"zmq: frame of {size} bytes refused")
+        return flags, self._read_n(size)
+
+    def recv_multipart(self) -> List[bytes]:
+        """Next data message (commands are handled/skipped)."""
+        while True:
+            flags, body = self.recv_frame()
+            if flags & _FLAG_CMD:
+                continue  # PING etc. — NULL mechanism needs no reply here
+            parts = [body]
+            while flags & _FLAG_MORE:
+                flags, body = self.recv_frame()
+                parts.append(body)
+            return parts
+
+    def _read_n(self, n: int) -> bytes:
+        while len(self._rbuf) < n:
+            chunk = self.sock.recv(max(4096, n - len(self._rbuf)))
+            if not chunk:
+                raise ConnectionError("zmq: peer closed")
+            self._rbuf += chunk
+        out, self._rbuf = self._rbuf[:n], self._rbuf[n:]
+        return out
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _parse_endpoint(server: str) -> Tuple[str, int]:
+    if not server.startswith("tcp://"):
+        raise EngineError(f"zmq: only tcp:// endpoints supported: {server}")
+    host, _, port = server[6:].partition(":")
+    if host in ("*", ""):  # canonical zmq wildcard bind form
+        host = "0.0.0.0"
+    try:
+        return host, int(port)
+    except ValueError:
+        raise EngineError(f"zmq: endpoint needs a numeric port: {server}")
+
+
+class PubServer:
+    """PUB socket: binds, handshakes subscribers, honors their prefix
+    subscriptions (0x01 subscribe / 0x00 unsubscribe messages)."""
+
+    def __init__(self, server: str) -> None:
+        host, port = _parse_endpoint(server)
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._peers: Dict[ZmtpPeer, List[bytes]] = {}  # peer -> prefixes
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="zmq-pub-accept").start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_peer, args=(sock,),
+                             daemon=True).start()
+
+    def _serve_peer(self, sock: socket.socket) -> None:
+        peer = ZmtpPeer(sock, "PUB")
+        try:
+            peer.handshake()
+        except Exception as e:
+            logger.warning("zmq pub: handshake failed: %s", e)
+            peer.close()
+            return
+        # send-only timeout: a wedged subscriber must not block publish
+        # (recv stays blocking — the subscription loop below needs it)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                        struct.pack("ll", 5, 0))
+        with self._mu:
+            self._peers[peer] = []
+        try:
+            while not self._stop.is_set():
+                msg = peer.recv_multipart()
+                if not msg or not msg[0]:
+                    continue
+                op, prefix = msg[0][0], msg[0][1:]
+                with self._mu:
+                    subs = self._peers.get(peer)
+                    if subs is None:
+                        return
+                    if op == 1:
+                        subs.append(prefix)
+                    elif op == 0 and prefix in subs:
+                        subs.remove(prefix)
+        except (ConnectionError, OSError, EngineError):
+            pass
+        finally:
+            with self._mu:
+                self._peers.pop(peer, None)
+            peer.close()
+
+    def subscriber_count(self) -> int:
+        with self._mu:
+            return len(self._peers)
+
+    def send(self, parts: List[bytes]) -> None:
+        """Deliver to every subscriber whose prefix matches the first
+        frame (PUB drops when no one matches — zmq semantics)."""
+        head = parts[0] if parts else b""
+        with self._mu:
+            targets = [p for p, subs in self._peers.items()
+                       if any(head.startswith(s) for s in subs)]
+        for p in targets:
+            try:
+                p.send_multipart(parts)
+            except OSError:
+                with self._mu:
+                    self._peers.pop(p, None)
+                p.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._mu:
+            peers = list(self._peers)
+            self._peers.clear()
+        for p in peers:
+            p.close()
+
+
+class SubClient:
+    """SUB socket: connects, subscribes to a topic prefix, and feeds
+    received messages to a callback; redials on connection loss."""
+
+    def __init__(self, server: str, topic: str,
+                 on_message: Callable[[List[bytes]], None]) -> None:
+        self.host, self.port = _parse_endpoint(server)
+        self.topic = topic.encode()
+        self.on_message = on_message
+        self._stop = threading.Event()
+        self._peer: Optional[ZmtpPeer] = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="zmq-sub")
+        self._thread.start()
+
+    def _run(self) -> None:
+        backoff = 0.1
+        while not self._stop.is_set():
+            try:
+                sock = socket.create_connection((self.host, self.port),
+                                                timeout=5)
+                peer = ZmtpPeer(sock, "SUB")
+                peer.handshake()
+                peer.send_frame(b"\x01" + self.topic)  # subscribe
+                self._peer = peer
+                backoff = 0.1
+                while not self._stop.is_set():
+                    self.on_message(peer.recv_multipart())
+            except Exception as e:
+                if self._stop.is_set():
+                    return
+                logger.debug("zmq sub: reconnect after: %s", e)
+                if self._peer is not None:
+                    self._peer.close()
+                    self._peer = None
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 5.0)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._peer is not None:
+            self._peer.close()
+        self._thread.join(timeout=3)

@@ -11,7 +11,7 @@ from ..planner.planner import RuleDef
 from ..sql import ast
 from ..sql.parser import parse
 from ..store import kv
-from ..utils.infra import ParseError, PlanError
+from ..utils.infra import EngineError, ParseError, PlanError
 
 
 class StreamProcessor:
@@ -114,17 +114,30 @@ class RulesetProcessor:
         self.store = store or kv.get_store()
 
     def export(self) -> Dict[str, Any]:
-        out: Dict[str, Any] = {"streams": {}, "tables": {}, "rules": {}}
+        out: Dict[str, Any] = {"streams": {}, "tables": {}, "rules": {},
+                               "scripts": {}}
         for name, v in self.store.kv("stream").items():
             out["streams"][name] = v["sql"]
         for name, v in self.store.kv("table").items():
             out["tables"][name] = v["sql"]
         for rid, v in self.store.kv("rule").items():
             out["rules"][rid] = v
+        mgr = self._script_mgr()
+        for name in mgr.list():
+            out["scripts"][name] = mgr.get(name)
         return out
 
-    def import_ruleset(self, doc: Dict[str, Any]) -> Dict[str, int]:
-        counts = {"streams": 0, "tables": 0, "rules": 0}
+    def _script_mgr(self):
+        """Scripts must come from/go to THIS processor's store (the global
+        manager may be backed by a different one, e.g. importing into a
+        fresh store); binding side effects are idempotent."""
+        from ..plugin.script import ScriptManager
+
+        return ScriptManager(self.store)
+
+    def import_ruleset(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        counts: Dict[str, Any] = {"streams": 0, "tables": 0, "rules": 0,
+                                  "scripts": 0}
         for name, sql in doc.get("streams", {}).items():
             self.store.kv("stream").set(name, {"sql": sql})
             counts["streams"] += 1
@@ -137,4 +150,26 @@ class RulesetProcessor:
             rule.setdefault("id", rid)
             self.store.kv("rule").set(rid, rule)
             counts["rules"] += 1
+        # scripts (reference rulesets carry JS bodies — they must be
+        # translated to Python first; per-script errors are reported, the
+        # rest of the import proceeds. docs/JS_MIGRATION.md)
+        script_errors: Dict[str, str] = {}
+        scripts = doc.get("scripts", {}) or {}
+        if scripts:
+            mgr = self._script_mgr()
+            for name, spec in scripts.items():
+                try:
+                    if isinstance(spec, str):
+                        spec = {"id": name, "script": spec}
+                    if not isinstance(spec, dict):
+                        raise EngineError(
+                            f"script spec must be an object or source "
+                            f"string, got {type(spec).__name__}")
+                    spec.setdefault("id", name)
+                    mgr.create(spec, overwrite=True)
+                    counts["scripts"] += 1
+                except Exception as e:
+                    script_errors[name] = str(e)
+        if script_errors:
+            counts["script_errors"] = script_errors
         return counts

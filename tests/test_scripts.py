@@ -137,3 +137,28 @@ def test_script_op_node_in_graph():
     unsub()
     rows = [r for g in got for r in (g if isinstance(g, list) else [g])]
     assert sorted(r["v2"] for r in rows) == [9, 16]
+
+
+def test_ruleset_carries_scripts(mgr):
+    """Export/import round-trips scripts; an untranslated JS body reports a
+    per-script error while the rest imports (docs/JS_MIGRATION.md)."""
+    from ekuiper_tpu.server.processors import RulesetProcessor
+
+    mgr.create({"id": "halve", "script": "args[0] / 2"})
+    rp = RulesetProcessor(kv.get_store())
+    doc = rp.export()
+    assert "halve" in doc["scripts"]
+    mgr.delete("halve")
+    counts = rp.import_ruleset(doc)
+    assert counts["scripts"] == 1
+    assert freg.lookup("halve").exec([10], {}) == 5
+
+    bad = {"scripts": {
+        "jsfunc": "function jsfunc(x) { return x * 2; }",  # untranslated JS
+        "good": {"id": "good", "script": "args[0] + 1"},
+    }}
+    counts = rp.import_ruleset(bad)
+    assert counts["scripts"] == 1
+    assert "jsfunc" in counts["script_errors"]
+    assert freg.lookup("good").exec([1], {}) == 2
+    mgr.delete("good")

@@ -121,7 +121,7 @@ class TestDispatch:
         # import into a fresh store
         api2 = RestApi(kv.Store("memory"))
         code, res = api2.dispatch("POST", "/ruleset/import", doc)
-        assert res == {"streams": 1, "tables": 0, "rules": 1}
+        assert res == {"streams": 1, "tables": 0, "rules": 1, "scripts": 0}
         code, res = api2.dispatch("GET", "/streams", None)
         assert res == ["demo"]
 

@@ -1,0 +1,128 @@
+"""TDengine3 sink: statement construction goldens mirror the reference's
+own unit expectations (extensions/impl/tdengine3/tdengine3_test.go:160-252)
+and the REST transport runs against a local taosAdapter mock."""
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from ekuiper_tpu.io.tdengine_io import Tdengine3Sink, build_insert
+from ekuiper_tpu.utils.infra import EngineError
+
+
+class TestBuildInsert:
+    def test_now_ts_and_string_quoting(self):
+        # ref golden: INSERT INTO t (ts,f1) values (now,"v1")
+        stmt = build_insert(
+            {"table": "t", "tsFieldName": "ts", "fields": ["f1"]},
+            {"f1": "v1"})
+        assert stmt == 'INSERT INTO t (ts,f1) values (now,"v1")'
+
+    def test_provide_ts_with_stable_tags(self):
+        # ref golden: INSERT INTO t (ts,k1) USING st TAGS("t1")
+        #             values (1737628594255,"v1")
+        stmt = build_insert(
+            {"table": "t", "tsFieldName": "ts", "provideTs": True,
+             "sTable": "st", "tagFields": ["tag"], "fields": ["k1"]},
+            {"ts": 1737628594255, "k1": "v1", "tag": "t1"})
+        assert stmt == ('INSERT INTO t (ts,k1) USING st TAGS("t1") '
+                        'values (1737628594255,"v1")')
+
+    def test_numeric_tag_and_multiple_fields(self):
+        # ref golden: INSERT INTO t (ts,k1,k2) USING st TAGS("t1",2)
+        #             values (1737628594255,"v1",2)
+        stmt = build_insert(
+            {"table": "t", "tsFieldName": "ts", "provideTs": True,
+             "sTable": "st", "tagFields": ["tg1", "tg2"],
+             "fields": ["k1", "k2"]},
+            {"ts": 1737628594255, "k1": "v1", "k2": 2, "tg1": "t1",
+             "tg2": 2})
+        assert stmt == ('INSERT INTO t (ts,k1,k2) USING st TAGS("t1",2) '
+                        'values (1737628594255,"v1",2)')
+
+    def test_all_fields_when_unspecified(self):
+        stmt = build_insert(
+            {"table": "t", "tsFieldName": "ts"},
+            {"b": 1, "a": "x"})
+        assert stmt == 'INSERT INTO t (ts,a,b) values (now,"x",1)'
+
+    def test_missing_ts_field_errors(self):
+        with pytest.raises(EngineError, match="timestamp field"):
+            build_insert({"table": "t", "tsFieldName": "ts",
+                          "provideTs": True}, {"a": 1})
+
+    def test_missing_selected_field_errors(self):
+        with pytest.raises(EngineError, match="field not found"):
+            build_insert({"table": "t", "tsFieldName": "ts",
+                          "fields": ["nope"]}, {"a": 1})
+
+
+class _Adapter:
+    """taosAdapter /rest/sql mock."""
+
+    def __init__(self, code=0):
+        self.requests = []
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                outer.requests.append(
+                    (self.path, self.headers.get("Authorization"),
+                     self.rfile.read(n).decode()))
+                body = json.dumps({"code": code, "desc": "err" if code else ""})
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body.encode())
+
+            def log_message(self, *a):
+                pass
+
+        self.srv = HTTPServer(("127.0.0.1", 0), H)
+        self.port = self.srv.server_address[1]
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.srv.shutdown()
+
+
+class TestRestTransport:
+    def test_collect_posts_with_basic_auth(self):
+        srv = _Adapter()
+        sink = Tdengine3Sink()
+        sink.configure({"host": "127.0.0.1", "port": srv.port,
+                        "database": "db1", "table": "t",
+                        "fields": ["f1"]})
+        sink.collect({"f1": "v1"})
+        sink.collect([{"f1": "v2"}, {"f1": "v3"}])
+        sink.close()
+        srv.close()
+        assert len(srv.requests) == 3
+        path, auth, body = srv.requests[0]
+        assert path == "/rest/sql/db1"
+        assert auth == "Basic " + base64.b64encode(b"root:taosdata").decode()
+        assert body == 'INSERT INTO t (ts,f1) values (now,"v1")'
+
+    def test_broker_error_code_raises(self):
+        srv = _Adapter(code=534)
+        sink = Tdengine3Sink()
+        sink.configure({"host": "127.0.0.1", "port": srv.port,
+                        "database": "db1", "table": "t"})
+        with pytest.raises(EngineError, match="534"):
+            sink.collect({"a": 1})
+        srv.close()
+
+    def test_requires_database_and_table(self):
+        with pytest.raises(EngineError, match="database"):
+            Tdengine3Sink().configure({"table": "t"})
+        with pytest.raises(EngineError, match="table"):
+            Tdengine3Sink().configure({"database": "d"})
+
+    def test_registered_unsgated(self):
+        from ekuiper_tpu.io import registry
+
+        assert "tdengine3" in registry.sink_types()
