@@ -33,7 +33,13 @@ def build_insert(cfg: Dict[str, Any], row: Dict[str, Any]) -> str:
     vals: List[str] = []
 
     def fmt(v: Any) -> str:
-        return f'"{v}"' if isinstance(v, str) else f"{v}"
+        if isinstance(v, str):
+            # escape for TDengine double-quoted literals — unescaped
+            # quotes break the statement and open SQL injection via
+            # row data
+            esc = v.replace("\\", "\\\\").replace('"', '\\"')
+            return f'"{esc}"'
+        return f"{v}"
 
     if cfg.get("provideTs"):
         if ts_field not in row:

@@ -37,10 +37,13 @@ class ZmqSource(Source):
         def on_message(parts) -> None:
             if not parts:
                 return
-            if topic:
+            if topic and len(parts) >= 2:
                 meta = {"topic": parts[0].decode(errors="replace")}
                 payload = b"".join(parts[1:])
             else:
+                # single-frame publishers embed the topic prefix in the
+                # payload frame (canonical libzmq pattern) — deliver the
+                # frame whole rather than mistaking it for a bare topic
                 meta = {}
                 payload = b"".join(parts)
             ingest(payload, meta)

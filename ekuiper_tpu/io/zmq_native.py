@@ -114,24 +114,45 @@ class ZmtpPeer:
             self.sock.sendall(out)
 
     def recv_frame(self) -> Tuple[int, bytes]:
-        flags = self._read_n(1)[0]
-        if flags & _FLAG_LONG:
-            size = struct.unpack(">Q", self._read_n(8))[0]
-        else:
-            size = self._read_n(1)[0]
-        if size > 256 * 1024 * 1024:
-            raise EngineError(f"zmq: frame of {size} bytes refused")
-        return flags, self._read_n(size)
+        """Resumable across socket timeouts: nothing is consumed from the
+        read buffer until the WHOLE frame is present, so an idle-poll
+        timeout can never desync the stream."""
+        while True:
+            buf = self._rbuf
+            if len(buf) >= 1:
+                flags = buf[0]
+                hdr = 9 if flags & _FLAG_LONG else 2
+                if len(buf) >= hdr:
+                    if flags & _FLAG_LONG:
+                        size = struct.unpack(">Q", buf[1:9])[0]
+                    else:
+                        size = buf[1]
+                    if size > 256 * 1024 * 1024:
+                        raise EngineError(f"zmq: frame of {size} bytes refused")
+                    if len(buf) >= hdr + size:
+                        body = buf[hdr:hdr + size]
+                        self._rbuf = buf[hdr + size:]
+                        return flags, bytes(body)
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("zmq: peer closed")
+            self._rbuf += chunk
 
     def recv_multipart(self) -> List[bytes]:
-        """Next data message (commands are handled/skipped)."""
+        """Next data message (commands are handled/skipped). A socket
+        timeout before the FIRST frame propagates (idle poll); once a
+        message started, continuation frames retry through timeouts so a
+        multipart is never torn."""
         while True:
             flags, body = self.recv_frame()
             if flags & _FLAG_CMD:
                 continue  # PING etc. — NULL mechanism needs no reply here
             parts = [body]
             while flags & _FLAG_MORE:
-                flags, body = self.recv_frame()
+                try:
+                    flags, body = self.recv_frame()
+                except socket.timeout:
+                    continue
                 parts.append(body)
             return parts
 
@@ -175,17 +196,33 @@ class PubServer:
         self._srv.listen(16)
         self.port = self._srv.getsockname()[1]
         self._peers: Dict[ZmtpPeer, List[bytes]] = {}  # peer -> prefixes
+        # every accepted socket, including ones still mid-handshake — close()
+        # must kill those too or a half-open orphan pins the port (its
+        # handshake read blocks up to 10s after the listener is gone)
+        self._accepted: List[socket.socket] = []
         self._mu = threading.Lock()
         self._stop = threading.Event()
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="zmq-pub-accept").start()
 
     def _accept_loop(self) -> None:
+        # short-poll accept instead of a fully blocking one: a thread parked
+        # deep in accept() survives close() (the syscall pins the kernel
+        # listener as a port-squatting zombie) and is exposed to fd-reuse
+        # races; with a 250ms poll every such window is bounded
+        self._srv.settimeout(0.25)
         while not self._stop.is_set():
             try:
                 sock, _ = self._srv.accept()
+            except socket.timeout:
+                continue
             except OSError:
                 return
+            with self._mu:
+                if self._stop.is_set():
+                    sock.close()
+                    return
+                self._accepted.append(sock)
             threading.Thread(target=self._serve_peer, args=(sock,),
                              daemon=True).start()
 
@@ -214,7 +251,8 @@ class PubServer:
                     if subs is None:
                         return
                     if op == 1:
-                        subs.append(prefix)
+                        if prefix not in subs:  # idle probes re-subscribe
+                            subs.append(prefix)
                     elif op == 0 and prefix in subs:
                         subs.remove(prefix)
         except (ConnectionError, OSError, EngineError):
@@ -222,6 +260,10 @@ class PubServer:
         finally:
             with self._mu:
                 self._peers.pop(peer, None)
+                try:
+                    self._accepted.remove(sock)
+                except ValueError:
+                    pass  # close() already drained the list
             peer.close()
 
     def subscriber_count(self) -> int:
@@ -246,14 +288,38 @@ class PubServer:
     def close(self) -> None:
         self._stop.set()
         try:
+            # abort the accept thread's blocked accept(): merely closing
+            # the fd does NOT interrupt it on Linux — the in-flight syscall
+            # keeps a zombie listener squatting the port until some
+            # connection happens to wake it
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._srv.close()
         except OSError:
             pass
         with self._mu:
             peers = list(self._peers)
             self._peers.clear()
+            accepted = list(self._accepted)
+            self._accepted.clear()
+        # abortive close (RST, not FIN): a graceful close parks the
+        # accepted sockets in FIN_WAIT until every subscriber notices,
+        # keeping the port unbindable across a quick PUB restart
+        for s in accepted:
+            try:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             struct.pack("ii", 1, 0))
+            except OSError:
+                pass
         for p in peers:
             p.close()
+        for s in accepted:
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 class SubClient:
@@ -275,15 +341,35 @@ class SubClient:
         backoff = 0.1
         while not self._stop.is_set():
             try:
-                sock = socket.create_connection((self.host, self.port),
-                                                timeout=5)
+                # pre-bind the source port: an unbound connect() retried
+                # against a dead listener on an ephemeral-range port can TCP
+                # simultaneous-open onto ITSELF, squatting the port so the
+                # real peer can never bind it again. With an explicit source
+                # bind, a dead target just refuses.
+                sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                sock.bind(("", 0))
+                if sock.getsockname()[1] == self.port:
+                    sock.close()
+                    raise ConnectionError("source port collided with target")
+                sock.settimeout(5)
+                sock.connect((self.host, self.port))
                 peer = ZmtpPeer(sock, "SUB")
                 peer.handshake()
                 peer.send_frame(b"\x01" + self.topic)  # subscribe
                 self._peer = peer
                 backoff = 0.1
+                # idle probe: every few quiet seconds re-send the
+                # (idempotent) subscription — a torn-down peer turns the
+                # send into an error and triggers the reconnect path, and a
+                # subscribe frame lost in a reconnect race gets replayed
+                sock.settimeout(3.0)
                 while not self._stop.is_set():
-                    self.on_message(peer.recv_multipart())
+                    try:
+                        msg = peer.recv_multipart()
+                    except socket.timeout:
+                        peer.send_frame(b"\x01" + self.topic)
+                        continue
+                    self.on_message(msg)
             except Exception as e:
                 if self._stop.is_set():
                     return

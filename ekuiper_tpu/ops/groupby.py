@@ -98,6 +98,10 @@ class DeviceGroupBy:
             for comp in spec.components:
                 self.comp_specs.setdefault(comp, []).append(i)
         self._fold = jax.jit(self._fold_impl, donate_argnums=(0,))
+        # row-masked fold: the sliding edge refold re-folds CACHED device
+        # batches under an arbitrary (mb,) bool row mask (window time cut),
+        # so trigger emission uploads one 65KB mask instead of the rows
+        self._fold_m = jax.jit(self._fold_masked_impl, donate_argnums=(0,))
         # pane mask is static: no device upload per emit, one cached
         # executable per live-pane combination (few), and the output is ONE
         # stacked array -> a single device->host transfer per window emit
@@ -259,9 +263,28 @@ class DeviceGroupBy:
     def _fold_impl(self, state, cols, slots, n_valid, pane_idx):
         import jax.numpy as jnp
 
+        base = jnp.arange(self.micro_batch, dtype=jnp.int32) < n_valid
+        return self._fold_core(state, cols, slots, base, pane_idx)
+
+    def _fold_masked_impl(self, state, cols, slots, mask, pane_idx):
+        return self._fold_core(state, cols, slots, mask, pane_idx)
+
+    def fold_masked(self, state, dev_cols, slots_dev, mask: np.ndarray,
+                    pane_idx: int):
+        """Re-fold a cached pre-padded device batch under a host row mask
+        (False rows contribute nothing — the mask already ANDs the real-row
+        count). Used by the sliding edge refold; see nodes_fused.py."""
+        import jax.numpy as jnp
+
+        return self._fold_m(state, dev_cols, slots_dev,
+                            jnp.asarray(mask, dtype=jnp.bool_),
+                            jnp.asarray(pane_idx, dtype=jnp.int32))
+
+    def _fold_core(self, state, cols, slots, base, pane_idx):
+        import jax.numpy as jnp
+
         slots = slots.astype(jnp.int32)
         pane_idx = pane_idx.astype(jnp.int32)  # scalar or per-row vector
-        base = jnp.arange(self.micro_batch, dtype=jnp.int32) < n_valid
         if self.plan.filter is not None:
             base = jnp.logical_and(base, self.plan.filter(cols))
         # per-column validity composes into per-spec masks below

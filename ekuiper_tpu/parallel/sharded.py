@@ -405,16 +405,19 @@ class ShardedGroupBy(DeviceGroupBy):
         slots: np.ndarray,
         valid: Optional[Dict[str, np.ndarray]] = None,
         pane_idx: int = 0,
+        n_rows: Optional[int] = None,
     ) -> Dict[str, Any]:
         """Host entry: chunk/pad to the static micro_batch, upload with
         row shardings, run the SPMD step. Signature matches DeviceGroupBy
-        so FusedWindowAggNode drives either interchangeably."""
+        so FusedWindowAggNode drives either interchangeably (n_rows is the
+        pre-padded-inputs convention; this path always re-pads host arrays
+        so it only overrides the row count)."""
         import jax
         import jax.numpy as jnp
 
         from ..ops.aggspec import materialize_hll_columns
 
-        n = len(slots)
+        n = n_rows if n_rows is not None else len(slots)
         mb = self.micro_batch
         valid = valid or {}
         cols = materialize_hll_columns(self.plan.columns, cols, n)

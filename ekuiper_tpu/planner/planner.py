@@ -222,18 +222,19 @@ def device_path_eligible(
         from ..sql.compiler import try_compile
 
         # device sliding: processing-time, trigger-gated (per-row emission
-        # without a condition belongs on the exact host path), single-chip
-        # (the scratch/ring refold is not sharded yet)
+        # without a condition belongs on the exact host path). Mesh OK:
+        # pane-vector folds, the scratch refold, and the dyn finalize all
+        # run sharded (parallel/sharded.py); heavy_hitters plans are
+        # already mesh-excluded below (node-local value dictionary)
         if opts.is_event_time:
-            return None
-        if (opts.plan_optimize_strategy or {}).get("mesh"):
             return None
         if w.trigger_condition is None or try_compile(
             w.trigger_condition, mode="host"
         ) is None:
             return None
-    if opts.is_event_time and w.window_type == ast.WindowType.COUNT_WINDOW:
-        return None  # event-time counts stay on the host buffering path
+    # event-time COUNT: the watermark node late-drops + orders rows, after
+    # which a count window folds exactly like processing time (the host
+    # path's _ingest_row is watermark-agnostic too, nodes_window.py:235)
     # event-time × mesh: supported — the sharded kernel routes per-row pane
     # vectors under shard_map (parallel/sharded.py _build_fold_vec), with
     # the scalar fast path for single-bucket batches

@@ -172,6 +172,14 @@ class FusedWindowAggNode(Node):
             self._scratch_pane = self.n_ring_panes
             self._pane_bucket: Dict[int, int] = {}  # pane -> bucket held
             self._ring: Dict[int, list] = {}  # bucket -> [(cols,valid,slots,ts)]
+            # device-side cache of the SAME segments (pre-padded fold
+            # inputs kept alive on device): the trigger-time edge refold
+            # then uploads one (mb,) bool mask per segment instead of
+            # re-uploading the rows — the r04 paced 407ms p50 was mostly
+            # this re-upload + its device folds. Entries align 1:1 with
+            # _ring lists (None = no device copy, e.g. after restore).
+            self._dev_ring: Dict[int, list] = {}
+            self._bucket_max_ts: Dict[int, int] = {}
             self._ring_max_bucket = -1
             self._pending_slides: Dict[int, int] = {}  # t -> fire_at_ms
             self._trigger_host = None
@@ -419,6 +427,19 @@ class FusedWindowAggNode(Node):
                                      pane_idx=np.zeros(1, dtype=np.int64))
                 dummy = self.gb.fold(dummy, cols, slots, pane_idx=0)
                 self.gb.finalize(dummy, 1, panes=[0])
+                if self.wt == ast.WindowType.SLIDING_WINDOW:
+                    # compile the mask-only edge refold (fold_masked) with
+                    # the exact runtime pytree: pre-padded device inputs +
+                    # (mb,) bool mask — a first real trigger must not pay
+                    # a 20-40s jit stall mid-stream
+                    dev = self._upload_sliding_inputs(
+                        {n: np.zeros(1, dtype=np.float32)
+                         for n in self.plan.columns},
+                        {}, np.zeros(1, dtype=np.int32))
+                    if dev is not None:
+                        mask = np.zeros(self.gb.micro_batch, dtype=np.bool_)
+                        dummy = self.gb.fold_masked(
+                            dummy, dev[3], dev[2], mask, self.n_ring_panes)
             else:
                 dummy = self.gb.fold(dummy, cols, slots,
                                      pane_idx=self.cur_pane)
@@ -511,7 +532,10 @@ class FusedWindowAggNode(Node):
             return 0
         idx = np.arange(start, end)
         sub = batch if (start == 0 and end == batch.n) else batch.take(idx)
-        if self.is_event_time:
+        if self.is_event_time and self.wt != ast.WindowType.COUNT_WINDOW:
+            # event-time COUNT folds like processing time: the upstream
+            # watermark node already late-dropped and ordered the rows, and
+            # count boundaries are row-count-driven, not bucket-driven
             return self._fold_event(sub)
         if self.wt == ast.WindowType.SLIDING_WINDOW:
             return self._fold_sliding(sub)
@@ -1235,16 +1259,23 @@ class FusedWindowAggNode(Node):
         floor_b = self._ring_max_bucket - self.n_ring_panes - 8
         for b in [b for b in self._ring if b < floor_b]:
             del self._ring[b]
+            self._dev_ring.pop(b, None)
+            self._bucket_max_ts.pop(b, None)
         cols, valid, slots = self._build_kernel_inputs(sub)
+        dev = self._upload_sliding_inputs(cols, valid, slots)
         pane_vec = (buckets % self.n_ring_panes).astype(np.uint8)
+        fold_cols, fold_valid, fold_slots, n_rows = (
+            (dev[0], dev[1], dev[2], sub.n) if dev is not None
+            else (cols, valid, slots, None))
         if len(np.unique(pane_vec)) == 1:
             # single-bucket batch: scalar-pane fast path (the common case —
             # a batch spans far less time than one pane)
-            self.state = self.gb.fold(self.state, cols, slots, valid,
-                                      int(pane_vec[0]))
+            self.state = self.gb.fold(self.state, fold_cols, fold_slots,
+                                      fold_valid, int(pane_vec[0]),
+                                      n_rows=n_rows)
         else:
-            self.state = self.gb.fold(self.state, cols, slots, valid,
-                                      pane_vec)
+            self.state = self.gb.fold(self.state, fold_cols, fold_slots,
+                                      fold_valid, pane_vec, n_rows=n_rows)
         for b in np.unique(buckets).tolist():
             m = buckets == b
             sel = np.nonzero(m)[0]
@@ -1254,6 +1285,13 @@ class FusedWindowAggNode(Node):
                 slots[sel], ts[sel],
             ) if not m.all() else (cols, valid, slots, ts)
             self._ring.setdefault(int(b), []).append(seg)
+            # aligned device entry: whole-batch refs + this bucket's row
+            # mask (the refold ANDs the window time cut into it)
+            self._dev_ring.setdefault(int(b), []).append(
+                None if dev is None else (dev[3], dev[2], m, ts))
+            bmax = int(ts[sel].max())
+            if bmax > self._bucket_max_ts.get(int(b), -1):
+                self._bucket_max_ts[int(b)] = bmax
         # trigger rows: vectorized OVER(WHEN ...) on the raw batch columns;
         trig_mask = _host_mask(self._trigger_host, sub.columns, sub.n)
         for i in np.nonzero(trig_mask)[0].tolist():
@@ -1263,6 +1301,49 @@ class FusedWindowAggNode(Node):
             else:
                 self._emit_sliding(t)
         return sub.n
+
+    def _upload_sliding_inputs(self, cols, valid, slots):
+        """Pre-pad + upload one batch's fold inputs, so (a) the fold uses
+        them without its own upload and (b) the ring keeps the device refs
+        for mask-only edge refolds. Returns (dev_cols, dev_valid, s_dev,
+        dev_all) or None when the batch can't ship as one chunk.
+        dev_all is the combined {col, __valid_col} dict fold_masked takes."""
+        mb = self.gb.micro_batch
+        n = len(slots)
+        if n > mb or not getattr(self.gb, "accepts_device_inputs", False):
+            return None
+        if n < mb // 4:
+            # small batches would pin a full mb-padded device buffer each
+            # for the whole ring retention window — HBM cost out of all
+            # proportion; their edge refolds are cheap host uploads anyway
+            return None
+        import jax.numpy as jnp
+
+        from ..ops.aggspec import materialize_hll_columns
+
+        cols = materialize_hll_columns(self.plan.columns, cols, n)
+        pad = mb - n
+        dev_cols, dev_valid, dev_all = {}, {}, {}
+        for name in self.plan.columns:
+            arr = np.asarray(cols[name], dtype=np.float32)
+            if pad:
+                arr = np.pad(arr, (0, pad))
+            d = jnp.asarray(arr)
+            dev_cols[name] = d
+            dev_all[name] = d
+            vm = valid.get(name)
+            if vm is not None:
+                vm = np.pad(vm, (0, pad)) if pad else vm
+                vm = jnp.asarray(vm)
+                dev_valid[name] = vm
+            dev_all["__valid_" + name] = vm
+        s = slots
+        if pad:
+            s = np.pad(s, (0, pad))
+        if self.gb.capacity <= 65535:
+            s = s.astype(np.uint16)
+        s_dev = jnp.asarray(s)
+        return dev_cols, dev_valid, s_dev, dev_all
 
     def _schedule_sliding(self, t: int, fire_at: int) -> None:
         """Register a delayed sliding emission; tracked in _pending_slides
@@ -1290,7 +1371,24 @@ class FusedWindowAggNode(Node):
         scratch_rows = []
 
         def ring_rows(b, lo_excl=None, hi_incl=None):
-            for cols, valid, slots, ts in self._ring.get(b, []):
+            devs = self._dev_ring.get(b, [])
+            for i, (cols, valid, slots, ts) in enumerate(self._ring.get(b, [])):
+                dev = devs[i] if i < len(devs) else None
+                if dev is not None:
+                    # mask-only refold: AND the window time cut into the
+                    # bucket mask over the cached whole-batch device input
+                    dev_all, s_dev, bmask, full_ts = dev
+                    m = bmask.copy()
+                    if lo_excl is not None:
+                        m &= full_ts > lo_excl
+                    if hi_incl is not None:
+                        m &= full_ts <= hi_incl
+                    if m.any():
+                        mb = self.gb.micro_batch
+                        if len(m) < mb:
+                            m = np.pad(m, (0, mb - len(m)))
+                        scratch_rows.append(("dev", dev_all, s_dev, m))
+                    continue
                 m = np.ones(len(ts), dtype=np.bool_)
                 if lo_excl is not None:
                     m &= ts > lo_excl
@@ -1298,7 +1396,7 @@ class FusedWindowAggNode(Node):
                     m &= ts <= hi_incl
                 if m.any():
                     sel = np.nonzero(m)[0]
-                    scratch_rows.append((
+                    scratch_rows.append(("host",
                         {k: v[sel] for k, v in cols.items()},
                         {k: v[sel] for k, v in valid.items()},
                         slots[sel]))
@@ -1315,11 +1413,26 @@ class FusedWindowAggNode(Node):
                 ring_rows(b_lo, lo_excl=lo, hi_incl=hi)
             else:
                 ring_rows(b_lo, lo_excl=lo)
-                ring_rows(b_hi, hi_incl=hi)
+                # high edge served straight from its PANE when exact: the
+                # pane holds precisely bucket b_hi's rows folded so far,
+                # which equals (b_hi*B, hi] when no received row exceeds hi
+                # and the pane's span clears the window's low cut
+                if (self._pane_bucket.get(b_hi % self.n_ring_panes) == b_hi
+                        and b_hi * self.bucket_ms > lo
+                        and self._bucket_max_ts.get(b_hi, hi + 1) <= hi):
+                    full.append(b_hi)
+                else:
+                    ring_rows(b_hi, hi_incl=hi)
         used_scratch = False
-        for cols, valid, slots in scratch_rows:
-            self.state = self.gb.fold(self.state, cols, slots, valid,
-                                      self._scratch_pane)
+        for entry in scratch_rows:
+            if entry[0] == "dev":
+                _, dev_all, s_dev, m = entry
+                self.state = self.gb.fold_masked(
+                    self.state, dev_all, s_dev, m, self._scratch_pane)
+            else:
+                _, cols, valid, slots = entry
+                self.state = self.gb.fold(self.state, cols, slots, valid,
+                                          self._scratch_pane)
             used_scratch = True
         panes = sorted({b % self.n_ring_panes for b in full})
         if used_scratch:
@@ -1852,6 +1965,14 @@ class FusedWindowAggNode(Node):
             self._pane_bucket = {int(k): v for k, v in
                                  state.get("pane_bucket", {}).items()}
             self._ring_max_bucket = state.get("ring_max_bucket", -1)
+            # device input cache + max-ts tracking don't survive a restore:
+            # refolds fall back to host uploads (exact), pane-serving stays
+            # off for pre-restore buckets (missing max-ts fails the check).
+            # Pad with None placeholders so post-restore appends stay
+            # 1:1-aligned with the restored _ring segment lists
+            self._dev_ring = {b: [None] * len(segs)
+                              for b, segs in self._ring.items()}
+            self._bucket_max_ts = {}
             self._ring = {
                 int(b): [
                     ({k: _dec_arr(v) for k, v in seg["cols"].items()},

@@ -405,3 +405,51 @@ class TestDivisibilityGate:
             "GROUP BY deviceId, HOPPINGWINDOW(ss, 25, 10)")
         opts = RuleOptionConfig(is_event_time=True)
         assert device_path_eligible(stmt, opts) is None
+
+
+class TestEventTimeCountParity:
+    """Event-time COUNT windows on the device path: the watermark node
+    late-drops + orders, then counting folds exactly like processing time
+    (host oracle: nodes_window.py _ingest_row COUNT branch)."""
+
+    def test_eligibility(self):
+        from ekuiper_tpu.planner.planner import device_path_eligible
+        from ekuiper_tpu.sql.parser import parse_select
+        from ekuiper_tpu.utils.config import RuleOptionConfig
+
+        stmt = parse_select(
+            "SELECT deviceId, count(*) AS c FROM ed "
+            "GROUP BY deviceId, COUNTWINDOW(4)")
+        assert device_path_eligible(
+            stmt, RuleOptionConfig(is_event_time=True)) is not None
+        # overlapping count windows still buffer on the host
+        stmt2 = parse_select(
+            "SELECT deviceId, count(*) AS c FROM ed "
+            "GROUP BY deviceId, COUNTWINDOW(4, 2)")
+        assert device_path_eligible(
+            stmt2, RuleOptionConfig(is_event_time=True)) is None
+
+    def test_parity_with_host(self, mock_clock):
+        sql = ("SELECT deviceId, count(*) AS c, avg(temperature) AS a "
+               "FROM ed GROUP BY deviceId, COUNTWINDOW(4)")
+        mem.reset()
+        store = kv.get_store()
+        _mk_stream(store)
+        fused_msgs, fused_topo = _run_rule(
+            store, mock_clock, sql, ROWS + PUSHER,
+            {"isEventTime": True, "lateTolerance": 1000}, "ecf")
+        assert any(isinstance(n, FusedWindowAggNode)
+                   for n in fused_topo.ops), \
+            "event-time count rule did not take the device path"
+        host_msgs, host_topo = _run_rule(
+            store, mock_clock, sql, ROWS + PUSHER,
+            {"isEventTime": True, "lateTolerance": 1000,
+             "use_device_kernel": False}, "ech")
+        assert not any(isinstance(n, FusedWindowAggNode)
+                       for n in host_topo.ops)
+
+        def norm(msgs):
+            return sorted(
+                (m["deviceId"], m["c"], round(m["a"], 4)) for m in msgs)
+
+        assert fused_msgs and norm(fused_msgs) == norm(host_msgs)

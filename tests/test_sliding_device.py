@@ -371,6 +371,7 @@ class TestSlidingBurst:
         node.process(b([10_200], [50.0]))
         # trigger: window (8410-2000, 8410+0] ... covers all four rows
         node.process(b([10_410], [95.0]))
+        node._drain_async_emits()
         msgs = flat(got)
         assert len(msgs) == 1
         assert msgs[0]["c"] == 4  # the late row counted
