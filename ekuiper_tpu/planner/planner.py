@@ -197,22 +197,21 @@ def device_path_eligible(
         ast.WindowType.STATE_WINDOW,
     ):
         return None
-    if w.window_type == ast.WindowType.SESSION_WINDOW and opts.is_event_time \
-            and (opts.plan_optimize_strategy or {}).get("mesh"):
-        # event-time sessions fold per-session at watermark time (single
-        # pane, per-emission finalize) — single chip only
-        return None
+    # event-time sessions: the per-session structure resolves host-side at
+    # watermark time (sort/split), then each session is a plain pane-0 fold
+    # + sync finalize — both run through the sharded kernel, so mesh is OK
     if w.window_type == ast.WindowType.STATE_WINDOW:
         from ..sql.compiler import try_compile
 
-        # device state windows: vectorizable begin/emit conditions,
-        # processing time, single chip (per-emission finalize). A WHERE
-        # clause filters BEFORE the window on the host path — a filtered
-        # row must not toggle the window, so such rules stay host-side
-        # (the same pre/post-WHERE divergence as COUNT windows)
-        if opts.is_event_time or (opts.plan_optimize_strategy or {}).get(
-                "mesh"):
-            return None
+        # device state windows: vectorizable begin/emit conditions.
+        # Event time OK — the watermark node orders rows, after which the
+        # begin/emit toggle scan is identical to processing time (the host
+        # path's _ingest_row STATE branch is watermark-agnostic too).
+        # Mesh OK — the toggle scan runs host-side; span folds + the sync
+        # finalize run through the sharded kernel like any other window.
+        # A WHERE clause filters BEFORE the window on the host path — a
+        # filtered row must not toggle the window, so such rules stay
+        # host-side (the same pre/post-WHERE divergence as COUNT windows)
         if stmt.condition is not None:
             return None
         if try_compile(w.begin_condition, mode="host") is None or \

@@ -95,9 +95,12 @@ class FusedWindowAggNode(Node):
         self.length_ms = window.length_ms()
         self.interval_ms = window.interval_ms()
         self.is_event_time = is_event_time
-        if is_event_time and self.wt == ast.WindowType.SESSION_WINDOW:
-            # event-time sessions: one pane (a pane holds exactly one
-            # complete session at fold time — see _evs_watermark); the
+        if is_event_time and self.wt in (ast.WindowType.SESSION_WINDOW,
+                                         ast.WindowType.COUNT_WINDOW,
+                                         ast.WindowType.STATE_WINDOW):
+            # event-time sessions/counts/state windows: one pane (sessions
+            # fold one complete session at a time, counts/state fold the
+            # open span into pane 0 and reset per emission); the
             # bucket/pane routing below is tumbling/hopping machinery
             self.n_panes = 1
             self._next_emit_bucket: Optional[int] = None
@@ -532,10 +535,12 @@ class FusedWindowAggNode(Node):
             return 0
         idx = np.arange(start, end)
         sub = batch if (start == 0 and end == batch.n) else batch.take(idx)
-        if self.is_event_time and self.wt != ast.WindowType.COUNT_WINDOW:
-            # event-time COUNT folds like processing time: the upstream
-            # watermark node already late-dropped and ordered the rows, and
-            # count boundaries are row-count-driven, not bucket-driven
+        if self.is_event_time and self.wt not in (
+                ast.WindowType.COUNT_WINDOW, ast.WindowType.STATE_WINDOW):
+            # event-time COUNT/STATE fold like processing time: the
+            # upstream watermark node already late-dropped and ordered the
+            # rows, and their boundaries are row-driven (count / condition
+            # toggles), not bucket-driven
             return self._fold_event(sub)
         if self.wt == ast.WindowType.SLIDING_WINDOW:
             return self._fold_sliding(sub)
@@ -1563,10 +1568,14 @@ class FusedWindowAggNode(Node):
             self._evs_flush()
             self.broadcast(eof)
             return
-        if self.is_event_time:
+        if self.is_event_time and self.wt not in (
+                ast.WindowType.COUNT_WINDOW, ast.WindowType.STATE_WINDOW):
             # flush every window that can still contain data (bounded
             # runs / trials) — iterate the dirty set, never bucket-by-bucket
-            # across gaps
+            # across gaps. COUNT/STATE fold into pane 0 like processing
+            # time and flush through the shared path below (their _dirty
+            # set is never populated — returning here would silently drop
+            # the open span)
             while self._dirty:
                 first = min(self._dirty)
                 nxt = self._next_emit_bucket

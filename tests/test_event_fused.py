@@ -157,7 +157,8 @@ class TestEventTimeSessionParity:
         opts.is_event_time = True
         assert device_path_eligible(stmt, opts) is not None
         opts.plan_optimize_strategy = {"mesh": {"rows": 2, "keys": 4}}
-        assert device_path_eligible(stmt, opts) is None  # single-chip only
+        # mesh OK since round 5: session split is host-side, folds shard
+        assert device_path_eligible(stmt, opts) is not None
 
     def test_session_parity(self, mock_clock):
         fused, host = self._run_both(mock_clock)
@@ -453,3 +454,74 @@ class TestEventTimeCountParity:
                 (m["deviceId"], m["c"], round(m["a"], 4)) for m in msgs)
 
         assert fused_msgs and norm(fused_msgs) == norm(host_msgs)
+
+
+class TestEventTimeStateParity:
+    """Event-time STATE windows on the device path — watermark-ordered rows
+    toggle begin/emit exactly like the host path's condition scan."""
+
+    def test_parity_with_host(self, mock_clock):
+        sql = ("SELECT deviceId, count(*) AS c, avg(temperature) AS a "
+               "FROM ed GROUP BY deviceId, "
+               "STATEWINDOW(temperature > 25, temperature < 8)")
+        rows = [
+            {"deviceId": "a", "temperature": 30.0, "ts": 1_000},  # begin
+            {"deviceId": "a", "temperature": 15.0, "ts": 2_000},
+            {"deviceId": "b", "temperature": 5.0, "ts": 3_000},   # emit
+            {"deviceId": "a", "temperature": 40.0, "ts": 4_000},  # begin
+            {"deviceId": "b", "temperature": 2.0, "ts": 5_000},   # emit
+        ]
+        mem.reset()
+        store = kv.get_store()
+        _mk_stream(store)
+        fused_msgs, fused_topo = _run_rule(
+            store, mock_clock, sql, rows,
+            {"isEventTime": True, "lateTolerance": 1000}, "esf")
+        assert any(isinstance(n, FusedWindowAggNode)
+                   for n in fused_topo.ops), \
+            "event-time state rule did not take the device path"
+        host_msgs, host_topo = _run_rule(
+            store, mock_clock, sql, rows,
+            {"isEventTime": True, "lateTolerance": 1000,
+             "use_device_kernel": False}, "esh")
+        assert not any(isinstance(n, FusedWindowAggNode)
+                       for n in host_topo.ops)
+
+        def norm(msgs):
+            return sorted(
+                (m["deviceId"], m["c"], round(m["a"], 4)) for m in msgs)
+
+        assert fused_msgs and norm(fused_msgs) == norm(host_msgs)
+
+
+def test_event_time_state_open_span_flushes_at_eof():
+    """An open (never-closed) event-time STATE window must flush at EOF,
+    matching the host path's buffer flush (review finding r5)."""
+    import numpy as np
+
+    from ekuiper_tpu.data.batch import ColumnBatch
+    from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+    from ekuiper_tpu.ops.emit import build_direct_emit
+    from ekuiper_tpu.runtime.events import EOF
+    from ekuiper_tpu.sql.parser import parse_select
+
+    sql = ("SELECT deviceId, count(*) AS c, avg(v) AS a FROM s "
+           "GROUP BY deviceId, STATEWINDOW(st = 1, st = 0)")
+    stmt = parse_select(sql)
+    plan = extract_kernel_plan(stmt)
+    node = FusedWindowAggNode(
+        "eof_st", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+        capacity=16, micro_batch=32, is_event_time=True,
+        direct_emit=build_direct_emit(stmt, plan, ["deviceId"]))
+    node.state = node.gb.init_state()
+    got = []
+    node.broadcast = lambda item: got.append(item)
+    node.process(ColumnBatch(
+        n=2,
+        columns={"deviceId": np.array(["a", "a"], dtype=np.object_),
+                 "v": np.asarray([1.0, 2.0], np.float32),
+                 "st": np.asarray([1, 5], np.int64)},
+        timestamps=np.asarray([1000, 2000], np.int64), emitter="s"))
+    node.on_eof(EOF(source_id="s"))
+    msgs = [m for item in got if isinstance(item, list) for m in item]
+    assert msgs and msgs[0]["c"] == 2 and abs(msgs[0]["a"] - 1.5) < 1e-6, got

@@ -426,3 +426,95 @@ class TestShardedSliding:
                 np.testing.assert_allclose(m["a"], np.mean(vals), rtol=1e-4)
                 np.testing.assert_allclose(m["mn"], min(vals), rtol=1e-6)
                 np.testing.assert_allclose(m["mx"], max(vals), rtol=1e-6)
+
+
+class TestShardedStateAndSession:
+    """STATE windows and event-time SESSION windows on the mesh: the toggle
+    scan / session split are host-side; every fold and the sync finalize
+    run through the sharded kernel — output must match single-chip."""
+
+    def _state_node(self, mesh):
+        from test_state_device import SQL as SSQL
+        from ekuiper_tpu.ops.emit import build_direct_emit
+        from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+
+        stmt = parse_select(SSQL)
+        plan = _plan(SSQL)
+        node = FusedWindowAggNode(
+            "sst", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+            capacity=64, micro_batch=128, mesh=mesh,
+            direct_emit=build_direct_emit(stmt, plan, ["deviceId"]))
+        node.state = node.gb.init_state()
+        got = []
+        node.broadcast = lambda item: got.append(item)
+        return node, got
+
+    def test_state_window_sharded_matches_single_chip(self, eight_devices):
+        from test_state_device import batch, msgs_of
+
+        mesh = make_mesh(rows=2, keys=4)
+        sh, sh_got = self._state_node(mesh)
+        assert isinstance(sh.gb, ShardedGroupBy)
+        single, si_got = self._state_node(None)
+        feeds = [
+            batch(["x", "a", "a", "b", "a", "x", "b", "b"],
+                  [9.0, 1.0, 2.0, 3.0, 4.0, 9.0, 10.0, 20.0],
+                  [5, 1, 5, 5, 0, 5, 1, 0]),
+            batch(["a", "b", "a"], [7.0, 8.0, 9.0], [1, 5, 0]),
+        ]
+        for b in feeds:
+            sh.process(b)
+            single.process(b)
+        assert msgs_of(sh_got) == msgs_of(si_got)
+        assert len(msgs_of(sh_got)) >= 2
+
+    def test_event_session_sharded_matches_single_chip(self, eight_devices):
+        from ekuiper_tpu.data.batch import ColumnBatch
+        from ekuiper_tpu.ops.emit import build_direct_emit
+        from ekuiper_tpu.runtime.events import Watermark
+        from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+
+        sql = ("SELECT k, count(*) AS c, avg(v) AS a FROM s "
+               "GROUP BY k, SESSIONWINDOW(ss, 10, 2)")
+        stmt = parse_select(sql)
+
+        def mk(mesh):
+            plan = _plan(sql)
+            node = FusedWindowAggNode(
+                "evs", stmt.window, plan,
+                dims=[d.expr for d in stmt.dimensions],
+                capacity=32, micro_batch=64, mesh=mesh, is_event_time=True,
+                direct_emit=build_direct_emit(stmt, plan, ["k"]))
+            node.state = node.gb.init_state()
+            got = []
+            node.broadcast = lambda item: got.append(item)
+            return node, got
+
+        def feed(node):
+            # two sessions per key, split by a >2s gap; watermark closes
+            # the first
+            ts = np.array([1000, 1200, 1500, 4000, 4100], dtype=np.int64)
+            node.process(ColumnBatch(
+                n=5,
+                columns={"k": np.array(["a", "a", "b", "a", "b"],
+                                       dtype=np.object_),
+                         "v": np.asarray([1, 2, 3, 4, 5], np.float32)},
+                timestamps=ts, emitter="s"))
+            node.on_watermark(Watermark(ts=10_000))
+
+        sh, sh_got = mk(make_mesh(rows=2, keys=4))
+        assert isinstance(sh.gb, ShardedGroupBy)
+        si, si_got = mk(None)
+        feed(sh)
+        feed(si)
+
+        def norm(got):
+            out = []
+            for item in got:
+                if isinstance(item, list):
+                    out.append(sorted(
+                        (m["k"], m["c"], round(m["a"], 4)) for m in item))
+            return out
+
+        assert norm(sh_got) == norm(si_got)
+        assert norm(sh_got), "no session emitted"

@@ -44,14 +44,15 @@ class TestSessionDevice:
     def test_eligibility(self):
         stmt = parse_select(SQL)
         assert device_path_eligible(stmt, RuleOptionConfig()) is not None
-        # event-time sessions are device-eligible single-chip since round 4
-        # (watermark-time per-session finalize); they stay host-side on a mesh
+        # event-time sessions are device-eligible (watermark-time
+        # per-session finalize), mesh included since round 5 — the session
+        # split is host-side, the folds/finalizes shard like any window
         assert device_path_eligible(
             stmt, RuleOptionConfig(is_event_time=True)) is not None
         assert device_path_eligible(
             stmt, RuleOptionConfig(
                 is_event_time=True,
-                plan_optimize_strategy={"mesh": "2x4"})) is None
+                plan_optimize_strategy={"mesh": "2x4"})) is not None
 
     def test_parity_gap_and_cap(self, mock_clock):
         """Two sessions split by a gap, then a cap-forced close — device and

@@ -56,9 +56,16 @@ class TestStateDevice:
     def test_eligibility(self):
         stmt = parse_select(SQL)
         assert device_path_eligible(stmt, RuleOptionConfig()) is not None
+        # mesh + event time both device-eligible since round 5 (toggle scan
+        # is host-side; span folds/finalize shard; watermark orders rows)
         opts = RuleOptionConfig(
             plan_optimize_strategy={"mesh": {"rows": 2, "keys": 4}})
-        assert device_path_eligible(stmt, opts) is None
+        assert device_path_eligible(stmt, opts) is not None
+        assert device_path_eligible(
+            stmt, RuleOptionConfig(is_event_time=True)) is not None
+        # WHERE still forces the host path (pre-window filter divergence)
+        stmt2 = parse_select(SQL.replace(" GROUP BY", " WHERE v > 0 GROUP BY"))
+        assert device_path_eligible(stmt2, RuleOptionConfig()) is None
 
     def test_open_close_within_one_batch(self):
         node, got = make_node()
