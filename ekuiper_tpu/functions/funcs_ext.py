@@ -1,0 +1,312 @@
+"""Extension function plugins as builtins — the reference ships these as
+portable/native plugins (extensions/functions/{geohash,image,onnx}); here
+they register directly since their dependencies are bundled (pure-python
+geohash, pillow for image ops, torch-cpu for model inference).
+
+- geohash*: full surface of extensions/functions/geohash/geohash.go
+  (encode/decode/boundingBox/neighbor/neighbors, string + uint64 forms,
+  mmcloughlin/geohash-compatible base32 and neighbor ordering).
+- resize/thumbnail: extensions/functions/image/{resize,thumbnail}.go
+  semantics over pillow (bilinear resize, raw RGB mode, format-preserving
+  re-encode; base64 strings accepted where Go takes []byte — JSON rows
+  carry binary as base64).
+- model_infer: the role of extensions/functions/onnx/onnx.go — in-process
+  model inference as a SQL function. Divergence: TorchScript via the
+  bundled torch-cpu instead of onnxruntime (not in image); models load
+  from <data_dir>/models/<name>.pt, cached per process.
+"""
+from __future__ import annotations
+
+import base64
+import io
+from typing import Any, Dict, List, Tuple
+
+from .registry import SCALAR, register
+
+_B32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+_B32_IDX = {c: i for i, c in enumerate(_B32)}
+
+# mmcloughlin/geohash neighbor ordering (geohash.go g_direction)
+_DIRS = {
+    "North": (1, 0), "NorthEast": (1, 1), "East": (0, 1),
+    "SouthEast": (-1, 1), "South": (-1, 0), "SouthWest": (-1, -1),
+    "West": (0, -1), "NorthWest": (1, -1),
+}
+_NEIGHBOR_ORDER = ["North", "NorthEast", "East", "SouthEast",
+                   "South", "SouthWest", "West", "NorthWest"]
+
+
+def _interleave(lat: float, lon: float, bits: int) -> int:
+    """bits total, even bits longitude first (standard geohash)."""
+    lat_rng = [-90.0, 90.0]
+    lon_rng = [-180.0, 180.0]
+    out = 0
+    for i in range(bits):
+        rng, v = (lon_rng, lon) if i % 2 == 0 else (lat_rng, lat)
+        mid = (rng[0] + rng[1]) / 2
+        bit = 1 if v >= mid else 0
+        out = (out << 1) | bit
+        if bit:
+            rng[0] = mid
+        else:
+            rng[1] = mid
+    return out
+
+
+def _deinterleave(code: int, bits: int) -> Tuple[Tuple[float, float], Tuple[float, float]]:
+    lat_rng = [-90.0, 90.0]
+    lon_rng = [-180.0, 180.0]
+    for i in range(bits):
+        bit = (code >> (bits - 1 - i)) & 1
+        rng = lon_rng if i % 2 == 0 else lat_rng
+        mid = (rng[0] + rng[1]) / 2
+        if bit:
+            rng[0] = mid
+        else:
+            rng[1] = mid
+    return (lat_rng[0], lat_rng[1]), (lon_rng[0], lon_rng[1])
+
+
+def _gh_encode(lat: float, lon: float, chars: int = 12) -> str:
+    code = _interleave(float(lat), float(lon), chars * 5)
+    return "".join(_B32[(code >> (5 * (chars - 1 - i))) & 31]
+                   for i in range(chars))
+
+
+def _gh_code(hash_: str) -> int:
+    code = 0
+    for c in hash_:
+        if c not in _B32_IDX:
+            raise ValueError(f"invalid geohash character {c!r}")
+        code = (code << 5) | _B32_IDX[c]
+    return code
+
+
+def _gh_box(hash_: str) -> Dict[str, float]:
+    (la0, la1), (lo0, lo1) = _deinterleave(_gh_code(hash_), len(hash_) * 5)
+    return {"MinLat": la0, "MaxLat": la1, "MinLng": lo0, "MaxLng": lo1}
+
+
+def _gh_decode(hash_: str) -> Tuple[float, float]:
+    b = _gh_box(hash_)
+    return ((b["MinLat"] + b["MaxLat"]) / 2, (b["MinLng"] + b["MaxLng"]) / 2)
+
+
+def _split_axes(code: int, bits: int) -> Tuple[int, int, int, int]:
+    """Interleaved code -> (lat_int, lon_int, lat_bits, lon_bits).
+    Even bit positions (MSB-first) are longitude."""
+    lon_bits = (bits + 1) // 2
+    lat_bits = bits // 2
+    lat = lon = 0
+    for i in range(bits):
+        bit = (code >> (bits - 1 - i)) & 1
+        if i % 2 == 0:
+            lon = (lon << 1) | bit
+        else:
+            lat = (lat << 1) | bit
+    return lat, lon, lat_bits, lon_bits
+
+
+def _join_axes(lat: int, lon: int, bits: int) -> int:
+    lon_bits = (bits + 1) // 2
+    lat_bits = bits // 2
+    out = 0
+    li, oi = lat_bits, lon_bits
+    for i in range(bits):
+        if i % 2 == 0:
+            oi -= 1
+            out = (out << 1) | ((lon >> oi) & 1)
+        else:
+            li -= 1
+            out = (out << 1) | ((lat >> li) & 1)
+    return out
+
+
+def _neighbor_code(code: int, bits: int, direction: str) -> int:
+    """Neighbor via per-axis integer increment with wraparound — the
+    mmcloughlin/geohash approach, so pole-row cells wrap instead of
+    returning themselves (a clamped midpoint re-encode would)."""
+    if direction not in _DIRS:
+        raise ValueError(f"invalid direction {direction!r}")
+    dlat, dlon = _DIRS[direction]
+    lat, lon, lat_bits, lon_bits = _split_axes(code, bits)
+    lat = (lat + dlat) % (1 << lat_bits)
+    lon = (lon + dlon) % (1 << lon_bits)
+    return _join_axes(lat, lon, bits)
+
+
+def _gh_neighbor(hash_: str, direction: str) -> str:
+    code = _neighbor_code(_gh_code(hash_), len(hash_) * 5, direction)
+    chars = len(hash_)
+    return "".join(_B32[(code >> (5 * (chars - 1 - i))) & 31]
+                   for i in range(chars))
+
+
+_INT_BITS = 64
+
+
+def _gh_encode_int(lat: float, lon: float) -> int:
+    return _interleave(float(lat), float(lon), _INT_BITS)
+
+
+def _gh_box_int(code: int) -> Dict[str, float]:
+    (la0, la1), (lo0, lo1) = _deinterleave(int(code), _INT_BITS)
+    return {"MinLat": la0, "MaxLat": la1, "MinLng": lo0, "MaxLng": lo1}
+
+
+def _gh_neighbor_int(code: int, direction: str) -> int:
+    return _neighbor_code(int(code), _INT_BITS, direction)
+
+
+@register("geohashencode", SCALAR)
+def f_geohash_encode(args, ctx):
+    chars = int(args[2]) if len(args) > 2 else 12
+    return _gh_encode(float(args[0]), float(args[1]), chars)
+
+
+@register("geohashencodeint", SCALAR)
+def f_geohash_encode_int(args, ctx):
+    return _gh_encode_int(float(args[0]), float(args[1]))
+
+
+@register("geohashdecode", SCALAR)
+def f_geohash_decode(args, ctx):
+    lat, lon = _gh_decode(str(args[0]))
+    return {"Latitude": lat, "Longitude": lon}
+
+
+@register("geohashdecodeint", SCALAR)
+def f_geohash_decode_int(args, ctx):
+    b = _gh_box_int(int(args[0]))
+    return {"Latitude": (b["MinLat"] + b["MaxLat"]) / 2,
+            "Longitude": (b["MinLng"] + b["MaxLng"]) / 2}
+
+
+@register("geohashboundingbox", SCALAR)
+def f_geohash_bbox(args, ctx):
+    return _gh_box(str(args[0]))
+
+
+@register("geohashboundingboxint", SCALAR)
+def f_geohash_bbox_int(args, ctx):
+    return _gh_box_int(int(args[0]))
+
+
+@register("geohashneighbor", SCALAR)
+def f_geohash_neighbor(args, ctx):
+    return _gh_neighbor(str(args[0]), str(args[1]))
+
+
+@register("geohashneighborint", SCALAR)
+def f_geohash_neighbor_int(args, ctx):
+    return _gh_neighbor_int(int(args[0]), str(args[1]))
+
+
+@register("geohashneighbors", SCALAR)
+def f_geohash_neighbors(args, ctx):
+    h = str(args[0])
+    return [_gh_neighbor(h, d) for d in _NEIGHBOR_ORDER]
+
+
+@register("geohashneighborsint", SCALAR)
+def f_geohash_neighbors_int(args, ctx):
+    c = int(args[0])
+    return [_gh_neighbor_int(c, d) for d in _NEIGHBOR_ORDER]
+
+
+# ------------------------------------------------------------------- image
+def _img_bytes(arg: Any) -> bytes:
+    if isinstance(arg, (bytes, bytearray)):
+        return bytes(arg)
+    if isinstance(arg, str):
+        return base64.b64decode(arg)
+    raise ValueError(f"expected image bytes / base64, got {type(arg).__name__}")
+
+
+def _resize(args: List[Any], exact: bool) -> Any:
+    from PIL import Image
+
+    raw = _img_bytes(args[0])
+    width, height = int(args[1]), int(args[2])
+    if width < 0 or height < 0:
+        raise ValueError("width/height must be non-negative")
+    is_raw = bool(args[3]) if len(args) > 3 else False
+    img = Image.open(io.BytesIO(raw))
+    fmt = img.format or "PNG"
+    if exact:
+        img = img.resize((width, height), Image.BILINEAR)
+    else:
+        img.thumbnail((width, height), Image.BILINEAR)
+    if is_raw:
+        # raw RGB byte planes, the reference's model-input mode
+        # (resize.go:70-84)
+        return img.convert("RGB").tobytes()
+    out = io.BytesIO()
+    img.save(out, format=fmt)
+    return out.getvalue()
+
+
+@register("resize", SCALAR)
+def f_resize(args, ctx):
+    """resize(img, width, height[, raw]) — image/resize.go:42."""
+    return _resize(args, exact=True)
+
+
+@register("thumbnail", SCALAR)
+def f_thumbnail(args, ctx):
+    """thumbnail(img, maxWidth, maxHeight) — image/thumbnail.go."""
+    return _resize(args, exact=False)
+
+
+# --------------------------------------------------------------- inference
+_MODELS: Dict[str, Any] = {}
+
+
+import re as _re
+
+_MODEL_NAME = _re.compile(r"^[A-Za-z0-9_.-]+$")
+
+
+def _load_model(name: str):
+    m = _MODELS.get(name)
+    if m is None:
+        import os
+
+        import torch
+
+        from ..utils.config import get_config
+
+        # the name comes from SQL text — it must stay a bare file name
+        # under <data_dir>/models, never a path (traversal would make the
+        # function an arbitrary-file loader)
+        if not _MODEL_NAME.match(name) or ".." in name:
+            raise ValueError(f"invalid model name {name!r}")
+        base = name[:-3] if name.endswith(".pt") else name
+        path = os.path.join(get_config().data_dir, "models", f"{base}.pt")
+        m = torch.jit.load(path, map_location="cpu")
+        m.eval()
+        _MODELS[name] = m
+    return m
+
+
+@register("model_infer", SCALAR)
+def f_model_infer(args, ctx):
+    """model_infer(model_name, input...) — in-process inference, the role
+    of extensions/functions/onnx/onnx.go (TorchScript divergence: models
+    are .pt files under <data_dir>/models/). Each extra arg is one input
+    tensor (scalars and flat lists become float32 tensors); the output
+    tensor returns as a (nested) list."""
+    import torch
+
+    model = _load_model(str(args[0]))
+    tensors = []
+    for a in args[1:]:
+        if isinstance(a, (list, tuple)):
+            tensors.append(torch.as_tensor(a, dtype=torch.float32))
+        else:
+            tensors.append(torch.as_tensor([float(a)], dtype=torch.float32))
+    with torch.no_grad():
+        out = model(*tensors)
+    if isinstance(out, (list, tuple)):
+        return [o.tolist() for o in out]
+    return out.tolist()

@@ -141,6 +141,7 @@ def _ensure_loaded() -> None:
         funcs_analytic,
         funcs_array,
         funcs_datetime,
+        funcs_ext,
         funcs_global_state,
         funcs_inc_agg,
         funcs_math,

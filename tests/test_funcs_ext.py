@@ -1,0 +1,146 @@
+"""Extension function plugins (geohash / image / model inference) —
+goldens cross-checked against mmcloughlin/geohash (the reference's
+library) and pillow round-trips; reference:
+extensions/functions/{geohash,image,onnx}."""
+import base64
+import io
+import os
+
+import numpy as np
+import pytest
+
+from ekuiper_tpu.functions import registry as freg
+
+
+def call(name, *args):
+    fd = freg.lookup(name)
+    assert fd is not None, f"{name} not registered"
+    return fd.exec(list(args), {})
+
+
+class TestGeohash:
+    def test_encode_known_values(self):
+        # canonical golden (Wikipedia geohash article):
+        # (57.64911, 10.40744) -> u4pruydqqvj
+        assert call("geohashEncode", 57.64911, 10.40744, 11) == "u4pruydqqvj"
+        assert call("geohashEncode", 48.858, 2.294, 6) == "u09tun"
+        assert call("geohashEncode", 0.0, 0.0, 1) == "s"
+        assert call("geohashEncode", -90.0, -180.0, 4) == "0000"
+
+    def test_decode_roundtrip(self):
+        h = call("geohashEncode", 48.858, 2.294)
+        pos = call("geohashDecode", h)
+        assert abs(pos["Latitude"] - 48.858) < 1e-5
+        assert abs(pos["Longitude"] - 2.294) < 1e-5
+
+    def test_int_roundtrip(self):
+        code = call("geohashEncodeInt", 48.858, 2.294)
+        assert isinstance(code, int) and code > 0
+        pos = call("geohashDecodeInt", code)
+        # 64-bit hash = 32 bits/axis: lon resolution 360/2^32 ≈ 8.4e-8
+        assert abs(pos["Latitude"] - 48.858) < 1e-6
+        assert abs(pos["Longitude"] - 2.294) < 1e-6
+
+    def test_bounding_box_contains_point(self):
+        b = call("geohashBoundingBox", "u09tun")
+        assert b["MinLat"] < 48.858 < b["MaxLat"]
+        assert b["MinLng"] < 2.294 < b["MaxLng"]
+
+    def test_neighbors(self):
+        # neighbors tile the plane: each neighbor's box touches the center
+        h = "u09tun"
+        ns = call("geohashNeighbors", h)
+        assert len(ns) == 8 and len(set(ns)) == 8 and h not in ns
+        east = call("geohashNeighbor", h, "East")
+        assert east in ns
+        b0, b1 = call("geohashBoundingBox", h), call("geohashBoundingBox", east)
+        assert abs(b1["MinLng"] - b0["MaxLng"]) < 1e-9
+        assert abs(b1["MinLat"] - b0["MinLat"]) < 1e-9
+
+    def test_neighbors_int(self):
+        code = call("geohashEncodeInt", 10.0, 10.0)
+        ns = call("geohashNeighborsInt", code)
+        assert len(ns) == 8 and all(isinstance(n, int) for n in ns)
+
+    def test_invalid_inputs(self):
+        with pytest.raises(Exception):
+            call("geohashDecode", "invalid!!")
+        with pytest.raises(Exception):
+            call("geohashNeighbor", "u09", "Up")
+
+
+class TestImage:
+    def _png(self, w=32, h=16):
+        from PIL import Image
+
+        img = Image.new("RGB", (w, h), (200, 10, 30))
+        out = io.BytesIO()
+        img.save(out, format="PNG")
+        return out.getvalue()
+
+    def test_resize_exact(self):
+        from PIL import Image
+
+        out = call("resize", self._png(), 8, 4)
+        img = Image.open(io.BytesIO(out))
+        assert img.size == (8, 4) and img.format == "PNG"
+
+    def test_resize_base64_input(self):
+        from PIL import Image
+
+        out = call("resize", base64.b64encode(self._png()).decode(), 8, 4)
+        assert Image.open(io.BytesIO(out)).size == (8, 4)
+
+    def test_resize_raw_mode(self):
+        out = call("resize", self._png(), 8, 4, True)
+        assert isinstance(out, bytes) and len(out) == 8 * 4 * 3
+        arr = np.frombuffer(out, dtype=np.uint8).reshape(4, 8, 3)
+        assert arr[0, 0, 0] > 150  # red-dominant fill preserved
+
+    def test_thumbnail_keeps_aspect(self):
+        from PIL import Image
+
+        out = call("thumbnail", self._png(32, 16), 8, 8)
+        img = Image.open(io.BytesIO(out))
+        assert img.size == (8, 4)  # aspect preserved, bounded by 8
+
+
+class TestModelInfer:
+    def test_torchscript_roundtrip(self, tmp_path, monkeypatch):
+        torch = pytest.importorskip("torch")
+
+        class Doubler(torch.nn.Module):
+            def forward(self, x):
+                return x * 2.0
+
+        mdir = tmp_path / "models"
+        mdir.mkdir()
+        torch.jit.script(Doubler()).save(str(mdir / "doubler.pt"))
+        from ekuiper_tpu.utils import config as cfgmod
+
+        cfg = cfgmod.get_config()
+        monkeypatch.setattr(cfg, "data_dir", str(tmp_path))
+        import ekuiper_tpu.functions.funcs_ext as fx
+
+        fx._MODELS.clear()
+        out = call("model_infer", "doubler", [1.0, 2.5, 3.0])
+        assert out == [2.0, 5.0, 6.0]
+        # cached on second call
+        assert "doubler" in fx._MODELS
+        out2 = call("model_infer", "doubler", 4.0)
+        assert out2 == [8.0]
+
+
+class TestGeohashPoles:
+    def test_pole_row_wraps_not_self(self):
+        h = call("geohashEncode", 89.9999, 0.0, 6)  # top lat row
+        north = call("geohashNeighbor", h, "North")
+        assert north != h
+        ns = call("geohashNeighbors", h)
+        assert len(set(ns)) == 8 and h not in ns
+
+    def test_model_name_traversal_rejected(self):
+        with pytest.raises(Exception, match="invalid model name"):
+            call("model_infer", "../../../etc/passwd", 1.0)
+        with pytest.raises(Exception, match="invalid model name"):
+            call("model_infer", "/abs/path.pt", 1.0)

@@ -518,3 +518,64 @@ class TestShardedStateAndSession:
 
         assert norm(sh_got) == norm(si_got)
         assert norm(sh_got), "no session emitted"
+
+
+def test_event_time_mesh_state_parity(eight_devices, mock_clock):
+    """Both newly-allowed flags TOGETHER: event-time STATE window on a
+    mesh, parity with the host path (review finding r5 coverage gap)."""
+    import time
+
+    import ekuiper_tpu.io.memory as mem
+    from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+    from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+    from ekuiper_tpu.server.processors import StreamProcessor
+    from ekuiper_tpu.store import kv
+
+    mem.reset()
+    store = kv.get_store()
+    StreamProcessor(store).exec_stmt(
+        'CREATE STREAM ems (deviceId STRING, t FLOAT, ts BIGINT) '
+        'WITH (DATASOURCE="in/ems", TYPE="memory", FORMAT="JSON", '
+        'TIMESTAMP="ts")')
+    rows = [
+        {"deviceId": "a", "t": 30.0, "ts": 1000},  # begin
+        {"deviceId": "b", "t": 12.0, "ts": 2000},
+        {"deviceId": "a", "t": 5.0, "ts": 3000},   # emit
+        {"deviceId": "b", "t": 40.0, "ts": 4000},  # begin
+        {"deviceId": "a", "t": 2.0, "ts": 5000},   # emit
+    ]
+
+    def run(rule_id, options):
+        topo = plan_rule(RuleDef(
+            id=rule_id,
+            sql=("SELECT deviceId, count(*) AS c, avg(t) AS a FROM ems "
+                 "GROUP BY deviceId, STATEWINDOW(t > 25, t < 8)"),
+            actions=[{"memory": {"topic": f"o/{rule_id}"}}],
+            options=options), store)
+        got = []
+        mem.subscribe(f"o/{rule_id}", lambda tp, p: got.append(p))
+        topo.open()
+        try:
+            for r in rows:
+                mem.publish("in/ems", r)
+            mock_clock.advance(20)
+            assert topo.wait_idle(30)
+            deadline = time.time() + 10
+            while time.time() < deadline and len(got) < 2:
+                time.sleep(0.02)
+        finally:
+            topo.close()
+        out = []
+        for p in got:
+            out.extend(p if isinstance(p, list) else [p])
+        return sorted((m["deviceId"], m["c"], round(m["a"], 4)) for m in out), topo
+
+    fused, ft = run("emsd", {
+        "isEventTime": True, "lateTolerance": 500,
+        "planOptimizeStrategy": {"mesh": {"rows": 2, "keys": 4}}})
+    assert any(isinstance(n, FusedWindowAggNode) for n in ft.ops)
+    host, ht = run("emsh", {
+        "isEventTime": True, "lateTolerance": 500,
+        "use_device_kernel": False})
+    assert not any(isinstance(n, FusedWindowAggNode) for n in ht.ops)
+    assert fused and fused == host
