@@ -149,20 +149,6 @@ def _ensure() -> None:
     register_sink("influx", InfluxSink)
     register_sink("influx2", Influx2Sink)
 
-    # connectors whose client libraries are not bundled register a factory
-    # that raises a clear error (the reference gates these behind build
-    # tags; a missing build tag gives the same "not compiled in" experience)
-    from ..utils.infra import EngineError
-
-    def _gated(kind: str, pkg: str):
-        class _Gated:
-            def __init__(self):
-                raise EngineError(
-                    f"{kind} connector requires the {pkg} package, which is "
-                    "not bundled in this image")
-
-        return _Gated
-
     from .kafka_io import KafkaSink, KafkaSource
 
     register_source("kafka", KafkaSource)
@@ -177,10 +163,6 @@ def _ensure() -> None:
 
     register_sink("tdengine3", Tdengine3Sink)
 
-    for kind, pkg, has_src, has_sink in (
-        ("video", "opencv-python", True, False),
-    ):
-        if has_src:
-            register_source(kind, _gated(kind, pkg))
-        if has_sink:
-            register_sink(kind, _gated(kind, pkg))
+    from .video_io import VideoSource
+
+    register_source("video", VideoSource)

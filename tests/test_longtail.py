@@ -30,13 +30,12 @@ class TestMeta:
 
 
 class TestGatedExtensions:
-    def test_kafka_zmq_ungated_video_gated(self):
-        # kafka + zmq became real connectors (bundled wire clients); video
-        # still needs a frame decoder the image lacks
+    def test_extension_connectors_ungated(self):
+        # kafka + zmq + video are real connectors now (bundled wire
+        # clients / MJPEG-over-HTTP frame puller)
         assert io_registry.create_source("kafka") is not None
         assert io_registry.create_sink("zmq") is not None
-        with pytest.raises(EngineError, match="opencv-python"):
-            io_registry.create_source("video")
+        assert io_registry.create_source("video") is not None
 
 
 class TestSqlIo:
