@@ -699,8 +699,13 @@ def _hetero_main() -> None:
             # the spike (depth << bufferLength so drop-oldest NEVER fires;
             # asserted below) and only stall when a node falls genuinely
             # behind for a sustained stretch
+            bp_deadline = time.time() + 120
             while max(f.inq.qsize() for f in fused) > 48:
                 time.sleep(0.002)
+                if time.time() > bp_deadline:
+                    raise RuntimeError(
+                        "hetero: queues stuck >120s (device link wedged?) "
+                        "— aborting phase")
             stall += time.time() - ts
         for t in topos:
             t.wait_idle(timeout=30.0)
@@ -816,9 +821,16 @@ def _full_pipe_main() -> None:
             byts += n_bytes_per
             n += 1
             # backpressure: keep the fused node's input queue shallow so
-            # drop-oldest never fires (dropped batches would fake the rate)
+            # drop-oldest never fires (dropped batches would fake the rate).
+            # Deadline-bounded: a wedged device link must fail the phase
+            # loudly, not hang it into the driver's subprocess timeout
+            bp_deadline = time.time() + 120
             while fused.inq.qsize() > 8:
                 time.sleep(0.002)
+                if time.time() > bp_deadline:
+                    raise RuntimeError(
+                        "full-pipe: fused queue stuck >120s (device link "
+                        "wedged?) — aborting phase")
         # drain: all queued batches consumed (state is owned by the node's
         # worker thread — donated buffers, do not touch from here)
         topo.wait_idle(timeout=30.0)
