@@ -16,6 +16,8 @@ Source props: brokers, partition (int, default all partitions), offset
 group-less default), maxBytes, pollInterval (ms between empty polls).
 Sink props: brokers, topic, key (static message key), partition (int,
 default round-robin), requiredACKs (-1/0/1), batchSize, format.
+Both: saslAuthType ("none" | "plain"), saslUserName, password — the
+reference's SASL prop names (source.go:255-277); SCRAM is not bundled.
 """
 from __future__ import annotations
 
@@ -29,6 +31,18 @@ from .converters import get_converter
 from .kafka_wire import KafkaClient
 
 
+def _sasl_of(props: Dict[str, Any]):
+    """(mech, user, password) from the reference's prop names, or None."""
+    kind = str(props.get("saslAuthType", "none") or "none").lower()
+    if kind in ("", "none"):
+        return None
+    if kind != "plain":
+        raise EngineError(
+            f"kafka: unsupported saslAuthType {kind!r} (only plain bundled)")
+    return ("PLAIN", str(props.get("saslUserName") or ""),
+            str(props.get("password") or props.get("saslPassword") or ""))
+
+
 class KafkaSource(Source, Rewindable):
     def __init__(self) -> None:
         self.topic = ""
@@ -37,6 +51,7 @@ class KafkaSource(Source, Rewindable):
         self.start = "earliest"
         self.max_bytes = 1_000_000
         self.poll_interval = 0.1
+        self.sasl = None
         self._client: Optional[KafkaClient] = None
         self._offsets: Dict[int, int] = {}  # partition -> next fetch offset
         self._stop = threading.Event()
@@ -60,6 +75,7 @@ class KafkaSource(Source, Rewindable):
         self.start = props.get("offset", "earliest")
         self.max_bytes = int(props.get("maxBytes", 1_000_000))
         self.poll_interval = float(props.get("pollInterval", 100)) / 1000.0
+        self.sasl = _sasl_of(props)
 
     def _note_failure(self, fails: Dict[int, int], retry_at: Dict[int, float],
                       p: int, off: int, e: Exception) -> None:
@@ -85,7 +101,7 @@ class KafkaSource(Source, Rewindable):
                     self._offsets[p] = int(self.start)
 
     def open(self, ingest) -> None:
-        self._client = KafkaClient(self.brokers)
+        self._client = KafkaClient(self.brokers, sasl=self.sasl)
         self._init_offsets(self._client)
 
         def loop() -> None:
@@ -185,6 +201,7 @@ class KafkaSink(Sink):
         self.brokers = ""
         self.key: Optional[str] = None
         self.partition: Optional[int] = None
+        self.sasl = None
         self.acks = 1
         self.format = "json"
         self._client: Optional[KafkaClient] = None
@@ -203,9 +220,10 @@ class KafkaSink(Sink):
         self.partition = int(p) if p is not None else None
         self.acks = int(props.get("requiredACKs", 1))
         self.format = props.get("format", "json")
+        self.sasl = _sasl_of(props)
 
     def connect(self) -> None:
-        self._client = KafkaClient(self.brokers)
+        self._client = KafkaClient(self.brokers, sasl=self.sasl)
         self._parts = ([self.partition] if self.partition is not None
                        else self._client.partitions(self.topic))
 

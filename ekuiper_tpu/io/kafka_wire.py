@@ -6,8 +6,10 @@ Kafka client, so the connector speaks the broker protocol directly over the
 engine's own sockets, the same way the MQTT connector bundles a native
 3.1.1 client (io/mqtt_native.py).
 
-Implements the five RPCs a group-less producer/consumer needs, pinned to
-legacy (non-flexible, big-endian) versions every broker since 0.10 serves:
+Implements the RPCs a group-less producer/consumer needs, pinned to
+legacy (non-flexible, big-endian) versions every broker since 0.10 serves
+(SASL auth is the exception: SaslHandshake v1 + SaslAuthenticate are
+KIP-152, broker >= 1.0):
 
     ApiVersions v0   handshake / liveness
     Metadata    v1   topic -> partition -> leader routing
@@ -242,18 +244,47 @@ def _check(code: int, what: str) -> None:
 
 
 class KafkaClient:
-    """Partition-leader-aware client over one or more bootstrap brokers."""
+    """Partition-leader-aware client over one or more bootstrap brokers.
+
+    sasl: optional ("PLAIN", username, password) — authenticated on every
+    broker connection via SaslHandshake v1 + SaslAuthenticate v0
+    (reference saslAuthType=plain, extensions/impl/kafka/source.go:255).
+    SCRAM is not implemented (would need the full RFC 5802 exchange)."""
 
     def __init__(self, brokers: str, client_id: str = "ekuiper-tpu",
-                 timeout: float = 10.0) -> None:
+                 timeout: float = 10.0,
+                 sasl: Optional[Tuple[str, str, str]] = None) -> None:
         self.bootstrap = [self._hostport(b) for b in brokers.split(",") if b]
         if not self.bootstrap:
             raise EngineError("kafka: brokers can not be empty")
+        if sasl is not None and sasl[0].upper() != "PLAIN":
+            raise EngineError(
+                f"kafka: unsupported SASL mechanism {sasl[0]!r} "
+                "(only PLAIN is bundled)")
         self.client_id = client_id
         self.timeout = timeout
+        self.sasl = sasl
         self._conns: Dict[Tuple[str, int], _BrokerConn] = {}
         self._leaders: Dict[Tuple[str, int], Tuple[str, int]] = {}
         self._mu = threading.Lock()
+
+    def _authenticate(self, conn: _BrokerConn) -> None:
+        """SASL/PLAIN: handshake the mechanism, then send the RFC 4616
+        [authzid] NUL authcid NUL passwd token."""
+        mech, user, password = self.sasl
+        r = conn.request(17, 1, _string("PLAIN"))  # SaslHandshake v1
+        code = r.i16()
+        if code != 0:
+            mechs = [r.string() for _ in range(r.i32())]
+            raise EngineError(
+                f"kafka: SASL handshake failed ({ERRS.get(code, code)}); "
+                f"broker offers {mechs}")
+        token = b"\x00" + user.encode() + b"\x00" + password.encode()
+        r = conn.request(36, 0, _bytes(token))  # SaslAuthenticate v0
+        code = r.i16()
+        msg = r.string()
+        if code != 0:
+            raise EngineError(f"kafka: SASL authentication failed: {msg}")
 
     @staticmethod
     def _hostport(b: str) -> Tuple[str, int]:
@@ -263,10 +294,25 @@ class KafkaClient:
     def _conn(self, addr: Tuple[str, int]) -> _BrokerConn:
         with self._mu:
             c = self._conns.get(addr)
-            if c is None:
-                c = _BrokerConn(addr[0], addr[1], self.client_id, self.timeout)
-                self._conns[addr] = c
+        if c is not None:
             return c
+        # dial + authenticate OUTSIDE the lock: SASL is two blocking round
+        # trips, and holding _mu through them would stall close() and all
+        # other routing against a wedged broker
+        c = _BrokerConn(addr[0], addr[1], self.client_id, self.timeout)
+        if self.sasl is not None:
+            try:
+                self._authenticate(c)
+            except BaseException:
+                c.close()
+                raise
+        with self._mu:
+            existing = self._conns.get(addr)
+            if existing is not None:  # lost the race — keep the winner
+                c.close()
+                return existing
+            self._conns[addr] = c
+        return c
 
     def _drop_conn(self, addr: Tuple[str, int]) -> None:
         with self._mu:

@@ -65,10 +65,15 @@ class _Cur:
 
 
 class MockBroker:
-    """One-node cluster. topics: name -> partition count."""
+    """One-node cluster. topics: name -> partition count.
+    sasl_users: when set, connections must SaslHandshake(PLAIN) +
+    SaslAuthenticate before any other API (independently hand-coded like
+    the rest of the broker)."""
 
-    def __init__(self, topics: Dict[str, int]) -> None:
+    def __init__(self, topics: Dict[str, int],
+                 sasl_users: Optional[Dict[str, str]] = None) -> None:
         self.topics = dict(topics)
+        self.sasl_users = sasl_users
         # (topic, partition) -> list of (key, value, ts)
         self.data: Dict[Tuple[str, int], List[Tuple[Optional[bytes], bytes, int]]] = {
             (t, p): [] for t, n in self.topics.items() for p in range(n)}
@@ -125,6 +130,8 @@ class MockBroker:
         return buf
 
     def _serve(self, conn: socket.socket) -> None:
+        authed = self.sasl_users is None
+        pending_mech: Optional[str] = None
         try:
             while not self._stop.is_set():
                 size = struct.unpack(">i", self._recv_n(conn, 4))[0]
@@ -132,6 +139,38 @@ class MockBroker:
                 api_key, api_ver, corr = req.i16(), req.i16(), req.i32()
                 req.s()  # client id
                 self.log.append((api_key, api_ver))
+                if api_key == 17:  # SaslHandshake v1
+                    mech = req.s() or ""
+                    if mech.upper() == "PLAIN":
+                        pending_mech = mech
+                        body = struct.pack(">h", 0) + struct.pack(">i", 1) \
+                            + _s("PLAIN")
+                    else:
+                        body = struct.pack(">h", 33) \
+                            + struct.pack(">i", 1) + _s("PLAIN")
+                    resp = struct.pack(">i", corr) + body
+                    conn.sendall(struct.pack(">i", len(resp)) + resp)
+                    continue
+                if api_key == 36:  # SaslAuthenticate v0
+                    token = req.b() or b""
+                    parts = token.split(b"\x00")
+                    ok = (pending_mech is not None and len(parts) == 3
+                          and self.sasl_users is not None
+                          and self.sasl_users.get(parts[1].decode())
+                          == parts[2].decode())
+                    if ok:
+                        authed = True
+                        body = struct.pack(">h", 0) + _s("") + _b(b"")
+                    else:
+                        body = struct.pack(">h", 58) \
+                            + _s("Authentication failed") + _b(b"")
+                    resp = struct.pack(">i", corr) + body
+                    conn.sendall(struct.pack(">i", len(resp)) + resp)
+                    if not ok:
+                        break  # real brokers drop unauthenticated conns
+                    continue
+                if not authed:
+                    break  # no API before authentication
                 handler = {18: self._api_versions, 3: self._metadata,
                            2: self._list_offsets, 0: self._produce,
                            1: self._fetch}.get(api_key)

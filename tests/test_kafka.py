@@ -309,3 +309,58 @@ class TestKafkaRuleE2E:
             topo.close()
         out = [json.loads(v) for _, v, _ in broker.data[("t1", 0)]]
         assert out and out[0] == {"deviceId": "d", "c": 5}
+
+
+class TestSaslPlain:
+    @pytest.fixture
+    def sasl_broker(self):
+        b = MockBroker({"t1": 1}, sasl_users={"alice": "secret"})
+        yield b
+        b.close()
+
+    def test_authenticated_roundtrip(self, sasl_broker):
+        c = KafkaClient(sasl_broker.bootstrap,
+                        sasl=("PLAIN", "alice", "secret"))
+        assert c.produce("t1", 0, [(None, b"hi", 1)]) == 0
+        _, msgs = c.fetch("t1", 0, 0)
+        assert [v for _, _, v, _ in msgs] == [b"hi"]
+        c.close()
+
+    def test_wrong_password_rejected(self, sasl_broker):
+        c = KafkaClient(sasl_broker.bootstrap,
+                        sasl=("PLAIN", "alice", "nope"))
+        with pytest.raises(EngineError, match="[Aa]uthentication"):
+            c.partitions("t1")
+        c.close()
+
+    def test_unauthenticated_conn_refused(self, sasl_broker):
+        c = KafkaClient(sasl_broker.bootstrap)
+        with pytest.raises(EngineError):
+            c.partitions("t1")
+        c.close()
+
+    def test_source_sink_props(self, sasl_broker):
+        sink = KafkaSink()
+        sink.configure({"topic": "t1", "brokers": sasl_broker.bootstrap,
+                        "saslAuthType": "plain", "saslUserName": "alice",
+                        "password": "secret"})
+        sink.connect()
+        sink.collect({"x": 1})
+        sink.close()
+        src = KafkaSource()
+        src.configure("t1", {"brokers": sasl_broker.bootstrap,
+                             "saslAuthType": "plain",
+                             "saslUserName": "alice", "password": "secret",
+                             "pollInterval": 20})
+        got = []
+        src.open(lambda payload, meta=None: got.append(payload))
+        deadline = time.time() + 5
+        while time.time() < deadline and not got:
+            time.sleep(0.02)
+        src.close()
+        assert got and json.loads(got[0]) == {"x": 1}
+
+    def test_scram_rejected_clearly(self):
+        with pytest.raises(EngineError, match="only plain"):
+            KafkaSource().configure("t", {
+                "brokers": "h:1", "saslAuthType": "scram_sha_256"})
