@@ -193,6 +193,7 @@ class TestConnector:
             mem.publish("t/z", {"a": 1.0})
             mock_clock.advance(20)  # memory-source linger flush
             time.sleep(0.5)
+            deadline = time.time() + 25  # sub reconnect backoff can hit 5s
             while time.time() < deadline and not got:
                 mem.publish("t/z", {"a": 21.0})
                 mock_clock.advance(20)
