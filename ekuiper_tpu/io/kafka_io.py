@@ -31,15 +31,22 @@ from .converters import get_converter
 from .kafka_wire import KafkaClient
 
 
+_SASL_KINDS = {"plain": "PLAIN", "scram_sha_256": "SCRAM-SHA-256",
+               "scram_sha_512": "SCRAM-SHA-512"}
+
+
 def _sasl_of(props: Dict[str, Any]):
-    """(mech, user, password) from the reference's prop names, or None."""
+    """(mech, user, password) from the reference's prop names
+    (saslAuthType plain/scram_sha_256/scram_sha_512), or None."""
     kind = str(props.get("saslAuthType", "none") or "none").lower()
     if kind in ("", "none"):
         return None
-    if kind != "plain":
+    mech = _SASL_KINDS.get(kind)
+    if mech is None:
         raise EngineError(
-            f"kafka: unsupported saslAuthType {kind!r} (only plain bundled)")
-    return ("PLAIN", str(props.get("saslUserName") or ""),
+            f"kafka: unsupported saslAuthType {kind!r} "
+            f"(want one of {sorted(_SASL_KINDS)})")
+    return (mech, str(props.get("saslUserName") or ""),
             str(props.get("password") or props.get("saslPassword") or ""))
 
 

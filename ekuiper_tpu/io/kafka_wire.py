@@ -23,7 +23,11 @@ deliberately NOT implemented; see io/kafka_io.py for the divergence note.
 """
 from __future__ import annotations
 
+import base64
 import gzip
+import hashlib
+import hmac
+import os
 import socket
 import struct
 import threading
@@ -179,6 +183,62 @@ def decode_message_set(data: bytes) -> List[Tuple[int, Optional[bytes], bytes, i
     return out
 
 
+# -------------------------------------------------------------------- scram
+def _scram_hash(mech: str):
+    return hashlib.sha512 if mech.endswith("512") else hashlib.sha256
+
+
+def _scram_hi(mech: str, password: bytes, salt: bytes, it: int) -> bytes:
+    return hashlib.pbkdf2_hmac(_scram_hash(mech)().name, password, salt, it)
+
+
+def _scram_client(mech: str, user: str, password: str, step) -> None:
+    """RFC 5802 client over a send(payload)->response callable. Verifies
+    the server signature — a broker that can't prove knowledge of the
+    stored key fails authentication even if it accepts ours."""
+    h = _scram_hash(mech)
+    c_nonce = base64.b64encode(os.urandom(18)).decode()
+    user_sasl = user.replace("=", "=3D").replace(",", "=2C")
+    c_first_bare = f"n={user_sasl},r={c_nonce}"
+    s_first = step(("n,," + c_first_bare).encode()).decode()
+    try:
+        attrs = dict(p.split("=", 1) for p in s_first.split(","))
+        nonce = attrs["r"]
+        salt = base64.b64decode(attrs["s"])
+        iters = int(attrs["i"])
+    except (ValueError, KeyError) as e:
+        raise EngineError(f"kafka: malformed SCRAM server-first message: {e}")
+    if not nonce.startswith(c_nonce):
+        raise EngineError("kafka: SCRAM server nonce mismatch")
+    if not 4096 <= iters <= 10_000_000:
+        # floor per RFC 7677 guidance (downgrade protection); ceiling so a
+        # rogue broker can't pin the CPU in PBKDF2 for hours inside connect
+        raise EngineError(
+            f"kafka: SCRAM iteration count {iters} outside [4096, 1e7]")
+    salted = _scram_hi(mech, password.encode(), salt, iters)
+    client_key = hmac.new(salted, b"Client Key", h).digest()
+    stored_key = h(client_key).digest()
+    c_final_bare = f"c=biws,r={nonce}"
+    auth_msg = f"{c_first_bare},{s_first},{c_final_bare}".encode()
+    client_sig = hmac.new(stored_key, auth_msg, h).digest()
+    proof = bytes(a ^ b for a, b in zip(client_key, client_sig))
+    c_final = f"{c_final_bare},p={base64.b64encode(proof).decode()}"
+    s_final = step(c_final.encode()).decode()
+    try:
+        fattrs = dict(p.split("=", 1) for p in s_final.split(","))
+        if "e" in fattrs:
+            raise EngineError(f"kafka: SCRAM rejected: {fattrs['e']}")
+        server_v = base64.b64decode(fattrs.get("v", ""))
+    except EngineError:
+        raise
+    except (ValueError, KeyError) as e:
+        raise EngineError(f"kafka: malformed SCRAM server-final message: {e}")
+    server_key = hmac.new(salted, b"Server Key", h).digest()
+    server_sig = hmac.new(server_key, auth_msg, h).digest()
+    if server_v != server_sig:
+        raise EngineError("kafka: SCRAM server signature invalid")
+
+
 # ------------------------------------------------------------------- client
 class _BrokerConn:
     """One TCP connection to one broker; int32-size-framed req/rep."""
@@ -246,10 +306,12 @@ def _check(code: int, what: str) -> None:
 class KafkaClient:
     """Partition-leader-aware client over one or more bootstrap brokers.
 
-    sasl: optional ("PLAIN", username, password) — authenticated on every
-    broker connection via SaslHandshake v1 + SaslAuthenticate v0
-    (reference saslAuthType=plain, extensions/impl/kafka/source.go:255).
-    SCRAM is not implemented (would need the full RFC 5802 exchange)."""
+    sasl: optional (mechanism, username, password) with mechanism PLAIN,
+    SCRAM-SHA-256 or SCRAM-SHA-512 — authenticated on every broker
+    connection via SaslHandshake v1 + SaslAuthenticate v0 round trips
+    (reference saslAuthType plain/scram_sha_256/scram_sha_512,
+    extensions/impl/kafka/source.go:255). SCRAM is the full RFC 5802
+    exchange over hashlib/hmac — no external dependency."""
 
     def __init__(self, brokers: str, client_id: str = "ekuiper-tpu",
                  timeout: float = 10.0,
@@ -257,10 +319,11 @@ class KafkaClient:
         self.bootstrap = [self._hostport(b) for b in brokers.split(",") if b]
         if not self.bootstrap:
             raise EngineError("kafka: brokers can not be empty")
-        if sasl is not None and sasl[0].upper() != "PLAIN":
+        if sasl is not None and sasl[0].upper() not in (
+                "PLAIN", "SCRAM-SHA-256", "SCRAM-SHA-512"):
             raise EngineError(
                 f"kafka: unsupported SASL mechanism {sasl[0]!r} "
-                "(only PLAIN is bundled)")
+                "(PLAIN / SCRAM-SHA-256 / SCRAM-SHA-512)")
         self.client_id = client_id
         self.timeout = timeout
         self.sasl = sasl
@@ -269,22 +332,32 @@ class KafkaClient:
         self._mu = threading.Lock()
 
     def _authenticate(self, conn: _BrokerConn) -> None:
-        """SASL/PLAIN: handshake the mechanism, then send the RFC 4616
-        [authzid] NUL authcid NUL passwd token."""
+        """SaslHandshake v1 announces the mechanism, then SaslAuthenticate
+        v0 round trips carry the mechanism exchange: one RFC 4616 token
+        for PLAIN, the three-message RFC 5802 exchange for SCRAM."""
         mech, user, password = self.sasl
-        r = conn.request(17, 1, _string("PLAIN"))  # SaslHandshake v1
+        mech = mech.upper()
+        r = conn.request(17, 1, _string(mech))  # SaslHandshake v1
         code = r.i16()
         if code != 0:
             mechs = [r.string() for _ in range(r.i32())]
             raise EngineError(
                 f"kafka: SASL handshake failed ({ERRS.get(code, code)}); "
                 f"broker offers {mechs}")
-        token = b"\x00" + user.encode() + b"\x00" + password.encode()
-        r = conn.request(36, 0, _bytes(token))  # SaslAuthenticate v0
-        code = r.i16()
-        msg = r.string()
-        if code != 0:
-            raise EngineError(f"kafka: SASL authentication failed: {msg}")
+
+        def auth_step(payload: bytes) -> bytes:
+            rr = conn.request(36, 0, _bytes(payload))
+            c = rr.i16()
+            msg = rr.string()
+            if c != 0:
+                raise EngineError(
+                    f"kafka: SASL authentication failed: {msg}")
+            return rr.bytes_() or b""
+
+        if mech == "PLAIN":
+            auth_step(b"\x00" + user.encode() + b"\x00" + password.encode())
+            return
+        _scram_client(mech, user, password, auth_step)
 
     @staticmethod
     def _hostport(b: str) -> Tuple[str, int]:

@@ -129,9 +129,12 @@ class MockBroker:
             buf += chunk
         return buf
 
+    _MECHS = ("PLAIN", "SCRAM-SHA-256", "SCRAM-SHA-512")
+
     def _serve(self, conn: socket.socket) -> None:
         authed = self.sasl_users is None
         pending_mech: Optional[str] = None
+        scram = None
         try:
             while not self._stop.is_set():
                 size = struct.unpack(">i", self._recv_n(conn, 4))[0]
@@ -140,11 +143,13 @@ class MockBroker:
                 req.s()  # client id
                 self.log.append((api_key, api_ver))
                 if api_key == 17:  # SaslHandshake v1
-                    mech = req.s() or ""
-                    if mech.upper() == "PLAIN":
+                    mech = (req.s() or "").upper()
+                    if mech in self._MECHS:
                         pending_mech = mech
-                        body = struct.pack(">h", 0) + struct.pack(">i", 1) \
-                            + _s("PLAIN")
+                        body = struct.pack(">h", 0) \
+                            + struct.pack(">i", len(self._MECHS))
+                        for m in self._MECHS:
+                            body += _s(m)
                     else:
                         body = struct.pack(">h", 33) \
                             + struct.pack(">i", 1) + _s("PLAIN")
@@ -153,21 +158,40 @@ class MockBroker:
                     continue
                 if api_key == 36:  # SaslAuthenticate v0
                     token = req.b() or b""
-                    parts = token.split(b"\x00")
-                    ok = (pending_mech is not None and len(parts) == 3
-                          and self.sasl_users is not None
-                          and self.sasl_users.get(parts[1].decode())
-                          == parts[2].decode())
-                    if ok:
-                        authed = True
-                        body = struct.pack(">h", 0) + _s("") + _b(b"")
-                    else:
+                    if pending_mech is None:
+                        break  # authenticate without handshake: drop
+                    ok = False
+                    reply_bytes = b""
+                    done = False
+                    if pending_mech == "PLAIN":
+                        parts = token.split(b"\x00")
+                        ok = (len(parts) == 3
+                              and self.sasl_users is not None
+                              and self.sasl_users.get(parts[1].decode())
+                              == parts[2].decode())
+                        done = True
+                    elif pending_mech is not None:  # SCRAM
+                        if scram is None:
+                            scram = scram_server_exchange(
+                                pending_mech, self.sasl_users or {})
+                        out = scram(token)
+                        if out is None:
+                            ok, done = False, True
+                        else:
+                            reply_bytes = out
+                            done = out.startswith(b"v=")
+                            ok = done
+                    if done and not ok:
                         body = struct.pack(">h", 58) \
                             + _s("Authentication failed") + _b(b"")
+                        resp = struct.pack(">i", corr) + body
+                        conn.sendall(struct.pack(">i", len(resp)) + resp)
+                        break  # real brokers drop unauthenticated conns
+                    if done and ok:
+                        authed = True
+                    body = struct.pack(">h", 0) + _s("") + _b(reply_bytes)
                     resp = struct.pack(">i", corr) + body
                     conn.sendall(struct.pack(">i", len(resp)) + resp)
-                    if not ok:
-                        break  # real brokers drop unauthenticated conns
                     continue
                 if not authed:
                     break  # no API before authentication
@@ -324,3 +348,63 @@ class MockBroker:
                               + b"".join(parts))
         return (struct.pack(">i", 0)  # throttle
                 + struct.pack(">i", len(out_topics)) + b"".join(out_topics))
+
+
+import base64
+import hashlib
+import hmac
+import os
+from typing import Any
+
+from ekuiper_tpu.io.kafka_wire import _scram_hash, _scram_hi
+
+def scram_server_exchange(mech, users):
+    """Server half of the RFC 5802 exchange: a stateful callable mapping
+    each client message to the server reply (None = authentication
+    failed). Test-only — shares just the hash/Hi primitives with the
+    client (ekuiper_tpu.io.kafka_wire)."""
+    h = _scram_hash(mech)
+    state: Dict[str, Any] = {}
+
+    def respond(client_msg: bytes):
+        msg = client_msg.decode()
+        if "first" not in state:
+            state["first"] = True
+            bare = msg.split(",", 2)[2]
+            state["c_first_bare"] = bare
+            user = dict(p.split("=", 1)
+                        for p in bare.split(","))["n"]
+            pw = users.get(user.replace("=2C", ",").replace("=3D", "="))
+            if pw is None:
+                return None
+            state["salt"] = os.urandom(12)
+            state["iters"] = 4096
+            c_nonce = dict(p.split("=", 1) for p in bare.split(","))["r"]
+            state["nonce"] = c_nonce + base64.b64encode(os.urandom(9)).decode()
+            state["salted"] = _scram_hi(mech, pw.encode(), state["salt"],
+                                        state["iters"])
+            s_first = (f"r={state['nonce']},"
+                       f"s={base64.b64encode(state['salt']).decode()},"
+                       f"i={state['iters']}")
+            state["s_first"] = s_first
+            return s_first.encode()
+        attrs = dict(p.split("=", 1) for p in msg.split(","))
+        if attrs.get("r") != state["nonce"]:
+            return None
+        c_final_bare = msg.rsplit(",p=", 1)[0]
+        auth_msg = (f"{state['c_first_bare']},{state['s_first']},"
+                    f"{c_final_bare}").encode()
+        client_key_sig = hmac.new(
+            h(hmac.new(state["salted"], b"Client Key", h).digest()).digest(),
+            auth_msg, h).digest()
+        proof = base64.b64decode(attrs["p"])
+        client_key = bytes(a ^ b for a, b in zip(proof, client_key_sig))
+        if h(client_key).digest() != h(
+                hmac.new(state["salted"], b"Client Key", h).digest()).digest():
+            return None
+        server_sig = hmac.new(
+            hmac.new(state["salted"], b"Server Key", h).digest(),
+            auth_msg, h).digest()
+        return f"v={base64.b64encode(server_sig).decode()}".encode()
+
+    return respond

@@ -187,7 +187,6 @@ class TestConnector:
             sub = SubClient(f"tcp://127.0.0.1:{port}", "out",
                             lambda parts: got.append(parts))
             sink = topo.sinks[0]
-            deadline = time.time() + 10
             # the sink's PubServer binds lazily on first collect — feed one
             # row, then wait for the subscription to land and feed another
             mem.publish("t/z", {"a": 1.0})
