@@ -419,3 +419,95 @@ class TestScram:
         with pytest.raises(EngineError):
             c.partitions("t1")
         c.close()
+
+
+class TestKafkaCheckpointReplay:
+    def test_offset_rewind_across_crash(self, broker, mock_clock):
+        """VERDICT r4 #5 'done' criterion: kafka offsets ride rule
+        checkpoints. Kill a qos=1 rule after consuming past a checkpoint,
+        restore — the source rewinds to the checkpointed offset and
+        re-fetches the tail from the BROKER itself (no re-publish; that is
+        the point of a rewindable log source). Window result equals an
+        uninterrupted run."""
+        import ekuiper_tpu.io.memory as mem
+        from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+        from ekuiper_tpu.server.processors import StreamProcessor
+        from ekuiper_tpu.store import kv
+
+        mem.reset()
+        store = kv.get_store()
+        store.kv("source_conf").set("kafka:ck", {
+            "brokers": broker.bootstrap, "pollInterval": 20})
+        StreamProcessor(store).exec_stmt(
+            'CREATE STREAM kck (deviceId STRING, v FLOAT) '
+            'WITH (DATASOURCE="t2", TYPE="kafka", CONF_KEY="ck", '
+            'FORMAT="JSON")')
+
+        def make_topo():
+            return plan_rule(RuleDef(
+                id="kck1", sql=(
+                    "SELECT deviceId, count(*) AS c, avg(v) AS a FROM kck "
+                    "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"),
+                actions=[{"memory": {"topic": "kck/out"}}],
+                options={"qos": 1, "checkpointInterval": 3_600_000}), store)
+
+        def feed(rows):
+            for d, v in rows:
+                broker.append("t2", 0, None,
+                              json.dumps({"deviceId": d, "v": v}).encode())
+
+        def consumed(topo, n):
+            deadline = time.time() + 10
+            src = (topo.sources[0] if topo.sources
+                   else topo._live_shared[0][0].source)
+            while time.time() < deadline:
+                off = getattr(src.connector, "get_offset", lambda: {})()
+                if off.get("0", 0) >= n:
+                    mock_clock.advance(20)
+                    if topo.wait_idle(10):
+                        return True
+                time.sleep(0.02)
+            return False
+
+        topo = make_topo()
+        topo.open()
+        feed([("a", 10.0), ("a", 20.0), ("b", 30.0)])
+        assert consumed(topo, 3)
+        cid = topo.trigger_checkpoint()
+        deadline = time.time() + 5
+        snap, ok = None, False
+        while time.time() < deadline:
+            snap, ok = store.kv("checkpoint:kck1").get_ok("latest")
+            if ok and snap.get("checkpoint_id") == cid:
+                break
+            time.sleep(0.01)
+        assert ok
+        feed([("a", 30.0), ("b", 10.0)])
+        assert consumed(topo, 5)
+        topo.close()  # crash: no graceful save
+
+        # PIN the checkpointed offset itself: the snapshot must carry the
+        # source at offset 3 — not 0/absent (an earliest-fallback restart
+        # would coincidentally produce the same window result on an empty
+        # restored state, masking a broken checkpoint path)
+        offsets = [st["offset"] for st in snap.get("states", {}).values()
+                   if isinstance(st, dict) and "offset" in st]
+        assert {"0": 3} in offsets, snap
+
+        got = []
+        mem.subscribe("kck/out", lambda t, p: got.append(p))
+        topo2 = make_topo()
+        topo2.open()
+        # NOTHING is re-published: the rewound source re-fetches rows 3-4
+        # from the broker's log on its own
+        assert consumed(topo2, 5)
+        mock_clock.advance(10_000)
+        deadline = time.time() + 8
+        while time.time() < deadline and not got:
+            time.sleep(0.02)
+        topo2.close()
+        msgs = []
+        for p in got:
+            msgs.extend(p if isinstance(p, list) else [p])
+        res = {m["deviceId"]: (m["c"], round(m["a"], 4)) for m in msgs}
+        assert res == {"a": (3, 20.0), "b": (2, 20.0)}, res
