@@ -44,3 +44,35 @@ def fresh_engine_state():
 @pytest.fixture
 def mock_clock():
     return timex.get_mock_clock()
+
+
+def wait_for_checkpoint(store, rule_id, cid, timeout=5.0):
+    """Poll the persisted checkpoint until `cid` lands; returns the snap.
+    Shared by the crash-replay e2e tests (test_checkpoint, test_kafka)."""
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        snap, ok = store.kv(f"checkpoint:{rule_id}").get_ok("latest")
+        if ok and snap.get("checkpoint_id") == cid:
+            return snap
+        time.sleep(0.01)
+    raise AssertionError(f"checkpoint {cid} for {rule_id} never persisted")
+
+
+def collect_window_result(mem, topic, mock_clock, advance_ms=10_000,
+                          timeout=8.0):
+    """Subscribe, fire the window boundary, and flatten the emissions to a
+    {key_field: ...} message list."""
+    import time
+
+    got = []
+    mem.subscribe(topic, lambda t, p: got.append(p))
+    mock_clock.advance(advance_ms)
+    deadline = time.time() + timeout
+    while time.time() < deadline and not got:
+        time.sleep(0.02)
+    msgs = []
+    for p in got:
+        msgs.extend(p if isinstance(p, list) else [p])
+    return msgs

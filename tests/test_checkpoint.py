@@ -157,15 +157,10 @@ class TestCrashReplay:
             mem.publish("t/ckpt", {"deviceId": d, "temperature": t})
         mock_clock.advance(20)
         assert topo.wait_idle(10)
+        from conftest import wait_for_checkpoint
+
         cid = topo.trigger_checkpoint()
-        deadline = time.time() + 5
-        snap, ok = None, False
-        while time.time() < deadline:
-            snap, ok = store.kv("checkpoint:ck").get_ok("latest")
-            if ok and snap.get("checkpoint_id") == cid:
-                break
-            time.sleep(0.01)
-        assert ok and snap["checkpoint_id"] == cid
+        wait_for_checkpoint(store, "ck", cid)
         # post-checkpoint rows arrive, then the process dies un-gracefully
         for d, t in post:
             mem.publish("t/ckpt", {"deviceId": d, "temperature": t})
@@ -181,16 +176,10 @@ class TestCrashReplay:
             mem.publish("t/ckpt", {"deviceId": d, "temperature": t})
         mock_clock.advance(20)
         assert topo2.wait_idle(10)
-        got = []
-        mem.subscribe("ckpt/out", lambda t, p: got.append(p))
-        mock_clock.advance(10_000)  # window fires
-        deadline = time.time() + 8
-        while time.time() < deadline and not got:
-            time.sleep(0.02)
+        from conftest import collect_window_result
+
+        msgs = collect_window_result(mem, "ckpt/out", mock_clock)
         topo2.close()
-        msgs = []
-        for p in got:
-            msgs.extend(p if isinstance(p, list) else [p])
         res = {m["deviceId"]: (m["c"], round(m["a"], 4)) for m in msgs}
         # uninterrupted expectation: a -> 3 rows avg 20; b -> 2 rows avg 20
         assert res == {"a": (3, 20.0), "b": (2, 20.0)}, res

@@ -473,15 +473,10 @@ class TestKafkaCheckpointReplay:
         topo.open()
         feed([("a", 10.0), ("a", 20.0), ("b", 30.0)])
         assert consumed(topo, 3)
+        from conftest import wait_for_checkpoint
+
         cid = topo.trigger_checkpoint()
-        deadline = time.time() + 5
-        snap, ok = None, False
-        while time.time() < deadline:
-            snap, ok = store.kv("checkpoint:kck1").get_ok("latest")
-            if ok and snap.get("checkpoint_id") == cid:
-                break
-            time.sleep(0.01)
-        assert ok
+        snap = wait_for_checkpoint(store, "kck1", cid)
         feed([("a", 30.0), ("b", 10.0)])
         assert consumed(topo, 5)
         topo.close()  # crash: no graceful save
@@ -494,20 +489,14 @@ class TestKafkaCheckpointReplay:
                    if isinstance(st, dict) and "offset" in st]
         assert {"0": 3} in offsets, snap
 
-        got = []
-        mem.subscribe("kck/out", lambda t, p: got.append(p))
+        from conftest import collect_window_result
+
         topo2 = make_topo()
         topo2.open()
         # NOTHING is re-published: the rewound source re-fetches rows 3-4
         # from the broker's log on its own
         assert consumed(topo2, 5)
-        mock_clock.advance(10_000)
-        deadline = time.time() + 8
-        while time.time() < deadline and not got:
-            time.sleep(0.02)
+        msgs = collect_window_result(mem, "kck/out", mock_clock)
         topo2.close()
-        msgs = []
-        for p in got:
-            msgs.extend(p if isinstance(p, list) else [p])
         res = {m["deviceId"]: (m["c"], round(m["a"], 4)) for m in msgs}
         assert res == {"a": (3, 20.0), "b": (2, 20.0)}, res
