@@ -24,7 +24,7 @@ def _jpeg(n):
 class _Camera:
     """Serves /stream (multipart/x-mixed-replace) and /snap (single jpeg)."""
 
-    def __init__(self, frames):
+    def __init__(self, frames, port=0):
         outer = self
 
         class H(BaseHTTPRequestHandler):
@@ -47,6 +47,8 @@ class _Camera:
                     # long-lived stream: cycle the frames far past the test
                     # duration so no reconnect replays confuse ordering
                     for i in range(300):
+                        if outer.dead:
+                            break
                         f = frames[i % len(frames)]
                         self.wfile.write(
                             b"--frame\r\nContent-Type: image/jpeg\r\n"
@@ -60,12 +62,15 @@ class _Camera:
                 pass
 
         self.snap_idx = 0
-        self.srv = HTTPServer(("127.0.0.1", 0), H)
+        self.dead = False
+        self.srv = HTTPServer(("127.0.0.1", port), H)
         self.port = self.srv.server_address[1]
         threading.Thread(target=self.srv.serve_forever, daemon=True).start()
 
     def close(self):
+        self.dead = True  # unblock in-flight stream handlers
         self.srv.shutdown()
+        self.srv.server_close()  # shutdown() alone leaves the listener open
 
 
 @pytest.fixture
@@ -134,3 +139,44 @@ def test_decodes_with_image_functions(camera):
 def test_requires_url():
     with pytest.raises(EngineError, match="url"):
         VideoSource().configure("", {})
+
+
+def test_reconnects_after_camera_restart():
+    """Stream dies (camera reboot) — the source redials the SAME endpoint
+    and frames resume."""
+    import socket as pysock
+
+    frames = [_jpeg(i) for i in range(3)]
+    probe = pysock.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    cam = _Camera(frames, port=port)
+    src = VideoSource()
+    src.configure("", {"url": f"http://127.0.0.1:{port}/stream",
+                       "interval": 20})
+    got = []
+    src.open(lambda payload, meta=None: got.append(meta["frame"]))
+    deadline = time.time() + 10
+    while time.time() < deadline and len(got) < 2:
+        time.sleep(0.02)
+    assert len(got) >= 2
+    cam.close()  # camera reboots
+    time.sleep(0.3)
+    cam2 = None
+    deadline = time.time() + 5
+    while cam2 is None:
+        try:
+            cam2 = _Camera(frames, port=port)
+        except OSError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.1)
+    n_before = len(got)
+    deadline = time.time() + 15
+    while time.time() < deadline and len(got) <= n_before:
+        time.sleep(0.05)
+    src.close()
+    cam2.close()
+    assert len(got) > n_before, "frames never resumed after camera restart"
+    assert got == sorted(got)  # frame counter kept increasing
