@@ -208,4 +208,5 @@ def from_config(cfg) -> Optional[OtlpExporter]:
         return None
     return OtlpExporter(ot.remote_endpoint,
                         batch_max_spans=ot.batch_max_spans,
-                        batch_interval_ms=ot.batch_interval_ms)
+                        batch_interval_ms=ot.batch_interval_ms,
+                        service_name=ot.service_name)

@@ -126,6 +126,7 @@ class OpenTelemetryConfig:
 
     enable_remote_collector: bool = False
     remote_endpoint: str = "localhost:4318"
+    service_name: str = "ekuiper_tpu"  # resource attribute on exported spans
     batch_max_spans: int = 512
     batch_interval_ms: int = 2000
 

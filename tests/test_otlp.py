@@ -198,3 +198,15 @@ class TestExporter:
         exp = from_config(cfg)
         assert exp is not None and exp.url == "http://127.0.0.1:9/v1/traces"
         exp.close()
+
+
+def test_config_service_name_plumbs():
+    from ekuiper_tpu.utils.config import Config
+
+    cfg = Config()
+    cfg.open_telemetry.enable_remote_collector = True
+    cfg.open_telemetry.remote_endpoint = "127.0.0.1:9"
+    cfg.open_telemetry.service_name = "edge-7"
+    exp = from_config(cfg)
+    assert exp.service_name == "edge-7"
+    exp.close()
