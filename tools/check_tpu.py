@@ -1,0 +1,64 @@
+"""TPU tunnel health probe — run BEFORE any bench session.
+
+The axon tunnel can die such that `jax.devices()` blocks forever inside
+`make_c_api_client` (observed round 5: 8+ hours). This probe runs the
+device enumeration in a subprocess with a hard timeout and, when healthy,
+measures the round-trip characteristics the bench methodology depends on
+(docs/PERF_NOTES.md):
+
+    python tools/check_tpu.py [--timeout 60]
+
+Exit 0 = healthy (prints device kind + RTT/upload numbers),
+exit 1 = tunnel dead/hung.
+"""
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+
+_PROBE = r"""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+devs = jax.devices()
+print(f"devices: {devs}")
+x = jnp.zeros(8)
+jax.block_until_ready(x)
+ts = []
+for _ in range(5):
+    t0 = time.time()
+    np.asarray(jnp.sum(x))
+    ts.append((time.time() - t0) * 1000)
+print(f"tiny dispatch->fetch roundtrip p50: {sorted(ts)[len(ts)//2]:.0f}ms")
+up = np.zeros((65536, 2), np.float32)
+t0 = time.time()
+jax.block_until_ready(jnp.asarray(up))
+print(f"0.5MB upload+sync: {(time.time() - t0) * 1000:.0f}ms")
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--timeout", type=float, default=60.0)
+    args = ap.parse_args()
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE],
+                           capture_output=True, text=True,
+                           timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        print(f"TPU DEAD: device init hung past {args.timeout:.0f}s "
+              "(tunnel down — do not start a bench)", file=sys.stderr)
+        return 1
+    sys.stdout.write(r.stdout)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-2000:])
+        print("TPU DEAD: probe crashed", file=sys.stderr)
+        return 1
+    print("TPU healthy")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
