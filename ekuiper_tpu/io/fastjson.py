@@ -111,14 +111,21 @@ def schema_field_spec(schema: Optional[Schema]):
 
 
 def decode_columns(
-    payloads: List[bytes], field_spec,
+    payloads: List[bytes], field_spec, shards: int = 1,
 ) -> Optional[Tuple[Dict[str, Any], Dict[str, Any], Any]]:
-    """(columns, valid, bad) via the native decoder, or None to fall back."""
+    """(columns, valid, bad) via the native decoder, or None to fall back.
+    shards > 1 splits the GIL-free parse pass across that many native
+    threads (contiguous payload slices into one shared allocation) —
+    output is byte-identical for any shard count."""
     mod = _load()
     if mod is None:
         return None
     try:
-        return mod.decode(list(payloads), field_spec)
+        try:
+            return mod.decode(list(payloads), field_spec, int(shards))
+        except TypeError:
+            # stale prebuilt .so without the shard API
+            return mod.decode(list(payloads), field_spec)
     except mod.Fallback:
         return None
     except Exception as e:

@@ -16,8 +16,11 @@ Source props: brokers, partition (int, default all partitions), offset
 group-less default), maxBytes, pollInterval (ms between empty polls).
 Sink props: brokers, topic, key (static message key), partition (int,
 default round-robin), requiredACKs (-1/0/1), batchSize, format.
-Both: saslAuthType ("none" | "plain"), saslUserName, password — the
-reference's SASL prop names (source.go:255-277); SCRAM is not bundled.
+Both: saslAuthType ("none" | "plain" | "scram_sha_256" | "scram_sha_512"),
+saslUserName, password — the reference's SASL prop names
+(source.go:255-277); SCRAM-SHA-256/512 are implemented in the bundled
+wire client (io/kafka_wire.py, RFC 5802 with server-signature
+verification).
 """
 from __future__ import annotations
 
@@ -161,6 +164,15 @@ class KafkaSource(Source, Rewindable):
                         self._note_failure(fails, retry_at, p, off, e)
                         continue
                     for moff, key, value, ts in msgs:
+                        if value is None:
+                            # delete tombstone (null value, distinct from
+                            # an empty payload): nothing to decode — skip
+                            # the record but still advance past its offset.
+                            # Progress was made: without got_any a run of
+                            # tombstones (compacted topics) would throttle
+                            # catch-up to one fetch per poll_interval
+                            got_any = True
+                            continue
                         ingest(value, {
                             "topic": self.topic, "partition": p,
                             "offset": moff, "timestamp": ts,

@@ -142,11 +142,15 @@ def encode_message_set(messages: List[Tuple[Optional[bytes], bytes, int]]) -> by
     return b"".join(out)
 
 
-def decode_message_set(data: bytes) -> List[Tuple[int, Optional[bytes], bytes, int]]:
+def decode_message_set(
+    data: bytes,
+) -> List[Tuple[int, Optional[bytes], Optional[bytes], int]]:
     """MessageSet bytes -> [(offset, key, value, timestamp_ms)]. A fetch may
     end with a partially-transferred entry — it is silently dropped (the
-    next fetch re-reads it), per protocol."""
-    out: List[Tuple[int, Optional[bytes], bytes, int]] = []
+    next fetch re-reads it), per protocol. A null value stays None — it is
+    a delete tombstone, distinct from an empty b"" payload; the consumer
+    decides how to surface it."""
+    out: List[Tuple[int, Optional[bytes], Optional[bytes], int]] = []
     pos = 0
     while pos + 12 <= len(data):
         offset, size = struct.unpack(">qi", data[pos:pos + 12])
@@ -162,10 +166,10 @@ def decode_message_set(data: bytes) -> List[Tuple[int, Optional[bytes], bytes, i
         codec = attrs & 0x07
         ts = r.i64() if magic >= 1 else -1
         key = r.bytes_()
-        value = r.bytes_() or b""
+        value = r.bytes_()
         if codec == 0:
             out.append((offset, key, value, ts))
-        elif codec == 1:
+        elif codec == 1 and value is not None:
             # gzip wrapper message: the value is an inner message set whose
             # entries carry relative offsets (magic 1) anchored so the LAST
             # inner message has the wrapper's offset

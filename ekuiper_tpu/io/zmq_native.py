@@ -233,6 +233,11 @@ class PubServer:
         except Exception as e:
             logger.warning("zmq pub: handshake failed: %s", e)
             peer.close()
+            with self._mu:
+                try:
+                    self._accepted.remove(sock)
+                except ValueError:
+                    pass  # close() already drained the list
             return
         # send-only timeout: a wedged subscriber must not block publish
         # (recv stays blocking — the subscription loop below needs it)

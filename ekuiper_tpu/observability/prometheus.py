@@ -63,6 +63,22 @@ def render(rule_registry) -> str:
                 f'kuiper_op_{mname}{{rule="{_esc(rule_id)}",'
                 f'op="{_esc(node.name)}",type="{_esc(node.op_type)}"}} '
                 f"{getattr(node.stats, attr)}")
+    # per-stage pipeline timings (decode/upload/fold): the ingest-pipeline
+    # balance — which stage a node's wall time goes to — read straight off
+    # the StatManagers' stage accounting
+    stage_rows = [(rule_id, node, stage, st)
+                  for rule_id, node in rows
+                  for stage, st in
+                  node.stats.snapshot()["stage_timings"].items()]
+    for mname, key in (("stage_us_total", "total_us"),
+                       ("stage_calls_total", "calls"),
+                       ("stage_rows_total", "rows")):
+        out.append(f"# TYPE kuiper_op_{mname} counter")
+        for rule_id, node, stage, st in stage_rows:
+            out.append(
+                f'kuiper_op_{mname}{{rule="{_esc(rule_id)}",'
+                f'op="{_esc(node.name)}",type="{_esc(node.op_type)}",'
+                f'stage="{_esc(stage)}"}} {st[key]}')
     out.append("# TYPE kuiper_uptime_seconds gauge")
     out.append(f"kuiper_uptime_seconds {time.time() - _START_TIME:.1f}")
     return "\n".join(out) + "\n"

@@ -87,6 +87,10 @@ def merged_options(rule: RuleDef) -> RuleOptionConfig:
         "planOptimizeStrategy": "plan_optimize_strategy",
         "tailMode": "tail_mode",
         "prefinalizeLeadMs": "prefinalize_lead_ms",
+        "decodePoolSize": "decode_pool_size",
+        "decodeShards": "decode_shards",
+        "ingestRingDepth": "ingest_ring_depth",
+        "slidingDevRingMb": "sliding_dev_ring_mb",
     }
     for k, v in rule.options.items():
         key = alias.get(k, k)
@@ -585,6 +589,9 @@ def _plan_stream_source(stream_name: str, src_name: str, opts, store,
             micro_batch_rows=opts.micro_batch_rows,
             linger_ms=opts.micro_batch_linger_ms,
             buffer_length=opts.buffer_length,
+            decode_pool_size=opts.decode_pool_size,
+            decode_shards=opts.decode_shards,
+            ring_depth=opts.ingest_ring_depth,
             # private pipeline: prune at decode. Shared pipelines must stay
             # unpruned (other riders need other columns) — see the entry.
             project_columns=(None if opts.share_source and opts.qos == 0
@@ -623,6 +630,8 @@ def _plan_stream_source(stream_name: str, src_name: str, opts, store,
             "strict": stream.options.strict_validation,
             "mb": opts.micro_batch_rows,
             "linger": opts.micro_batch_linger_ms,
+            "pool": [opts.decode_pool_size, opts.decode_shards,
+                     opts.ingest_ring_depth],
         })
         entry = SharedEntryNode(f"{src_name}_shared",
                                 project_columns=project_columns,
@@ -803,6 +812,7 @@ def _build_device_chain(
         emit_columnar=opts.emit_columnar,
         is_event_time=opts.is_event_time,
         late_tolerance_ms=opts.late_tolerance_ms,
+        dev_ring_budget_mb=opts.sliding_dev_ring_mb,
     )
     topo.add_op(fused)
     if opts.is_event_time:

@@ -336,6 +336,12 @@ class Node:
     def on_error(self, exc: Exception, item: Any) -> None:
         """Per-item error: forwarded downstream as data when send_error."""
 
+    def extra_pending(self) -> int:
+        """Work in flight OUTSIDE the input queue (e.g. the source's decode
+        ring) — Topo.wait_idle counts it so 'idle' still means no data
+        anywhere in the DAG."""
+        return 0
+
     # ------------------------------------------------------------------ output
     def emit(self, item: Any, count: int = 1) -> None:
         if getattr(self, "_tracing_now", False):

@@ -83,6 +83,7 @@ class FusedWindowAggNode(Node):
         tail_mode: str = "device",  # window-tail rows: "device" | "host"
         is_event_time: bool = False,  # watermark-driven panes (see below)
         late_tolerance_ms: int = 0,
+        dev_ring_budget_mb: int = 256,  # sliding _dev_ring HBM cap
         **kw,
     ) -> None:
         super().__init__(name, op_type="op", **kw)
@@ -182,6 +183,16 @@ class FusedWindowAggNode(Node):
             # this re-upload + its device folds. Entries align 1:1 with
             # _ring lists (None = no device copy, e.g. after restore).
             self._dev_ring: Dict[int, list] = {}
+            # HBM budget for the cache: each qualifying batch pins
+            # mb-padded float32 buffers per column for the whole ring
+            # retention window, which at high batch rates on long windows
+            # is GBs — past the cap the OLDEST entries drop to None and
+            # their refolds fall back to the exact host path
+            self.dev_ring_budget_bytes = int(dev_ring_budget_mb) << 20
+            self._dev_ring_bytes = 0
+            from collections import deque as _deque
+
+            self._dev_ring_fifo = _deque()  # (bucket, idx, nbytes) in age order
             self._bucket_max_ts: Dict[int, int] = {}
             self._ring_max_bucket = -1
             self._pending_slides: Dict[int, int] = {}  # t -> fire_at_ms
@@ -434,11 +445,13 @@ class FusedWindowAggNode(Node):
                     # compile the mask-only edge refold (fold_masked) with
                     # the exact runtime pytree: pre-padded device inputs +
                     # (mb,) bool mask — a first real trigger must not pay
-                    # a 20-40s jit stall mid-stream
+                    # a 20-40s jit stall mid-stream. force=True bypasses
+                    # the small-batch HBM guard, which would silently
+                    # reject this 1-row batch and skip the compile
                     dev = self._upload_sliding_inputs(
                         {n: np.zeros(1, dtype=np.float32)
                          for n in self.plan.columns},
-                        {}, np.zeros(1, dtype=np.int32))
+                        {}, np.zeros(1, dtype=np.int32), force=True)
                     if dev is not None:
                         mask = np.zeros(self.gb.micro_batch, dtype=np.bool_)
                         dummy = self.gb.fold_masked(
@@ -729,14 +742,26 @@ class FusedWindowAggNode(Node):
 
     def _fold_rows(self, sub: ColumnBatch, pane_arg) -> int:
         """Encode keys + build kernel columns + device fold for `sub`,
-        folding into `pane_arg` (scalar pane or per-row pane vector)."""
+        folding into `pane_arg` (scalar pane or per-row pane vector).
+        Stage accounting: "upload" covers key encode + kernel-input build +
+        shared device puts (the host-side work feeding the link), "fold"
+        the jitted fold dispatch (which carries the implicit H2D copy when
+        inputs weren't pre-uploaded) — together with the source's "decode"
+        these expose the ingest-pipeline balance per node."""
+        import time as _time
+
         frozen = self._device_frozen and bool(self._pipeline)
+        t0 = _time.perf_counter()
         cols, valid, slots = self._build_kernel_inputs(sub, frozen)
+        dev = None
         if not frozen:
             if self.gb.capacity < self.kt.capacity:
                 # deferred grow (keys first seen in an earlier frozen span)
                 self.state = self.gb.grow(self.state, self.kt.capacity)
             dev = self._shared_device_inputs(sub, cols, valid, slots)
+        t1 = _time.perf_counter()
+        self.stats.observe_stage("upload", (t1 - t0) * 1e6, sub.n)
+        if not frozen:
             if dev is not None:
                 # shared uploads: device columns/slots computed once serve
                 # every fan-out consumer; host copies still feed the shadows
@@ -748,6 +773,8 @@ class FusedWindowAggNode(Node):
             else:
                 self.state = self.gb.fold(self.state, cols, slots, valid,
                                           pane_arg)
+            self.stats.observe_stage(
+                "fold", (_time.perf_counter() - t1) * 1e6, sub.n)
         # every live shadow mirrors the fold (dedup: frozen-span retries and
         # the backstop may share shadow objects)
         seen = set()
@@ -1262,16 +1289,31 @@ class FusedWindowAggNode(Node):
         # ring outlives panes by a margin so the stale-window fallback can
         # always reconstruct; beyond that the window is unrecoverable anyway
         floor_b = self._ring_max_bucket - self.n_ring_panes - 8
-        for b in [b for b in self._ring if b < floor_b]:
+        expired = [b for b in self._ring if b < floor_b]
+        for b in expired:
             del self._ring[b]
-            self._dev_ring.pop(b, None)
+            dropped = self._dev_ring.pop(b, None)
+            if dropped:
+                self._dev_ring_bytes -= sum(
+                    self._dev_entry_nbytes(e) for e in dropped)
             self._bucket_max_ts.pop(b, None)
+        if expired:
+            # purge the expired buckets' fifo bookkeeping too: the evict
+            # loop only drains it when OVER budget, so an under-budget rule
+            # would otherwise grow the deque for the life of the stream
+            self._dev_ring_fifo = type(self._dev_ring_fifo)(
+                t for t in self._dev_ring_fifo if t[0] >= floor_b)
+        import time as _time
+
+        t0 = _time.perf_counter()
         cols, valid, slots = self._build_kernel_inputs(sub)
         dev = self._upload_sliding_inputs(cols, valid, slots)
         pane_vec = (buckets % self.n_ring_panes).astype(np.uint8)
         fold_cols, fold_valid, fold_slots, n_rows = (
             (dev[0], dev[1], dev[2], sub.n) if dev is not None
             else (cols, valid, slots, None))
+        t1 = _time.perf_counter()
+        self.stats.observe_stage("upload", (t1 - t0) * 1e6, sub.n)
         if len(np.unique(pane_vec)) == 1:
             # single-bucket batch: scalar-pane fast path (the common case —
             # a batch spans far less time than one pane)
@@ -1281,6 +1323,8 @@ class FusedWindowAggNode(Node):
         else:
             self.state = self.gb.fold(self.state, fold_cols, fold_slots,
                                       fold_valid, pane_vec, n_rows=n_rows)
+        self.stats.observe_stage(
+            "fold", (_time.perf_counter() - t1) * 1e6, sub.n)
         for b in np.unique(buckets).tolist():
             m = buckets == b
             sel = np.nonzero(m)[0]
@@ -1292,8 +1336,14 @@ class FusedWindowAggNode(Node):
             self._ring.setdefault(int(b), []).append(seg)
             # aligned device entry: whole-batch refs + this bucket's row
             # mask (the refold ANDs the window time cut into it)
-            self._dev_ring.setdefault(int(b), []).append(
-                None if dev is None else (dev[3], dev[2], m, ts))
+            entry = None if dev is None else (dev[3], dev[2], m, ts)
+            lst = self._dev_ring.setdefault(int(b), [])
+            lst.append(entry)
+            if entry is not None:
+                nb = self._dev_entry_nbytes(entry)
+                self._dev_ring_bytes += nb
+                self._dev_ring_fifo.append((int(b), len(lst) - 1, nb))
+                self._dev_ring_evict()
             bmax = int(ts[sel].max())
             if bmax > self._bucket_max_ts.get(int(b), -1):
                 self._bucket_max_ts[int(b)] = bmax
@@ -1307,17 +1357,20 @@ class FusedWindowAggNode(Node):
                 self._emit_sliding(t)
         return sub.n
 
-    def _upload_sliding_inputs(self, cols, valid, slots):
+    def _upload_sliding_inputs(self, cols, valid, slots, force: bool = False):
         """Pre-pad + upload one batch's fold inputs, so (a) the fold uses
         them without its own upload and (b) the ring keeps the device refs
         for mask-only edge refolds. Returns (dev_cols, dev_valid, s_dev,
         dev_all) or None when the batch can't ship as one chunk.
-        dev_all is the combined {col, __valid_col} dict fold_masked takes."""
+        dev_all is the combined {col, __valid_col} dict fold_masked takes.
+        `force` bypasses the small-batch HBM guard — the warmup uses it so
+        fold_masked actually compiles (a 1-row warmup batch would otherwise
+        be rejected and the first real trigger would pay the jit stall)."""
         mb = self.gb.micro_batch
         n = len(slots)
         if n > mb or not getattr(self.gb, "accepts_device_inputs", False):
             return None
-        if n < mb // 4:
+        if n < mb // 4 and not force:
             # small batches would pin a full mb-padded device buffer each
             # for the whole ring retention window — HBM cost out of all
             # proportion; their edge refolds are cheap host uploads anyway
@@ -1349,6 +1402,38 @@ class FusedWindowAggNode(Node):
             s = s.astype(np.uint16)
         s_dev = jnp.asarray(s)
         return dev_cols, dev_valid, s_dev, dev_all
+
+    @staticmethod
+    def _dev_entry_nbytes(entry) -> int:
+        """Device footprint of one _dev_ring entry. Multi-bucket batches
+        share the same whole-batch buffers across their entries, so this
+        over-counts them — the budget errs toward evicting early, never
+        toward exceeding HBM."""
+        if entry is None:
+            return 0
+        dev_all, s_dev = entry[0], entry[1]
+
+        def nb(a):
+            if a is None:
+                return 0
+            v = getattr(a, "nbytes", None)
+            return int(v) if v is not None else int(
+                a.size * a.dtype.itemsize)
+
+        return sum(nb(a) for a in dev_all.values()) + nb(s_dev)
+
+    def _dev_ring_evict(self) -> None:
+        """Drop the oldest cached device entries until the cache fits the
+        HBM budget; their refolds fall back to the exact host path (the
+        aligned _ring rows are always retained)."""
+        while (self._dev_ring_bytes > self.dev_ring_budget_bytes
+               and self._dev_ring_fifo):
+            b, idx, nbytes = self._dev_ring_fifo.popleft()
+            lst = self._dev_ring.get(b)
+            if lst is None or idx >= len(lst) or lst[idx] is None:
+                continue  # already gone (bucket expired past the ring floor)
+            lst[idx] = None
+            self._dev_ring_bytes -= nbytes
 
     def _schedule_sliding(self, t: int, fire_at: int) -> None:
         """Register a delayed sliding emission; tracked in _pending_slides
@@ -1974,13 +2059,6 @@ class FusedWindowAggNode(Node):
             self._pane_bucket = {int(k): v for k, v in
                                  state.get("pane_bucket", {}).items()}
             self._ring_max_bucket = state.get("ring_max_bucket", -1)
-            # device input cache + max-ts tracking don't survive a restore:
-            # refolds fall back to host uploads (exact), pane-serving stays
-            # off for pre-restore buckets (missing max-ts fails the check).
-            # Pad with None placeholders so post-restore appends stay
-            # 1:1-aligned with the restored _ring segment lists
-            self._dev_ring = {b: [None] * len(segs)
-                              for b, segs in self._ring.items()}
             self._bucket_max_ts = {}
             self._ring = {
                 int(b): [
@@ -1991,6 +2069,20 @@ class FusedWindowAggNode(Node):
                 ]
                 for b, segs in state.get("ring", {}).items()
             }
+            # device input cache + max-ts tracking don't survive a restore:
+            # refolds fall back to host uploads (exact), pane-serving stays
+            # off for pre-restore buckets (missing max-ts fails the check).
+            # Pad with None placeholders so post-restore appends stay
+            # 1:1-aligned with the restored _ring segment lists — this must
+            # run AFTER the ring is rebuilt (building it from the
+            # pre-restore ring left restored segments unpadded, so the
+            # first post-restore append landed at device index 0 while its
+            # rows sat at ring index k: refolds then served the wrong
+            # segment from the cache)
+            self._dev_ring = {b: [None] * len(segs)
+                              for b, segs in self._ring.items()}
+            self._dev_ring_bytes = 0
+            self._dev_ring_fifo.clear()
             # re-arm delayed emissions that were pending at the checkpoint
             # (past-due ones fire immediately) — without this, windows for
             # triggers inside the restart gap would silently never emit

@@ -37,6 +37,9 @@ class SourceNode(Node):
         emit_batches: bool = True,
         converter=None,  # io.converters.Converter for bytes payloads
         project_columns=None,  # column-pruning set (planner/optimizer.py)
+        decode_pool_size: int = 0,  # 0 = decode inline (no pool threads)
+        decode_shards: int = 0,  # native parse shards; 0 = auto
+        ring_depth: int = 2,  # decoded-batch ring depth (pool backpressure)
     ) -> None:
         super().__init__(name, op_type="source", buffer_length=buffer_length)
         self.connector = connector
@@ -93,6 +96,19 @@ class SourceNode(Node):
                     ensure_native()
         self._pending_lock = threading.Lock()
         self._linger_timer = None
+        # sharded ingest pipeline (runtime/ingest.py): flush-time decode
+        # runs on pool workers, shard-parallel inside the native parse,
+        # handed to the fused node through a bounded ordered ring. Pool-
+        # less sources (decode_pool_size=0) decode inline exactly as
+        # before. The pool itself starts LAZILY at first use: planned-but-
+        # never-opened topos (rule validation plans then closes without
+        # open()) must not leak worker threads.
+        self.decode_pool_size = (int(decode_pool_size) if emit_batches
+                                 else 0)
+        self.ring_depth = int(ring_depth)
+        self._decode_shards = (int(decode_shards) if decode_shards
+                               else max(self.decode_pool_size, 1))
+        self._pool = None
 
     # ------------------------------------------------------------------ ingest
     def on_open(self) -> None:
@@ -104,6 +120,8 @@ class SourceNode(Node):
         except Exception as exc:
             logger.debug("source %s close error: %s", self.name, exc)
         self._flush()
+        if self._pool is not None:
+            self._pool.close()
 
     def ingest(self, payload: Any, metadata: Optional[Dict[str, Any]] = None) -> None:
         """Connector callback: raw bytes (decoded here via the stream's
@@ -299,49 +317,111 @@ class SourceNode(Node):
             except Exception as exc:
                 self.stats.inc_exception(f"rewind failed: {exc}")
 
-    def _flush(self, final: bool = True) -> None:
-        from ..data.batch import from_messages
+    def _flush(self, final: bool = True) -> bool:
+        """Flush pending buffers; a final flush also drains the decode
+        ring so callers can safely broadcast EOF/barriers after it.
+        Returns False when that drain timed out (rows may still be
+        decoding) — the barrier path fails its checkpoint on that. The
+        drain runs OUTSIDE the pending lock: appending new rows needs
+        nothing from the ring, and a held lock would stall every
+        connector callback for the drain's duration."""
+        msgs = raws = None
+        with self._pending_lock:
+            if self._pending_msgs or self._pending_raw:
+                msgs, self._pending_msgs = self._pending_msgs, []
+                tss, self._pending_ts = self._pending_ts, []
+                raws, self._pending_raw = self._pending_raw, []
+                rtss, self._pending_raw_ts = self._pending_raw_ts, []
+                if not final and len(raws) > self.micro_batch_rows:
+                    # emit micro_batch-aligned slices and keep the
+                    # remainder pending: the fused kernel pads every chunk
+                    # to a static micro_batch shape, so a 1024-row tail
+                    # would upload a full chunk's worth of padding — on a
+                    # bandwidth-limited link that nearly halves ingest for
+                    # misaligned flushes
+                    cut = (len(raws) // self.micro_batch_rows
+                           ) * self.micro_batch_rows
+                    self._pending_raw = raws[cut:]
+                    self._pending_raw_ts = rtss[cut:]
+                    raws, rtss = raws[:cut], rtss[:cut]
+        if msgs:
+            self._dispatch_job(("msgs", msgs, tss))
+        if raws:
+            self._dispatch_job(("raw", raws, rtss))
+        if final and self._pool is not None:
+            if not self._pool.drain():
+                logger.error(
+                    "source %s: decode ring drain timed out on a final "
+                    "flush; decoded batches may trail stream-end events",
+                    self.name)
+                return False
+        return True
+
+    def _ensure_pool(self):
+        from .ingest import DecodePool
 
         with self._pending_lock:
-            if not self._pending_msgs and not self._pending_raw:
+            if self._pool is None:
+                self._pool = DecodePool(
+                    self.decode_pool_size, self.ring_depth,
+                    decode_fn=self._decode_job,
+                    emit_fn=self._emit_decoded,
+                    name=self.name)
+            return self._pool
+
+    def _dispatch_job(self, job) -> None:
+        """Decode+emit one flush unit: on the decode pool when configured
+        (shard-parallel native parse off the connector thread, ordered
+        ring emission — runtime/ingest.py), else inline as before. BOTH
+        job kinds go through the ring when the pool is on, so a msg batch
+        can never overtake an earlier raw batch still decoding."""
+        if self.decode_pool_size > 0:
+            try:
+                self._ensure_pool().submit(job)
                 return
-            msgs, self._pending_msgs = self._pending_msgs, []
-            tss, self._pending_ts = self._pending_ts, []
-            raws, self._pending_raw = self._pending_raw, []
-            rtss, self._pending_raw_ts = self._pending_raw_ts, []
-            if not final and len(raws) > self.micro_batch_rows:
-                # emit micro_batch-aligned slices and keep the remainder
-                # pending: the fused kernel pads every chunk to a static
-                # micro_batch shape, so a 1024-row tail would upload a full
-                # chunk's worth of padding — on a bandwidth-limited link
-                # that nearly halves ingest for misaligned flushes
-                cut = (len(raws) // self.micro_batch_rows
-                       ) * self.micro_batch_rows
-                self._pending_raw = raws[cut:]
-                self._pending_raw_ts = rtss[cut:]
-                raws, rtss = raws[:cut], rtss[:cut]
-        if msgs:
+            except RuntimeError:
+                pass  # pool closed (shutdown race): decode inline
+        self._emit_decoded(self._decode_job(job))
+
+    def _emit_decoded(self, batch: Optional[ColumnBatch]) -> None:
+        if batch is not None and batch.n:
+            self.emit(batch, count=batch.n)
+
+    def _decode_job(self, job) -> Optional[ColumnBatch]:
+        """One decode unit: ("raw", payloads, tss) | ("msgs", msgs, tss)
+        -> ColumnBatch | None. Runs on pool workers — touches only
+        immutable config, the converter, and the (locked) StatManager."""
+        import time as _time
+
+        from ..data.batch import from_messages
+
+        kind, items, tss = job
+        t0 = _time.perf_counter()
+        if kind == "raw":
+            batch = self._decode_raw_to_batch(items, tss)
+        else:
             batch, n_drop = from_messages(
-                msgs, tss, schema=self.schema, emitter=self.name,
+                items, tss, schema=self.schema, emitter=self.name,
                 strict=self.strict, timestamp_field=self.timestamp_field,
                 on_error=self.stats.inc_exception,
                 project=self.project_columns)
             if n_drop:
                 logger.debug("source %s dropped %d rows at columnarize",
                              self.name, n_drop)
-            if batch.n:
-                self.emit(batch, count=batch.n)
-        if raws:
-            self._flush_raw(raws, rtss)
+        self.stats.observe_stage(
+            "decode", (_time.perf_counter() - t0) * 1e6, len(items))
+        return batch
 
-    def _flush_raw(self, raws: List[bytes], rtss: List[int]) -> None:
+    def _decode_raw_to_batch(self, raws: List[bytes],
+                             rtss: List[int]) -> Optional[ColumnBatch]:
         """Native columnar decode of buffered raw JSON payloads
         (io/fastjson.py); python fallback preserves row↔timestamp pairing."""
         import numpy as np
 
         from ..io.fastjson import decode_columns
 
-        out = decode_columns(raws, self._fast_spec)
+        out = decode_columns(raws, self._fast_spec,
+                             shards=self._decode_shards)
         if out is None:
             from ..data.batch import from_messages
 
@@ -362,15 +442,13 @@ class SourceNode(Node):
                             msgs.append(x)
                             tss.append(t)
             if not msgs:
-                return
+                return None
             batch, _ = from_messages(
                 msgs, tss, schema=self.schema, emitter=self.name,
                 strict=self.strict, timestamp_field=self.timestamp_field,
                 on_error=self.stats.inc_exception,
                 project=self.project_columns)
-            if batch.n:
-                self.emit(batch, count=batch.n)
-            return
+            return batch
         cols, valid, bad = out
         keep = ~np.asarray(bad, dtype=np.bool_)
         n_bad = len(raws) - int(keep.sum())
@@ -389,7 +467,7 @@ class SourceNode(Node):
                 keep &= vm
             ts = cols[self.timestamp_field]
         if not keep.any():
-            return
+            return None
         all_keep = keep.all()
         columns = {k: (v if all_keep else v[keep]) for k, v in cols.items()}
         vout = {}
@@ -397,14 +475,33 @@ class SourceNode(Node):
             vs = vm if all_keep else vm[keep]
             if not vs.all():
                 vout[k] = vs
-        batch = ColumnBatch(
+        return ColumnBatch(
             n=int(keep.sum()), columns=columns, valid=vout,
             timestamps=(ts if all_keep else ts[keep]), emitter=self.name)
-        self.emit(batch, count=batch.n)
 
     def on_eof(self, eof: EOF) -> None:
         self._flush()
         self.broadcast(eof)
+
+    def extra_pending(self) -> int:
+        return self._pool.in_flight if self._pool is not None else 0
+
+    def on_barrier(self, barrier) -> None:
+        """Checkpoint barrier: flush pending rows and drain the decode
+        ring BEFORE snapshotting the connector offset and forwarding. The
+        offset already covers every ingested row, so any row still
+        buffered here when the barrier passes would be downstream of the
+        checkpoint cut yet behind the offset — lost on restore. A drain
+        timeout therefore FAILS this checkpoint (no ack — a later barrier
+        retries) while still forwarding the barrier so downstream
+        aligners never stall, mirroring Node.on_barrier's snapshot-error
+        path."""
+        if not self._flush(final=True):
+            self.stats.inc_exception(
+                "decode ring drain timed out; checkpoint skipped")
+            self.broadcast(barrier)
+            return
+        super().on_barrier(barrier)
 
     # source node's queue is only used for barriers/EOF injection
     def process(self, item: Any) -> None:

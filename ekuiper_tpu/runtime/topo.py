@@ -105,9 +105,13 @@ class Topo:
         import time as _time
 
         deadline = _time.monotonic() + timeout
-        nodes = self.all_nodes()
+        # shared-subtopo nodes (the physical source + its decode ring) count
+        # too: data sitting there is still in flight toward this rule
+        nodes = self.all_nodes() + [
+            n for st, _ in self._live_shared for n in st.nodes]
         while _time.monotonic() < deadline:
-            if all(n.inq.unfinished_tasks == 0 for n in nodes):
+            if all(n.inq.unfinished_tasks == 0 and n.extra_pending() == 0
+                   for n in nodes):
                 return True
             _time.sleep(0.002)
         return False

@@ -39,6 +39,25 @@ class RuleOptionConfig:
     # TPU execution options
     micro_batch_rows: int = 4096
     micro_batch_linger_ms: int = 10
+    # sharded ingest pipeline (runtime/ingest.py): decode_pool_size worker
+    # threads decode drained payload runs off the connector thread, handing
+    # ColumnBatches to the fused node through a bounded ring so decode of
+    # batch k+1 overlaps the upload+fold of batch k. Default 0 = decode
+    # inline on the ingest thread: emission then happens synchronously
+    # inside ingest/flush, which rules driven by the mockable clock
+    # (timex) depend on. Byte-fed production pipelines should set 2-4
+    # (the full-pipe bench runs with 3).
+    decode_pool_size: int = 0
+    # native parse shards per decode call (jsoncol.cpp GIL-free pass);
+    # 0 = auto (decode_pool_size when the pool is on, else 1)
+    decode_shards: int = 0
+    # decoded-batch ring depth: in-flight decodes before submit blocks
+    # (backpressure toward the connector)
+    ingest_ring_depth: int = 2
+    # HBM budget for the sliding-window device-side fold-input cache
+    # (nodes_fused.py _dev_ring); oldest entries fall back to exact host
+    # refolds past the cap
+    sliding_dev_ring_mb: int = 256
     key_slots: int = 16384  # group-by hash-slot table size per rule
     use_device_kernel: bool = True  # fuse window+agg into a jitted kernel when possible
     # pre-issue the window finalize this long before the boundary so the

@@ -46,6 +46,10 @@ class StatManager:
         self.buffer_length: int = 0
         self._started_at: Optional[int] = None
         self._started_perf: float = 0.0
+        # named pipeline-stage accounting (decode/upload/fold, ...): lets
+        # operators see where ingest wall time goes per node — the balance
+        # of the sharded ingest pipeline is tuned from these
+        self.stages: Dict[str, Dict[str, int]] = {}
 
     def inc_in(self, n: int = 1) -> None:
         with self._lock:
@@ -86,6 +90,18 @@ class StatManager:
         with self._lock:
             self.buffer_length = n
 
+    def observe_stage(self, stage: str, us: int, rows: int = 0) -> None:
+        """Accrue `us` microseconds (and optionally rows) to a named
+        pipeline stage. Cheap enough for per-batch calls."""
+        with self._lock:
+            st = self.stages.get(stage)
+            if st is None:
+                st = self.stages[stage] = {
+                    "calls": 0, "total_us": 0, "rows": 0}
+            st["calls"] += 1
+            st["total_us"] += int(us)
+            st["rows"] += int(rows)
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -99,6 +115,7 @@ class StatManager:
                 "exceptions_total": self.exceptions,
                 "last_exception": self.last_exception,
                 "last_exception_time": self.last_exception_time,
+                "stage_timings": {k: dict(v) for k, v in self.stages.items()},
             }
 
     def metrics_list(self) -> List[Any]:

@@ -6,9 +6,17 @@
 // This extension parses a run of raw JSON object payloads DIRECTLY into
 // typed numpy columns + validity masks in one C pass:
 //
-//   decode(payloads: list[bytes], fields: ((name, type), ...))
+//   decode(payloads: list[bytes], fields: ((name, type), ...), shards=1)
 //     -> (columns: dict[str, ndarray], valid: dict[str, ndarray],
 //         bad: ndarray[bool])
+//
+// shards > 1 runs the GIL-free parse pass over `shards` contiguous slices
+// of the payload list on native threads concurrently. Every shard writes
+// into ITS row range of the one shared numpy allocation (rows are disjoint
+// by construction — no per-shard buffers, no concat), keeps a private
+// scratch/arena/StrRef list, and the final GIL'd intern pass walks shards
+// in slice order so string interning (and therefore the output) is
+// byte-identical to the single-thread path for any shard count.
 //
 // field types: 0=FLOAT(f32) 1=BIGINT(i64) 2=BOOLEAN(bool) 3=STRING(object)
 // Semantics mirror data/cast.py CONVERT_ALL coercion (the row-path
@@ -33,9 +41,11 @@
 #include <charconv>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <deque>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -220,9 +230,20 @@ struct Parser {
 
 // shortest-round-trip double -> string, matching Python str(float) closely
 void format_double(double v, std::string& out) {
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
   char buf[40];
   auto res = std::to_chars(buf, buf + sizeof(buf), v);
   out.assign(buf, res.ptr);
+#else
+  // no floating-point to_chars (GCC < 11): smallest %g precision that
+  // parses back to exactly v — same shortest-round-trip contract
+  char buf[40];
+  for (int prec = 1; prec <= 17; prec++) {
+    std::snprintf(buf, sizeof(buf), "%.*g", prec, v);
+    if (std::strtod(buf, nullptr) == v) break;
+  }
+  out = buf;
+#endif
 }
 
 struct Interner {
@@ -481,10 +502,44 @@ int parse_row(Parser& ps, std::vector<Field>& fields, npy_intp r,
 
 PyObject* FallbackError = nullptr;
 
+// Per-shard private parse state: everything the GIL-free pass touches that
+// is not a disjoint row range of the shared output buffers.
+struct Shard {
+  npy_intp begin = 0;
+  npy_intp end = 0;
+  std::vector<StrRef> strs;
+  Arena arena;
+  bool fallback = false;
+};
+
+// Parse rows [sh.begin, sh.end) of the payload slice. Pure native code —
+// runs with the GIL released, possibly on a std::thread.
+void parse_shard(Shard& sh,
+                 const std::vector<std::pair<const char*, Py_ssize_t>>& bufs,
+                 std::vector<Field>& fields, unsigned char* bad) {
+  std::string tmp;
+  sh.strs.reserve((size_t)(sh.end - sh.begin));
+  for (npy_intp r = sh.begin; r < sh.end; r++) {
+    Parser ps(bufs[(size_t)r].first,
+              bufs[(size_t)r].first + bufs[(size_t)r].second);
+    int rc = parse_row(ps, fields, r, sh.strs, sh.arena, tmp);
+    if (rc == 2) {
+      sh.fallback = true;
+      break;
+    }
+    if (rc == 1) {
+      bad[r] = 1;
+      for (auto& f : fields) f.valid[r] = 0;
+    }
+  }
+}
+
 PyObject* jc_decode(PyObject*, PyObject* args) {
   PyObject* payloads;
   PyObject* fields_spec;
-  if (!PyArg_ParseTuple(args, "OO", &payloads, &fields_spec)) return nullptr;
+  int n_shards = 1;
+  if (!PyArg_ParseTuple(args, "OO|i", &payloads, &fields_spec, &n_shards))
+    return nullptr;
   if (!PyList_Check(payloads) || !PyTuple_Check(fields_spec)) {
     PyErr_SetString(PyExc_TypeError, "decode(list[bytes], tuple[(name, type)])");
     return nullptr;
@@ -583,26 +638,44 @@ PyObject* jc_decode(PyObject*, PyObject* args) {
   // string values become StrRefs. This is the bulk of the work and runs
   // truly parallel to the engine's other Python threads (the fused node
   // worker, emit workers), which is what lets a byte-fed pipe keep the
-  // device path busy (reference measures bytes-in end-to-end, README.md:98)
-  std::vector<StrRef> strs;
-  strs.reserve((size_t)n_rows);
-  Arena arena;
-  std::string tmp;
-  bool need_fallback = false;
-  Py_BEGIN_ALLOW_THREADS
-  for (npy_intp r = 0; r < n_rows; r++) {
-    Parser ps(bufs[(size_t)r].first,
-              bufs[(size_t)r].first + bufs[(size_t)r].second);
-    int rc = parse_row(ps, fields, r, strs, arena, tmp);
-    if (rc == 2) {
-      need_fallback = true;
-      break;
-    }
-    if (rc == 1) {
-      bad[r] = 1;
-      for (auto& f : fields) f.valid[r] = 0;
+  // device path busy (reference measures bytes-in end-to-end, README.md:98).
+  // With shards > 1 the pass itself also fans out over native threads:
+  // each shard owns a contiguous row slice of the SAME output buffers.
+  if (n_shards < 1) n_shards = 1;
+  if (n_shards > 32) n_shards = 32;
+  // tiny batches: thread spawn would cost more than the parse
+  while (n_shards > 1 && n_rows < (npy_intp)n_shards * 256) n_shards--;
+  std::vector<Shard> shards((size_t)n_shards);
+  {
+    npy_intp chunk = (n_rows + n_shards - 1) / n_shards;
+    for (int i = 0; i < n_shards; i++) {
+      shards[(size_t)i].begin = std::min((npy_intp)i * chunk, n_rows);
+      shards[(size_t)i].end = std::min((npy_intp)(i + 1) * chunk, n_rows);
     }
   }
+  bool need_fallback = false;
+  Py_BEGIN_ALLOW_THREADS
+  if (n_shards == 1) {
+    parse_shard(shards[0], bufs, fields, bad);
+  } else {
+    std::vector<std::thread> workers;
+    workers.reserve((size_t)(n_shards - 1));
+    try {
+      for (int i = 1; i < n_shards; i++)
+        workers.emplace_back(parse_shard, std::ref(shards[(size_t)i]),
+                             std::cref(bufs), std::ref(fields), bad);
+    } catch (const std::exception&) {
+      // thread/resource exhaustion (EAGAIN): the un-spawned shards run
+      // serially below — a slower decode, never a std::terminate (and
+      // never an exception escaping the no-GIL region)
+    }
+    parse_shard(shards[0], bufs, fields, bad);
+    for (size_t i = workers.size() + 1; i < (size_t)n_shards; i++)
+      parse_shard(shards[i], bufs, fields, bad);
+    for (auto& w : workers) w.join();
+  }
+  for (auto& sh : shards)
+    if (sh.fallback) need_fallback = true;
   Py_END_ALLOW_THREADS
   if (need_fallback) {
     Py_DECREF(cols); Py_DECREF(valids); Py_DECREF(bad_arr);
@@ -613,23 +686,27 @@ PyObject* jc_decode(PyObject*, PyObject* args) {
   // pass 2 — intern string values under the GIL: hash + incref per value
   // (hit path), PyUnicode decode only for novel strings. Invalid UTF-8
   // marks the row bad (json.loads parity), never a batch fallback.
+  // Shards are walked in slice order, so the intern sequence (and the
+  // bounded table's contents) matches the single-thread pass exactly.
   Interner intern;
-  for (const StrRef& sr : strs) {
-    if (bad[sr.row]) continue;  // a later field already failed this row
-    PyObject* u = intern.get(sr.p, sr.n);
-    if (u == nullptr) {
-      if (intern.bad_utf8) {
-        intern.bad_utf8 = false;
-        bad[sr.row] = 1;
-        for (auto& f : fields) f.valid[sr.row] = 0;
-        continue;
+  for (auto& sh : shards) {
+    for (const StrRef& sr : sh.strs) {
+      if (bad[sr.row]) continue;  // a later field already failed this row
+      PyObject* u = intern.get(sr.p, sr.n);
+      if (u == nullptr) {
+        if (intern.bad_utf8) {
+          intern.bad_utf8 = false;
+          bad[sr.row] = 1;
+          for (auto& f : fields) f.valid[sr.row] = 0;
+          continue;
+        }
+        Py_DECREF(cols); Py_DECREF(valids); Py_DECREF(bad_arr);
+        return nullptr;  // real error (e.g. MemoryError) already set
       }
-      Py_DECREF(cols); Py_DECREF(valids); Py_DECREF(bad_arr);
-      return nullptr;  // real error (e.g. MemoryError) already set
+      Field& f = fields[(size_t)sr.field];
+      Py_XDECREF(f.obj[sr.row]);
+      f.obj[sr.row] = u;
     }
-    Field& f = fields[(size_t)sr.field];
-    Py_XDECREF(f.obj[sr.row]);
-    f.obj[sr.row] = u;
   }
   PyObject* out = PyTuple_Pack(3, cols, valids, bad_arr);
   Py_DECREF(cols);
@@ -640,7 +717,7 @@ PyObject* jc_decode(PyObject*, PyObject* args) {
 
 PyMethodDef methods[] = {
     {"decode", jc_decode, METH_VARARGS,
-     "decode(payloads, fields) -> (columns, valid, bad)"},
+     "decode(payloads, fields, shards=1) -> (columns, valid, bad)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

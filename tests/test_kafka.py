@@ -500,3 +500,18 @@ class TestKafkaCheckpointReplay:
         topo2.close()
         res = {m["deviceId"]: (m["c"], round(m["a"], 4)) for m in msgs}
         assert res == {"a": (3, 20.0), "b": (2, 20.0)}, res
+
+
+class TestTombstones:
+    def test_null_value_stays_none(self):
+        """A delete tombstone (null value) must survive decode as None —
+        coercing to b"" made it indistinguishable from an empty payload
+        (ADVICE r5 low)."""
+        from ekuiper_tpu.io.kafka_wire import (decode_message_set,
+                                               encode_message_set)
+
+        mset = encode_message_set(
+            [(b"k", None, 5), (None, b"", 6), (None, b"x", 7)])
+        got = decode_message_set(mset)
+        assert [(k, v) for _, k, v, _ in got] == [
+            (b"k", None), (None, b""), (None, b"x")]

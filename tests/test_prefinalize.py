@@ -332,6 +332,11 @@ class TestNodePrefinalize:
                     node.on_pre_trigger(PreTrigger(ts=10_000 * (w + 1)))
             node.on_trigger(Trigger(ts=10_000 * (w + 1)))
             sync_node.on_trigger(Trigger(ts=10_000 * (w + 1)))
+        # boundaries without a landed pre-issue defer to the emit worker
+        # (_emit_late_async) — drain before asserting, like the count/
+        # sliding async tests; without this the check raced the worker
+        node._drain_async_emits()
+        sync_node._drain_async_emits()
         assert len(got) == len(sync_got) == 4
         for a, b in zip(got, sync_got):
             assert _flat([a]) == _flat([b])

@@ -375,3 +375,79 @@ class TestSlidingBurst:
         msgs = flat(got)
         assert len(msgs) == 1
         assert msgs[0]["c"] == 4  # the late row counted
+
+
+class TestDevRingBudget:
+    """HBM budget on the sliding device-input cache (_dev_ring): past the
+    cap the oldest entries drop to None and refolds take the exact host
+    path — output parity must hold at ANY budget."""
+
+    def _run_with_budget(self, budget_bytes):
+        stmt = parse_select(SQL)
+        plan = extract_kernel_plan(stmt)
+        node = FusedWindowAggNode(
+            "sb", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+            capacity=64, micro_batch=128,
+            direct_emit=build_direct_emit(stmt, plan, ["deviceId"]))
+        if budget_bytes is not None:
+            node.dev_ring_budget_bytes = budget_bytes
+        node.state = node.gb.init_state()
+        got = []
+        node.broadcast = lambda item: got.append(item)
+        rng = np.random.default_rng(21)
+        for b in mkbatches(rng, n_batches=10, rows=64):
+            node.process(b)
+        node._drain_async_emits()
+        return got, node
+
+    def test_zero_budget_evicts_everything_and_stays_exact(self):
+        ref, _ = self._run_with_budget(None)
+        got, node = self._run_with_budget(0)
+        # cache fully evicted: nothing pinned, accounting balanced
+        assert node._dev_ring_bytes == 0
+        assert all(e is None for lst in node._dev_ring.values() for e in lst)
+        # parity: host-path refolds produce the same windows
+        assert per_trigger(got) == per_trigger(ref)
+
+    def test_default_budget_caches_and_accounts(self):
+        got, node = self._run_with_budget(None)
+        cached = [e for lst in node._dev_ring.values() for e in lst
+                  if e is not None]
+        assert cached  # 64-row batches pass the mb//4 guard
+        assert node._dev_ring_bytes > 0
+        assert node._dev_ring_bytes <= node.dev_ring_budget_bytes
+
+    def test_tiny_budget_keeps_only_newest(self):
+        _, ref_node = self._run_with_budget(None)
+        one_entry = ref_node._dev_entry_nbytes(
+            next(e for lst in ref_node._dev_ring.values() for e in lst
+                 if e is not None))
+        got, node = self._run_with_budget(one_entry)
+        cached = sum(1 for lst in node._dev_ring.values() for e in lst
+                     if e is not None)
+        assert cached <= 1
+        assert node._dev_ring_bytes <= node.dev_ring_budget_bytes
+
+
+class TestWarmupForce:
+    def test_warmup_upload_bypasses_small_batch_guard(self):
+        """The 1-row warmup batch must compile fold_masked: without force
+        the mb//4 guard rejects it and the first real trigger pays the jit
+        stall the warmup promises to avoid (ADVICE r5 medium)."""
+        stmt = parse_select(SQL)
+        plan = extract_kernel_plan(stmt)
+        node = FusedWindowAggNode(
+            "sw", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+            capacity=64, micro_batch=128,
+            direct_emit=build_direct_emit(stmt, plan, ["deviceId"]))
+        node.state = node.gb.init_state()
+        cols = {n: np.zeros(1, dtype=np.float32) for n in plan.columns}
+        slots = np.zeros(1, dtype=np.int32)
+        assert node._upload_sliding_inputs(cols, {}, slots) is None
+        dev = node._upload_sliding_inputs(cols, {}, slots, force=True)
+        assert dev is not None
+        # the forced upload is mb-padded: exactly what fold_masked takes
+        assert int(dev[2].shape[0]) == node.gb.micro_batch
+        # and _warmup itself goes through without error, compiling the
+        # mask-only refold executable
+        node._warmup()

@@ -9,7 +9,8 @@ from http.server import BaseHTTPRequestHandler, HTTPServer
 
 import pytest
 
-from ekuiper_tpu.io.tdengine_io import Tdengine3Sink, build_insert
+from ekuiper_tpu.io.tdengine_io import (Tdengine3Sink, build_insert,
+                                        build_insert_many)
 from ekuiper_tpu.utils.infra import EngineError
 
 
@@ -101,11 +102,14 @@ class TestRestTransport:
         sink.collect([{"f1": "v2"}, {"f1": "v3"}])
         sink.close()
         srv.close()
-        assert len(srv.requests) == 3
+        # one POST per collect(): the list batches into a multi-row INSERT
+        assert len(srv.requests) == 2
         path, auth, body = srv.requests[0]
         assert path == "/rest/sql/db1"
         assert auth == "Basic " + base64.b64encode(b"root:taosdata").decode()
         assert body == 'INSERT INTO t (ts,f1) values (now,"v1")'
+        assert srv.requests[1][2] == \
+            'INSERT INTO t (ts,f1) values (now,"v2")(now,"v3")'
 
     def test_broker_error_code_raises(self):
         srv = _Adapter(code=534)
@@ -126,3 +130,67 @@ class TestRestTransport:
         from ekuiper_tpu.io import registry
 
         assert "tdengine3" in registry.sink_types()
+
+
+class TestBuildInsertMany:
+    """Multi-row batching goldens: every value group must byte-match what
+    the single-row builder would have produced for that row (the existing
+    builder is the spec — VERDICT r5 weak #5)."""
+
+    CFG = {"table": "t", "tsFieldName": "ts", "provideTs": True,
+           "fields": ["f1"]}
+
+    def test_single_row_matches_build_insert(self):
+        row = {"ts": 1, "f1": "a"}
+        assert build_insert_many(self.CFG, [row]) == \
+            [build_insert(self.CFG, row)]
+
+    def test_multi_row_one_statement(self):
+        rows = [{"ts": 1, "f1": "a"}, {"ts": 2, "f1": "b"},
+                {"ts": 3, "f1": "c"}]
+        stmts = build_insert_many(self.CFG, rows)
+        assert stmts == ['INSERT INTO t (ts,f1) values (1,"a")(2,"b")(3,"c")']
+        # golden vs the single-row builder: shared prefix + each row's group
+        singles = [build_insert(self.CFG, r) for r in rows]
+        prefix, g0 = singles[0].split(" values ")
+        assert stmts[0].startswith(prefix + " values ")
+        groups = stmts[0].split(" values ", 1)[1]
+        assert groups == "".join(s.split(" values ", 1)[1] for s in singles)
+
+    def test_tag_change_splits_statements(self):
+        cfg = {"table": "t", "tsFieldName": "ts", "provideTs": True,
+               "sTable": "st", "tagFields": ["tag"], "fields": ["k1"]}
+        rows = [{"ts": 1, "k1": "a", "tag": "x"},
+                {"ts": 2, "k1": "b", "tag": "x"},
+                {"ts": 3, "k1": "c", "tag": "y"}]
+        stmts = build_insert_many(cfg, rows)
+        assert len(stmts) == 2
+        assert stmts[0] == ('INSERT INTO t (ts,k1) USING st TAGS("x")'
+                            ' values (1,"a")(2,"b")')
+        assert stmts[1] == ('INSERT INTO t (ts,k1) USING st TAGS("y")'
+                            ' values (3,"c")')
+
+    def test_column_set_change_splits_statements(self):
+        cfg = {"table": "t", "tsFieldName": "ts", "provideTs": True}
+        rows = [{"ts": 1, "a": 1}, {"ts": 2, "a": 2, "b": 3}]
+        stmts = build_insert_many(cfg, rows)
+        assert stmts == ['INSERT INTO t (ts,a) values (1,1)',
+                         'INSERT INTO t (ts,a,b) values (2,2,3)']
+
+    def test_bad_row_fails_before_any_statement(self):
+        with pytest.raises(EngineError):
+            build_insert_many(self.CFG, [{"ts": 1, "f1": "a"}, {"ts": 2}])
+
+    def test_oversized_emit_chunks_below_sql_length_cap(self):
+        from ekuiper_tpu.io import tdengine_io
+
+        rows = [{"ts": i, "f1": "x" * 200} for i in range(6000)]
+        stmts = build_insert_many(self.CFG, rows)
+        assert len(stmts) > 1  # ~1.2MB of value groups must split
+        assert all(len(s) <= tdengine_io._MAX_STMT_BYTES + 1024
+                   for s in stmts)
+        # no row lost or reordered across the chunk cuts
+        groups = "".join(s.split(" values ", 1)[1] for s in stmts)
+        singles = "".join(
+            build_insert(self.CFG, r).split(" values ", 1)[1] for r in rows)
+        assert groups == singles

@@ -4,6 +4,7 @@ and a rule e2e — modeled on the reference zmq extension
 (extensions/impl/zmq) and its test plugin (test/plugins/pub/zmq_pub.go)."""
 import json
 import struct
+import socket
 import time
 
 import pytest
@@ -202,3 +203,28 @@ class TestConnector:
             topo.close()
         vals = [json.loads(b"".join(p[1:])) for p in got]
         assert any(v.get("b") == 42.0 for v in vals), vals
+
+
+class TestHandshakeFailure:
+    def test_failed_handshake_releases_accepted_slot(self):
+        """A peer that fails the ZMTP handshake must not leak its socket
+        in _accepted (ADVICE r5 low: repeated failures grew the list until
+        close)."""
+        pub = PubServer("tcp://127.0.0.1:0")
+        try:
+            for _ in range(3):
+                s = socket.create_connection(("127.0.0.1", pub.port),
+                                             timeout=2)
+                s.sendall(b"this is not a zmtp greeting at all" * 3)
+                s.close()
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                with pub._mu:
+                    if not pub._accepted:
+                        break
+                time.sleep(0.05)
+            with pub._mu:
+                assert not pub._accepted
+            assert pub.subscriber_count() == 0
+        finally:
+            pub.close()
