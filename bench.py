@@ -743,6 +743,18 @@ def _hetero_main() -> None:
         mem.reset()
 
 
+def _stage_summary(node) -> dict:
+    """Per-stage StatManager timings for the bench artifact: the ingest
+    pipeline balance (source decode/upload vs fused upload/fold) is an
+    acceptance number, not just an operator dashboard."""
+    out = {}
+    for stage, st in node.stats.snapshot()["stage_timings"].items():
+        calls = max(st["calls"], 1)
+        out[stage] = {"calls": st["calls"], "rows": st["rows"],
+                      "us_per_call": round(st["total_us"] / calls, 1)}
+    return out
+
+
 def _full_pipe_session(measure) -> None:
     """Shared full-pipe harness: raw JSON bytes → native columnar decode
     (jsoncol.cpp, shard-parallel on the decode pool) → fused device window,
@@ -846,7 +858,7 @@ def _full_pipe_session(measure) -> None:
 
         dec = ("native" if src._fast_spec is not None
                and fastjson._load() is not None else "python")
-        measure(run_segment, src, dec)
+        measure(run_segment, src, dec, fused)
     finally:
         topo.close()
         mem.reset()
@@ -857,7 +869,7 @@ def _full_pipe_main() -> None:
     MQTT+decode pipeline, README.md:98; kernel-fed numbers skip ingest,
     this line does not). Prints a stderr metric line."""
 
-    def measure(run_segment, src, dec):
+    def measure(run_segment, src, dec, fused):
         rows, byts, elapsed = run_segment(10.0)
         print(
             f"# full-pipe ingest (json bytes → decode[{dec}] → coerce → "
@@ -866,9 +878,13 @@ def _full_pipe_main() -> None:
             f"{byts / elapsed / 1e6:.1f}MB/s bytes-in)",
             file=sys.stderr,
         )
+        prep = src.prep_ctx
         record("full_pipe", rows_per_sec=rows / elapsed,
                mb_per_sec=byts / elapsed / 1e6, decoder=dec,
-               pool=src.decode_pool_size, shards=src._decode_shards)
+               pool=src.decode_pool_size, shards=src._decode_shards,
+               prep_batches=(prep.n_precomputed if prep else 0),
+               stages={"source": _stage_summary(src),
+                       "fused": _stage_summary(fused)})
 
     _full_pipe_session(measure)
 
@@ -896,7 +912,7 @@ def _full_pipe_contended_main() -> None:
     import os as _os
     import tempfile
 
-    def measure(run_segment, src, dec):
+    def measure(run_segment, src, dec, fused):
         rows, byts, elapsed = run_segment(10.0)
         idle = rows / elapsed
         n_burn = max(2, (_os.cpu_count() or 4) // 2)
@@ -927,10 +943,14 @@ def _full_pipe_contended_main() -> None:
             f"rows/s ({degr:.0f}% degradation)",
             file=sys.stderr,
         )
+        prep = src.prep_ctx
         record("full_pipe_contended", idle_rows_per_sec=idle,
                loaded_rows_per_sec=loaded, degradation_pct=degr,
                burners=n_burn, decoder=dec,
-               pool=src.decode_pool_size, shards=src._decode_shards)
+               pool=src.decode_pool_size, shards=src._decode_shards,
+               prep_batches=(prep.n_precomputed if prep else 0),
+               stages={"source": _stage_summary(src),
+                       "fused": _stage_summary(fused)})
 
     _full_pipe_session(measure)
 

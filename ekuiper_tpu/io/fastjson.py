@@ -110,6 +110,21 @@ def schema_field_spec(schema: Optional[Schema]):
     return tuple(spec)
 
 
+def native_module():
+    """The loaded ekjsoncol module, or None. Does NOT trigger a build —
+    callers that can start one use ensure_native(); everything else (the
+    key-slot encode fast path in ops/keytable.py) just rides whatever a
+    source already built."""
+    return _load()
+
+
+def has_keytab() -> bool:
+    """True when the loaded native decoder carries the persistent key-slot
+    table API (a stale prebuilt .so may predate it)."""
+    mod = _load()
+    return mod is not None and hasattr(mod, "keytab_encode")
+
+
 def decode_columns(
     payloads: List[bytes], field_spec, shards: int = 1,
 ) -> Optional[Tuple[Dict[str, Any], Dict[str, Any], Any]]:

@@ -79,6 +79,29 @@ def render(rule_registry) -> str:
                 f'kuiper_op_{mname}{{rule="{_esc(rule_id)}",'
                 f'op="{_esc(node.name)}",type="{_esc(node.op_type)}",'
                 f'stage="{_esc(stage)}"}} {st[key]}')
+    # ingest-pipeline occupancy: ring depth (decoded batches awaiting their
+    # emission turn) and decode-queue depth (jobs awaiting a worker) per
+    # pooled source — backpressure becomes a visible gauge instead of an
+    # inference from throughput dips
+    pool_rows = []
+    for rule_id, node in rows:
+        depths_fn = getattr(node, "pool_depths", None)
+        if depths_fn is None:
+            continue
+        depths = depths_fn()
+        if depths is not None:
+            pool_rows.append((rule_id, node, depths))
+    for mname, idx, help_txt in (
+            ("ingest_ring_depth", 0,
+             "decoded batches in the ordered ring (submitted, not emitted)"),
+            ("decode_pool_queue", 1,
+             "decode jobs waiting for a pool worker")):
+        out.append(f"# TYPE kuiper_{mname} gauge")
+        out.append(f"# HELP kuiper_{mname} {help_txt}")
+        for rule_id, node, depths in pool_rows:
+            out.append(
+                f'kuiper_{mname}{{rule="{_esc(rule_id)}",'
+                f'op="{_esc(node.name)}"}} {depths[idx]}')
     out.append("# TYPE kuiper_uptime_seconds gauge")
     out.append(f"kuiper_uptime_seconds {time.time() - _START_TIME:.1f}")
     return "\n".join(out) + "\n"

@@ -51,6 +51,18 @@ def _wide_size(comp: str) -> int:
     return _WIDE_SIZE[comp]
 
 
+def slot_dtype(capacity: int):
+    """Slot-vector wire dtype for a key capacity — the ONE place holding
+    the uint16/int32 boundary. Slots ship as uint16 while every assignable
+    slot id (0..capacity-1) fits; past 65,535 they ship int32. Callers that
+    cache pre-padded slot arrays (sliding _dev_ring, the ingest prep's
+    share cache) key or re-derive on this, so a capacity doubling past the
+    boundary switches new uploads to int32 while already-cached uint16
+    arrays stay valid — their values predate the grow and still index the
+    same dense slots (_fold_core casts to int32 on device)."""
+    return np.uint16 if capacity <= 65535 else np.int32
+
+
 def apply_int_semantics(specs, host: List[np.ndarray]) -> List[np.ndarray]:
     """Reference-exact integer semantics on finalize output: counts are
     int64; integer-typed inputs get truncating avg / integral sum/min/max.
@@ -243,9 +255,8 @@ class DeviceGroupBy:
                 # ships as ONE scalar count compared against an iota on
                 # device instead of an mb-byte bool mask — HBM/link
                 # bandwidth is the bottleneck, not device compute
-                if self.capacity <= 65535:
-                    s = s.astype(np.uint16)
-                s_dev = jnp.asarray(s)
+                s_dev = jnp.asarray(
+                    s.astype(slot_dtype(self.capacity), copy=False))
             if isinstance(pane_idx, np.ndarray):
                 pv = pane_idx[start:end]
                 if pad:

@@ -15,11 +15,75 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 
+def _native_keytab_module():
+    """ekjsoncol when it is loaded AND carries the keytab API, else None.
+    Never triggers a build (io/fastjson.py owns that lifecycle)."""
+    try:
+        from ..io import fastjson
+
+        if fastjson.has_keytab():
+            return fastjson.native_module()
+    except Exception:
+        pass
+    return None
+
+
 class KeyTable:
     def __init__(self, initial_capacity: int = 16384) -> None:
         self.capacity = initial_capacity
         self._ids: Dict[Any, int] = {}
         self._keys: List[Any] = []
+        # native slot-encode fast path (native/jsoncol.cpp keytab_*): a
+        # persistent byte-keyed hash table assigns slots in one C pass for
+        # plain str/None key columns — the dominant GROUP BY shape. The
+        # Python table REMAINS the source of truth (reverse decode,
+        # checkpointing, every non-str shape); the native table mirrors it
+        # via the ordered new-key appendix and a lazy catch-up, and any
+        # batch the C side can't represent byte-identically falls back
+        # here without ever diverging the two.
+        self._ntab = None
+        self._native_n = 0  # python keys already mirrored into the native tab
+        self._native_ok = True
+
+    # -------------------------------------------------------------- native
+    def _native_encode(self, lst: list) -> Optional[Tuple[np.ndarray, bool]]:
+        """One-pass C slot encode for str/None key lists; None when the
+        native path is unavailable or this table's history can't mirror
+        (non-string keys seen) — the caller runs the Python path."""
+        if not self._native_ok:
+            return None
+        mod = _native_keytab_module()
+        if mod is None:
+            return None
+        try:
+            if self._ntab is None:
+                self._ntab = mod.keytab_new()
+            if self._native_n < len(self._keys):
+                # catch up: keys that arrived via Python paths (sorted
+                # fallback, tuples, restore) feed the native table in slot
+                # order so both sides assign identical ids from here on
+                missing = self._keys[self._native_n:]
+                if not all(type(k) is str for k in missing):
+                    self._native_ok = False  # tuples/numerics: python-only
+                    return None
+                mod.keytab_encode(self._ntab, missing)
+                self._native_n = len(self._keys)
+            slots, appendix = mod.keytab_encode(self._ntab, lst)
+        except Exception:
+            # ekjsoncol.Fallback (non-str / lone-surrogate key) or any
+            # native fault: the table was NOT mutated — python path
+            return None
+        if appendix:
+            ids = self._ids
+            start = len(self._keys)
+            ids.update(zip(appendix, range(start, start + len(appendix))))
+            self._keys.extend(appendix)
+            self._native_n = len(self._keys)
+        grew = False
+        while len(self._keys) > self.capacity:
+            self.capacity *= 2
+            grew = True
+        return slots, grew
 
     def __len__(self) -> int:
         return len(self._keys)
@@ -38,8 +102,12 @@ class KeyTable:
         (new key) drops to the insertion loop; unhashable values drop to the
         sort-based legacy path below."""
         if col.dtype == np.object_ and len(col):
+            lst = col.tolist()
+            out = self._native_encode(lst)
+            if out is not None:
+                return out
             try:
-                return self._encode_hashed(col.tolist())
+                return self._encode_hashed(lst)
             except TypeError:
                 pass  # unhashable elements — legacy sort path
         return self._encode_sorted(col)
@@ -118,9 +186,20 @@ class KeyTable:
         try:
             uniq, inverse = np.unique(col, return_inverse=True)
         except TypeError:
-            # mixed incomparable types: fall back to stringified sort key
-            col = np.array([repr(x) for x in col], dtype="U")
-            uniq, inverse = np.unique(col, return_inverse=True)
+            # mixed incomparable types: keep hashable values as THEMSELVES
+            # and stringify only unhashable elements (matching
+            # encode_multi's _h). The old blanket repr() gave every value a
+            # second identity in mixed batches — '' became "''", so a key
+            # seen via this path and via the hashed path got TWO slots.
+            normed = []
+            for x in col.tolist():
+                try:
+                    hash(x)
+                except TypeError:
+                    normed.append(repr(x))
+                else:
+                    normed.append(x)
+            return self._encode_hashed(normed)
         uids = np.empty(len(uniq), dtype=np.int32)
         ids = self._ids
         keys = self._keys
@@ -186,10 +265,16 @@ class KeyTable:
     def clear(self) -> None:
         self._ids.clear()
         self._keys.clear()
+        # drop the native mirror; the next native encode re-feeds from
+        # _keys (empty now), so both sides restart in lockstep
+        self._ntab = None
+        self._native_n = 0
+        self._native_ok = True
 
     def restore(self, keys: List[Any]) -> None:
         """Rebuild in the exact slot order of a checkpoint (slot ids index
-        the saved device partials, so order must be preserved)."""
+        the saved device partials, so order must be preserved). The native
+        mirror re-syncs lazily via the catch-up in _native_encode."""
         self.clear()
         for i, k in enumerate(keys):
             self._ids[k] = i

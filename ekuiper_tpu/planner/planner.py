@@ -90,6 +90,7 @@ def merged_options(rule: RuleDef) -> RuleOptionConfig:
         "decodePoolSize": "decode_pool_size",
         "decodeShards": "decode_shards",
         "ingestRingDepth": "ingest_ring_depth",
+        "ingestPrepUpload": "ingest_prep_upload",
         "slidingDevRingMb": "sliding_dev_ring_mb",
     }
     for k, v in rule.options.items():
@@ -592,6 +593,7 @@ def _plan_stream_source(stream_name: str, src_name: str, opts, store,
             decode_pool_size=opts.decode_pool_size,
             decode_shards=opts.decode_shards,
             ring_depth=opts.ingest_ring_depth,
+            prep_upload=opts.ingest_prep_upload,
             # private pipeline: prune at decode. Shared pipelines must stay
             # unpruned (other riders need other columns) — see the entry.
             project_columns=(None if opts.share_source and opts.qos == 0
@@ -631,7 +633,7 @@ def _plan_stream_source(stream_name: str, src_name: str, opts, store,
             "mb": opts.micro_batch_rows,
             "linger": opts.micro_batch_linger_ms,
             "pool": [opts.decode_pool_size, opts.decode_shards,
-                     opts.ingest_ring_depth],
+                     opts.ingest_ring_depth, opts.ingest_prep_upload],
         })
         entry = SharedEntryNode(f"{src_name}_shared",
                                 project_columns=project_columns,
@@ -815,6 +817,18 @@ def _build_device_chain(
         dev_ring_budget_mb=opts.sliding_dev_ring_mb,
     )
     topo.add_op(fused)
+    # hand the kernel-input shape to the source's ingest prep at PLAN time
+    # (runtime/ingest.py IngestPrepCtx): the decode pool's upload stage then
+    # pre-encodes keys + device_puts kernel columns from the FIRST batch.
+    # Paths without the hook (rate-limited chains, host path) still get
+    # registered by the fused node's first _shared_device_inputs call.
+    reg = getattr(src, "register_prep_spec", None)
+    if reg is not None and getattr(fused.gb, "accepts_device_inputs", False) \
+            and fused.wt != ast.WindowType.SLIDING_WINDOW:
+        # sliding excluded: its folds upload through _upload_sliding_inputs
+        # (whose pre-padded buffers the _dev_ring must own for trigger-time
+        # mask refolds) — a prep upload would be a second, unused copy
+        reg(fused.prep_spec())
     if opts.is_event_time:
         # event-time: watermark generation + late drop feeds the kernel's
         # per-row pane routing (columnar all the way)

@@ -19,12 +19,24 @@ Ordering contract: emission order == submission order, always — the pool
 is invisible to everything downstream except for the added pipelining.
 `drain()` blocks until every submitted job has emitted; the source calls it
 on final flushes (EOF/close) so batches never trail stream-end events.
+
+Round 7 adds the `upload` stage: the ring drainer runs prepare_fn on each
+result IN SUBMIT ORDER just before emitting it — the source wires this to
+IngestPrepCtx.precompute, which key-slot-encodes the batch (native C table,
+ops/keytable.py) and pre-pads + device_puts the kernel inputs under the
+SAME share keys the fused node's _shared_device_inputs uses. A batch thus
+arrives at the fused worker already slot-encoded and already resident on
+device: H2D of batch k+1 overlaps the fold dispatch of batch k, and the
+fused worker's own `upload` stage collapses to cache lookups. Running the
+encode on the ordered drain (not on whichever worker finishes first) keeps
+slot numbering, emitted group order, and checkpoint key order exactly what
+the inline path produces — the pool stays invisible downstream.
 """
 from __future__ import annotations
 
 import threading
 import time as _time
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..utils.infra import logger
 
@@ -36,14 +48,21 @@ class DecodePool:
                                       thread-safe; None = nothing to emit)
     emit_fn(result)                   called in submit order; at most one
                                       thread emits at any time
+    prepare_fn(result)                optional post-decode stage run by the
+                                      drainer IN SUBMIT ORDER just before
+                                      each emit (the pipelined upload
+                                      stage; ordered so key-slot
+                                      assignment stays deterministic)
     """
 
     def __init__(self, size: int, ring_depth: int, decode_fn: Callable,
-                 emit_fn: Callable, name: str = "ingest") -> None:
+                 emit_fn: Callable, name: str = "ingest",
+                 prepare_fn: Optional[Callable] = None) -> None:
         self.size = max(1, int(size))
         self.ring_depth = max(1, int(ring_depth))
         self._decode = decode_fn
         self._emit = emit_fn
+        self._prepare = prepare_fn
         self._lock = threading.Lock()
         self._job_ready = threading.Condition(self._lock)
         self._slot_free = threading.Condition(self._lock)
@@ -69,6 +88,13 @@ class DecodePool:
         """Jobs submitted but not yet emitted (ring occupancy)."""
         with self._lock:
             return self._in_flight
+
+    @property
+    def queue_depth(self) -> int:
+        """Jobs submitted but not yet picked up by a worker — sustained
+        nonzero means decode is the bottleneck, not the ring."""
+        with self._lock:
+            return len(self._jobs)
 
     def submit(self, job: Any) -> None:
         """Queue a decode job; blocks while the ring is full (backpressure).
@@ -145,6 +171,21 @@ class DecodePool:
                 self._emit_seq += 1
             try:
                 if head is not None:
+                    if self._prepare is not None:
+                        # upload stage — INSIDE the ordered drain, so the
+                        # key-slot encode assigns slots in submission order
+                        # (worker-completion order would make slot
+                        # numbering, emitted group order, and checkpoint
+                        # key order nondeterministic run-to-run). Still
+                        # off the fused worker: prepare of batch k+1 runs
+                        # while the fused node folds batch k. A failure
+                        # only loses the pre-compute — the fused node
+                        # rebuilds inline, exactly as before.
+                        try:
+                            self._prepare(head)
+                        except Exception as exc:
+                            logger.warning(
+                                "ingest prepare (upload) failed: %s", exc)
                     self._emit(head)
             except Exception as exc:
                 logger.warning("decode pool emit failed: %s", exc)
@@ -154,3 +195,154 @@ class DecodePool:
                     self._slot_free.notify_all()
                     if self._in_flight == 0:
                         self._drained.notify_all()
+
+
+def pad_col_for_device(host, vm, mb: int):
+    """Canonical pad + device upload for one kernel column — the ONE
+    builder behind the share key ("dcol", name, mb). Both the prep ctx
+    (pool-side pre-upload) and nodes_fused._shared_device_inputs (inline
+    fallback) call this, so a cache hit can never serve a differently
+    built array than the inline path would have made."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    arr = np.asarray(host, dtype=np.float32)
+    if len(arr) < mb:
+        arr = np.pad(arr, (0, mb - len(arr)))
+    dm = None
+    if vm is not None:
+        m = vm if len(vm) == mb else np.pad(vm, (0, mb - len(vm)))
+        dm = jnp.asarray(m)
+    return jnp.asarray(arr), dm
+
+
+def pad_slots_for_device(slots, mb: int, u16: bool):
+    """Canonical pad + dtype + upload for the slot vector — the ONE
+    builder behind the share key ("dslots", key_name, mb, u16)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    s = slots
+    if len(s) < mb:
+        s = np.pad(s, (0, mb - len(s)))
+    return jnp.asarray(s.astype(np.uint16 if u16 else np.int32))
+
+
+class IngestPrepCtx:
+    """Shared ingest prep + the pipelined upload stage.
+
+    One of these rides every ColumnBatch (as `shared_ctx`) emitted by a
+    prep-enabled source or shared subtopo. Two jobs:
+
+    - `encode(batch, key_name)`: ONE group-key encode per batch for every
+      fan-out consumer (the neutral KeyTable assigns dense
+      insertion-ordered slots; a consumer feeding its own table the same
+      key sequence via keys_slice gets identical ids). The table's hashed
+      path rides the native C key-slot table (ops/keytable.py
+      _native_encode) when the extension is present.
+
+    - `precompute(batch)`: the upload stage, run by decode-pool workers.
+      Consumers declare their kernel-input shape with `register_upload`;
+      precompute then key-slot-encodes the batch and builds the padded
+      float32 device columns + slot vector under the SAME share keys
+      nodes_fused._shared_device_inputs memoizes on — so the fused worker
+      finds everything cached and its per-batch `upload` stage collapses
+      to dict lookups while H2D of batch k+1 overlapped fold of batch k.
+
+    Capacity-grow signalling round-trips through the share-key scheme: the
+    slot vector's key carries a u16 bit derived from the neutral table's
+    capacity at encode time. When a grow crosses 65,535 the bit flips, so
+    any in-flight batch pre-uploaded with the old dtype simply MISSES the
+    fused node's cache lookup and is re-padded/re-uploaded there with the
+    grown dtype (the grow itself re-specializes the fold executables).
+    Slot VALUES are insertion-ordered and dense, so pre-encoded slots stay
+    valid across grows — only the dtype choice is capacity-sensitive.
+    """
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.key_tables: Dict[str, Any] = {}
+        # (key_name|None, micro_batch) -> set of kernel column names;
+        # key_name None = columns-only spec (multi-dim consumers)
+        self._specs: Dict[Tuple[Optional[str], int], set] = {}
+        # telemetry: batches/columns pre-uploaded by the pool (bench + tests)
+        self.n_precomputed = 0
+        self.n_precomputed_cols = 0
+
+    # ----------------------------------------------------------- encoding
+    def encode(self, batch, key_name: str):
+        """(slots int32, n_keys, kt) for `key_name` over `batch`, computed
+        once per batch across all consumers."""
+        def factory():
+            import numpy as np
+
+            from ..ops.keytable import KeyTable
+
+            with self.lock:
+                kt = self.key_tables.get(key_name)
+                if kt is None:
+                    kt = self.key_tables[key_name] = KeyTable()
+                col = batch.columns.get(key_name)
+                if col is None:
+                    col = np.full(batch.n, None, dtype=np.object_)
+                slots, _ = kt.encode_column(col)
+                return slots, kt.n_keys, kt
+
+        return batch.share(("slots", key_name), factory)
+
+    # ------------------------------------------------------- upload stage
+    def register_upload(self, key_name: Optional[str], columns,
+                        micro_batch: int) -> None:
+        """A fused consumer declares what precompute() should build. Merged
+        by (key_name, micro_batch): heterogeneous consumers of one stream
+        union their column needs — one upload serves all of them."""
+        with self.lock:
+            spec = self._specs.setdefault(
+                (key_name, int(micro_batch)), set())
+            spec.update(columns)
+
+    def precompute(self, batch) -> int:
+        """Build padded device inputs for `batch` under the fused node's
+        share keys. Returns the number of device arrays created. Failures
+        are non-fatal: the fused node rebuilds anything missing inline."""
+        import numpy as np
+
+        with self.lock:
+            specs = [(k, set(v)) for k, v in self._specs.items()]
+        if not specs or getattr(batch, "n", 0) == 0:
+            return 0
+        try:
+            import jax.numpy as jnp  # noqa: F401 — availability probe
+        except Exception:
+            return 0
+        n_up = 0
+        for (key_name, mb), columns in specs:
+            if batch.n > mb:
+                # multi-chunk batches can't ship as one pre-padded upload
+                # (fold's device-input contract); source flushes are
+                # micro-batch aligned so this is the rare tail only
+                continue
+            if key_name is not None:
+                slots, n_keys, kt = self.encode(batch, key_name)
+                from ..ops.groupby import slot_dtype
+
+                with self.lock:
+                    u16 = slot_dtype(kt.capacity) is np.uint16
+                batch.share(("dslots", key_name, mb, u16),
+                            lambda s=slots, u=u16, m=mb:
+                            pad_slots_for_device(s, m, u))
+                n_up += 1
+            for name in sorted(columns):
+                col = batch.columns.get(name)
+                if col is None or col.dtype == np.object_:
+                    continue  # fused node NaN-fills / coerces these itself
+                vm = batch.valid.get(name)
+                batch.share(("dcol", name, mb),
+                            lambda h=col, v=vm, m=mb:
+                            pad_col_for_device(h, v, m))
+                n_up += 1
+        if n_up:
+            with self.lock:
+                self.n_precomputed += 1
+                self.n_precomputed_cols += n_up
+        return n_up

@@ -273,6 +273,8 @@ class FusedWindowAggNode(Node):
         # shared-source fan-out slot reuse: None = undecided, True = our kt
         # mirrors the subtopo's neutral table, False = self-encode forever
         self._shared_slots_ok = None
+        self._shared_nkt = None  # the neutral table our slots come from
+        self._prep_registered = False  # upload spec handed to the prep ctx
         self.state = None
         self.cur_pane = 0
         self._timer = None
@@ -588,16 +590,37 @@ class FusedWindowAggNode(Node):
                 self.kt.decode_all() == nkt.keys_slice(0, self.kt.n_keys))
             if not self._shared_slots_ok:
                 return None
+        self._shared_nkt = nkt
         if self.kt.n_keys < n_keys:
             new = np.array(nkt.keys_slice(self.kt.n_keys, n_keys),
                            dtype=np.object_)
             _, grew = self.kt.encode_column(new)
             if grew and not frozen:
                 self.state = self.gb.grow(self.state, self.kt.capacity)
-        if self.kt.n_keys != n_keys:
-            self._shared_slots_ok = False  # diverged: self-encode from now on
+        if self.kt.n_keys < n_keys:
+            # truly diverged (sync could not reach the snapshot): self-
+            # encode from now on. n_keys ABOVE the snapshot is normal with
+            # the pipelined upload stage — pool workers may encode batch
+            # k+1 before batch k's snapshot is consumed, so our table can
+            # legitimately run ahead of an older batch's n_keys; its slot
+            # values are all below the snapshot and stay valid.
+            self._shared_slots_ok = False
             return None
         return slots
+
+    def prep_spec(self):
+        """(key_name, kernel columns, micro_batch) for the ingest prep's
+        upload stage — the ONE definition of what precompute() should
+        build for this node (the planner registers it at plan time, the
+        first _shared_device_inputs call covers un-plumbed paths)."""
+        key_name = (self.dims[0].name
+                    if len(self.dims) == 1
+                    and getattr(self.dims[0], "name", None) else None)
+        return (key_name,
+                [n for n in self.plan.columns
+                 if not n.startswith(HLL_COL_PREFIX)
+                 and not n.startswith(HH_COL_PREFIX)],
+                self.gb.micro_batch)
 
     def _shared_device_inputs(self, sub: ColumnBatch, cols, valid, slots):
         """One device upload per column/slot vector for ALL fan-out
@@ -612,7 +635,17 @@ class FusedWindowAggNode(Node):
         if ctx is None or sub.n > mb or \
                 not getattr(self.gb, "accepts_device_inputs", False):
             return None
-        import jax.numpy as jnp
+        if not self._prep_registered:
+            # hand the upload spec to the prep ctx once: from then on the
+            # decode pool's upload stage pre-builds these device inputs and
+            # every share() below is a cache hit off the fused worker
+            self._prep_registered = True
+            reg = getattr(ctx, "register_upload", None)
+            if reg is not None:
+                reg(*self.prep_spec())
+        # canonical builders shared with the prep ctx's pool-side
+        # pre-upload (runtime/ingest.py): same keys, same bytes
+        from .ingest import pad_col_for_device, pad_slots_for_device
 
         dcols: Dict[str, Any] = {}
         dvalid: Dict[str, Any] = {}
@@ -624,34 +657,27 @@ class FusedWindowAggNode(Node):
             if src_col is None or src_col.dtype == np.object_:
                 continue
             host, vm = cols[name], valid.get(name)
-
-            def fac(host=host, vm=vm):
-                arr = np.asarray(host, dtype=np.float32)
-                if len(arr) < mb:
-                    arr = np.pad(arr, (0, mb - len(arr)))
-                dm = None
-                if vm is not None:
-                    m = vm if len(vm) == mb else np.pad(vm, (0, mb - len(vm)))
-                    dm = jnp.asarray(m)
-                return jnp.asarray(arr), dm
-
-            dv, dm = sub.share(("dcol", name, mb), fac)
+            dv, dm = sub.share(("dcol", name, mb),
+                               lambda h=host, v=vm:
+                               pad_col_for_device(h, v, mb))
             dcols[name] = dv
             if dm is not None:
                 dvalid[name] = dm
         dslots = None
         if slots is not None and self._shared_slots_ok and \
                 len(self.dims) == 1:
-            u16 = self.kt.capacity <= 65535
+            from ..ops.groupby import slot_dtype
 
-            def sfac(slots=slots):
-                s = slots
-                if len(s) < mb:
-                    s = np.pad(s, (0, mb - len(s)))
-                return jnp.asarray(s.astype(np.uint16 if u16 else np.int32))
-
+            # dtype follows the NEUTRAL table's capacity (the slots' value
+            # domain — and what the prep ctx keyed its pre-upload on, so
+            # the lookup below hits); our own kt may be pre-sized larger
+            # without invalidating a uint16 wire format
+            cap = (self._shared_nkt.capacity
+                   if self._shared_nkt is not None else self.kt.capacity)
+            u16 = slot_dtype(cap) is np.uint16
             dslots = sub.share(
-                ("dslots", self.dims[0].name, mb, u16), sfac)
+                ("dslots", self.dims[0].name, mb, u16),
+                lambda s=slots, u=u16: pad_slots_for_device(s, mb, u))
         if not dcols and dslots is None:
             return None
         return dcols, dvalid, dslots
@@ -1398,9 +1424,14 @@ class FusedWindowAggNode(Node):
         s = slots
         if pad:
             s = np.pad(s, (0, pad))
-        if self.gb.capacity <= 65535:
-            s = s.astype(np.uint16)
-        s_dev = jnp.asarray(s)
+        from ..ops.groupby import slot_dtype
+
+        # capacity here is post-grow for this batch (_build_kernel_inputs
+        # ran first), so a mid-stream doubling past 65,535 switches NEW
+        # cached entries to int32; earlier uint16 entries in _dev_ring stay
+        # valid — their slot values predate the grow (fold_masked casts)
+        s_dev = jnp.asarray(s.astype(slot_dtype(self.gb.capacity),
+                                     copy=False))
         return dev_cols, dev_valid, s_dev, dev_all
 
     @staticmethod

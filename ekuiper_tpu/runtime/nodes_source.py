@@ -40,6 +40,7 @@ class SourceNode(Node):
         decode_pool_size: int = 0,  # 0 = decode inline (no pool threads)
         decode_shards: int = 0,  # native parse shards; 0 = auto
         ring_depth: int = 2,  # decoded-batch ring depth (pool backpressure)
+        prep_upload: bool = True,  # pool workers pre-encode keys + device_put
     ) -> None:
         super().__init__(name, op_type="source", buffer_length=buffer_length)
         self.connector = connector
@@ -109,6 +110,17 @@ class SourceNode(Node):
         self._decode_shards = (int(decode_shards) if decode_shards
                                else max(self.decode_pool_size, 1))
         self._pool = None
+        # pipelined upload stage (runtime/ingest.py IngestPrepCtx): pool
+        # workers key-slot-encode each decoded batch and pre-pad +
+        # device_put its kernel inputs, so the fused worker receives
+        # device-resident refs instead of raw host columns. Only with the
+        # pool on — the decode_pool_size=0 default path stays bit-for-bit
+        # the pre-pool inline pipeline (mock-clock determinism).
+        self.prep_ctx = None
+        if self.decode_pool_size > 0 and prep_upload:
+            from .ingest import IngestPrepCtx
+
+            self.prep_ctx = IngestPrepCtx()
 
     # ------------------------------------------------------------------ ingest
     def on_open(self) -> None:
@@ -366,8 +378,40 @@ class SourceNode(Node):
                     self.decode_pool_size, self.ring_depth,
                     decode_fn=self._decode_job,
                     emit_fn=self._emit_decoded,
-                    name=self.name)
+                    name=self.name,
+                    prepare_fn=(self._prep_upload
+                                if self.prep_ctx is not None else None))
             return self._pool
+
+    def _prep_upload(self, batch: ColumnBatch) -> None:
+        """Upload stage (pool worker thread): precompute key slots + padded
+        device inputs for the batch so the fused node's upload collapses to
+        share-cache hits. Accrues to THIS node's `upload` stage — together
+        with the fused node's (now residual) `upload` timing the pipeline
+        balance stays observable per node."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        n_up = self.prep_ctx.precompute(batch)
+        if n_up:
+            self.stats.observe_stage(
+                "upload", (_time.perf_counter() - t0) * 1e6, batch.n)
+
+    def pool_depths(self):
+        """(ring occupancy, decode queue depth) for the Prometheus gauges;
+        None when no pool has started."""
+        pool = self._pool
+        if pool is None:
+            return None
+        return pool.in_flight, pool.queue_depth
+
+    def register_prep_spec(self, spec) -> None:
+        """Plan-time upload-spec registration: (key_name, columns,
+        micro_batch) from the planner, so the pool's upload stage serves
+        from the FIRST batch instead of after the fused node's first fold
+        (which also registers, covering un-plumbed paths)."""
+        if self.prep_ctx is not None:
+            self.prep_ctx.register_upload(*spec)
 
     def _dispatch_job(self, job) -> None:
         """Decode+emit one flush unit: on the decode pool when configured
@@ -410,6 +454,12 @@ class SourceNode(Node):
                              self.name, n_drop)
         self.stats.observe_stage(
             "decode", (_time.perf_counter() - t0) * 1e6, len(items))
+        if batch is not None and self.prep_ctx is not None \
+                and batch.shared_ctx is None:
+            # ride the prep ctx on the batch so downstream fused nodes
+            # consume the shared encode/upload instead of redoing them
+            batch.ensure_share_state()
+            batch.shared_ctx = self.prep_ctx
         return batch
 
     def _decode_raw_to_batch(self, raws: List[bytes],

@@ -67,43 +67,11 @@ class SubTopoRef:
         raise RuntimeError(f"cannot attach to subtopo {self.key}")
 
 
-class SharedPrepCtx:
-    """Per-subtopo shared ingest prep: N fan-out consumers of the same
-    ColumnBatch share ONE group-key encode and ONE device upload per
-    column instead of redoing them per rule (the reference shares only the
-    decoded stream, subtopo.go:38; on a bandwidth-limited accelerator the
-    per-rule re-encode + re-upload is the fan-out ceiling, so the shared
-    unit here extends through key encoding and HBM upload).
-
-    The neutral KeyTable assigns dense insertion-ordered slot ids; a
-    consumer that feeds its own KeyTable the same key sequence (via
-    keys_slice) gets identical ids, so slots computed once are valid for
-    every consumer while each node's table stays self-contained for
-    emit-time decode and checkpoints."""
-
-    def __init__(self) -> None:
-        self.lock = threading.RLock()
-        self.key_tables: Dict[str, Any] = {}
-
-    def encode(self, batch, key_name: str):
-        """(slots int32, n_keys, kt) for `key_name` over `batch`, computed
-        once per batch across all consumers."""
-        def factory():
-            import numpy as np
-
-            from ..ops.keytable import KeyTable
-
-            with self.lock:
-                kt = self.key_tables.get(key_name)
-                if kt is None:
-                    kt = self.key_tables[key_name] = KeyTable()
-                col = batch.columns.get(key_name)
-                if col is None:
-                    col = np.full(batch.n, None, dtype=np.object_)
-                slots, _ = kt.encode_column(col)
-                return slots, kt.n_keys, kt
-
-        return batch.share(("slots", key_name), factory)
+# Per-subtopo shared ingest prep — one key encode + one device upload per
+# batch for every fan-out consumer. The implementation moved to
+# runtime/ingest.py (IngestPrepCtx) when the decode pool gained the
+# pipelined upload stage; this name stays for the subtopo-facing role.
+from .ingest import IngestPrepCtx as SharedPrepCtx  # noqa: E402
 
 
 class SrcSubTopo:
@@ -117,7 +85,11 @@ class SrcSubTopo:
         self._attached: Dict[str, Tuple[Node, Any]] = {}
         self._opened = False
         self._closed = False
-        self.prep_ctx = SharedPrepCtx()
+        # adopt the source's prep ctx when it has one (prep-enabled source:
+        # its decode pool precomputes into the SAME ctx the entries attach
+        # to batches), else create the subtopo-local one as before
+        self.prep_ctx = (getattr(self.source, "prep_ctx", None)
+                         or SharedPrepCtx())
 
     @property
     def tail(self) -> Node:
@@ -145,6 +117,12 @@ class SrcSubTopo:
                 raise ValueError(f"rule {rule_id} already attached to {self.key}")
             self._attached[rule_id] = (entry, topo)
             entry.prep_ctx = self.prep_ctx  # shared fan-out ingest prep
+            # plan-time upload specs stashed on the entry reach the shared
+            # ctx here (the subtopo instance resolves only at open)
+            reg = getattr(self.prep_ctx, "register_upload", None)
+            if reg is not None:
+                for spec in getattr(entry, "prep_specs", ()):
+                    reg(*spec)
             self.tail.outputs = self.tail.outputs + [entry]  # copy-on-write
             if not self._opened:
                 # chain first, source last, so the first payload finds the
@@ -195,6 +173,12 @@ class SharedEntryNode(Node):
         self.project_columns = (set(project_columns)
                                 if project_columns is not None else None)
         self.prep_ctx = None  # set by SrcSubTopo.attach
+        self.prep_specs: List[tuple] = []  # plan-time upload specs
+
+    def register_prep_spec(self, spec) -> None:
+        """Stash a plan-time upload spec; SrcSubTopo.attach forwards it to
+        the shared prep ctx once this entry joins a live subtopo."""
+        self.prep_specs.append(spec)
 
     def process(self, item: Any) -> None:
         cols = self.project_columns

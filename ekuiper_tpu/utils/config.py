@@ -54,6 +54,12 @@ class RuleOptionConfig:
     # decoded-batch ring depth: in-flight decodes before submit blocks
     # (backpressure toward the connector)
     ingest_ring_depth: int = 2
+    # pipelined upload stage (pool-on only): decode-pool workers key-slot-
+    # encode each batch (native C table when built) and pre-pad +
+    # device_put its kernel inputs, so H2D of batch k+1 overlaps the fold
+    # of batch k and the fused worker's upload stage collapses to share-
+    # cache hits. Off = pool decodes only, fused node preps inline.
+    ingest_prep_upload: bool = True
     # HBM budget for the sliding-window device-side fold-input cache
     # (nodes_fused.py _dev_ring); oldest entries fall back to exact host
     # refolds past the cap

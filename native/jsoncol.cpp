@@ -715,9 +715,184 @@ PyObject* jc_decode(PyObject*, PyObject* args) {
   return out;
 }
 
+// ---------------------------------------------------------------------------
+// Persistent per-stream key-slot table (GROUP BY dictionary encode).
+//
+// The Python KeyTable's steady-state encode is a C-level dict map per row
+// (~7 ms per 64k batch) serialized on the fused worker thread. keytab_*
+// moves that walk into one native pass over the decoded key column: a
+// persistent byte-keyed hash table (key bytes -> dense int32 slot) plus a
+// bounded pointer-identity cache over the interned PyUnicode objects the
+// decoder emits (repeated device ids resolve by pointer hash, no byte
+// compare). Newly-seen keys return as an ordered appendix so the Python
+// KeyTable — which STAYS the source of truth for reverse decode,
+// checkpointing, and every fallback path — bulk-syncs to identical slot
+// ids. Normalization matches KeyTable._normalize: None encodes as "".
+//
+// Contract: encode(tab, keys_list) either completes fully or raises
+// WITHOUT mutating the table (non-str/None elements, lone-surrogate
+// strings -> ekjsoncol.Fallback; the caller runs the Python path).
+
+struct KeyTab {
+  std::unordered_map<StrKey, int32_t, StrKeyHash> byte_map;
+  std::deque<std::string> storage;  // owns key bytes; stable addresses
+  std::unordered_map<PyObject*, int32_t> ptr_cache;  // strong refs
+  int64_t n = 0;  // slots assigned == byte_map.size()
+
+  ~KeyTab() {
+    // capsule destructors can run during interpreter teardown, when
+    // touching refcounts is no longer safe
+    if (Py_IsInitialized()) {
+      for (auto& kv : ptr_cache) Py_DECREF(kv.first);
+    }
+  }
+};
+
+constexpr size_t kPtrCacheCap = 1u << 16;
+
+void keytab_destruct(PyObject* cap) {
+  auto* kt = (KeyTab*)PyCapsule_GetPointer(cap, "ekjsoncol.keytab");
+  delete kt;
+}
+
+KeyTab* keytab_from(PyObject* cap) {
+  return (KeyTab*)PyCapsule_GetPointer(cap, "ekjsoncol.keytab");
+}
+
+PyObject* kt_new(PyObject*, PyObject*) {
+  return PyCapsule_New(new KeyTab(), "ekjsoncol.keytab", keytab_destruct);
+}
+
+PyObject* kt_len(PyObject*, PyObject* args) {
+  PyObject* cap;
+  if (!PyArg_ParseTuple(args, "O", &cap)) return nullptr;
+  KeyTab* kt = keytab_from(cap);
+  if (kt == nullptr) return nullptr;
+  return PyLong_FromLongLong((long long)kt->n);
+}
+
+PyObject* kt_clear(PyObject*, PyObject* args) {
+  PyObject* cap;
+  if (!PyArg_ParseTuple(args, "O", &cap)) return nullptr;
+  KeyTab* kt = keytab_from(cap);
+  if (kt == nullptr) return nullptr;
+  for (auto& kv : kt->ptr_cache) Py_DECREF(kv.first);
+  kt->ptr_cache.clear();
+  kt->byte_map.clear();
+  kt->storage.clear();
+  kt->n = 0;
+  Py_RETURN_NONE;
+}
+
+PyObject* kt_encode(PyObject*, PyObject* args) {
+  PyObject* cap;
+  PyObject* seq;
+  if (!PyArg_ParseTuple(args, "OO", &cap, &seq)) return nullptr;
+  KeyTab* kt = keytab_from(cap);
+  if (kt == nullptr) return nullptr;
+  PyObject* fast = PySequence_Fast(seq, "keytab_encode expects a sequence");
+  if (fast == nullptr) return nullptr;
+  npy_intp n = (npy_intp)PySequence_Fast_GET_SIZE(fast);
+  PyObject** items = PySequence_Fast_ITEMS(fast);
+
+  // pass 1 — validate + resolve key bytes BEFORE any table mutation, so a
+  // reject leaves the table byte-identical to the Python-path history.
+  // Exact str / None only: subclasses (np.str_) or other types keep the
+  // Python dict semantics the native map can't reproduce.
+  std::vector<std::pair<const char*, Py_ssize_t>> spans((size_t)n);
+  for (npy_intp i = 0; i < n; i++) {
+    PyObject* it = items[i];
+    if (it == Py_None) {
+      spans[(size_t)i] = {"", 0};  // KeyTable._normalize: None -> ""
+      continue;
+    }
+    if (!PyUnicode_CheckExact(it)) {
+      Py_DECREF(fast);
+      PyErr_SetString(FallbackError, "non-string key");
+      return nullptr;
+    }
+    Py_ssize_t sn = 0;
+    const char* sp = PyUnicode_AsUTF8AndSize(it, &sn);
+    if (sp == nullptr) {  // lone surrogates: not UTF-8 encodable
+      PyErr_Clear();
+      Py_DECREF(fast);
+      PyErr_SetString(FallbackError, "non-encodable key");
+      return nullptr;
+    }
+    spans[(size_t)i] = {sp, sn};
+  }
+
+  PyObject* slots_arr = PyArray_SimpleNew(1, &n, NPY_INT32);
+  PyObject* appendix = PyList_New(0);
+  if (slots_arr == nullptr || appendix == nullptr) {
+    Py_XDECREF(slots_arr); Py_XDECREF(appendix); Py_DECREF(fast);
+    return nullptr;
+  }
+  int32_t* slots = (int32_t*)PyArray_DATA((PyArrayObject*)slots_arr);
+
+  // pass 2 — assign slots: pointer-identity hit (interned repeats), byte
+  // hit, or new slot + appendix entry (normalized key object).
+  bool fail = false;
+  for (npy_intp i = 0; i < n && !fail; i++) {
+    PyObject* it = items[i];
+    auto pit = kt->ptr_cache.find(it);
+    if (pit != kt->ptr_cache.end()) {
+      slots[i] = pit->second;
+      continue;
+    }
+    StrKey key{spans[(size_t)i].first, (size_t)spans[(size_t)i].second};
+    auto bit = kt->byte_map.find(key);
+    int32_t slot;
+    if (bit != kt->byte_map.end()) {
+      slot = bit->second;
+    } else {
+      slot = (int32_t)kt->n++;
+      kt->storage.emplace_back(key.p, key.n);
+      const std::string& owned = kt->storage.back();
+      kt->byte_map.emplace(StrKey{owned.data(), owned.size()}, slot);
+      // appendix carries the NORMALIZED key ("" for None, else the raw
+      // string object) in first-seen order — feeding exactly this
+      // sequence to a Python KeyTable assigns identical ids
+      if (it == Py_None) {
+        PyObject* empty = PyUnicode_FromStringAndSize("", 0);
+        if (empty == nullptr || PyList_Append(appendix, empty) < 0) {
+          Py_XDECREF(empty);
+          fail = true;
+          break;
+        }
+        Py_DECREF(empty);
+      } else if (PyList_Append(appendix, it) < 0) {
+        fail = true;
+        break;
+      }
+    }
+    slots[i] = slot;
+    if (kt->ptr_cache.size() < kPtrCacheCap) {
+      Py_INCREF(it);
+      kt->ptr_cache.emplace(it, slot);
+    }
+  }
+  Py_DECREF(fast);
+  if (fail) {
+    Py_DECREF(slots_arr);
+    Py_DECREF(appendix);
+    return nullptr;
+  }
+  PyObject* out = PyTuple_Pack(2, slots_arr, appendix);
+  Py_DECREF(slots_arr);
+  Py_DECREF(appendix);
+  return out;
+}
+
 PyMethodDef methods[] = {
     {"decode", jc_decode, METH_VARARGS,
      "decode(payloads, fields, shards=1) -> (columns, valid, bad)"},
+    {"keytab_new", kt_new, METH_NOARGS,
+     "keytab_new() -> persistent key-slot table capsule"},
+    {"keytab_encode", kt_encode, METH_VARARGS,
+     "keytab_encode(tab, keys) -> (slots int32, appendix list)"},
+    {"keytab_len", kt_len, METH_VARARGS, "keytab_len(tab) -> int"},
+    {"keytab_clear", kt_clear, METH_VARARGS, "keytab_clear(tab)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

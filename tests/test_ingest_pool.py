@@ -306,6 +306,243 @@ class TestSourceDeterminism:
         src.on_close()
 
 
+def obj_col(vals):
+    col = np.empty(len(vals), dtype=object)
+    col[:] = vals
+    return col
+
+
+def make_fused(sql="SELECT count(*) AS c, avg(temperature) AS a FROM s "
+                   "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)",
+               micro_batch=256, capacity=64):
+    from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+    from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+    from ekuiper_tpu.sql.parser import parse_select
+
+    stmt = parse_select(sql)
+    plan = extract_kernel_plan(stmt)
+    assert plan is not None
+    node = FusedWindowAggNode(
+        "f", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+        capacity=capacity, micro_batch=micro_batch)
+    node.state = node.gb.init_state()
+    return node
+
+
+class TestPrepUploadStage:
+    def test_pool0_default_path_unchanged(self):
+        src, got = make_source(0)
+        assert src.prep_ctx is None
+        src.ingest([{"count": 1}] * 10)
+        src._flush()
+        src.on_close()
+        assert got and all(b.shared_ctx is None for b in got)
+
+    def test_prep_ctx_rides_pooled_batches(self, native):
+        src, got = make_source(2)
+        assert src.prep_ctx is not None
+        src.ingest([json.dumps({"count": i}).encode() for i in range(600)])
+        src._flush()
+        src.on_close()
+        assert got and all(b.shared_ctx is src.prep_ctx for b in got)
+
+    def test_prep_upload_opt_out(self, native):
+        from ekuiper_tpu.runtime.nodes_source import SourceNode
+
+        src = SourceNode(
+            "s", connector=type("C", (), {
+                "open": lambda self, cb: None,
+                "close": lambda self: None})(),
+            schema=SCHEMA, converter=JsonConverter(),
+            decode_pool_size=2, prep_upload=False)
+        assert src.prep_ctx is None
+
+    def test_precompute_builds_fused_share_keys(self, native):
+        import jax.numpy as jnp
+
+        src, got = make_source(2, micro_batch_rows=256)
+        src.prep_ctx.register_upload("deviceId", ["temperature", "count"],
+                                     256)
+        payloads = mixed_payloads(512, seed=21)
+        src.ingest(payloads[:256])
+        src.ingest(payloads[256:])
+        src._flush()
+        src.on_close()
+        assert len(got) == 2
+        for b in got:
+            st = b.share_state
+            assert ("slots", "deviceId") in st
+            assert ("dslots", "deviceId", 256, True) in st
+            assert ("dcol", "temperature", 256) in st
+            dev, dm = st[("dcol", "temperature", 256)]
+            assert isinstance(dev, jnp.ndarray) and dev.shape == (256,)
+            dslots = st[("dslots", "deviceId", 256, True)]
+            assert dslots.dtype == jnp.uint16
+        # the upload stage accrued on the SOURCE node
+        stages = src.stats.snapshot()["stage_timings"]
+        assert "upload" in stages and stages["upload"]["calls"] >= 2
+        # slots match an independent python encode of the same columns
+        from ekuiper_tpu.ops.keytable import KeyTable
+
+        ref = KeyTable()
+        ref._native_ok = False
+        for b in got:
+            slots, n_keys, _ = b.share_state[("slots", "deviceId")]
+            ref_slots, _ = ref.encode_column(b.columns["deviceId"])
+            np.testing.assert_array_equal(slots, ref_slots)
+
+    def test_fused_node_consumes_pre_uploaded_inputs(self, native):
+        """Parity: a fused node fed prep-uploaded pooled batches computes
+        the same window state as one fed the inline (pool=0) batches, and
+        actually hits the pre-built share entries."""
+        outs = []
+        for pool in (0, 2):
+            src, got = make_source(pool, micro_batch_rows=256)
+            if src.prep_ctx is not None:
+                src.prep_ctx.register_upload(
+                    "deviceId", ["temperature", "count"], 256)
+            payloads = mixed_payloads(1024, seed=33)
+            for i in range(0, 1024, 256):  # aligned drains: 256-row batches
+                src.ingest(payloads[i:i + 256])
+            src._flush()
+            src.on_close()
+            node = make_fused()
+            for b in got:
+                prebuilt = (b.share_state is not None
+                            and ("dslots", "deviceId", 256, True)
+                            in b.share_state)
+                node.process(b)
+                if pool and b.n == 256:
+                    assert prebuilt  # the pool built it BEFORE the fold
+            assert node._shared_slots_ok is not False
+            res, act = node.gb.finalize(node.state, max(node.kt.n_keys, 1))
+            outs.append((node.kt.decode_all(),
+                         [np.asarray(r) for r in res], np.asarray(act)))
+        keys_a, res_a, act_a = outs[0]
+        keys_b, res_b, act_b = outs[1]
+        assert keys_a == keys_b
+        for ra, rb in zip(res_a, res_b):
+            np.testing.assert_array_equal(ra, rb)  # NaN-positions equal too
+        np.testing.assert_array_equal(act_a, act_b)
+
+    def test_out_of_order_pool_encode_tolerated(self):
+        """Pool workers may key-encode batch k+1 before batch k's snapshot
+        is consumed; the fused sync must tolerate its table running ahead
+        of an older snapshot instead of poisoning slot reuse."""
+        from ekuiper_tpu.data.batch import ColumnBatch
+        from ekuiper_tpu.runtime.ingest import IngestPrepCtx
+
+        ctx = IngestPrepCtx()
+        a = ColumnBatch(n=3, columns={
+            "deviceId": obj_col(["a", "b", "a"]),
+            "temperature": np.array([1, 2, 3], dtype=np.float32)},
+            emitter="s")
+        b = ColumnBatch(n=3, columns={
+            "deviceId": obj_col(["c", "a", "d"]),
+            "temperature": np.array([4, 5, 6], dtype=np.float32)},
+            emitter="s")
+        for batch in (a, b):
+            batch.ensure_share_state()
+            batch.shared_ctx = ctx
+        ctx.encode(b, "deviceId")  # pool finished the LATER batch first
+        ctx.encode(a, "deviceId")
+        node = make_fused()
+        node.process(a)  # emission order: a then b
+        node.process(b)
+        assert node._shared_slots_ok is True
+        assert node.kt.decode_all() == ["c", "a", "d", "b"]
+        res, act = node.gb.finalize(node.state, node.kt.n_keys)
+        counts = {node.kt.decode(i): int(res[0][i])
+                  for i in range(node.kt.n_keys)}
+        assert counts == {"a": 3, "b": 1, "c": 1, "d": 1}
+
+    def test_capacity_grow_flips_slot_share_key(self, monkeypatch):
+        """The grow round-trip: once the neutral table's capacity crosses
+        the slot-dtype boundary, precompute keys new uploads under
+        u16=False — in-flight uint16 pre-uploads simply miss the fused
+        lookup and are rebuilt there (never folded with a stale dtype)."""
+        import ekuiper_tpu.ops.groupby as groupby_mod
+        from ekuiper_tpu.data.batch import ColumnBatch
+        from ekuiper_tpu.ops.keytable import KeyTable
+        from ekuiper_tpu.runtime.ingest import IngestPrepCtx
+
+        monkeypatch.setattr(
+            groupby_mod, "slot_dtype",
+            lambda cap: np.uint16 if cap <= 16 else np.int32)
+        ctx = IngestPrepCtx()
+        kt = KeyTable(initial_capacity=16)
+        kt._native_ok = False
+        ctx.key_tables["deviceId"] = kt
+        ctx.register_upload("deviceId", ["temperature"], 32)
+
+        def mk(keys):
+            b = ColumnBatch(n=len(keys), columns={
+                "deviceId": obj_col(keys),
+                "temperature": np.arange(len(keys), dtype=np.float32)},
+                emitter="s")
+            b.ensure_share_state()
+            b.shared_ctx = ctx
+            return b
+
+        b1 = mk([f"k{i}" for i in range(10)])
+        ctx.precompute(b1)
+        assert ("dslots", "deviceId", 32, True) in b1.share_state
+        b2 = mk([f"n{i}" for i in range(20)])  # 30 keys > 16: capacity 32
+        ctx.precompute(b2)
+        assert kt.capacity == 32
+        assert ("dslots", "deviceId", 32, False) in b2.share_state
+        assert ("dslots", "deviceId", 32, True) not in b2.share_state
+
+    def test_pool_depth_gauges(self, native):
+        src, got = make_source(1, micro_batch_rows=256)
+        assert src.pool_depths() is None  # pool starts lazily
+        gate = threading.Event()
+        inner = src._decode_job
+
+        def slow(job):
+            gate.wait(timeout=5)
+            return inner(job)
+
+        src._ensure_pool()._decode = slow
+        src.ingest([json.dumps({"count": i}).encode() for i in range(512)])
+        time.sleep(0.05)
+        ring, queue = src.pool_depths()
+        assert ring >= 1  # submitted, not yet emitted
+        gate.set()
+        src._flush()
+        src.on_close()
+        ring, queue = src.pool_depths()
+        assert ring == 0 and queue == 0
+
+    def test_pool_gauges_render_in_prometheus(self, native):
+        from ekuiper_tpu.observability.prometheus import render
+
+        src, got = make_source(2)
+        src.ingest([json.dumps({"count": i}).encode() for i in range(600)])
+        src._flush()
+
+        class FakeTopo:
+            _live_shared = []
+
+            def all_nodes(self):
+                return [src]
+
+        class FakeState:
+            topo = FakeTopo()
+
+        class FakeReg:
+            def list(self):
+                return [{"id": "r1", "status": "running"}]
+
+            def state(self, rid):
+                return FakeState()
+
+        text = render(FakeReg())
+        assert 'kuiper_ingest_ring_depth{rule="r1",op="s"}' in text
+        assert 'kuiper_decode_pool_queue{rule="r1",op="s"}' in text
+        src.on_close()
+
+
 class TestStagePrometheus:
     def test_stage_lines_render(self):
         from ekuiper_tpu.observability.prometheus import render

@@ -1,0 +1,276 @@
+"""KeyTable coverage (round 7): sorted-fallback vs hashed-path slot parity,
+None/"" alias normalization (one slot per normalized key, regression for
+the repr-fallback double-slot bug), native-vs-Python slot parity across
+decode shard counts including the new-key appendix sync, checkpoint
+restore round-trips, and the uint16/int32 slot-dtype switch at capacity
+growth."""
+import json
+
+import numpy as np
+import pytest
+
+from ekuiper_tpu.io import fastjson
+from ekuiper_tpu.ops.groupby import slot_dtype
+from ekuiper_tpu.ops.keytable import KeyTable
+
+
+def python_table() -> KeyTable:
+    """A KeyTable pinned to the pure-Python paths (parity reference)."""
+    kt = KeyTable()
+    kt._native_ok = False
+    return kt
+
+
+@pytest.fixture(scope="module")
+def native():
+    fastjson.ensure_native(background=False)
+    mod = fastjson._load()
+    if mod is None or not fastjson.has_keytab():
+        pytest.skip("native keytab unavailable (no toolchain)")
+    return mod
+
+
+def obj_col(vals):
+    col = np.empty(len(vals), dtype=object)
+    col[:] = vals
+    return col
+
+
+class TestAliasNormalization:
+    def test_none_and_empty_share_one_slot_hashed(self):
+        kt = python_table()
+        s, _ = kt.encode_column(obj_col([None, "", "x", None]))
+        assert s[0] == s[1] == s[3]
+        assert kt.decode(int(s[0])) == ""
+
+    def test_mixed_batch_repr_fallback_no_double_slot(self):
+        """Regression: a batch with None, "" AND an unhashable element used
+        to take the blanket-repr sort fallback, storing '' under its repr
+        "''" — a later hashed batch then assigned '' a SECOND slot."""
+        kt = python_table()
+        s1, _ = kt.encode_column(obj_col([None, "", [1], "x"]))
+        assert s1[0] == s1[1]
+        s2, _ = kt.encode_column(obj_col(["", None, "x"]))
+        assert s2[0] == s2[1] == s1[0]
+        assert s2[2] == s1[3]
+        # exactly one slot exists for the normalized empty key
+        assert kt.decode_all().count("") == 1
+
+    def test_tuple_variants_share_one_slot(self):
+        kt = python_table()
+        s1, _ = kt.encode_multi([obj_col(["a", "a"]),
+                                 obj_col([None, ""])])
+        assert s1[0] == s1[1]
+        # unhashable element elsewhere routes through the _h stringify path
+        s2, _ = kt.encode_multi([obj_col(["a", "a"]),
+                                 obj_col(["", None])])
+        assert set(s2.tolist()) == {s1[0]}
+        assert kt.decode(int(s1[0])) == ("a", "")
+
+    def test_mixed_strings_keep_identity_across_paths(self):
+        """A plain string in a mixed (repr-fallback) batch must get the
+        same slot the hashed path would assign it."""
+        kt = python_table()
+        s1, _ = kt.encode_column(obj_col(["dev1", {"u": 1}]))
+        s2, _ = kt.encode_column(obj_col(["dev1"]))
+        assert s2[0] == s1[0]
+
+
+class TestSortedHashedParity:
+    def test_unicode_vs_object_same_slots(self):
+        """The same key sequence through the sorted (fixed-width unicode)
+        and hashed (object) paths assigns consistent slots."""
+        ka, kb = python_table(), python_table()
+        vals = ["b", "a", "", "b", "c", "a"]
+        sa, _ = ka.encode_column(np.array(vals, dtype="U"))
+        sb, _ = kb.encode_column(obj_col(vals))
+        # slot NUMBERING differs (sorted path assigns in sorted order) but
+        # grouping must agree and cross-path reuse must resolve
+        assert [ka.decode(int(x)) for x in sa] == vals
+        assert [kb.decode(int(x)) for x in sb] == vals
+        s2, _ = ka.encode_column(obj_col(vals))  # hashed batch, same table
+        np.testing.assert_array_equal(s2, sa)
+
+    def test_sorted_none_matches_hashed_alias(self):
+        ka = python_table()
+        sa, _ = ka.encode_column(np.array([None, "", "x"], dtype=object))
+        kb = python_table()
+        # numeric->unicode col with empty string via sorted path
+        sb1, _ = kb.encode_column(np.array(["", "x"], dtype="U"))
+        sb2, _ = kb.encode_column(obj_col([None]))
+        assert sb2[0] == sb1[0]
+        assert ka.decode(int(sa[0])) == kb.decode(int(sb2[0])) == ""
+
+
+class TestNativeParity:
+    def test_random_parity_and_appendix_sync(self, native):
+        rng = np.random.default_rng(11)
+        kn, kp = KeyTable(), python_table()
+        for batch in range(8):
+            vals = [f"dev_{int(rng.integers(0, 300))}" for _ in range(400)]
+            for i in range(0, 400, 17):
+                vals[i] = None
+            for i in range(3, 400, 41):
+                vals[i] = ""
+            col = obj_col(vals)
+            sn, gn = kn.encode_column(col)
+            sp, gp = kp.encode_column(col)
+            np.testing.assert_array_equal(sn, sp)
+            assert gn == gp
+        assert kn._ntab is not None and kn._native_ok
+        assert kn.decode_all() == kp.decode_all()
+        assert kn.capacity == kp.capacity
+
+    def test_parity_across_decode_shards(self, native):
+        """Key columns decoded with 1/2/4 native parse shards feed the
+        native slot encode; slots + appendix must be identical to the
+        Python table fed the same column."""
+        from ekuiper_tpu.data.types import DataType, Field, Schema
+
+        schema = Schema(fields=[Field("deviceId", DataType.STRING),
+                                Field("v", DataType.FLOAT)])
+        spec = fastjson.schema_field_spec(schema)
+        rng = np.random.default_rng(5)
+        payloads = []
+        for i in range(3000):
+            m = {"v": float(i)}
+            if i % 9 != 0:  # ~1/9 rows miss the key (None -> "" slot)
+                m["deviceId"] = f"d{int(rng.integers(0, 150))}"
+            payloads.append(json.dumps(m).encode())
+        ref_slots = None
+        for shards in (1, 2, 4):
+            cols, valid, bad = fastjson.decode_columns(
+                payloads, spec, shards=shards)
+            kn, kp = KeyTable(), python_table()
+            sn, _ = kn.encode_column(cols["deviceId"])
+            sp, _ = kp.encode_column(cols["deviceId"])
+            np.testing.assert_array_equal(sn, sp)
+            assert kn.decode_all() == kp.decode_all()
+            if ref_slots is None:
+                ref_slots = sn
+            else:
+                np.testing.assert_array_equal(sn, ref_slots)
+
+    def test_native_catches_up_after_python_only_batches(self, native):
+        kn, kp = KeyTable(), python_table()
+        # sorted path first (unicode col): keys enter WITHOUT the native tab
+        for kt in (kn, kp):
+            kt.encode_column(np.array(["s1", "s2"], dtype="U"))
+        sn, _ = kn.encode_column(obj_col(["s2", "new", None]))
+        sp, _ = kp.encode_column(obj_col(["s2", "new", None]))
+        np.testing.assert_array_equal(sn, sp)
+        assert kn._native_n == kn.n_keys  # mirror caught up
+
+    def test_tuple_keys_disable_mirror_without_divergence(self, native):
+        kn, kp = KeyTable(), python_table()
+        for kt in (kn, kp):
+            kt.encode_multi([obj_col(["a", "b"]), obj_col([1, None])])
+        sn, _ = kn.encode_column(obj_col(["z", "a"]))
+        sp, _ = kp.encode_column(obj_col(["z", "a"]))
+        np.testing.assert_array_equal(sn, sp)
+        assert kn._native_ok is False  # tuples can't mirror natively
+        assert kn.decode_all() == kp.decode_all()
+
+    def test_restore_roundtrip(self, native):
+        kn = KeyTable()
+        kn.encode_column(obj_col(["a", None, "b"]))
+        saved = kn.decode_all()
+        kr = KeyTable()
+        kr.restore(saved)
+        s, _ = kr.encode_column(obj_col(["b", "", "c", "a"]))
+        assert s.tolist() == [2, 1, 3, 0]
+        assert kr.decode_all() == saved + ["c"]
+
+    def test_surrogate_key_falls_back_cleanly(self, native):
+        kn, kp = KeyTable(), python_table()
+        col = obj_col(["ok", "\ud800bad", "ok"])
+        sn, _ = kn.encode_column(col)
+        sp, _ = kp.encode_column(col)
+        np.testing.assert_array_equal(sn, sp)
+        assert kn.decode_all() == kp.decode_all()
+        # and the mirror still serves later clean batches
+        sn2, _ = kn.encode_column(obj_col(["ok", "fresh"]))
+        sp2, _ = kp.encode_column(obj_col(["ok", "fresh"]))
+        np.testing.assert_array_equal(sn2, sp2)
+
+
+class TestSlotDtypeSwitch:
+    def test_boundary(self):
+        assert slot_dtype(16384) is np.uint16
+        assert slot_dtype(65535) is np.uint16
+        assert slot_dtype(65536) is np.int32
+        assert slot_dtype(131072) is np.int32
+
+    def test_fold_switches_dtype_at_growth_and_stays_exact(self):
+        """Capacity doubling past the uint16 boundary mid-stream: folds
+        before the grow ship uint16, after ship int32; per-slot counts
+        stay exact across the switch (the grow preserves partials)."""
+        from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+        from ekuiper_tpu.ops.groupby import DeviceGroupBy
+        from ekuiper_tpu.sql.parser import parse_select
+
+        stmt = parse_select(
+            "SELECT count(*) FROM s GROUP BY k, TUMBLINGWINDOW(ss, 10)")
+        plan = extract_kernel_plan(stmt)
+        gb = DeviceGroupBy(plan, capacity=65536 // 2, n_panes=1,
+                           micro_batch=64)
+        assert slot_dtype(gb.capacity) is np.uint16
+        state = gb.init_state()
+        # fold rows into slots near the top of the uint16 range
+        lo_slots = np.array([0, 1, 32766, 32767] * 16, dtype=np.int32)
+        state = gb.fold(state, {}, lo_slots, pane_idx=0)
+        # grow past the boundary (as a 65k+1-th key would force)
+        state = gb.grow(state, 65536 * 2)
+        assert slot_dtype(gb.capacity) is np.int32
+        hi_slots = np.array([0, 70000, 100000, 32767] * 16, dtype=np.int32)
+        state = gb.fold(state, {}, hi_slots, pane_idx=0)
+        outs, act = gb.finalize(state, 100001)
+        counts = outs[0]
+        assert counts[0] == 32 and counts[1] == 16
+        assert counts[32766] == 16 and counts[32767] == 32
+        assert counts[70000] == 16 and counts[100000] == 16
+
+    def test_cached_uint16_batches_refold_after_growth(self):
+        """Sliding _dev_ring scenario: pre-padded uint16 slot arrays cached
+        BEFORE a grow must refold exactly against the grown state (their
+        values predate the grow, so no invalidation is needed), alongside
+        new int32 uploads."""
+        import jax.numpy as jnp
+
+        from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+        from ekuiper_tpu.ops.groupby import DeviceGroupBy
+        from ekuiper_tpu.sql.parser import parse_select
+
+        stmt = parse_select(
+            "SELECT count(*), sum(v) FROM s "
+            "GROUP BY k, TUMBLINGWINDOW(ss, 10)")
+        plan = extract_kernel_plan(stmt)
+        mb = 32
+        gb = DeviceGroupBy(plan, capacity=65536 // 2, n_panes=2,
+                           micro_batch=mb)
+        state = gb.init_state()
+        # cached entry built while capacity allowed uint16
+        slots_a = np.arange(mb, dtype=np.int32) % 7
+        dev_a = {
+            "v": jnp.asarray(np.full(mb, 2.0, dtype=np.float32)),
+            "__valid_v": None,
+        }
+        s_dev_a = jnp.asarray(slots_a.astype(slot_dtype(gb.capacity)))
+        assert s_dev_a.dtype == jnp.uint16
+        state = gb.grow(state, 65536 * 2)  # capacity doubles past 65,536
+        # post-grow upload ships int32
+        slots_b = np.full(mb, 90000, dtype=np.int32)
+        s_dev_b = jnp.asarray(slots_b.astype(slot_dtype(gb.capacity)))
+        assert s_dev_b.dtype == jnp.int32
+        dev_b = {
+            "v": jnp.asarray(np.full(mb, 3.0, dtype=np.float32)),
+            "__valid_v": None,
+        }
+        mask = np.ones(mb, dtype=np.bool_)
+        state = gb.fold_masked(state, dev_a, s_dev_a, mask, 0)
+        state = gb.fold_masked(state, dev_b, s_dev_b, mask, 0)
+        outs, act = gb.finalize(state, 90001)
+        counts, sums = outs
+        assert counts[0] == 5 and counts[6] == 4  # 32 rows over slots 0..6
+        assert counts[90000] == mb and sums[90000] == 3.0 * mb
+        assert sums[0] == 2.0 * counts[0]

@@ -1,0 +1,34 @@
+"""Tier-1 native-decoder preflight (tools/check_native.py): the GCC-10
+class of regression — extension silently failing to build and every
+"native" path running the Python fallback — must FAIL tests, not skip
+them, wherever a toolchain exists to build with."""
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _toolchain_present() -> bool:
+    import sysconfig
+
+    if shutil.which("make") is None:
+        return False
+    if shutil.which("g++") is None and shutil.which("c++") is None:
+        return False
+    inc = sysconfig.get_paths().get("include")
+    return bool(inc and (Path(inc) / "Python.h").exists())
+
+
+def test_native_preflight_passes():
+    if not _toolchain_present():
+        pytest.skip("no native toolchain: cannot build ekjsoncol here")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_native.py")],
+        capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, (
+        "native decoder preflight FAILED — the native path is silently "
+        f"falling back to Python:\n{proc.stderr}\n{proc.stdout}")

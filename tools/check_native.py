@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Native-decoder preflight: fail LOUDLY when ekjsoncol silently falls
+back to the Python path.
+
+PR 1 found the seed's native decoder had NEVER built in-image (GCC 10
+lacks float std::to_chars) while every "native" bench phase silently ran
+the Python fallback — this class of regression must never recur unnoticed.
+The check builds the extension synchronously if needed, then proves the
+decode AND the key-slot table actually serve:
+
+  exit 0 — native decode + keytab probes passed
+  exit 1 — extension unavailable or a probe failed (details on stderr)
+
+Run standalone (`python tools/check_native.py`) or from the bench/test
+preflight (tests/test_native_preflight.py wraps it tier-1).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def check(verbose: bool = True) -> list:
+    """Returns a list of failure strings; empty = native path healthy."""
+    failures = []
+
+    def note(msg):
+        if verbose:
+            print(f"check_native: {msg}", file=sys.stderr)
+
+    from ekuiper_tpu.io import fastjson
+
+    fastjson.ensure_native(background=False)
+    mod = fastjson._load()
+    if mod is None:
+        return ["ekjsoncol extension did not build/load — the native "
+                "decode path is silently running the Python fallback"]
+
+    # decode probe: typed columns out of raw JSON, no Fallback
+    from ekuiper_tpu.data.types import DataType, Field, Schema
+
+    schema = Schema(fields=[
+        Field("k", DataType.STRING),
+        Field("v", DataType.FLOAT),
+        Field("n", DataType.BIGINT),
+    ])
+    spec = fastjson.schema_field_spec(schema)
+    payloads = [b'{"k": "a", "v": 1.5, "n": 7}',
+                b'{"k": null, "v": "2.5"}',
+                b'{"k": "a", "n": -3}']
+    out = fastjson.decode_columns(payloads, spec, shards=2)
+    if out is None:
+        failures.append("decode_columns returned None for a trivially "
+                        "decodable batch — native decode is falling back")
+    else:
+        cols, valid, bad = out
+        if bad.any():
+            failures.append(f"decode marked good payloads bad: {bad.tolist()}")
+        if cols["v"].tolist()[:2] != [1.5, 2.5]:
+            failures.append(f"decode value mismatch: {cols['v'].tolist()}")
+        if cols["k"][0] != "a" or cols["k"][0] is not cols["k"][2]:
+            failures.append("string interning broken (same value, "
+                            "different objects)")
+
+    # key-slot table probe: the persistent native encode behind
+    # KeyTable._native_encode (stale prebuilt .so lacks the API)
+    if not fastjson.has_keytab():
+        failures.append("loaded ekjsoncol lacks the keytab API — stale "
+                        "prebuilt .so; key-slot encode is falling back")
+    else:
+        import numpy as np
+
+        from ekuiper_tpu.ops.keytable import KeyTable
+
+        kt = KeyTable()
+        col = np.array(["x", None, "", "x", "y"], dtype=object)
+        slots, _ = kt.encode_column(col)
+        if kt._ntab is None or not kt._native_ok:
+            failures.append("KeyTable did not engage the native key-slot "
+                            "table for a plain string column")
+        ref = KeyTable()
+        ref._native_ok = False
+        ref_slots, _ = ref.encode_column(col)
+        if slots.tolist() != ref_slots.tolist() \
+                or kt.decode_all() != ref.decode_all():
+            failures.append(
+                f"native/python slot divergence: {slots.tolist()} vs "
+                f"{ref_slots.tolist()}")
+
+    for f in failures:
+        note(f"FAIL: {f}")
+    if not failures:
+        note("native decode + key-slot table OK")
+    return failures
+
+
+def main() -> int:
+    return 1 if check() else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
