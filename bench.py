@@ -39,6 +39,22 @@ KEY_SLOTS = 16_384
 WARMUP_BATCHES = 3
 BASELINE_MSG_S = 12_000.0
 
+# Total wall-clock budget for the WHOLE bench run. The driver wraps
+# `python bench.py` in a hard 900s timeout; r05 died to it (rc=124, no
+# artifact) because the full-pipe SUBPROCESS alone was allowed 900s. Every
+# phase budget is now capped by the remaining global budget, and a global
+# watchdog emits the final self-contained JSON just before the driver
+# would kill us.
+TOTAL_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "870"))
+_DEADLINE: list = []  # [epoch_seconds], set by main()
+
+
+def _remaining_s() -> float:
+    """Seconds left in the global budget (inf outside main())."""
+    if not _DEADLINE:
+        return float("inf")
+    return _DEADLINE[0] - time.time()
+
 # Every phase records its key metrics here via record(); the final stdout
 # JSON line carries the whole dict under "phases", so the driver artifact
 # is self-contained even when its output tail is byte-truncated
@@ -537,31 +553,65 @@ def bench_countwindow_hll_1m(kt_slots) -> None:
            rows_per_sec_incl_recompile=grow_rows / grow_s)
 
 
+def _harvest_phase_stderr(stderr, tag: str) -> bool:
+    """Re-parse a phase subprocess's stderr: merge its `#R ` record lines
+    into RESULTS (so PARTIAL progress survives a timeout/kill) and relay
+    its human `# ` lines. Returns True when the phase's own metric line
+    made it out."""
+    if isinstance(stderr, bytes):
+        stderr = stderr.decode(errors="replace")
+    lines = (stderr or "").splitlines()
+    for line in lines:
+        if line.startswith("#R "):
+            try:
+                RESULTS.update(json.loads(line[3:]))
+            except ValueError:
+                pass
+        elif line.startswith("# "):
+            print(line, file=sys.stderr)
+    return any(line.startswith(f"# {tag}") for line in lines)
+
+
 def _run_isolated(func: str, tag: str, timeout: float = 900) -> None:
     """Run a bench phase in a subprocess: phases that open+close threaded
     topos against the tunneled TPU can intermittently crash native client
-    teardown at exit — isolation keeps the headline bench process alive."""
+    teardown at exit — isolation keeps the headline bench process alive.
+
+    The subprocess rides the same per-phase watchdog discipline as the
+    in-process phases (r05 post-mortem: _full_pipe_main got the whole 900s
+    driver budget, so the DRIVER timed out first and nothing was
+    recorded): its timeout is capped by the remaining global budget, the
+    child arms its own watchdog (BENCH_CHILD_BUDGET_S) so it dies with
+    its partial records flushed, and a parent-side TimeoutExpired still
+    harvests whatever `#R ` lines the child printed before the kill."""
     import subprocess
 
+    timeout = min(timeout, max(_remaining_s() - 20.0, 0.0))
+    if timeout < 30.0:
+        print(f"# {tag}: skipped — {_remaining_s():.0f}s of global budget "
+              "left", file=sys.stderr)
+        RESULTS[f"{tag}_error"] = "skipped: global budget exhausted"
+        return
+    env = dict(os.environ)
+    env["BENCH_CHILD_BUDGET_S"] = str(int(max(timeout - 15.0, 15.0)))
     try:
         r = subprocess.run(
             [sys.executable, "-c", f"import bench; bench.{func}()"],
             cwd=os.path.dirname(os.path.abspath(__file__)),
-            capture_output=True, timeout=timeout, text=True)
-        for line in r.stderr.splitlines():
-            if line.startswith("#R "):
-                try:
-                    RESULTS.update(json.loads(line[3:]))
-                except ValueError:
-                    pass
-            elif line.startswith("# "):
-                print(line, file=sys.stderr)
-        if not any(line.startswith(f"# {tag}")
-                   for line in r.stderr.splitlines()):
+            capture_output=True, timeout=timeout, text=True, env=env)
+        if not _harvest_phase_stderr(r.stderr, tag):
             print(f"# {tag}: subprocess failed rc={r.returncode}",
                   file=sys.stderr)
+            RESULTS.setdefault(f"{tag}_error", f"subprocess rc={r.returncode}")
+    except subprocess.TimeoutExpired as exc:
+        # partial per-phase records STILL land in the artifact
+        _harvest_phase_stderr(exc.stderr, tag)
+        print(f"# {tag}: subprocess timed out after {timeout:.0f}s "
+              "(partial records harvested)", file=sys.stderr)
+        RESULTS[f"{tag}_error"] = f"timeout after {timeout:.0f}s"
     except Exception as exc:
         print(f"# {tag}: {exc}", file=sys.stderr)
+        RESULTS[f"{tag}_error"] = str(exc)
 
 
 def bench_full_pipe_ingest() -> None:
@@ -769,6 +819,14 @@ def _full_pipe_session(measure) -> None:
     from ekuiper_tpu.server.processors import StreamProcessor
     from ekuiper_tpu.store import kv
 
+    # child-side watchdog (r05 fix): the parent kills us silently at its
+    # subprocess timeout — die a little earlier WITH the partial records
+    # and a final JSON flushed, so the artifact always carries this phase
+    child_budget = float(os.environ.get("BENCH_CHILD_BUDGET_S", "0") or 0)
+    dog = PhaseWatchdog()
+    if child_budget > 0:
+        dog.arm("full_pipe_child", child_budget)
+
     mem.reset()
     from ekuiper_tpu.io import fastjson
 
@@ -822,8 +880,14 @@ def _full_pipe_session(measure) -> None:
         # return before the pipe ever ran (queues look empty), leaving
         # every compile inside the measured window. Two rounds: all 12
         # drains cover ~97% of the 10k keys, so steady-state capacity and
-        # executables are reached before timing starts.
-        warm_deadline = time.time() + 600
+        # executables are reached before timing starts. The warm window is
+        # capped HARD below the child budget (no floor that could swallow
+        # it): the measured segment must start before the watchdog fires,
+        # even if that means measuring with compiles still warm.
+        warm_s = 600.0
+        if child_budget > 0:
+            warm_s = min(warm_s, max(child_budget - 45.0, 5.0))
+        warm_deadline = time.time() + warm_s
         for _ in range(2):
             for d in drains:
                 src.ingest(d)
@@ -858,10 +922,44 @@ def _full_pipe_session(measure) -> None:
 
         dec = ("native" if src._fast_spec is not None
                and fastjson._load() is not None else "python")
-        measure(run_segment, src, dec, fused)
+        measure(run_segment, src, dec, fused, topo)
     finally:
+        dog.disarm()
         topo.close()
         mem.reset()
+
+
+def _hist_overhead(fused) -> dict:
+    """Measured cost of the histogram hot path against the fused fold —
+    the acceptance number behind 'histograms add <1% to the fold'. The
+    fold path gained exactly: one queue-wait record + one process-latency
+    record per dispatched batch (observability/histogram.py O(1) record),
+    so overhead = 2 x record cost / per-batch fold time."""
+    from ekuiper_tpu.observability.histogram import LatencyHistogram
+
+    h = LatencyHistogram()
+    n = 100_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        h.record(i & 0xFFFFF)
+    per_record_us = (time.perf_counter() - t0) * 1e6 / n
+    st = fused.stats.snapshot()["stage_timings"].get("fold")
+    fold_us = (st["total_us"] / max(st["calls"], 1)) if st else 0.0
+    pct = (100.0 * 2 * per_record_us / fold_us) if fold_us else None
+    return {"record_us": round(per_record_us, 3),
+            "fold_us_per_call": round(fold_us, 1),
+            "pct_of_fold": round(pct, 3) if pct is not None else None}
+
+
+def _e2e_fields(topo) -> dict:
+    """SLO fields for the artifact: the rule's ingest→emit distribution
+    (runtime/topo.py e2e_hist, fed by the sink) as p50/p99 ms."""
+    h = topo.e2e_hist
+    if h.count == 0:
+        return {"e2e_p50_ms": None, "e2e_p99_ms": None, "e2e_samples": 0}
+    return {"e2e_p50_ms": float(h.percentile(50)),
+            "e2e_p99_ms": float(h.percentile(99)),
+            "e2e_samples": h.count}
 
 
 def _full_pipe_main() -> None:
@@ -869,13 +967,19 @@ def _full_pipe_main() -> None:
     MQTT+decode pipeline, README.md:98; kernel-fed numbers skip ingest,
     this line does not). Prints a stderr metric line."""
 
-    def measure(run_segment, src, dec, fused):
+    def measure(run_segment, src, dec, fused, topo):
+        # warm-up emissions (jit-stall dwells) must not pollute the SLO
+        # fields: the measured segment starts from an empty distribution
+        topo.e2e_hist.snapshot_and_decay(0.0)
         rows, byts, elapsed = run_segment(10.0)
+        e2e = _e2e_fields(topo)
         print(
             f"# full-pipe ingest (json bytes → decode[{dec}] → coerce → "
             f"fused window, real topo): {rows:,} rows / {byts / 1e6:.0f}MB "
             f"in {elapsed:.2f}s ({rows / elapsed:,.0f} rows/s, "
-            f"{byts / elapsed / 1e6:.1f}MB/s bytes-in)",
+            f"{byts / elapsed / 1e6:.1f}MB/s bytes-in); ingest→emit "
+            f"p50={e2e['e2e_p50_ms']}ms p99={e2e['e2e_p99_ms']}ms over "
+            f"{e2e['e2e_samples']} window emits",
             file=sys.stderr,
         )
         prep = src.prep_ctx
@@ -883,8 +987,10 @@ def _full_pipe_main() -> None:
                mb_per_sec=byts / elapsed / 1e6, decoder=dec,
                pool=src.decode_pool_size, shards=src._decode_shards,
                prep_batches=(prep.n_precomputed if prep else 0),
+               hist_overhead=_hist_overhead(fused),
                stages={"source": _stage_summary(src),
-                       "fused": _stage_summary(fused)})
+                       "fused": _stage_summary(fused)},
+               **e2e)
 
     _full_pipe_session(measure)
 
@@ -912,7 +1018,7 @@ def _full_pipe_contended_main() -> None:
     import os as _os
     import tempfile
 
-    def measure(run_segment, src, dec, fused):
+    def measure(run_segment, src, dec, fused, topo):
         rows, byts, elapsed = run_segment(10.0)
         idle = rows / elapsed
         n_burn = max(2, (_os.cpu_count() or 4) // 2)
@@ -926,6 +1032,8 @@ def _full_pipe_contended_main() -> None:
             b.start()
         try:
             time.sleep(0.5)  # burners reach steady spin before the segment
+            # e2e fields report the LOADED segment only (the phase's claim)
+            topo.e2e_hist.snapshot_and_decay(0.0)
             rows, byts, elapsed = run_segment(10.0)
         finally:
             with open(stop_path, "w"):
@@ -950,7 +1058,8 @@ def _full_pipe_contended_main() -> None:
                pool=src.decode_pool_size, shards=src._decode_shards,
                prep_batches=(prep.n_precomputed if prep else 0),
                stages={"source": _stage_summary(src),
-                       "fused": _stage_summary(fused)})
+                       "fused": _stage_summary(fused)},
+               **_e2e_fields(topo))
 
     _full_pipe_session(measure)
 
@@ -1296,6 +1405,14 @@ class PhaseWatchdog:
 
 
 def main() -> None:
+    # global budget: the driver hard-kills `python bench.py` (rc=124, no
+    # artifact) — phase budgets are carved from TOTAL_BUDGET_S and a
+    # last-resort watchdog emits the final JSON with whatever was recorded
+    # just before that outer timeout would hit
+    _DEADLINE.clear()
+    _DEADLINE.append(time.time() + TOTAL_BUDGET_S)
+    global_dog = PhaseWatchdog()
+    global_dog.arm("total_budget", TOTAL_BUDGET_S - 10.0)
     # tunnel health gate: a dead tunnel short-circuits to a self-contained
     # failure artifact instead of burning subprocess timeouts and hanging
     # at first in-process jax use
@@ -1328,6 +1445,12 @@ def main() -> None:
         ("event_time", 600.0, lambda: bench_event_time(batches, KEY_SLOTS)),
         ("rule_group", 600.0, lambda: bench_rule_group(batches, KEY_SLOTS)),
     ):
+        budget_s = min(budget_s, max(_remaining_s() - 15.0, 0.0))
+        if budget_s < 20.0:
+            print(f"# {name}: skipped — global budget exhausted",
+                  file=sys.stderr)
+            RESULTS[f"{name}_error"] = "skipped: global budget exhausted"
+            continue
         dog.arm(name, budget_s)
         try:
             out = fn()
@@ -1339,6 +1462,7 @@ def main() -> None:
         finally:
             dog.disarm()
 
+    global_dog.disarm()
     _final_json(rows_per_sec)
 
 

@@ -44,6 +44,10 @@ class ColumnBatch:
     # SharedEntryNode carry these references so all riders hit one cache.
     shared_ctx: Any = None
     share_state: Optional[Dict[Any, Any]] = None
+    # ingest wall time (engine clock, ms) of the batch's oldest row —
+    # stamped at the source, carried through every hop so emit/sink nodes
+    # can record true ingest→emit latency (observability/histogram.py)
+    ingest_ms: Optional[int] = None
 
     # unannotated -> a plain class attribute, not a dataclass field
     _SHARE_INIT_LOCK = _threading.Lock()
@@ -106,6 +110,7 @@ class ColumnBatch:
             valid={k: v[idx] for k, v in self.valid.items()},
             timestamps=None if self.timestamps is None else self.timestamps[idx],
             emitter=self.emitter,
+            ingest_ms=self.ingest_ms,
         )
 
     def to_tuples(self) -> List[Tuple]:
@@ -175,9 +180,11 @@ class ColumnBatch:
         ts = None
         if all(b.timestamps is not None for b in batches):
             ts = np.concatenate([b.timestamps for b in batches])
+        ings = [b.ingest_ms for b in batches if b.ingest_ms is not None]
         return ColumnBatch(
             n=n_total, columns=columns, valid=valid, timestamps=ts,
             emitter=batches[0].emitter,
+            ingest_ms=min(ings) if ings else None,
         )
 
 

@@ -112,6 +112,10 @@ def encode_span(span) -> bytes:
     for k, v in (("rule", span.rule_id), ("op", span.op),
                  ("item.kind", span.kind), ("item.rows", span.rows)):
         out += _ld(9, _kv(k, v))
+    # extra span attributes (e.g. the sink's end-to-end e2e_ms latency) —
+    # absent on the common span, so legacy encodings are byte-identical
+    for k, v in (getattr(span, "attrs", None) or {}).items():
+        out += _ld(9, _kv(str(k), v))
     return out
 
 

@@ -2,26 +2,53 @@
 (analogue of metrics/metrics.go:64-88 + internal/server/prome_init.go).
 
 No client library: the text format is lines of
-`name{labels} value` with `# TYPE` headers — rendered directly from the
-rules' StatManagers on each scrape, so there is no second bookkeeping
-system to keep in sync (the reference wires its StatManager into
-promauto gauges the same way)."""
+`name{labels} value` with `# TYPE`/`# HELP` headers — rendered directly
+from the rules' StatManagers on each scrape, so there is no second
+bookkeeping system to keep in sync (the reference wires its StatManager
+into promauto gauges the same way).
+
+Every metric family carries a HELP line and is cataloged in
+docs/OBSERVABILITY.md; tools/check_metrics.py lints that invariant from
+the tier-1 suite. Nodes owned by a SHARED subtopo (one physical source
+serving N rules) are emitted exactly once, under rule="__shared__" —
+per-rule emission double-counted their records_*_total in any PromQL sum.
+"""
 from __future__ import annotations
 
 import time
 from typing import Any, Dict, List, Tuple
 
+from .histogram import E2E_BOUNDS_MS, render_prom_histogram
+
 _STATE_VALUES = {"running": 1, "stopped": 0}
 
+#: (metric name == StatManager snapshot key, help) — values come off the
+#: per-node snapshot taken once per scrape, so every line of one node is
+#: a consistent cut
 _COUNTERS = (
-    ("records_in_total", "records_in"),
-    ("records_out_total", "records_out"),
-    ("exceptions_total", "exceptions"),
+    ("records_in_total", "items received by the op"),
+    ("records_out_total", "items emitted by the op"),
+    ("exceptions_total", "per-item errors swallowed by the op"),
 )
 _GAUGES = (
-    ("buffer_length", "buffer_length"),
-    ("process_latency_us", "process_latency_us"),
+    ("buffer_length", "input queue occupancy"),
+    ("process_latency_us", "last dispatch latency (engine clock, us)"),
 )
+_STAGES = (
+    ("stage_us_total", "total_us", "cumulative wall time per pipeline stage"),
+    ("stage_calls_total", "calls", "invocations per pipeline stage"),
+    ("stage_rows_total", "rows", "rows handled per pipeline stage"),
+)
+#: per-op latency-distribution quantiles exported per scrape — keys into
+#: the StatManager snapshot's histogram summaries (computed once per node
+#: per scrape, reused here instead of re-scanning the histograms). Label
+#: name is `q`, NOT the reserved `quantile` (promtool flags that label on
+#: anything but summary-typed metrics).
+_QUANTILES = (("p50", "0.5"), ("p90", "0.9"), ("p99", "0.99"))
+
+#: rule label shared nodes are emitted under (matches the subtopo's
+#: rule context, runtime/subtopo.py _FanoutTopoShim)
+SHARED_RULE_LABEL = "__shared__"
 
 _START_TIME = time.time()
 
@@ -30,12 +57,18 @@ def _esc(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", " ")
 
 
+def _family(out: List[str], name: str, mtype: str, help_txt: str) -> None:
+    out.append(f"# TYPE {name} {mtype}")
+    out.append(f"# HELP {name} {help_txt}")
+
+
 def render(rule_registry) -> str:
     """Scrape callback: rule states + every node's StatManager."""
     out: List[str] = []
-    out.append("# TYPE kuiper_rule_status gauge")
-    out.append("# HELP kuiper_rule_status 1 running, 0 stopped")
+    _family(out, "kuiper_rule_status", "gauge", "1 running, 0 stopped")
     rows: List[Tuple[str, Any]] = []
+    shared_nodes: Dict[int, Any] = {}  # id(node) -> node, emitted ONCE
+    e2e_rows: List[Tuple[str, Any]] = []  # (rule_id, LatencyHistogram)
     for entry in rule_registry.list():
         rule_id = entry["id"]
         out.append(
@@ -46,38 +79,53 @@ def render(rule_registry) -> str:
         if topo is not None:
             for node in topo.all_nodes():
                 rows.append((rule_id, node))
-            for subtopo, _ in topo._live_shared:
+            for subtopo, _ in topo.live_shared():
                 for node in subtopo.nodes:
-                    rows.append((rule_id, node))
-    for mname, attr in _COUNTERS:
-        out.append(f"# TYPE kuiper_op_{mname} counter")
-        for rule_id, node in rows:
-            out.append(
-                f'kuiper_op_{mname}{{rule="{_esc(rule_id)}",'
-                f'op="{_esc(node.name)}",type="{_esc(node.op_type)}"}} '
-                f"{getattr(node.stats, attr)}")
-    for mname, attr in _GAUGES:
-        out.append(f"# TYPE kuiper_op_{mname} gauge")
-        for rule_id, node in rows:
-            out.append(
-                f'kuiper_op_{mname}{{rule="{_esc(rule_id)}",'
-                f'op="{_esc(node.name)}",type="{_esc(node.op_type)}"}} '
-                f"{getattr(node.stats, attr)}")
-    # per-stage pipeline timings (decode/upload/fold): the ingest-pipeline
-    # balance — which stage a node's wall time goes to — read straight off
-    # the StatManagers' stage accounting
+                    shared_nodes.setdefault(id(node), node)
+            e2e_rows.append((rule_id, topo.e2e_hist))
+    rows.extend((SHARED_RULE_LABEL, node) for node in shared_nodes.values())
+    snaps = [(rule_id, node, node.stats.snapshot()) for rule_id, node in rows]
+
+    def op_labels(rule_id: str, node: Any) -> str:
+        return (f'rule="{_esc(rule_id)}",op="{_esc(node.name)}",'
+                f'type="{_esc(node.op_type)}"')
+
+    for mname, help_txt in _COUNTERS:
+        _family(out, f"kuiper_op_{mname}", "counter", help_txt)
+        for rule_id, node, snap in snaps:
+            out.append(f"kuiper_op_{mname}{{{op_labels(rule_id, node)}}} "
+                       f"{snap[mname]}")
+    for mname, help_txt in _GAUGES:
+        _family(out, f"kuiper_op_{mname}", "gauge", help_txt)
+        for rule_id, node, snap in snaps:
+            out.append(f"kuiper_op_{mname}{{{op_labels(rule_id, node)}}} "
+                       f"{snap[mname]}")
+    # per-op latency DISTRIBUTIONS (observability/histogram.py): dispatch
+    # busy time and input-queue wait as quantile gauges — the per-op view
+    # of the tail the e2e histogram aggregates per rule
+    for mname, snap_key, help_txt in (
+            ("process_latency_quantile_us", "process_latency_us_hist",
+             "dispatch busy-time percentile (us, log-bucketed histogram)"),
+            ("queue_wait_quantile_us", "queue_wait_us_hist",
+             "input-queue wait percentile (us, log-bucketed histogram)")):
+        _family(out, f"kuiper_op_{mname}", "gauge", help_txt)
+        for rule_id, node, snap in snaps:
+            summary = snap[snap_key]
+            for key, qlabel in _QUANTILES:
+                out.append(
+                    f"kuiper_op_{mname}{{{op_labels(rule_id, node)},"
+                    f'q="{qlabel}"}} {summary[key]}')
+    # per-stage pipeline timings (decode/ring/upload/fold): the ingest-
+    # pipeline balance — which stage a node's wall time goes to — read
+    # straight off the StatManagers' stage accounting
     stage_rows = [(rule_id, node, stage, st)
-                  for rule_id, node in rows
-                  for stage, st in
-                  node.stats.snapshot()["stage_timings"].items()]
-    for mname, key in (("stage_us_total", "total_us"),
-                       ("stage_calls_total", "calls"),
-                       ("stage_rows_total", "rows")):
-        out.append(f"# TYPE kuiper_op_{mname} counter")
+                  for rule_id, node, snap in snaps
+                  for stage, st in snap["stage_timings"].items()]
+    for mname, key, help_txt in _STAGES:
+        _family(out, f"kuiper_op_{mname}", "counter", help_txt)
         for rule_id, node, stage, st in stage_rows:
             out.append(
-                f'kuiper_op_{mname}{{rule="{_esc(rule_id)}",'
-                f'op="{_esc(node.name)}",type="{_esc(node.op_type)}",'
+                f"kuiper_op_{mname}{{{op_labels(rule_id, node)},"
                 f'stage="{_esc(stage)}"}} {st[key]}')
     # ingest-pipeline occupancy: ring depth (decoded batches awaiting their
     # emission turn) and decode-queue depth (jobs awaiting a worker) per
@@ -96,13 +144,22 @@ def render(rule_registry) -> str:
              "decoded batches in the ordered ring (submitted, not emitted)"),
             ("decode_pool_queue", 1,
              "decode jobs waiting for a pool worker")):
-        out.append(f"# TYPE kuiper_{mname} gauge")
-        out.append(f"# HELP kuiper_{mname} {help_txt}")
+        _family(out, f"kuiper_{mname}", "gauge", help_txt)
         for rule_id, node, depths in pool_rows:
             out.append(
                 f'kuiper_{mname}{{rule="{_esc(rule_id)}",'
                 f'op="{_esc(node.name)}"}} {depths[idx]}')
-    out.append("# TYPE kuiper_uptime_seconds gauge")
+    # the SLO headline: per-rule ingest→emit latency as a real Prometheus
+    # histogram (_bucket/_sum/_count with le labels) — histogram_quantile()
+    # over it answers "is p99 emit under 50ms" directly
+    _family(out, "kuiper_rule_e2e_latency_ms", "histogram",
+            "ingest->emit end-to-end latency per rule (ms)")
+    for rule_id, hist in e2e_rows:
+        render_prom_histogram(
+            out, "kuiper_rule_e2e_latency_ms", f'rule="{_esc(rule_id)}"',
+            hist, E2E_BOUNDS_MS)
+    _family(out, "kuiper_uptime_seconds", "gauge",
+            "seconds since engine start")
     out.append(f"kuiper_uptime_seconds {time.time() - _START_TIME:.1f}")
     return "\n".join(out) + "\n"
 

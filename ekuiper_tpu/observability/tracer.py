@@ -18,7 +18,7 @@ import itertools
 import threading
 import time
 import weakref
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
 _local = threading.local()
@@ -26,10 +26,10 @@ _local = threading.local()
 
 class Span:
     __slots__ = ("trace_id", "span_id", "parent_id", "rule_id", "op",
-                 "start_ms", "duration_us", "kind", "rows")
+                 "start_ms", "duration_us", "kind", "rows", "attrs")
 
     def __init__(self, trace_id, span_id, parent_id, rule_id, op, start_ms,
-                 duration_us, kind, rows) -> None:
+                 duration_us, kind, rows, attrs=None) -> None:
         self.trace_id = trace_id
         self.span_id = span_id
         self.parent_id = parent_id
@@ -39,15 +39,21 @@ class Span:
         self.duration_us = duration_us
         self.kind = kind
         self.rows = rows
+        # extra key→value span attributes (e.g. the sink's e2e_ms latency);
+        # None for the common attribute-less span
+        self.attrs = attrs
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "traceId": self.trace_id, "spanId": self.span_id,
             "parentSpanId": self.parent_id, "rule": self.rule_id,
             "op": self.op, "startTimeMs": self.start_ms,
             "durationUs": self.duration_us, "kind": self.kind,
             "rows": self.rows,
         }
+        if self.attrs:
+            out["attributes"] = dict(self.attrs)
+        return out
 
 
 class Tracer:
@@ -64,9 +70,15 @@ class Tracer:
         # trace propagation across queue hops: emitted items are tagged with
         # the emitting dispatch's trace id, keyed by id() with a weakref
         # cleanup (many item types — dataclasses with eq — are unhashable,
-        # so WeakKeyDictionary can't hold them); non-weakref-able items
-        # (plain lists/dicts) fall back to the receiver's current trace
+        # so WeakKeyDictionary can't hold them)
         self._item_traces: Dict[int, tuple] = {}
+        # non-weakref-able items (plain lists/dicts — e.g. multi-row project
+        # output) can't register a cleanup callback, so they live in a
+        # BOUNDED insertion-ordered map with explicit oldest-first eviction.
+        # id() reuse after gc can mis-associate a stale entry with a new
+        # object; the map is small and short-lived, and a wrong trace id on
+        # one span is a telemetry blemish, not a correctness issue.
+        self._fallback_traces: "OrderedDict[int, str]" = OrderedDict()
         # optional remote tee (observability/otlp.py) — every span the local
         # store admits is also handed to the exporter, mirroring the
         # reference's dual local+OTLP export (pkg/tracer/manager.go:62-76)
@@ -121,6 +133,9 @@ class Tracer:
     def set_current(trace_id: Optional[str]) -> None:
         _local.trace_id = trace_id
 
+    #: bounded size of the non-weakref-able item→trace fallback map
+    FALLBACK_CAP = 4096
+
     def tag(self, item: Any) -> None:
         tid = self.current_trace()
         if tid is None:
@@ -130,20 +145,30 @@ class Tracer:
             ref = weakref.ref(
                 item, lambda _r, k=key: self._item_traces.pop(k, None))
         except TypeError:
-            return  # not weakref-able (plain list/dict)
+            # not weakref-able (plain list/dict): bounded fallback map so
+            # the trace still survives the queue hop to the next node
+            with self._lock:
+                self._fallback_traces[key] = tid
+                self._fallback_traces.move_to_end(key)
+                while len(self._fallback_traces) > self.FALLBACK_CAP:
+                    self._fallback_traces.popitem(last=False)
+            return
         self._item_traces[key] = (ref, tid)
 
     def lookup(self, item: Any) -> Optional[str]:
         got = self._item_traces.get(id(item))
         if got is not None and got[0]() is item:
             return got[1]
+        tid = self._fallback_traces.get(id(item))
+        if tid is not None:
+            return tid
         return None
 
     def record(self, rule_id: str, op: str, start_ms: int, duration_us: int,
-               kind: str, rows: int) -> None:
+               kind: str, rows: int, attrs: Optional[dict] = None) -> None:
         trace_id = self.current_trace() or self.new_trace()
         span = Span(trace_id, f"s{next(self._ids):08x}", "", rule_id, op,
-                    start_ms, duration_us, kind, rows)
+                    start_ms, duration_us, kind, rows, attrs=attrs)
         with self._lock:
             if self._enabled.get(rule_id) == "head":
                 # head sampling: bound recording rate on hot rules

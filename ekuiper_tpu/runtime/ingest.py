@@ -57,12 +57,20 @@ class DecodePool:
 
     def __init__(self, size: int, ring_depth: int, decode_fn: Callable,
                  emit_fn: Callable, name: str = "ingest",
-                 prepare_fn: Optional[Callable] = None) -> None:
+                 prepare_fn: Optional[Callable] = None,
+                 stats=None) -> None:
         self.size = max(1, int(size))
         self.ring_depth = max(1, int(ring_depth))
         self._decode = decode_fn
         self._emit = emit_fn
         self._prepare = prepare_fn
+        # optional StatManager: the drainer accrues each job's
+        # decoded→emitted dwell to a "ring" stage — time a READY result
+        # waited for its emission turn (stamping at submit would fold the
+        # decode work, already accrued to "decode", in again and misstate
+        # the pipeline balance)
+        self._stats = stats
+        self._ready_ts: Dict[int, float] = {}  # seq -> result-deposit time
         self._lock = threading.Lock()
         self._job_ready = threading.Condition(self._lock)
         self._slot_free = threading.Condition(self._lock)
@@ -159,6 +167,8 @@ class DecodePool:
         order stays total."""
         with self._lock:
             self._results[seq] = result
+            if self._stats is not None:
+                self._ready_ts[seq] = _time.perf_counter()
             if self._emitting or self._emit_seq not in self._results:
                 return
             self._emitting = True
@@ -168,7 +178,12 @@ class DecodePool:
                     self._emitting = False
                     return
                 head = self._results.pop(self._emit_seq)
+                t_ready = self._ready_ts.pop(self._emit_seq, None)
                 self._emit_seq += 1
+            if t_ready is not None and self._stats is not None:
+                self._stats.observe_stage(
+                    "ring", (_time.perf_counter() - t_ready) * 1e6,
+                    getattr(head, "n", 0) if head is not None else 0)
             try:
                 if head is not None:
                     if self._prepare is not None:

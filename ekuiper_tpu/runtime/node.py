@@ -13,6 +13,7 @@ from __future__ import annotations
 import queue
 import threading
 import time as _time
+from collections import deque
 from typing import Any, Callable, List, Optional
 
 from ..observability.tracer import Tracer, item_stats
@@ -20,6 +21,52 @@ from ..utils.infra import logger, safe_run
 from ..utils.metrics import StatManager
 from ..utils.timex import now_ms as timex_now_ms
 from .events import EOF, Barrier, ErrorEvent, PreTrigger, Trigger, Watermark
+
+
+#: per-thread ingest-provenance override for emissions delivered OFF the
+#: dispatch thread (the fused node's async emit worker): the issuing
+#: dispatch captures its provenance into the emit queue and the worker
+#: installs it here for the delivery — reading the node's live
+#: _cur_ingest_ms from the worker would stamp window results with batches
+#: folded AFTER the boundary, under-reporting e2e exactly when emission
+#: is slow
+_emit_ctx = threading.local()
+
+#: distinct "no override installed" marker: None is a VALID override value
+#: (issue-time provenance was absent — the delivery must then stamp
+#: nothing, not fall back to the live _cur_ingest_ms it was shielding
+#: against)
+_NO_OVERRIDE = object()
+
+
+def _item_ingest_ms(item: Any) -> Optional[int]:
+    """Ingest timestamp riding an item, if any. Bare lists (multi-row
+    project output) can't carry attributes, so their first element speaks
+    for the emission — rows of one emission share provenance."""
+    ing = getattr(item, "ingest_ms", None)
+    if ing is None and type(item) is list and item:
+        ing = getattr(item[0], "ingest_ms", None)
+    return ing
+
+
+def _stamp_ingest_ms(item: Any, ing: int) -> None:
+    """Attach the ingest timestamp to an outgoing item when it can hold
+    one (dataclasses take ad-hoc attributes; list elements are stamped
+    individually; bytes/str/dict silently can't — their e2e sample is
+    recorded at the last attributable hop)."""
+    try:
+        if getattr(item, "ingest_ms", None) is None:
+            item.ingest_ms = ing
+        return
+    except (AttributeError, TypeError):
+        pass
+    if type(item) is list:
+        for x in item:
+            try:
+                if getattr(x, "ingest_ms", None) is None:
+                    x.ingest_ms = ing
+            except (AttributeError, TypeError):
+                return  # homogeneous lists: first failure ends the walk
 
 
 class _Tagged:
@@ -62,6 +109,19 @@ class Node:
         # the aligner can hold back per edge; below that, only barriers are
         # tagged (skips a per-item envelope allocation on the hot path)
         self._tag_data = False
+        # queue-wait telemetry: enqueue perf timestamps, FIFO-paired with
+        # the input queue (same order; deque ops are GIL-atomic). close()'s
+        # wake sentinel bypasses put(), so pairing can skew by one at
+        # shutdown — telemetry-grade, guarded by emptiness checks.
+        self._enq_times: deque = deque()
+        # ingest→emit provenance: the most recent ingest timestamp (ms,
+        # engine clock) seen on a dispatched item. emit() stamps it onto
+        # outgoing items so sinks can record true end-to-end latency even
+        # for window emissions that happen on trigger/worker dispatches.
+        self._cur_ingest_ms: Optional[int] = None
+        # span attributes for the CURRENT dispatch (set by subclasses,
+        # e.g. the sink's e2e latency), attached to the recorded span
+        self._span_attrs: Optional[dict] = None
 
     # ------------------------------------------------------------------ wiring
     def connect(self, downstream: "Node") -> "Node":
@@ -73,6 +133,10 @@ class Node:
     def put(self, item: Any, from_name: Optional[str] = None) -> None:
         """Enqueue with drop-oldest on overflow (node.go:140-196)."""
         entry = _Tagged(item, from_name) if from_name is not None else item
+        # enqueue-clock appended BEFORE the queue insert: the worker may
+        # dequeue the instant the item lands, and a missing time would
+        # orphan the FIFO pairing for every later item
+        self._enq_times.append(_time.perf_counter())
         if self.disable_buffer_full_discard:
             self.inq.put(entry)
             return
@@ -84,10 +148,20 @@ class Node:
                 try:
                     dropped = self.inq.get_nowait()
                     self.inq.task_done()  # dropped items count as handled
+                    if self._enq_times:
+                        self._enq_times.popleft()  # its wait sample goes too
                     self.stats.inc_exception("buffer full, dropped oldest")
                     logger.debug("%s: buffer full, dropped %r", self.name, type(dropped))
                 except queue.Empty:
                     continue
+
+    def put_control(self, item: Any) -> None:
+        """Enqueue a control event (window trigger, session timer) —
+        BLOCKING, never subject to drop-oldest — while keeping the
+        queue-wait clock FIFO-paired with the queue (a bare inq.put would
+        desync every later wait sample)."""
+        self._enq_times.append(_time.perf_counter())
+        self.inq.put(item)
 
     def send_to(self, out: "Node", item: Any) -> None:
         """Single place encoding the sender-tagging contract: barriers are
@@ -145,6 +219,13 @@ class Node:
                     entry = self.inq.get(timeout=0.2)
                 except queue.Empty:
                     continue
+                if self._enq_times:
+                    try:
+                        self.stats.observe_queue_wait(
+                            (_time.perf_counter()
+                             - self._enq_times.popleft()) * 1e6)
+                    except IndexError:
+                        pass  # raced another consumer draining at close
                 try:
                     if entry is None:
                         continue
@@ -201,6 +282,12 @@ class Node:
                 tracer.new_trace()
             t0 = _time.monotonic()
         self._tracing_now = traced
+        ing = _item_ingest_ms(item)
+        if ing is not None:
+            # keep the LAST seen provenance (not reset on control events):
+            # window emissions fire on trigger dispatches, where the freshest
+            # contributing batch's ingest time is exactly the right stamp
+            self._cur_ingest_ms = ing
         self.stats.inc_in()
         self.stats.process_begin()
         try:
@@ -222,9 +309,11 @@ class Node:
             self.stats.process_end()
             if traced:
                 kind, rows = item_stats(item)
+                attrs, self._span_attrs = self._span_attrs, None
                 tracer.record(
                     self._topo.rule_id, self.name, timex_now_ms(),
-                    int((_time.monotonic() - t0) * 1e6), kind, rows)
+                    int((_time.monotonic() - t0) * 1e6), kind, rows,
+                    attrs=attrs)
                 self._tracing_now = False
 
     # ------------------------------------------------------------- overridables
@@ -346,6 +435,11 @@ class Node:
     def emit(self, item: Any, count: int = 1) -> None:
         if getattr(self, "_tracing_now", False):
             Tracer.global_instance().tag(item)  # trace follows the item
+        ing = getattr(_emit_ctx, "ingest_ms", _NO_OVERRIDE)
+        if ing is _NO_OVERRIDE:
+            ing = self._cur_ingest_ms
+        if ing is not None:
+            _stamp_ingest_ms(item, ing)  # provenance follows the item too
         self.stats.inc_out(count)
         self.broadcast(item)
 

@@ -500,7 +500,7 @@ class FusedWindowAggNode(Node):
         interval = self._tick_interval()
         next_end = timex.align_to_window(now + 1, interval)
         self._timer = timex.after(
-            next_end - now, lambda ts: self.inq.put(Trigger(ts=ts))
+            next_end - now, lambda ts: self.put_control(Trigger(ts=ts))
         )
         if self._prefinalize_ok:
             # two chances per boundary: the 2x-lead pre-issue covers tunnel
@@ -511,7 +511,7 @@ class FusedWindowAggNode(Node):
                 if next_end - now > k * lead:
                     self._pre_timers.append(timex.after(
                         next_end - now - k * lead,
-                        lambda ts, end=next_end: self.inq.put(PreTrigger(ts=end)),
+                        lambda ts, end=next_end: self.put_control(PreTrigger(ts=end)),
                     ))
 
     # ------------------------------------------------------------------- data
@@ -1047,7 +1047,7 @@ class FusedWindowAggNode(Node):
                 sid = self._session_id
                 self._cap_timer = timex.after(
                     self.length_ms,
-                    lambda ts, _s=sid: self.inq.put(
+                    lambda ts, _s=sid: self.put_control(
                         Trigger(ts=ts, tag=("session_cap", _s))))
         self._last_row_ms = now
         if (self._gap_timer is None or self._gap_timer.fired
@@ -1064,7 +1064,7 @@ class FusedWindowAggNode(Node):
         sid, gen = self._session_id, self._gap_gen
         self._gap_timer = timex.after(
             max(delay_ms, 1),
-            lambda ts, _s=sid, _g=gen: self.inq.put(
+            lambda ts, _s=sid, _g=gen: self.put_control(
                 Trigger(ts=ts, tag=("session_gap", _s, _g))))
 
     def _on_session_trigger(self, trig: Trigger) -> None:
@@ -1096,7 +1096,7 @@ class FusedWindowAggNode(Node):
             sid = self._session_id
             self._cap_timer = timex.after(
                 remaining,
-                lambda ts, _s=sid: self.inq.put(
+                lambda ts, _s=sid: self.put_control(
                     Trigger(ts=ts, tag=("session_cap", _s))))
         self._arm_gap_check(self.gap_ms)
 
@@ -1145,8 +1145,11 @@ class FusedWindowAggNode(Node):
         except AttributeError:
             pass
         self._ensure_emit_worker()
+        # ingest provenance captured AT ISSUE (this is the dispatch
+        # thread): the worker must not read the live _cur_ingest_ms,
+        # which keeps advancing with post-boundary folds
         self._emit_q.put((kind, stacked_dev, self.kt.n_keys, wr,
-                          _time.time()))
+                          _time.time(), self._cur_ingest_ms))
 
     def _ensure_emit_worker(self) -> None:
         import queue
@@ -1165,11 +1168,17 @@ class FusedWindowAggNode(Node):
 
         from ..ops.groupby import apply_int_semantics
 
+        from .node import _NO_OVERRIDE, _emit_ctx
+
         while True:
             item = self._emit_q.get()
             if item is None:
                 break
-            kind, stacked_dev, n_keys, wr, t_issue = item
+            kind, stacked_dev, n_keys, wr, t_issue, issue_ing = item
+            # install the issue-time provenance for every emit() this
+            # delivery makes (node.py reads it ahead of _cur_ingest_ms;
+            # issue_ing=None means "stamp nothing", not "read live")
+            _emit_ctx.ingest_ms = issue_ing
             try:
                 if kind == "pf":
                     pipeline, frozen, backup = stacked_dev
@@ -1211,6 +1220,7 @@ class FusedWindowAggNode(Node):
                 # the node's normal exception accounting)
                 self.stats.inc_exception(f"async {kind} emit failed: {exc}")
             finally:
+                _emit_ctx.ingest_ms = _NO_OVERRIDE
                 self._emit_q.task_done()
 
     # bounded drain deadline; tests shrink it to exercise the abort path
@@ -1471,7 +1481,7 @@ class FusedWindowAggNode(Node):
         so a checkpoint/restore re-arms it instead of dropping the window."""
         self._pending_slides[t] = fire_at
         delay = max(fire_at - timex.now_ms(), 0)
-        timex.after(delay, lambda _ts, t0=t: self.inq.put(
+        timex.after(delay, lambda _ts, t0=t: self.put_control(
             Trigger(ts=t0, tag=("sliding", t0))))
 
     def _emit_sliding(self, t: int) -> None:
@@ -1750,7 +1760,7 @@ class FusedWindowAggNode(Node):
             # that fallback
             backup = self.gb._finalize(self.state, (True,) * self.gb.n_panes)
             self._emit_q.put(("pf", (pipeline, frozen, backup), n_keys, wr,
-                              _time.time()))
+                              _time.time(), self._cur_ingest_ms))
         else:
             # no pre-issue in flight: dispatch the finalize on the
             # immutable state and let the worker fetch + deliver

@@ -167,6 +167,7 @@ class SourceNode(Node):
             if not self.emit_batches:
                 t = self._preprocess(payload)
                 if t is not None:
+                    t.ingest_ms = now
                     self.emit(t)
                 return
             # preserve the tuple's own (replay/historical) timestamp
@@ -195,6 +196,7 @@ class SourceNode(Node):
                     emitter=self.name, message=m, timestamp=now,
                     metadata=metadata or {}))
                 if t is not None:
+                    t.ingest_ms = now
                     self.emit(t)
             return
         self._buffer("msgs", msgs, [now] * len(msgs))
@@ -380,7 +382,8 @@ class SourceNode(Node):
                     emit_fn=self._emit_decoded,
                     name=self.name,
                     prepare_fn=(self._prep_upload
-                                if self.prep_ctx is not None else None))
+                                if self.prep_ctx is not None else None),
+                    stats=self.stats)
             return self._pool
 
     def _prep_upload(self, batch: ColumnBatch) -> None:
@@ -454,6 +457,11 @@ class SourceNode(Node):
                              self.name, n_drop)
         self.stats.observe_stage(
             "decode", (_time.perf_counter() - t0) * 1e6, len(items))
+        if batch is not None and batch.ingest_ms is None and tss:
+            # e2e provenance: the batch speaks for its OLDEST row (arrival
+            # order == tss order), so micro-batch linger and every later
+            # pipeline stage count toward the recorded ingest→emit latency
+            batch.ingest_ms = int(tss[0])
         if batch is not None and self.prep_ctx is not None \
                 and batch.shared_ctx is None:
             # ride the prep ctx on the batch so downstream fused nodes

@@ -151,7 +151,7 @@ class WindowNode(Node):
         # epoch-aligned boundaries like the reference's getAlignedWindowEndTime
         next_end = timex.align_to_window(now + 1, interval)
         self._timer = timex.after(
-            next_end - now, lambda ts: self.inq.put(Trigger(ts=ts))
+            next_end - now, lambda ts: self.put_control(Trigger(ts=ts))
         )
 
     # --------------------------------------------------------------- ingest
@@ -259,14 +259,14 @@ class WindowNode(Node):
                 self._session_start = now
                 if self.length_ms > 0:
                     self._session_cap_timer = timex.after(
-                        self.length_ms, lambda ts: self.inq.put(Trigger(ts=ts, tag="cap"))
+                        self.length_ms, lambda ts: self.put_control(Trigger(ts=ts, tag="cap"))
                     )
             self.buffer.append(r)
             if self._session_timer is not None:
                 self._session_timer.stop()
             timeout = self.interval_ms or self.length_ms
             self._session_timer = timex.after(
-                timeout, lambda ts: self.inq.put(Trigger(ts=ts, tag="gap"))
+                timeout, lambda ts: self.put_control(Trigger(ts=ts, tag="gap"))
             )
             return
         if wt == ast.WindowType.SLIDING_WINDOW and not self.is_event_time:
@@ -281,7 +281,7 @@ class WindowNode(Node):
                     t0 = now
                     timex.after(
                         self.delay_ms,
-                        lambda ts: self.inq.put(Trigger(ts=ts, tag=("delayed", t0))),
+                        lambda ts: self.put_control(Trigger(ts=ts, tag=("delayed", t0))),
                     )
                 else:
                     self._emit_window(

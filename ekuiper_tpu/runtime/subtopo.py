@@ -204,6 +204,7 @@ class SharedEntryNode(Node):
                     timestamps=item.timestamps, emitter=item.emitter,
                     shared_ctx=item.shared_ctx,
                     share_state=item.share_state,
+                    ingest_ms=item.ingest_ms,
                 )
             elif isinstance(item, Row) and not (
                 set(item.message) <= cols

@@ -8,6 +8,7 @@ import queue
 import threading
 from typing import Any, Dict, List, Optional
 
+from ..observability.histogram import LatencyHistogram
 from ..store import kv
 from ..utils import timex
 from ..utils.infra import logger
@@ -35,6 +36,11 @@ class Topo:
         self._ckpt_lock = threading.Lock()
         self._ckpt_pending: Dict[int, Dict[str, Optional[dict]]] = {}
         self._store = None
+        # rule-level ingest→emit latency distribution (ms): sinks record a
+        # sample per delivered emission (nodes_sink.py _observe_e2e); the
+        # Prometheus layer exports it as the kuiper_rule_e2e_latency_ms
+        # histogram, the status JSON as a p50/p90/p99/max summary
+        self.e2e_hist = LatencyHistogram()
 
     # ------------------------------------------------------------------ wiring
     def add_source(self, node: Node) -> Node:
@@ -61,6 +67,16 @@ class Topo:
 
     def all_nodes(self) -> List[Node]:
         return self.sources + self.ops + self.sinks
+
+    def live_shared(self) -> List:
+        """(SrcSubTopo, entry node) pairs this rule currently rides — the
+        public accessor for observability layers (scrapes must not reach
+        into the private open()/close()-managed list)."""
+        return list(self._live_shared)
+
+    def observe_e2e(self, lat_ms: int) -> None:
+        """One ingest→emit latency sample (ms), recorded by sink nodes."""
+        self.e2e_hist.record(lat_ms)
 
     # --------------------------------------------------------------- lifecycle
     def open(self) -> None:
@@ -138,7 +154,10 @@ class Topo:
             # shared source instances
             for name, sm in subtopo.status().items():
                 stats.setdefault(name, sm)
-        return flatten_status(stats)
+        out = flatten_status(stats)
+        # rule-level SLO summary: the ingest→emit distribution percentiles
+        out["e2e_latency_ms"] = self.e2e_hist.snapshot()
+        return out
 
     def topo_json(self) -> Dict[str, Any]:
         edges: Dict[str, List[str]] = {}

@@ -98,6 +98,8 @@ class RestApi:
           lambda m: self.rules.reset_state(m["id"]) or "Rule %s state was reset" % m["id"])
         r("GET", r"^/rules/usage/cpu$",
           lambda m: self.rules.cpu_usage())
+        r("GET", r"^/rules/usage/latency$",
+          lambda m: self.rules.latency_usage())
         r("GET", r"^/rules/(?P<id>[^/]+)/status$",
           lambda m: self.rules.status(m["id"]))
         r("GET", r"^/rules/(?P<id>[^/]+)/topo$",

@@ -163,6 +163,21 @@ class RuleRegistry:
             }
         return out
 
+    def latency_usage(self) -> Dict[str, Any]:
+        """Per-rule ingest→emit latency summary (REST
+        /rules/usage/latency, sibling of /rules/usage/cpu): the SLO view
+        across every live rule at a glance — {count, p50, p90, p99, max}
+        in ms off each topo's end-to-end histogram."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            rules = dict(self._rules)
+        for rule_id, rs in rules.items():
+            topo = rs.topo  # capture: stop/restart may null it concurrently
+            if topo is None:
+                continue
+            out[rule_id] = topo.e2e_hist.snapshot()
+        return out
+
     def explain(self, rule_id: str) -> Dict[str, Any]:
         rule = self.processor.get(rule_id)
         return plan_explain(rule, self.store)

@@ -1,0 +1,158 @@
+"""Latency histogram math (observability/histogram.py): bucket
+boundaries, merge, decay, percentile accuracy, and the Prometheus
+cumulative-bucket mapping."""
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from ekuiper_tpu.observability.histogram import (
+    E2E_BOUNDS_MS,
+    LatencyHistogram,
+    MAX_BITS,
+    SUB_BITS,
+    _bucket_max,
+    _index,
+    render_prom_histogram,
+)
+
+
+class TestBuckets:
+    def test_linear_range_is_exact(self):
+        for v in range(1 << SUB_BITS):
+            assert _index(v) == v
+            assert _bucket_max(v) == v
+
+    def test_bucket_contains_value(self):
+        # every value maps to a bucket whose [implied lower, max] range
+        # contains it, with relative width <= 2^-SUB_BITS
+        for v in (16, 17, 31, 32, 100, 1000, 65_535, 10**6, 10**9, 2**40):
+            idx = _index(v)
+            hi = _bucket_max(idx)
+            assert v <= hi
+            assert hi - v <= max(v >> SUB_BITS, 1), (v, hi)
+
+    def test_index_monotonic(self):
+        vals = sorted(random.Random(3).sample(range(1, 10**7), 5000))
+        idxs = [_index(v) for v in vals]
+        assert idxs == sorted(idxs)
+
+    def test_clamp_at_top(self):
+        top = _index(2**MAX_BITS)
+        assert top == _index(2**60)
+        assert _bucket_max(top) == 2**MAX_BITS - 1
+
+
+class TestRecordPercentile:
+    def test_percentile_tracks_numpy(self):
+        rng = random.Random(7)
+        vals = [rng.randint(0, 2_000_000) for _ in range(30_000)]
+        h = LatencyHistogram()
+        for v in vals:
+            h.record(v)
+        assert h.count == len(vals)
+        assert h.sum == sum(vals)
+        assert h.max == max(vals)
+        assert h.min == min(vals)
+        for q in (50, 90, 99, 99.9):
+            true = float(np.percentile(vals, q))
+            est = h.percentile(q)
+            # bucket upper edge: overestimates by <= 6.25%, never under
+            assert true <= est + 1
+            assert est <= true * (1 + 2**-SUB_BITS) + 1, (q, est, true)
+
+    def test_empty_and_single(self):
+        h = LatencyHistogram()
+        assert h.percentile(99) == 0
+        assert h.snapshot() == {"count": 0, "p50": 0, "p90": 0, "p99": 0,
+                                "max": 0}
+        h.record(123)
+        assert h.percentile(1) == h.percentile(100) == 123
+
+    def test_negative_clamps_to_zero(self):
+        h = LatencyHistogram()
+        h.record(-5)
+        assert h.count == 1 and h.max == 0
+
+    def test_concurrent_records_all_land(self):
+        h = LatencyHistogram()
+
+        def work():
+            for i in range(5000):
+                h.record(i)
+
+        ts = [threading.Thread(target=work) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert h.count == 20_000
+
+
+class TestMergeDecay:
+    def test_merge_is_additive(self):
+        a, b = LatencyHistogram(), LatencyHistogram()
+        for v in (1, 10, 100):
+            a.record(v)
+        for v in (1000, 5):
+            b.record(v)
+        a.merge(b)
+        assert a.count == 5
+        assert a.sum == 1116
+        assert a.min == 1 and a.max == 1000
+        assert a.percentile(100) == 1000
+
+    def test_merge_empty_noop(self):
+        a = LatencyHistogram()
+        a.record(7)
+        a.merge(LatencyHistogram())
+        assert a.count == 1 and a.min == 7
+
+    def test_decay_halves_and_clears(self):
+        h = LatencyHistogram()
+        for _ in range(8):
+            h.record(40)
+        snap = h.snapshot_and_decay(0.5)
+        assert snap["count"] == 8 and snap["p50"] == 40
+        assert h.count == 4
+        assert h.percentile(50) == 40  # shape preserved
+        h.snapshot_and_decay(0.0)
+        assert h.count == 0 and h.max == 0 and h.sum == 0
+
+    def test_decay_drops_singletons(self):
+        h = LatencyHistogram()
+        h.record(99)
+        h.snapshot_and_decay(0.5)  # int(1 * 0.5) == 0
+        assert h.count == 0
+
+
+class TestPromExport:
+    def test_cumulative_monotonic_and_conservative(self):
+        h = LatencyHistogram()
+        for v in (0, 3, 49, 50, 51, 400, 70_000):
+            h.record(v)
+        cum = h.cumulative(E2E_BOUNDS_MS)
+        assert cum == sorted(cum)
+        assert cum[-1] <= h.count  # 70k exceeds the ladder -> only +Inf
+        # never under-reports latency: count at `le=50` must not exceed
+        # the true number of samples <= 50
+        le50 = cum[E2E_BOUNDS_MS.index(50)]
+        assert le50 <= 4
+
+    def test_render_lines(self):
+        h = LatencyHistogram()
+        for v in (2, 30, 800):
+            h.record(v)
+        out = []
+        render_prom_histogram(out, "kuiper_rule_e2e_latency_ms",
+                              'rule="r\\"1"', h)
+        les = [ln.rsplit('le="', 1)[1].split('"')[0]
+               for ln in out if "_bucket" in ln]
+        assert les[-1] == "+Inf"
+        assert [float(x) for x in les[:-1]] == sorted(float(x)
+                                                      for x in les[:-1])
+        assert out[-2] == 'kuiper_rule_e2e_latency_ms_sum{rule="r\\"1"} 832'
+        assert out[-1] == 'kuiper_rule_e2e_latency_ms_count{rule="r\\"1"} 3'
+        inf_val = int([ln for ln in out if 'le="+Inf"' in ln][0].split()[-1])
+        assert inf_val == 3

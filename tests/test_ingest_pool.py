@@ -522,7 +522,11 @@ class TestPrepUploadStage:
         src._flush()
 
         class FakeTopo:
-            _live_shared = []
+            from ekuiper_tpu.observability.histogram import LatencyHistogram
+            e2e_hist = LatencyHistogram()
+
+            def live_shared(self):
+                return []
 
             def all_nodes(self):
                 return [src]

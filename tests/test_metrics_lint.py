@@ -1,0 +1,36 @@
+"""Tier-1 metrics-exposition lint (tools/check_metrics.py): every metric
+the Prometheus layer can emit must be kuiper_-prefixed, carry # TYPE and
+# HELP, and be cataloged in docs/OBSERVABILITY.md — a new metric added
+without docs fails the suite, like tools/check_native.py does for a
+silently-broken native build."""
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_check_metrics_passes():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_metrics.py")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (
+        "metrics exposition lint FAILED:\n"
+        f"{proc.stdout}\n{proc.stderr}")
+    assert "OK" in proc.stdout
+
+
+def test_lint_catches_undocumented_metric():
+    """The lint itself must detect a violation, not just pass vacuously."""
+    sys.path.insert(0, str(REPO))
+    from tools.check_metrics import lint
+
+    text = ("# TYPE kuiper_bogus_total counter\n"
+            "# HELP kuiper_bogus_total not in docs\n"
+            'kuiper_bogus_total{rule="r"} 1\n'
+            "no_prefix_metric 2\n")
+    errors = lint(text, "docs without that name")
+    msgs = "\n".join(errors)
+    assert "kuiper_bogus_total: not documented" in msgs
+    assert "no_prefix_metric: not kuiper_-prefixed" in msgs
+    assert "no_prefix_metric: no # TYPE header" in msgs

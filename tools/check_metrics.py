@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Metrics-exposition lint — run from the tier-1 suite (like
+tools/check_native.py): renders a full synthetic Prometheus scrape and
+fails loudly when any emitted metric
+
+  1. is not `kuiper_`-prefixed,
+  2. lacks a `# TYPE` or `# HELP` header, or
+  3. is missing from the docs/OBSERVABILITY.md catalog.
+
+The synthetic registry exercises every family render() can emit: a rule
+with a staged + pooled node, a shared subtopo node, and a populated
+end-to-end histogram — so a new metric added without docs or headers
+cannot slip through a scrape that simply never hit its branch.
+
+Exit 0 = clean; exit 1 prints one line per violation.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DOCS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs", "OBSERVABILITY.md")
+
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{|\s)")
+
+
+def _synthetic_scrape() -> str:
+    """Render a scrape covering every metric family."""
+    from ekuiper_tpu.observability.histogram import LatencyHistogram
+    from ekuiper_tpu.observability.prometheus import render
+    from ekuiper_tpu.utils.metrics import StatManager
+
+    class Node:
+        def __init__(self, name, op_type="op", pooled=False):
+            self.name = name
+            self.op_type = op_type
+            self.stats = StatManager(op_type, name)
+            self.stats.inc_in(3)
+            self.stats.inc_out(2)
+            self.stats.observe_stage("decode", 120.0, 3)
+            self.stats.observe_queue_wait(42.0)
+            self.stats.process_begin()
+            self.stats.process_end()
+            if pooled:
+                self.pool_depths = lambda: (1, 0)
+
+    class SubTopo:
+        nodes = [Node("shared_src", op_type="source", pooled=True)]
+
+    class Topo:
+        e2e_hist = LatencyHistogram()
+
+        def all_nodes(self):
+            return [Node("src", "source"), Node("op1"), Node("sink", "sink")]
+
+        def live_shared(self):
+            return [(SubTopo(), None)]
+
+    Topo.e2e_hist.record(7)
+    Topo.e2e_hist.record(42)
+
+    class State:
+        topo = Topo()
+
+    class Registry:
+        def list(self):
+            return [{"id": "lint_rule", "status": "running"}]
+
+        def state(self, rid):
+            return State()
+
+    return render(Registry())
+
+
+def lint(text: str, docs_text: str) -> list:
+    errors = []
+    types: dict = {}
+    helps: set = set()
+    seen: dict = {}  # base family name -> first sample line no
+    for i, line in enumerate(text.splitlines(), 1):
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) >= 3:
+                helps.add(parts[2])
+            continue
+        if not line.strip() or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {i}: unparseable sample line: {line!r}")
+            continue
+        name = m.group(1)
+        base = name
+        # histogram/summary series roll up to their family name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                base = name[: -len(suffix)]
+                break
+        seen.setdefault(base, i)
+    for base, line_no in sorted(seen.items(), key=lambda kv: kv[1]):
+        if not base.startswith("kuiper_"):
+            errors.append(f"{base}: not kuiper_-prefixed (line {line_no})")
+        if base not in types:
+            errors.append(f"{base}: no # TYPE header (line {line_no})")
+        if base not in helps:
+            errors.append(f"{base}: no # HELP header (line {line_no})")
+        # word-boundary match: a family must appear as a whole name —
+        # substring hits (kuiper_op_stage_us inside kuiper_op_stage_us_total)
+        # must not count as documentation
+        if not re.search(rf"(?<![A-Za-z0-9_]){re.escape(base)}(?![A-Za-z0-9_])",
+                         docs_text):
+            errors.append(
+                f"{base}: not documented in docs/OBSERVABILITY.md "
+                f"(line {line_no})")
+    return errors
+
+
+def main() -> int:
+    try:
+        with open(DOCS) as f:
+            docs_text = f.read()
+    except FileNotFoundError:
+        print(f"check_metrics: missing {DOCS}")
+        return 1
+    text = _synthetic_scrape()
+    errors = lint(text, docs_text)
+    if errors:
+        print(f"check_metrics: {len(errors)} violation(s)")
+        for e in errors:
+            print("  " + e)
+        return 1
+    n = len({ln.split()[2] for ln in text.splitlines()
+             if ln.startswith("# TYPE ")})
+    print(f"check_metrics: OK ({n} metric families, all prefixed, "
+          "typed, helped, documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
