@@ -55,6 +55,18 @@ def _remaining_s() -> float:
         return float("inf")
     return _DEADLINE[0] - time.time()
 
+
+def phase_budget(nominal_s: float, remaining_s=None,
+                 reserve_s: float = 15.0) -> float:
+    """Wall-clock budget for one phase: its nominal allowance clamped so
+    the phase can never spend past the global deadline minus a reserve
+    for the final-JSON flush. THE invariant (unit-tested,
+    tests/test_bench_budget.py — the r05 rc=124 post-mortem class of bug):
+    for any sequence of phases each consuming at most its clamped budget,
+    total spend stays within TOTAL_BUDGET_S."""
+    rem = _remaining_s() if remaining_s is None else remaining_s
+    return min(float(nominal_s), max(rem - reserve_s, 0.0))
+
 # Every phase records its key metrics here via record(); the final stdout
 # JSON line carries the whole dict under "phases", so the driver artifact
 # is self-contained even when its output tail is byte-truncated
@@ -586,7 +598,7 @@ def _run_isolated(func: str, tag: str, timeout: float = 900) -> None:
     harvests whatever `#R ` lines the child printed before the kill."""
     import subprocess
 
-    timeout = min(timeout, max(_remaining_s() - 20.0, 0.0))
+    timeout = phase_budget(timeout, reserve_s=20.0)
     if timeout < 30.0:
         print(f"# {tag}: skipped — {_remaining_s():.0f}s of global budget "
               "left", file=sys.stderr)
@@ -1064,6 +1076,209 @@ def _full_pipe_contended_main() -> None:
     _full_pipe_session(measure)
 
 
+def bench_multi_rule_shared(batches, kt_slots) -> None:
+    """ISSUE 4 acceptance phase: 8 correlated rules, one stream, 10k keys —
+    shared pane fold (one device fold per batch + per-rule pane combine)
+    vs 8 independent folds. Records aggregate rule-rows/s for both plans,
+    the fold-dedup ratio, and a deterministic byte-parity check of the
+    emitted windows (integer-valued measurements so pane-sum association
+    is exact — docs/SHARING.md)."""
+    import jax
+
+    from ekuiper_tpu.data.batch import ColumnBatch
+    from ekuiper_tpu.data.rows import WindowRange
+    from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+    from ekuiper_tpu.ops.emit import build_direct_emit
+    from ekuiper_tpu.ops.panestore import pane_gcd, union_plan
+    from ekuiper_tpu.runtime.events import Trigger
+    from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+    from ekuiper_tpu.runtime.nodes_sharedfold import (
+        MemberSpec, SharedEmitNode, SharedFoldNode)
+    from ekuiper_tpu.sql import ast
+    from ekuiper_tpu.sql.parser import parse_select
+
+    n_rules = 8
+    sqls = [
+        "SELECT deviceId, avg(temperature) AS a, count(*) AS c FROM demo "
+        "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)",
+        "SELECT deviceId, min(temperature) AS mn, max(temperature) AS mx "
+        "FROM demo GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)",
+        "SELECT deviceId, sum(temperature) AS s FROM demo "
+        "GROUP BY deviceId, HOPPINGWINDOW(ss, 10, 5)",
+        "SELECT deviceId, count(*) AS c, max(temperature) AS mx FROM demo "
+        "GROUP BY deviceId, HOPPINGWINDOW(ss, 20, 5)",
+        "SELECT deviceId, avg(temperature) AS a, min(temperature) AS mn "
+        "FROM demo GROUP BY deviceId, TUMBLINGWINDOW(ss, 20)",
+        "SELECT deviceId, avg(temperature) AS a, count(*) AS c FROM demo "
+        "GROUP BY deviceId, TUMBLINGWINDOW(ss, 15)",
+        "SELECT deviceId, sum(temperature) AS s, count(*) AS c FROM demo "
+        "GROUP BY deviceId, TUMBLINGWINDOW(ss, 5)",
+        "SELECT deviceId, avg(temperature) AS a FROM demo "
+        "GROUP BY deviceId, HOPPINGWINDOW(ss, 15, 5)",
+    ]
+    stmts = [parse_select(s) for s in sqls]
+    plans = [extract_kernel_plan(s) for s in stmts]
+    assert all(p is not None for p in plans)
+    union, _ = union_plan(plans)
+    windows = []
+    for s in stmts:
+        w = s.window
+        windows += [w.length_ms(), w.interval_ms() or w.length_ms()]
+    pane = pane_gcd(windows)
+    max_span = max(s.window.length_ms() // pane for s in stmts)
+
+    # integer-valued temperatures: pane-sum association is exact, so the
+    # shared-vs-private comparison below is BYTE-identical, not approximate
+    int_batches = [
+        ColumnBatch(n=b.n,
+                    columns={"deviceId": b.columns["deviceId"],
+                             "temperature": np.rint(
+                                 b.columns["temperature"]).astype(
+                                     np.float32)},
+                    timestamps=b.timestamps, emitter=b.emitter)
+        for b in batches
+    ]
+
+    def mk_shared():
+        node = SharedFoldNode(
+            "bench", "shared_fold[demo]", union, pane, max_span + 2,
+            subtopo_ref=None, capacity=kt_slots, micro_batch=BATCH_ROWS)
+        node._cur_bucket = 0
+        entries = []
+        for i, (stmt, plan) in enumerate(zip(stmts, plans)):
+            w = stmt.window
+            spec = MemberSpec(
+                rule_id=f"r{i}", length_ms=w.length_ms(),
+                interval_ms=w.interval_ms() or w.length_ms(), plan=plan,
+                direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+                dims=["deviceId"], emit_columnar=True)
+            e = SharedEmitNode(f"r{i}_emit", buffer_length=4096)
+            node.attach_rule(spec, e, None)
+            entries.append(e)
+        return node, entries
+
+    def mk_private():
+        nodes, caps = [], []
+        for stmt, plan in zip(stmts, plans):
+            n = FusedWindowAggNode(
+                "priv", stmt.window, plan,
+                dims=[d.expr for d in stmt.dimensions],
+                capacity=kt_slots, micro_batch=BATCH_ROWS,
+                direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+                emit_columnar=True, prefinalize_lead_ms=0)
+            n.state = n.gb.init_state()
+            got = []
+            n.broadcast = lambda item, g=got: g.append(item)
+            nodes.append(n)
+            caps.append(got)
+        return nodes, caps
+
+    def private_boundary(p, end):
+        iv = p.interval_ms or p.length_ms
+        if end % iv:
+            return
+        p._emit(WindowRange(end - p.length_ms, end))
+        if p.wt == ast.WindowType.TUMBLING_WINDOW:
+            p.state = p.gb.reset_pane(p.state, 0)
+        else:
+            p.cur_pane = (p.cur_pane + 1) % p.n_panes
+            p.state = p.gb.reset_pane(p.state, p.cur_pane)
+
+    # ---- parity: identical batches + boundaries through both plans ----
+    shared, entries = mk_shared()
+    privs, caps = mk_private()
+    for end_i in range(1, 5):
+        end = end_i * pane
+        shared.process(int_batches[end_i % len(int_batches)])
+        for p in privs:
+            p.process(int_batches[end_i % len(int_batches)])
+        shared.on_trigger(Trigger(ts=end))
+        for p in privs:
+            private_boundary(p, end)
+    jax.block_until_ready(shared.store.state)
+    n_windows = 0
+    for i, e in enumerate(entries):
+        got = []
+        while not e.inq.empty():
+            item = e.inq.get_nowait()
+            if isinstance(item, ColumnBatch):
+                got.append(item)
+        ref = [x for x in caps[i] if isinstance(x, ColumnBatch)]
+        assert len(got) == len(ref), f"rule {i}: {len(got)} vs {len(ref)}"
+        for a, b in zip(got, ref):
+            for c in a.columns:
+                assert np.array_equal(a.columns[c], b.columns[c]), \
+                    f"rule {i} col {c} diverged"
+        n_windows += len(got)
+    parity_windows = n_windows
+
+    # ---- throughput: aggregate rule-rows/s shared vs independent ----
+    def run(fold_fn, boundary_fn, state_ref, seconds=6.0):
+        rows = 0
+        n = 0
+        t0 = time.time()
+        while time.time() - t0 < seconds:
+            fold_fn(int_batches[n % len(int_batches)])
+            rows += BATCH_ROWS
+            n += 1
+            if n % T_BLOCK_EVERY == 0:
+                # bound the dispatch queue: block on the CURRENT state
+                # before the boundary donates it (a held older marker
+                # would reference donated buffers). Same pipeline bubble
+                # for both arms — the comparison stays fair.
+                jax.block_until_ready(state_ref()["act"])
+            if n % 16 == 0:
+                boundary_fn((n // 16) * pane)
+        jax.block_until_ready(state_ref())
+        return rows, time.time() - t0
+
+    shared, entries = mk_shared()
+    shared.process(int_batches[0])
+    shared.on_trigger(Trigger(ts=pane))  # warm fold + combine
+    jax.block_until_ready(shared.store.state)
+    for e in entries:
+        while not e.inq.empty():
+            e.inq.get_nowait()
+    shared.folds_did = shared.folds_would = 0
+    s_rows, s_el = run(shared.process,
+                       lambda end: shared.on_trigger(Trigger(ts=end)),
+                       lambda: shared.store.state)
+    dedup = shared.fold_dedup_ratio()
+
+    privs, caps = mk_private()
+    for p in privs:
+        p.process(int_batches[0])
+        private_boundary(p, p.interval_ms or p.length_ms)
+    jax.block_until_ready(privs[0].state)
+
+    def priv_fold(b):
+        for p in privs:
+            p.process(b)
+
+    def priv_boundary(end):
+        for p in privs:
+            private_boundary(p, end)
+
+    p_rows, p_el = run(priv_fold, priv_boundary, lambda: privs[0].state)
+    shared_agg = s_rows * n_rules / s_el
+    priv_agg = p_rows * n_rules / p_el
+    speedup = shared_agg / max(priv_agg, 1e-9)
+    print(
+        f"# multi-rule shared fold ({n_rules} correlated rules, "
+        f"{N_DEVICES} keys, pane {pane}ms x {max_span + 2} panes): shared "
+        f"{shared_agg:,.0f} rule-rows/s vs independent {priv_agg:,.0f} "
+        f"rule-rows/s = {speedup:.1f}x; fold-dedup ratio {dedup:.3f}; "
+        f"parity: {parity_windows} windows byte-identical",
+        file=sys.stderr,
+    )
+    record("multi_rule_shared",
+           shared_rule_rows_per_sec=shared_agg,
+           independent_rule_rows_per_sec=priv_agg,
+           speedup=speedup, fold_dedup_ratio=dedup,
+           parity_windows=parity_windows, n_rules=n_rules,
+           pane_ms=pane)
+
+
 def bench_event_time(batches, kt_slots) -> None:
     """Event-time device path: per-row pane routing + watermark-driven
     emission. Prints a stderr metric line."""
@@ -1444,8 +1659,10 @@ def main() -> None:
         ("hll_1m", 900.0, lambda: bench_countwindow_hll_1m(KEY_SLOTS)),
         ("event_time", 600.0, lambda: bench_event_time(batches, KEY_SLOTS)),
         ("rule_group", 600.0, lambda: bench_rule_group(batches, KEY_SLOTS)),
+        ("multi_rule_shared", 600.0,
+         lambda: bench_multi_rule_shared(batches, KEY_SLOTS)),
     ):
-        budget_s = min(budget_s, max(_remaining_s() - 15.0, 0.0))
+        budget_s = phase_budget(budget_s)
         if budget_s < 20.0:
             print(f"# {name}: skipped — global budget exhausted",
                   file=sys.stderr)

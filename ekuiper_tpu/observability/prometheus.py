@@ -149,6 +149,28 @@ def render(rule_registry) -> str:
             out.append(
                 f'kuiper_{mname}{{rule="{_esc(rule_id)}",'
                 f'op="{_esc(node.name)}"}} {depths[idx]}')
+    # shared pane folds (runtime/nodes_sharedfold.py): pool-level gauges —
+    # members per store and the fold-dedup ratio (1 - folds run / folds N
+    # private rules would have run). The store node's own op metrics (incl.
+    # the per-rule emit-combine stage timings, stage="emit[<rule>]") ride
+    # the rule="__shared__" rows above via each rider's live_shared()
+    # nodes, so only the pool-level aggregates are emitted here.
+    from ..runtime import nodes_sharedfold as _sharedfold
+
+    fold_stores = _sharedfold.live_stores()
+    for mname, mtype, help_txt, value in (
+            ("kuiper_shared_fold_rules", "gauge",
+             "member rules riding each shared pane fold",
+             lambda st: st.member_count()),
+            ("kuiper_shared_fold_dedup_ratio", "gauge",
+             "1 - device folds run / folds N private rules would have run",
+             lambda st: round(st.fold_dedup_ratio(), 4)),
+            ("kuiper_shared_fold_windows_total", "counter",
+             "per-rule windows emitted from shared pane folds",
+             lambda st: st.windows_emitted)):
+        _family(out, mname, mtype, help_txt)
+        for st in fold_stores:
+            out.append(f'{mname}{{op="{_esc(st.name)}"}} {value(st)}')
     # the SLO headline: per-rule ingest→emit latency as a real Prometheus
     # histogram (_bucket/_sum/_count with le labels) — histogram_quantile()
     # over it answers "is p99 emit under 50ms" directly

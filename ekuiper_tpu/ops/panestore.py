@@ -1,0 +1,216 @@
+"""Shared pane store — the device half of cross-rule window-aggregate
+sharing (planner/sharing.py + runtime/nodes_sharedfold.py).
+
+"Factor Windows" (arxiv 2008.12379) observes that correlated window
+aggregates over one stream can be rewritten to share factored partials;
+the pane/slice merge the group-by kernel already uses for hopping windows
+(ops/groupby.py, the constant-time merge structure of arxiv 2009.13768)
+is exactly that factorization. Here the panes become a FIRST-CLASS shared
+resource: one device-resident ring of panes at the GCD granularity of the
+member rules' windows, folded once per batch, from which each rule's
+window is a pane-subset finalize (tumbling = pane-sum over its span,
+hopping = its live pane set).
+
+This module owns the device state and the union-plan algebra; the node
+driving it (attach/detach, boundary timers, watermarks, per-rule emit)
+lives in runtime/nodes_sharedfold.py.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .aggspec import (
+    HLL_COL_PREFIX,
+    KernelPlan,
+    _call_key,
+    _hll_encode_numeric,
+    hash_column_for_hll,
+)
+from .groupby import DeviceGroupBy
+from .keytable import KeyTable
+
+
+def pane_gcd(values_ms: Iterable[int]) -> int:
+    """Common pane width for a set of window lengths/intervals (ms)."""
+    g = 0
+    for v in values_ms:
+        if v:
+            g = math.gcd(g, int(v))
+    return max(g, 1)
+
+
+def union_plan(plans: Sequence[KernelPlan]) -> Tuple[KernelPlan, List[List[int]]]:
+    """Union N rules' kernel plans into one foldable plan, deduplicating
+    aggregate specs by call key (avg(x) wanted by 5 rules folds once).
+    Returns (union, maps) where maps[r][i] is the union spec index of rule
+    r's spec i. The WHERE filter must be identical across members (the
+    planner keys sharing on the WHERE expression), so the first plan's
+    filter speaks for all."""
+    specs: List = []
+    index: Dict[str, int] = {}
+    columns: set = set()
+    maps: List[List[int]] = []
+    for plan in plans:
+        m: List[int] = []
+        for spec in plan.specs:
+            key = _call_key(spec.call)
+            at = index.get(key)
+            if at is None:
+                at = index[key] = len(specs)
+                specs.append(spec)
+            m.append(at)
+        columns |= plan.columns
+        maps.append(m)
+    first = plans[0]
+    return (
+        KernelPlan(specs=specs, filter=first.filter, columns=columns,
+                   filter_host=first.filter_host),
+        maps,
+    )
+
+
+def spec_map_into(union: KernelPlan, plan: KernelPlan) -> List[int]:
+    """Map a member rule's spec indices into a live union plan; raises
+    KeyError when the union does not cover the rule (the planner declines
+    such joins — hitting this means a plan/open race)."""
+    index = {_call_key(s.call): i for i, s in enumerate(union.specs)}
+    return [index[_call_key(s.call)] for s in plan.specs]
+
+
+def build_value_columns(
+    plan: KernelPlan, sub,
+) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+    """Materialize the union plan's numeric columns + validity masks for a
+    ColumnBatch — the dims-free subset of the fused node's kernel-input
+    build (runtime/nodes_fused.py _build_kernel_inputs): hll derived
+    columns, object-column coercion, NaN fill for missing columns.
+    heavy_hitters never reaches a shared fold (node-local dictionaries),
+    so there is no __hhc__ branch here."""
+    cols: Dict[str, np.ndarray] = {}
+    valid: Dict[str, np.ndarray] = {}
+    for name in plan.columns:
+        if name.startswith(HLL_COL_PREFIX):
+            raw_name = name[len(HLL_COL_PREFIX):]
+            col = sub.columns.get(raw_name)
+            if col is None:
+                cols[name] = np.full(sub.n, np.nan, dtype=np.float32)
+            elif col.dtype == np.object_:
+                cols[name] = hash_column_for_hll(col)
+            else:
+                cols[name] = _hll_encode_numeric(col)
+            v = sub.valid.get(raw_name)
+            if v is not None:
+                valid[name] = v
+            continue
+        col = sub.columns.get(name)
+        if col is None:
+            cols[name] = np.full(sub.n, np.nan, dtype=np.float32)
+            continue
+        if col.dtype == np.object_:
+            coerced = np.full(sub.n, np.nan, dtype=np.float32)
+            for i, v in enumerate(col):
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    coerced[i] = v
+            cols[name] = coerced
+        else:
+            cols[name] = col
+        v = sub.valid.get(name)
+        if v is not None:
+            valid[name] = v
+    return cols, valid
+
+
+class PaneStore:
+    """Device pane ring + key table for one shared fold.
+
+    State shape is the group-by kernel's (n_panes, capacity, k) partials;
+    pane p holds the rows of wall/event bucket b where b % n_panes == p.
+    One fold per batch serves every member rule; a rule's window is a
+    finalize over the pane subset spanning it (ops/groupby.py
+    _finalize_dyn — a traced pane mask, one compiled executable no matter
+    which subset)."""
+
+    def __init__(self, plan: KernelPlan, pane_ms: int, n_panes: int,
+                 capacity: int = 16384, micro_batch: int = 4096) -> None:
+        self.plan = plan
+        self.pane_ms = int(pane_ms)
+        self.n_panes = int(n_panes)
+        self.gb = DeviceGroupBy(plan, capacity=capacity, n_panes=self.n_panes,
+                                micro_batch=micro_batch)
+        self.kt = KeyTable(self.gb.capacity)
+        self.state = self.gb.init_state()
+        self._dtypes_seen = False
+
+    # ------------------------------------------------------------------ fold
+    def fold(self, cols: Dict[str, np.ndarray], valid, slots, pane_arg,
+             n_rows: Optional[int] = None) -> None:
+        """Fold one batch's kernel inputs into `pane_arg` (scalar pane or
+        per-row pane vector). Grows the device state when the key table
+        outran it (new keys this batch)."""
+        if not self._dtypes_seen:
+            self.gb.observe_dtypes(cols)
+            self._dtypes_seen = True
+        if self.gb.capacity < self.kt.capacity:
+            self.state = self.gb.grow(self.state, self.kt.capacity)
+        self.state = self.gb.fold(self.state, cols, slots, valid, pane_arg,
+                                  n_rows=n_rows)
+
+    # --------------------------------------------------------------- combine
+    def combine(self, panes: Sequence[int],
+                n_keys: int) -> Tuple[List[np.ndarray], np.ndarray]:
+        """Finalize the union plan over a pane subset: one device launch,
+        one transfer; integer semantics already applied (groupby.py)."""
+        return self.gb.finalize(self.state, n_keys,
+                                panes=sorted(set(int(p) for p in panes)))
+
+    def reset_pane(self, pane: int) -> None:
+        self.state = self.gb.reset_pane(self.state, int(pane))
+
+    # ---------------------------------------------------------------- warmup
+    def warmup(self) -> None:
+        """Compile fold (scalar + vector pane) and the dyn finalize on a
+        throwaway state so the first live batch/boundary doesn't pay the
+        jit latency. Never touches self.state (it may hold restored
+        partials)."""
+        try:
+            cols = {name: np.zeros(1, dtype=np.float32)
+                    for name in self.plan.columns}
+            slots = np.zeros(1, dtype=np.int32)
+            dummy = self.gb.init_state()
+            dummy = self.gb.fold(dummy, dict(cols), slots, pane_idx=0)
+            dummy = self.gb.fold(dummy, dict(cols), slots,
+                                 pane_idx=np.zeros(1, dtype=np.int64))
+            self.gb.finalize(dummy, 1, panes=[0])
+        except Exception:
+            pass  # non-fatal: first live use compiles instead
+
+    # ------------------------------------------------------------ checkpoint
+    def snapshot(self) -> Dict:
+        host = self.gb.state_to_host(self.state)
+        return {
+            "keys": self.kt.decode_all(),
+            "partials": {k: v.tolist() for k, v in host.items()},
+            "pane_ms": self.pane_ms,
+            "n_panes": self.n_panes,
+        }
+
+    def restore(self, snap: Dict) -> None:
+        if int(snap.get("pane_ms", self.pane_ms)) != self.pane_ms or \
+                int(snap.get("n_panes", self.n_panes)) != self.n_panes:
+            raise ValueError(
+                "pane store snapshot does not match this store's pane "
+                f"geometry ({snap.get('pane_ms')}ms x {snap.get('n_panes')} "
+                f"vs {self.pane_ms}ms x {self.n_panes})")
+        keys = snap.get("keys", [])
+        self.kt.restore([tuple(k) if isinstance(k, list) else k for k in keys])
+        partials = snap.get("partials")
+        if partials:
+            host = {k: np.asarray(v, dtype=np.float32)
+                    for k, v in partials.items()}
+            cap = next(iter(host.values())).shape[1]
+            self.gb.capacity = cap
+            self.kt.capacity = max(self.kt.capacity, cap)
+            self.state = self.gb.state_from_host(host)

@@ -35,7 +35,7 @@ from ..sql import ast
 from ..sql.parser import parse_select
 from ..utils.config import RuleOptionConfig, get_config
 from ..utils.cron import parse_duration_ms
-from ..utils.infra import PlanError
+from ..utils.infra import PlanError, logger
 
 
 @dataclass
@@ -92,6 +92,7 @@ def merged_options(rule: RuleDef) -> RuleOptionConfig:
         "ingestRingDepth": "ingest_ring_depth",
         "ingestPrepUpload": "ingest_prep_upload",
         "slidingDevRingMb": "sliding_dev_ring_mb",
+        "sharedFold": "shared_fold",
     }
     for k, v in rule.options.items():
         key = alias.get(k, k)
@@ -393,24 +394,39 @@ def plan_rule(rule: RuleDef, store) -> Topo:
     from .optimizer import referenced_columns
 
     needed = referenced_columns(stmt)
-    source_nodes: List[SourceNode] = []
-    for tbl in stream_tbls:
-        src_name = tbl.ref_name if multi else tbl.name
-        source_nodes.append(
-            _plan_stream_source(tbl.name, src_name, opts, store, topo,
-                                project_columns=needed))
-
     kernel_plan = device_path_eligible(stmt, opts)
-    if kernel_plan is not None and len(source_nodes) == 1 and not lookup_joins:
-        tail = _build_device_chain(
-            topo, stmt, kernel_plan, source_nodes[0], opts, rule_id=rule.id
-        )
-    else:
-        tail = _build_host_chain(
-            topo, stmt, source_nodes, opts, rule.id,
-            stream_joins=stream_joins, lookup_joins=lookup_joins, store=store,
-            source_names=[t.ref_name if multi else t.name
-                          for t in stream_tbls])
+
+    # shared pane fold (planner/sharing.py): correlated rules over one
+    # stream fold once into a pooled pane store and combine per window —
+    # when the rewrite applies, the rule needs no per-rule source entry at
+    # all (its data flows source → shared fold → its emit hop)
+    tail = None
+    if kernel_plan is not None and len(stream_tbls) == 1 and not stmt.joins:
+        from .sharing import try_plan_shared
+
+        tail = try_plan_shared(topo, stmt, kernel_plan, opts, rule, store)
+
+    if tail is None:
+        source_nodes: List[SourceNode] = []
+        for tbl in stream_tbls:
+            src_name = tbl.ref_name if multi else tbl.name
+            source_nodes.append(
+                _plan_stream_source(tbl.name, src_name, opts, store, topo,
+                                    project_columns=needed))
+
+        if kernel_plan is not None and len(source_nodes) == 1 \
+                and not lookup_joins:
+            tail = _build_device_chain(
+                topo, stmt, kernel_plan, source_nodes[0], opts,
+                rule_id=rule.id
+            )
+        else:
+            tail = _build_host_chain(
+                topo, stmt, source_nodes, opts, rule.id,
+                stream_joins=stream_joins, lookup_joins=lookup_joins,
+                store=store,
+                source_names=[t.ref_name if multi else t.name
+                              for t in stream_tbls])
 
     # sinks
     actions = rule.actions or [{"log": {}}]
@@ -546,16 +562,26 @@ def _equality_key_fields(join: ast.Join) -> List:
     return pairs
 
 
-def _plan_stream_source(stream_name: str, src_name: str, opts, store,
-                        topo: Topo, project_columns=None):
-    """Build (or ride) the ingest+decode pipeline for one stream: a pooled
-    shared subtopo for qos=0 rules, a topo-private SourceNode otherwise.
-    Returns the node rule chains connect to."""
+def _with_ts_field(project_columns, stream, opts):
+    """Pruning set + the event-time timestamp field (which the stream must
+    always retain) — THE one definition, shared by the subtopo builder and
+    the per-rule entry projection so the two can never drift."""
+    ts_field = stream.options.timestamp if opts.is_event_time else ""
+    if project_columns is not None and ts_field:
+        return set(project_columns) | {ts_field}
+    return project_columns
+
+
+def _subtopo_spec(stream_name: str, src_name: str, opts, store,
+                  project_columns=None):
+    """(subtopo pool key, node builder, stream def) for one stream's
+    shareable ingest pipeline — factored out of _plan_stream_source so the
+    shared-fold pass (planner/sharing.py) can key its pane stores on the
+    same identity without planning a per-rule entry."""
     stream = load_stream_def(stream_name, store)
     props = _source_props(stream, store)
     ts_field = stream.options.timestamp if opts.is_event_time else ""
-    if project_columns is not None and ts_field:
-        project_columns = set(project_columns) | {ts_field}
+    project_columns = _with_ts_field(project_columns, stream, opts)
 
     def build_nodes(name=src_name):
         nodes = []
@@ -613,34 +639,57 @@ def _plan_stream_source(stream_name: str, src_name: str, opts, store,
             nodes.append(rl)
         return nodes
 
+    from ..runtime import subtopo as subtopo_pool
+
+    key = subtopo_pool.subtopo_key(stream_name, {
+        # everything that changes what the pipeline emits, including the
+        # emitter name (join rules match rows by emitter == alias) and
+        # the connector identity (type/datasource can change across
+        # DROP/CREATE STREAM between plans)
+        "name": src_name,
+        "type": stream.options.type or "memory",
+        "datasource": stream.options.datasource,
+        "props": props,
+        "format": stream.options.format or "json",
+        "fields": [f.name for f in stream.fields],
+        "ts": ts_field,
+        "strict": stream.options.strict_validation,
+        "mb": opts.micro_batch_rows,
+        "linger": opts.micro_batch_linger_ms,
+        "pool": [opts.decode_pool_size, opts.decode_shards,
+                 opts.ingest_ring_depth, opts.ingest_prep_upload],
+    })
+    return key, build_nodes, stream
+
+
+def _plan_stream_source(stream_name: str, src_name: str, opts, store,
+                        topo: Topo, project_columns=None):
+    """Build (or ride) the ingest+decode pipeline for one stream: a pooled
+    shared subtopo for qos=0 rules, a topo-private SourceNode otherwise.
+    Returns the node rule chains connect to."""
+    key, build_nodes, stream = _subtopo_spec(
+        stream_name, src_name, opts, store, project_columns=project_columns)
+    project_columns = _with_ts_field(project_columns, stream, opts)
+
     if opts.share_source and opts.qos == 0:
-        from ..runtime import subtopo as subtopo_pool
         from ..runtime.subtopo import SharedEntryNode, SubTopoRef
 
-        key = subtopo_pool.subtopo_key(stream_name, {
-            # everything that changes what the pipeline emits, including the
-            # emitter name (join rules match rows by emitter == alias) and
-            # the connector identity (type/datasource can change across
-            # DROP/CREATE STREAM between plans)
-            "name": src_name,
-            "type": stream.options.type or "memory",
-            "datasource": stream.options.datasource,
-            "props": props,
-            "format": stream.options.format or "json",
-            "fields": [f.name for f in stream.fields],
-            "ts": ts_field,
-            "strict": stream.options.strict_validation,
-            "mb": opts.micro_batch_rows,
-            "linger": opts.micro_batch_linger_ms,
-            "pool": [opts.decode_pool_size, opts.decode_shards,
-                     opts.ingest_ring_depth, opts.ingest_prep_upload],
-        })
         entry = SharedEntryNode(f"{src_name}_shared",
                                 project_columns=project_columns,
                                 buffer_length=opts.buffer_length)
         topo.add_op(entry)
         topo.add_shared_source(SubTopoRef(key, build_nodes), entry)
         return entry
+
+    if opts.share_source and opts.qos > 0:
+        # explicit, logged fallback (ISSUE 4 satellite): the qos=0-only
+        # restriction on pooled pipelines was silent convention before —
+        # checkpoint barriers are rule-scoped and cannot flow through a
+        # pipeline serving other rules
+        logger.info(
+            "rule %s: qos=%d requires rule-scoped checkpoint barriers — "
+            "using a private source pipeline (shared subtopos and shared "
+            "folds serve qos=0 rules only)", topo.rule_id, opts.qos)
 
     nodes = build_nodes()
     topo.add_source(nodes[0])
@@ -960,9 +1009,23 @@ def explain(rule: RuleDef, store) -> Dict[str, Any]:
     stmt = parse_select(rule.sql)
     opts = merged_options(rule)
     kernel_plan = device_path_eligible(stmt, opts)
-    path = "device-fused" if kernel_plan is not None else "host"
+    sharing_info = None
+    if kernel_plan is not None and len(stmt.sources) == 1 and not stmt.joins:
+        from . import sharing as sharing_mod
+
+        try:
+            sharing_info = sharing_mod.explain_decision(
+                rule, stmt, opts, kernel_plan, store)
+        except Exception as exc:  # explain must never fail on the probe
+            sharing_info = {"decision": "private", "reason": str(exc)}
+    shared = bool(sharing_info and sharing_info.get("decision") == "shared")
+    path = ("device-fused-shared" if shared
+            else "device-fused" if kernel_plan is not None else "host")
     ops: List[str] = ["source"]
-    if kernel_plan is not None:
+    if shared:
+        ops.append("shared_pane_fold[TPU]")
+        ops.append("emit_combine")
+    elif kernel_plan is not None:
         ops.append("fused_window_groupby_agg[TPU]")
         if stmt.having is not None:
             ops.append("having")
@@ -988,4 +1051,7 @@ def explain(rule: RuleDef, store) -> Dict[str, Any]:
             ops.append("order")
         ops.append("project")
     ops.append("sink")
-    return {"path": path, "operators": ops}
+    out: Dict[str, Any] = {"path": path, "operators": ops}
+    if sharing_info is not None:
+        out["sharing"] = sharing_info
+    return out

@@ -49,6 +49,11 @@ class RuleRegistry:
             rs = RuleState(rule, self.store)
         except Exception:
             self.processor.drop(rule.id)
+            # the failed validation plan may have declared sharing
+            # candidacy for a rule that will never exist
+            from ..planner import sharing
+
+            sharing.undeclare(rule.id)
             raise
         with self._lock:
             self._rules[rule.id] = rs
@@ -59,6 +64,11 @@ class RuleRegistry:
 
     def update(self, rule_json: Dict[str, Any]) -> None:
         rule = self.processor.update(rule_json)
+        # drop stale sharing candidacy (the SQL/options may have changed
+        # its store key); the restart below re-declares under the new one
+        from ..planner import sharing
+
+        sharing.undeclare(rule.id)
         with self._lock:
             rs = self._rules.get(rule.id)
         if rs is not None:
@@ -83,6 +93,11 @@ class RuleRegistry:
             rs.stop()
         self.processor.drop(rule_id)
         self.store.kv("rule_run_state").delete(rule_id)
+        # a deleted rule must stop counting as a sharing peer (ghost
+        # declarations would make a later lone rule share with nobody)
+        from ..planner import sharing
+
+        sharing.undeclare(rule_id)
 
     # --------------------------------------------------------------- lifecycle
     def _get(self, rule_id: str) -> RuleState:
@@ -195,11 +210,29 @@ class RuleRegistry:
         rule = RuleDef.from_dict(rule_json)
         if not rule.sql and rule.graph is None:
             return {"valid": False, "error": "rule sql or graph is required"}
+        # a validation probe must neither LEAVE sharing candidacy behind
+        # (a phantom peer would flip later lone rules to shared) nor
+        # OVERWRITE a registered rule's live declaration (probing an
+        # existing id with a different window would skew the pane GCD of
+        # future stores); the rollback is scoped to the probed id so
+        # concurrent rule CRUD on other rules is untouched
+        from ..planner import sharing
+
+        existed = rule.id in set(self.processor.list()) if rule.id else False
         try:
-            plan_rule(rule, self.store).close()
-            return {"valid": True}
-        except Exception as exc:
-            return {"valid": False, "error": str(exc)}
+            with sharing.probe_declarations(rule.id):
+                try:
+                    plan_rule(rule, self.store).close()
+                    return {"valid": True}
+                except Exception as exc:
+                    return {"valid": False, "error": str(exc)}
+        finally:
+            # probe restore races a concurrent DELETE of the same id: the
+            # restored declaration would resurrect a ghost peer — drop it
+            # when the rule vanished (or never existed) during the probe
+            if rule.id and (not existed
+                            or rule.id not in set(self.processor.list())):
+                sharing.undeclare(rule.id)
 
     def reset_state(self, rule_id: str) -> None:
         """Drop checkpointed state (REST /rules/{id}/reset_state)."""

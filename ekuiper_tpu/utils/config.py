@@ -79,6 +79,12 @@ class RuleOptionConfig:
     # one shared ingest+decode pipeline per stream config across qos=0 rules
     # (reference subtopo_pool); checkpointed rules always get a private source
     share_source: bool = True
+    # cost-based cross-rule window-aggregate sharing (planner/sharing.py):
+    # correlated rules over one stream fold once into a shared pane store
+    # and combine panes per window. qos=0 + share_source only; the planner
+    # falls back to a private fold (logged) when the rewrite doesn't apply
+    # or its cost model says it won't pay.
+    shared_fold: bool = True
     # planOptimizeStrategy analogue (reference: internal/pkg/def/rule.go:55-66);
     # {"mesh": {"rows": R, "keys": K}} runs the fused kernel sharded over an
     # R x K device mesh (parallel/sharded.py)

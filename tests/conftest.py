@@ -30,14 +30,20 @@ from ekuiper_tpu.store import kv  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def fresh_engine_state():
-    """Fresh mock clock + in-memory store + empty subtopo pool per test."""
-    from ekuiper_tpu.runtime import subtopo
+    """Fresh mock clock + in-memory store + empty subtopo/shared-fold
+    pools per test."""
+    from ekuiper_tpu.planner import sharing
+    from ekuiper_tpu.runtime import nodes_sharedfold, subtopo
 
     clock = timex.set_mock_clock(0)
     kv.setup("memory")
+    nodes_sharedfold.reset()
     subtopo.reset()
+    sharing.reset()
     yield clock
+    nodes_sharedfold.reset()
     subtopo.reset()
+    sharing.reset()
     timex.use_real_clock()
 
 

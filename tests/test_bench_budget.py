@@ -1,0 +1,60 @@
+"""bench.py phase-budget invariant (ISSUE 4 satellite — the r05 rc=124
+post-mortem class of bug): phase budgets are carved from the remaining
+global budget, so no sequence of phases can ever be ALLOWED to spend past
+TOTAL_BUDGET_S — the driver's hard kill can then never land before the
+bench's own watchdog flushes the artifact."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def _simulate(nominals, total, reserve):
+    """Carve each phase's budget from the simulated remaining budget and
+    let the phase consume ALL of it (the worst case the clamp must bound).
+    Returns (per-phase budgets, total spend)."""
+    remaining = total
+    budgets = []
+    for nominal in nominals:
+        b = bench.phase_budget(nominal, remaining_s=remaining,
+                               reserve_s=reserve)
+        assert b >= 0.0
+        assert b <= nominal
+        budgets.append(b)
+        remaining -= b  # phase runs to its full allowance
+    return budgets, total - remaining
+
+
+def test_budgets_never_sum_past_global_budget():
+    rng = np.random.default_rng(42)
+    for _ in range(200):
+        n = int(rng.integers(1, 12))
+        nominals = rng.uniform(10.0, 2000.0, n).tolist()
+        total = float(rng.uniform(30.0, 1200.0))
+        reserve = float(rng.uniform(0.0, 30.0))
+        budgets, spent = _simulate(nominals, total, reserve)
+        assert spent <= total + 1e-9, (nominals, total, budgets)
+
+
+def test_exhausted_budget_yields_zero():
+    assert bench.phase_budget(600.0, remaining_s=10.0, reserve_s=15.0) == 0.0
+    assert bench.phase_budget(600.0, remaining_s=-5.0) == 0.0
+
+
+def test_reserve_is_kept_for_the_artifact_flush():
+    # a phase can never be granted the final reserve_s of the budget
+    b = bench.phase_budget(10_000.0, remaining_s=100.0, reserve_s=15.0)
+    assert b == 85.0
+
+
+def test_bench_registry_includes_multi_rule_shared():
+    """The new phase is wired into main()'s budgeted phase table."""
+    import inspect
+
+    src = inspect.getsource(bench.main)
+    assert "multi_rule_shared" in src
+    assert "phase_budget" in src

@@ -1,0 +1,655 @@
+"""Shared pane-fold subsystem (planner/sharing.py + ops/panestore.py +
+runtime/nodes_sharedfold.py): correlated rules over one stream fold once
+into a shared pane store; per-rule emitted windows must be bit-for-bit
+what the unshared plan produces, across tumbling/hopping and
+processing/event time, including attach/detach mid-stream.
+
+Parity inputs use integer-valued float32 measurements so pane-sum
+association is exact (docs/SHARING.md "exactness" section): count/min/max
+are order-independent, and integer-valued sums are exactly representable,
+so shared-vs-private comparison is byte-identical, not approximate.
+"""
+import logging
+import time
+
+import numpy as np
+import pytest
+
+from ekuiper_tpu.data.batch import ColumnBatch
+from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+from ekuiper_tpu.ops.emit import build_direct_emit
+from ekuiper_tpu.ops.panestore import pane_gcd, spec_map_into, union_plan
+from ekuiper_tpu.planner import sharing
+from ekuiper_tpu.planner.planner import RuleDef, explain, plan_rule
+from ekuiper_tpu.runtime import nodes_sharedfold as sf
+from ekuiper_tpu.runtime import subtopo
+from ekuiper_tpu.runtime.events import Trigger, Watermark
+from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+from ekuiper_tpu.runtime.nodes_sharedfold import (
+    MemberSpec, SharedEmitNode, SharedFoldNode)
+from ekuiper_tpu.data.rows import WindowRange
+from ekuiper_tpu.server.processors import StreamProcessor
+from ekuiper_tpu.sql import ast
+from ekuiper_tpu.sql.parser import parse_select
+from ekuiper_tpu.store import kv
+from ekuiper_tpu.utils.infra import logger
+import ekuiper_tpu.io.memory as mem
+
+SQLS = [
+    "SELECT deviceId, avg(temperature) AS a, count(*) AS c FROM demo "
+    "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)",
+    "SELECT deviceId, min(temperature) AS mn, max(temperature) AS mx "
+    "FROM demo GROUP BY deviceId, HOPPINGWINDOW(ss, 10, 5)",
+    "SELECT deviceId, sum(temperature) AS s, count(*) AS c FROM demo "
+    "GROUP BY deviceId, TUMBLINGWINDOW(ss, 20)",
+]
+
+
+def _plans(sqls=SQLS):
+    stmts = [parse_select(s) for s in sqls]
+    return stmts, [extract_kernel_plan(s) for s in stmts]
+
+
+def _member(i, stmt, plan, emit_columnar=True):
+    w = stmt.window
+    length = w.length_ms()
+    iv = w.interval_ms() or length
+    return MemberSpec(
+        rule_id=f"r{i}", length_ms=length, interval_ms=iv, plan=plan,
+        direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+        dims=["deviceId"], emit_columnar=emit_columnar)
+
+
+def _private(stmt, plan, **kw):
+    node = FusedWindowAggNode(
+        "priv", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
+        capacity=64, micro_batch=128,
+        direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+        emit_columnar=True, prefinalize_lead_ms=0, **kw)
+    node.state = node.gb.init_state()
+    got = []
+    node.broadcast = lambda item, g=got: g.append(item)
+    return node, got
+
+
+def _int_batch(rng, n, t0=0, span_ms=1):
+    """Integer-valued measurements: pane-sum association is exact."""
+    ids = np.array([f"d{rng.integers(0, 8)}" for _ in range(n)],
+                   dtype=np.object_)
+    temp = np.rint(rng.normal(20, 5, n)).astype(np.float32)
+    ts = np.sort(rng.integers(t0, t0 + span_ms, n)).astype(np.int64)
+    return ColumnBatch(n=n, columns={"deviceId": ids, "temperature": temp},
+                       timestamps=ts, emitter="demo")
+
+
+def _copy(b):
+    return ColumnBatch(n=b.n, columns=b.columns, valid=b.valid,
+                       timestamps=b.timestamps, emitter=b.emitter)
+
+
+def _drain_cbs(entry):
+    out = []
+    while not entry.inq.empty():
+        item = entry.inq.get_nowait()
+        if isinstance(item, ColumnBatch):
+            out.append(item)
+    return out
+
+
+def _assert_cb_equal(a, b, ctx=""):
+    assert set(a.columns) == set(b.columns), ctx
+    for c in a.columns:
+        assert a.columns[c].dtype == b.columns[c].dtype, (ctx, c)
+        assert np.array_equal(a.columns[c], b.columns[c]), (ctx, c)
+
+
+def _private_boundary(p, end):
+    iv = p.interval_ms or p.length_ms
+    if end % iv:
+        return
+    p._emit(WindowRange(end - p.length_ms, end))
+    if p.wt == ast.WindowType.TUMBLING_WINDOW:
+        p.state = p.gb.reset_pane(p.state, 0)
+    else:
+        p.cur_pane = (p.cur_pane + 1) % p.n_panes
+        p.state = p.gb.reset_pane(p.state, p.cur_pane)
+
+
+class TestUnionPlan:
+    def test_dedup_and_maps(self):
+        stmts, plans = _plans([
+            SQLS[0],
+            "SELECT deviceId, count(*) AS c, avg(temperature) AS a, "
+            "sum(temperature) AS s FROM demo "
+            "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)",
+        ])
+        union, maps = union_plan(plans)
+        # avg + count shared; sum added once
+        assert [s.kind for s in union.specs] == ["avg", "count", "sum"]
+        assert maps == [[0, 1], [1, 0, 2]]
+        assert spec_map_into(union, plans[0]) == [0, 1]
+        with pytest.raises(KeyError):
+            spec_map_into(plans[0], plans[1])  # sum not covered
+
+    def test_pane_gcd(self):
+        assert pane_gcd([10_000, 5_000, 20_000]) == 5_000
+        assert pane_gcd([10_000, 15_000]) == 5_000
+        assert pane_gcd([]) == 1
+
+
+class TestParityProcessingTime:
+    def test_tumbling_hopping_byte_identical(self):
+        stmts, plans = _plans()
+        union, _ = union_plan(plans)
+        pane = pane_gcd([10_000, 5_000, 20_000])
+        store = SharedFoldNode("k", "sf", union, pane, 6, subtopo_ref=None,
+                               capacity=64, micro_batch=128)
+        store._cur_bucket = 0
+        entries = []
+        for i, (stmt, plan) in enumerate(zip(stmts, plans)):
+            e = SharedEmitNode(f"r{i}_emit")
+            assert store.attach_rule(_member(i, stmt, plan), e, None)
+            entries.append(e)
+        privs = [_private(stmt, plan) for stmt, plan in zip(stmts, plans)]
+        rng = np.random.default_rng(3)
+        for end in (5_000, 10_000, 15_000, 20_000, 25_000, 30_000):
+            for _ in range(2):
+                b = _int_batch(rng, 100)
+                store.process(b)
+                for p, _g in privs:
+                    p.process(_copy(b))
+            store.on_trigger(Trigger(ts=end))
+            for p, _g in privs:
+                _private_boundary(p, end)
+        for i, e in enumerate(entries):
+            shared = _drain_cbs(e)
+            priv = [x for x in privs[i][1] if isinstance(x, ColumnBatch)]
+            assert shared and len(shared) == len(priv), (i, len(shared),
+                                                         len(priv))
+            for s, p in zip(shared, priv):
+                _assert_cb_equal(s, p, ctx=f"rule {i}")
+        # dedup accounting: one fold per batch for 3 members
+        assert store.folds_did == 12
+        assert store.fold_dedup_ratio() == pytest.approx(2 / 3)
+
+    def test_emissions_carry_ingest_provenance(self):
+        """Shared-fold window emissions must stamp ingest_ms (the PR 3
+        e2e SLO layer) exactly like the private node's emit() would —
+        send_to alone doesn't stamp."""
+        stmts, plans = _plans(SQLS[:1])
+        union, _ = union_plan(plans)
+        store = SharedFoldNode("k", "sf", union, 10_000, 3,
+                               subtopo_ref=None, capacity=64,
+                               micro_batch=128)
+        store._cur_bucket = 0
+        e = SharedEmitNode("r0_emit")
+        store.attach_rule(_member(0, stmts[0], plans[0]), e, None)
+        rng = np.random.default_rng(6)
+        b = _int_batch(rng, 40)
+        b.ingest_ms = 1234  # what a source node would stamp
+        store._cur_ingest_ms = 1234  # node fabric sets this per dispatch
+        store.process(b)
+        store.on_trigger(Trigger(ts=10_000))
+        got = _drain_cbs(e)
+        assert got and got[0].ingest_ms == 1234
+
+    def test_tick_trigger_carries_scheduled_boundary(self, monkeypatch):
+        """The real clock invokes timer callbacks with the ACTUAL
+        (sleep-overshot) fire time; the tick must enqueue the SCHEDULED
+        pane boundary or every member's `end % interval == 0` emission
+        gate fails forever in production."""
+        from ekuiper_tpu.utils import timex as timex_mod
+
+        stmts, plans = _plans(SQLS[:1])
+        union, _ = union_plan(plans)
+        store = SharedFoldNode("k", "sf", union, 5_000, 4, subtopo_ref=None,
+                               capacity=64, micro_batch=128)
+        captured = {}
+
+        def fake_after(ms, cb=None):
+            captured["cb"] = cb
+
+            class T:
+                def stop(self):
+                    pass
+
+            return T()
+
+        monkeypatch.setattr(timex_mod, "after", fake_after)
+        store._schedule_tick()
+        expected = timex_mod.align_to_window(timex_mod.now_ms() + 1, 5_000)
+        captured["cb"](expected + 3)  # simulate sleep overshoot
+        trig = store.inq.get_nowait()
+        assert trig.ts == expected  # aligned, NOT the late fire time
+
+    def test_attach_midstream_warms_from_live_panes(self):
+        stmts, plans = _plans()
+        union, _ = union_plan(plans)
+        store = SharedFoldNode("k", "sf", union, 5_000, 6, subtopo_ref=None,
+                               capacity=64, micro_batch=128)
+        store._cur_bucket = 0
+        e0 = SharedEmitNode("r0_emit")
+        store.attach_rule(_member(0, stmts[0], plans[0]), e0, None)
+        rng = np.random.default_rng(4)
+        store.process(_int_batch(rng, 60))
+        store.on_trigger(Trigger(ts=5_000))
+        # late joiner: attaches mid-window, without restarting the peer
+        e1 = SharedEmitNode("r1_emit")
+        store.attach_rule(_member(1, stmts[1], plans[1]), e1, None)
+        assert store.member_count() == 2
+        store.process(_int_batch(rng, 60))
+        store.on_trigger(Trigger(ts=10_000))
+        # the late joiner's first window covers the LIVE panes — including
+        # rows folded before it attached (warm-attach semantics)
+        got = _drain_cbs(e1)
+        assert got and int(got[0].columns["mx"].shape[0]) > 0
+        assert _drain_cbs(e0)  # peer kept emitting
+        # detach mid-stream: peer unaffected, store survives
+        store.detach_rule("r1")
+        assert store.member_count() == 1
+        store.process(_int_batch(rng, 60))
+        store.on_trigger(Trigger(ts=15_000))
+        store.on_trigger(Trigger(ts=20_000))
+        assert _drain_cbs(e0)
+        store.detach_rule("r0")  # last detach closes the store
+
+
+class TestEventTimeRecycleGuard:
+    def test_stale_rows_drop_instead_of_corrupting_newer_pane(self):
+        """A row whose pane a NEWER bucket already claimed must DROP
+        (counted), never fold into the newer bucket's window."""
+        stmts, plans = _plans(SQLS[:1])
+        union, _ = union_plan(plans)
+        store = SharedFoldNode("k", "sf", union, 1_000, 4, subtopo_ref=None,
+                               capacity=64, micro_batch=128,
+                               is_event_time=True)
+        e = SharedEmitNode("r0_emit")
+        store.attach_rule(MemberSpec(
+            rule_id="r0", length_ms=1_000, interval_ms=1_000,
+            plan=plans[0],
+            direct_emit=build_direct_emit(stmts[0], plans[0], ["deviceId"]),
+            dims=["deviceId"]), e, None)
+
+        def at(bucket, n):
+            ids = np.array(["d0"] * n, dtype=np.object_)
+            return ColumnBatch(
+                n=n, columns={"deviceId": ids,
+                              "temperature": np.full(n, 10.0, np.float32)},
+                timestamps=np.full(n, bucket * 1_000 + 5, dtype=np.int64),
+                emitter="demo")
+
+        store.process(at(0, 3))
+        store.process(at(10, 4))  # bucket 10 claims pane 10 % 4 = 2
+        exc_before = store.stats.snapshot()["exceptions_total"]
+        store.process(at(2, 5))   # pane 2 % 4 = 2 held by NEWER bucket 10
+        assert store.stats.snapshot()["exceptions_total"] > exc_before
+        store.on_watermark(Watermark(ts=11_000))
+        got = _drain_cbs(e)
+        # bucket 10's window counts exactly its own 4 rows — the 5 stale
+        # rows were dropped, not folded into pane 2
+        counts = {int(cb.columns["c"][0]) for cb in got}
+        assert 4 in counts and 9 not in counts, counts
+
+    def test_recycled_pane_never_leaks_future_rows_into_old_window(self):
+        """A pane recycled to a newer bucket must be EXCLUDED from an old
+        window's combine — its loss was counted at recycle time; merging
+        it would fold future rows into the old window (corruption)."""
+        stmts, plans = _plans(SQLS[:1])  # tumbling, but length overridden
+        union, _ = union_plan(plans)
+        store = SharedFoldNode("k", "sf", union, 1_000, 6, subtopo_ref=None,
+                               capacity=64, micro_batch=128,
+                               is_event_time=True)
+        e = SharedEmitNode("r0_emit")
+        store.attach_rule(MemberSpec(
+            rule_id="r0", length_ms=4_000, interval_ms=4_000,
+            plan=plans[0],
+            direct_emit=build_direct_emit(stmts[0], plans[0], ["deviceId"]),
+            dims=["deviceId"]), e, None)
+
+        def at(bucket, n):
+            ids = np.array(["d0"] * n, dtype=np.object_)
+            return ColumnBatch(
+                n=n, columns={"deviceId": ids,
+                              "temperature": np.full(n, 5.0, np.float32)},
+                timestamps=np.full(n, bucket * 1_000 + 5, dtype=np.int64),
+                emitter="demo")
+
+        for b in range(4):  # buckets 0..3 (the [0,4000) window)
+            store.process(at(b, 2))
+        store.process(at(6, 7))  # bucket 6 recycles pane 0 (6 % 6)
+        store.on_watermark(Watermark(ts=4_000))
+        got = _drain_cbs(e)
+        assert got, "window [0,4000) must still emit from buckets 1-3"
+        # bucket 0's 2 rows were lost (counted); bucket 6's 7 rows must
+        # NOT appear: count is exactly buckets 1-3 = 6 rows
+        assert int(got[0].columns["c"][0]) == 6, got[0].columns["c"]
+
+    def test_wide_batch_spread_drops_aliasing_rows(self):
+        """One batch spanning >= n_panes buckets would alias two buckets
+        onto one pane WITHIN one fold — older rows drop (counted)."""
+        stmts, plans = _plans(SQLS[:1])
+        union, _ = union_plan(plans)
+        store = SharedFoldNode("k", "sf", union, 1_000, 4, subtopo_ref=None,
+                               capacity=64, micro_batch=128,
+                               is_event_time=True)
+        e = SharedEmitNode("r0_emit")
+        store.attach_rule(MemberSpec(
+            rule_id="r0", length_ms=1_000, interval_ms=1_000,
+            plan=plans[0],
+            direct_emit=build_direct_emit(stmts[0], plans[0], ["deviceId"]),
+            dims=["deviceId"]), e, None)
+        n = 10
+        ids = np.array(["d0"] * n, dtype=np.object_)
+        ts = np.array([b * 1_000 + 5 for b in range(n)], dtype=np.int64)
+        store.process(ColumnBatch(
+            n=n, columns={"deviceId": ids,
+                          "temperature": np.full(n, 1.0, np.float32)},
+            timestamps=ts, emitter="demo"))
+        # buckets 0..5 aliased (spread 10 >= 4 panes): dropped + counted
+        assert store.stats.snapshot()["exceptions_total"] >= 1
+        store.on_watermark(Watermark(ts=20_000))
+        got = _drain_cbs(e)
+        assert all(int(cb.columns["c"][0]) == 1 for cb in got)
+        assert len(got) == 4  # only the surviving newest buckets emitted
+
+
+class TestParityEventTime:
+    def test_event_time_byte_identical(self):
+        stmts, plans = _plans(SQLS[:2])
+        union, _ = union_plan(plans)
+        store = SharedFoldNode("k", "sf", union, 5_000, 6, subtopo_ref=None,
+                               capacity=64, micro_batch=128,
+                               is_event_time=True)
+        entries = []
+        for i, (stmt, plan) in enumerate(zip(stmts, plans)):
+            e = SharedEmitNode(f"r{i}_emit")
+            store.attach_rule(_member(i, stmt, plan), e, None)
+            entries.append(e)
+        privs = [_private(stmt, plan, is_event_time=True,
+                          late_tolerance_ms=0)
+                 for stmt, plan in zip(stmts, plans)]
+        rng = np.random.default_rng(9)
+        for k in range(10):
+            b = _int_batch(rng, 80, t0=12_000 + k * 3_000, span_ms=3_000)
+            store.process(b)
+            for p, _g in privs:
+                p.process(_copy(b))
+            wm_ts = 12_000 + k * 3_000
+            store.on_watermark(Watermark(ts=wm_ts))
+            for p, _g in privs:
+                p.on_watermark(Watermark(ts=wm_ts))
+        for i, e in enumerate(entries):
+            shared = _drain_cbs(e)
+            priv = [x for x in privs[i][1] if isinstance(x, ColumnBatch)]
+            assert shared and len(shared) == len(priv)
+            for s, p in zip(shared, priv):
+                _assert_cb_equal(s, p, ctx=f"evt rule {i}")
+
+
+def _mk_stream(store, topic="t/sf"):
+    StreamProcessor(store).exec_stmt(
+        'CREATE STREAM demo (deviceId STRING, temperature FLOAT) '
+        f'WITH (DATASOURCE="{topic}", TYPE="memory", FORMAT="JSON")')
+
+
+def _rule(rid, sql, **opts):
+    return RuleDef(id=rid, sql=sql,
+                   actions=[{"memory": {"topic": f"out/{rid}"}}],
+                   options=opts)
+
+
+def _flat(msgs):
+    out = []
+    for p in msgs:
+        out.extend(p if isinstance(p, list) else [p])
+    return sorted(out, key=str)
+
+
+class TestPlannerIntegration:
+    def test_correlated_rules_share_and_match_private_plan(self, mock_clock):
+        store = kv.get_store()
+        _mk_stream(store)
+        r1 = _rule("r1", SQLS[0])
+        r2 = _rule("r2", SQLS[1])
+        rp = _rule("rp", SQLS[0], sharedFold=False)  # private reference
+        # first plan of a lone rule stays private but DECLARES candidacy;
+        # planning the peer then replanning r1 converges both onto the
+        # shared fold (create-order independence via declarations)
+        t_first = plan_rule(r1, store)
+        # lone rule: shared SOURCE (subtopo) but a private fused fold
+        assert any(isinstance(n, FusedWindowAggNode) for n in t_first.ops)
+        assert not any(isinstance(ref, sf.SharedFoldRef)
+                       for ref, _ in t_first.shared)
+        t2 = plan_rule(r2, store)  # sees r1's declaration -> shared
+        t1 = plan_rule(r1, store)  # replan joins the fleet
+        tp = plan_rule(rp, store)
+        # shared plan: no private source, no private fused node
+        assert not t1.sources
+        assert not any(isinstance(n, FusedWindowAggNode) for n in t1.ops)
+        assert any(isinstance(n, FusedWindowAggNode) for n in tp.ops)
+        t1.open(); t2.open(); tp.open()
+        try:
+            assert sf.pool_size() == 1 and subtopo.pool_size() == 1
+            st = sf.live_stores()[0]
+            assert st.member_count() == 2
+            assert st.pane_ms == 5_000  # GCD of 10s tumbling + 10s/5s hop
+            got = {r: [] for r in ("r1", "rp")}
+            for r in got:
+                mem.subscribe(f"out/{r}", lambda t, p, r=r: got[r].append(p))
+            rng = np.random.default_rng(5)
+            for _ in range(60):
+                mem.publish("t/sf", {
+                    "deviceId": f"d{rng.integers(0, 8)}",
+                    "temperature": float(np.rint(rng.normal(20, 5)))})
+            mock_clock.advance(20)  # linger flush
+            deadline = time.time() + 8
+            while time.time() < deadline and not (
+                    t1.wait_idle(2) and tp.wait_idle(2)):
+                time.sleep(0.02)
+            mock_clock.advance(10_000 - 20)  # tumbling boundary
+            deadline = time.time() + 8
+            while time.time() < deadline and not (got["r1"] and got["rp"]):
+                time.sleep(0.02)
+            assert _flat(got["r1"]) == _flat(got["rp"]) != []
+            # one fold served both rules
+            assert st.folds_did >= 1 and st.fold_dedup_ratio() > 0
+        finally:
+            t1.close()
+            assert sf.live_stores() and \
+                sf.live_stores()[0].member_count() == 1
+            t2.close(); tp.close()
+        assert sf.pool_size() == 0 and subtopo.pool_size() == 0
+
+    def test_explain_shows_sharing_decision(self):
+        store = kv.get_store()
+        _mk_stream(store)
+        # no peers yet: private, but the reason says it is a candidate
+        out = explain(_rule("rx", SQLS[0]), store)
+        assert out["path"] == "device-fused"
+        assert out["sharing"]["decision"] == "private"
+        assert "peer" in out["sharing"]["reason"]
+        # a declared correlated peer flips the decision to shared
+        plan_rule(_rule("peer", SQLS[1]), store)
+        out = explain(_rule("rx", SQLS[0]), store)
+        assert out["path"] == "device-fused-shared"
+        assert out["sharing"]["decision"] == "shared"
+        est = out["sharing"]["estimates"]
+        assert est["saved_fold_us_per_s"] > est["emit_overhead_us_per_s"]
+        # declined rule explains the reason too
+        out = explain(_rule("ry", SQLS[0], sharedFold=False), store)
+        assert out["path"] == "device-fused"
+        assert out["sharing"]["decision"] == "private"
+        assert "sharedFold" in out["sharing"]["reason"]
+
+    def test_qos_rule_gets_logged_private_fallback(self):
+        """ISSUE satellite: a qos>0 rule requesting a shared fold must get
+        an explicit, LOGGED planner fallback — not silent convention."""
+        store = kv.get_store()
+        _mk_stream(store)
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        h = Capture(level=logging.INFO)
+        logger.addHandler(h)
+        old_level = logger.level
+        logger.setLevel(logging.INFO)
+        try:
+            t = plan_rule(_rule("rq", SQLS[0], qos=1, sharedFold=True),
+                          store)
+        finally:
+            logger.setLevel(old_level)
+            logger.removeHandler(h)
+        # private plan: own source, private fused node, no shared fold
+        assert t.sources and not t.shared
+        assert any(isinstance(n, FusedWindowAggNode) for n in t.ops)
+        assert sf.pool_size() == 0
+        msgs = [r.getMessage() for r in records]
+        assert any("qos" in m and ("declined" in m or "private" in m)
+                   for m in msgs), msgs
+
+    def test_cost_model_declines_wide_span(self):
+        """A window spanning more shared panes than the cap keeps its
+        private fold, with the reason visible in the decision."""
+        store = kv.get_store()
+        _mk_stream(store)
+        # declare a 1s-tumbling peer, then probe a 600s window: span 600
+        plan_rule(_rule("rs", SQLS[0].replace(
+            "TUMBLINGWINDOW(ss, 10)", "TUMBLINGWINDOW(ss, 1)")), store)
+        out = explain(_rule("rw", SQLS[0].replace(
+            "TUMBLINGWINDOW(ss, 10)", "TUMBLINGWINDOW(ss, 600)")), store)
+        assert out["sharing"]["decision"] == "private"
+        assert "panes" in out["sharing"]["reason"]
+
+    def test_uncorrelated_where_does_not_share(self):
+        """Different WHERE clauses gate different fold inputs — distinct
+        stores (key includes the WHERE expression)."""
+        store = kv.get_store()
+        _mk_stream(store)
+        def mk(rid, thresh):
+            return _rule(rid, "SELECT deviceId, count(*) AS c FROM demo "
+                         f"WHERE temperature > {thresh} GROUP BY deviceId, "
+                         "TUMBLINGWINDOW(ss, 10)")
+
+        # two pairs: within a pair the WHERE matches (they share); across
+        # pairs it differs (distinct stores — the key includes the WHERE)
+        for r in (mk("ra0", 5), mk("rb0", 50)):
+            plan_rule(r, store)  # declare candidates
+        ta, tb = plan_rule(mk("ra1", 5), store), plan_rule(mk("rb1", 50),
+                                                           store)
+        assert not ta.sources and not tb.sources  # both planned shared
+        ta.open(); tb.open()
+        try:
+            assert sf.pool_size() == 2  # two stores, one per WHERE
+            names = {st.name for st in sf.live_stores()}
+            # distinct display names: identical names would emit duplicate
+            # Prometheus series and invalidate the whole scrape
+            assert len(names) == 2, names
+        finally:
+            ta.close(); tb.close()
+
+    def test_validate_probe_leaves_no_ghost_candidacy(self):
+        """POST /rules/validate plans (and declares) but creates nothing —
+        the phantom must not count as a peer for later lone rules."""
+        from ekuiper_tpu.server.rule_manager import RuleRegistry
+
+        store = kv.get_store()
+        _mk_stream(store)
+        rr = RuleRegistry(store)
+        out = rr.validate({"id": "phantom", "sql": SQLS[0],
+                           "actions": [{"nop": {}}]})
+        assert out["valid"] is True
+        assert not sharing._declared
+        assert explain(_rule("lone", SQLS[0]),
+                       store)["sharing"]["decision"] == "private"
+        # probing a REGISTERED rule's id with a DIFFERENT window must not
+        # overwrite its live declaration (pane GCD of future stores)
+        rr.create({"id": "real", "sql": SQLS[0], "actions": [{"nop": {}}],
+                   "options": {"triggered": False}})
+        before = sharing.snapshot_declarations()
+        rr.validate({"id": "real", "sql": SQLS[0].replace(
+            "TUMBLINGWINDOW(ss, 10)", "TUMBLINGWINDOW(ss, 7)"),
+            "actions": [{"nop": {}}]})
+        assert sharing.snapshot_declarations() == before
+        rr.delete("real")
+
+    def test_delete_forgets_sharing_candidacy(self):
+        """A deleted rule must stop counting as a peer — ghost
+        declarations would make a later lone rule 'share' with nobody."""
+        from ekuiper_tpu.server.rule_manager import RuleRegistry
+
+        store = kv.get_store()
+        _mk_stream(store)
+        rr = RuleRegistry(store)
+        rr.create({"id": "ghost", "sql": SQLS[0],
+                   "actions": [{"nop": {}}],
+                   "options": {"triggered": False}})
+        assert sharing._declared  # candidacy declared at validation plan
+        rr.delete("ghost")
+        assert not sharing._declared
+        # with the ghost gone, a new lone rule stays private
+        out = explain(_rule("lone", SQLS[0]), store)
+        assert out["sharing"]["decision"] == "private"
+
+    def test_store_builder_clamps_pane_to_span_cap(self):
+        """A fine-grained declaration landing between a peer's decide()
+        and the store build must not blow the peer's span past the pane
+        cap (decide-time vs build-time GCD race): the builder drops the
+        finest declarations until every surviving span fits."""
+        from ekuiper_tpu.planner.sharing import (
+            MAX_SPAN_PANES, _store_builder, declare)
+
+        stmts, plans = _plans(SQLS[:1])
+        key = "k|fold|test"
+        declare(key, "long", 64_000, 64_000, plans[0])
+        declare(key, "fine", 70, 70, plans[0])  # gcd would become 10ms
+        opts_obj = type("O", (), {"key_slots": 64, "micro_batch_rows": 128,
+                                  "buffer_length": 16})()
+        fallback = {"length_ms": 64_000, "interval_ms": 64_000,
+                    "plan": plans[0]}
+        build = _store_builder(key, "subkey", lambda: [], "sf", opts_obj,
+                               False, 0, fallback_decl=fallback)
+        node = build()
+        node._subtopo_ref = None  # standalone: no real source pipeline
+        assert node.n_panes <= 255
+        # empty-declarations race (concurrent delete between plan and
+        # open): the builder falls back to the resolver's own declaration
+        sharing.reset()
+        node2 = _store_builder(key, "subkey", lambda: [], "sf", opts_obj,
+                               False, 0, fallback_decl=fallback)()
+        assert node2.pane_ms == 64_000 and node2.plan.specs
+        assert 64_000 // node.pane_ms <= MAX_SPAN_PANES
+        # the long rule attaches; the dropped fine rule is rejected and
+        # its restart replans against the live store (private fallback)
+        e = SharedEmitNode("long_emit")
+        assert node.attach_rule(
+            MemberSpec(rule_id="long", length_ms=64_000,
+                       interval_ms=64_000, plan=plans[0], direct_emit=None,
+                       dims=["deviceId"]), e, None)
+        with pytest.raises(RuntimeError, match="not a multiple"):
+            node.attach_rule(
+                MemberSpec(rule_id="fine", length_ms=70, interval_ms=70,
+                           plan=plans[0], direct_emit=None,
+                           dims=["deviceId"]),
+                SharedEmitNode("fine_emit"), None)
+
+
+class TestProbeSharing:
+    def test_probe_smoke(self):
+        """tools/probe_sharing.py prints the decision table for the demo
+        rule set and exits 0 (tier-1 smoke, like check_metrics)."""
+        import os
+        import subprocess
+        import sys
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, os.path.join(root, "tools",
+                                          "probe_sharing.py")],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stderr
+        assert "shared" in r.stdout
+        assert "saved" in r.stdout or "us/s" in r.stdout

@@ -73,7 +73,24 @@ def _synthetic_scrape() -> str:
         def state(self, rid):
             return State()
 
-    return render(Registry())
+    # a pooled shared fold so the kuiper_shared_fold_* families render
+    from ekuiper_tpu.runtime import nodes_sharedfold
+
+    class FakeStore:
+        name = "shared_fold[lint]"
+        windows_emitted = 3
+
+        def member_count(self):
+            return 2
+
+        def fold_dedup_ratio(self):
+            return 0.5
+
+    nodes_sharedfold._stores["__lint__"] = FakeStore()
+    try:
+        return render(Registry())
+    finally:
+        nodes_sharedfold._stores.pop("__lint__", None)
 
 
 def lint(text: str, docs_text: str) -> list:

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Sharing probe — print the shared-fold decision + estimated savings for
+a rule set, EXPLAIN-driven (planner/sharing.py), without running anything.
+
+Usage:
+    python tools/probe_sharing.py [ruleset.json]
+
+ruleset.json:
+    {"streams": ["CREATE STREAM demo (...) WITH (...)", ...],
+     "rules":   [{"id": "r1", "sql": "SELECT ...", "options": {...}}, ...]}
+
+Without an argument a built-in demo set (8 correlated rules over one
+stream — the bench's multi_rule_shared shape) is probed. Rules are
+declared in listing order, so the table shows exactly what a same-order
+CREATE sequence would plan: later rules see earlier ones as peers and the
+pane is the GCD across the declared set.
+
+Run from the tier-1 suite as a smoke test (tests/test_shared_fold.py).
+Exit 0 = probe rendered; exit 1 = a stream/rule failed to parse or plan.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEMO = {
+    "streams": [
+        'CREATE STREAM demo (deviceId STRING, temperature FLOAT) '
+        'WITH (DATASOURCE="t/probe", TYPE="memory", FORMAT="JSON")',
+    ],
+    "rules": [
+        {"id": "dash_avg", "sql":
+            "SELECT deviceId, avg(temperature) AS a, count(*) AS c FROM "
+            "demo GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"},
+        {"id": "dash_minmax", "sql":
+            "SELECT deviceId, min(temperature) AS mn, max(temperature) AS "
+            "mx FROM demo GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"},
+        {"id": "alert_sum", "sql":
+            "SELECT deviceId, sum(temperature) AS s FROM demo "
+            "GROUP BY deviceId, HOPPINGWINDOW(ss, 10, 5)"},
+        {"id": "alert_cnt", "sql":
+            "SELECT deviceId, count(*) AS c FROM demo "
+            "GROUP BY deviceId, HOPPINGWINDOW(ss, 20, 5)"},
+        {"id": "trend_avg", "sql":
+            "SELECT deviceId, avg(temperature) AS a FROM demo "
+            "GROUP BY deviceId, TUMBLINGWINDOW(ss, 20)"},
+        {"id": "spread", "sql":
+            "SELECT deviceId, stddev(temperature) AS sd FROM demo "
+            "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"},
+        {"id": "fast_sum", "sql":
+            "SELECT deviceId, sum(temperature) AS s, count(*) AS c FROM "
+            "demo GROUP BY deviceId, TUMBLINGWINDOW(ss, 5)"},
+        {"id": "ckpt_avg", "sql":
+            "SELECT deviceId, avg(temperature) AS a FROM demo "
+            "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)",
+         "options": {"qos": 1}},
+    ],
+}
+
+
+def probe(doc: dict) -> int:
+    from ekuiper_tpu.ops.emit import build_direct_emit
+    from ekuiper_tpu.planner import sharing
+    from ekuiper_tpu.planner.planner import (
+        RuleDef, _subtopo_spec, device_path_eligible, merged_options)
+    from ekuiper_tpu.server.processors import StreamProcessor
+    from ekuiper_tpu.sql.parser import parse_select
+    from ekuiper_tpu.store import kv
+
+    kv.setup("memory")
+    store = kv.get_store()
+    sp = StreamProcessor(store)
+    for sql in doc.get("streams", []):
+        sp.exec_stmt(sql)
+
+    rows = []
+    for rdef in doc.get("rules", []):
+        rule = RuleDef.from_dict(rdef)
+        stmt = parse_select(rule.sql)
+        opts = merged_options(rule)
+        plan = device_path_eligible(stmt, opts)
+        if plan is None or len(stmt.sources) != 1 or stmt.joins:
+            rows.append((rule.id, "host/private", "-",
+                         "not device-fusable (no sharing candidate)"))
+            continue
+        subkey, _, _ = _subtopo_spec(
+            stmt.sources[0].name, stmt.sources[0].name, opts, store)
+        dims = [d.expr.name for d in stmt.dimensions]
+        direct = build_direct_emit(stmt, plan, dims)
+        d = sharing.decide(stmt, opts, plan, subkey, rule.id,
+                           has_direct_emit=direct is not None)
+        if d.eligible:
+            # declare so later rules in the listing see this one as a peer
+            length = stmt.window.length_ms()
+            interval = stmt.window.interval_ms() or length
+            sharing.declare(d.store_key, rule.id, length, interval, plan)
+        if d.share:
+            est = d.estimates
+            rows.append((
+                rule.id, "shared",
+                f"pane {est['pane_ms']}ms x{est['span_panes']}",
+                f"saved {est['saved_fold_us_per_s']:.0f}us/s vs "
+                f"{est['emit_overhead_us_per_s']:.0f}us/s combine "
+                f"({est['peers']} peer(s))"))
+        else:
+            rows.append((rule.id, "private", "-", d.reason))
+
+    widths = [max(len(str(r[i])) for r in rows + [("rule", "decision",
+                                                   "panes", "why")])
+              for i in range(4)]
+    header = ("rule", "decision", "panes", "why")
+    for r in (header,) + tuple(rows):
+        print("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+    n_shared = sum(1 for r in rows if r[1] == "shared")
+    print(f"\n{n_shared}/{len(rows)} rule(s) would share a pane fold.")
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) > 1:
+        with open(sys.argv[1]) as f:
+            doc = json.load(f)
+    else:
+        doc = DEMO
+    try:
+        return probe(doc)
+    except Exception as exc:  # noqa: BLE001
+        print(f"probe_sharing: {exc}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
