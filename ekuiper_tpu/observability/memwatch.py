@@ -1,0 +1,163 @@
+"""Device/host memory accounting — who owns the HBM.
+
+Every device-resident component in the engine — the sliding `_dev_ring`
+batch cache (runtime/nodes_fused.py), group-by partial state
+(ops/groupby.py), shared pane rings (ops/panestore.py), sketches
+(ops/sketches.py) — allocates against one physical HBM pool with only
+per-component budgets (`sliding_dev_ring_mb`). Before this module the
+ENGINE-WIDE footprint was invisible: a slow leak (unrecycled panes, a
+key-table that never stops growing) looked like throughput decay until
+the allocator OOM'd. Components now register a byte probe here and the
+observability layers read them all at once:
+
+- `kuiper_device_bytes{component,rule}` Prometheus gauges,
+- `GET /diagnostics/memory` (per-component rows + a `jax.live_arrays()`
+  sample — the allocator's OWN view, which catches anything that forgot
+  to register).
+
+Registration is weakref-based: a component registers `(component, rule,
+owner, fn)` where `fn(owner) -> bytes`; when the owner is garbage
+collected the row disappears on the next snapshot. No unregister calls
+on close paths to forget, no leak when one is missed. Probes run only at
+scrape/diagnostics time (pull model) — the hot path pays nothing.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional
+
+
+class _Probe:
+    __slots__ = ("component", "rule", "owner_ref", "fn")
+
+    def __init__(self, component: str, rule: str, owner: Any,
+                 fn: Callable[[Any], int]) -> None:
+        self.component = component
+        self.rule = rule
+        self.owner_ref = weakref.ref(owner)
+        self.fn = fn
+
+
+class MemRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._probes: List[_Probe] = []
+
+    def register(self, component: str, owner: Any,
+                 fn: Callable[[Any], int],
+                 rule: Optional[str] = None) -> None:
+        """Register a live-byte probe. `fn(owner)` must be cheap (an
+        attribute read or a small sum) — it runs on every scrape. `rule`
+        defaults to the registering thread's rule context."""
+        if rule is None:
+            from ..utils.rulelog import current_rule
+
+            rule = current_rule() or ""
+        with self._lock:
+            self._probes.append(_Probe(component, rule, owner, fn))
+
+    # ---------------------------------------------------------------- queries
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """[{component, rule, bytes}] for every live probe; dead owners are
+        dropped in place."""
+        with self._lock:
+            probes = list(self._probes)
+        out: List[Dict[str, Any]] = []
+        dead: List[_Probe] = []
+        for p in probes:
+            owner = p.owner_ref()
+            if owner is None:
+                dead.append(p)
+                continue
+            try:
+                n = int(p.fn(owner))
+            except Exception:
+                continue  # a probe must never fail a scrape
+            out.append({"component": p.component, "rule": p.rule,
+                        "bytes": n})
+        if dead:
+            with self._lock:
+                self._probes = [p for p in self._probes if p not in dead]
+        return out
+
+    def aggregate(self) -> Dict[tuple, int]:
+        """{(component, rule): bytes} — one gauge line per pair."""
+        agg: Dict[tuple, int] = {}
+        for row in self.snapshot():
+            key = (row["component"], row["rule"])
+            agg[key] = agg.get(key, 0) + row["bytes"]
+        return agg
+
+    def total_bytes(self) -> int:
+        return sum(r["bytes"] for r in self.snapshot())
+
+    def clear(self) -> None:
+        """Test hook."""
+        with self._lock:
+            self._probes.clear()
+
+
+_registry = MemRegistry()
+
+
+def registry() -> MemRegistry:
+    return _registry
+
+
+def register(component: str, owner: Any, fn: Callable[[Any], int],
+             rule: Optional[str] = None) -> None:
+    _registry.register(component, owner, fn, rule=rule)
+
+
+def jax_sample() -> Dict[str, Any]:
+    """The allocator's own view: every live jax.Array's bytes, by backend.
+    Ground truth against the registered probes — a large gap means a
+    component is allocating device memory without reporting it."""
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+        total = 0
+        for a in arrays:
+            try:
+                total += int(a.nbytes)
+            except Exception:
+                pass
+        return {
+            "backend": jax.default_backend(),
+            "live_arrays": len(arrays),
+            "live_bytes": total,
+        }
+    except Exception as exc:  # no jax / backend not initialized
+        return {"backend": "unavailable", "live_arrays": 0,
+                "live_bytes": 0, "error": str(exc)}
+
+
+def diagnostics() -> Dict[str, Any]:
+    """The GET /diagnostics/memory payload."""
+    rows = _registry.snapshot()
+    return {
+        "components": sorted(
+            rows, key=lambda r: (-r["bytes"], r["component"], r["rule"])),
+        "registered_bytes_total": sum(r["bytes"] for r in rows),
+        "jax": jax_sample(),
+    }
+
+
+def render_prometheus(out: List[str], esc) -> None:
+    """Append kuiper_device_bytes gauges to a /metrics scrape: one line
+    per (component, rule) plus the jax live-array sample under
+    component="jax_live_arrays" (engine-wide, so rule="__engine__")."""
+    name = "kuiper_device_bytes"
+    out.append(f"# TYPE {name} gauge")
+    out.append(f"# HELP {name} device/host bytes held per component "
+               "(self-reported; jax_live_arrays = allocator view)")
+    for (component, rule), n in sorted(_registry.aggregate().items()):
+        out.append(
+            f'{name}{{component="{esc(component)}",'
+            f'rule="{esc(rule or "__engine__")}"}} {n}')
+    js = jax_sample()
+    out.append(
+        f'{name}{{component="jax_live_arrays",rule="__engine__"}} '
+        f"{js['live_bytes']}")

@@ -100,6 +100,30 @@ def render(rule_registry) -> str:
         for rule_id, node, snap in snaps:
             out.append(f"kuiper_op_{mname}{{{op_labels(rule_id, node)}}} "
                        f"{snap[mname]}")
+    # drop taxonomy (utils/metrics.py inc_dropped): data discarded BY
+    # DESIGN, labeled by reason — buffer_full (drop-oldest backpressure),
+    # pane_recycle, decode_error, stale_watermark. Distinct from
+    # exceptions_total, which counts operator ERRORS only.
+    _family(out, "kuiper_node_dropped_total", "counter",
+            "items discarded by design, labeled by reason "
+            "(buffer_full/pane_recycle/decode_error/stale_watermark)")
+    for rule_id, node, snap in snaps:
+        for reason, n in sorted(snap["dropped_total"].items()):
+            out.append(
+                f"kuiper_node_dropped_total{{{op_labels(rule_id, node)},"
+                f'reason="{_esc(reason)}"}} {n}')
+    # per-edge queue depth: the node's input queue IS its fan-in edge
+    # set's buffer (one bounded queue per node), sampled LIVE at scrape —
+    # unlike buffer_length (last-dispatch gauge) this sees a queue that
+    # filled after the node's last dispatch, the backpressure onset shape
+    _family(out, "kuiper_node_queue_depth", "gauge",
+            "input-queue occupancy sampled at scrape time")
+    for rule_id, node, _snap in snaps:
+        q = getattr(node, "inq", None)
+        if q is not None:
+            out.append(
+                f"kuiper_node_queue_depth{{{op_labels(rule_id, node)}}} "
+                f"{q.qsize()}")
     # per-op latency DISTRIBUTIONS (observability/histogram.py): dispatch
     # busy time and input-queue wait as quantile gauges — the per-op view
     # of the tail the e2e histogram aggregates per rule
@@ -180,6 +204,13 @@ def render(rule_registry) -> str:
         render_prom_histogram(
             out, "kuiper_rule_e2e_latency_ms", f'rule="{_esc(rule_id)}"',
             hist, E2E_BOUNDS_MS)
+    # engine-health planes (devwatch: XLA trace-vs-hit accounting;
+    # memwatch: per-component device/host byte probes) — module-global
+    # registries, so they render once per scrape, not per rule
+    from . import devwatch, memwatch
+
+    devwatch.render_prometheus(out, _esc)
+    memwatch.render_prometheus(out, _esc)
     _family(out, "kuiper_uptime_seconds", "gauge",
             "seconds since engine start")
     out.append(f"kuiper_uptime_seconds {time.time() - _START_TIME:.1f}")

@@ -109,22 +109,34 @@ class DeviceGroupBy:
         for i, spec in enumerate(plan.specs):
             for comp in spec.components:
                 self.comp_specs.setdefault(comp, []).append(i)
-        self._fold = jax.jit(self._fold_impl, donate_argnums=(0,))
+        from ..observability.devwatch import watched_jit
+
+        self._fold = watched_jit(self._fold_impl, op=self._watch_op("fold"),
+                                 donate_argnums=(0,))
         # row-masked fold: the sliding edge refold re-folds CACHED device
         # batches under an arbitrary (mb,) bool row mask (window time cut),
         # so trigger emission uploads one 65KB mask instead of the rows
-        self._fold_m = jax.jit(self._fold_masked_impl, donate_argnums=(0,))
+        self._fold_m = watched_jit(self._fold_masked_impl,
+                                   op=self._watch_op("fold_masked"),
+                                   donate_argnums=(0,))
         # pane mask is static: no device upload per emit, one cached
         # executable per live-pane combination (few), and the output is ONE
         # stacked array -> a single device->host transfer per window emit
         # (sync round trips cost 10-90ms on tunneled TPU; see bench notes)
-        self._finalize = jax.jit(self._finalize_impl, static_argnums=(1,))
+        self._finalize = watched_jit(self._finalize_impl,
+                                     op=self._watch_op("finalize"),
+                                     static_argnums=(1,))
         # dynamic-mask variant: event-time windows rotate through per-window
         # pane subsets; a static mask would compile one executable per
         # subset (up to n_panes compiles), a traced mask compiles once
-        self._finalize_dyn = jax.jit(self._finalize_dyn_impl)
-        self._components = jax.jit(self._components_impl, static_argnums=(1,))
-        self._reset_pane = jax.jit(self._reset_pane_impl, donate_argnums=(0,))
+        self._finalize_dyn = watched_jit(self._finalize_dyn_impl,
+                                         op=self._watch_op("finalize_dyn"))
+        self._components = watched_jit(self._components_impl,
+                                       op=self._watch_op("components"),
+                                       static_argnums=(1,))
+        self._reset_pane = watched_jit(self._reset_pane_impl,
+                                       op=self._watch_op("reset_pane"),
+                                       donate_argnums=(0,))
         # heavy_hitters finalize: candidate recovery + top-k run ON DEVICE
         # (sketches.hh_candidates) so the emit transfer is 2*k2 floats/key,
         # not the HH_SIZE-wide raw sketch; dedupe + value decode finish on
@@ -133,7 +145,16 @@ class DeviceGroupBy:
             s.kind == "heavy_hitters" for s in plan.specs
         )
         if self._host_finalize_only:
-            self._hh_fin = jax.jit(self._hh_finalize_impl)
+            self._hh_fin = watched_jit(self._hh_finalize_impl,
+                                       op=self._watch_op("hh_finalize"))
+
+    #: kuiper_xla_* metric prefix for this kernel's jit sites; subclasses
+    #: override (multirule / sharded) so recompiles attribute to the
+    #: kernel variant that paid them
+    watch_prefix = "groupby"
+
+    def _watch_op(self, site: str) -> str:
+        return f"{self.watch_prefix}.{site}"
 
     #: the latency-hiding emit pipeline (ops/prefinalize.py) works here;
     #: the sharded subclass opts out (its finalize runs collective gathers)
@@ -656,7 +677,11 @@ class DeviceGroupBy:
         import jax.numpy as jnp
 
         if not hasattr(self, "_absorb"):
-            self._absorb = jax.jit(self._absorb_impl, donate_argnums=(0,))
+            from ..observability.devwatch import watched_jit
+
+            self._absorb = watched_jit(self._absorb_impl,
+                                       op=self._watch_op("absorb"),
+                                       donate_argnums=(0,))
         sh = {k: jnp.asarray(v) for k, v in shadow_data.items()}
         return self._absorb(state, sh, jnp.asarray(pane_idx, dtype=jnp.int32))
 

@@ -250,6 +250,29 @@ class KeyTable:
                   for row in zip(*(c.tolist() for c in cols))]
         return self._encode_hashed(combos)
 
+    def approx_bytes(self) -> int:
+        """Approximate host bytes held by the table (memory accounting,
+        observability/memwatch.py). A full walk is O(n_keys), so the
+        result is cached until the key count changes — scrapes of a
+        steady-state million-key table cost one comparison."""
+        n = len(self._keys)
+        cached = getattr(self, "_approx_bytes_cache", None)
+        if cached is not None and cached[0] == n:
+            return cached[1]
+        key_bytes = 0
+        for k in self._keys:
+            if type(k) is str:
+                key_bytes += 56 + len(k)  # CPython str header + payload
+            elif isinstance(k, tuple):
+                key_bytes += 56 + 64 * len(k)
+            else:
+                key_bytes += 64
+        # ids dict holds ~the same keys again by reference + int values;
+        # ~100B/entry of dict/list machinery covers both containers
+        total = key_bytes + n * 100
+        self._approx_bytes_cache = (n, total)
+        return total
+
     def decode(self, slot: int) -> Any:
         return self._keys[slot]
 

@@ -143,6 +143,16 @@ class PaneStore:
         self.kt = KeyTable(self.gb.capacity)
         self.state = self.gb.init_state()
         self._dtypes_seen = False
+        # HBM accounting: the shared pane ring serves N rules but is ONE
+        # allocation — reported once, under the shared-rule label
+        from ..observability import memwatch
+
+        memwatch.register(
+            "pane_store", self,
+            lambda st: sum(int(getattr(a, "nbytes", 0) or 0)
+                           for a in st.state.values())
+            + st.kt.approx_bytes(),
+            rule="__shared__")
 
     # ------------------------------------------------------------------ fold
     def fold(self, cols: Dict[str, np.ndarray], valid, slots, pane_arg,

@@ -240,8 +240,17 @@ class CountMinSketch:
         self.max_candidates = max_candidates
         self.counts = jnp.zeros((depth, width), dtype=jnp.float32)
         self.candidates: dict = {}
-        self._update = jax.jit(self._update_impl, donate_argnums=(0,))
-        self._query = jax.jit(self._query_impl)
+        from ..observability.devwatch import watched_jit
+        from ..observability import memwatch
+
+        self._update = watched_jit(self._update_impl, op="sketch.update",
+                                   donate_argnums=(0,))
+        self._query = watched_jit(self._query_impl, op="sketch.query")
+        # HBM accounting: the (d, w) device counts plus the bounded host
+        # candidate map (~96B/entry of dict + key machinery)
+        memwatch.register(
+            "sketch", self,
+            lambda sk: int(sk.counts.nbytes) + 96 * len(sk.candidates))
 
     def _hashes(self, values):
         import jax.numpy as jnp

@@ -135,6 +135,7 @@ class BatchedGroupBy(DeviceGroupBy):
     the per-rule filter parameters differ along the axis."""
 
     supports_prefinalize = False  # group emits are fetched in one transfer
+    watch_prefix = "multirule"
 
     def __init__(self, spec: RuleBatchSpec, capacity: int = 16384,
                  n_panes: int = 1, micro_batch: int = 4096) -> None:
@@ -148,11 +149,16 @@ class BatchedGroupBy(DeviceGroupBy):
         import jax.numpy as jnp
 
         self._params = jnp.asarray(spec.params)  # (R, P)
-        self._fold = jax.jit(self._batched_fold_impl, donate_argnums=(0,))
-        self._finalize = jax.jit(self._batched_finalize_impl,
-                                 static_argnums=(1,))
-        self._reset_pane = jax.jit(self._batched_reset_impl,
-                                   donate_argnums=(0,))
+        from ..observability.devwatch import watched_jit
+
+        self._fold = watched_jit(self._batched_fold_impl,
+                                 op="multirule.fold", donate_argnums=(0,))
+        self._finalize = watched_jit(self._batched_finalize_impl,
+                                     op="multirule.finalize",
+                                     static_argnums=(1,))
+        self._reset_pane = watched_jit(self._batched_reset_impl,
+                                       op="multirule.reset_pane",
+                                       donate_argnums=(0,))
 
     # state ------------------------------------------------------------
     def init_state(self) -> Dict[str, Any]:

@@ -45,6 +45,8 @@ class ShardedGroupBy(DeviceGroupBy):
     layout: cols/valid/slots (N,) sharded over "rows".
     """
 
+    watch_prefix = "sharded"
+
     # finalize runs collective gathers across the mesh; the pre-issued
     # emit pipeline (ops/prefinalize.py) is single-chip only for now
     supports_prefinalize = False
@@ -268,7 +270,10 @@ class ShardedGroupBy(DeviceGroupBy):
                 out_specs=state_specs,
             )(state, cols, slots, row_valid, pane_idx)
 
-        return jax.jit(step, donate_argnums=(0,))
+        from ..observability.devwatch import watched_jit
+
+        return watched_jit(step, op=self._watch_op("fold_step"),
+                           donate_argnums=(0,))
 
     def _build_fold_vec(self):
         """Per-row pane-vector fold (event-time multi-bucket batches under
@@ -396,7 +401,10 @@ class ShardedGroupBy(DeviceGroupBy):
                 out_specs=state_specs,
             )(state, cols, slots, row_valid, pane_vec)
 
-        return jax.jit(step, donate_argnums=(0,))
+        from ..observability.devwatch import watched_jit
+
+        return watched_jit(step, op=self._watch_op("fold_step_vec"),
+                           donate_argnums=(0,))
 
     def fold(
         self,

@@ -366,9 +366,18 @@ def try_plan_shared(topo, stmt: ast.SelectStatement, kernel_plan: KernelPlan,
         # and the store's pane GCD covers its windows
         declare(decision.store_key, rule.id, length, interval, kernel_plan)
     if not decision.share:
-        log = logger.warning if (explicit or opts.qos > 0) else logger.debug
+        loud = explicit or opts.qos > 0
+        log = logger.warning if loud else logger.debug
         log("rule %s: shared-fold rewrite declined — %s; planning a "
             "private fold", rule.id, decision.reason)
+        if loud:
+            # the operator asked for sharing (or qos forces privacy):
+            # leave a flight-recorder breadcrumb, not just a log line
+            from ..runtime.events import recorder
+
+            recorder().record(
+                "qos_private_fallback", rule=rule.id,
+                reason=decision.reason, qos=opts.qos, explicit=explicit)
         return None
     # display name must be UNIQUE per store: two stores on the same
     # stream+dims (different WHERE / time-domain facets) with one name

@@ -9,6 +9,7 @@ alignment semantics match the reference's checkpoint design.
 """
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
@@ -60,3 +61,85 @@ class PreTrigger:
 class ErrorEvent:
     error: BaseException
     origin: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Engine flight recorder — a bounded in-memory ring of STRUCTURED engine
+# events (rule state changes, recompile storms, drop bursts, shared-fold
+# attach/detach, qos private fallbacks, memory-budget evictions). The
+# node-to-node events above are data-plane; these are control-plane
+# breadcrumbs: when a rule degrades at 3am, `GET /diagnostics/events` (or
+# a tools/kuiperdiag.py bundle) replays the last N state transitions
+# without anyone having had DEBUG logging on. Recording is a deque append
+# under a short lock — cheap enough for every producer site; producers
+# are expected to pre-throttle high-frequency conditions (drop BURSTS at
+# decade thresholds, ONE storm event per jit site), so the ring holds
+# hours of history, not milliseconds.
+
+
+class FlightRecorder:
+    """Bounded ring of engine events, oldest evicted first."""
+
+    DEFAULT_CAPACITY = 1024
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        from collections import deque
+
+        self.capacity = int(capacity)
+        self._ring: "deque" = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0  # total ever recorded (monotonic event id)
+
+    def record(self, kind: str, rule: str = "", **detail: Any) -> None:
+        """Append one event. `detail` values must be JSON-serializable
+        (the ring is served verbatim over REST)."""
+        from ..utils import timex
+
+        ev = {"kind": kind, "rule": rule, "ts_ms": timex.now_ms(),
+              **detail}
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            self._ring.append(ev)
+
+    def events(self, kind: Optional[str] = None,
+               rule: Optional[str] = None,
+               limit: Optional[int] = None) -> list:
+        """Events oldest→newest, optionally filtered; `limit` keeps the
+        NEWEST n after filtering."""
+        with self._lock:
+            out = list(self._ring)
+        if kind is not None:
+            out = [e for e in out if e["kind"] == kind]
+        if rule is not None:
+            out = [e for e in out if e["rule"] == rule]
+        if limit is not None and limit >= 0:
+            out = out[len(out) - min(limit, len(out)):]
+        return out
+
+    @property
+    def total_recorded(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def clear(self) -> None:
+        """Test hook — empties the ring, keeps the monotonic seq."""
+        with self._lock:
+            self._ring.clear()
+
+    def diagnostics(self, kind: Optional[str] = None,
+                    rule: Optional[str] = None,
+                    limit: Optional[int] = None) -> Dict[str, Any]:
+        """The GET /diagnostics/events payload."""
+        evs = self.events(kind=kind, rule=rule, limit=limit)
+        return {"events": evs, "capacity": self.capacity,
+                "total_recorded": self.total_recorded,
+                "returned": len(evs)}
+
+
+_recorder = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    """The engine-wide flight recorder singleton."""
+    return _recorder

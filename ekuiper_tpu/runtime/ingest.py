@@ -71,6 +71,13 @@ class DecodePool:
         # the pipeline balance)
         self._stats = stats
         self._ready_ts: Dict[int, float] = {}  # seq -> result-deposit time
+        # memory accounting: decoded batches parked in the ring awaiting
+        # their emission turn hold host columns alive — a visible
+        # component row, not a mystery RSS bump (probe runs at scrape
+        # time only; ring depth is small so the walk is a few dicts)
+        from ..observability import memwatch
+
+        memwatch.register("decode_ring", self, DecodePool._ring_bytes)
         self._lock = threading.Lock()
         self._job_ready = threading.Condition(self._lock)
         self._slot_free = threading.Condition(self._lock)
@@ -156,8 +163,35 @@ class DecodePool:
                 result = self._decode(job)
             except Exception as exc:
                 logger.warning("decode pool job failed: %s", exc)
+                if self._stats is not None:
+                    # the job's rows are gone: count the loss in the drop
+                    # taxonomy, sized by the job's payload count (a job is
+                    # a whole flush unit — (kind, items, tss); counting 1
+                    # would understate the loss by the batch size). The
+                    # per-payload decode errors inside a SURVIVING job are
+                    # already counted by the decode_fn.
+                    n_lost = 1
+                    if (isinstance(job, tuple) and len(job) > 1
+                            and hasattr(job[1], "__len__")):
+                        n_lost = max(len(job[1]), 1)
+                    self._stats.inc_dropped("decode_error", n=n_lost,
+                                            detail="decode pool job failed")
                 result = None
             self._finish(seq, result)
+
+    def _ring_bytes(self) -> int:
+        """Host bytes held by decoded-but-unemitted ring results."""
+        with self._lock:
+            results = list(self._results.values())
+        total = 0
+        for r in results:
+            cols = getattr(r, "columns", None)
+            if not cols:
+                continue
+            for arr in cols.values():
+                nb = getattr(arr, "nbytes", 0)
+                total += int(nb or 0)
+        return total
 
     def _finish(self, seq: int, result: Any) -> None:
         """Deposit a finished decode; if the emit cursor's result is ready

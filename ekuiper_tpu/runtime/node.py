@@ -150,7 +150,11 @@ class Node:
                     self.inq.task_done()  # dropped items count as handled
                     if self._enq_times:
                         self._enq_times.popleft()  # its wait sample goes too
-                    self.stats.inc_exception("buffer full, dropped oldest")
+                    # a backpressure drop is the fabric WORKING AS DESIGNED,
+                    # not an operator error: it counts in the drop taxonomy
+                    # (kuiper_node_dropped_total{reason="buffer_full"}),
+                    # never in exceptions_total
+                    self.stats.inc_dropped("buffer_full")
                     logger.debug("%s: buffer full, dropped %r", self.name, type(dropped))
                 except queue.Empty:
                     continue

@@ -411,6 +411,22 @@ class FusedWindowAggNode(Node):
     def on_open(self) -> None:
         if self.state is None:  # keep checkpoint-restored partials
             self.state = self.gb.init_state()
+        # HBM accounting (observability/memwatch.py): the three pools this
+        # node owns — group-by partial state, the sliding device batch
+        # cache, and the host key table — become kuiper_device_bytes rows
+        from ..observability import memwatch
+
+        rule = getattr(self._topo, "rule_id", "") if self._topo else ""
+        memwatch.register(
+            "groupby_state", self,
+            lambda n: sum(int(getattr(a, "nbytes", 0) or 0)
+                          for a in (n.state or {}).values()),
+            rule=rule)
+        memwatch.register("key_table", self,
+                          lambda n: n.kt.approx_bytes(), rule=rule)
+        if self.wt == ast.WindowType.SLIDING_WINDOW:
+            memwatch.register("dev_ring", self,
+                              lambda n: n._dev_ring_bytes, rule=rule)
         # register the trigger timer BEFORE the (slow) warmup compile so the
         # first window boundary is anchored at open time, not compile-end
         if not self.is_event_time and self.wt in (
@@ -827,8 +843,8 @@ class FusedWindowAggNode(Node):
         late = buckets < self._next_emit_bucket
         if late.any():
             n_late = int(late.sum())
-            self.stats.inc_exception("late event dropped (bucket emitted)",
-                                     n=n_late)
+            self.stats.inc_dropped("stale_watermark", n=n_late,
+                                   detail="bucket already emitted")
             keep = np.nonzero(~late)[0]
             if len(keep) == 0:
                 return 0
@@ -1302,8 +1318,9 @@ class FusedWindowAggNode(Node):
             if drop_buckets:
                 late = np.isin(buckets, drop_buckets)
                 n_late = int(late.sum())
-                self.stats.inc_exception(
-                    "late row dropped (sliding pane retention)", n=n_late)
+                self.stats.inc_dropped(
+                    "pane_recycle", n=n_late,
+                    detail="sliding pane retention")
                 keep = np.nonzero(~late)[0]
                 if len(keep) == 0:
                     return 0
@@ -1467,6 +1484,7 @@ class FusedWindowAggNode(Node):
         """Drop the oldest cached device entries until the cache fits the
         HBM budget; their refolds fall back to the exact host path (the
         aligned _ring rows are always retained)."""
+        freed = evicted = 0
         while (self._dev_ring_bytes > self.dev_ring_budget_bytes
                and self._dev_ring_fifo):
             b, idx, nbytes = self._dev_ring_fifo.popleft()
@@ -1475,6 +1493,18 @@ class FusedWindowAggNode(Node):
                 continue  # already gone (bucket expired past the ring floor)
             lst[idx] = None
             self._dev_ring_bytes -= nbytes
+            freed += nbytes
+            evicted += 1
+        if evicted:
+            # flight-recorder breadcrumb: budget pressure is why refolds
+            # slowed down (host-path fallback), worth a line in a bundle
+            from .events import recorder
+
+            recorder().record(
+                "memory_evict", rule=self.stats.rule_id,
+                component="dev_ring", node=self.name, entries=evicted,
+                bytes_freed=freed, bytes_now=self._dev_ring_bytes,
+                budget_bytes=self.dev_ring_budget_bytes)
 
     def _schedule_sliding(self, t: int, fire_at: int) -> None:
         """Register a delayed sliding emission; tracked in _pending_slides

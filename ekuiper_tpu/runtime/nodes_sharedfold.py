@@ -145,8 +145,13 @@ class SharedFoldNode(Node):
                 buffer_length=buffer_length)
             self._wm_node.connect(self)
         self._topo = _StoreShim(self)
+        # shared store nodes are emitted under rule="__shared__" in the
+        # scrape; their flight events (pane_recycle bursts) carry the
+        # same label so /diagnostics/events?rule= filtering lines up
+        self.stats.rule_id = "__shared__"
         if self._wm_node is not None:
             self._wm_node._topo = self._topo
+            self._wm_node.stats.rule_id = "__shared__"
         self._opened = False
         self._closed = False
         self._tick_timer = None
@@ -252,6 +257,10 @@ class SharedFoldNode(Node):
             logger.debug("%s: rule %s attached (%d member(s), warm from "
                          "live panes)", self.name, spec.rule_id,
                          len(members))
+            from .events import recorder
+
+            recorder().record("shared_fold_attach", rule=spec.rule_id,
+                              store=self.name, members=len(members))
             return True
 
     def detach_rule(self, rule_id: str) -> None:
@@ -263,6 +272,10 @@ class SharedFoldNode(Node):
             members = dict(self._members)
             del members[rule_id]
             self._members = members
+            from .events import recorder
+
+            recorder().record("shared_fold_detach", rule=rule_id,
+                              store=self.name, members=len(members))
             self.outputs = [o for o in self.outputs if o is not m.entry]
             if not members and self._opened:
                 self._closed = True
@@ -421,9 +434,9 @@ class SharedFoldNode(Node):
             if held is not None and held > int(b):
                 drop |= buckets == b
         if drop.any():
-            self.stats.inc_exception(
-                "late event dropped (pane emitted/recycled)",
-                n=int(drop.sum()))
+            self.stats.inc_dropped(
+                "pane_recycle", n=int(drop.sum()),
+                detail="late event (pane emitted/recycled)")
             keep = np.nonzero(~drop)[0]
             if len(keep) == 0:
                 return None, None, None, None, None
@@ -442,8 +455,9 @@ class SharedFoldNode(Node):
                 # not emitted yet (watermark lagging past the pane budget)
                 # that is COUNTED data loss, never corruption.
                 if held in self._dirty:
-                    self.stats.inc_exception(
-                        "pane recycled before emission (watermark lag)")
+                    self.stats.inc_dropped(
+                        "pane_recycle",
+                        detail="recycled before emission (watermark lag)")
                 self.store.reset_pane(pane)
                 self._dirty.discard(held)
             self._pane_bucket[pane] = b

@@ -160,6 +160,7 @@ class SourceNode(Node):
                 payload = self.converter.decode(bytes(payload))
             except Exception as exc:
                 self.stats.inc_exception(f"decode error: {exc}")
+                self.stats.inc_dropped("decode_error")
                 return
         msgs: List[Dict[str, Any]] = []
         if isinstance(payload, Tuple):
@@ -272,6 +273,7 @@ class SourceNode(Node):
                 m = self.converter.decode(bytes(p))
             except Exception as exc:
                 self.stats.inc_exception(f"decode error: {exc}")
+                self.stats.inc_dropped("decode_error")
                 continue
             if isinstance(m, dict):
                 msgs.append(m)
@@ -490,6 +492,7 @@ class SourceNode(Node):
                     m = self.converter.decode(p)
                 except Exception as exc:
                     self.stats.inc_exception(f"decode error: {exc}")
+                    self.stats.inc_dropped("decode_error")
                     continue
                 if isinstance(m, dict):
                     msgs.append(m)
@@ -513,6 +516,7 @@ class SourceNode(Node):
         if n_bad:
             self.stats.inc_exception(
                 "undecodable or uncastable payload", n=n_bad)
+            self.stats.inc_dropped("decode_error", n=n_bad)
         ts = np.asarray(rtss, dtype=np.int64)
         if self.timestamp_field:
             vm = valid[self.timestamp_field]

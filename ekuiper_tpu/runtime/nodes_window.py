@@ -46,7 +46,7 @@ class WatermarkNode(Node):
             n_late = int(item.n - keep.sum())
             if n_late:
                 self.dropped += n_late
-                self.stats.inc_exception("late event dropped", n=n_late)
+                self.stats.inc_dropped("stale_watermark", n=n_late)
                 idx = np.nonzero(keep)[0]
                 item = item.take(idx)
                 ts = ts[idx]
@@ -59,7 +59,7 @@ class WatermarkNode(Node):
         elif isinstance(item, Row):
             if item.timestamp < self.max_ts - self.late_tolerance:
                 self.dropped += 1
-                self.stats.inc_exception("late event dropped")
+                self.stats.inc_dropped("stale_watermark")
             else:
                 self.max_ts = max(self.max_ts, item.timestamp)
                 self.emit(item)

@@ -57,6 +57,20 @@ class RuleState:
         self._sched_timer = None
         self._sched_gen = 0  # invalidates stale timers after a user stop
 
+    def _set_state(self, st: RunState, reason: str = "") -> None:
+        """Every FSM transition goes through here so the flight recorder
+        (runtime/events.py) keeps a replayable state history per rule —
+        callers hold self._lock or run on the serialized action worker."""
+        prev = self.state
+        self.state = st
+        if prev is not st:
+            from .events import recorder
+
+            recorder().record(
+                "rule_state", rule=self.rule.id, state=st.value,
+                previous=prev.value,
+                **({"reason": reason} if reason else {}))
+
     # --------------------------------------------------------------- actions
     def start(self) -> None:
         self._enqueue("start")
@@ -99,7 +113,7 @@ class RuleState:
             except Exception as exc:
                 logger.error("rule %s action %s failed: %s", self.rule.id, action, exc)
                 with self._lock:
-                    self.state = RunState.STOPPED_BY_ERR
+                    self._set_state(RunState.STOPPED_BY_ERR, reason=str(exc))
                     self.last_error = str(exc)
 
     # ------------------------------------------------------------- transitions
@@ -107,7 +121,7 @@ class RuleState:
         with self._lock:
             if self.state in (RunState.RUNNING, RunState.STARTING):
                 return
-            self.state = RunState.STARTING
+            self._set_state(RunState.STARTING)
         if self._cron is not None:
             self._schedule_next_fire()
             return
@@ -124,7 +138,7 @@ class RuleState:
         fire_at = self._cron.next_fire_ms(now)
         gen = self._sched_gen
         with self._lock:
-            self.state = RunState.SCHEDULED
+            self._set_state(RunState.SCHEDULED)
         self._sched_timer = timex.after(
             fire_at - now, lambda ts: self._enqueue(f"cron_fire:{gen}"))
 
@@ -150,18 +164,18 @@ class RuleState:
             self._schedule_next_fire()
         else:
             with self._lock:
-                self.state = RunState.STOPPED
+                self._set_state(RunState.STOPPED)
 
     def _open_topo(self) -> None:
         with self._lock:
             if self.state == RunState.RUNNING:
                 return
-            self.state = RunState.STARTING
+            self._set_state(RunState.STARTING)
         topo = plan_rule(self.rule, self.store)
         topo.open()
         with self._lock:
             self.topo = topo
-            self.state = RunState.RUNNING
+            self._set_state(RunState.RUNNING)
             self.started_at = timex.now_ms()
             self.last_error = ""
         self._stop_supervision.clear()
@@ -186,14 +200,14 @@ class RuleState:
         with self._lock:
             if self.state == RunState.STOPPED:
                 return
-            self.state = RunState.STOPPING
+            self._set_state(RunState.STOPPING)
         self._sched_gen += 1  # invalidate in-flight schedule timers
         if self._sched_timer is not None:
             self._sched_timer.stop()
             self._sched_timer = None
         self._close_topo()
         with self._lock:
-            self.state = RunState.STOPPED
+            self._set_state(RunState.STOPPED)
 
     # ------------------------------------------------------------- supervision
     def _supervise(self) -> None:
@@ -222,7 +236,8 @@ class RuleState:
                 self.last_error = str(err)
             if tried >= attempts:
                 with self._lock:
-                    self.state = RunState.STOPPED_BY_ERR
+                    self._set_state(RunState.STOPPED_BY_ERR,
+                                    reason=str(err))
                 topo.close()
                 with self._lock:
                     self.topo = None
@@ -237,10 +252,12 @@ class RuleState:
                 new_topo.open()
                 with self._lock:
                     self.topo = new_topo
-                    self.state = RunState.RUNNING
+                    self._set_state(RunState.RUNNING,
+                                    reason="restart strategy")
             except Exception as exc:
                 with self._lock:
-                    self.state = RunState.STOPPED_BY_ERR
+                    self._set_state(RunState.STOPPED_BY_ERR,
+                                    reason=str(exc))
                     self.last_error = str(exc)
                 return
 

@@ -81,6 +81,10 @@ class SrcSubTopo:
         self._shim = _FanoutTopoShim(self)
         for n in nodes:
             n._topo = self._shim
+            # shared nodes never pass through Topo.add_*: stamp the same
+            # rule label the Prometheus exposition uses, so their
+            # drop-burst flight events filter consistently
+            n.stats.rule_id = "__shared__"
         self._lock = threading.RLock()
         self._attached: Dict[str, Tuple[Node, Any]] = {}
         self._opened = False

@@ -45,16 +45,19 @@ class Topo:
     # ------------------------------------------------------------------ wiring
     def add_source(self, node: Node) -> Node:
         node._topo = self
+        node.stats.rule_id = self.rule_id
         self.sources.append(node)
         return node
 
     def add_op(self, node: Node) -> Node:
         node._topo = self
+        node.stats.rule_id = self.rule_id
         self.ops.append(node)
         return node
 
     def add_sink(self, node: Node) -> Node:
         node._topo = self
+        node.stats.rule_id = self.rule_id
         self.sinks.append(node)
         return node
 
@@ -157,6 +160,15 @@ class Topo:
         out = flatten_status(stats)
         # rule-level SLO summary: the ingest→emit distribution percentiles
         out["e2e_latency_ms"] = self.e2e_hist.snapshot()
+        # engine-health views (observability/devwatch.py): per-op XLA
+        # trace-vs-cache-hit counts — a steady-state rule should show
+        # compiles flat while cache_hits climb; anything else is paying
+        # compile latency per batch
+        from ..observability import devwatch
+
+        xla = devwatch.registry().rule_status(self.rule_id)
+        if xla:
+            out["xla_compile"] = xla
         return out
 
     def topo_json(self) -> Dict[str, Any]:

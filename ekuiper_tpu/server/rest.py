@@ -180,6 +180,14 @@ class RestApi:
         # trace routes, metrics/metrics_dump.go)
         r("GET", r"^/metrics$", lambda m: self.prometheus_metrics())
         r("GET", r"^/metrics/dump$", lambda m: self.metrics_dump())
+        # engine-health diagnostics: the flight recorder's event ring,
+        # per-component device/host memory accounting, and the XLA
+        # compile watcher — the views tools/kuiperdiag.py bundles
+        r("GET", r"^/diagnostics/events$",
+          lambda m, query=None: self.diagnostics_events(query or {}))
+        r("GET", r"^/diagnostics/memory$",
+          lambda m: self.diagnostics_memory())
+        r("GET", r"^/diagnostics/xla$", lambda m: self.diagnostics_xla())
         r("POST", r"^/rules/(?P<id>[^/]+)/trace/start$",
           lambda m, body=None: self._tracer().enable(
               m["id"], (body or {}).get("strategy", "always"))
@@ -384,6 +392,39 @@ class RestApi:
         from ..observability import prometheus
 
         return prometheus.TextResponse(prometheus.render(self.rules))
+
+    @staticmethod
+    def diagnostics_events(query: Dict[str, str]) -> Dict[str, Any]:
+        """GET /diagnostics/events?kind=&rule=&limit= — the flight
+        recorder's ring, oldest→newest (limit keeps the newest n)."""
+        from ..runtime.events import recorder
+
+        limit = None
+        if query.get("limit"):
+            try:
+                limit = max(int(query["limit"]), 0)
+            except ValueError:
+                raise EngineError(f"invalid limit {query['limit']!r}")
+        return recorder().diagnostics(
+            kind=query.get("kind") or None,
+            rule=query.get("rule") or None, limit=limit)
+
+    @staticmethod
+    def diagnostics_memory() -> Dict[str, Any]:
+        """GET /diagnostics/memory — per-component byte probes plus the
+        jax.live_arrays() allocator view."""
+        from ..observability import memwatch
+
+        return memwatch.diagnostics()
+
+    @staticmethod
+    def diagnostics_xla() -> Dict[str, Any]:
+        """GET /diagnostics/xla — per-site compile/cache-hit accounting."""
+        from ..observability import devwatch
+
+        reg = devwatch.registry()
+        return {"totals": reg.totals(),
+                "sites": [w.snapshot() for w in reg.watches()]}
 
     def metrics_dump(self):
         """Write every rule's status snapshot to the data dir and return the

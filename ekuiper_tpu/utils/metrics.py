@@ -32,11 +32,20 @@ class StatManager:
         self.op_type = op_type
         self.op_id = op_id
         self.instance = instance
+        # owning rule, stamped by Topo.add_* — drop-burst flight events
+        # need attribution even when the dropping thread (an upstream
+        # connector) carries no rule context
+        self.rule_id: str = ""
         self._lock = threading.Lock()
         self.records_in = 0
         self.records_out = 0
         self.messages_processed = 0
         self.exceptions = 0
+        # drop taxonomy: data discarded BY DESIGN (backpressure, late
+        # rows, undecodable payloads) counts here with a reason label —
+        # never in `exceptions`, which means operator ERRORS. Reasons:
+        # buffer_full / pane_recycle / decode_error / stale_watermark.
+        self.dropped: Dict[str, int] = {}
         self.last_exception: str = ""
         self.last_exception_time: int = 0
         self.last_invocation: int = 0
@@ -77,6 +86,31 @@ class StatManager:
             self.exceptions += n
             self.last_exception = err
             self.last_exception_time = timex.now_ms()
+
+    #: drop-burst flight-recorder thresholds: an event fires when a
+    #: reason's cumulative count first reaches each decade — the FIRST
+    #: drop is always an event (something new is being discarded), later
+    #: ones only at 10x growth so a sustained storm can't flood the ring
+    _BURST_DECADES = tuple(10 ** k for k in range(10))
+
+    def inc_dropped(self, reason: str, n: int = 1, detail: str = "") -> None:
+        """Count `n` items discarded for `reason` (taxonomy above) and
+        record a flight-recorder drop-burst event at decade crossings."""
+        with self._lock:
+            old = self.dropped.get(reason, 0)
+            new = old + n
+            self.dropped[reason] = new
+        crossed = 0
+        for t in self._BURST_DECADES:
+            if old < t <= new:
+                crossed = t
+        if crossed:
+            from ..runtime.events import recorder
+
+            recorder().record(
+                "drop_burst", rule=self.rule_id, node=self.op_id,
+                reason=reason, total=new, threshold=crossed,
+                **({"detail": detail} if detail else {}))
 
     def process_begin(self) -> None:
         self._started_at = timex.now_ms()
@@ -129,6 +163,7 @@ class StatManager:
                 "last_exception": self.last_exception,
                 "last_exception_time": self.last_exception_time,
                 "stage_timings": {k: dict(v) for k, v in self.stages.items()},
+                "dropped_total": dict(self.dropped),
             }
         # percentile summaries computed OUTSIDE the stats lock (histograms
         # carry their own): p50/p90/p99/max for the status/REST layers

@@ -831,7 +831,12 @@ PyObject* kt_encode(PyObject*, PyObject* args) {
   int32_t* slots = (int32_t*)PyArray_DATA((PyArrayObject*)slots_arr);
 
   // pass 2 — assign slots: pointer-identity hit (interned repeats), byte
-  // hit, or new slot + appendix entry (normalized key object).
+  // hit, or new slot + appendix entry (normalized key object). The
+  // appendix append runs BEFORE the slot commits: an append failure (OOM)
+  // must not leave a slot the Python source of truth never hears about
+  // (the no-mutate-on-failure contract ops/keytable.py assumes — a
+  // mutated-but-unreported table would diverge the mirror forever).
+  const int64_t n0 = kt->n;  // rollback floor: slots committed this call
   bool fail = false;
   for (npy_intp i = 0; i < n && !fail; i++) {
     PyObject* it = items[i];
@@ -846,10 +851,6 @@ PyObject* kt_encode(PyObject*, PyObject* args) {
     if (bit != kt->byte_map.end()) {
       slot = bit->second;
     } else {
-      slot = (int32_t)kt->n++;
-      kt->storage.emplace_back(key.p, key.n);
-      const std::string& owned = kt->storage.back();
-      kt->byte_map.emplace(StrKey{owned.data(), owned.size()}, slot);
       // appendix carries the NORMALIZED key ("" for None, else the raw
       // string object) in first-seen order — feeding exactly this
       // sequence to a Python KeyTable assigns identical ids
@@ -865,6 +866,10 @@ PyObject* kt_encode(PyObject*, PyObject* args) {
         fail = true;
         break;
       }
+      slot = (int32_t)kt->n++;
+      kt->storage.emplace_back(key.p, key.n);
+      const std::string& owned = kt->storage.back();
+      kt->byte_map.emplace(StrKey{owned.data(), owned.size()}, slot);
     }
     slots[i] = slot;
     if (kt->ptr_cache.size() < kPtrCacheCap) {
@@ -874,6 +879,25 @@ PyObject* kt_encode(PyObject*, PyObject* args) {
   }
   Py_DECREF(fast);
   if (fail) {
+    // mid-batch failure: EARLIER rows of this call may have committed
+    // slots whose appendix will now never reach the Python table — roll
+    // every slot >= n0 back out of storage/byte_map/n, and evict
+    // ptr_cache entries pointing at them (a stale pointer hit would
+    // otherwise resurrect a slot id the table no longer assigns)
+    while (kt->n > n0) {
+      const std::string& owned = kt->storage.back();
+      kt->byte_map.erase(StrKey{owned.data(), owned.size()});
+      kt->storage.pop_back();
+      kt->n--;
+    }
+    for (auto itc = kt->ptr_cache.begin(); itc != kt->ptr_cache.end();) {
+      if (itc->second >= n0) {
+        Py_DECREF(itc->first);
+        itc = kt->ptr_cache.erase(itc);
+      } else {
+        ++itc;
+      }
+    }
     Py_DECREF(slots_arr);
     Py_DECREF(appendix);
     return nullptr;

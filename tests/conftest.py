@@ -35,15 +35,22 @@ def fresh_engine_state():
     from ekuiper_tpu.planner import sharing
     from ekuiper_tpu.runtime import nodes_sharedfold, subtopo
 
+    from ekuiper_tpu.observability import devwatch, memwatch
+    from ekuiper_tpu.runtime.events import recorder
+
     clock = timex.set_mock_clock(0)
     kv.setup("memory")
     nodes_sharedfold.reset()
     subtopo.reset()
     sharing.reset()
+    recorder().clear()
     yield clock
     nodes_sharedfold.reset()
     subtopo.reset()
     sharing.reset()
+    recorder().clear()
+    devwatch.registry().clear()
+    memwatch.registry().clear()
     timex.use_real_clock()
 
 

@@ -1,0 +1,266 @@
+"""XLA compile watcher (observability/devwatch.py): trace-vs-cache-hit
+accounting, recompile-storm detection, compile-histogram export math.
+
+All mock-clock/CPU tier-1 — the watcher rides jit semantics (the wrapped
+body executes only under tracing), so a CPU jit exercises exactly the
+code the TPU path runs.
+"""
+import numpy as np
+import pytest
+
+from ekuiper_tpu.observability import devwatch
+from ekuiper_tpu.observability.devwatch import (
+    COMPILE_BOUNDS_US,
+    STORM_SIGNATURES,
+    watched_jit,
+)
+from ekuiper_tpu.runtime.events import recorder
+from ekuiper_tpu.utils.rulelog import set_rule_context
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    devwatch.registry().clear()
+    set_rule_context(None)
+    yield
+    devwatch.registry().clear()
+    set_rule_context(None)
+
+
+def _sum2(x):
+    return x * 2.0
+
+
+class TestTraceAccounting:
+    def test_same_shape_folds_hit_cache(self):
+        """Repeated same-shape calls: exactly ONE trace, the rest cache
+        hits — the steady-state invariant the acceptance criteria pin
+        (kuiper_xla_compile_total flat after warmup)."""
+        fn = watched_jit(_sum2, op="test.fold")
+        x = np.zeros(64, dtype=np.float32)
+        for _ in range(5):
+            fn(x)
+        snap = fn.rec.snapshot()
+        assert snap["calls"] == 5
+        assert snap["compiles"] == 1
+        assert snap["cache_hits"] == 4
+        assert snap["distinct_signatures"] == 1
+        assert snap["storms"] == 0
+        assert snap["compile_us"]["count"] == 1
+
+    def test_new_shape_retraces(self):
+        fn = watched_jit(_sum2, op="test.fold")
+        fn(np.zeros(8, dtype=np.float32))
+        fn(np.zeros(16, dtype=np.float32))
+        fn(np.zeros(8, dtype=np.float32))  # back to a cached executable
+        snap = fn.rec.snapshot()
+        assert snap["compiles"] == 2
+        assert snap["cache_hits"] == 1
+        assert snap["distinct_signatures"] == 2
+
+    def test_dtype_change_retraces_and_signature_names_it(self):
+        fn = watched_jit(_sum2, op="test.fold")
+        fn(np.zeros(8, dtype=np.float32))
+        fn(np.zeros(8, dtype=np.int32))
+        assert fn.rec.snapshot()["compiles"] == 2
+        sigs = set(fn.rec.signatures)
+        assert any("float32[8]" in s for s in sigs)
+        assert any("int32[8]" in s for s in sigs)
+
+    def test_static_argnums_respecialize_counts(self):
+        def f(x, k):
+            return x * k
+
+        fn = watched_jit(f, op="test.static", static_argnums=(1,))
+        x = np.zeros(4, dtype=np.float32)
+        fn(x, 2)
+        fn(x, 2)
+        fn(x, 3)  # new static value -> new executable
+        snap = fn.rec.snapshot()
+        assert snap["compiles"] == 2
+        assert snap["cache_hits"] == 1
+
+    def test_jit_kwargs_pass_through(self):
+        """donate_argnums reaches the underlying jit (result correctness
+        is the observable: donation still computes the right value)."""
+        def f(state, d):
+            return {k: v + d for k, v in state.items()}
+
+        fn = watched_jit(f, op="test.donate", donate_argnums=(0,))
+        import jax.numpy as jnp
+
+        out = fn({"a": jnp.zeros(4)}, 1.0)
+        assert np.allclose(np.asarray(out["a"]), 1.0)
+        assert fn.rec.snapshot()["compiles"] == 1
+
+    def test_rule_attribution_from_thread_context(self):
+        set_rule_context("rule_w")
+        fn = watched_jit(_sum2, op="test.fold")
+        fn(np.zeros(4, dtype=np.float32))
+        assert fn.rec.rule == "rule_w"
+        status = devwatch.registry().rule_status("rule_w")
+        assert status["test.fold"]["compiles"] == 1
+
+
+class TestStormDetection:
+    def test_shape_churn_triggers_exactly_one_storm_event(self):
+        """Deliberate shape churn: one storm event in the flight recorder
+        when the distinct-signature count crosses the threshold — and
+        ONLY one, no matter how long the churn continues."""
+        fn = watched_jit(_sum2, op="churn.fold")
+        for n in range(1, STORM_SIGNATURES + 20):
+            fn(np.zeros(n, dtype=np.float32))
+        snap = fn.rec.snapshot()
+        assert snap["compiles"] == STORM_SIGNATURES + 19
+        assert snap["storms"] == 1
+        storms = recorder().events(kind="compile_storm")
+        assert len(storms) == 1
+        ev = storms[0]
+        assert ev["op"] == "churn.fold"
+        assert ev["signatures"] == STORM_SIGNATURES + 1
+        assert "float32" in ev["last_signature"]
+
+    def test_legitimate_respecialization_stays_quiet(self):
+        """Capacity-doubling style respecialization (a handful of shapes)
+        must NOT be flagged."""
+        fn = watched_jit(_sum2, op="grow.fold")
+        for n in (1, 2, 4, 8, 16, 32):  # 6 shapes < threshold
+            fn(np.zeros(n, dtype=np.float32))
+        assert fn.rec.snapshot()["storms"] == 0
+        assert recorder().events(kind="compile_storm") == []
+
+    def test_signature_table_bounded(self):
+        w = devwatch.registry().register("bound.op", None)
+        for i in range(devwatch.SIG_CAP + 50):
+            w.on_compile(10.0, (i,), {})  # every int reprs to a new sig
+        assert len(w.signatures) == devwatch.SIG_CAP
+        assert w.sig_overflow == 50
+        # overflow still counts toward the distinct total
+        assert w.snapshot()["distinct_signatures"] == devwatch.SIG_CAP + 50
+
+
+class TestHistogramExport:
+    def test_compile_seconds_exposition_math(self):
+        """kuiper_xla_compile_seconds: le ladder rendered in SECONDS,
+        cumulative buckets conservative (a sample never lands below its
+        true bound), +Inf == count, sum in seconds."""
+        w = devwatch.registry().register("exp.fold", "r1")
+        w.calls = 3
+        # 2ms, 30ms, 0.8s compiles
+        for us in (2_000, 30_000, 800_000):
+            w.on_compile(float(us), (), {})
+        out = []
+        devwatch.render_prometheus(out, lambda s: s)
+        text = "\n".join(out)
+        assert '# TYPE kuiper_xla_compile_seconds histogram' in text
+        lbl = 'op="exp.fold",rule="r1"'
+
+        def bucket(le):
+            for line in out:
+                if line.startswith(
+                        f'kuiper_xla_compile_seconds_bucket{{{lbl},le="{le}"}}'):
+                    return int(line.rsplit(" ", 1)[1])
+            raise AssertionError(f"no bucket le={le}: {text}")
+
+        # ladder bounds are COMPILE_BOUNDS_US rendered /1e6
+        assert bucket("0.001") == 0          # nothing at or under 1ms
+        assert bucket("0.005") >= 1          # the 2ms compile
+        assert bucket("0.1") >= 2            # + the 30ms compile
+        assert bucket("1") == 3              # everything
+        assert bucket("+Inf") == 3
+        # monotone non-decreasing across the whole ladder
+        seq = [bucket(f"{b / 1e6:g}") for b in COMPILE_BOUNDS_US]
+        assert seq == sorted(seq)
+        sum_line = next(l for l in out if l.startswith(
+            f"kuiper_xla_compile_seconds_sum{{{lbl}}}"))
+        total_s = float(sum_line.rsplit(" ", 1)[1])
+        assert abs(total_s - 0.832) < 1e-6
+        cnt_line = next(l for l in out if l.startswith(
+            f"kuiper_xla_compile_seconds_count{{{lbl}}}"))
+        assert int(cnt_line.rsplit(" ", 1)[1]) == 3
+
+    def test_counter_families_render(self):
+        w = devwatch.registry().register("fam.fold", None)
+        w.calls = 7
+        w.on_compile(5_000.0, (), {})
+        out = []
+        devwatch.render_prometheus(out, lambda s: s)
+        text = "\n".join(out)
+        assert ('kuiper_xla_compile_total{op="fam.fold",'
+                'rule="__engine__"} 1') in text
+        assert ('kuiper_xla_cache_hit_total{op="fam.fold",'
+                'rule="__engine__"} 6') in text
+        assert ('kuiper_xla_compile_signatures{op="fam.fold",'
+                'rule="__engine__"} 1') in text
+
+
+class TestRegistryBounds:
+    def test_dead_watches_retire_counters_monotonically(self):
+        """Rule restart churn: collected watches fold their counts into
+        the retired rollup (counters never reset), while LIVE watches are
+        never evicted no matter how many siblings churned past them."""
+        import gc
+
+        reg = devwatch.registry()
+        survivor = reg.register("churny.op", "r")
+        survivor.calls = 5
+        survivor.traces = 1
+        for _ in range(300):
+            w = reg.register("churny.op", "r")
+            w.calls = 2
+            w.traces = 1
+            del w  # owner collected -> __del__ retires the counts
+        gc.collect()
+        agg = reg.aggregate()[("churny.op", "r")]
+        assert agg["calls"] == 5 + 2 * 300
+        assert agg["compiles"] == 1 + 300
+        # the live watch is still individually visible (not frozen)
+        assert survivor in reg.watches()
+        survivor.calls += 1
+        assert reg.aggregate()[("churny.op", "r")]["calls"] == 6 + 600
+
+    def test_unused_watches_vanish_without_metric_rows(self):
+        """A site registered but never called (e.g. a subclass re-wrapping
+        its base's jit attrs) leaves NO permanent zero-valued rows."""
+        import gc
+
+        reg = devwatch.registry()
+        w = reg.register("orphan.op", "r")
+        del w
+        gc.collect()
+        assert ("orphan.op", "r") not in reg.aggregate()
+        out = []
+        devwatch.render_prometheus(out, lambda s: s)
+        assert not any("orphan.op" in l for l in out)
+
+
+class TestDeviceGroupByIntegration:
+    def test_fold_sites_registered_and_steady_state_flat(self):
+        """A real DeviceGroupBy fold: repeated same-shape batches compile
+        once and then only hit the cache — through the actual engine
+        kernel, not a toy fn."""
+        from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+        from ekuiper_tpu.ops.groupby import DeviceGroupBy
+        from ekuiper_tpu.sql.parser import parse_select
+
+        set_rule_context("gb_rule")
+        stmt = parse_select(
+            "SELECT deviceId, count(*) AS c, avg(temperature) AS a "
+            "FROM demo GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        plan = extract_kernel_plan(stmt)
+        gb = DeviceGroupBy(plan, capacity=64, micro_batch=64)
+        state = gb.init_state()
+        cols = {"temperature": np.full(64, 20.0, dtype=np.float32)}
+        slots = np.zeros(64, dtype=np.int32)
+        for _ in range(4):
+            state = gb.fold(state, dict(cols), slots, pane_idx=0)
+        status = devwatch.registry().rule_status("gb_rule")
+        fold = status["groupby.fold"]
+        assert fold["compiles"] == 1
+        assert fold["cache_hits"] == 3
+        assert fold["storms"] == 0
+        # finalize executes + registers too
+        outs, act = gb.finalize(state, 1)
+        assert float(act[0]) == 64.0 * 4
+        assert "groupby.finalize" in devwatch.registry().rule_status(
+            "gb_rule")

@@ -1,0 +1,308 @@
+"""Flight recorder, drop taxonomy, /diagnostics/* endpoints, and the
+kuiperdiag support bundle — all mock-clock, CPU, tier-1."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from ekuiper_tpu.runtime.events import FlightRecorder, recorder
+from ekuiper_tpu.runtime.node import Node
+from ekuiper_tpu.utils.metrics import StatManager
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_eviction_order(self):
+        fr = FlightRecorder(capacity=4)
+        for i in range(7):
+            fr.record("k", rule="r", i=i)
+        evs = fr.events()
+        # oldest evicted first; the survivors keep arrival order
+        assert [e["i"] for e in evs] == [3, 4, 5, 6]
+        assert [e["seq"] for e in evs] == [4, 5, 6, 7]
+        assert fr.total_recorded == 7
+        assert fr.capacity == 4
+
+    def test_filters_and_limit(self):
+        fr = FlightRecorder(capacity=16)
+        fr.record("a", rule="r1", x=1)
+        fr.record("b", rule="r1", x=2)
+        fr.record("a", rule="r2", x=3)
+        assert [e["x"] for e in fr.events(kind="a")] == [1, 3]
+        assert [e["x"] for e in fr.events(rule="r1")] == [1, 2]
+        assert [e["x"] for e in fr.events(kind="a", rule="r2")] == [3]
+        # limit keeps the NEWEST n after filtering
+        assert [e["x"] for e in fr.events(limit=2)] == [2, 3]
+        assert fr.events(kind="zzz") == []
+
+    def test_mock_clock_timestamps(self, mock_clock):
+        fr = FlightRecorder()
+        fr.record("t")
+        mock_clock.advance(1234)
+        fr.record("t")
+        ts = [e["ts_ms"] for e in fr.events()]
+        assert ts[1] - ts[0] == 1234
+
+    def test_diagnostics_shape(self):
+        fr = FlightRecorder(capacity=8)
+        fr.record("k", rule="r")
+        d = fr.diagnostics()
+        assert d["capacity"] == 8
+        assert d["total_recorded"] == 1
+        assert d["returned"] == 1
+        assert d["events"][0]["kind"] == "k"
+        # must be one self-contained json document (REST serves verbatim)
+        json.dumps(d)
+
+
+class TestDropTaxonomy:
+    def test_counts_by_reason_and_exceptions_untouched(self):
+        sm = StatManager("op", "n1")
+        sm.inc_dropped("buffer_full")
+        sm.inc_dropped("buffer_full", n=3)
+        sm.inc_dropped("decode_error")
+        snap = sm.snapshot()
+        assert snap["dropped_total"] == {"buffer_full": 4,
+                                         "decode_error": 1}
+        assert snap["exceptions_total"] == 0
+        assert snap["last_exception"] == ""
+
+    def test_drop_burst_events_at_decades(self):
+        sm = StatManager("op", "n2")
+        sm.rule_id = "rb"
+        sm.inc_dropped("buffer_full")  # 1st drop -> threshold-1 event
+        assert len(recorder().events(kind="drop_burst")) == 1
+        for _ in range(8):
+            sm.inc_dropped("buffer_full")  # 2..9: quiet
+        assert len(recorder().events(kind="drop_burst")) == 1
+        sm.inc_dropped("buffer_full")  # 10th -> threshold-10 event
+        evs = recorder().events(kind="drop_burst")
+        assert len(evs) == 2
+        assert evs[-1]["threshold"] == 10
+        assert evs[-1]["total"] == 10
+        assert evs[-1]["rule"] == "rb"
+        assert evs[-1]["node"] == "n2"
+        # a bulk increment that jumps decades fires ONE event (highest)
+        sm.inc_dropped("buffer_full", n=500)
+        evs = recorder().events(kind="drop_burst")
+        assert len(evs) == 3
+        assert evs[-1]["threshold"] == 100
+
+    def test_node_buffer_full_reclassified(self):
+        """Satellite: drop-oldest is a drop, not an exception — and the
+        reference drop-oldest semantics are unchanged (newest kept)."""
+        n = Node("bf", buffer_length=2)
+        n.put("a")
+        n.put("b")
+        n.put("c")  # full -> drops "a"
+        n.put("d")  # full -> drops "b"
+        assert n.stats.dropped == {"buffer_full": 2}
+        assert n.stats.exceptions == 0
+        held = [n.inq.get_nowait() for _ in range(2)]
+        assert held == ["c", "d"]
+        evs = recorder().events(kind="drop_burst")
+        assert evs and evs[0]["reason"] == "buffer_full"
+
+    def test_watermark_late_drop_is_stale_watermark(self):
+        from ekuiper_tpu.runtime.nodes_window import WatermarkNode
+
+        wm = WatermarkNode("wm", late_tolerance_ms=0)
+        got = []
+        wm.broadcast = lambda item: got.append(item)
+        from ekuiper_tpu.data.batch import ColumnBatch
+
+        def b(ts_list):
+            k = len(ts_list)
+            return ColumnBatch(
+                n=k, columns={"v": np.ones(k, dtype=np.float32)},
+                timestamps=np.asarray(ts_list, dtype=np.int64),
+                emitter="s")
+
+        wm.process(b([5_000]))
+        wm.process(b([1_000]))  # behind the watermark -> dropped
+        assert wm.stats.dropped.get("stale_watermark") == 1
+        assert wm.stats.exceptions == 0
+
+    def test_status_json_carries_drop_map(self):
+        from ekuiper_tpu.runtime.topo import Topo
+
+        topo = Topo("rd")
+        node = Node("n", op_type="op")
+        topo.add_op(node)
+        assert node.stats.rule_id == "rd"
+        node.stats.inc_dropped("pane_recycle", n=2)
+        st = topo.status()
+        assert st["op_n_0_dropped_total"] == {"pane_recycle": 2}
+
+
+class TestDiagnosticsEndpoints:
+    @pytest.fixture
+    def api(self):
+        from ekuiper_tpu.server.rest import RestApi
+        from ekuiper_tpu.store import kv
+
+        return RestApi(kv.get_store())
+
+    def test_events_endpoint_filters(self, api):
+        recorder().record("compile_storm", rule="r1", op="o")
+        recorder().record("drop_burst", rule="r2", reason="buffer_full")
+        code, out = api.dispatch("GET", "/diagnostics/events", None, {})
+        assert code == 200 and out["returned"] == 2
+        code, out = api.dispatch("GET", "/diagnostics/events", None,
+                                 {"kind": "compile_storm"})
+        assert code == 200 and out["returned"] == 1
+        assert out["events"][0]["rule"] == "r1"
+        code, out = api.dispatch("GET", "/diagnostics/events", None,
+                                 {"limit": "1"})
+        assert out["returned"] == 1
+        assert out["events"][0]["kind"] == "drop_burst"
+        code, out = api.dispatch("GET", "/diagnostics/events", None,
+                                 {"limit": "bogus"})
+        assert code == 400
+
+    def test_memory_endpoint(self, api):
+        from ekuiper_tpu.observability import memwatch
+
+        class Owner:
+            pass
+
+        owner = Owner()
+        memwatch.register("test_component", owner, lambda o: 12345,
+                          rule="rm")
+        try:
+            code, out = api.dispatch("GET", "/diagnostics/memory", None, {})
+            assert code == 200
+            rows = [r for r in out["components"]
+                    if r["component"] == "test_component"]
+            assert rows == [{"component": "test_component", "rule": "rm",
+                             "bytes": 12345}]
+            assert out["registered_bytes_total"] >= 12345
+            assert "live_bytes" in out["jax"]
+            json.dumps(out)
+        finally:
+            memwatch.registry().clear()
+
+    def test_memory_probe_dies_with_owner(self):
+        from ekuiper_tpu.observability import memwatch
+
+        class Owner:
+            pass
+
+        owner = Owner()
+        memwatch.register("ephemeral", owner, lambda o: 1, rule="x")
+        assert any(r["component"] == "ephemeral"
+                   for r in memwatch.registry().snapshot())
+        del owner
+        import gc
+
+        gc.collect()
+        assert not any(r["component"] == "ephemeral"
+                       for r in memwatch.registry().snapshot())
+
+    def test_xla_endpoint(self, api):
+        from ekuiper_tpu.observability import devwatch
+
+        w = devwatch.registry().register("diag.fold", "rx")
+        w.calls = 2
+        w.on_compile(1_000.0, (), {})
+        code, out = api.dispatch("GET", "/diagnostics/xla", None, {})
+        assert code == 200
+        assert out["totals"]["compiles"] >= 1
+        site = next(s for s in out["sites"] if s["op"] == "diag.fold")
+        assert site["compiles"] == 1 and site["cache_hits"] == 1
+        json.dumps(out)
+
+    def test_prometheus_scrape_has_new_families(self, api):
+        recorder().record("x")
+        code, out = api.dispatch("GET", "/metrics", None, {})
+        assert code == 200
+        text = str(out)
+        assert "# TYPE kuiper_device_bytes gauge" in text
+        assert 'component="jax_live_arrays"' in text
+        assert "# TYPE kuiper_node_dropped_total counter" in text
+        assert "# TYPE kuiper_xla_compile_total counter" in text
+
+
+class TestRuleLifecycleEvents:
+    def test_rule_state_transitions_recorded(self):
+        """An end-to-end rule start/stop leaves a replayable rule_state
+        trail in the recorder."""
+        from ekuiper_tpu.server.processors import StreamProcessor
+        from ekuiper_tpu.server.rule_manager import RuleRegistry
+        from ekuiper_tpu.store import kv
+
+        store = kv.get_store()
+        StreamProcessor(store).exec_stmt(
+            'CREATE STREAM fr_s (deviceId STRING, v FLOAT) WITH '
+            '(DATASOURCE="topic/fr", TYPE="memory", FORMAT="JSON")')
+        reg = RuleRegistry(store)
+        rid = reg.create({
+            "id": "fr_rule",
+            "sql": "SELECT deviceId, count(*) AS c FROM fr_s "
+                   "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)",
+            "actions": [{"nop": {}}]})
+        try:
+            import time
+
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                states = [e["state"] for e in recorder().events(
+                    kind="rule_state", rule=rid)]
+                if "running" in states:
+                    break
+                time.sleep(0.02)
+            states = [e["state"] for e in recorder().events(
+                kind="rule_state", rule=rid)]
+            assert "starting" in states and "running" in states
+        finally:
+            reg.delete(rid)
+        deadline = __import__("time").time() + 10
+        while __import__("time").time() < deadline:
+            states = [e["state"] for e in recorder().events(
+                kind="rule_state", rule=rid)]
+            if "stopped" in states:
+                break
+            __import__("time").sleep(0.02)
+        assert "stopped" in states
+
+
+class TestKuiperdiag:
+    def test_smoke_bundle(self):
+        """tools/kuiperdiag.py --smoke: boots an in-process engine, emits
+        a self-contained JSON bundle, validates its shape (tier-1, like
+        check_metrics/check_native)."""
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "kuiperdiag.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=240,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, (
+            f"kuiperdiag --smoke FAILED:\n{proc.stdout}\n{proc.stderr}")
+        assert "OK" in proc.stdout
+
+    def test_collect_degrades_per_section(self):
+        """A half-dead engine still yields a bundle: failing sections
+        carry {"error": ...} instead of killing the collection."""
+        sys.path.insert(0, str(REPO))
+        from tools.kuiperdiag import REQUIRED_SECTIONS, collect
+
+        def flaky_fetch(path):
+            if path.startswith("/diagnostics/memory"):
+                raise RuntimeError("boom")
+            if path == "/rules":
+                return 200, [{"id": "r1"}]
+            if path.startswith("/rules/r1/status"):
+                return 500, {"error": "dead"}
+            return 200, {"ok": path}
+
+        bundle = collect(flaky_fetch)
+        assert bundle["memory"] == {"error": "boom"}
+        assert bundle["rule_details"]["r1"]["status"]["error"].startswith(
+            "status 500")
+        for k in REQUIRED_SECTIONS:
+            assert k in bundle
+        json.dumps(bundle)

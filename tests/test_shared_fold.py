@@ -280,9 +280,11 @@ class TestEventTimeRecycleGuard:
 
         store.process(at(0, 3))
         store.process(at(10, 4))  # bucket 10 claims pane 10 % 4 = 2
-        exc_before = store.stats.snapshot()["exceptions_total"]
+        drop_before = store.stats.snapshot()["dropped_total"].get(
+            "pane_recycle", 0)
         store.process(at(2, 5))   # pane 2 % 4 = 2 held by NEWER bucket 10
-        assert store.stats.snapshot()["exceptions_total"] > exc_before
+        assert store.stats.snapshot()["dropped_total"].get(
+            "pane_recycle", 0) > drop_before
         store.on_watermark(Watermark(ts=11_000))
         got = _drain_cbs(e)
         # bucket 10's window counts exactly its own 4 rows — the 5 stale
@@ -346,7 +348,8 @@ class TestEventTimeRecycleGuard:
                           "temperature": np.full(n, 1.0, np.float32)},
             timestamps=ts, emitter="demo"))
         # buckets 0..5 aliased (spread 10 >= 4 panes): dropped + counted
-        assert store.stats.snapshot()["exceptions_total"] >= 1
+        assert store.stats.snapshot()["dropped_total"].get(
+            "pane_recycle", 0) >= 1
         store.on_watermark(Watermark(ts=20_000))
         got = _drain_cbs(e)
         assert all(int(cb.columns["c"][0]) == 1 for cb in got)

@@ -34,13 +34,21 @@ def _synthetic_scrape() -> str:
     from ekuiper_tpu.observability.prometheus import render
     from ekuiper_tpu.utils.metrics import StatManager
 
+    class FakeQueue:
+        @staticmethod
+        def qsize():
+            return 2
+
     class Node:
         def __init__(self, name, op_type="op", pooled=False):
             self.name = name
             self.op_type = op_type
+            self.inq = FakeQueue()
             self.stats = StatManager(op_type, name)
+            self.stats.rule_id = "lint_rule"
             self.stats.inc_in(3)
             self.stats.inc_out(2)
+            self.stats.inc_dropped("buffer_full")
             self.stats.observe_stage("decode", 120.0, 3)
             self.stats.observe_queue_wait(42.0)
             self.stats.process_begin()
@@ -87,10 +95,28 @@ def _synthetic_scrape() -> str:
             return 0.5
 
     nodes_sharedfold._stores["__lint__"] = FakeStore()
+    # engine-health families: one populated compile watch (with a compile
+    # sample so kuiper_xla_compile_seconds renders buckets) and one memory
+    # probe — render() reads the module registries directly
+    from ekuiper_tpu.observability import devwatch, memwatch
+
+    watch = devwatch.registry().register("lint.fold", "lint_rule")
+    watch.calls = 5
+    watch.on_compile(12_000.0, (), {})
+
+    class MemOwner:
+        pass
+
+    owner = MemOwner()
+    memwatch.register("lint_component", owner, lambda o: 4096,
+                      rule="lint_rule")
     try:
         return render(Registry())
     finally:
         nodes_sharedfold._stores.pop("__lint__", None)
+        devwatch.registry().clear()
+        memwatch.registry().clear()
+        del owner
 
 
 def lint(text: str, docs_text: str) -> list:
