@@ -1028,6 +1028,64 @@ def _e2e_fields(topo) -> dict:
             "e2e_samples": h.count}
 
 
+class _HealthTopoShim:
+    """Just enough Topo surface for the health evaluator when a bench
+    phase drives nodes directly (no planned Topo): all_nodes + no shared
+    list, no e2e histogram (the evaluator skips absent surfaces)."""
+
+    def __init__(self, nodes):
+        self._nodes = nodes
+
+    def all_nodes(self):
+        return self._nodes
+
+    def live_shared(self):
+        return []
+
+
+def _health_fields(topo, fused, elapsed_s, rule_id="pipe1") -> dict:
+    """Final health verdict + peak burn rate + measured evaluator
+    overhead (observability/health.py) for a bench phase. Same
+    methodology as devwatch_overhead — measured cost scaled against the
+    fold stage: the evaluator ticks once per DEFAULT_INTERVAL_MS, so
+    overhead = mean tick cost x the ticks this segment would have seen
+    at the default cadence, over the fold time the segment actually
+    spent (acceptance target <1% of fold)."""
+    from ekuiper_tpu.observability import health
+
+    ev = health.HealthEvaluator(lambda: [(rule_id, topo, {})])
+    # seed tick: first delta is the whole segment, so ITS verdict carries
+    # the segment-wide burn/bottleneck/watermark attribution; later ticks
+    # see empty deltas (traffic stopped) and only advance the FSM
+    ev.tick()
+    seed = ev.verdicts().get(rule_id) or {}
+    tick_us = []  # warm ticks only — the seed paid the lazy imports
+    for _ in range(5):
+        ev.tick()
+        tick_us.append(ev.last_tick_us)
+    v = ev.verdicts().get(rule_id) or seed
+    mean_us = sum(tick_us) / len(tick_us)
+    st = (fused.stats.snapshot()["stage_timings"].get("fold")
+          if fused is not None else None)
+    fold_us = st["total_us"] if st else 0
+    ticks = max(elapsed_s * 1000.0 / health.DEFAULT_INTERVAL_MS, 1.0)
+    pct = (100.0 * mean_us * ticks / fold_us) if fold_us else None
+    burn = seed.get("burn_rate") or {}
+    return {
+        "health_verdict": v.get("state"),
+        "peak_burn_rate": ev.peak_burn(rule_id),
+        "burn_rate_fast": burn.get("fast"),
+        "burn_rate_slow": burn.get("slow"),
+        "bottleneck_stage": (seed.get("bottleneck") or {}).get("stage"),
+        "watermark_lag_ms": (seed.get("watermark") or {}).get("lag_ms"),
+        "health_overhead": {
+            "tick_us": round(mean_us, 1),
+            "interval_ms": health.DEFAULT_INTERVAL_MS,
+            "pct_of_fold": round(pct, 3) if pct is not None else None,
+        },
+    }
+
+
 def _full_pipe_main() -> None:
     """Full-pipe ingest throughput (the reference measures through its
     MQTT+decode pipeline, README.md:98; kernel-fed numbers skip ingest,
@@ -1059,7 +1117,7 @@ def _full_pipe_main() -> None:
                device_bytes_peak=run_segment.device_bytes_peak,
                stages={"source": _stage_summary(src),
                        "fused": _stage_summary(fused)},
-               **e2e)
+               **e2e, **_health_fields(topo, fused, elapsed))
 
     _full_pipe_session(measure)
 
@@ -1130,7 +1188,8 @@ def _full_pipe_contended_main() -> None:
                device_bytes_peak=run_segment.device_bytes_peak,
                stages={"source": _stage_summary(src),
                        "fused": _stage_summary(fused)},
-               **_e2e_fields(topo))
+               **_e2e_fields(topo),
+               **_health_fields(topo, fused, elapsed))
 
     _full_pipe_session(measure)
 
@@ -1335,7 +1394,10 @@ def bench_multi_rule_shared(batches, kt_slots) -> None:
            independent_rule_rows_per_sec=priv_agg,
            speedup=speedup, fold_dedup_ratio=dedup,
            parity_windows=parity_windows, n_rules=n_rules,
-           pane_ms=pane)
+           pane_ms=pane,
+           **_health_fields(
+               _HealthTopoShim(shared.pipeline_nodes() + entries),
+               shared, s_el, rule_id="r0"))
 
 
 def bench_event_time(batches, kt_slots) -> None:

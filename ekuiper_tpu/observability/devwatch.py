@@ -130,9 +130,16 @@ class OpWatch:
                 "latency; latest signature: %s",
                 self.op, n_sigs, self.traces, self.calls, sig)
             recorder().record(
-                "compile_storm", rule=self.rule or "",
+                "compile_storm", rule=self.rule or "", severity="warn",
                 op=self.op, signatures=n_sigs, traces=self.traces,
                 last_signature=sig[:256])
+
+    def signature_dump(self) -> Dict[str, int]:
+        """Full signature table copy (sig -> compiles it caused) — the
+        deep-capture bundle's HLO-signature dump (health.capture_profile);
+        too wide for the per-scrape snapshot."""
+        with self._lock:
+            return dict(self.signatures)
 
     # -------------------------------------------------------------- queries
     def snapshot(self) -> Dict[str, Any]:

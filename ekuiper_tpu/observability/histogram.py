@@ -179,6 +179,42 @@ class LatencyHistogram:
                 self.min = self.max = 0
         return snap
 
+    def bucket_counts(self) -> List[int]:
+        """Copy of the raw bucket counts — the health plane's delta
+        windows subtract two of these to get the distribution of samples
+        recorded BETWEEN evaluator ticks (the cumulative histogram itself
+        must never be decayed while Prometheus scrapes it)."""
+        with self._lock:
+            return list(self._counts)
+
+    def record_bucket_counts(self, counts: Sequence[int]) -> None:
+        """Fold raw per-bucket count deltas (a `bucket_counts()`
+        difference) into this histogram. min/max/sum are maintained at
+        bucket resolution (upper edges) — the same ≤6.25% error as every
+        other derived quantity."""
+        total = s = 0
+        lo = hi = -1
+        for i, c in enumerate(counts):
+            if c > 0:
+                total += c
+                s += c * _bucket_max(i)
+                if lo < 0:
+                    lo = i
+                hi = i
+        if not total:
+            return
+        with self._lock:
+            for i, c in enumerate(counts):
+                if c > 0:
+                    self._counts[i] += c
+            lo_v, hi_v = _bucket_max(lo), _bucket_max(hi)
+            if self.count == 0 or lo_v < self.min:
+                self.min = lo_v
+            if hi_v > self.max:
+                self.max = hi_v
+            self.count += total
+            self.sum += s
+
     def _cumulative_locked(self, bounds: Sequence[int]) -> List[int]:
         out = [0] * len(bounds)
         bi = 0

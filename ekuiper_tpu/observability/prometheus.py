@@ -113,17 +113,22 @@ def render(rule_registry) -> str:
                 f"kuiper_node_dropped_total{{{op_labels(rule_id, node)},"
                 f'reason="{_esc(reason)}"}} {n}')
     # per-edge queue depth: the node's input queue IS its fan-in edge
-    # set's buffer (one bounded queue per node), sampled LIVE at scrape —
-    # unlike buffer_length (last-dispatch gauge) this sees a queue that
-    # filled after the node's last dispatch, the backpressure onset shape
+    # set's buffer (one bounded queue per node). Reported as the MAX of
+    # the live occupancy and the enqueue-time high-water mark since the
+    # last scrape (StatManager.note_queue_depth) — a backpressure spike
+    # that fills and drains BETWEEN scrapes (or between health-evaluator
+    # ticks) would otherwise be invisible to burn-rate math
     _family(out, "kuiper_node_queue_depth", "gauge",
-            "input-queue occupancy sampled at scrape time")
+            "peak input-queue occupancy since last scrape "
+            "(enqueue-time high-water mark, floor = live occupancy)")
     for rule_id, node, _snap in snaps:
         q = getattr(node, "inq", None)
         if q is not None:
+            take = getattr(node.stats, "take_queue_peak_scrape", None)
+            peak = take() if take is not None else 0
             out.append(
                 f"kuiper_node_queue_depth{{{op_labels(rule_id, node)}}} "
-                f"{q.qsize()}")
+                f"{max(q.qsize(), peak)}")
     # per-op latency DISTRIBUTIONS (observability/histogram.py): dispatch
     # busy time and input-queue wait as quantile gauges — the per-op view
     # of the tail the e2e histogram aggregates per rule
@@ -207,10 +212,14 @@ def render(rule_registry) -> str:
     # engine-health planes (devwatch: XLA trace-vs-hit accounting;
     # memwatch: per-component device/host byte probes) — module-global
     # registries, so they render once per scrape, not per rule
-    from . import devwatch, memwatch
+    from . import devwatch, health, memwatch
 
     devwatch.render_prometheus(out, _esc)
     memwatch.render_prometheus(out, _esc)
+    # health plane (observability/health.py): per-rule verdict, SLO burn
+    # rate, watermark lag, bottleneck stage — computed at evaluator ticks,
+    # rendered from the last verdicts (a scrape never forces a tick)
+    health.render_prometheus(out, _esc)
     _family(out, "kuiper_uptime_seconds", "gauge",
             "seconds since engine start")
     out.append(f"kuiper_uptime_seconds {time.time() - _START_TIME:.1f}")

@@ -90,13 +90,21 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._seq = 0  # total ever recorded (monotonic event id)
 
-    def record(self, kind: str, rule: str = "", **detail: Any) -> None:
+    #: event severities, mildest first — producers grade their events so
+    #: pollers can alert on warn/error without parsing kinds
+    SEVERITIES = ("info", "warn", "error")
+
+    def record(self, kind: str, rule: str = "", severity: str = "info",
+               **detail: Any) -> None:
         """Append one event. `detail` values must be JSON-serializable
-        (the ring is served verbatim over REST)."""
+        (the ring is served verbatim over REST). `severity` grades the
+        event info/warn/error; unknown grades clamp to info."""
         from ..utils import timex
 
-        ev = {"kind": kind, "rule": rule, "ts_ms": timex.now_ms(),
-              **detail}
+        if severity not in self.SEVERITIES:
+            severity = "info"
+        ev = {"kind": kind, "rule": rule, "severity": severity,
+              "ts_ms": timex.now_ms(), **detail}
         with self._lock:
             self._seq += 1
             ev["seq"] = self._seq
@@ -104,15 +112,29 @@ class FlightRecorder:
 
     def events(self, kind: Optional[str] = None,
                rule: Optional[str] = None,
-               limit: Optional[int] = None) -> list:
-        """Events oldest→newest, optionally filtered; `limit` keeps the
-        NEWEST n after filtering."""
+               limit: Optional[int] = None,
+               since: Optional[int] = None) -> list:
+        """Events oldest→newest, optionally filtered. `since` returns
+        only events with seq > since — pollers tail the ring
+        incrementally by passing the last seq they saw (kuiperdiag
+        bundles record it). `limit` keeps the NEWEST n after filtering —
+        except when combined with `since`, where it keeps the OLDEST n:
+        a tailing client pages FORWARD from its cursor, so truncation
+        must drop the events it will fetch next page, not the ones
+        between its cursor and the window (which `last_seq` would then
+        silently skip forever)."""
         with self._lock:
             out = list(self._ring)
+        if since is not None:
+            out = [e for e in out if e["seq"] > since]
         if kind is not None:
             out = [e for e in out if e["kind"] == kind]
         if rule is not None:
             out = [e for e in out if e["rule"] == rule]
+        if since is not None:
+            if limit is not None and limit >= 0:
+                out = out[:limit]
+            return out
         if limit is not None and limit >= 0:
             out = out[len(out) - min(limit, len(out)):]
         return out
@@ -129,12 +151,16 @@ class FlightRecorder:
 
     def diagnostics(self, kind: Optional[str] = None,
                     rule: Optional[str] = None,
-                    limit: Optional[int] = None) -> Dict[str, Any]:
-        """The GET /diagnostics/events payload."""
-        evs = self.events(kind=kind, rule=rule, limit=limit)
+                    limit: Optional[int] = None,
+                    since: Optional[int] = None) -> Dict[str, Any]:
+        """The GET /diagnostics/events payload. `last_seq` is the newest
+        seq in the response (or the caller's `since` when nothing newer
+        exists) — feed it back as `?since=` to tail without re-reading."""
+        evs = self.events(kind=kind, rule=rule, limit=limit, since=since)
         return {"events": evs, "capacity": self.capacity,
                 "total_recorded": self.total_recorded,
-                "returned": len(evs)}
+                "returned": len(evs),
+                "last_seq": evs[-1]["seq"] if evs else (since or 0)}
 
 
 _recorder = FlightRecorder()

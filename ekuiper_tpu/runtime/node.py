@@ -139,10 +139,15 @@ class Node:
         self._enq_times.append(_time.perf_counter())
         if self.disable_buffer_full_discard:
             self.inq.put(entry)
+            # enqueue-time high-water mark: a backpressure spike that
+            # drains before the next Prometheus scrape / evaluator tick
+            # must still be visible to the health plane's burn-rate math
+            self.stats.note_queue_depth(self.inq.qsize())
             return
         while True:
             try:
                 self.inq.put_nowait(entry)
+                self.stats.note_queue_depth(self.inq.qsize())
                 return
             except queue.Full:
                 try:
@@ -166,6 +171,7 @@ class Node:
         desync every later wait sample)."""
         self._enq_times.append(_time.perf_counter())
         self.inq.put(item)
+        self.stats.note_queue_depth(self.inq.qsize())
 
     def send_to(self, out: "Node", item: Any) -> None:
         """Single place encoding the sender-tagging contract: barriers are

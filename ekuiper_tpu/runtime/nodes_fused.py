@@ -624,6 +624,24 @@ class FusedWindowAggNode(Node):
             return None
         return slots
 
+    def pane_occupancy(self) -> "Optional[float]":
+        """Event-time pane-ring occupancy (dirty buckets / ring size),
+        None on clock-driven paths where the ring has no backlog notion.
+        Health-evaluator probe (observability/health.py): occupancy near
+        1.0 means the watermark lags far enough that panes risk the
+        counted `pane_recycle` loss mode. Session/count/state windows
+        fold into ONE pane but track dirtiness per absolute time bucket
+        — a dirty-count/1 ratio is not a recycle-risk fraction, so they
+        report None like the clock-driven paths."""
+        dirty = getattr(self, "_dirty", None)
+        if dirty is None:
+            return None
+        if self.wt in (ast.WindowType.SESSION_WINDOW,
+                       ast.WindowType.COUNT_WINDOW,
+                       ast.WindowType.STATE_WINDOW):
+            return None
+        return len(dirty) / max(self.n_panes, 1)
+
     def prep_spec(self):
         """(key_name, kernel columns, micro_batch) for the ingest prep's
         upload stage — the ONE definition of what precompute() should
@@ -1501,7 +1519,7 @@ class FusedWindowAggNode(Node):
             from .events import recorder
 
             recorder().record(
-                "memory_evict", rule=self.stats.rule_id,
+                "memory_evict", rule=self.stats.rule_id, severity="warn",
                 component="dev_ring", node=self.name, entries=evicted,
                 bytes_freed=freed, bytes_now=self._dev_ring_bytes,
                 budget_bytes=self.dev_ring_budget_bytes)

@@ -201,6 +201,20 @@ class SharedFoldNode(Node):
             return 0.0
         return 1.0 - self.folds_did / self.folds_would
 
+    def pane_occupancy(self) -> float:
+        """Fraction of the pane ring held by unexpired (dirty) buckets —
+        occupancy approaching 1.0 under event time means the watermark
+        lags far enough that panes risk recycling before emission (the
+        counted `pane_recycle` loss mode). Health-evaluator probe."""
+        return len(self._dirty) / max(self.n_panes, 1)
+
+    def member_cursor_ms(self, rule_id: str) -> Optional[int]:
+        """One member rule's event-time emit cursor (last emitted window
+        end). Watermark lag is a PER-RULE fact even though the pane store
+        is shared — each member advances its own cursor."""
+        m = self._members.get(rule_id)
+        return m.last_end_ms if m is not None else None
+
     def _prep_spec(self):
         """(key_name, kernel columns, micro_batch) for the shared ingest
         prep's upload stage — the union plan's one declaration of what

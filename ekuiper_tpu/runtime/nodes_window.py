@@ -70,6 +70,17 @@ class WatermarkNode(Node):
         if new_wm > 0:
             self.broadcast(Watermark(ts=new_wm))
 
+    def watermark_ts(self) -> Optional[int]:
+        """Current watermark (None until one is established) — the health
+        plane's watermark-lag probe (observability/health.py) reads this
+        per tick; lag = engine clock − watermark. Mirrors the broadcast
+        guard in `_on`: a tolerance-adjusted value ≤ 0 was never emitted
+        downstream and must not read as a (wildly lagging) watermark."""
+        wm = self.max_ts - self.late_tolerance
+        if wm <= 0:
+            return None
+        return wm
+
     def snapshot_state(self) -> Optional[dict]:
         return {"max_ts": self.max_ts}
 
@@ -418,6 +429,12 @@ class WindowNode(Node):
             self.buffer = []
             self.bbuf = []
         self.broadcast(eof)
+
+    def occupancy_rows(self) -> int:
+        """Rows buffered awaiting a trigger (row + columnar buffers) —
+        the host window path's analogue of pane-ring occupancy, sampled
+        by the health evaluator."""
+        return len(self.buffer) + sum(b.n for b in self.bbuf)
 
     # ----------------------------------------------------------------- emit
     def _emit_window(self, rows: List[Row], wr: WindowRange) -> None:

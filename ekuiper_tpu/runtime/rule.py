@@ -67,8 +67,10 @@ class RuleState:
             from .events import recorder
 
             recorder().record(
-                "rule_state", rule=self.rule.id, state=st.value,
-                previous=prev.value,
+                "rule_state", rule=self.rule.id,
+                severity=("error" if st is RunState.STOPPED_BY_ERR
+                          else "info"),
+                state=st.value, previous=prev.value,
                 **({"reason": reason} if reason else {}))
 
     # --------------------------------------------------------------- actions

@@ -169,6 +169,14 @@ class Topo:
         xla = devwatch.registry().rule_status(self.rule_id)
         if xla:
             out["xla_compile"] = xla
+        # health-plane verdict (observability/health.py), when the
+        # evaluator has one — last verdict only, a status call must not
+        # pay evaluation cost
+        from ..observability import health
+
+        verdict = health.rule_verdict(self.rule_id)
+        if verdict is not None:
+            out["health"] = verdict
         return out
 
     def topo_json(self) -> Dict[str, Any]:

@@ -86,6 +86,9 @@ def start_up(config_path: str | None = None, block: bool = True):
 
     def shutdown(*_args) -> None:
         logger.info("shutting down")
+        from ..observability import health
+
+        health.reset()  # stop the evaluator's recurring timer
         api.rules.stop_all()
         PortableManager.global_instance().kill_all()  # server.go:329 KillAll
         if exporter is not None:

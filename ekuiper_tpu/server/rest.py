@@ -102,6 +102,8 @@ class RestApi:
           lambda m: self.rules.latency_usage())
         r("GET", r"^/rules/(?P<id>[^/]+)/status$",
           lambda m: self.rules.status(m["id"]))
+        r("GET", r"^/rules/(?P<id>[^/]+)/health$",
+          lambda m: self.rule_health(m["id"]))
         r("GET", r"^/rules/(?P<id>[^/]+)/topo$",
           lambda m: self.rules.topo_json(m["id"]))
         r("GET", r"^/rules/(?P<id>[^/]+)/explain$",
@@ -188,6 +190,12 @@ class RestApi:
         r("GET", r"^/diagnostics/memory$",
           lambda m: self.diagnostics_memory())
         r("GET", r"^/diagnostics/xla$", lambda m: self.diagnostics_xla())
+        # health plane: per-rule SLO verdicts + engine view, and the
+        # on-demand bounded profiler capture (observability/health.py)
+        r("GET", r"^/diagnostics/health$",
+          lambda m: self.diagnostics_health())
+        r("POST", r"^/diagnostics/profile$",
+          lambda m, body=None: self.diagnostics_profile(body or {}))
         r("POST", r"^/rules/(?P<id>[^/]+)/trace/start$",
           lambda m, body=None: self._tracer().enable(
               m["id"], (body or {}).get("strategy", "always"))
@@ -242,6 +250,11 @@ class RestApi:
         r("GET", r"^/plugins/portables/(?P<name>[^/]+)$", self.describe_plugin)
         r("DELETE", r"^/plugins/portables/(?P<name>[^/]+)$",
           lambda m: self._plugins().delete(m["name"]) or f"Plugin {m['name']} is deleted.")
+        # health evaluator: periodic per-rule SLO/bottleneck/watermark
+        # verdicts over this registry's live topos
+        from ..observability import health as _health
+
+        self.health_evaluator = _health.install(self._health_rules)
 
     # ----------------------------------------------------- data import/export
     def data_import(self, m, body: Optional[dict] = None,
@@ -393,10 +406,101 @@ class RestApi:
 
         return prometheus.TextResponse(prometheus.render(self.rules))
 
+    def _health_rules(self) -> List[tuple]:
+        """(rule_id, topo, options) triples for the health evaluator —
+        every rule with a live topo."""
+        out = []
+        for entry in self.rules.list():
+            rid = entry.get("id")
+            if not rid:
+                continue
+            rs = self.rules.state(rid)
+            if rs is None or rs.topo is None:
+                continue
+            out.append((rid, rs.topo, rs.rule.options))
+        return out
+
+    def rule_health(self, rule_id: str) -> Dict[str, Any]:
+        """GET /rules/{id}/health — the rule's last health verdict (one
+        synchronous tick seeds it when the evaluator hasn't seen the rule
+        yet)."""
+        from ..observability import health
+
+        self.rules.processor.get(rule_id)  # 400 on unknown rule
+        ev = health.evaluator() or self.health_evaluator
+        # only let the request force a seeding tick when the rule is
+        # actually evaluable (live topo — the same per-entry test
+        # _health_rules applies): a stopped rule never grows a track,
+        # and a forced tick PER POLL would decay every other rule's
+        # burn windows and hysteresis off-cadence
+        rs = self.rules.state(rule_id)
+        evaluable = rs is not None and rs.topo is not None
+        verdict = ev.rule_health(rule_id, refresh_if_missing=evaluable)
+        if verdict is None:
+            if evaluable:
+                # the rule IS running; its per-tick evaluation raises
+                return {"rule": rule_id, "state": "unknown",
+                        "reason": "health evaluation is failing for "
+                                  "this rule; see engine log"}
+            return {"rule": rule_id, "state": "unknown",
+                    "reason": "rule is not running (no live topo to "
+                              "evaluate)"}
+        return verdict
+
+    def diagnostics_health(self) -> Dict[str, Any]:
+        """GET /diagnostics/health — every rule's verdict plus the
+        evaluator/HBM engine view."""
+        from ..observability import health
+
+        ev = health.evaluator() or self.health_evaluator
+        # seed only rules the evaluator has never ATTEMPTED (no track) —
+        # keying on missing verdicts would re-tick on every poll for a
+        # rule whose evaluation persistently raises
+        if any(not ev.has_track(rid) for rid, _topo, _o in
+               self._health_rules()):
+            ev.tick()  # a live rule the periodic tick hasn't seen yet
+        return ev.diagnostics()
+
+    @staticmethod
+    def diagnostics_profile(body: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /diagnostics/profile {duration_ms?, out_dir?} — bounded
+        jax.profiler trace + devwatch/memwatch/health dump into a bundle
+        directory (collected by tools/kuiperdiag.py --profile)."""
+        from ..observability import health
+
+        try:
+            duration = int(body.get("duration_ms", 1000))
+        except (TypeError, ValueError):
+            raise EngineError(
+                f"invalid duration_ms {body.get('duration_ms')!r}")
+        out_dir = body.get("out_dir") or None
+        if out_dir is not None:
+            # the REST port is the untrusted boundary: an arbitrary
+            # out_dir would let any client create directories and write
+            # files anywhere the engine user can — captures over HTTP
+            # must land under the store path (capture_profile itself
+            # stays flexible for in-process tools/tests)
+            from ..utils.config import get_config
+
+            base = os.path.realpath(get_config().store.path)
+            cand = os.path.realpath(out_dir)
+            if cand != base and not cand.startswith(base + os.sep):
+                raise EngineError(
+                    f"out_dir must be under the store path {base!r}")
+            out_dir = cand
+        try:
+            return health.capture_profile(duration_ms=duration,
+                                          out_dir=out_dir)
+        except RuntimeError as exc:
+            raise EngineError(str(exc))
+
     @staticmethod
     def diagnostics_events(query: Dict[str, str]) -> Dict[str, Any]:
-        """GET /diagnostics/events?kind=&rule=&limit= — the flight
-        recorder's ring, oldest→newest (limit keeps the newest n)."""
+        """GET /diagnostics/events?kind=&rule=&limit=&since= — the flight
+        recorder's ring, oldest→newest (since returns only events with
+        seq > since, for incremental tailing; limit keeps the newest n,
+        or the OLDEST n when combined with since so a tailer pages
+        forward without skipping)."""
         from ..runtime.events import recorder
 
         limit = None
@@ -405,9 +509,15 @@ class RestApi:
                 limit = max(int(query["limit"]), 0)
             except ValueError:
                 raise EngineError(f"invalid limit {query['limit']!r}")
+        since = None
+        if query.get("since"):
+            try:
+                since = max(int(query["since"]), 0)
+            except ValueError:
+                raise EngineError(f"invalid since {query['since']!r}")
         return recorder().diagnostics(
             kind=query.get("kind") or None,
-            rule=query.get("rule") or None, limit=limit)
+            rule=query.get("rule") or None, limit=limit, since=since)
 
     @staticmethod
     def diagnostics_memory() -> Dict[str, Any]:

@@ -67,6 +67,35 @@ class StatManager:
         # queue before its dispatch began (both µs, real perf clock).
         self.proc_hist = LatencyHistogram()
         self.queue_hist = LatencyHistogram()
+        # queue-depth high-water marks, noted at ENQUEUE time (node.py
+        # put/put_control) so a spike that drains between observations is
+        # still seen. Two marks with independent read-and-reset consumers:
+        # the Prometheus scrape and the health evaluator's tick (their
+        # cadences differ — one shared mark would blind whichever reads
+        # second). Unlocked telemetry-grade updates: a lost increment
+        # under a racing put costs one sample, never correctness.
+        self._qd_peak_scrape = 0
+        self._qd_peak_tick = 0
+
+    def note_queue_depth(self, n: int) -> None:
+        """Record an observed input-queue occupancy (enqueue-time)."""
+        if n > self._qd_peak_scrape:
+            self._qd_peak_scrape = n
+        if n > self._qd_peak_tick:
+            self._qd_peak_tick = n
+
+    def take_queue_peak_scrape(self) -> int:
+        """Max observed depth since the last scrape (read-and-reset)."""
+        p = self._qd_peak_scrape
+        self._qd_peak_scrape = 0
+        return p
+
+    def take_queue_peak_tick(self) -> int:
+        """Max observed depth since the last evaluator tick
+        (read-and-reset)."""
+        p = self._qd_peak_tick
+        self._qd_peak_tick = 0
+        return p
 
     def inc_in(self, n: int = 1) -> None:
         with self._lock:
@@ -108,8 +137,9 @@ class StatManager:
             from ..runtime.events import recorder
 
             recorder().record(
-                "drop_burst", rule=self.rule_id, node=self.op_id,
-                reason=reason, total=new, threshold=crossed,
+                "drop_burst", rule=self.rule_id, severity="warn",
+                node=self.op_id, reason=reason, total=new,
+                threshold=crossed,
                 **({"detail": detail} if detail else {}))
 
     def process_begin(self) -> None:
@@ -148,6 +178,37 @@ class StatManager:
             st["calls"] += 1
             st["total_us"] += int(us)
             st["rows"] += int(rows)
+
+    def health_sample(self) -> Dict[str, Any]:
+        """Cheap cumulative counters for the health evaluator's per-tick
+        deltas — no histogram walks (snapshot() computes percentile
+        summaries; a per-tick, per-node walk of every bucket array would
+        make evaluator cost scale with histogram width).
+
+        Deliberately LOCK-FREE: evaluator ticks can fire inside a mock
+        clock's advance() (which holds the clock lock), while data-path
+        threads hold this StatManager's lock and call timex.now_ms()
+        (inc_in, process_end) — taking `self._lock` here would be a
+        clock-lock/stats-lock ABBA deadlock. Monotonic int reads are
+        atomic under the GIL; a dict resized mid-iteration just retries
+        (telemetry-grade: a stale sample costs one tick's precision)."""
+        for _ in range(4):
+            try:
+                return {
+                    "busy_us": self.process_time_us_total,
+                    "stages": {k: v["total_us"]
+                               for k, v in self.stages.items()},
+                    "dropped": sum(self.dropped.values()),
+                    "in": self.records_in,
+                }
+            except RuntimeError:  # dict changed size during iteration
+                continue
+        # retries exhausted: flag the sample so the evaluator SKIPS this
+        # node for the tick instead of baselining empty stages/drops —
+        # the next delta would otherwise replay the node's entire
+        # cumulative history as one tick's worth
+        return {"busy_us": self.process_time_us_total, "stages": {},
+                "dropped": 0, "in": self.records_in, "partial": True}
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:

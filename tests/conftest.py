@@ -35,7 +35,7 @@ def fresh_engine_state():
     from ekuiper_tpu.planner import sharing
     from ekuiper_tpu.runtime import nodes_sharedfold, subtopo
 
-    from ekuiper_tpu.observability import devwatch, memwatch
+    from ekuiper_tpu.observability import devwatch, health, memwatch
     from ekuiper_tpu.runtime.events import recorder
 
     clock = timex.set_mock_clock(0)
@@ -44,7 +44,9 @@ def fresh_engine_state():
     subtopo.reset()
     sharing.reset()
     recorder().clear()
+    health.reset()
     yield clock
+    health.reset()
     nodes_sharedfold.reset()
     subtopo.reset()
     sharing.reset()

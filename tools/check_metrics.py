@@ -59,11 +59,19 @@ def _synthetic_scrape() -> str:
     class SubTopo:
         nodes = [Node("shared_src", op_type="source", pooled=True)]
 
+    # one REAL watermark node so the health evaluator's event-time probe
+    # (and with it kuiper_watermark_lag_ms) renders a sample
+    from ekuiper_tpu.runtime.nodes_window import WatermarkNode
+
+    wm_node = WatermarkNode("wm_lint")
+    wm_node.max_ts = 1  # watermark established → lag is reportable
+
     class Topo:
         e2e_hist = LatencyHistogram()
 
         def all_nodes(self):
-            return [Node("src", "source"), Node("op1"), Node("sink", "sink")]
+            return [Node("src", "source"), Node("op1"), wm_node,
+                    Node("sink", "sink")]
 
         def live_shared(self):
             return [(SubTopo(), None)]
@@ -110,9 +118,17 @@ def _synthetic_scrape() -> str:
     owner = MemOwner()
     memwatch.register("lint_component", owner, lambda o: 4096,
                       rule="lint_rule")
+    # health plane: an installed evaluator with one ticked verdict so the
+    # kuiper_rule_health / kuiper_slo_burn_rate / kuiper_watermark_lag_ms
+    # / kuiper_bottleneck_stage families all render samples
+    from ekuiper_tpu.observability import health
+
+    hev = health.install(lambda: [("lint_rule", Topo(), {})], start=False)
+    hev.tick()
     try:
         return render(Registry())
     finally:
+        health.reset()
         nodes_sharedfold._stores.pop("__lint__", None)
         devwatch.registry().clear()
         memwatch.registry().clear()

@@ -14,8 +14,16 @@ engine remotely, into ONE self-contained JSON document:
 
 Usage:
   kuiperdiag.py [--host 127.0.0.1] [--port 9081] [--out bundle.json]
+  kuiperdiag.py --profile [--profile-ms 1000]
+                               # also trigger POST /diagnostics/profile
+                               # (bounded jax.profiler trace + devwatch
+                               # dump) and record its bundle dir
+  kuiperdiag.py --events-since SEQ
+                               # tail the event ring incrementally from
+                               # a prior bundle's events.last_seq
   kuiperdiag.py --smoke        # tier-1 self-test: in-process engine,
-                               # no network, validates bundle shape
+                               # no network, validates bundle shape +
+                               # /diagnostics/health + a 1s profile
 
 Every section degrades independently: an endpoint that errors contributes
 {"error": ...} instead of killing the bundle — a half-dead engine is
@@ -33,10 +41,11 @@ from typing import Any, Callable, Dict, Optional, Tuple
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 Fetch = Callable[[str], Tuple[int, Any]]
+Post = Callable[[str, dict], Tuple[int, Any]]
 
 #: sections (beyond per-rule detail) a valid bundle must carry
 REQUIRED_SECTIONS = ("server", "rules", "metrics", "events", "memory",
-                     "xla", "configs", "versions")
+                     "xla", "health", "configs", "versions")
 
 
 def _versions() -> Dict[str, Any]:
@@ -55,9 +64,15 @@ def _versions() -> Dict[str, Any]:
     return out
 
 
-def collect(fetch: Fetch, events_limit: int = 1000) -> Dict[str, Any]:
+def collect(fetch: Fetch, events_limit: int = 1000,
+            events_since: Optional[int] = None,
+            profile_ms: int = 0, post: Optional[Post] = None,
+            profile_dir: Optional[str] = None) -> Dict[str, Any]:
     """Assemble the bundle through `fetch(path) -> (status, payload)` —
-    HTTP against a live server, or in-process dispatch for --smoke."""
+    HTTP against a live server, or in-process dispatch for --smoke.
+    `events_since` tails the flight-recorder ring incrementally (pass a
+    prior bundle's `events.last_seq`); `profile_ms > 0` also triggers a
+    bounded profiler capture through `post` and records the result."""
 
     def get(path: str) -> Any:
         try:
@@ -69,7 +84,7 @@ def collect(fetch: Fetch, events_limit: int = 1000) -> Dict[str, Any]:
         return obj
 
     bundle: Dict[str, Any] = {
-        "bundle_version": 1,
+        "bundle_version": 2,
         "generated_at_ms": int(time.time() * 1000),
         "versions": _versions(),
     }
@@ -85,13 +100,29 @@ def collect(fetch: Fetch, events_limit: int = 1000) -> Dict[str, Any]:
             details[rid] = {
                 "status": get(f"/rules/{rid}/status"),
                 "explain": get(f"/rules/{rid}/explain"),
+                "health": get(f"/rules/{rid}/health"),
             }
     bundle["rule_details"] = details
     bundle["metrics"] = get("/metrics")
-    bundle["events"] = get(f"/diagnostics/events?limit={events_limit}")
+    ev_path = f"/diagnostics/events?limit={events_limit}"
+    if events_since is not None:
+        ev_path += f"&since={events_since}"
+    bundle["events"] = get(ev_path)
     bundle["memory"] = get("/diagnostics/memory")
     bundle["xla"] = get("/diagnostics/xla")
+    bundle["health"] = get("/diagnostics/health")
     bundle["configs"] = get("/configs")
+    if profile_ms > 0 and post is not None:
+        body = {"duration_ms": profile_ms}
+        if profile_dir:
+            body["out_dir"] = profile_dir
+        try:
+            code, obj = post("/diagnostics/profile", body)
+            bundle["profile"] = (obj if code == 200
+                                 else {"error": f"status {code}",
+                                       "body": obj})
+        except Exception as exc:
+            bundle["profile"] = {"error": str(exc)}
     return bundle
 
 
@@ -118,6 +149,31 @@ def http_fetch(host: str, port: int, timeout: float = 10.0) -> Fetch:
     return fetch
 
 
+def http_post(host: str, port: int, timeout: float = 60.0) -> Post:
+    """POST (the profile trigger) — long timeout: the capture itself
+    blocks for its duration."""
+    from urllib.error import HTTPError
+    from urllib.request import Request, urlopen
+
+    def post(path: str, body: dict) -> Tuple[int, Any]:
+        req = Request(f"http://{host}:{port}{path}",
+                      data=json.dumps(body).encode(),
+                      headers={"Content-Type": "application/json"},
+                      method="POST")
+        try:
+            with urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read().decode()
+                                               or "null")
+        except HTTPError as exc:
+            raw = exc.read()
+            try:
+                return exc.code, json.loads(raw.decode() or "null")
+            except Exception:
+                return exc.code, raw.decode(errors="replace")
+
+    return post
+
+
 def inproc_fetch(api) -> Fetch:
     """Dispatch straight into a RestApi (no socket) — the --smoke path."""
     from urllib.parse import parse_qs, urlparse
@@ -134,6 +190,13 @@ def inproc_fetch(api) -> Fetch:
     return fetch
 
 
+def inproc_post(api) -> Post:
+    def post(path: str, body: dict) -> Tuple[int, Any]:
+        return api.dispatch("POST", path, body, {})
+
+    return post
+
+
 # --------------------------------------------------------------------- smoke
 def smoke() -> int:
     """Tier-1 self-test: boot an in-process engine with one live rule,
@@ -148,6 +211,7 @@ def smoke() -> int:
     store = kv.get_store()
     api = RestApi(store)
     rid = "kuiperdiag_smoke"
+    profile_dir = None
     try:
         code, out = api.dispatch("POST", "/streams", {
             "sql": "CREATE STREAM diagsmoke (deviceId STRING, v FLOAT) "
@@ -164,10 +228,26 @@ def smoke() -> int:
         if code not in (200, 201):
             print(f"kuiperdiag --smoke: rule create failed: {out}")
             return 1
+        # rule start is async (FSM action queue): wait for the live topo,
+        # the health sections below evaluate only running rules
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            rs = api.rules.state(rid)
+            if rs is not None and rs.topo is not None:
+                break
+            time.sleep(0.05)
         mem.publish("topic/diagsmoke",
                     [b'{"deviceId": "d1", "v": 1.5}',
                      b'{"deviceId": "d2", "v": 2.5}'])
-        bundle = collect(inproc_fetch(api), events_limit=100)
+        # the REST boundary only accepts capture dirs under the store
+        # path; exercise the smoke capture through the same constraint
+        from ekuiper_tpu.utils.config import get_config
+
+        profile_dir = os.path.join(get_config().store.path, "profiles",
+                                   f"ekdiag_smoke_{os.getpid()}")
+        bundle = collect(inproc_fetch(api), events_limit=100,
+                         profile_ms=1000, post=inproc_post(api),
+                         profile_dir=profile_dir)
         missing = [k for k in REQUIRED_SECTIONS
                    if not bundle.get(k)
                    or (isinstance(bundle[k], dict) and "error" in bundle[k])]
@@ -178,6 +258,26 @@ def smoke() -> int:
             problems.append("metrics scrape content")
         if not recorder().total_recorded:
             problems.append("flight recorder (no rule_state events)")
+        # health plane: the rule's verdict must be present with a state
+        health = bundle.get("health") or {}
+        if rid not in (health.get("rules") or {}):
+            problems.append(f"health.rules[{rid}]")
+        if not (bundle.get("rule_details", {}).get(rid, {})
+                .get("health", {}).get("state")):
+            problems.append(f"rule_details[{rid}].health.state")
+        # incremental tailing: the recorded last_seq must tail cleanly
+        last_seq = (bundle.get("events") or {}).get("last_seq")
+        if not isinstance(last_seq, int) or last_seq <= 0:
+            problems.append("events.last_seq")
+        # profile capture: the bundle dir must exist and carry the
+        # devwatch dump (the jax trace itself may degrade on bare CPU —
+        # that is recorded in profile.trace, not a smoke failure)
+        profile = bundle.get("profile") or {}
+        pdir = profile.get("dir")
+        if not pdir or not os.path.isdir(pdir):
+            problems.append(f"profile.dir ({profile})")
+        elif "devwatch_dump.json" not in (profile.get("files") or []):
+            problems.append("profile devwatch_dump.json")
         # the whole point: the bundle must round-trip as ONE json document
         encoded = json.dumps(bundle)
         if problems:
@@ -186,7 +286,9 @@ def smoke() -> int:
             return 1
         print(f"kuiperdiag --smoke: OK ({len(encoded)} bytes, "
               f"{len(bundle['rule_details'])} rule(s), "
-              f"{bundle['events'].get('returned', 0)} event(s))")
+              f"{bundle['events'].get('returned', 0)} event(s), "
+              f"last_seq={last_seq}, profile trace "
+              f"{profile.get('trace', '?')})")
         return 0
     finally:
         try:
@@ -194,6 +296,10 @@ def smoke() -> int:
         except Exception:
             pass
         mem.reset()
+        if profile_dir:
+            import shutil
+
+            shutil.rmtree(profile_dir, ignore_errors=True)
 
 
 def main() -> int:
@@ -203,6 +309,15 @@ def main() -> int:
     ap.add_argument("--out", default="-",
                     help="output file (default: stdout)")
     ap.add_argument("--events-limit", type=int, default=1000)
+    ap.add_argument("--events-since", type=int, default=None,
+                    help="tail the event ring from this seq (a prior "
+                         "bundle's events.last_seq)")
+    ap.add_argument("--profile", action="store_true",
+                    help="also trigger a bounded profiler capture "
+                         "(POST /diagnostics/profile) and record its "
+                         "bundle directory")
+    ap.add_argument("--profile-ms", type=int, default=1000,
+                    help="profiler capture duration (server-capped)")
     ap.add_argument("--smoke", action="store_true",
                     help="in-process self-test (tier-1)")
     args = ap.parse_args()
@@ -215,8 +330,14 @@ def main() -> int:
         sys.stdout.flush()
         sys.stderr.flush()
         os._exit(rc)
-    bundle = collect(http_fetch(args.host, args.port),
-                     events_limit=args.events_limit)
+    bundle = collect(
+        http_fetch(args.host, args.port),
+        events_limit=args.events_limit,
+        events_since=args.events_since,
+        profile_ms=args.profile_ms if args.profile else 0,
+        post=http_post(args.host, args.port,
+                       timeout=max(args.profile_ms / 1000.0 + 30.0, 60.0))
+        if args.profile else None)
     text = json.dumps(bundle, indent=2, default=str)
     if args.out == "-":
         print(text)
