@@ -57,15 +57,54 @@ def _remaining_s() -> float:
 
 
 def phase_budget(nominal_s: float, remaining_s=None,
-                 reserve_s: float = 15.0) -> float:
+                 reserve_s: float = 15.0,
+                 later_floor_s: float = 0.0) -> float:
     """Wall-clock budget for one phase: its nominal allowance clamped so
     the phase can never spend past the global deadline minus a reserve
-    for the final-JSON flush. THE invariant (unit-tested,
+    for the final-JSON flush, minus the floors of every later phase
+    (`later_floor_s`, see PHASE_FLOORS). THE invariants (unit-tested,
     tests/test_bench_budget.py — the r05 rc=124 post-mortem class of bug):
     for any sequence of phases each consuming at most its clamped budget,
-    total spend stays within TOTAL_BUDGET_S."""
+    total spend stays within TOTAL_BUDGET_S; and when the roster's floors
+    fit the budget, every phase is offered at least min(nominal, floor)
+    seconds no matter how greedily earlier phases spent theirs."""
     rem = _remaining_s() if remaining_s is None else remaining_s
-    return min(float(nominal_s), max(rem - reserve_s, 0.0))
+    return min(float(nominal_s), max(rem - reserve_s - later_floor_s, 0.0))
+
+
+#: roster-ordered (tag, minimum useful seconds) per phase. phase_budget()
+#: subtracts the floors of every LATER phase from the remaining global
+#: budget before granting one, so a single slow phase can never starve
+#: the rest of the roster out of the artifact (BENCH_r05's rc=124: the
+#: full_pipe child alone was allowed the driver's whole 900s, so nothing
+#: after it — or even the final JSON — ever ran). A floor is a guarantee
+#: of OPPORTUNITY, not a spend: fast phases return their unused share to
+#: the pool. Floors sum to well under TOTAL_BUDGET_S (asserted in
+#: tests/test_bench_budget.py).
+PHASE_FLOORS = (
+    ("full-pipe", 120.0),
+    ("full-pipe-contended", 90.0),
+    ("hetero 256-rule", 90.0),
+    ("phase_throughput", 60.0),
+    ("phase_latency", 40.0),
+    ("sliding", 50.0),
+    ("heavy_hitters", 30.0),
+    ("hll_1m", 60.0),
+    ("event_time", 25.0),
+    ("rule_group", 25.0),
+    ("multi_rule_shared", 30.0),
+)
+
+
+def later_floor(tag: str) -> float:
+    """Sum of the floors of every phase AFTER `tag` in the roster (0.0
+    for a tag not in the roster — ad-hoc phases get the plain greedy
+    carve)."""
+    names = [n for n, _ in PHASE_FLOORS]
+    if tag not in names:
+        return 0.0
+    i = names.index(tag)
+    return float(sum(f for _, f in PHASE_FLOORS[i + 1:]))
 
 # Every phase records its key metrics here via record(); the final stdout
 # JSON line carries the whole dict under "phases", so the driver artifact
@@ -81,6 +120,50 @@ def record(phase: str, **kv) -> None:
     # subprocess-isolated phases get their record lines re-parsed by the
     # parent (_run_isolated); plain stderr so humans can read them too
     print("#R " + json.dumps({phase: d}), file=sys.stderr, flush=True)
+
+
+def _flush_record_dump() -> None:
+    """One `#R ` line carrying EVERYTHING recorded so far — the dying
+    gasp of a watchdog. Per-record lines already stream out as phases
+    finish, but when a watchdog fires inside a subprocess-isolated phase
+    the child's stdout JSON is discarded; this stderr line is what the
+    parent's harvest (`_harvest_phase_stderr`) folds into the artifact's
+    `phases` (the r05 class: a killed child left `parsed` null)."""
+    try:
+        print("#R " + json.dumps(dict(RESULTS)), file=sys.stderr,
+              flush=True)
+    except Exception:
+        pass
+
+def _block_marker(marker) -> None:
+    """Pace the dispatch queue: wait for a buffer captured one mark ago.
+    Capture sites take a tiny SLICE of the state (`state["act"][:1]`) —
+    a fresh buffer nothing ever donates, whose computation completes no
+    earlier than the state it was cut from — because the state array
+    itself is donated to a later fold on backends that honor
+    donate_argnums (CPU jax does): blocking the raw array raised
+    INVALID_ARGUMENT and killed the sliding phase on every CPU round,
+    and skipping deleted markers instead would silently disable pacing
+    on exactly those backends. The deleted-buffer tolerance below is a
+    last-resort guard for races, not the mechanism."""
+    if marker is None:
+        return
+    import jax
+
+    try:
+        deleted = getattr(marker, "is_deleted", None)
+        if deleted is not None and deleted():
+            return
+        jax.block_until_ready(marker)
+    except Exception as exc:
+        # ONLY the donation race between the check and the block is
+        # benign; a real device fault must propagate (the marker is the
+        # in-flight bound — swallowing it would let the loop dispatch
+        # unboundedly and measure client RAM, not the pipeline)
+        msg = str(exc).lower()
+        if "deleted" not in msg and "donated" not in msg:
+            raise
+
 
 # Phase T: saturated link; long windows amortize the boundary's device wait.
 # 20 windows -> >=20 device-served boundary samples (r03 recorded only 4,
@@ -232,91 +315,109 @@ def bench_sliding_percentile(batches, kt_slots) -> None:
     node._emit_sliding(timex.now_ms())  # warm finalize path
     node._drain_async_emits()
     jax.block_until_ready(node.state)
-    emits.clear()
-    deliver_ts.clear()
-    issue_ts.clear()
-    rows = 0
-    n = 0
-    marker = None
-    t0 = time.time()
-    while time.time() - t0 < 12.0:
-        node.process(stamped(n, spike=(n % 40 == 39)))
-        rows += BATCH_ROWS
-        n += 1
-        if n % T_BLOCK_EVERY == 0:
-            if marker is not None:
-                jax.block_until_ready(marker)
-            marker = node.state["act"]
-    node._drain_async_emits()
-    jax.block_until_ready(node.state)
-    elapsed = time.time() - t0
-    # trigger emissions deliver via the emit worker: report BOTH the fold
-    # stall (time the trigger spends in the fold stream — the dispatch) and
-    # the issue->delivered latency the sink observes
-    if issue_ts:
-        stall_ms = [d for _, d in issue_ts]
-        lat = (f"fold stall p50={np.percentile(stall_ms, 50):.1f}ms "
-               f"max={max(stall_ms):.0f}ms; "
-               + _delivery_latency_line(issue_ts, deliver_ts))
-    else:
-        lat = "no triggers fired"
-    print(
-        f"# sliding percentile (10s window, 10k keys, device path): "
-        f"{rows:,} rows in {elapsed:.2f}s ({rows / elapsed:,.0f} rows/s), "
-        f"{len(issue_ts)} trigger emissions, {lat}",
-        file=sys.stderr,
-    )
-    k = min(len(issue_ts), len(deliver_ts))
-    record("sliding_saturated", rows_per_sec=rows / elapsed,
-           triggers=len(issue_ts),
-           fold_stall_p50_ms=float(np.percentile(
-               [d for _, d in issue_ts], 50)) if issue_ts else None,
-           fold_stall_max_ms=float(max(d for _, d in issue_ts))
-           if issue_ts else None,
-           deliver_p50_ms=float(np.percentile(
-               [(deliver_ts[i] - issue_ts[i][0]) * 1000 for i in range(k)],
-               50)) if k else None)
-    # paced segment (phase-L analogue): at sustainable load the delivery
-    # latency is what a sink actually observes — the saturated segment
-    # above queues the finalize behind ~16 in-flight fold dispatches
-    emits.clear()
-    deliver_ts.clear()
-    issue_ts.clear()
-    interval = BATCH_ROWS / 1_000_000  # pace at 1M rows/s
-    rows = 0
-    n = 0
-    t0 = time.time()
-    while time.time() - t0 < 8.0:
-        target = t0 + n * interval
-        delay = target - time.time()
-        if delay > 0:
-            time.sleep(delay)
-        node.process(stamped(n, spike=(n % 5 == 4)))
-        rows += BATCH_ROWS
-        n += 1
-    node._drain_async_emits()
-    jax.block_until_ready(node.state)
-    elapsed = time.time() - t0
-    print(
-        f"# sliding percentile paced (1.0M rows/s): {rows:,} rows in "
-        f"{elapsed:.2f}s ({rows / elapsed:,.0f} rows/s), {len(issue_ts)} "
-        f"trigger emissions, "
-        f"{_delivery_latency_line(issue_ts, deliver_ts)}",
-        file=sys.stderr,
-    )
-    k = min(len(issue_ts), len(deliver_ts))
-    record("sliding_paced", rows_per_sec=rows / elapsed,
-           triggers=len(issue_ts),
-           fold_stall_p50_ms=float(np.percentile(
-               [d for _, d in issue_ts], 50)) if issue_ts else None,
-           fold_stall_max_ms=float(max(d for _, d in issue_ts))
-           if issue_ts else None,
-           deliver_p50_ms=float(np.percentile(
-               [(deliver_ts[i] - issue_ts[i][0]) * 1000 for i in range(k)],
-               50)) if k else None,
-           deliver_p99_ms=float(np.percentile(
-               [(deliver_ts[i] - issue_ts[i][0]) * 1000 for i in range(k)],
-               99)) if k else None)
+    # the sliding phase is WHERE the 865ms stalls live (BENCH_r04) — run
+    # it with dense device-timing sampling so kernel_split can decompose
+    # every trigger's refold path (fold_masked / finalize_dyn /
+    # components) into dispatch / compile / device-compute / transfer;
+    # the probe starts AFTER warmup so steady-state numbers aren't
+    # polluted by warmup compiles, but mid-segment refold compiles (a
+    # real stall component) are counted
+    from ekuiper_tpu.observability import kernwatch
+
+    prior_sampling = kernwatch.set_sampling(hot=8, boundary=1)
+    try:
+        kernel_split = _kernel_split_probe()
+        emits.clear()
+        deliver_ts.clear()
+        issue_ts.clear()
+        rows = 0
+        n = 0
+        marker = None
+        t0 = time.time()
+        while time.time() - t0 < 12.0:
+            node.process(stamped(n, spike=(n % 40 == 39)))
+            rows += BATCH_ROWS
+            n += 1
+            if n % T_BLOCK_EVERY == 0:
+                _block_marker(marker)
+                marker = node.state["act"][:1]  # non-donated slice
+        node._drain_async_emits()
+        jax.block_until_ready(node.state)
+        elapsed = time.time() - t0
+        # trigger emissions deliver via the emit worker: report BOTH the fold
+        # stall (time the trigger spends in the fold stream — the dispatch) and
+        # the issue->delivered latency the sink observes
+        if issue_ts:
+            stall_ms = [d for _, d in issue_ts]
+            lat = (f"fold stall p50={np.percentile(stall_ms, 50):.1f}ms "
+                   f"max={max(stall_ms):.0f}ms; "
+                   + _delivery_latency_line(issue_ts, deliver_ts))
+        else:
+            lat = "no triggers fired"
+        print(
+            f"# sliding percentile (10s window, 10k keys, device path): "
+            f"{rows:,} rows in {elapsed:.2f}s ({rows / elapsed:,.0f} rows/s), "
+            f"{len(issue_ts)} trigger emissions, {lat}",
+            file=sys.stderr,
+        )
+        k = min(len(issue_ts), len(deliver_ts))
+        record("sliding_saturated", rows_per_sec=rows / elapsed,
+               triggers=len(issue_ts),
+               fold_stall_p50_ms=float(np.percentile(
+                   [d for _, d in issue_ts], 50)) if issue_ts else None,
+               fold_stall_max_ms=float(max(d for _, d in issue_ts))
+               if issue_ts else None,
+               deliver_p50_ms=float(np.percentile(
+                   [(deliver_ts[i] - issue_ts[i][0]) * 1000 for i in range(k)],
+                   50)) if k else None,
+               kernel_split=kernel_split())
+        # paced segment (phase-L analogue): at sustainable load the delivery
+        # latency is what a sink actually observes — the saturated segment
+        # above queues the finalize behind ~16 in-flight fold dispatches
+        kernel_split = _kernel_split_probe()  # fresh deltas for this segment
+        emits.clear()
+        deliver_ts.clear()
+        issue_ts.clear()
+        interval = BATCH_ROWS / 1_000_000  # pace at 1M rows/s
+        rows = 0
+        n = 0
+        t0 = time.time()
+        while time.time() - t0 < 8.0:
+            target = t0 + n * interval
+            delay = target - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            node.process(stamped(n, spike=(n % 5 == 4)))
+            rows += BATCH_ROWS
+            n += 1
+        node._drain_async_emits()
+        jax.block_until_ready(node.state)
+        elapsed = time.time() - t0
+        print(
+            f"# sliding percentile paced (1.0M rows/s): {rows:,} rows in "
+            f"{elapsed:.2f}s ({rows / elapsed:,.0f} rows/s), {len(issue_ts)} "
+            f"trigger emissions, "
+            f"{_delivery_latency_line(issue_ts, deliver_ts)}",
+            file=sys.stderr,
+        )
+        k = min(len(issue_ts), len(deliver_ts))
+        record("sliding_paced", rows_per_sec=rows / elapsed,
+               triggers=len(issue_ts),
+               fold_stall_p50_ms=float(np.percentile(
+                   [d for _, d in issue_ts], 50)) if issue_ts else None,
+               fold_stall_max_ms=float(max(d for _, d in issue_ts))
+               if issue_ts else None,
+               deliver_p50_ms=float(np.percentile(
+                   [(deliver_ts[i] - issue_ts[i][0]) * 1000 for i in range(k)],
+                   50)) if k else None,
+               deliver_p99_ms=float(np.percentile(
+                   [(deliver_ts[i] - issue_ts[i][0]) * 1000 for i in range(k)],
+                   99)) if k else None,
+               kernel_split=kernel_split())
+    finally:
+        # dense sampling must not leak into later phases even if a
+        # segment dies mid-run
+        kernwatch.set_sampling(**prior_sampling)
 
 
 def bench_hopping_heavy_hitters(batches, kt_slots) -> None:
@@ -478,9 +579,8 @@ def bench_countwindow_hll_1m(kt_slots) -> None:
             rows += BATCH_ROWS
             n += 1
             if n % T_BLOCK_EVERY == 0:
-                if marker is not None:
-                    jax.block_until_ready(marker)
-                marker = node.state["act"]
+                _block_marker(marker)
+                marker = node.state["act"][:1]  # non-donated slice
         node._drain_async_emits()
         jax.block_until_ready(node.state)
         return rows, time.time() - t0
@@ -598,7 +698,8 @@ def _run_isolated(func: str, tag: str, timeout: float = 900) -> None:
     harvests whatever `#R ` lines the child printed before the kill."""
     import subprocess
 
-    timeout = phase_budget(timeout, reserve_s=20.0)
+    timeout = phase_budget(timeout, reserve_s=20.0,
+                           later_floor_s=later_floor(tag))
     if timeout < 30.0:
         print(f"# {tag}: skipped — {_remaining_s():.0f}s of global budget "
               "left", file=sys.stderr)
@@ -995,6 +1096,124 @@ def _devwatch_overhead(fused) -> dict:
             "pct_of_fold": round(pct, 3) if pct is not None else None}
 
 
+def _kernwatch_overhead(fused) -> dict:
+    """Measured cost of the kernel observatory (observability/
+    kernwatch.py) against the fused fold — the acceptance number behind
+    'device-time sampling ≤1% of fold', same bar as devwatch_overhead.
+    Every watched call pays one cadence check (`KernelRecord.tick`);
+    every Nth call additionally pays a device sync (`block_until_ready`
+    on the outputs) plus the dispatch/device split math. Amortized
+    per-call cost at the hot cadence = tick + sample / N."""
+    import jax
+
+    from ekuiper_tpu.observability import kernwatch
+    from ekuiper_tpu.observability.kernwatch import KernelRecord
+
+    rec = KernelRecord("bench.kern_probe")
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        rec.tick()
+    tick_us = (time.perf_counter() - t0) * 1e6 / n
+    # sample cost = (dispatch + block + split math) − bare dispatch, on a
+    # compiled identity kernel: what a sampled call pays BEYOND the call
+    x = np.zeros(8, dtype=np.float32)
+    f = jax.jit(lambda v: v)
+    jax.block_until_ready(f(x))
+    m = 500
+    t0 = time.perf_counter()
+    for _ in range(m):
+        f(x)
+    bare_us = (time.perf_counter() - t0) * 1e6 / m
+    t0 = time.perf_counter()
+    for _ in range(m):
+        ta = time.perf_counter()
+        out = f(x)
+        tb = time.perf_counter()
+        rec.sample(out, ta, tb, (x,), {})
+    sample_us = max((time.perf_counter() - t0) * 1e6 / m - bare_us, 0.0)
+    # cadence 0 = hot sampling disabled: only the tick cost remains
+    every = kernwatch.DEFAULT_SAMPLING["hot"]
+    per_call = tick_us + (sample_us / every if every > 0 else 0.0)
+    st = fused.stats.snapshot()["stage_timings"].get("fold")
+    fold_us = (st["total_us"] / max(st["calls"], 1)) if st else 0.0
+    pct = (100.0 * per_call / fold_us) if fold_us else None
+    return {"tick_us": round(tick_us, 3),
+            "sample_us": round(sample_us, 1),
+            "sample_every": every,
+            "per_call_us": round(per_call, 3),
+            "fold_us_per_call": round(fold_us, 1),
+            "pct_of_fold": round(pct, 3) if pct is not None else None}
+
+
+def _kernel_fields() -> dict:
+    """The kernel observatory's per-kernel device-time summary for the
+    bench artifact (observability/kernwatch.py): top sites by sampled
+    device time with FLOPs/bytes cost and roofline utilization — the
+    numbers a ROADMAP re-anchor can cite for headroom claims."""
+    from ekuiper_tpu.observability import kernwatch
+
+    return kernwatch.bench_summary()
+
+
+def _kernel_split_probe():
+    """Device-time decomposition over the jit registry: returns
+    `finish() -> dict` computing per-op deltas of sampled dispatch /
+    device / transfer time plus devwatch compile time since the probe
+    started — the sliding phase's answer to WHERE its trigger stalls go
+    (the 865ms fold stalls of BENCH_r04 were one opaque host number)."""
+    from ekuiper_tpu.observability import devwatch, kernwatch
+
+    def totals():
+        t = {}
+        for w in devwatch.registry().watches():
+            k = w.kern
+            t[w.op] = (k.samples, k.dispatch_us, k.device_us,
+                       k.transfer_us, w.compile_hist.sum, w.traces)
+        return t
+
+    before = totals()
+
+    def finish(top: int = 8) -> dict:
+        after = totals()
+        ops = {}
+        agg = {"samples": 0, "dispatch_us": 0.0, "device_us": 0.0,
+               "transfer_us": 0.0, "compile_us": 0.0, "compiles": 0}
+        for op, a in after.items():
+            b = before.get(op, (0, 0.0, 0.0, 0.0, 0, 0))
+            samples, disp, dev, xfer, comp_us, traces = (
+                x - y for x, y in zip(a, b))
+            if samples <= 0 and traces <= 0:
+                continue
+            agg["samples"] += samples
+            agg["dispatch_us"] += disp
+            agg["device_us"] += dev
+            agg["transfer_us"] += xfer
+            agg["compile_us"] += comp_us
+            agg["compiles"] += traces
+            ops[op] = {"samples": samples,
+                       "dispatch_ms": round(disp / 1e3, 2),
+                       "device_ms": round(dev / 1e3, 2),
+                       "transfer_est_ms": round(xfer / 1e3, 2),
+                       **({"compile_ms": round(comp_us / 1e3, 1),
+                           "compiles": traces} if traces else {})}
+        hot = sorted(ops, key=lambda o: -ops[o]["device_ms"])[:top]
+        return {
+            "device": kernwatch.device_spec().get("kind"),
+            "sampling": dict(kernwatch.DEFAULT_SAMPLING),
+            "samples": agg["samples"],
+            "dispatch_ms": round(agg["dispatch_us"] / 1e3, 2),
+            "compile_ms": round(agg["compile_us"] / 1e3, 1),
+            "device_compute_ms": round(
+                (agg["device_us"] - agg["transfer_us"]) / 1e3, 2),
+            "transfer_est_ms": round(agg["transfer_us"] / 1e3, 2),
+            "compiles": agg["compiles"],
+            "ops": {o: ops[o] for o in hot},
+        }
+
+    return finish
+
+
 def _hist_overhead(fused) -> dict:
     """Measured cost of the histogram hot path against the fused fold —
     the acceptance number behind 'histograms add <1% to the fold'. The
@@ -1113,6 +1332,8 @@ def _full_pipe_main() -> None:
                prep_batches=(prep.n_precomputed if prep else 0),
                hist_overhead=_hist_overhead(fused),
                devwatch_overhead=_devwatch_overhead(fused),
+               kernwatch_overhead=_kernwatch_overhead(fused),
+               kernels=_kernel_fields(),
                compile_count=run_segment.compile_count,
                device_bytes_peak=run_segment.device_bytes_peak,
                stages={"source": _stage_summary(src),
@@ -1184,6 +1405,7 @@ def _full_pipe_contended_main() -> None:
                burners=n_burn, decoder=dec,
                pool=src.decode_pool_size, shards=src._decode_shards,
                prep_batches=(prep.n_precomputed if prep else 0),
+               kernels=_kernel_fields(),
                compile_count=run_segment.compile_count,
                device_bytes_peak=run_segment.device_bytes_peak,
                stages={"source": _stage_summary(src),
@@ -1588,9 +1810,8 @@ def phase_throughput(batches) -> float:
             # for the state as of one mark AGO (usually already done), so
             # at most ~2*T_BLOCK_EVERY batches are ever in flight. An
             # unbounded loop would measure client RAM, not the pipeline.
-            if marker is not None:
-                jax.block_until_ready(marker)
-            marker = node.state["act"]
+            _block_marker(marker)
+            marker = node.state["act"][:1]  # non-donated slice
         m = n % T_WINDOW_BATCHES
         if m in T_PRE_ISSUE_AT:
             node.on_pre_trigger(PreTrigger(ts=0))
@@ -1733,6 +1954,7 @@ class PhaseWatchdog:
             RESULTS[f"{phase}_error"] = f"watchdog: exceeded {seconds:.0f}s"
             print(f"# WATCHDOG: {phase} exceeded {seconds:.0f}s — emitting "
                   "final JSON and exiting", file=sys.stderr, flush=True)
+            _flush_record_dump()
             _final_json(error=f"{phase} exceeded {seconds:.0f}s watchdog")
         except BaseException:
             pass
@@ -1783,7 +2005,7 @@ def main() -> None:
         ("multi_rule_shared", 600.0,
          lambda: bench_multi_rule_shared(batches, KEY_SLOTS)),
     ):
-        budget_s = phase_budget(budget_s)
+        budget_s = phase_budget(budget_s, later_floor_s=later_floor(name))
         if budget_s < 20.0:
             print(f"# {name}: skipped — global budget exhausted",
                   file=sys.stderr)

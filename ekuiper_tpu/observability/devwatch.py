@@ -70,7 +70,10 @@ class OpWatch:
     owns ~6 of these; instances do not share jit caches, so they do not
     share watch records either)."""
 
-    def __init__(self, op: str, rule: Optional[str]) -> None:
+    def __init__(self, op: str, rule: Optional[str],
+                 kind: str = "hot") -> None:
+        from . import kernwatch
+
         self.op = op
         self.rule = rule  # attributed lazily from the rule thread context
         self.calls = 0
@@ -79,6 +82,9 @@ class OpWatch:
         self.signatures: Dict[str, int] = {}  # sig -> compiles it caused
         self.sig_overflow = 0
         self.storms = 0  # threshold crossings flagged (0 or 1 per site)
+        # device-side twin (observability/kernwatch.py): cost capture on
+        # compiles + sampled device timing, cadence per site kind
+        self.kern = kernwatch.KernelRecord(op, kind)
         self._trace_pending = False
         self._lock = threading.Lock()
 
@@ -180,13 +186,28 @@ class _WatchedJit:
     def __call__(self, *args, **kwargs):
         rec = self.rec
         rec._trace_pending = False
+        kern = rec.kern
+        sampled = kern.tick()
         t0 = _time.perf_counter()
         out = self._jitted(*args, **kwargs)
+        t1 = _time.perf_counter()
         rec.calls += 1
-        if rec._trace_pending:
+        compiled = rec._trace_pending
+        if compiled:
             # the call's wall time IS trace+compile (+ one dispatch, noise
             # against multi-ms XLA compiles)
-            rec.on_compile((_time.perf_counter() - t0) * 1e6, args, kwargs)
+            rec.on_compile((t1 - t0) * 1e6, args, kwargs)
+            # cost_analysis off the lowered HLO — compiles only (lower()
+            # re-traces; never worth it on the call path)
+            kern.on_compile(self._jitted, args, kwargs)
+        if sampled and not compiled:
+            # sampled device-timing path: block on the outputs and split
+            # the call into host-dispatch vs device time (kernwatch). A
+            # call that COMPILED is never a timing sample — its wall time
+            # is the compile, which would poison the dispatch floor and
+            # device/roofline math and double-count against the compile
+            # histogram in any dispatch/compile/device decomposition
+            kern.sample(out, t0, t1, args, kwargs)
         return out
 
 
@@ -210,8 +231,9 @@ class _Registry:
         self._watches: List = []  # weakref.ref[OpWatch]
         self._retired: Dict[Tuple[str, str], Dict[str, int]] = {}
 
-    def register(self, op: str, rule: Optional[str]) -> OpWatch:
-        w = OpWatch(op, rule)
+    def register(self, op: str, rule: Optional[str],
+                 kind: str = "hot") -> OpWatch:
+        w = OpWatch(op, rule, kind)
         with self._lock:
             self._watches.append(self._weakref.ref(w))
             if len(self._watches) % 64 == 0:  # amortized dead-ref prune
@@ -226,6 +248,11 @@ class _Registry:
         if w.calls == 0 and w.traces == 0:
             return  # never used: leave no zero-valued metric rows behind
         key = (w.op, w.rule or "")
+        kern = getattr(w, "kern", None)
+        if kern is not None:
+            from . import kernwatch
+
+            kernwatch.retire(w.op, w.rule or "", kern)
         with self._lock:
             acc = self._retired.setdefault(
                 key, {"calls": 0, "compiles": 0, "storms": 0})
@@ -312,13 +339,18 @@ def registry() -> _Registry:
     return _registry
 
 
-def watched_jit(fn: Callable, op: str, **jit_kwargs) -> Callable:
+def watched_jit(fn: Callable, op: str, kind: str = "hot",
+                **jit_kwargs) -> Callable:
     """Drop-in instrumented `jax.jit(fn, **jit_kwargs)`. `op` names the
     site in metrics (`kuiper_xla_*{op=...}`); the owning rule is read from
-    the rule thread context at first call (plan/worker threads carry it)."""
+    the rule thread context at first call (plan/worker threads carry it).
+    `kind` is the kernwatch site class — "hot" (per-batch path, sparse
+    device-timing samples) or "boundary" (window/trigger cadence, dense
+    samples are affordable)."""
     from ..utils.rulelog import current_rule
 
-    return _WatchedJit(fn, _registry.register(op, current_rule()), jit_kwargs)
+    return _WatchedJit(fn, _registry.register(op, current_rule(), kind),
+                       jit_kwargs)
 
 
 #: `le` ladder for kuiper_xla_compile_seconds, in µs (rendered as seconds:

@@ -153,10 +153,10 @@ class _RuleTrack:
     """Per-rule evaluator state across ticks."""
 
     __slots__ = ("fast_hist", "slow_hist", "prev_e2e", "prev_nodes",
-                 "prev_queue", "fast_drops", "slow_drops", "fast_in",
-                 "slow_in", "state", "state_since_ms", "ticks_in_state",
-                 "up_pend", "up_level", "down_pend", "verdict",
-                 "peak_burn")
+                 "prev_queue", "prev_kern", "fast_drops", "slow_drops",
+                 "fast_in", "slow_in", "state", "state_since_ms",
+                 "ticks_in_state", "up_pend", "up_level", "down_pend",
+                 "verdict", "peak_burn")
 
     def __init__(self, now_ms: int) -> None:
         self.fast_hist = LatencyHistogram()
@@ -164,6 +164,7 @@ class _RuleTrack:
         self.prev_e2e: Optional[List[int]] = None
         self.prev_nodes: Dict[str, Dict[str, Any]] = {}
         self.prev_queue: Dict[str, int] = {}
+        self.prev_kern: Dict[str, Dict[str, Any]] = {}
         self.fast_drops = 0.0
         self.slow_drops = 0.0
         self.fast_in = 0.0
@@ -268,6 +269,15 @@ class HealthEvaluator:
                 rules = []
                 sweep = False
             self._tick_qpeaks: Dict[int, int] = {}
+            # kernel-observatory counters for ALL rules in one registry
+            # pass (observability/kernwatch.py) — _device_axis diffs per
+            # rule against this tick-shared map
+            from . import kernwatch
+
+            try:
+                self._tick_kern = kernwatch.rule_ops_all()
+            except Exception:
+                self._tick_kern = {}
             seen = set()
             for entry in rules:
                 try:
@@ -483,6 +493,18 @@ class HealthEvaluator:
             }
         tr.prev_queue = queue_peaks
 
+        # ---- device/host axis (observability/kernwatch.py): per-tick
+        # deltas of the rule's sampled kernel timings split the dominant
+        # stage's wall time into device-side compute/transfer vs
+        # host-side dispatch, and carry the hottest kernel's roofline
+        # utilization — "fold is dominant" becomes "fold is
+        # device-compute-bound at 71% of the HBM roof"
+        device_time = self._device_axis(rid, tr,
+                                        getattr(self, "_tick_kern", None))
+        if device_time is not None and bottleneck.get("stage"):
+            bottleneck["axis"] = device_time["axis"]
+            bottleneck["device_time"] = device_time
+
         # ---- event-time progress (watermark lag, pane occupancy)
         wm_info = self._watermark_probe(rid, ordered, now)
 
@@ -591,6 +613,60 @@ class HealthEvaluator:
             "hbm": self._rule_hbm(rid),
             **({"reasons": reasons} if reasons else {}),
         }
+
+    @staticmethod
+    def _device_axis(rid: str, tr: "_RuleTrack",
+                     ops: Optional[Dict[str, Any]] = None
+                     ) -> Optional[Dict[str, Any]]:
+        """Tick delta of the rule's kernwatch counters → device vs host
+        attribution. None when no kernel was sampled this tick (the axis
+        is only asserted on evidence, never inferred). `ops` is the
+        tick-shared kernwatch.rule_ops_all() map; None falls back to a
+        single-rule fetch (direct callers, tests)."""
+        if ops is not None:
+            cur = {op: dict(v) for op, v in (ops.get(rid) or {}).items()}
+        else:
+            from . import kernwatch
+
+            try:
+                cur = kernwatch.rule_ops(rid)
+            except Exception:
+                return None
+        prev = tr.prev_kern
+        tr.prev_kern = cur
+        dev_d = disp_d = 0.0
+        samp_d = 0
+        top_op: Optional[str] = None
+        top_dev = -1.0
+        for op, c in cur.items():
+            p = prev.get(op, {})
+            sd = c["samples"] - p.get("samples", 0)
+            if sd <= 0:
+                continue
+            dd = max(c["device_us"] - p.get("device_us", 0.0), 0.0)
+            pd = max(c["dispatch_us"] - p.get("dispatch_us", 0.0), 0.0)
+            samp_d += sd
+            dev_d += dd
+            disp_d += pd
+            if dd > top_dev:
+                top_dev, top_op = dd, op
+        if samp_d <= 0:
+            return None
+        total = dev_d + disp_d
+        share = dev_d / total if total > 0 else 0.0
+        out: Dict[str, Any] = {
+            "axis": "device" if share >= 0.5 else "host",
+            "device_share": round(share, 4),
+            "device_us": int(dev_d),
+            "dispatch_us": int(disp_d),
+            "samples": int(samp_d),
+            "op": top_op,
+        }
+        top = cur.get(top_op) or {}
+        if top.get("roofline_util") is not None:
+            out["roofline_util"] = top["roofline_util"]
+            out["bound"] = top.get("bound")
+        return out
 
     @staticmethod
     def _watermark_probe(rid: str, nodes: List[Any],

@@ -210,11 +210,13 @@ def render(rule_registry) -> str:
             out, "kuiper_rule_e2e_latency_ms", f'rule="{_esc(rule_id)}"',
             hist, E2E_BOUNDS_MS)
     # engine-health planes (devwatch: XLA trace-vs-hit accounting;
-    # memwatch: per-component device/host byte probes) — module-global
-    # registries, so they render once per scrape, not per rule
-    from . import devwatch, health, memwatch
+    # kernwatch: sampled device time + roofline; memwatch: per-component
+    # device/host byte probes) — module-global registries, so they render
+    # once per scrape, not per rule
+    from . import devwatch, health, kernwatch, memwatch
 
     devwatch.render_prometheus(out, _esc)
+    kernwatch.render_prometheus(out, _esc)
     memwatch.render_prometheus(out, _esc)
     # health plane (observability/health.py): per-rule verdict, SLO burn
     # rate, watermark lag, bottleneck stage — computed at evaluator ticks,

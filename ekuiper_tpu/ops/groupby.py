@@ -118,6 +118,7 @@ class DeviceGroupBy:
         # so trigger emission uploads one 65KB mask instead of the rows
         self._fold_m = watched_jit(self._fold_masked_impl,
                                    op=self._watch_op("fold_masked"),
+                                   kind="boundary",
                                    donate_argnums=(0,))
         # pane mask is static: no device upload per emit, one cached
         # executable per live-pane combination (few), and the output is ONE
@@ -125,17 +126,21 @@ class DeviceGroupBy:
         # (sync round trips cost 10-90ms on tunneled TPU; see bench notes)
         self._finalize = watched_jit(self._finalize_impl,
                                      op=self._watch_op("finalize"),
+                                     kind="boundary",
                                      static_argnums=(1,))
         # dynamic-mask variant: event-time windows rotate through per-window
         # pane subsets; a static mask would compile one executable per
         # subset (up to n_panes compiles), a traced mask compiles once
         self._finalize_dyn = watched_jit(self._finalize_dyn_impl,
-                                         op=self._watch_op("finalize_dyn"))
+                                         op=self._watch_op("finalize_dyn"),
+                                         kind="boundary")
         self._components = watched_jit(self._components_impl,
                                        op=self._watch_op("components"),
+                                       kind="boundary",
                                        static_argnums=(1,))
         self._reset_pane = watched_jit(self._reset_pane_impl,
                                        op=self._watch_op("reset_pane"),
+                                       kind="boundary",
                                        donate_argnums=(0,))
         # heavy_hitters finalize: candidate recovery + top-k run ON DEVICE
         # (sketches.hh_candidates) so the emit transfer is 2*k2 floats/key,
@@ -146,7 +151,8 @@ class DeviceGroupBy:
         )
         if self._host_finalize_only:
             self._hh_fin = watched_jit(self._hh_finalize_impl,
-                                       op=self._watch_op("hh_finalize"))
+                                       op=self._watch_op("hh_finalize"),
+                                       kind="boundary")
 
     #: kuiper_xla_* metric prefix for this kernel's jit sites; subclasses
     #: override (multirule / sharded) so recompiles attribute to the
@@ -681,6 +687,7 @@ class DeviceGroupBy:
 
             self._absorb = watched_jit(self._absorb_impl,
                                        op=self._watch_op("absorb"),
+                                       kind="boundary",
                                        donate_argnums=(0,))
         sh = {k: jnp.asarray(v) for k, v in shadow_data.items()}
         return self._absorb(state, sh, jnp.asarray(pane_idx, dtype=jnp.int32))

@@ -245,7 +245,8 @@ class CountMinSketch:
 
         self._update = watched_jit(self._update_impl, op="sketch.update",
                                    donate_argnums=(0,))
-        self._query = watched_jit(self._query_impl, op="sketch.query")
+        self._query = watched_jit(self._query_impl, op="sketch.query",
+                                  kind="boundary")
         # HBM accounting: the (d, w) device counts plus the bounded host
         # candidate map (~96B/entry of dict + key machinery)
         memwatch.register(

@@ -155,9 +155,11 @@ class BatchedGroupBy(DeviceGroupBy):
                                  op="multirule.fold", donate_argnums=(0,))
         self._finalize = watched_jit(self._batched_finalize_impl,
                                      op="multirule.finalize",
+                                     kind="boundary",
                                      static_argnums=(1,))
         self._reset_pane = watched_jit(self._batched_reset_impl,
                                        op="multirule.reset_pane",
+                                       kind="boundary",
                                        donate_argnums=(0,))
 
     # state ------------------------------------------------------------

@@ -169,6 +169,15 @@ class Topo:
         xla = devwatch.registry().rule_status(self.rule_id)
         if xla:
             out["xla_compile"] = xla
+        # device-time split (observability/kernwatch.py): the rule's
+        # sampled host-dispatch vs device-compute time and per-kernel
+        # roofline utilization — the device-side twin of the host stage
+        # timings above
+        from ..observability import kernwatch
+
+        kern = kernwatch.rule_status(self.rule_id)
+        if kern:
+            out["device_time"] = kern
         # health-plane verdict (observability/health.py), when the
         # evaluator has one — last verdict only, a status call must not
         # pay evaluation cost

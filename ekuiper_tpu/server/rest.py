@@ -190,6 +190,8 @@ class RestApi:
         r("GET", r"^/diagnostics/memory$",
           lambda m: self.diagnostics_memory())
         r("GET", r"^/diagnostics/xla$", lambda m: self.diagnostics_xla())
+        r("GET", r"^/diagnostics/kernels$",
+          lambda m: self.diagnostics_kernels())
         # health plane: per-rule SLO verdicts + engine view, and the
         # on-demand bounded profiler capture (observability/health.py)
         r("GET", r"^/diagnostics/health$",
@@ -535,6 +537,15 @@ class RestApi:
         reg = devwatch.registry()
         return {"totals": reg.totals(),
                 "sites": [w.snapshot() for w in reg.watches()]}
+
+    @staticmethod
+    def diagnostics_kernels() -> Dict[str, Any]:
+        """GET /diagnostics/kernels — sampled device-time split, XLA cost
+        estimates, and roofline utilization per jit site
+        (observability/kernwatch.py)."""
+        from ..observability import kernwatch
+
+        return kernwatch.diagnostics()
 
     def metrics_dump(self):
         """Write every rule's status snapshot to the data dir and return the

@@ -35,7 +35,8 @@ def fresh_engine_state():
     from ekuiper_tpu.planner import sharing
     from ekuiper_tpu.runtime import nodes_sharedfold, subtopo
 
-    from ekuiper_tpu.observability import devwatch, health, memwatch
+    from ekuiper_tpu.observability import (devwatch, health, kernwatch,
+                                           memwatch)
     from ekuiper_tpu.runtime.events import recorder
 
     clock = timex.set_mock_clock(0)
@@ -52,6 +53,7 @@ def fresh_engine_state():
     sharing.reset()
     recorder().clear()
     devwatch.registry().clear()
+    kernwatch.reset()
     memwatch.registry().clear()
     timex.use_real_clock()
 

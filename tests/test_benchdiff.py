@@ -1,0 +1,174 @@
+"""Bench trajectory gate (tools/benchdiff.py): metric flattening,
+direction-aware noise tolerance, headline gating, failed-round (r05
+class) detection — plus the --smoke subprocess self-test wired into
+tier-1 like kuiperdiag."""
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.benchdiff import (  # noqa: E402
+    classify, compare, flatten, gate, round_ok)
+
+
+def art(value=2_800_000, phases=None, rc=0, parsed=True):
+    return {"n": 1, "cmd": "bench", "rc": rc, "tail": "",
+            "parsed": ({"metric": "t", "value": value, "unit": "rows/s",
+                        "phases": phases or {}} if parsed else None)}
+
+
+class TestFlatten:
+    def test_headline_and_phase_leaves(self):
+        flat = flatten(art(123.0, {
+            "full_pipe": {"rows_per_sec": 1e6, "e2e_p99_ms": 4.0,
+                          "decoder": "native", "pool": 3,
+                          "stages": {"fused": {"fold": {
+                              "us_per_call": 60.0}}}}}))
+        assert flat["headline.value"] == 123.0
+        assert flat["phases.full_pipe.rows_per_sec"] == 1e6
+        assert flat["phases.full_pipe.e2e_p99_ms"] == 4.0
+        # nested leaves flatten through dicts
+        assert ("phases.full_pipe.stages.fused.fold.us_per_call" in flat)
+        # config echoes / strings are context, not compared metrics
+        assert "phases.full_pipe.decoder" not in flat
+        assert "phases.full_pipe.pool" not in flat
+
+    def test_booleans_and_nan_excluded(self):
+        flat = flatten(art(1.0, {
+            "p": {"ok_per_sec": True, "bad_ms": float("nan")}}))
+        assert "phases.p.ok_per_sec" not in flat
+        assert "phases.p.bad_ms" not in flat
+
+    def test_classify_directions(self):
+        assert classify("headline.value") == "higher"
+        assert classify("phases.full_pipe.rows_per_sec") == "higher"
+        assert classify("phases.x.dedup_ratio") == "higher"
+        assert classify("phases.x.e2e_p99_ms") == "lower"
+        assert classify("phases.x.degradation_pct") == "lower"
+        assert classify("phases.x.triggers") is None
+
+
+class TestRoundOk:
+    def test_parsed_null_is_the_r05_class(self):
+        ok, reason = round_ok(art(rc=124, parsed=False))
+        assert not ok
+        assert "rc=124" in reason
+
+    def test_watchdog_exit_with_artifact_is_usable(self):
+        # bench's own watchdogs exit rc=3 WITH a final JSON — usable
+        ok, _ = round_ok(art(rc=3))
+        assert ok
+
+
+class TestCompareAndGate:
+    def test_within_tolerance_is_ok(self):
+        cmp = compare([("a", art(1000.0)), ("b", art(950.0))])
+        assert gate(cmp) == 0
+        assert not cmp["regressions"]
+
+    def test_headline_regression_gates(self):
+        cmp = compare([("a", art(1000.0)), ("b", art(500.0))])
+        assert gate(cmp) == 1
+        assert cmp["headline_regressions"][0]["metric"] == "headline.value"
+        assert cmp["headline_regressions"][0]["delta_pct"] == -50.0
+
+    def test_latency_direction_inverted(self):
+        base = art(phases={"full_pipe": {"e2e_p99_ms": 4.0}})
+        worse = art(phases={"full_pipe": {"e2e_p99_ms": 20.0}})
+        better = art(phases={"full_pipe": {"e2e_p99_ms": 1.0}})
+        assert gate(compare([("a", base), ("b", worse)])) == 1  # headline
+        cmp = compare([("a", base), ("b", better)])
+        assert gate(cmp) == 0
+        row = next(r for r in cmp["rows"]
+                   if r["metric"] == "phases.full_pipe.e2e_p99_ms")
+        assert row["status"] == "improved"
+
+    def test_non_headline_regression_reports_but_passes(self):
+        base = art(phases={"sliding_paced": {"deliver_p99_ms": 100.0}})
+        slow = art(phases={"sliding_paced": {"deliver_p99_ms": 400.0}})
+        cmp = compare([("a", base), ("b", slow)])
+        assert gate(cmp) == 0
+        assert [r["metric"] for r in cmp["regressions"]] == \
+            ["phases.sliding_paced.deliver_p99_ms"]
+
+    def test_custom_tolerance(self):
+        cmp = compare([("a", art(1000.0)), ("b", art(870.0))],
+                      tolerance=0.10)
+        # headline keeps its OWN tolerance (10%): -13% gates
+        assert gate(cmp) == 1
+
+    def test_baseline_skips_rounds_missing_the_metric(self):
+        """An r05-shaped hole (round with no phases) must not erase the
+        baseline for phase metrics."""
+        base = art(phases={"full_pipe": {"rows_per_sec": 1e6}})
+        hole = art()  # headline only
+        cand = art(phases={"full_pipe": {"rows_per_sec": 0.4e6}})
+        cmp = compare([("r1", base), ("r2", hole), ("r3", cand)])
+        row = next(r for r in cmp["rows"]
+                   if r["metric"] == "phases.full_pipe.rows_per_sec")
+        assert row["baseline_round"] == "r1"
+        assert gate(cmp) == 1
+
+    def test_new_and_dropped_metrics_never_gate(self):
+        base = art(phases={"old_phase": {"rows_per_sec": 1e6}})
+        cand = art(phases={"new_phase": {"rows_per_sec": 1.0}})
+        cmp = compare([("a", base), ("b", cand)])
+        assert gate(cmp) == 0
+        statuses = {r["metric"]: r["status"] for r in cmp["rows"]}
+        assert statuses["phases.old_phase.rows_per_sec"] == "dropped"
+        assert statuses["phases.new_phase.rows_per_sec"] == "new"
+
+    def test_vanished_headline_metric_gates(self):
+        """A partially-dead bench — full_pipe child died, tumbling
+        headline survived — must fail the gate: a HEADLINE metric
+        present in the baseline but missing from the candidate is a
+        regression, not a 'dropped' footnote."""
+        base = art(phases={"full_pipe": {"rows_per_sec": 1e6,
+                                         "e2e_p99_ms": 4.0}})
+        cand = art()  # parsed fine, but no full_pipe phase at all
+        cmp = compare([("a", base), ("b", cand)])
+        assert cmp["candidate_ok"]
+        assert gate(cmp) == 1
+        gated = {r["metric"] for r in cmp["headline_regressions"]}
+        assert gated == {"phases.full_pipe.rows_per_sec",
+                         "phases.full_pipe.e2e_p99_ms"}
+        # non-headline metrics vanishing still never gate (other test)
+
+    def test_zero_baseline_still_flags(self):
+        """0ms -> 500ms must flag (no ratio exists; it must not divide
+        to 'ok'), and 0 -> 0 stays ok; a higher-better metric appearing
+        from zero is an improvement."""
+        base = art(phases={"s": {"fold_stall_p50_ms": 0.0,
+                                 "extra_per_sec": 0.0}})
+        cand = art(phases={"s": {"fold_stall_p50_ms": 500.0,
+                                 "extra_per_sec": 10.0}})
+        cmp = compare([("a", base), ("b", cand)])
+        statuses = {r["metric"]: r["status"] for r in cmp["rows"]}
+        assert statuses["phases.s.fold_stall_p50_ms"] == "REGRESSION"
+        assert statuses["phases.s.extra_per_sec"] == "improved"
+        assert gate(cmp) == 0  # neither is a headline metric
+        same = compare([("a", base), ("b", base)])
+        assert all(r["status"] == "ok" for r in same["rows"]
+                   if r["metric"].startswith("phases.s."))
+
+    def test_failed_candidate_gates(self):
+        cmp = compare([("a", art()), ("b", art(rc=124, parsed=False))])
+        assert not cmp["candidate_ok"]
+        assert gate(cmp) == 1
+
+
+class TestSmoke:
+    def test_smoke_cli(self):
+        """tools/benchdiff.py --smoke exits 0 (tier-1, like
+        kuiperdiag --smoke / check_metrics)."""
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "benchdiff.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, (
+            f"benchdiff --smoke FAILED:\n{proc.stdout}\n{proc.stderr}")
+        assert "OK" in proc.stdout

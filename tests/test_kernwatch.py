@@ -1,0 +1,454 @@
+"""Kernel observatory (observability/kernwatch.py): XLA cost-analysis
+capture with graceful no-estimate fallback, device-timing sampling
+cadence + measured overhead bound, roofline math, the Prometheus/REST
+surfaces, and the health plane's device/host bottleneck axis — all
+CPU/mock-clock tier-1 (the sampling path rides `block_until_ready`,
+which a CPU jit exercises exactly like a TPU one)."""
+import gc
+import time
+import types
+
+import numpy as np
+import pytest
+
+from ekuiper_tpu.observability import devwatch, kernwatch
+from ekuiper_tpu.observability.devwatch import watched_jit
+from ekuiper_tpu.observability.kernwatch import KernelRecord, roofline
+from ekuiper_tpu.utils.rulelog import set_rule_context
+
+#: a deterministic peak-spec the tests pin the device cache to, so the
+#: roofline numbers below are exact regardless of the host's real kind
+TEST_SPEC = {"name": "test dev", "peak_flops": 1e9, "hbm_gbs": 1.0,
+             "h2d_gbs": 1.0}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    devwatch.registry().clear()
+    kernwatch.reset()
+    set_rule_context(None)
+    yield
+    devwatch.registry().clear()
+    kernwatch.reset()
+    set_rule_context(None)
+
+
+def _pin_spec(spec=TEST_SPEC):
+    """Pre-seed the device-spec cache (kernwatch.reset() clears it)."""
+    kernwatch._device_spec_cache.clear()
+    kernwatch._device_spec_cache.append(
+        {"kind": "testdev", "spec": dict(spec) if spec else None})
+
+
+# ---------------------------------------------------------------- roofline
+class TestRoofline:
+    def test_memory_bound_when_bytes_ratio_dominates(self):
+        # 1e6 bytes in 2000us against a 1 GB/s roof -> 0.5 of HBM peak;
+        # 1e5 flops in 2000us against 1 GFLOP/s -> 0.05 of compute peak
+        rl = roofline(1e5, 1e6, 2000.0, TEST_SPEC)
+        assert rl == {"util": 0.5, "bound": "memory"}
+
+    def test_compute_bound_when_flops_ratio_dominates(self):
+        rl = roofline(1e6, 1e4, 2000.0, TEST_SPEC)
+        assert rl["bound"] == "compute"
+        assert rl["util"] == 0.5
+
+    def test_degrades_to_empty(self):
+        assert roofline(1e6, 1e6, 1000.0, None) == {}       # unknown kind
+        assert roofline(1e6, 1e6, 0.0, TEST_SPEC) == {}     # no time
+        assert roofline(None, None, 1000.0, TEST_SPEC) == {}  # no cost
+
+    def test_utilization_above_one_is_reported_not_clamped(self):
+        # a wrong peak table must be VISIBLE (util > 1), never hidden
+        rl = roofline(None, 1e7, 1000.0, TEST_SPEC)
+        assert rl["util"] == pytest.approx(10.0)
+
+
+# ----------------------------------------------------------- KernelRecord
+class TestKernelRecord:
+    def test_sampling_cadence(self):
+        rec = KernelRecord("t.op")
+        rec.sample_every = 4
+        fired = [rec.tick() for _ in range(12)]
+        assert fired == [False, False, False, True] * 3
+
+    def test_zero_cadence_disables_sampling(self):
+        rec = KernelRecord("t.op")
+        rec.sample_every = 0
+        assert not any(rec.tick() for _ in range(64))
+
+    def test_dispatch_floor_split(self):
+        """device time = blocked total minus the site's running-minimum
+        dispatch time (pure host work) — the floor ratchets DOWN only."""
+        _pin_spec()
+        rec = KernelRecord("t.op")
+        rec.record_sample(dispatch_us=40.0, total_us=100.0)
+        assert rec.dispatch_floor_us == 40.0
+        assert rec.device_us == 60.0
+        rec.record_sample(dispatch_us=20.0, total_us=120.0)  # new floor
+        assert rec.dispatch_floor_us == 20.0
+        assert rec.device_us == 60.0 + 100.0
+        rec.record_sample(dispatch_us=50.0, total_us=70.0)  # floor holds
+        assert rec.dispatch_floor_us == 20.0
+        snap = rec.snapshot()
+        assert snap["samples"] == 3
+        assert snap["device_us_total"] == pytest.approx(210.0)
+        assert snap["dispatch_us_total"] == pytest.approx(110.0)
+
+    def test_transfer_estimate_capped_by_device_time(self):
+        _pin_spec()  # h2d 1 GB/s -> 1e3 bytes/us
+        rec = KernelRecord("t.op")
+        rec.record_sample(dispatch_us=0.0, total_us=50.0, h2d_bytes=10_000)
+        assert rec.transfer_us == pytest.approx(10.0)  # 10k / 1e3
+        rec.record_sample(dispatch_us=0.0, total_us=5.0, h2d_bytes=10**9)
+        # the estimate can never exceed the measured device wait
+        assert rec.transfer_us == pytest.approx(10.0 + 5.0)
+
+    def test_sampled_roofline_rides_cost(self):
+        _pin_spec()
+        rec = KernelRecord("t.op")
+        rec.set_cost(flops=None, bytes_=5e5)
+        rec.record_sample(dispatch_us=0.0, total_us=1000.0)
+        # 5e5 bytes / 1e-3 s = 5e8 B/s against 1 GB/s -> 0.5, memory-bound
+        assert rec.roofline_util() == pytest.approx(0.5)
+        snap = rec.snapshot()
+        assert snap["bound"] == "memory"
+        assert snap["last_sample"]["roofline_util"] == pytest.approx(0.5)
+
+    def test_set_cost_intensity(self):
+        rec = KernelRecord("t.op")
+        rec.set_cost(flops=2e6, bytes_=8e6)
+        assert rec.cost == {"flops": 2e6, "bytes": 8e6, "intensity": 0.25}
+
+
+# ------------------------------------------------------------ cost capture
+class _FakeJitted:
+    """jit stand-in whose lower().cost_analysis() is scripted."""
+
+    def __init__(self, result):
+        self._result = result
+
+    def lower(self, *a, **k):
+        if isinstance(self._result, Exception):
+            raise self._result
+        return self
+
+    def cost_analysis(self):
+        return self._result
+
+
+class TestCostCapture:
+    def test_captures_flops_bytes_intensity(self):
+        rec = KernelRecord("t.op")
+        rec.on_compile(_FakeJitted({"flops": 100.0, "bytes accessed": 400.0,
+                                    "utilization": 0.1}), (), {})
+        assert rec.cost == {"flops": 100.0, "bytes": 400.0,
+                            "intensity": 0.25}
+        assert rec.cost_error is None
+
+    def test_list_result_uses_first_device(self):
+        rec = KernelRecord("t.op")
+        rec.on_compile(_FakeJitted([{"flops": 7.0}]), (), {})
+        assert rec.cost == {"flops": 7.0}
+
+    def test_no_estimates_backend_degrades(self):
+        """CPU-class backends may return nothing — the record must keep
+        working (cost None, reason recorded) instead of raising."""
+        for result in (None, [], {}, {"other": 1.0},
+                       {"flops": float("nan"), "bytes accessed": -1.0}):
+            rec = KernelRecord("t.op")
+            rec.on_compile(_FakeJitted(result), (), {})
+            assert rec.cost is None
+            assert rec.cost_error
+        rec = KernelRecord("t.op")
+        rec.on_compile(_FakeJitted(RuntimeError("no lowering")), (), {})
+        assert rec.cost is None
+        assert "no lowering" in rec.cost_error
+
+    def test_watched_jit_compile_captures_or_degrades(self):
+        """End to end on the real backend: after one compile the site has
+        EITHER a cost estimate or a recorded degradation reason — never
+        silence, never an exception on the call path."""
+        fn = watched_jit(lambda v: v * 2.0, op="kern.cost")
+        fn(np.ones(32, dtype=np.float32))
+        kern = fn.rec.kern
+        assert (kern.cost is not None) or kern.cost_error
+
+    def test_cost_error_not_sticky_across_recompiles(self):
+        rec = KernelRecord("t.op")
+        rec.on_compile(_FakeJitted({}), (), {})
+        assert rec.cost_error
+        rec.on_compile(_FakeJitted({"flops": 3.0}), (), {})
+        assert rec.cost == {"flops": 3.0}
+        assert rec.cost_error is None
+
+
+# ------------------------------------------------- sampling via watched_jit
+class TestSampledTiming:
+    def test_every_nth_call_is_sampled(self):
+        fn = watched_jit(lambda v: v + 1.0, op="kern.fold")
+        fn.rec.kern.sample_every = 2
+        x = np.zeros(16, dtype=np.float32)
+        for _ in range(8):
+            fn(x)
+        kern = fn.rec.kern
+        assert kern.samples == 4
+        assert kern.dispatch_floor_us is not None
+        assert kern.device_us >= 0.0
+        snap = kern.snapshot()
+        assert snap["dispatch_us_total"] > 0.0
+
+    def test_compiling_call_is_never_a_timing_sample(self):
+        """A call that traced+compiled must not land in the device-time
+        sample set — its wall time is the compile, which would poison the
+        dispatch floor and double-count against the compile histogram in
+        the dispatch/compile/device decomposition."""
+        fn = watched_jit(lambda v: v * 2.0, op="kern.fold")
+        fn.rec.kern.sample_every = 1  # every call would sample
+        x = np.zeros(16, dtype=np.float32)
+        fn(x)  # compiles -> skipped
+        assert fn.rec.kern.samples == 0
+        fn(x)  # cache hit -> sampled
+        assert fn.rec.kern.samples == 1
+        fn(np.zeros(32, dtype=np.float32))  # new shape: compiles again
+        assert fn.rec.kern.samples == 1
+
+    def test_boundary_kind_uses_dense_cadence(self):
+        fn = watched_jit(lambda v: v, op="kern.finalize", kind="boundary")
+        assert fn.rec.kern.kind == "boundary"
+        assert (fn.rec.kern.sample_every
+                == kernwatch.DEFAULT_SAMPLING["boundary"])
+
+    def test_unknown_kind_falls_back_to_hot(self):
+        assert KernelRecord("t.op", kind="bogus").kind == "hot"
+
+    def test_sample_never_breaks_the_call(self):
+        """A sampling failure (unblockable output) must not surface to
+        the caller — telemetry is sacrificial."""
+        rec = KernelRecord("t.op")
+        rec.sample(object(), 0.0, 0.0, (), {})  # not a jax type: no crash
+        # numpy arg-byte walk rides the same contract
+        rec.sample(None, 0.0, 0.0, (np.zeros(4),), {})
+
+    def test_set_sampling_updates_live_records_and_returns_prior(self):
+        fn = watched_jit(lambda v: v, op="kern.fold")
+        prior = kernwatch.set_sampling(hot=3)
+        try:
+            assert fn.rec.kern.sample_every == 3
+            assert kernwatch.DEFAULT_SAMPLING["hot"] == 3
+            assert prior["hot"] != 3 or prior["hot"] == 64
+        finally:
+            kernwatch.set_sampling(**prior)
+        assert kernwatch.DEFAULT_SAMPLING["hot"] == prior["hot"]
+
+    def test_overhead_bound(self):
+        """The amortized per-call cost at the hot cadence (one cadence
+        check always + one blocked sample every N) must stay under 1% of
+        a realistic fold dispatch — the same bar devwatch holds. An
+        absolute floor keeps the bound meaningful on very fast hosts."""
+        import jax
+        import jax.numpy as jnp
+
+        rec = KernelRecord("t.op")
+        n = 20_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            rec.tick()
+        tick_us = (time.perf_counter() - t0) * 1e6 / n
+
+        f = jax.jit(lambda v: v)
+        x = np.zeros(8, dtype=np.float32)
+        jax.block_until_ready(f(x))
+        m = 300
+        t0 = time.perf_counter()
+        for _ in range(m):
+            f(x)
+        bare_us = (time.perf_counter() - t0) * 1e6 / m
+        t0 = time.perf_counter()
+        for _ in range(m):
+            ta = time.perf_counter()
+            out = f(x)
+            tb = time.perf_counter()
+            rec.sample(out, ta, tb, (x,), {})
+        sample_us = max(
+            (time.perf_counter() - t0) * 1e6 / m - bare_us, 0.0)
+        per_call = tick_us + sample_us / kernwatch.DEFAULT_SAMPLING["hot"]
+
+        # a real (small) fold: segment-sum over 64k rows into 16k slots
+        slots = np.random.default_rng(0).integers(
+            0, 16_384, 65_536).astype(np.int32)
+        vals = np.ones(65_536, dtype=np.float32)
+        fold = jax.jit(lambda s, v: jnp.zeros(16_384).at[s].add(v))
+        jax.block_until_ready(fold(slots, vals))
+        t0 = time.perf_counter()
+        for _ in range(20):
+            fold(slots, vals)
+        fold_us = (time.perf_counter() - t0) * 1e6 / 20
+        assert per_call < max(0.01 * fold_us, 2.0), (
+            f"kernwatch overhead {per_call:.3f}us/call vs fold "
+            f"{fold_us:.1f}us — over the 1% bar")
+
+
+# ------------------------------------------------------- rollups + surfaces
+class TestSurfacesAndRollups:
+    def _sampled_site(self, op="kern.fold", rule="kr1", device_us=900.0,
+                      dispatch_us=100.0):
+        set_rule_context(rule)
+        fn = watched_jit(lambda v: v, op=op)
+        set_rule_context(None)
+        kern = fn.rec.kern
+        # both samples share the dispatch floor, so each contributes
+        # exactly `device_us` of post-floor device time
+        kern.record_sample(dispatch_us=dispatch_us,
+                           total_us=dispatch_us + device_us)
+        kern.record_sample(dispatch_us=dispatch_us,
+                           total_us=dispatch_us + device_us)
+        return fn
+
+    def test_rule_status_reports_split_and_ops(self):
+        _pin_spec()
+        fn = self._sampled_site()
+        st = kernwatch.rule_status("kr1")
+        assert st["samples"] == 2
+        assert st["device_ms"] == pytest.approx(1.8, abs=0.01)
+        assert st["device_share"] > 0.8
+        assert "kern.fold" in st["ops"]
+        assert kernwatch.rule_status("other") == {}
+        del fn
+
+    def test_diagnostics_shape(self):
+        _pin_spec()
+        fn = self._sampled_site()  # bound: live watches are weakref'd
+        d = kernwatch.diagnostics()
+        assert d["device"]["kind"] == "testdev"
+        assert set(d["sampling"]) == {"hot", "boundary"}
+        assert d["sites"] and d["sites"][0]["op"] == "kern.fold"
+        assert d["totals"]["samples"] == 2
+        from ekuiper_tpu.server.rest import RestApi
+
+        assert RestApi.diagnostics_kernels()["totals"] == d["totals"]
+
+    def test_prometheus_families_render(self):
+        _pin_spec()
+        fn = self._sampled_site()
+        fn.rec.kern.set_cost(flops=1e6, bytes_=4e6)
+        fn.rec.kern.record_sample(dispatch_us=10.0, total_us=1000.0)
+        out = []
+        kernwatch.render_prometheus(out, lambda s: s)
+        text = "\n".join(out)
+        for fam in ("kuiper_kernel_device_ms", "kuiper_kernel_dispatch_ms",
+                    "kuiper_kernel_flops", "kuiper_kernel_bytes",
+                    "kuiper_kernel_roofline_util"):
+            assert f"# TYPE {fam}" in text
+            assert f"# HELP {fam}" in text
+        assert 'kuiper_kernel_flops{op="kern.fold",rule="kr1"} 1000000' \
+            in text
+        # a bytes-only estimate must not fabricate a 0-FLOPs measurement
+        partial = self._sampled_site(op="kern.partial", rule="kr2")
+        partial.rec.kern.set_cost(flops=None, bytes_=7e6)
+        out2 = []
+        kernwatch.render_prometheus(out2, lambda s: s)
+        text2 = "\n".join(out2)
+        assert 'kuiper_kernel_bytes{op="kern.partial",rule="kr2"} 7000000' \
+            in text2
+        assert 'kuiper_kernel_flops{op="kern.partial"' not in text2
+
+    def test_retired_counters_stay_monotonic(self):
+        """A dying jit site folds its sampled time into the module rollup
+        (via devwatch retire) so exported counters never go backwards on
+        rule restart."""
+        fn = self._sampled_site()
+        fn.rec.calls = 2  # devwatch skips never-used watches
+        before = kernwatch.aggregate()[("kern.fold", "kr1")]["device_us"]
+        assert before > 0
+        del fn
+        gc.collect()
+        after = kernwatch.aggregate()[("kern.fold", "kr1")]
+        assert after["device_us"] == pytest.approx(before)
+        assert kernwatch.rule_ops("kr1")["kern.fold"]["samples"] == 2
+
+    def test_rule_ops_all_single_pass_matches_per_rule(self):
+        """The tick-shared one-pass map (what the health evaluator uses)
+        agrees with the per-rule view, including retired counters."""
+        a = self._sampled_site(op="kern.fold", rule="ra")
+        b = self._sampled_site(op="kern.fold", rule="rb")
+        a.rec.calls = b.rec.calls = 2
+        del b
+        gc.collect()  # rb retires into the rollup
+        allops = kernwatch.rule_ops_all()
+        assert set(allops) >= {"ra", "rb"}
+        for rid in ("ra", "rb"):
+            assert allops[rid] == kernwatch.rule_ops(rid)
+            assert allops[rid]["kern.fold"]["samples"] == 2
+        del a
+
+    def test_bench_summary_ranks_by_device_time(self):
+        _pin_spec()
+        hot = self._sampled_site(op="kern.hot", device_us=5000.0)
+        cool = self._sampled_site(op="kern.cool", device_us=10.0)
+        top = kernwatch.bench_summary(top=1)
+        assert top["device"] == "testdev"
+        assert [r["op"] for r in top["top"]] == ["kern.hot"]
+
+
+# ----------------------------------------- health-plane device/host axis
+class TestHealthDeviceAxis:
+    def _track(self):
+        return types.SimpleNamespace(prev_kern={})
+
+    def test_device_axis_from_sampled_deltas(self):
+        from ekuiper_tpu.observability.health import HealthEvaluator
+
+        _pin_spec()
+        set_rule_context("r1")
+        fn = watched_jit(lambda v: v, op="kern.fold")
+        set_rule_context(None)
+        fn.rec.kern.set_cost(flops=None, bytes_=5e5)
+        fn.rec.kern.record_sample(dispatch_us=100.0, total_us=1000.0)
+        fn.rec.kern.record_sample(dispatch_us=100.0, total_us=1000.0)
+        tr = self._track()
+        axis = HealthEvaluator._device_axis("r1", tr)
+        assert axis["axis"] == "device"
+        assert axis["device_share"] > 0.85
+        assert axis["op"] == "kern.fold"
+        assert axis["samples"] == 2
+        assert axis["roofline_util"] is not None
+        assert axis["bound"] == "memory"
+        # no new samples since -> the axis is NOT asserted this tick
+        assert HealthEvaluator._device_axis("r1", tr) is None
+        # fresh samples revive it; against the 100us floor the dispatch
+        # now dominates the new delta (900 host vs 850 post-floor wait)
+        fn.rec.kern.record_sample(dispatch_us=900.0, total_us=950.0)
+        axis = HealthEvaluator._device_axis("r1", tr)
+        assert axis["axis"] == "host"
+
+    def test_axis_absent_without_samples(self):
+        from ekuiper_tpu.observability.health import HealthEvaluator
+
+        assert HealthEvaluator._device_axis("r1", self._track()) is None
+
+    def test_verdict_bottleneck_carries_axis(self, mock_clock):
+        """Full evaluator tick: when the rule's kernels were sampled this
+        tick, the bottleneck verdict gains axis/device_time — 'fold is
+        dominant' becomes 'fold is device-bound at N% of roof'."""
+        from tests.test_health import FakeNode, FakeTopo, _evaluator
+
+        _pin_spec()
+        set_rule_context("r1")
+        fn = watched_jit(lambda v: v, op="kern.fold")
+        set_rule_context(None)
+        fold = FakeNode("fused", "op")
+        topo = FakeTopo([FakeNode("src", "source"), fold])
+        ev = _evaluator(topo)
+        fold.stats.observe_stage("fold", 80_000)
+        fn.rec.kern.record_sample(dispatch_us=0.0, total_us=0.0)
+        fn.rec.kern.record_sample(dispatch_us=50.0, total_us=2000.0)
+        bn = ev.tick()["r1"]["bottleneck"]
+        assert bn["stage"] == "fold"
+        assert bn["axis"] == "device"
+        assert bn["device_time"]["device_us"] > 0
+        # next tick, nothing sampled: the axis disappears, the stage stays
+        fold.stats.observe_stage("fold", 1_000)
+        bn = ev.tick()["r1"]["bottleneck"]
+        assert bn["stage"] == "fold"
+        assert "axis" not in bn

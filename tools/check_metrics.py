@@ -106,11 +106,17 @@ def _synthetic_scrape() -> str:
     # engine-health families: one populated compile watch (with a compile
     # sample so kuiper_xla_compile_seconds renders buckets) and one memory
     # probe — render() reads the module registries directly
-    from ekuiper_tpu.observability import devwatch, memwatch
+    from ekuiper_tpu.observability import devwatch, kernwatch, memwatch
 
     watch = devwatch.registry().register("lint.fold", "lint_rule")
     watch.calls = 5
     watch.on_compile(12_000.0, (), {})
+    # kernel observatory (observability/kernwatch.py): one sampled site
+    # with a synthetic XLA cost so all five kuiper_kernel_* families
+    # (device/dispatch time counters, flops/bytes gauges, roofline
+    # utilization) render samples
+    watch.kern.set_cost(flops=2e6, bytes_=1.12e7)
+    watch.kern.record_sample(dispatch_us=50.0, total_us=850.0)
 
     class MemOwner:
         pass
@@ -131,6 +137,7 @@ def _synthetic_scrape() -> str:
         health.reset()
         nodes_sharedfold._stores.pop("__lint__", None)
         devwatch.registry().clear()
+        kernwatch.reset()
         memwatch.registry().clear()
         del owner
 

@@ -10,6 +10,8 @@ engine remotely, into ONE self-contained JSON document:
   - the flight recorder's event ring (/diagnostics/events)
   - device/host memory accounting (/diagnostics/memory)
   - XLA compile watcher state (/diagnostics/xla)
+  - kernel observatory: sampled device-time split, XLA cost estimates
+    and roofline utilization per jit site (/diagnostics/kernels)
   - the runtime config overlay (/configs)
 
 Usage:
@@ -45,7 +47,7 @@ Post = Callable[[str, dict], Tuple[int, Any]]
 
 #: sections (beyond per-rule detail) a valid bundle must carry
 REQUIRED_SECTIONS = ("server", "rules", "metrics", "events", "memory",
-                     "xla", "health", "configs", "versions")
+                     "xla", "kernels", "health", "configs", "versions")
 
 
 def _versions() -> Dict[str, Any]:
@@ -110,6 +112,7 @@ def collect(fetch: Fetch, events_limit: int = 1000,
     bundle["events"] = get(ev_path)
     bundle["memory"] = get("/diagnostics/memory")
     bundle["xla"] = get("/diagnostics/xla")
+    bundle["kernels"] = get("/diagnostics/kernels")
     bundle["health"] = get("/diagnostics/health")
     bundle["configs"] = get("/configs")
     if profile_ms > 0 and post is not None:
@@ -265,6 +268,13 @@ def smoke() -> int:
         if not (bundle.get("rule_details", {}).get(rid, {})
                 .get("health", {}).get("state")):
             problems.append(f"rule_details[{rid}].health.state")
+        # kernel observatory: the section must name the device and carry
+        # the site list (sampling may legitimately be empty this early)
+        kern = bundle.get("kernels") or {}
+        if not (kern.get("device") or {}).get("kind"):
+            problems.append("kernels.device.kind")
+        if not isinstance(kern.get("sites"), list):
+            problems.append("kernels.sites")
         # incremental tailing: the recorded last_seq must tail cleanly
         last_seq = (bundle.get("events") or {}).get("last_seq")
         if not isinstance(last_seq, int) or last_seq <= 0:
