@@ -212,6 +212,10 @@ class HealthEvaluator:
         self.slow_decay = float(slow_decay)
         self._tracks: Dict[str, _RuleTrack] = {}
         self._lock = threading.RLock()
+        # single-flight guard for REST-triggered seeding ticks; ordered
+        # BEFORE the clock/evaluator locks (held across tick()), never
+        # taken from timer callbacks — see rule_health
+        self._seed_mu = threading.Lock()
         self._timer = None
         self._running = False
         self.ticks = 0
@@ -253,9 +257,14 @@ class HealthEvaluator:
     # ------------------------------------------------------------------- tick
     def tick(self) -> Dict[str, Dict[str, Any]]:
         """Evaluate every rule once. Returns {rule_id: verdict}."""
+        # clock read BEFORE the evaluator lock: a mock-clock advance fires
+        # _fire -> tick() while HOLDING the clock lock, so taking the
+        # clock inside our lock would be the clock/evaluator ABBA square
+        # (utils/lockcheck.py flags it — same class as the PR 6
+        # clock/stats inversion)
+        now = timex.now_ms()
         with self._lock:
             t0 = _time.perf_counter()
-            now = timex.now_ms()
             sweep = True
             try:
                 rules = list(self._rules_fn() or [])
@@ -577,6 +586,10 @@ class HealthEvaluator:
                         else "warn" if tr.state == DEGRADED else "info")
             recorder().record(
                 "rule_health", rule=rid, severity=severity,
+                # ts_ms: we hold self._lock, which mock-clock callbacks
+                # also take (_fire -> tick) — record() must not read the
+                # clock on our behalf (see FlightRecorder.record)
+                ts_ms=now,
                 state=tr.state, previous=prev_state,
                 burn_fast=round(burn_f, 2), burn_slow=round(burn_s, 2),
                 bottleneck=bottleneck.get("stage"),
@@ -751,9 +764,24 @@ class HealthEvaluator:
         a polled endpoint must not be able to trigger them repeatedly."""
         with self._lock:
             tr = self._tracks.get(rule_id)
-            if tr is None and refresh_if_missing:
-                self.tick()
-                tr = self._tracks.get(rule_id)
+        if tr is None and refresh_if_missing:
+            # tick() OUTSIDE our lock: it reads the engine clock first,
+            # and a mock advance fires _fire -> tick while holding the
+            # clock lock — ticking reentrantly under self._lock was the
+            # evaluator half of the clock/health ABBA utils/lockcheck.py
+            # caught on day one (clock orders before the evaluator lock).
+            # _seed_mu keeps the seeding single-flight: concurrent polls
+            # for an untracked rule must produce ONE off-cadence tick,
+            # not one each (off-cadence ticks decay every rule's burn
+            # windows — see the docstring above)
+            with self._seed_mu:
+                with self._lock:
+                    tr = self._tracks.get(rule_id)
+                if tr is None:
+                    self.tick()
+                    with self._lock:
+                        tr = self._tracks.get(rule_id)
+        with self._lock:
             return tr.verdict if tr is not None else None
 
     def peak_burn(self, rule_id: str) -> float:
@@ -900,6 +928,7 @@ def capture_profile(duration_ms: int = 1000,
 
             out_dir = os.path.join(
                 get_config().store.path, "profiles",
+                # kuiperlint: ignore[clock-discipline]: bundle dirs need unique wall timestamps — a frozen mock clock would collide captures
                 f"profile_{int(_time.time() * 1000)}")
         os.makedirs(out_dir, exist_ok=True)
         result: Dict[str, Any] = {"dir": out_dir, "duration_ms": dur_ms}
@@ -909,6 +938,7 @@ def capture_profile(duration_ms: int = 1000,
 
             jax.profiler.start_trace(out_dir)
             try:
+                # kuiperlint: ignore[clock-discipline]: jax.profiler.trace records wall time; timex.sleep under a mock clock would end the capture instantly
                 _time.sleep(dur_ms / 1000.0)
             finally:
                 jax.profiler.stop_trace()
@@ -921,6 +951,7 @@ def capture_profile(duration_ms: int = 1000,
         from . import devwatch, memwatch
 
         dump = {
+            # kuiperlint: ignore[clock-discipline]: postmortem bundles are correlated against external logs by wall time, not engine time
             "generated_at_ms": int(_time.time() * 1000),
             "xla": {
                 "totals": devwatch.registry().totals(),

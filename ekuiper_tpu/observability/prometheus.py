@@ -50,6 +50,7 @@ _QUANTILES = (("p50", "0.5"), ("p90", "0.9"), ("p99", "0.99"))
 #: rule context, runtime/subtopo.py _FanoutTopoShim)
 SHARED_RULE_LABEL = "__shared__"
 
+# kuiperlint: ignore[clock-discipline]: process uptime is wall-clock by definition — mocking it would misreport restarts to operators
 _START_TIME = time.time()
 
 
@@ -224,6 +225,7 @@ def render(rule_registry) -> str:
     health.render_prometheus(out, _esc)
     _family(out, "kuiper_uptime_seconds", "gauge",
             "seconds since engine start")
+    # kuiperlint: ignore[clock-discipline]: wall-clock pair of _START_TIME above
     out.append(f"kuiper_uptime_seconds {time.time() - _START_TIME:.1f}")
     return "\n".join(out) + "\n"
 

@@ -16,10 +16,11 @@ from __future__ import annotations
 
 import itertools
 import threading
-import time
 import weakref
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
+
+from ..utils import timex
 
 _local = threading.local()
 
@@ -169,10 +170,15 @@ class Tracer:
         trace_id = self.current_trace() or self.new_trace()
         span = Span(trace_id, f"s{next(self._ids):08x}", "", rule_id, op,
                     start_ms, duration_us, kind, rows, attrs=attrs)
+        # ENGINE-clock seconds for head sampling: mock-clock tests see
+        # deterministic sampling windows (advance() moves the bucket
+        # boundary). Read BEFORE self._lock — a mock advance fires timer
+        # callbacks holding the clock lock, and those can reach tag()
+        # (which takes self._lock), so reading the clock under our lock
+        # would invert the clock-first order utils/lockcheck.py polices
+        sec = timex.now_ms() // 1000
         with self._lock:
             if self._enabled.get(rule_id) == "head":
-                # head sampling: bound recording rate on hot rules
-                sec = int(time.time())
                 wsec, n = getattr(self, "_head_window", {}).get(
                     rule_id, (sec, 0))
                 if wsec != sec:

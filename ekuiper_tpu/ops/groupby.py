@@ -257,6 +257,7 @@ class DeviceGroupBy:
                     dev_cols[name] = c
                     dev_cols["__valid_" + name] = valid.get(name)
                     continue
+                # kuiperlint: ignore[host-sync]: `c` is a HOST column here (device arrays took the pre-padded branch above) — this is H2D staging, not a sync
                 arr = np.asarray(c[start:end], dtype=np.float32)
                 if pad:
                     arr = np.pad(arr, (0, pad))

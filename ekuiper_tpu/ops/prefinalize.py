@@ -284,6 +284,7 @@ class HostShadow:
         base = np.ones(n, dtype=np.bool_)
         if self.plan.filter_host is not None:
             base &= np.broadcast_to(
+                # kuiperlint: ignore[host-sync]: host-shadow fold — `cols` are host numpy columns by contract, no device value in reach
                 np.asarray(self.plan.filter_host(cols), dtype=np.bool_), (n,)
             )
         self.data["act"] += np.bincount(
@@ -295,6 +296,7 @@ class HostShadow:
                 m = base
             else:
                 v = np.broadcast_to(
+                    # kuiperlint: ignore[host-sync]: host-shadow fold on host columns (see filter_host above)
                     np.asarray(spec.arg_host(cols), dtype=np.float32), (n,)
                 )
                 m = base
@@ -305,6 +307,7 @@ class HostShadow:
                 m = np.logical_and(m, ~np.isnan(v))
             if spec.filter_host is not None:
                 m = np.logical_and(m, np.broadcast_to(
+                    # kuiperlint: ignore[host-sync]: host-shadow fold on host columns (see filter_host above)
                     np.asarray(spec.filter_host(cols), dtype=np.bool_), (n,)
                 ))
             mf = m.astype(np.float32)
@@ -420,7 +423,8 @@ class PendingFinalize:
 
     def __init__(self, stacked: Any, capacity: int, layout) -> None:
         import threading
-        import time
+
+        from ..utils import timex
 
         self.stacked = stacked  # one (capacity, W) device array = one leaf
         self.capacity = capacity
@@ -428,15 +432,16 @@ class PendingFinalize:
         self._result: Optional[Dict[str, np.ndarray]] = None
         self._exc: Optional[BaseException] = None
         self._done = threading.Event()
-        # telemetry for the emit path: when the fetch was issued / landed
-        self.t_created = time.time()
-        self.t_done: Optional[float] = None
+        # telemetry for the emit path: when the fetch was issued / landed,
+        # in ENGINE-clock ms — mock-clock runs see deterministic timings
+        self.t_created = timex.now_ms()
+        self.t_done: Optional[int] = None
         threading.Thread(
             target=self._fetch, name="prefinalize-fetch", daemon=True
         ).start()
 
     def _fetch(self) -> None:
-        import time
+        from ..utils import timex
 
         try:
             self._result = unpack_components(
@@ -444,7 +449,7 @@ class PendingFinalize:
         except BaseException as exc:  # surfaced to the emit thread
             self._exc = exc
         finally:
-            self.t_done = time.time()
+            self.t_done = timex.now_ms()
             self._done.set()
 
     def ready(self) -> bool:
@@ -454,7 +459,7 @@ class PendingFinalize:
         """Issue→landed latency (telemetry); -1 while still in flight."""
         if self.t_done is None:
             return -1.0
-        return (self.t_done - self.t_created) * 1000.0
+        return float(self.t_done - self.t_created)
 
     def get(self) -> Dict[str, np.ndarray]:
         self._done.wait()

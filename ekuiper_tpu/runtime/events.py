@@ -95,16 +95,21 @@ class FlightRecorder:
     SEVERITIES = ("info", "warn", "error")
 
     def record(self, kind: str, rule: str = "", severity: str = "info",
-               **detail: Any) -> None:
+               ts_ms: Optional[int] = None, **detail: Any) -> None:
         """Append one event. `detail` values must be JSON-serializable
         (the ring is served verbatim over REST). `severity` grades the
-        event info/warn/error; unknown grades clamp to info."""
+        event info/warn/error; unknown grades clamp to info. Callers
+        that hold a lock which also gets taken inside engine-clock timer
+        callbacks MUST pass `ts_ms` (their pre-lock clock read): reading
+        the clock here would put their lock before the clock lock, the
+        ABBA class utils/lockcheck.py polices (clock orders first)."""
         from ..utils import timex
 
         if severity not in self.SEVERITIES:
             severity = "info"
         ev = {"kind": kind, "rule": rule, "severity": severity,
-              "ts_ms": timex.now_ms(), **detail}
+              "ts_ms": timex.now_ms() if ts_ms is None else int(ts_ms),
+              **detail}
         with self._lock:
             self._seq += 1
             ev["seq"] = self._seq

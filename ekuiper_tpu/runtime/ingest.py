@@ -129,11 +129,11 @@ class DecodePool:
     def drain(self, timeout: Optional[float] = 30.0) -> bool:
         """Block until every submitted job has emitted. Returns False on
         timeout (a wedged decode must not hang EOF/close forever)."""
-        deadline = None if timeout is None else _time.monotonic() + timeout
+        deadline = None if timeout is None else _time.perf_counter() + timeout
         with self._lock:
             while self._in_flight > 0:
                 remaining = (None if deadline is None
-                             else deadline - _time.monotonic())
+                             else deadline - _time.perf_counter())
                 if remaining is not None and remaining <= 0:
                     return False
                 self._drained.wait(timeout=remaining)

@@ -290,7 +290,7 @@ class Node:
                 tracer.set_current(tid)
             elif self.op_type == "source" or tracer.current_trace() is None:
                 tracer.new_trace()
-            t0 = _time.monotonic()
+            t0 = _time.perf_counter()
         self._tracing_now = traced
         ing = _item_ingest_ms(item)
         if ing is not None:
@@ -322,7 +322,7 @@ class Node:
                 attrs, self._span_attrs = self._span_attrs, None
                 tracer.record(
                     self._topo.rule_id, self.name, timex_now_ms(),
-                    int((_time.monotonic() - t0) * 1e6), kind, rows,
+                    int((_time.perf_counter() - t0) * 1e6), kind, rows,
                     attrs=attrs)
                 self._tracing_now = False
 

@@ -22,7 +22,7 @@ import base64
 import json
 import pickle
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..utils import timex
 from ..utils.codecs import get_compressor, get_encryptor
@@ -213,6 +213,8 @@ class CacheNode(Node):
         self._disk_tail = 0  # next key to write
         self._mu = threading.Lock()
         self._timer = None
+        self._armed = False  # resend timer reserved (see _reserve_arm_locked)
+        self._closed = False
         self._inflight = None  # ("mem"|"disk", item) awaiting sink ack/nack
         # (disk_key, item) for a mem in-flight delivery whose payload a
         # barrier spilled to disk while the sink ack was still outstanding;
@@ -234,8 +236,10 @@ class CacheNode(Node):
         # a restart with spilled backlog must resend WITHOUT waiting for new
         # traffic (a fully-consumed rewindable source may never push again)
         with self._mu:
-            if self._mem or self._disk_head != self._disk_tail:
-                self._arm_locked()
+            arm = ((self._mem or self._disk_head != self._disk_tail)
+                   and self._reserve_arm_locked())
+        if arm:
+            self._register_arm()
 
     # pass-through; SinkNode acks successes / nacks failures back to us
     def process(self, item: Any) -> None:
@@ -250,6 +254,7 @@ class CacheNode(Node):
     def ack(self, item: Any) -> None:
         """Downstream delivery confirmed — only now drop the spilled copy
         (sync_cache deletes a disk record only after a successful send)."""
+        arm = False
         with self._mu:
             fl = self._inflight
             if fl is None or fl[1] is not item and fl[1] != item:
@@ -262,37 +267,46 @@ class CacheNode(Node):
                     if sp[0] == self._disk_head:
                         self._disk_head += 1
                     if bool(self._mem) or self._disk_head != self._disk_tail:
-                        self._arm_locked()
-                return  # ack for a pass-through item — nothing tracked
-            kind = fl[0]
-            self._inflight = None
-            if kind == "disk":
-                self.kv.delete(str(self._disk_head))
-                self._disk_head += 1
-            if bool(self._mem) or self._disk_head != self._disk_tail:
-                self._arm_locked()
+                        arm = self._reserve_arm_locked()
+                # else: ack for a pass-through item — nothing tracked
+            else:
+                kind = fl[0]
+                self._inflight = None
+                if kind == "disk":
+                    self.kv.delete(str(self._disk_head))
+                    self._disk_head += 1
+                if bool(self._mem) or self._disk_head != self._disk_tail:
+                    arm = self._reserve_arm_locked()
+        if arm:
+            self._register_arm()
 
     def nack(self, item: Any) -> None:
         """Called by the downstream SinkNode when collect ultimately fails."""
+        arm = False
+        tracked = False
         with self._mu:
             fl = self._inflight
+            sp = self._spilled_inflight
             if fl is not None and (fl[1] is item or fl[1] == item):
                 self._inflight = None
                 if fl[0] == "mem":
                     self._mem.insert(0, item)
                 # a disk record was never deleted — it will be re-read
-                self._arm_locked()
-                return
-            sp = self._spilled_inflight
-            if sp is not None and (sp[1] is item or sp[1] == item):
+                tracked = True
+                arm = self._reserve_arm_locked()
+            elif sp is not None and (sp[1] is item or sp[1] == item):
                 # failed delivery whose payload a barrier spilled: the disk
                 # record IS the retry copy — re-enqueueing would duplicate
                 self._spilled_inflight = None
-                self._arm_locked()
-                return
-        self._enqueue(item, front=True)
+                tracked = True
+                arm = self._reserve_arm_locked()
+        if arm:
+            self._register_arm()
+        if not tracked:
+            self._enqueue(item, front=True)
 
     def _enqueue(self, item: Any, front: bool = False) -> None:
+        dropped = 0
         with self._mu:
             if front:
                 self._mem.insert(0, item)
@@ -304,42 +318,67 @@ class CacheNode(Node):
                     self.kv.set(str(self._disk_tail), _dumps(item))
                     self._disk_tail += 1
                 else:
-                    self.stats.inc_exception("disk cache full, dropped")
+                    dropped = 1  # stat recorded below, outside _mu
             else:
                 self._mem.append(item)
-            self._arm_locked()
+            arm = self._reserve_arm_locked()
+        if dropped:
+            # outside _mu: inc_exception reads the engine clock, and the
+            # mock clock fires _resend -> _mu while holding the clock
+            # lock (clock orders before _mu — utils/lockcheck.py)
+            self.stats.inc_exception("disk cache full, dropped")
+        if arm:
+            self._register_arm()
 
     def _arm(self) -> None:
         with self._mu:
-            self._arm_locked()
+            arm = self._reserve_arm_locked()
+        if arm:
+            self._register_arm()
 
-    def _arm_locked(self) -> None:
-        if self._timer is not None:
-            return
+    def _reserve_arm_locked(self) -> bool:
+        """Reserve the resend timer. Caller holds self._mu and, when this
+        returns True, MUST call _register_arm() AFTER releasing it: timer
+        registration takes the engine clock lock, and the mock clock
+        fires callbacks (-> _resend -> self._mu) while holding it —
+        arming under self._mu was the clock/cache ABBA
+        utils/lockcheck.py caught on day one (clock orders before _mu)."""
+        if self._armed or self._closed:
+            return False
+        self._armed = True
+        return True
+
+    def _register_arm(self) -> None:
+        # outside self._mu by contract (see _reserve_arm_locked)
         self._timer = timex.get_clock().after(
             self.resend_interval_ms, lambda _now: self._resend())
 
     def _resend(self) -> None:
+        arm = False
+        item = None
         with self._mu:
             self._timer = None
+            self._armed = False
+            if self._closed:
+                return
             if self._inflight is not None or self._spilled_inflight is not None:
                 # previous delivery still unconfirmed — wait for ack/nack
                 # (a spilled in-flight is still a live downstream delivery;
                 # resending its disk record now would duplicate it)
-                self._arm_locked()
-                return
-            item = None
-            if self._mem:
+                arm = self._reserve_arm_locked()
+            elif self._mem:
                 item = self._mem.pop(0)
                 self._inflight = ("mem", item)
             elif self.kv is not None and self._disk_head != self._disk_tail:
                 raw = self.kv.get(str(self._disk_head))
                 if raw is None:  # lost record — skip the slot
                     self._disk_head += 1
-                    self._arm_locked()
-                    return
-                item = _loads(raw)
-                self._inflight = ("disk", item)  # deleted only on ack
+                    arm = self._reserve_arm_locked()
+                else:
+                    item = _loads(raw)
+                    self._inflight = ("disk", item)  # deleted only on ack
+        if arm:
+            self._register_arm()
         if item is not None:
             self.emit(item)
 
@@ -350,23 +389,25 @@ class CacheNode(Node):
                 n += 1
             return n
 
-    def _spill_page_locked(self) -> int:
+    def _spill_page_locked(self) -> Tuple[int, int]:
         """Move the memory page (queue FRONT — oldest pending) plus any
         unconfirmed in-flight delivery INTO the spill KV, prepending BEFORE
         the disk head (keys may go negative) so replay order stays
         oldest-first. Enforces max_disk_cache like _enqueue: the OLDEST
-        items keep their slots, the newest overflow drops with a stat.
-        Caller holds self._mu. Returns items moved."""
+        items keep their slots, the newest overflow drops. Caller holds
+        self._mu and returns (moved, dropped); the caller records the
+        drop stat AFTER releasing _mu (inc_exception reads the engine
+        clock — clock orders before _mu, utils/lockcheck.py)."""
         items = list(self._mem)
         inflight_item = None
         if self._inflight is not None and self._inflight[0] == "mem":
             inflight_item = self._inflight[1]
             items.insert(0, inflight_item)
             self._inflight = None
+        n_drop = 0
         room = self.max_disk_cache - (self._disk_tail - self._disk_head)
         if len(items) > max(room, 0):
             n_drop = len(items) - max(room, 0)
-            self.stats.inc_exception("disk cache full, dropped", n=n_drop)
             items = items[:max(room, 0)]
         for item in reversed(items):
             self._disk_head -= 1
@@ -376,7 +417,7 @@ class CacheNode(Node):
             # remember the key so its still-outstanding ack can delete it
             self._spilled_inflight = (self._disk_head, inflight_item)
         self._mem.clear()
-        return len(items)
+        return len(items), n_drop
 
     def snapshot_state(self) -> Optional[dict]:
         # The spill KV is the ONE durable store for pending payloads: at a
@@ -385,13 +426,20 @@ class CacheNode(Node):
         # carries only bookkeeping — no payload double-persist between the
         # checkpoint and the close-time spill. Memory-only caches (no KV)
         # still encode the page into the checkpoint itself.
+        out = None
+        dropped = 0
         with self._mu:
             if self.kv is not None:
-                n = self._spill_page_locked()
-                return {"spilled": n}
-            items = list(self._mem)
-            if self._inflight is not None and self._inflight[0] == "mem":
-                items.insert(0, self._inflight[1])
+                n, dropped = self._spill_page_locked()
+                out = {"spilled": n}
+            else:
+                items = list(self._mem)
+                if self._inflight is not None and self._inflight[0] == "mem":
+                    items.insert(0, self._inflight[1])
+        if dropped:
+            self.stats.inc_exception("disk cache full, dropped", n=dropped)
+        if out is not None:
+            return out
         return {"mem_enc": [_dumps(i) for i in items]}
 
     def restore_state(self, state: dict) -> None:
@@ -405,6 +453,10 @@ class CacheNode(Node):
 
     def on_close(self) -> None:
         with self._mu:
+            # closed gate: an arm reserved but not yet registered by a
+            # racing thread may still create a timer, but its _resend
+            # no-ops once closed is set — nothing re-emits after close
+            self._closed = True
             timer, self._timer = self._timer, None
         if timer is not None:
             timer.stop()
@@ -413,7 +465,10 @@ class CacheNode(Node):
         # in-flight record was never deleted, so it replays by itself
         if self.kv is not None:
             with self._mu:
-                self._spill_page_locked()
+                _, dropped = self._spill_page_locked()
+            if dropped:
+                self.stats.inc_exception("disk cache full, dropped",
+                                         n=dropped)
 
 
 class RateLimitNode(Node):

@@ -1183,7 +1183,7 @@ class FusedWindowAggNode(Node):
         # thread): the worker must not read the live _cur_ingest_ms,
         # which keeps advancing with post-boundary folds
         self._emit_q.put((kind, stacked_dev, self.kt.n_keys, wr,
-                          _time.time(), self._cur_ingest_ms))
+                          _time.perf_counter(), self._cur_ingest_ms))
 
     def _ensure_emit_worker(self) -> None:
         import queue
@@ -1219,12 +1219,13 @@ class FusedWindowAggNode(Node):
                     self._deliver_pf(pipeline, frozen, backup, n_keys, wr,
                                      t_issue)
                     continue
+                # kuiperlint: ignore[host-sync]: emit worker thread — THE intended sync point; the fold thread already dispatched and moved on
                 arr = np.asarray(stacked_dev)
                 if kind == "mr":
                     self._deliver_mr(arr, n_keys, wr)
                     self.last_emit_info = {
                         "source": "device-async",
-                        "fetch_ms": (_time.time() - t_issue) * 1000.0,
+                        "fetch_ms": (_time.perf_counter() - t_issue) * 1000.0,
                         "ages_ms": [],
                     }
                     continue
@@ -1234,10 +1235,11 @@ class FusedWindowAggNode(Node):
                     outs = [arr[i][:n_keys]
                             for i in range(len(self.plan.specs))]
                     outs = apply_int_semantics(self.plan.specs, outs)
+                    # kuiperlint: ignore[host-sync]: `arr` already landed on host two lines up
                     act = np.asarray(arr[-1][:n_keys])
                 self.last_emit_info = {
                     "source": "device-async",
-                    "fetch_ms": (_time.time() - t_issue) * 1000.0,
+                    "fetch_ms": (_time.perf_counter() - t_issue) * 1000.0,
                     "ages_ms": [],
                 }
                 active = np.nonzero(act > 0)[0]
@@ -1277,10 +1279,10 @@ class FusedWindowAggNode(Node):
             return
         if deadline_s is None:
             deadline_s = self.drain_deadline_s
-        deadline = time.monotonic() + deadline_s
+        deadline = time.perf_counter() + deadline_s
         with q.all_tasks_done:
             while q.unfinished_tasks:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - time.perf_counter()
                 if remaining <= 0:
                     if must_complete:
                         raise RuntimeError(
@@ -1808,7 +1810,7 @@ class FusedWindowAggNode(Node):
             # that fallback
             backup = self.gb._finalize(self.state, (True,) * self.gb.n_panes)
             self._emit_q.put(("pf", (pipeline, frozen, backup), n_keys, wr,
-                              _time.time(), self._cur_ingest_ms))
+                              _time.perf_counter(), self._cur_ingest_ms))
         else:
             # no pre-issue in flight: dispatch the finalize on the
             # immutable state and let the worker fetch + deliver
@@ -1841,10 +1843,12 @@ class FusedWindowAggNode(Node):
                            "recovering from the backup finalize", self.name,
                            exc)
             try:
+                # kuiperlint: ignore[host-sync]: recovery path on the emit worker — fetching the backup finalize IS the point
                 arr = np.asarray(backup)
                 outs = [arr[i][:n_keys]
                         for i in range(len(self.plan.specs))]
                 outs = apply_int_semantics(self.plan.specs, outs)
+                # kuiperlint: ignore[host-sync]: `arr` already landed on host above
                 act = np.asarray(arr[-1][:n_keys])
             except Exception as exc2:
                 logger.error(
@@ -1857,7 +1861,7 @@ class FusedWindowAggNode(Node):
             "source": "device-async-late",
             "fetch_ms": (chosen[0].fetch_ms()
                          if hasattr(chosen[0], "fetch_ms")
-                         else (_time.time() - t_issue) * 1000.0),
+                         else (_time.perf_counter() - t_issue) * 1000.0),
             "ages_ms": [],
         }
         active = np.nonzero(act > 0)[0]
@@ -1876,8 +1880,6 @@ class FusedWindowAggNode(Node):
             self.last_emit_info = None  # no stale record for empty windows
             return
         if pipeline:
-            import time as _time
-
             from ..ops.prefinalize import IdentityFinalize
 
             # newest READY pre-issue wins (prefer real device fetches over
@@ -1894,14 +1896,16 @@ class FusedWindowAggNode(Node):
             self._storm = self._backstop_ok and bool(real) and not any(
                 p.ready() for p, _ in real
             )
-            now = _time.time()
+            # engine-clock ms, matching PendingFinalize.t_created — ages
+            # are deterministic under the mock clock
+            now = timex.now_ms()
             self.last_emit_info = {
                 "source": ("backstop"
                            if isinstance(chosen[0], IdentityFinalize)
                            else "device"),
                 "fetch_ms": (chosen[0].fetch_ms()
                              if hasattr(chosen[0], "fetch_ms") else 0.0),
-                "ages_ms": [(now - p.t_created) * 1000.0
+                "ages_ms": [float(now - p.t_created)
                             for p, _ in real if hasattr(p, "t_created")],
             }
             try:

@@ -37,6 +37,13 @@ class RuleState:
         self.last_error: str = ""
         self.started_at = 0
         self._lock = threading.RLock()
+        # worker-spawn guard, SEPARATE from self._lock: _enqueue runs
+        # inside timex timer callbacks, which the mock clock fires while
+        # holding the clock lock — and self._lock is held elsewhere
+        # while reading the clock (_set_state -> flight recorder), so
+        # taking self._lock here would close the clock/rule ABBA square
+        # utils/lockcheck.py caught on day one (clock orders first)
+        self._worker_mu = threading.Lock()
         self._actions: "queue.Queue[str]" = queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._supervisor: Optional[threading.Thread] = None
@@ -86,7 +93,7 @@ class RuleState:
 
     def _enqueue(self, action: str) -> None:
         self._actions.put(action)
-        with self._lock:
+        with self._worker_mu:
             if self._worker is None or not self._worker.is_alive():
                 self._worker = threading.Thread(
                     target=self._drain_actions, daemon=True,
@@ -175,10 +182,11 @@ class RuleState:
             self._set_state(RunState.STARTING)
         topo = plan_rule(self.rule, self.store)
         topo.open()
+        now = timex.now_ms()  # before the lock — clock orders first
         with self._lock:
             self.topo = topo
             self._set_state(RunState.RUNNING)
-            self.started_at = timex.now_ms()
+            self.started_at = now
             self.last_error = ""
         self._stop_supervision.clear()
         self._supervisor = threading.Thread(

@@ -123,15 +123,16 @@ class Topo:
         Deterministic replacement for sleep()-based settling in tests."""
         import time as _time
 
-        deadline = _time.monotonic() + timeout
+        deadline = _time.perf_counter() + timeout
         # shared-subtopo nodes (the physical source + its decode ring) count
         # too: data sitting there is still in flight toward this rule
         nodes = self.all_nodes() + [
             n for st, _ in self._live_shared for n in st.nodes]
-        while _time.monotonic() < deadline:
+        while _time.perf_counter() < deadline:
             if all(n.inq.unfinished_tasks == 0 and n.extra_pending() == 0
                    for n in nodes):
                 return True
+            # kuiperlint: ignore[clock-discipline]: real-thread poll — worker queues drain in wall time even when the engine clock is mocked
             _time.sleep(0.002)
         return False
 

@@ -1,0 +1,240 @@
+"""Dynamic lock-order checker — the runtime twin of kuiperlint's static
+`lock-order` pass.
+
+When installed (tests do it via conftest; KUIPER_LOCKCHECK=0 opts out),
+`threading.Lock`/`RLock`/`Condition` allocated from ekuiper_tpu code are
+wrapped in a tracking proxy that records, per thread, the ACQUISITION
+ORDER actually exercised: taking lock B while holding lock A adds the
+edge A→B to a process-global graph keyed by each lock's allocation site
+(file:line — every instance of a class shares its lock's site, which is
+exactly the granularity ordering rules are written at). `check()` runs
+cycle detection over the accumulated graph; the per-test teardown in
+tests/conftest.py asserts it stays empty, so the test that closes an
+ABBA cycle is the test that fails.
+
+The static pass sees paths tests never schedule; this checker sees
+orders the AST can't resolve (callbacks, dynamic dispatch). Together
+they cover the PR 6 clock/stats inversion class from both sides.
+
+Design notes:
+ * Only locks created from ekuiper_tpu modules are tracked — stdlib
+   internals (queue, threading.Condition's implicit RLock) keep vanilla
+   locks, so overhead lands on engine locks only (~1µs/acquire).
+ * Condition.wait() releases the underlying lock: the proxy implements
+   `_release_save`/`_acquire_restore`/`_is_owned` so the held-set
+   bookkeeping tracks the real ownership through waits.
+ * Same-site edges are skipped: RLock reentry and sibling instances of
+   one class are not ordering violations.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+_state_lock = _ORIG_LOCK()  # guards _edges; never held while blocking
+_edges: Dict[Tuple[str, str], str] = {}  # (held_site, new_site) -> witness
+_tls = threading.local()
+_installed = False
+
+
+def _held() -> List[list]:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+class _TrackedLock:
+    """Proxy over a real lock carrying its allocation site."""
+
+    __slots__ = ("_inner", "site", "_reentrant")
+
+    def __init__(self, inner, site: str, reentrant: bool) -> None:
+        self._inner = inner
+        self.site = site
+        self._reentrant = reentrant
+
+    # ------------------------------------------------------- acquire path
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._note_acquire()
+        return ok
+
+    def release(self) -> None:
+        self._note_release()
+        self._inner.release()
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    # --------------------------------------- Condition(lock) integration
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        # plain Lock: CPython's own Condition fallback probe
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        depth = self._forget()
+        if hasattr(self._inner, "_release_save"):
+            return (self._inner._release_save(), depth)
+        self._inner.release()
+        return (None, depth)
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, depth = state
+        if inner_state is not None:
+            self._inner._acquire_restore(inner_state)
+        else:
+            self._inner.acquire()
+        self._note_acquire(depth=depth)
+
+    # ------------------------------------------------------- bookkeeping
+    def _note_acquire(self, depth: int = 1) -> None:
+        held = _held()
+        if self._reentrant:
+            for entry in held:
+                if entry[0] is self:
+                    entry[1] += depth
+                    return
+        new_edges = [(e[0].site, self.site) for e in held
+                     if e[0].site != self.site]
+        held.append([self, depth])
+        if new_edges:
+            tname = threading.current_thread().name
+            witness = f"thread {tname}"
+            if os.environ.get("KUIPER_LOCKCHECK_TRACE"):
+                # debugging aid: record WHERE the edge was exercised so a
+                # cycle report points at code, not just allocation sites
+                import traceback
+
+                frames = [f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno}"
+                          for f in traceback.extract_stack()[-8:-2]]
+                witness += " via " + " > ".join(frames)
+            with _state_lock:
+                for edge in new_edges:
+                    _edges.setdefault(edge, witness)
+
+    def _note_release(self) -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                held[i][1] -= 1
+                if held[i][1] <= 0:
+                    del held[i]
+                return
+        # released on a thread that never noted the acquire (e.g. lock
+        # handed across threads): nothing to unwind
+
+    def _forget(self) -> int:
+        """Drop this lock from the held set entirely (Condition.wait);
+        returns the reentry depth to restore afterwards."""
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                depth = held[i][1]
+                del held[i]
+                return depth
+        return 1
+
+
+def _site_of(frame) -> str:
+    fn = frame.f_code.co_filename
+    parts = fn.replace(os.sep, "/").rsplit("/", 2)
+    return f"{'/'.join(parts[-2:])}:{frame.f_lineno}"
+
+
+def _make_factory(orig, reentrant: bool):
+    def factory():
+        import sys
+
+        inner = orig()
+        frame = sys._getframe(1)
+        if "ekuiper_tpu" not in frame.f_code.co_filename:
+            return inner  # stdlib/third-party allocation: stay vanilla
+        return _TrackedLock(inner, _site_of(frame), reentrant)
+
+    return factory
+
+
+def install() -> None:
+    """Patch threading's lock factories; idempotent."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _make_factory(_ORIG_LOCK, reentrant=False)
+    threading.RLock = _make_factory(_ORIG_RLOCK, reentrant=True)
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    threading.Lock = _ORIG_LOCK
+    threading.RLock = _ORIG_RLOCK
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    with _state_lock:
+        _edges.clear()
+
+
+def edges() -> Dict[Tuple[str, str], str]:
+    with _state_lock:
+        return dict(_edges)
+
+
+def check() -> List[str]:
+    """Cycle-check the accumulated acquisition graph. Returns one
+    human-readable description per cycle (empty == ordering is sound)."""
+    with _state_lock:
+        snapshot = dict(_edges)
+    graph: Dict[str, set] = {}
+    for (a, b) in snapshot:
+        graph.setdefault(a, set()).add(b)
+
+    out: List[str] = []
+    visiting: List[str] = []
+    state: Dict[str, int] = {}  # 0 unseen / 1 on stack / 2 done
+    reported = set()
+
+    def dfs(v: str) -> None:
+        state[v] = 1
+        visiting.append(v)
+        for w in sorted(graph.get(v, ())):
+            if state.get(w, 0) == 1:
+                cycle = tuple(visiting[visiting.index(w):] + [w])
+                if cycle not in reported:
+                    reported.add(cycle)
+                    wit = "; ".join(
+                        f"{x}->{y} ({snapshot.get((x, y), '?')})"
+                        for x, y in zip(cycle, cycle[1:]))
+                    out.append("lock-order cycle: " + " -> ".join(cycle)
+                               + f" [{wit}]")
+            elif state.get(w, 0) == 0:
+                dfs(w)
+        visiting.pop()
+        state[v] = 2
+
+    for v in sorted(graph):
+        if state.get(v, 0) == 0:
+            dfs(v)
+    return out

@@ -98,9 +98,15 @@ class StatManager:
         return p
 
     def inc_in(self, n: int = 1) -> None:
+        # clock read OUTSIDE the stats lock: a mock advance() fires timer
+        # callbacks under the CLOCK lock, and those can reach a stats
+        # lock (drop-oldest -> inc_dropped) — holding stats while taking
+        # clock here would complete the ABBA square (utils/lockcheck.py
+        # flags it; the PR 6 health_sample fix covered only one side)
+        now = timex.now_ms()
         with self._lock:
             self.records_in += n
-            self.last_invocation = timex.now_ms()
+            self.last_invocation = now
 
     def inc_out(self, n: int = 1) -> None:
         with self._lock:
@@ -111,10 +117,11 @@ class StatManager:
             self.messages_processed += n
 
     def inc_exception(self, err: str, n: int = 1) -> None:
+        now = timex.now_ms()  # before the lock — see inc_in
         with self._lock:
             self.exceptions += n
             self.last_exception = err
-            self.last_exception_time = timex.now_ms()
+            self.last_exception_time = now
 
     #: drop-burst flight-recorder thresholds: an event fires when a
     #: reason's cumulative count first reaches each decade — the FIRST
@@ -149,12 +156,12 @@ class StatManager:
     def process_end(self) -> None:
         if self._started_at is not None:
             busy_us = int((_time.perf_counter() - self._started_perf) * 1e6)
+            now = timex.now_ms()  # before the lock — see inc_in
             with self._lock:
                 # latency follows the engine clock (mock-deterministic in
                 # tests); the cumulative busy total uses a real perf
                 # counter — sub-ms work must still accrue
-                self.process_latency_us = (
-                    timex.now_ms() - self._started_at) * 1000
+                self.process_latency_us = (now - self._started_at) * 1000
                 self.process_time_us_total += busy_us
             self.proc_hist.record(busy_us)
             self._started_at = None

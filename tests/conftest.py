@@ -24,6 +24,17 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Dynamic lock-order checker (utils/lockcheck.py): must install BEFORE
+# any ekuiper_tpu module allocates its locks, so every engine lock is
+# tracked. KUIPER_LOCKCHECK=0 opts out.
+from ekuiper_tpu.utils import lockcheck  # noqa: E402
+
+if os.environ.get("KUIPER_LOCKCHECK", "1") != "0":
+    lockcheck.install()
+
+#: cycles already reported by a teardown — later teardowns skip them
+_reported_lock_cycles: set = set()
+
 from ekuiper_tpu.utils import timex  # noqa: E402
 from ekuiper_tpu.store import kv  # noqa: E402
 
@@ -56,6 +67,17 @@ def fresh_engine_state():
     kernwatch.reset()
     memwatch.registry().clear()
     timex.use_real_clock()
+    # dynamic lock-order teardown check: the acquisition graph
+    # accumulates across tests (a consistent GLOBAL order is the
+    # invariant); the test that closes an ABBA cycle fails here. Only
+    # NEW cycles fail — the graph is never pruned, so without the memo
+    # one inversion would cascade into every later test's teardown and
+    # bury the culprit
+    if lockcheck.installed():
+        fresh = [c for c in lockcheck.check()
+                 if c not in _reported_lock_cycles]
+        _reported_lock_cycles.update(fresh)
+        assert not fresh, "\n".join(fresh)
 
 
 @pytest.fixture

@@ -764,3 +764,39 @@ class TestReviewHardening:
         node.stats.health_sample = real_sample
         v = ev.tick()["r1"]  # recovery: delta vs ORIGINAL baseline
         assert v["bottleneck"]["stage_us"].get("fold", 0) == 500
+
+
+class TestSeedingSingleFlight:
+    def test_concurrent_polls_tick_once(self):
+        """rule_health's seeding tick runs OUTSIDE the evaluator lock
+        (the clock/evaluator ABBA fix) but must stay single-flight:
+        N concurrent polls for an untracked rule produce ONE
+        off-cadence tick, not one each (review regression — each extra
+        tick decays every rule's burn windows)."""
+        import threading
+        import time
+
+        topo = FakeTopo([FakeNode("fold")])
+        ev = _evaluator(topo)
+        ticks = []
+        orig_tick = ev.tick
+
+        def slow_tick():
+            ticks.append(1)
+            time.sleep(0.05)  # widen the race window
+            return orig_tick()
+
+        ev.tick = slow_tick
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(ev.rule_health("r1")))
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(ticks) == 1, f"{len(ticks)} seeding ticks fired"
+        assert len(results) == 4
+        assert all(r is not None for r in results)

@@ -417,3 +417,39 @@ class TestKeyTableFastPath:
         slots, grew = kt.encode_column(
             np.array(["a", "b", "c"], dtype=np.object_))
         assert grew and kt.capacity == 4
+
+
+class TestEngineClockTelemetry:
+    """ISSUE 8 regression: PendingFinalize timing used raw time.time()
+    (wall clock) — under the mock clock its fetch_ms telemetry drifted
+    with real scheduling while everything else in the engine stood
+    still. It now rides timex, so a frozen mock clock yields exact,
+    deterministic timestamps."""
+
+    def test_pending_finalize_rides_the_mock_clock(self, mock_clock):
+        from ekuiper_tpu.ops.prefinalize import PendingFinalize
+
+        mock_clock.set(5_000_000)
+        _, mkbatch, mknode = _node_bits()
+        node, _ = mknode(True, "device")
+        node.process(mkbatch(40))
+        p = node.gb.prefinalize_begin(node.state)
+        assert isinstance(p, PendingFinalize)
+        # wall-clock epoch would be ~1.7e12 ms; the engine clock says 5e6
+        assert p.t_created == 5_000_000
+        p.get()  # the fetch thread lands in real time...
+        # ...but stamps engine time: frozen clock -> exactly 0 ms, not
+        # "whatever the OS scheduler did" (the old nondeterminism)
+        assert p.t_done == 5_000_000
+        assert p.fetch_ms() == 0.0
+
+    def test_fetch_ms_engine_clock_math(self):
+        from ekuiper_tpu.ops import prefinalize as pf
+
+        # fetch_ms is pure engine-clock arithmetic on the stamps: the
+        # in-flight sentinel stays -1, landed deltas are exact ms
+        q = pf.PendingFinalize.__new__(pf.PendingFinalize)
+        q.t_created, q.t_done = 1000, None
+        assert q.fetch_ms() == -1.0
+        q.t_done = 1250
+        assert q.fetch_ms() == 250.0

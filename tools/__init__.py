@@ -1,0 +1,1 @@
+# Marker so `python -m tools.kuiperlint` resolves from the repo root.

@@ -28,6 +28,18 @@ DOCS = os.path.join(os.path.dirname(os.path.dirname(
 _SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{|\s)")
 
 
+def documented_families(docs_path: str = DOCS) -> set:
+    """Every kuiper_* family named in docs/OBSERVABILITY.md — the
+    catalog this lint (and kuiperlint's static metric-hygiene pass)
+    treats as the registered set. Empty when the catalog is missing."""
+    try:
+        with open(docs_path) as f:
+            text = f.read()
+    except OSError:
+        return set()
+    return set(re.findall(r"kuiper_[a-z0-9_]+", text))
+
+
 def _synthetic_scrape() -> str:
     """Render a scrape covering every metric family."""
     from ekuiper_tpu.observability.histogram import LatencyHistogram

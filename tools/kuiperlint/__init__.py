@@ -1,0 +1,325 @@
+"""kuiperlint — repo-native invariant-enforcing static analysis.
+
+The engine's correctness contract (mockable clock discipline, exhaustive
+jit attribution, lock ordering, no implicit device sync in hot paths,
+donated-buffer hygiene, documented metrics) lives here as mechanical
+AST passes instead of in reviewer memory — the TiLT argument applied to
+tooling: invariants the codebase has already paid for once are checked
+by the compiler layer forever after.
+
+Usage (from the repo root):
+
+    python -m tools.kuiperlint ekuiper_tpu/            # human output
+    python -m tools.kuiperlint --json ekuiper_tpu/     # machine output
+    python -m tools.kuiperlint --rules clock-discipline,lock-order src/
+
+Suppression is per-line via an inline pragma that MUST carry a
+justification (an unjustified pragma is itself a violation):
+
+    t0 = time.monotonic()  # kuiperlint: ignore[clock-discipline]: real-thread deadline, not engine time
+
+A pragma comment on its own line suppresses the next source line.
+Rule catalog and how to add a pass: docs/STATIC_ANALYSIS.md.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+#: pragma grammar — `# kuiperlint: ignore[rule1,rule2]: justification`
+PRAGMA_RE = re.compile(
+    r"#\s*kuiperlint:\s*ignore\[(?P<rules>[a-z0-9_,\-\s]*)\]"
+    r"(?::\s*(?P<why>.*))?\s*$")
+
+PRAGMA_RULE = "pragma-hygiene"  # violations about pragmas themselves
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str  # repo-root-relative posix path
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+
+@dataclass
+class Pragma:
+    line: int          # line the pragma comment sits on
+    rules: Tuple[str, ...]
+    justified: bool
+    own_line: bool     # comment-only line -> also covers the next line
+
+
+class LintFile:
+    """One parsed source file handed to every pass."""
+
+    def __init__(self, abspath: Path, relpath: str, source: str,
+                 tree: ast.AST) -> None:
+        self.abspath = abspath
+        self.path = relpath  # posix, relative to the lint root
+        self.source = source
+        self.tree = tree
+        self.pragmas: Dict[int, List[Pragma]] = {}
+        self._scan_pragmas()
+
+    def _scan_pragmas(self) -> None:
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            comments = [(t.start[0], t.start[1], t.string) for t in toks
+                        if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, SyntaxError, IndentationError):
+            comments = []
+        # a comment is "own-line" when nothing but whitespace precedes it
+        lines = self.source.splitlines()
+        for lineno, col, text in comments:
+            m = PRAGMA_RE.search(text)
+            if m is None:
+                continue
+            rules = tuple(r.strip() for r in m.group("rules").split(",")
+                          if r.strip())
+            why = (m.group("why") or "").strip()
+            own = lines[lineno - 1][:col].strip() == ""
+            self.pragmas.setdefault(lineno, []).append(
+                Pragma(lineno, rules, bool(why), own))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A justified pragma on the same line, or an own-line pragma on
+        the line directly above, suppresses `rule` at `line`."""
+        for p in self.pragmas.get(line, []):
+            if rule in p.rules and p.justified:
+                return True
+        for p in self.pragmas.get(line - 1, []):
+            if p.own_line and rule in p.rules and p.justified:
+                return True
+        return False
+
+
+class Report:
+    """Violation sink shared by all passes during one run."""
+
+    def __init__(self) -> None:
+        self.violations: List[Violation] = []
+        self.files_seen = 0
+
+    def add(self, rule: str, f: "LintFile", node, message: str) -> None:
+        line = getattr(node, "lineno", 0) or 0
+        col = (getattr(node, "col_offset", 0) or 0) + 1
+        self.violations.append(Violation(rule, f.path, line, col, message))
+
+    def add_at(self, rule: str, path: str, line: int, col: int,
+               message: str) -> None:
+        self.violations.append(Violation(rule, path, line, col, message))
+
+
+class Pass:
+    """Base class. Subclasses set `name`/`description`/`scope` and
+    implement visit() (per file) and optionally finalize() (cross-file,
+    after every file was visited — for graph passes)."""
+
+    name: str = ""
+    description: str = ""
+    #: fnmatch globs (lint-root-relative posix paths) the pass applies to
+    scope: Tuple[str, ...] = ("**",)
+    #: globs exempted even when inside scope (per-path allowlist)
+    allow: Tuple[str, ...] = ()
+
+    def applies(self, relpath: str) -> bool:
+        if not any(_match(relpath, g) for g in self.scope):
+            return False
+        return not any(_match(relpath, g) for g in self.allow)
+
+    def begin(self) -> None:
+        """Reset cross-file state (a registry pass instance is reused
+        across runs in-process, e.g. from tests)."""
+
+    def visit(self, f: LintFile, report: Report) -> None:
+        raise NotImplementedError
+
+    def finalize(self, report: Report) -> None:
+        pass
+
+
+def _match(relpath: str, glob: str) -> bool:
+    if fnmatch.fnmatch(relpath, glob):
+        return True
+    # "pkg/sub/**" should also match files directly under deeper dirs the
+    # way shell globstar does; fnmatch treats ** like * (no /), so try a
+    # prefix interpretation too
+    if glob.endswith("/**") and relpath.startswith(glob[:-2]):
+        return True
+    return False
+
+
+_REGISTRY: Dict[str, Pass] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the pass registry."""
+    inst = cls()
+    if not inst.name:
+        raise ValueError(f"{cls.__name__} has no rule name")
+    if inst.name in _REGISTRY:
+        raise ValueError(f"duplicate kuiperlint pass {inst.name!r}")
+    _REGISTRY[inst.name] = inst
+    return cls
+
+
+def all_passes() -> Dict[str, Pass]:
+    from . import passes  # noqa: F401  (imports register every pass)
+
+    return dict(_REGISTRY)
+
+
+# --------------------------------------------------------------- import maps
+class ImportMap:
+    """Best-effort alias resolution: maps local names to dotted origins
+    so `import time as _time; _time.sleep(...)` resolves to `time.sleep`
+    and `from jax import jit; jit(...)` resolves to `jax.jit`."""
+
+    def __init__(self, tree: ast.AST) -> None:
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.names:
+                mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = (
+                        f"{mod}.{a.name}" if mod else a.name)
+
+    def resolve_call(self, func: ast.AST) -> Optional[str]:
+        """Dotted name of a call target with the FIRST segment resolved
+        through the import aliases; None for unresolvable shapes."""
+        parts: List[str] = []
+        node = func
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        head = self.aliases.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:])
+
+
+# ------------------------------------------------------------------ running
+def collect_files(paths: Sequence[str], root: Path) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        pth = Path(p)
+        if not pth.is_absolute():
+            pth = root / pth
+        if pth.is_dir():
+            out.extend(sorted(
+                f for f in pth.rglob("*.py")
+                if "__pycache__" not in f.parts and ".git" not in f.parts))
+        elif pth.suffix == ".py":
+            out.append(pth)
+    return out
+
+
+def run(paths: Sequence[str], root: Optional[Path] = None,
+        rules: Optional[Iterable[str]] = None) -> Tuple[List[Violation], int]:
+    """Lint `paths` (files or directories). Returns (violations, n_files).
+
+    `root` anchors pass scoping (pass scopes are root-relative globs) and
+    defaults to the repo root; tests point it at fixture trees.
+    """
+    root = (root or REPO_ROOT).resolve()
+    registry = all_passes()
+    if rules is not None:
+        want = set(rules)
+        unknown = want - set(registry)
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+        registry = {k: v for k, v in registry.items() if k in want}
+    for p in registry.values():
+        p.begin()
+
+    report = Report()
+    files: List[LintFile] = []
+    for abspath in collect_files(paths, root):
+        try:
+            rel = abspath.resolve().relative_to(root).as_posix()
+        except ValueError:
+            rel = abspath.as_posix()
+        try:
+            source = abspath.read_text()
+            tree = ast.parse(source, filename=str(abspath))
+        except (OSError, SyntaxError) as exc:
+            report.files_seen += 1  # seen, just not analyzable
+            report.add_at(PRAGMA_RULE, rel, getattr(exc, "lineno", 0) or 0, 1,
+                          f"unparseable file: {exc}")
+            continue
+        f = LintFile(abspath, rel, source, tree)
+        files.append(f)
+        report.files_seen += 1
+        # pragma hygiene is checked here so it runs even with --rules
+        for plist in f.pragmas.values():
+            for pr in plist:
+                if not pr.rules:
+                    report.add_at(PRAGMA_RULE, rel, pr.line, 1,
+                                  "pragma names no rule: ignore[<rule>]")
+                for r in pr.rules:
+                    if r not in all_passes() and r != PRAGMA_RULE:
+                        report.add_at(PRAGMA_RULE, rel, pr.line, 1,
+                                      f"pragma names unknown rule {r!r}")
+                if not pr.justified:
+                    report.add_at(
+                        PRAGMA_RULE, rel, pr.line, 1,
+                        "suppression without justification — write "
+                        "`# kuiperlint: ignore[rule]: <why>`")
+        for p in registry.values():
+            if p.applies(rel):
+                p.visit(f, report)
+    for p in registry.values():
+        p.finalize(report)
+
+    by_path = {f.path: f for f in files}
+    kept = [v for v in report.violations
+            if v.rule == PRAGMA_RULE
+            or v.path not in by_path
+            or not by_path[v.path].suppressed(v.rule, v.line)]
+    kept.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return kept, report.files_seen
+
+
+def render_human(violations: List[Violation], n_files: int) -> str:
+    lines = [v.format() for v in violations]
+    lines.append(
+        f"kuiperlint: {len(violations)} violation(s) in {n_files} file(s)"
+        if violations else
+        f"kuiperlint: OK ({n_files} file(s), "
+        f"{len(all_passes())} passes clean)")
+    return "\n".join(lines)
+
+
+def render_json(violations: List[Violation], n_files: int) -> str:
+    return json.dumps({
+        "files": n_files,
+        "passes": sorted(all_passes()),
+        "violations": [v.to_json() for v in violations],
+        "ok": not violations,
+    }, indent=2)

@@ -1,0 +1,53 @@
+"""CLI: `python -m tools.kuiperlint [paths...]` — exit 0 clean, 1 on
+violations, 2 on usage/internal error (mirrors tools/check_metrics.py's
+loud-failure contract so the tier-1 suite can gate on it)."""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import REPO_ROOT, all_passes, render_human, render_json, run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.kuiperlint",
+        description="repo-native invariant lint (docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories (default: ekuiper_tpu/)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of passes to run")
+    ap.add_argument("--root", default=None,
+                    help="scope anchor directory (default: repo root)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the pass catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, p in sorted(all_passes().items()):
+            print(f"{name:18s} {p.description}")
+        return 0
+
+    paths = args.paths or ["ekuiper_tpu"]
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    root = Path(args.root).resolve() if args.root else REPO_ROOT
+    try:
+        violations, n_files = run(paths, root=root, rules=rules)
+    except ValueError as exc:
+        print(f"kuiperlint: {exc}", file=sys.stderr)
+        return 2
+    if n_files == 0:
+        print(f"kuiperlint: no python files under {' '.join(paths)}",
+              file=sys.stderr)
+        return 2
+    print(render_json(violations, n_files) if args.as_json
+          else render_human(violations, n_files))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+"""clock-discipline — engine paths must use the mockable timex clock.
+
+A raw `time.time()` / `time.monotonic()` / `time.sleep()` in runtime/,
+ops/, planner/ or observability/ silently breaks mock-clock determinism:
+tests advance `timex` but the wall clock keeps running, so timing
+telemetry (and anything gated on it) diverges between test and prod
+(the ops/prefinalize.py:432 class this pass was built from).
+`time.perf_counter()` stays legal — it measures durations, never a
+point on the engine's timeline.
+
+Plugin IPC and the standalone tools under ekuiper_tpu/tools talk to
+real external processes and are allowlisted wholesale.
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import ImportMap, LintFile, Pass, Report, register
+
+BANNED = {
+    "time.time": "timex.now_ms() (engine clock)",
+    "time.time_ns": "timex.now_ms() (engine clock)",
+    "time.monotonic": "timex.now_ms(), or time.perf_counter() for durations",
+    "time.monotonic_ns": "timex.now_ms(), or time.perf_counter() for durations",
+    "time.sleep": "timex.sleep() / timex.after() (mock-clock aware)",
+}
+
+
+@register
+class ClockDiscipline(Pass):
+    name = "clock-discipline"
+    description = ("no raw time.time/monotonic/sleep in engine paths — "
+                   "use ekuiper_tpu.utils.timex")
+    scope = (
+        "ekuiper_tpu/runtime/**",
+        "ekuiper_tpu/ops/**",
+        "ekuiper_tpu/planner/**",
+        "ekuiper_tpu/observability/**",
+    )
+    allow = (
+        # plugin IPC handshakes block on real subprocesses
+        "ekuiper_tpu/plugin/**",
+        # standalone operator tools run outside the engine clock
+        "ekuiper_tpu/tools/**",
+    )
+
+    def visit(self, f: LintFile, report: Report) -> None:
+        imports = ImportMap(f.tree)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = imports.resolve_call(node.func)
+            if target in BANNED:
+                report.add(
+                    self.name, f, node,
+                    f"wall-clock call {target}() in an engine path — use "
+                    f"{BANNED[target]}")

@@ -1,0 +1,163 @@
+"""donation-safety — a donated buffer may not be read after the call.
+
+`donate_argnums` hands the argument's device buffer to XLA for reuse;
+on donation-honoring backends the original array is DELETED the moment
+the call dispatches. Reading it afterwards raises (TPU) or silently
+reads stale memory — and on CPU, which ignores donation, the bug stays
+invisible until the first TPU run (PR 7's `_block_marker` class).
+
+The pass tracks names bound to `watched_jit(..., donate_argnums=...)` /
+`jax.jit(..., donate_argnums=...)` (locals and `self._fold`-style
+attributes), and inside each function flags any read of a donated
+argument (a plain name or a `self.X` attribute) AFTER the jitted call,
+unless the name was reassigned first — `state = fold(state, ...)` is
+the blessed shape.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import ImportMap, LintFile, Pass, Report, register
+
+JIT_WRAPPERS = ("watched_jit", "jax.jit",
+                "ekuiper_tpu.observability.devwatch.watched_jit")
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.append(e.value)
+            return tuple(out)
+        return ()  # dynamic spec: positions unknown -> don't guess
+    return None
+
+
+def _expr_key(node: ast.AST) -> Optional[str]:
+    """Stable key for trackable value expressions: bare names ("state")
+    and self attributes ("self.state")."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return f"self.{node.attr}"
+    return None
+
+
+@register
+class DonationSafety(Pass):
+    name = "donation-safety"
+    description = ("an argument donated via donate_argnums may not be "
+                   "read after the jitted call in the same scope")
+    scope = ("ekuiper_tpu/**",)
+
+    def visit(self, f: LintFile, report: Report) -> None:
+        imports = ImportMap(f.tree)
+        # 1) collect donated callables: "self._fold"/"fold" -> positions
+        donated: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                    node.value, ast.Call):
+                continue
+            target_fn = imports.resolve_call(node.value.func)
+            if target_fn not in JIT_WRAPPERS:
+                continue
+            pos = _donated_positions(node.value)
+            if not pos:
+                continue
+            for t in node.targets:
+                key = _expr_key(t)
+                if key:
+                    donated[key] = pos
+        if not donated:
+            return
+        # 2) per function: linear read-after-donation scan
+        for fn in ast.walk(f.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_fn(fn, donated, f, report)
+
+    def _scan_fn(self, fn: ast.AST, donated: Dict[str, Tuple[int, ...]],
+                 f: LintFile, report: Report) -> None:
+        # Events ordered by EXECUTION position, not lexical position:
+        #  * a donation takes effect at the END of the jitted call (arg
+        #    reads inside the call itself are the donation, not a bug)
+        #  * an assignment's store lands at the END of the statement
+        #    (`state = fold(state)` stores after the call dispatches)
+        events: List[Tuple[Tuple[int, int], int, str, str, ast.AST]] = []
+        # kind priority breaks position ties: load < donate < store
+        PRIO = {"load": 0, "donate": 1, "store": 2}
+
+        def add(pos, kind, key, node):
+            events.append((pos, PRIO[kind], kind, key, node))
+
+        def end(node):
+            return (node.end_lineno or node.lineno,
+                    node.end_col_offset or node.col_offset)
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                callee = _expr_key(node.func)
+                if callee in donated:
+                    for i in donated[callee]:
+                        if i < len(node.args):
+                            key = _expr_key(node.args[i])
+                            if key:
+                                add(end(node), "donate", key, node)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for sub in ast.walk(t):
+                        key = _expr_key(sub)
+                        if key and isinstance(getattr(sub, "ctx", None),
+                                              ast.Store):
+                            add(end(node), "store", key, sub)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                for sub in ast.walk(node.target):
+                    key = _expr_key(sub)
+                    if key:
+                        add((node.lineno, node.col_offset), "store", key,
+                            sub)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        for sub in ast.walk(item.optional_vars):
+                            key = _expr_key(sub)
+                            if key:
+                                add((node.lineno, node.col_offset),
+                                    "store", key, sub)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    key = _expr_key(t)
+                    if key:
+                        add(end(node), "store", key, t)
+            key = _expr_key(node)
+            if key is not None and isinstance(
+                    getattr(node, "ctx", None), ast.Load):
+                add((node.lineno, node.col_offset), "load", key, node)
+        events.sort(key=lambda e: (e[0], e[1]))
+        events = [(pos, kind, key, node)
+                  for pos, _prio, kind, key, node in events]
+        dead: Dict[str, Tuple[int, int]] = {}  # key -> donation site
+        for pos, kind, key, node in events:
+            if kind == "donate":
+                dead[key] = pos
+            elif kind == "store":
+                dead.pop(key, None)
+            elif kind == "load" and key in dead and pos > dead[key]:
+                dline, _ = dead[key]
+                report.add(
+                    self.name, f, node,
+                    f"{key} was donated to a jitted call at line {dline} "
+                    "and read again — the device buffer is deleted on "
+                    "donation-honoring backends (rebind the result or "
+                    "snapshot a copy before the call)")
+                dead.pop(key)  # one report per donation

@@ -1,0 +1,82 @@
+"""host-sync — no implicit device→host sync in per-batch hot paths.
+
+`float(x[i])`, `.item()`, `np.asarray(dev)`, `jax.device_get`, and
+`.block_until_ready()` on a device value stall the dispatch pipeline:
+the host blocks until every queued XLA program ahead of it retires, so
+one stray `.item()` in a fold/emit path turns the async device feed
+back into lock-step (the perf footgun the PR 2 upload pipeline and the
+PR 7 kernel split exist to avoid). Boundary paths that are MEANT to
+fetch (emit workers, prefinalize threads) carry a pragma naming the
+intended sync point.
+
+Scope: functions on the per-batch path — names matching fold/emit/
+absorb/combine/deliver/drain/trigger/process in runtime/ and ops/.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .. import ImportMap, LintFile, Pass, Report, register
+
+HOT_FN = re.compile(
+    r"(^|_)(fold|emit|absorb|combine|deliver|drain|trigger|process)")
+
+SYNC_CALLS = {
+    "numpy.asarray": "np.asarray on a device value blocks on the fetch",
+    "numpy.array": "np.array on a device value blocks on the fetch",
+    "jax.device_get": "device_get blocks on the transfer",
+}
+SYNC_METHODS = {
+    "item": ".item() forces a device->host scalar sync",
+    "block_until_ready": "block_until_ready stalls the dispatch pipeline",
+    "copy_to_host": "synchronous host copy",
+}
+
+
+@register
+class HostSync(Pass):
+    name = "host-sync"
+    description = ("no implicit device sync (float()/.item()/np.asarray/"
+                   "block_until_ready) in per-batch fold/emit paths")
+    scope = ("ekuiper_tpu/runtime/**", "ekuiper_tpu/ops/**")
+
+    def visit(self, f: LintFile, report: Report) -> None:
+        imports = ImportMap(f.tree)
+        for fn in ast.walk(f.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not HOT_FN.search(fn.name):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = self._classify(node, imports)
+                if msg:
+                    report.add(self.name, f, node,
+                               f"{msg} inside hot path {fn.name}() — move "
+                               "to a boundary/worker thread or pragma the "
+                               "intended sync point")
+
+    @staticmethod
+    def _classify(node: ast.Call, imports: ImportMap) -> Optional[str]:
+        target = imports.resolve_call(node.func)
+        if target in SYNC_CALLS:
+            return SYNC_CALLS[target]
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in SYNC_METHODS
+                # np.asarray(...).item() style or obj.item() — both count;
+                # module-attr functions (time.sleep) resolved above already
+                and target not in SYNC_CALLS):
+            return SYNC_METHODS[node.func.attr]
+        # float(x[i]) on a subscript: the classic one-scalar implicit
+        # sync; float(name)/float(literal) stay legal (host math), and
+        # int(x[i]) is not flagged — the tree's int() subscripts are
+        # overwhelmingly host-side numpy index math (np.nonzero results)
+        if (isinstance(node.func, ast.Name)
+                and node.func.id == "float" and node.args
+                and isinstance(node.args[0], ast.Subscript)):
+            return ("float() over a subscripted array forces a "
+                    "per-element device sync")
+        return None

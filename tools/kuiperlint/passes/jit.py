@@ -1,0 +1,59 @@
+"""jit-coverage — every jit site must ride devwatch's watched_jit.
+
+kernwatch/devwatch attribution (recompile storms, cache hits, roofline,
+device-time split) is only exhaustive because EVERY `jax.jit` call goes
+through `observability.devwatch.watched_jit`. A bare `jax.jit` site is
+invisible to the flight recorder: its recompiles don't count, its
+kernels never appear in /diagnostics/kernels, and a compile storm there
+bisects to nothing. devwatch.py itself is the one place allowed to call
+`jax.jit` (it IS the wrapper).
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import ImportMap, LintFile, Pass, Report, register
+
+BANNED = ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit")
+
+
+@register
+class JitCoverage(Pass):
+    name = "jit-coverage"
+    description = ("bare jax.jit outside devwatch.py — wrap with "
+                   "observability.devwatch.watched_jit")
+    scope = ("ekuiper_tpu/**",)
+    allow = ("ekuiper_tpu/observability/devwatch.py",)
+
+    def visit(self, f: LintFile, report: Report) -> None:
+        imports = ImportMap(f.tree)
+        for node in ast.walk(f.tree):
+            # bare `@jax.jit` decorator: an Attribute/Name in the
+            # decorator list, not a Call — the most common jit shape
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if (not isinstance(dec, ast.Call)
+                            and imports.resolve_call(dec) in BANNED):
+                        report.add(
+                            self.name, f, dec,
+                            f"bare @{imports.resolve_call(dec)} decorator "
+                            "escapes devwatch — use watched_jit(fn, "
+                            "op=...) so XLA recompile/kernel attribution "
+                            "stays exhaustive")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            target = imports.resolve_call(node.func)
+            flagged = target in BANNED
+            if not flagged and target in ("functools.partial", "partial"):
+                # functools.partial(jax.jit, ...) is still a bare jit site
+                flagged = any(
+                    imports.resolve_call(a) in BANNED
+                    for a in node.args if isinstance(a, (ast.Attribute,
+                                                         ast.Name)))
+            if flagged:
+                report.add(
+                    self.name, f, node,
+                    f"bare {target or 'jax.jit'}() escapes devwatch — use "
+                    "watched_jit(fn, op=..., **jit_kwargs) so XLA "
+                    "recompile/kernel attribution stays exhaustive")

@@ -1,0 +1,399 @@
+"""lock-order — static lock-acquisition graph, fail on cycles.
+
+The ABBA class (PR 6: a mock-clock advance held the timex clock lock
+while ticking the health evaluator, which took the StatManager lock —
+while scrape threads took them in the opposite order) is mechanically
+visible before it deadlocks: build the acquisition-order graph and fail
+on any cycle.
+
+Graph construction (conservative — unresolvable expressions are
+skipped, never guessed):
+
+* A lock NODE is a `threading.Lock/RLock/Condition/Semaphore` assigned
+  to `self.X` (node id `module.Class.X`) or a module-level name
+  (`module.X`). `Condition(existing_lock)` aliases to the lock it wraps
+  — taking the condition IS taking the lock.
+* An EDGE A -> B is added when `with B` appears lexically inside
+  `with A`, or when a call made while holding A resolves (same-class
+  method, same-module function, or imported module function) to a
+  function whose transitive acquire set contains B.
+* A cycle in the resulting graph means two code paths can take the same
+  locks in opposite orders; the report names the cycle and one witness
+  site per edge.
+
+The dynamic twin (ekuiper_tpu/utils/lockcheck.py) checks the orders
+actually exercised at runtime under tests; this pass covers paths tests
+never schedule.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import LintFile, Pass, Report, register
+
+LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+
+
+def _module_id(relpath: str) -> str:
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+class _Imports:
+    """Import resolution with package-relative handling (`from ..utils
+    import timex` inside ekuiper_tpu/runtime/x.py -> ekuiper_tpu.utils
+    .timex), which the generic ImportMap skips."""
+
+    def __init__(self, tree: ast.AST, module_id: str) -> None:
+        self.aliases: Dict[str, str] = {}
+        pkg = module_id.split(".")[:-1]
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg[: len(pkg) - (node.level - 1)]
+                    mod = ".".join(base + ([node.module] if node.module
+                                           else []))
+                else:
+                    mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = (
+                        f"{mod}.{a.name}" if mod else a.name)
+
+    def resolve(self, func: ast.AST) -> Optional[str]:
+        parts: List[str] = []
+        node = func
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        head = self.aliases.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:])
+
+
+class _FnInfo:
+    __slots__ = ("acquires", "calls_under", "calls")
+
+    def __init__(self) -> None:
+        self.acquires: Set[str] = set()
+        # (held_lock_id, callee_key, path, line)
+        self.calls_under: List[Tuple[str, str, str, int]] = []
+        self.calls: Set[str] = set()  # every resolvable callee
+
+
+@register
+class LockOrder(Pass):
+    name = "lock-order"
+    description = ("static `with <lock>` acquisition graph across "
+                   "modules must be acyclic (ABBA deadlock class)")
+    scope = ("ekuiper_tpu/**",)
+
+    def begin(self) -> None:
+        self.locks: Set[str] = set()
+        self.cond_alias: Dict[str, str] = {}
+        self.fns: Dict[str, _FnInfo] = {}
+        # (held, acquired) -> first witness (path, line)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        # (path, line) sites carrying a justified lock-order pragma: a
+        # cycle is suppressed when ANY of its witness edges is blessed
+        # (the report anchors at one arbitrary edge; the user pragmas
+        # the edge they can argue about)
+        self.pragma_sites: Set[Tuple[str, int]] = set()
+
+    # ------------------------------------------------------------ per file
+    def visit(self, f: LintFile, report: Report) -> None:
+        mod = _module_id(f.path)
+        imports = _Imports(f.tree, mod)
+        for plist in f.pragmas.values():
+            for pr in plist:
+                if self.name in pr.rules and pr.justified:
+                    self.pragma_sites.add((f.path, pr.line))
+                    if pr.own_line:
+                        self.pragma_sites.add((f.path, pr.line + 1))
+        self._collect_locks(f.tree, mod, imports)
+        for scope_name, fn_node, class_name in _functions(f.tree, mod):
+            info = self.fns.setdefault(scope_name, _FnInfo())
+            self._walk_fn(fn_node.body, [], info, f, mod, class_name,
+                          imports)
+
+    def _collect_locks(self, tree: ast.AST, mod: str,
+                       imports: _Imports) -> None:
+        for cls_name, target, value in _assignments(tree):
+            if not isinstance(value, ast.Call):
+                continue
+            factory = imports.resolve(value.func)
+            if factory not in LOCK_FACTORIES:
+                continue
+            lock_id = self._target_id(target, mod, cls_name)
+            if lock_id is None:
+                continue
+            self.locks.add(lock_id)
+            # Condition(existing_lock): alias to the wrapped lock's node
+            if (factory == "threading.Condition" and value.args
+                    and isinstance(value.args[0], (ast.Attribute, ast.Name))):
+                wrapped = self._expr_lock_id(value.args[0], mod, cls_name)
+                if wrapped is not None:
+                    self.cond_alias[lock_id] = wrapped
+
+    @staticmethod
+    def _target_id(target: ast.AST, mod: str,
+                   cls_name: Optional[str]) -> Optional[str]:
+        if (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self" and cls_name):
+            return f"{mod}.{cls_name}.{target.attr}"
+        if isinstance(target, ast.Name):
+            scope = f"{mod}.{cls_name}" if cls_name else mod
+            return f"{scope}.{target.id}"
+        return None
+
+    def _expr_lock_id(self, expr: ast.AST, mod: str,
+                      cls_name: Optional[str]) -> Optional[str]:
+        """Resolve a `with <expr>` / Condition(<expr>) operand to a known
+        lock node id, chasing condition aliases."""
+        cand: Optional[str] = None
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)):
+            if expr.value.id == "self" and cls_name:
+                cand = f"{mod}.{cls_name}.{expr.attr}"
+            else:
+                # module_alias._lock style: only same-module globals resolve
+                cand = None
+        elif isinstance(expr, ast.Name):
+            for scope in ((f"{mod}.{cls_name}", mod) if cls_name
+                          else (mod,)):
+                if f"{scope}.{expr.id}" in self.locks:
+                    cand = f"{scope}.{expr.id}"
+                    break
+        if cand is None or cand not in self.locks:
+            return None
+        seen = set()
+        while cand in self.cond_alias and cand not in seen:
+            seen.add(cand)
+            cand = self.cond_alias[cand]
+        return cand
+
+    # --------------------------------------------------------- fn walking
+    def _walk_fn(self, body, held: List[str], info: _FnInfo, f: LintFile,
+                 mod: str, cls_name: Optional[str],
+                 imports: _Imports) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, held, info, f, mod, cls_name, imports)
+
+    def _walk_stmt(self, node: ast.AST, held: List[str], info: _FnInfo,
+                   f: LintFile, mod: str, cls_name: Optional[str],
+                   imports: _Imports) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs get their own scope via _functions()
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in node.items:
+                lock_id = self._expr_lock_id(item.context_expr, mod, cls_name)
+                # calls inside the context expr run before acquisition
+                self._scan_calls(item.context_expr, held, info, f, mod,
+                                 cls_name, imports)
+                if lock_id is None:
+                    continue
+                info.acquires.add(lock_id)
+                for h in held + acquired:
+                    if h != lock_id:
+                        self.edges.setdefault(
+                            (h, lock_id), (f.path, item.context_expr.lineno))
+                acquired.append(lock_id)
+            self._walk_fn(node.body, held + acquired, info, f, mod,
+                          cls_name, imports)
+            return
+        # non-with statement: record calls (with held context), then
+        # recurse into compound-statement bodies — including non-stmt
+        # containers that carry statement lists (ast.ExceptHandler,
+        # ast.match_case): exception paths are exactly where ABBA
+        # cleanup acquisitions hide
+        for fld in ast.iter_fields(node):
+            value = fld[1]
+            items = value if isinstance(value, list) else [value]
+            for it in items:
+                if isinstance(it, ast.stmt):
+                    self._walk_stmt(it, held, info, f, mod, cls_name,
+                                    imports)
+                elif isinstance(it, ast.expr):
+                    self._scan_calls(it, held, info, f, mod, cls_name,
+                                     imports)
+                elif isinstance(it, ast.AST):
+                    self._walk_stmt(it, held, info, f, mod, cls_name,
+                                    imports)
+
+    def _scan_calls(self, expr: ast.AST, held: List[str], info: _FnInfo,
+                    f: LintFile, mod: str, cls_name: Optional[str],
+                    imports: _Imports) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            key = self._callee_key(node.func, mod, cls_name, imports)
+            if key is None:
+                continue
+            info.calls.add(key)
+            for h in held:
+                info.calls_under.append((h, key, f.path, node.lineno))
+
+    @staticmethod
+    def _callee_key(func: ast.AST, mod: str, cls_name: Optional[str],
+                    imports: _Imports) -> Optional[str]:
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)):
+            if func.value.id == "self" and cls_name:
+                return f"{mod}.{cls_name}.{func.attr}"
+            resolved = imports.resolve(func)
+            return resolved
+        if isinstance(func, ast.Name):
+            resolved = imports.resolve(func)
+            if resolved == func.id:
+                return f"{mod}.{func.id}"  # same-module function
+            return resolved
+        return None
+
+    # ------------------------------------------------------------- finalize
+    def finalize(self, report: Report) -> None:
+        # transitive acquire closure over the (partial) call graph
+        eff: Dict[str, Set[str]] = {k: set(v.acquires)
+                                    for k, v in self.fns.items()}
+        changed = True
+        while changed:
+            changed = False
+            for name, info in self.fns.items():
+                for callee in info.calls:
+                    extra = eff.get(callee)
+                    if extra and not extra <= eff[name]:
+                        eff[name] |= extra
+                        changed = True
+        # call-mediated edges: held A while calling f => A -> eff(f)
+        for info in self.fns.values():
+            for held, callee, path, line in info.calls_under:
+                for acquired in eff.get(callee, ()):
+                    if acquired != held:
+                        self.edges.setdefault((held, acquired), (path, line))
+
+        cycles = _find_cycles({a: {b for (x, b) in self.edges if x == a}
+                               for (a, _b) in self.edges})
+        for cycle in cycles:
+            if any(self.edges.get((a, b)) in self.pragma_sites
+                   for a, b in zip(cycle, cycle[1:])):
+                continue  # an edge of this cycle is pragma-blessed
+            first_edge = (cycle[0], cycle[1])
+            path, line = self.edges.get(first_edge, ("<graph>", 0))
+            chain = " -> ".join(cycle)
+            witnesses = "; ".join(
+                f"{a}->{b} at {self.edges[(a, b)][0]}:{self.edges[(a, b)][1]}"
+                for a, b in zip(cycle, cycle[1:])
+                if (a, b) in self.edges)
+            report.add_at(
+                self.name, path, line, 1,
+                f"lock-order cycle: {chain} (two paths can take these "
+                f"locks in opposite orders; witnesses: {witnesses})")
+
+
+def _assignments(tree: ast.AST):
+    """Yield (enclosing_class_name_or_None, target, value) for every
+    simple assignment, walking into classes and functions."""
+    def walk(node, cls_name):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from walk(child, cls_name)
+            elif isinstance(child, ast.Assign):
+                for t in child.targets:
+                    yield (cls_name, t, child.value)
+            elif isinstance(child, ast.AnnAssign) and child.value is not None:
+                yield (cls_name, child.target, child.value)
+            else:
+                yield from walk(child, cls_name)
+    yield from walk(tree, None)
+
+
+def _functions(tree: ast.AST, mod: str):
+    """Yield (qualname, fn_node, enclosing_class_or_None)."""
+    def walk(node, prefix, cls_name):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}.{child.name}", child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield (f"{prefix}.{child.name}", child, cls_name)
+                yield from walk(child, f"{prefix}.{child.name}", cls_name)
+            else:
+                yield from walk(child, prefix, cls_name)
+    yield from walk(tree, mod, None)
+
+
+def _find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Minimal cycle witnesses, one per strongly-connected component
+    (Tarjan; SCCs of size 1 without a self-edge are acyclic)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in graph.get(v, ()):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(comp)
+
+    nodes = set(graph) | {w for vs in graph.values() for w in vs}
+    for v in sorted(nodes):
+        if v not in index:
+            strongconnect(v)
+
+    cycles: List[List[str]] = []
+    for comp in sccs:
+        comp_set = set(comp)
+        # walk inside the SCC until a node repeats -> concrete cycle
+        start = sorted(comp)[0]
+        path = [start]
+        seen = {start}
+        cur = start
+        while True:
+            nxt = sorted(w for w in graph.get(cur, ())
+                         if w in comp_set)
+            if not nxt:
+                break
+            cur = nxt[0]
+            if cur in seen:
+                path.append(cur)
+                cycles.append(path[path.index(cur):])
+                break
+            seen.add(cur)
+            path.append(cur)
+    return cycles

@@ -1,0 +1,87 @@
+"""metric-hygiene — every kuiper_* literal must map to a documented family.
+
+The static half of tools/check_metrics.py (which renders a synthetic
+scrape and diffs it against docs/OBSERVABILITY.md at runtime): here the
+SOURCE is swept instead, so a metric family added to an exporter but
+not to the catalog fails even if no code path in the synthetic scrape
+renders it yet. Dynamic family names built as f-strings
+(`f"kuiper_op_{name}"`) are checked by prefix — some documented family
+must extend the literal fragment.
+
+Scope: ekuiper_tpu/observability/ — the only layer allowed to mint
+metric families.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Set
+
+from .. import LintFile, Pass, Report, register
+
+FRAGMENT_RE = re.compile(r"kuiper_[a-z0-9_]*")
+SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _documented() -> Set[str]:
+    # single source of truth shared with the runtime exposition lint
+    import sys
+
+    from .. import REPO_ROOT
+
+    repo = str(REPO_ROOT)
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.check_metrics import documented_families
+
+    return documented_families()
+
+
+@register
+class MetricHygiene(Pass):
+    name = "metric-hygiene"
+    description = ("every kuiper_* metric literal in the observability "
+                   "layer must match a family documented in "
+                   "docs/OBSERVABILITY.md")
+    scope = ("ekuiper_tpu/observability/**",)
+
+    def begin(self) -> None:
+        self._docs: Set[str] = set()
+        self._loaded = False
+
+    def _families(self) -> Set[str]:
+        if not self._loaded:
+            self._docs = _documented()
+            self._loaded = True
+        return self._docs
+
+    def visit(self, f: LintFile, report: Report) -> None:
+        docs = self._families()
+        if not docs:
+            return  # no catalog (fixture trees): nothing to diff against
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            for frag in FRAGMENT_RE.findall(node.value):
+                if not self._fragment_ok(frag, node.value, docs):
+                    report.add(
+                        self.name, f, node,
+                        f"metric literal {frag!r} has no documented "
+                        "family in docs/OBSERVABILITY.md — document it "
+                        "(and cover it in tools/check_metrics.py's "
+                        "synthetic scrape) before shipping")
+
+    @staticmethod
+    def _fragment_ok(frag: str, whole: str, docs: Set[str]) -> bool:
+        if frag in docs:
+            return True
+        # histogram series names roll up to their family
+        for suf in SERIES_SUFFIXES:
+            if frag.endswith(suf) and frag[: -len(suf)] in docs:
+                return True
+        # dynamic prefix (f"kuiper_op_{name}" -> fragment "kuiper_op_"):
+        # legal when at least one documented family extends it
+        if frag.endswith("_") and any(d.startswith(frag) for d in docs):
+            return True
+        return False
