@@ -201,6 +201,9 @@ class _RedisBus(_Bus):
         pattern = "*" in chan
 
         def loop() -> None:
+            from ..utils.backoff import Backoff
+
+            bo = Backoff(base_s=0.5, cap_s=30.0)
             while not self._stop.is_set():
                 cli = None
                 try:
@@ -210,6 +213,7 @@ class _RedisBus(_Bus):
                     with self._lock:
                         self._sub_clients.append(cli)
                     cli.send("PSUBSCRIBE" if pattern else "SUBSCRIBE", chan)
+                    bo.reset()
                     while not self._stop.is_set():
                         reply = cli.read_reply()
                         if not isinstance(reply, list) or len(reply) < 3:
@@ -238,7 +242,8 @@ class _RedisBus(_Bus):
                     if self._stop.is_set():
                         return
                     logger.warning("edgex redis bus reconnect: %s", exc)
-                    self._stop.wait(1.0)
+                    if bo.wait(self._stop):
+                        return
 
         th = threading.Thread(target=loop, daemon=True, name="edgex-redis-sub")
         th.start()

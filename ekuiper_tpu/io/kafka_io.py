@@ -94,7 +94,12 @@ class KafkaSource(Source, Rewindable):
         log = logger.error if n >= 3 else logger.warning
         log("kafka fetch %s/%d at offset %d (attempt %d): %s",
             self.topic, p, off, n, e)
-        retry_at[p] = time.monotonic() + min(2.0 ** (n - 1), 30.0)
+        # jittered exponential deadline (utils/backoff.py): N consumers
+        # of a recovering partition must not re-fetch on the same beat
+        from ..utils.backoff import backoff_delay_s
+
+        retry_at[p] = time.monotonic() + backoff_delay_s(
+            n, base_s=1.0, cap_s=30.0)
 
     def _init_offsets(self, client: KafkaClient) -> None:
         parts = ([self.partition] if self.partition is not None

@@ -227,6 +227,11 @@ class Client:
                 self._reconnect()
 
     def _reconnect(self) -> None:
+        # jittered exponential backoff (utils/backoff.py): a broker
+        # restart must not make every client redial on the same beat
+        from ..utils.backoff import Backoff
+
+        bo = Backoff(base_s=0.5, cap_s=30.0)
         while not self._stop.is_set():
             try:
                 self._dial()
@@ -234,7 +239,8 @@ class Client:
                     self.subscribe(topic, qos)
                 return
             except Exception:
-                self._stop.wait(1.0)
+                if bo.wait(self._stop):
+                    return
 
     def _handle(self, typ: int, body: bytes) -> None:
         kind = typ & 0xF0

@@ -155,6 +155,9 @@ class RedisSubSource(Source):
                          name="redis-sub").start()
 
     def _loop(self, ingest) -> None:
+        from ..utils.backoff import Backoff
+
+        bo = Backoff(base_s=0.5, cap_s=30.0)
         while not self._stop.is_set():
             try:
                 cli = _client_from_props(self.props)
@@ -164,6 +167,7 @@ class RedisSubSource(Source):
                 cli._sock.settimeout(None)
                 self._cli = cli
                 cli.send("SUBSCRIBE", *self.channels)
+                bo.reset()
                 while not self._stop.is_set():
                     reply = cli.read_reply()
                     if isinstance(reply, list) and len(reply) >= 3 and \
@@ -173,7 +177,8 @@ class RedisSubSource(Source):
                 if self._stop.is_set():
                     return
                 logger.warning("redisSub reconnect: %s", exc)
-                self._stop.wait(1.0)
+                if bo.wait(self._stop):
+                    return
 
     def close(self) -> None:
         self._stop.set()

@@ -182,12 +182,16 @@ class WebsocketSource(Source):
     def _client_loop(self) -> None:
         from websockets.sync.client import connect
 
+        from ..utils.backoff import Backoff
+
+        bo = Backoff(base_s=0.5, cap_s=30.0)
         while not self._stop.is_set():
             try:
                 with connect(self.addr, open_timeout=5) as ws:
                     if self._stop.is_set():
                         return  # stopped while dialing
                     self._client = ws
+                    bo.reset()
                     while not self._stop.is_set():
                         # bounded recv so a silent peer can't pin the thread
                         # past close()
@@ -200,7 +204,8 @@ class WebsocketSource(Source):
                 if self._stop.is_set():
                     return
                 logger.warning("ws source reconnect (%s): %s", self.addr, exc)
-                self._stop.wait(1.0)
+                if bo.wait(self._stop):
+                    return
 
     def close(self) -> None:
         self._stop.set()

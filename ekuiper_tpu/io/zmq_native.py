@@ -343,7 +343,11 @@ class SubClient:
         self._thread.start()
 
     def _run(self) -> None:
-        backoff = 0.1
+        # jittered exponential redial (utils/backoff.py) — a fleet of
+        # SUBs must not stampede a restarting publisher in lockstep
+        from ..utils.backoff import Backoff
+
+        backoff = Backoff(base_s=0.1, cap_s=5.0)
         while not self._stop.is_set():
             try:
                 # pre-bind the source port: an unbound connect() retried
@@ -362,7 +366,7 @@ class SubClient:
                 peer.handshake()
                 peer.send_frame(b"\x01" + self.topic)  # subscribe
                 self._peer = peer
-                backoff = 0.1
+                backoff.reset()
                 # idle probe: every few quiet seconds re-send the
                 # (idempotent) subscription — a torn-down peer turns the
                 # send into an error and triggers the reconnect path, and a
@@ -382,8 +386,8 @@ class SubClient:
                 if self._peer is not None:
                     self._peer.close()
                     self._peer = None
-                self._stop.wait(backoff)
-                backoff = min(backoff * 2, 5.0)
+                if backoff.wait(self._stop):
+                    return
 
     def close(self) -> None:
         self._stop.set()

@@ -103,11 +103,12 @@ def render(rule_registry) -> str:
                        f"{snap[mname]}")
     # drop taxonomy (utils/metrics.py inc_dropped): data discarded BY
     # DESIGN, labeled by reason — buffer_full (drop-oldest backpressure),
-    # pane_recycle, decode_error, stale_watermark. Distinct from
-    # exceptions_total, which counts operator ERRORS only.
+    # pane_recycle, decode_error, stale_watermark, shed_qos (SLO-driven
+    # shedding, runtime/control.py). Distinct from exceptions_total,
+    # which counts operator ERRORS only.
     _family(out, "kuiper_node_dropped_total", "counter",
-            "items discarded by design, labeled by reason "
-            "(buffer_full/pane_recycle/decode_error/stale_watermark)")
+            "items discarded by design, labeled by reason (buffer_full/"
+            "pane_recycle/decode_error/stale_watermark/shed_qos)")
     for rule_id, node, snap in snaps:
         for reason, n in sorted(snap["dropped_total"].items()):
             out.append(
@@ -223,6 +224,12 @@ def render(rule_registry) -> str:
     # rate, watermark lag, bottleneck stage — computed at evaluator ticks,
     # rendered from the last verdicts (a scrape never forces a tick)
     health.render_prometheus(out, _esc)
+    # QoS control plane (runtime/control.py): admission decisions, rows
+    # shed per rule/qos class, autosize action count — rendered from the
+    # installed controller's counters (absent when none is installed)
+    from ..runtime import control as _control
+
+    _control.render_prometheus(out, _esc)
     _family(out, "kuiper_uptime_seconds", "gauge",
             "seconds since engine start")
     # kuiperlint: ignore[clock-discipline]: wall-clock pair of _START_TIME above

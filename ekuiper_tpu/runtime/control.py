@@ -1,0 +1,902 @@
+"""Adaptive QoS control plane — the first ACTUATOR on the engine's
+telemetry.
+
+The observability stack built across the last PRs measures everything:
+per-rule SLO burn, bottleneck stage, watermark lag, HBM trend, XLA
+compile storms, drop taxonomy. The health plane
+(observability/health.py) turns those into per-rule VERDICTS — and until
+now nothing acted on them: a rule-churn storm or a hot-key skew shift
+degraded every rule equally through global drop-oldest backpressure.
+This module closes the loop (ROADMAP item 5) with three bounded,
+logged actuators:
+
+- **Admission control** — `admit_rule()` prices a candidate rule BEFORE
+  it starts: the sharing cost model's fold/emit coefficients
+  (planner/sharing.py, the Factor-Windows currency) give its steady-state
+  device cost, memwatch + the health plane's HBM trend bound its memory
+  claim, and devwatch's compile-storm counters flag a bad moment to add
+  compile load. The decision is structured — accept | reject(reason,
+  price) | queue(reason, price) — never a bare exception: a rejected
+  rule's caller gets the price that condemned it, a queued rule is
+  retried every control tick and started when pressure clears.
+
+- **SLO-driven load shedding** — when the health FSM holds a rule at
+  `breaching`, the controller sheds THAT RULE's input at its topo entry
+  nodes (runtime/topo.py entry_nodes — downstream of shared work,
+  upstream of the rule's private pipeline) through the existing drop
+  taxonomy (`StatManager.inc_dropped(reason="shed_qos")`). The shed
+  fraction climbs a per-qos-class ladder with hysteresis mirroring the
+  health FSM (`up_ticks` breaching ticks per escalation, `down_ticks`
+  healthy ticks per step down); `qosClass: critical` rules are never
+  shed. Every transition is a flight-recorder event.
+
+- **Auto-sizing** — when the attributed bottleneck is `decode` or
+  `upload` on a rule that is not healthy, the controller resizes the
+  source's decode pool (more parse workers) or ingest ring (deeper
+  decode→fold overlap), bounded by `KUIPER_AUTOSIZE_MAX_POOL/RING`,
+  cooled down between actions, stepped back toward the configured size
+  after sustained health, and logged + flight-recorded per action.
+  Inline sources (decode_pool_size=0) are never converted — that path
+  is bit-for-bit deterministic by contract.
+
+Configuration (all read at decision time, so tests/bench set per-case):
+
+  KUIPER_CONTROL_INTERVAL_MS            controller cadence (default 5s)
+  KUIPER_ADMISSION=0                    disable admission (accept all)
+  KUIPER_HBM_BUDGET_MB                  reject when current+projected HBM
+                                        exceeds it (0 = off)
+  KUIPER_ADMISSION_FOLD_BUDGET_US_PER_S reject when the committed fold
+                                        ledger + price exceeds it (0=off)
+  KUIPER_ADMISSION_DEFER_BREACHING      queue new rules while >= N rules
+                                        are breaching (0 = off)
+  KUIPER_ADMISSION_DEFER_STORMS=0       stop queueing on compile storms
+  KUIPER_AUTOSIZE_MAX_POOL / _MAX_RING  autosize upper bounds (default 6)
+
+Prometheus families (docs/OBSERVABILITY.md + docs/RESILIENCE.md):
+kuiper_admission_total{decision}, kuiper_shed_total{rule,qos},
+kuiper_autosize_events_total.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils import timex
+from ..utils.infra import EngineError, logger
+
+# ------------------------------------------------------------- QoS classes
+#: per-class shed ladders: level 1..n -> fraction of the rule's input
+#: discarded at its entry nodes. `critical` is exempt — it rides global
+#: backpressure only. The class is a RULE option (`qosClass`), distinct
+#: from the checkpoint `qos` level.
+SHED_LADDERS: Dict[str, tuple] = {
+    "low": (0.25, 0.50, 0.75, 0.90),
+    "standard": (0.10, 0.25, 0.50, 0.75),
+    "high": (0.05, 0.10, 0.25, 0.50),
+    "critical": (),
+}
+
+DEFAULT_QOS_CLASS = "standard"
+
+
+def parse_qos_class(options: Optional[Dict[str, Any]]) -> str:
+    """Rule QoS class off its options (`qosClass`/`qos_class`); unknown
+    values fall back to `standard` (a typo must not exempt a rule from
+    shedding — nor subject it to the `low` ladder)."""
+    raw = (options or {}).get("qosClass",
+                              (options or {}).get("qos_class"))
+    cls = str(raw).strip().lower() if raw is not None else DEFAULT_QOS_CLASS
+    return cls if cls in SHED_LADDERS else DEFAULT_QOS_CLASS
+
+
+# --------------------------------------------------------------- admission
+#: admission pricing coefficients beyond the sharing model's: rough
+#: steady-state cost of a host-path rule per batch (row loop + project +
+#: sink), and the HBM projection's pane multiplier (panes + emit staging)
+HOST_BATCH_US = 50.0
+HBM_PANE_FACTOR = 4
+
+DEFAULT_INTERVAL_MS = int(os.environ.get("KUIPER_CONTROL_INTERVAL_MS",
+                                         "5000") or 5000)
+#: admission queue bound — past it, queueing degrades to reject (a queue
+#: that grows without bound during a storm is its own meltdown)
+ADMISSION_QUEUE_CAP = 64
+
+
+class AdmissionRejected(EngineError):
+    """A rule was refused admission. Carries the STRUCTURED decision
+    (reason + price) — the REST layer serializes it instead of a bare
+    error string, per the control plane's no-opaque-rejections
+    contract."""
+
+    def __init__(self, decision: Dict[str, Any]) -> None:
+        super().__init__(
+            f"rule admission rejected: {decision.get('reason', '?')}")
+        self.decision = decision
+
+
+def _env_float(name: str, default: float = 0.0) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def price_rule(rule, store) -> Dict[str, Any]:
+    """Price a candidate rule off the live cost model + telemetry.
+    Degrades per component — a rule the planner cannot price (graph
+    rules, parse oddities) gets a zero-cost component, never an
+    exception: admission must not be a new way for create to crash."""
+    price: Dict[str, Any] = {
+        "fold_us_per_s": 0.0,
+        "path": "unknown",
+        "hbm_projected_bytes": 0,
+        "hbm_current_bytes": 0,
+        "hbm_trend_bytes_per_min": 0.0,
+        "compile_storms_total": 0,
+    }
+    from ..observability import devwatch, memwatch
+    from ..planner import sharing
+
+    try:
+        price["hbm_current_bytes"] = memwatch.registry().total_bytes()
+    except Exception:
+        pass
+    try:
+        from ..observability import health
+
+        ev = health.evaluator()
+        if ev is not None:
+            price["hbm_trend_bytes_per_min"] = \
+                ev.hbm_trend()["trend_bytes_per_min"]
+    except Exception:
+        pass
+    try:
+        price["compile_storms_total"] = \
+            devwatch.registry().totals()["storms"]
+    except Exception:
+        pass
+    try:
+        from ..ops.aggspec import extract_kernel_plan
+        from ..planner.planner import explain as plan_explain
+        from ..planner.planner import merged_options
+        from ..sql.parser import parse_select
+
+        stmt = parse_select(rule.sql)
+        opts = merged_options(rule)
+        batches_per_s = 1000.0 / max(opts.micro_batch_linger_ms, 1)
+        plan = None
+        try:
+            plan = extract_kernel_plan(stmt)
+        except Exception:
+            plan = None
+        if plan is None:
+            price["path"] = "host"
+            price["fold_us_per_s"] = round(HOST_BATCH_US * batches_per_s, 1)
+        else:
+            n_specs = len(plan.specs)
+            explain = {}
+            try:
+                explain = plan_explain(rule, store)
+            except Exception:
+                pass
+            share = explain.get("sharing") or {}
+            if share.get("decision") == "shared":
+                # marginal cost of joining the fleet: the emit-combine
+                # overhead the sharing model already estimated — the
+                # fold itself is already being paid for
+                price["path"] = "device-shared"
+                price["fold_us_per_s"] = float(
+                    (share.get("estimates") or {})
+                    .get("emit_overhead_us_per_s", 0.0))
+            else:
+                price["path"] = "device-private"
+                price["fold_us_per_s"] = round(
+                    (sharing.FOLD_DISPATCH_US
+                     + sharing.FOLD_SPEC_US * n_specs) * batches_per_s, 1)
+            # projected window-state claim: one f32 slot per key per agg
+            # spec, times the pane/staging multiplier (documented in
+            # docs/RESILIENCE.md — a bound, not an allocation)
+            price["hbm_projected_bytes"] = int(
+                opts.key_slots * max(n_specs, 1) * 4 * HBM_PANE_FACTOR)
+            if share:
+                price["sharing"] = {
+                    "decision": share.get("decision"),
+                    "reason": share.get("reason", "")[:160],
+                }
+    except Exception as exc:
+        price["price_error"] = str(exc)[:200]
+    return price
+
+
+def _static_gates(price: Dict[str, Any],
+                  committed_us_per_s: float) -> Optional[Dict[str, Any]]:
+    """Budget gates that need no controller: return a reject decision or
+    None. Budgets default OFF (env unset) — admission then accepts."""
+    hbm_budget_mb = _env_float("KUIPER_HBM_BUDGET_MB")
+    if hbm_budget_mb > 0:
+        projected = price["hbm_current_bytes"] + price["hbm_projected_bytes"]
+        if projected > hbm_budget_mb * 1024 * 1024:
+            return {
+                "decision": "reject",
+                "reason": (
+                    f"projected HBM {projected / 1e6:.1f}MB exceeds the "
+                    f"{hbm_budget_mb:.0f}MB budget "
+                    "(KUIPER_HBM_BUDGET_MB)"),
+                "price": price,
+            }
+    fold_budget = _env_float("KUIPER_ADMISSION_FOLD_BUDGET_US_PER_S")
+    if fold_budget > 0:
+        if committed_us_per_s + price["fold_us_per_s"] > fold_budget:
+            return {
+                "decision": "reject",
+                "reason": (
+                    f"fold cost {price['fold_us_per_s']:.0f}us/s on top of "
+                    f"{committed_us_per_s:.0f}us/s already committed "
+                    f"exceeds the {fold_budget:.0f}us/s budget "
+                    "(KUIPER_ADMISSION_FOLD_BUDGET_US_PER_S)"),
+                "price": price,
+            }
+    return None
+
+
+# -------------------------------------------------------------- controller
+class _RuleCtl:
+    """Per-rule controller state across ticks."""
+
+    __slots__ = ("shed_level", "breach_run", "clear_run", "qos_class",
+                 "shed_rows_seen", "autosize_cool", "orig_sizes",
+                 "missing_runs")
+
+    def __init__(self) -> None:
+        self.shed_level = 0
+        self.breach_run = 0
+        self.clear_run = 0
+        self.qos_class = DEFAULT_QOS_CLASS
+        self.shed_rows_seen = 0
+        self.autosize_cool = 0
+        self.orig_sizes: Dict[str, Dict[str, int]] = {}
+        self.missing_runs = 0
+
+
+class QoSController:
+    """Periodic actuator over the health plane's verdicts. `rules_fn()`
+    yields the same (rule_id, topo, options) triples the HealthEvaluator
+    consumes; `start_fn(rule_id)` starts a queued rule when admission
+    pressure clears; `verdicts_fn()` defaults to the installed health
+    evaluator's last verdicts (injectable for tests)."""
+
+    def __init__(self, rules_fn: Callable[[], List[tuple]],
+                 start_fn: Optional[Callable[[str], None]] = None,
+                 verdicts_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 unqueue_fn: Optional[Callable[[str], None]] = None,
+                 interval_ms: int = DEFAULT_INTERVAL_MS,
+                 up_ticks: int = 2, down_ticks: int = 3) -> None:
+        self._rules_fn = rules_fn
+        self._start_fn = start_fn
+        self._verdicts_fn = verdicts_fn
+        # called when an entry leaves the queue WITHOUT being started
+        # (dequeue-time reject) — the registry wires this to drop the
+        # persisted admission_queue slot, or a restart would resurrect
+        # a rule the controller already refused
+        self._unqueue_fn = unqueue_fn
+        self.interval_ms = int(interval_ms)
+        self.up_ticks = max(int(up_ticks), 1)
+        self.down_ticks = max(int(down_ticks), 1)
+        self._lock = threading.RLock()
+        self._timer = None
+        self._running = False
+        self.ticks = 0
+        self._tracks: Dict[str, _RuleCtl] = {}
+        # admission bookkeeping
+        self._adm_counts = {"accept": 0, "reject": 0, "queue": 0}
+        self._aqueue: Dict[str, Dict[str, Any]] = {}  # rid -> entry
+        self._committed: Dict[str, float] = {}  # rid -> fold_us_per_s
+        self._prev_storms = 0
+        self._storm_active = False
+        # shed accounting: monotonic row totals per (rule, qos class) —
+        # survives topo restarts (node counters reset with the topo)
+        self._shed_totals: Dict[tuple, int] = {}
+        # autosize accounting
+        self.autosize_events = 0
+        self._autosize_log: deque = deque(maxlen=64)
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        with self._lock:
+            if self._running:
+                return
+            self._running = True
+        self._arm()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._running = False
+            if self._timer is not None:
+                self._timer.stop()
+                self._timer = None
+
+    def _arm(self) -> None:
+        self._timer = timex.after(self.interval_ms, self._fire)
+
+    def _fire(self, ts: int) -> None:
+        if not self._running:
+            return
+        try:
+            self.tick()
+        except Exception as exc:  # the controller must never kill a timer
+            logger.warning("qos controller tick failed: %s", exc)
+        if self._running:
+            self._arm()
+
+    # -------------------------------------------------------------- admission
+    def storm_active(self) -> bool:
+        """True when a compile storm fired since the last control tick —
+        a bad moment to admit new compile load."""
+        from ..observability import devwatch
+
+        try:
+            now_storms = devwatch.registry().totals()["storms"]
+        except Exception:
+            return False
+        with self._lock:
+            return self._storm_active or now_storms > self._prev_storms
+
+    def breaching_count(self) -> int:
+        verdicts = self._verdicts()
+        return sum(1 for v in verdicts.values()
+                   if v.get("state") == "breaching")
+
+    def committed_us_per_s(self) -> float:
+        with self._lock:
+            return sum(self._committed.values())
+
+    def note_admission(self, decision: str) -> None:
+        with self._lock:
+            self._adm_counts[decision] = \
+                self._adm_counts.get(decision, 0) + 1
+
+    def commit(self, rule_id: str, fold_us_per_s: float) -> None:
+        with self._lock:
+            self._committed[rule_id] = float(fold_us_per_s)
+
+    def release(self, rule_id: str) -> None:
+        """Rule deleted: drop its admission ledger entry + queue slot +
+        controller track (shed TOTALS survive — monotonic counters)."""
+        with self._lock:
+            self._committed.pop(rule_id, None)
+            self._aqueue.pop(rule_id, None)
+            self._tracks.pop(rule_id, None)
+
+    def enqueue(self, rule_id: str, decision: Dict[str, Any]) -> bool:
+        """Park a queue-decided rule for retry at control ticks. False
+        when the queue is full (the caller downgrades to reject). The
+        `queue` counter + flight event are recorded HERE, on success —
+        counting at decision time would misreport a full-queue
+        downgrade as both queued and rejected."""
+        now = timex.now_ms()
+        with self._lock:
+            if len(self._aqueue) >= ADMISSION_QUEUE_CAP \
+                    and rule_id not in self._aqueue:
+                return False
+            self._aqueue[rule_id] = {
+                "rule": rule_id,
+                "reason": decision.get("reason", ""),
+                "price": decision.get("price", {}),
+                "enqueued_ms": now,
+                "attempts": 0,
+            }
+        self.note_admission("queue")
+        from .events import recorder
+
+        recorder().record(
+            "admission", rule=rule_id, severity="info", ts_ms=now,
+            decision="queue", reason=decision.get("reason", ""))
+        return True
+
+    def queued(self, rule_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            entry = self._aqueue.get(rule_id)
+            return dict(entry) if entry is not None else None
+
+    def claim(self, rule_id: str) -> Optional[Dict[str, Any]]:
+        """Atomically pop a queued rule and commit its price — the ONE
+        place the dequeue+commit invariant lives (the controller's own
+        drain and the registry's operator-start override both use it).
+        Returns the entry, or None when the rule wasn't queued."""
+        with self._lock:
+            entry = self._aqueue.pop(rule_id, None)
+            if entry is None:
+                return None
+            self._committed[rule_id] = float(
+                (entry.get("price") or {}).get("fold_us_per_s", 0.0))
+            return entry
+
+    def _drain_admission_queue(self, now: int) -> None:
+        """Retry queued rules; start the ones whose pressure cleared.
+        Starts run OUTSIDE the controller lock — start_fn reaches the
+        rule registry, whose locks must never nest under ours."""
+        with self._lock:
+            pending = list(self._aqueue.items())
+        if not pending:
+            return
+        defer, reason = self._pressure()
+        if defer:
+            with self._lock:
+                for _rid, entry in pending:
+                    entry["attempts"] += 1
+            return
+        from .events import recorder
+
+        for rid, entry in pending:
+            # the budget gates re-run at dequeue time: N rules queued
+            # during one storm each passed the gates against a ledger
+            # that excluded the others — starting them all unchecked
+            # could jointly blow the very budgets the gates enforce
+            with self._lock:
+                pending_entry = self._aqueue.get(rid)
+                committed = sum(v for r, v in self._committed.items()
+                                if r != rid)
+            if pending_entry is None:
+                continue
+            price = dict(pending_entry.get("price") or {})
+            price.setdefault("fold_us_per_s", 0.0)
+            price.setdefault("hbm_projected_bytes", 0)
+            # the HBM side must re-gate against LIVE telemetry — the
+            # enqueue-time snapshot is exactly what the queue period
+            # may have invalidated
+            try:
+                from ..observability import memwatch
+
+                price["hbm_current_bytes"] = \
+                    memwatch.registry().total_bytes()
+            except Exception:
+                price.setdefault("hbm_current_bytes", 0)
+            rej = _static_gates(price, committed)
+            if rej is not None:
+                with self._lock:
+                    self._aqueue.pop(rid, None)
+                self.note_admission("reject")
+                recorder().record(
+                    "admission", rule=rid, severity="warn", ts_ms=now,
+                    decision="reject", dequeued=True,
+                    reason=rej["reason"])
+                logger.warning("queued rule %s rejected at dequeue: %s",
+                               rid, rej["reason"])
+                if self._unqueue_fn is not None:
+                    try:
+                        self._unqueue_fn(rid)
+                    except Exception:
+                        pass
+                continue
+            entry = self.claim(rid)
+            if entry is None:
+                continue
+            self.note_admission("accept")
+            recorder().record(
+                "admission", rule=rid, severity="info", ts_ms=now,
+                decision="accept", dequeued=True,
+                queued_ms=max(now - entry.get("enqueued_ms", now), 0),
+                reason="admission pressure cleared")
+            if self._start_fn is not None:
+                try:
+                    self._start_fn(rid)
+                except Exception as exc:
+                    logger.warning(
+                        "queued rule %s failed to start: %s", rid, exc)
+
+    def _pressure(self) -> tuple:
+        """(defer?, reason) — the transient conditions that QUEUE a new
+        rule instead of accepting or rejecting it outright."""
+        if os.environ.get("KUIPER_ADMISSION_DEFER_STORMS", "1") != "0" \
+                and self.storm_active():
+            return True, ("an XLA compile storm is active; new compile "
+                          "load is deferred until it clears")
+        breach_gate = int(_env_float("KUIPER_ADMISSION_DEFER_BREACHING"))
+        if breach_gate > 0:
+            n = self.breaching_count()
+            if n >= breach_gate:
+                return True, (f"{n} rule(s) are breaching their SLO; "
+                              "admission deferred until the engine "
+                              "recovers")
+        return False, ""
+
+    # ----------------------------------------------------------------- tick
+    def _verdicts(self) -> Dict[str, Any]:
+        if self._verdicts_fn is not None:
+            try:
+                return self._verdicts_fn() or {}
+            except Exception:
+                return {}
+        from ..observability import health
+
+        ev = health.evaluator()
+        if ev is None:
+            return {}
+        try:
+            return ev.verdicts()
+        except Exception:
+            return {}
+
+    def tick(self) -> Dict[str, Any]:
+        """One control pass: update the storm edge, walk every rule's
+        verdict through the shed ladder + autosizer, then retry the
+        admission queue. Returns a {rule: action} summary (tests)."""
+        # clock BEFORE the lock: mock-clock advances fire _fire -> tick
+        # while holding the clock lock (same ABBA class health.tick
+        # documents; clock orders first)
+        now = timex.now_ms()
+        verdicts = self._verdicts()
+        from ..observability import devwatch
+
+        actions: Dict[str, Any] = {}
+        with self._lock:
+            try:
+                storms = devwatch.registry().totals()["storms"]
+                self._storm_active = storms > self._prev_storms
+                self._prev_storms = storms
+            except Exception:
+                self._storm_active = False
+            try:
+                rules = list(self._rules_fn() or [])
+            except Exception as exc:
+                logger.warning("qos controller rules_fn failed: %s", exc)
+                rules = []
+            seen = set()
+            for entry in rules:
+                try:
+                    rid, topo, options = entry
+                except (TypeError, ValueError):
+                    continue
+                if topo is None:
+                    continue
+                seen.add(rid)
+                try:
+                    act = self._control_rule(rid, topo, options or {},
+                                             verdicts.get(rid), now)
+                    if act:
+                        actions[rid] = act
+                except Exception as exc:
+                    logger.warning("qos control of rule %s failed: %s",
+                                   rid, exc)
+            # tracks are swept with a GRACE period, not on first miss: a
+            # rule mid-restart (kill/restore, update) briefly has no live
+            # topo, and dropping its track then would reset the shed
+            # ladder + re-baseline its shed accounting mid-storm
+            for rid in [r for r in self._tracks if r not in seen]:
+                tr = self._tracks[rid]
+                tr.missing_runs += 1
+                if tr.missing_runs > 10:
+                    del self._tracks[rid]
+            for rid in seen:
+                if rid in self._tracks:
+                    self._tracks[rid].missing_runs = 0
+            self.ticks += 1
+        self._drain_admission_queue(now)
+        return actions
+
+    # ------------------------------------------------------------- per rule
+    def _control_rule(self, rid: str, topo: Any, options: Dict[str, Any],
+                      verdict: Optional[Dict[str, Any]],
+                      now: int) -> Dict[str, Any]:
+        tr = self._tracks.get(rid)
+        if tr is None:
+            tr = self._tracks[rid] = _RuleCtl()
+        tr.qos_class = parse_qos_class(options)
+        ladder = SHED_LADDERS[tr.qos_class]
+        # a rule UPDATE can change the class under a live shed level —
+        # clamp to the new ladder (critical's empty ladder clamps to 0,
+        # i.e. the re-assert below clears the gate) or the indexing
+        # throws and this rule drops out of control forever
+        if tr.shed_level > len(ladder):
+            tr.shed_level = len(ladder)
+        state = (verdict or {}).get("state", "healthy")
+        act: Dict[str, Any] = {}
+
+        # ---- shed accounting: fold the entry nodes' shed_qos counters
+        # into the monotonic per-(rule, qos) totals. A restarted topo
+        # resets its node counters — cur < seen re-baselines, no
+        # negative deltas, no double counting.
+        try:
+            cur_rows = topo.shed_rows()
+        except Exception:
+            cur_rows = tr.shed_rows_seen
+        delta = cur_rows - tr.shed_rows_seen
+        if delta < 0:
+            delta = cur_rows
+        if delta > 0:
+            key = (rid, tr.qos_class)
+            self._shed_totals[key] = self._shed_totals.get(key, 0) + delta
+        tr.shed_rows_seen = cur_rows
+
+        # ---- re-assert the gate after a topo restart: the shed LEVEL
+        # lives here, the fraction lives on the (rebuildable) entry
+        # nodes — a restarted rule must not silently resume unshed while
+        # the controller believes it is relieved
+        expected = ladder[tr.shed_level - 1] if tr.shed_level > 0 else 0.0
+        try:
+            if abs(topo.shed_fraction() - expected) > 1e-9:
+                topo.set_shed(expected)
+        except Exception:
+            pass
+
+        # ---- shed ladder with health-FSM-mirrored hysteresis
+        if state == "breaching":
+            tr.breach_run += 1
+            tr.clear_run = 0
+        elif state == "healthy":
+            tr.clear_run += 1
+            tr.breach_run = 0
+        else:  # degraded holds the current level
+            tr.breach_run = 0
+            tr.clear_run = 0
+        target = tr.shed_level
+        if ladder and tr.breach_run >= self.up_ticks \
+                and tr.shed_level < len(ladder):
+            target = tr.shed_level + 1
+            tr.breach_run = 0
+        elif tr.clear_run >= self.down_ticks and tr.shed_level > 0:
+            target = tr.shed_level - 1
+            tr.clear_run = 0
+        if target != tr.shed_level:
+            prev_level = tr.shed_level
+            tr.shed_level = target
+            frac = ladder[target - 1] if target > 0 else 0.0
+            topo.set_shed(frac)
+            from .events import recorder
+
+            severity = "warn" if target > prev_level else "info"
+            recorder().record(
+                "shed", rule=rid, severity=severity, ts_ms=now,
+                level=target, previous=prev_level,
+                fraction=frac, qos=tr.qos_class,
+                state=state)
+            logger.log(
+                30 if target > prev_level else 20,
+                "rule %s: shed level %d -> %d (%.0f%% of input, qos "
+                "class %s, health %s)", rid, prev_level, target,
+                frac * 100, tr.qos_class, state)
+            act["shed"] = {"level": target, "fraction": frac}
+        if state == "breaching" and not ladder and verdict is not None:
+            act.setdefault("shed", {"level": 0, "fraction": 0.0,
+                                    "exempt": "critical"})
+
+        # ---- autosize off the attributed bottleneck
+        if tr.autosize_cool > 0:
+            tr.autosize_cool -= 1
+        else:
+            auto = self._autosize_rule(rid, topo, tr, verdict, state, now)
+            if auto:
+                tr.autosize_cool = 3  # cooldown: one action per ~3 ticks
+                act["autosize"] = auto
+        return act
+
+    def _autosize_rule(self, rid: str, topo: Any, tr: _RuleCtl,
+                       verdict: Optional[Dict[str, Any]], state: str,
+                       now: int) -> Optional[Dict[str, Any]]:
+        max_pool = int(_env_float("KUIPER_AUTOSIZE_MAX_POOL", 6))
+        max_ring = int(_env_float("KUIPER_AUTOSIZE_MAX_RING", 6))
+        srcs = [n for n in list(getattr(topo, "sources", []))
+                + [n for st, _ in getattr(topo, "live_shared",
+                                          lambda: [])()
+                   for n in getattr(st, "nodes", [])]
+                if hasattr(n, "resize_ingest")
+                and getattr(n, "decode_pool_size", 0) > 0]
+        if not srcs:
+            return None
+        bn = (verdict or {}).get("bottleneck") or {}
+        stage = bn.get("stage")
+        src = srcs[0]
+        orig = tr.orig_sizes.setdefault(src.name, {
+            "pool_size": src.decode_pool_size,
+            "ring_depth": src.ring_depth,
+        })
+        action = None
+        if state != "healthy" and stage == "decode" \
+                and src.decode_pool_size < max_pool:
+            applied = src.resize_ingest(
+                pool_size=src.decode_pool_size + 1)
+            action = {"node": src.name, "action": "grow_pool",
+                      "stage": stage, "applied": applied}
+        elif state != "healthy" and stage == "upload" \
+                and src.ring_depth < max_ring:
+            applied = src.resize_ingest(ring_depth=src.ring_depth + 1)
+            action = {"node": src.name, "action": "grow_ring",
+                      "stage": stage, "applied": applied}
+        elif state == "healthy" and tr.clear_run >= 2 * self.down_ticks:
+            # sustained health: step back toward the configured sizes
+            if src.decode_pool_size > orig["pool_size"]:
+                applied = src.resize_ingest(
+                    pool_size=src.decode_pool_size - 1)
+                action = {"node": src.name, "action": "shrink_pool",
+                          "stage": stage, "applied": applied}
+            elif src.ring_depth > orig["ring_depth"]:
+                applied = src.resize_ingest(
+                    ring_depth=src.ring_depth - 1)
+                action = {"node": src.name, "action": "shrink_ring",
+                          "stage": stage, "applied": applied}
+        if action is None:
+            return None
+        self.autosize_events += 1
+        self._autosize_log.append({"ts_ms": now, "rule": rid, **action})
+        from .events import recorder
+
+        recorder().record(
+            "autosize", rule=rid, severity="info", ts_ms=now, **{
+                k: v for k, v in action.items() if k != "applied"},
+            **(action.get("applied") or {}))
+        logger.info("rule %s: autosize %s on %s (bottleneck %s) -> %s",
+                    rid, action["action"], action["node"], stage,
+                    action.get("applied"))
+        return action
+
+    # ---------------------------------------------------------------- queries
+    def shed_state(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            out = {}
+            for rid, tr in self._tracks.items():
+                ladder = SHED_LADDERS[tr.qos_class]
+                lvl = min(tr.shed_level, len(ladder))  # mid-update clamp
+                out[rid] = {
+                    "level": lvl,
+                    "fraction": ladder[lvl - 1] if lvl > 0 else 0.0,
+                    "qos": tr.qos_class,
+                    "rows": tr.shed_rows_seen,
+                }
+            return out
+
+    def shed_totals(self) -> Dict[tuple, int]:
+        with self._lock:
+            return dict(self._shed_totals)
+
+    def admission_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._adm_counts)
+
+    def diagnostics(self) -> Dict[str, Any]:
+        """The GET /diagnostics/control payload."""
+        with self._lock:
+            queued = [dict(e) for e in self._aqueue.values()]
+            committed = sum(self._committed.values())
+            autosize_recent = list(self._autosize_log)
+        return {
+            "controller": {
+                "interval_ms": self.interval_ms,
+                "ticks": self.ticks,
+                "up_ticks": self.up_ticks,
+                "down_ticks": self.down_ticks,
+            },
+            "admission": {
+                "decisions": self.admission_counts(),
+                "queued": queued,
+                "committed_fold_us_per_s": round(committed, 1),
+                "budgets": {
+                    "hbm_budget_mb": _env_float("KUIPER_HBM_BUDGET_MB"),
+                    "fold_budget_us_per_s": _env_float(
+                        "KUIPER_ADMISSION_FOLD_BUDGET_US_PER_S"),
+                    "defer_breaching": int(_env_float(
+                        "KUIPER_ADMISSION_DEFER_BREACHING")),
+                },
+                "storm_active": self.storm_active(),
+            },
+            "shedding": self.shed_state(),
+            "shed_totals": {
+                f"{rid}|{qos}": n
+                for (rid, qos), n in sorted(self.shed_totals().items())},
+            "autosize": {
+                "events": self.autosize_events,
+                "recent": autosize_recent,
+            },
+        }
+
+
+# -------------------------------------------------------------- singleton
+_controller: Optional[QoSController] = None
+_install_lock = threading.Lock()
+
+
+def install(rules_fn: Callable[[], List[tuple]],
+            start_fn: Optional[Callable[[str], None]] = None,
+            interval_ms: int = DEFAULT_INTERVAL_MS,
+            start: bool = True, **kw) -> QoSController:
+    """Install (replacing any prior) the engine-wide controller. The
+    REST server installs one over its rule registry at boot."""
+    global _controller
+    with _install_lock:
+        if _controller is not None:
+            _controller.stop()
+        _controller = QoSController(rules_fn, start_fn=start_fn,
+                                    interval_ms=interval_ms, **kw)
+        ctl = _controller
+    if start:
+        ctl.start()
+    return ctl
+
+
+def controller() -> Optional[QoSController]:
+    return _controller
+
+
+def reset() -> None:
+    """Test hook: stop and drop the installed controller."""
+    global _controller
+    with _install_lock:
+        if _controller is not None:
+            _controller.stop()
+        _controller = None
+
+
+# ------------------------------------------------------- admission helpers
+def admit_rule(rule, store, allow_queue: bool = True) -> Dict[str, Any]:
+    """The admission decision for one candidate rule: {"decision":
+    accept|reject|queue, "reason", "price"}. Pure read — callers act on
+    it (RuleRegistry.create/update). Works without an installed
+    controller (static budget gates only; pressure deferral and
+    counters need the controller). `allow_queue=False` (updates — the
+    old definition keeps running, there is nothing to defer) skips the
+    pressure gate entirely so no phantom queue decision is counted or
+    flight-recorded."""
+    if os.environ.get("KUIPER_ADMISSION", "1") == "0":
+        return {"decision": "accept", "reason": "admission disabled",
+                "price": {}}
+    ctl = _controller
+    price = price_rule(rule, store)
+    committed = ctl.committed_us_per_s() if ctl is not None else 0.0
+    # a rule replacing itself (update) must not be double-billed
+    if ctl is not None:
+        with ctl._lock:
+            committed -= ctl._committed.get(rule.id, 0.0)
+    decision = _static_gates(price, max(committed, 0.0))
+    if decision is None and ctl is not None and allow_queue:
+        defer, reason = ctl._pressure()
+        if defer:
+            decision = {"decision": "queue", "reason": reason,
+                        "price": price}
+    if decision is None:
+        decision = {"decision": "accept", "reason": "within budgets",
+                    "price": price}
+    if ctl is not None:
+        # queue decisions are counted/flight-recorded by enqueue() on
+        # SUCCESS — counting here would misreport a full-queue
+        # downgrade (429) as queued
+        if decision["decision"] != "queue":
+            ctl.note_admission(decision["decision"])
+        if decision["decision"] == "reject":
+            from .events import recorder
+
+            recorder().record(
+                "admission", rule=rule.id, severity="warn",
+                decision="reject", reason=decision["reason"],
+                fold_us_per_s=price.get("fold_us_per_s"),
+                path=price.get("path"))
+    return decision
+
+
+# -------------------------------------------------------- Prometheus view
+def render_prometheus(out: List[str], esc) -> None:
+    """Append the control-plane families to a /metrics scrape."""
+    ctl = _controller
+    if ctl is None:
+        return
+    out.append("# TYPE kuiper_admission_total counter")
+    out.append("# HELP kuiper_admission_total rule admission decisions "
+               "by outcome (accept/reject/queue)")
+    counts = ctl.admission_counts()
+    for decision in ("accept", "reject", "queue"):
+        out.append(
+            f'kuiper_admission_total{{decision="{decision}"}} '
+            f"{counts.get(decision, 0)}")
+    out.append("# TYPE kuiper_shed_total counter")
+    out.append("# HELP kuiper_shed_total rows shed per rule by the SLO "
+               "control plane, labeled by qos class "
+               "(reason=shed_qos in the drop taxonomy)")
+    for (rid, qos), n in sorted(ctl.shed_totals().items()):
+        out.append(
+            f'kuiper_shed_total{{rule="{esc(rid)}",qos="{esc(qos)}"}} '
+            f"{n}")
+    out.append("# TYPE kuiper_autosize_events_total counter")
+    out.append("# HELP kuiper_autosize_events_total decode pool / ingest "
+               "ring autosize actions taken by the control plane")
+    out.append(f"kuiper_autosize_events_total {ctl.autosize_events}")

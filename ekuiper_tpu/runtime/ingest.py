@@ -61,6 +61,7 @@ class DecodePool:
                  stats=None) -> None:
         self.size = max(1, int(size))
         self.ring_depth = max(1, int(ring_depth))
+        self._name = name
         self._decode = decode_fn
         self._emit = emit_fn
         self._prepare = prepare_fn
@@ -90,7 +91,7 @@ class DecodePool:
         self._emitting = False  # one drainer at a time keeps order total
         self._closed = False
         self._threads = [
-            threading.Thread(target=self._worker, daemon=True,
+            threading.Thread(target=self._worker, args=(i,), daemon=True,
                              name=f"{name}-decode-{i}")
             for i in range(self.size)
         ]
@@ -148,12 +149,47 @@ class DecodePool:
         for t in self._threads:
             t.join(timeout=1.0)
 
+    # ------------------------------------------------------------ autosize
+    def resize(self, new_size: int) -> int:
+        """Adjust the worker count (QoS auto-sizing, runtime/control.py).
+        Growth spawns threads immediately; shrink retires the highest-
+        indexed workers at their next wake (in-flight decodes finish —
+        the ordering contract is untouched, only parallelism changes).
+        Returns the applied size; a closed pool keeps its size."""
+        new_size = max(1, int(new_size))
+        with self._lock:
+            if self._closed:
+                return self.size
+            old = self.size
+            self.size = new_size
+            if new_size < old:
+                self._job_ready.notify_all()  # wake retirees
+        for i in range(old, new_size):
+            t = threading.Thread(target=self._worker, args=(i,),
+                                 daemon=True,
+                                 name=f"{self._name}-decode-{i}")
+            self._threads.append(t)
+            t.start()
+        return new_size
+
+    def set_ring_depth(self, depth: int) -> int:
+        """Adjust the ordered-ring depth (QoS auto-sizing). A deeper ring
+        lets decode run further ahead of upload+fold; a grown depth frees
+        submitters currently blocked on the old bound."""
+        with self._lock:
+            self.ring_depth = max(1, int(depth))
+            self._slot_free.notify_all()
+            return self.ring_depth
+
     # -------------------------------------------------------------- worker
-    def _worker(self) -> None:
+    def _worker(self, idx: int = 0) -> None:
         while True:
             with self._lock:
-                while not self._jobs and not self._closed:
+                while not self._jobs and not self._closed \
+                        and idx < self.size:
                     self._job_ready.wait(timeout=1.0)
+                if idx >= self.size and not self._jobs:
+                    return  # retired by resize(); peers drain the queue
                 if not self._jobs:
                     if self._closed:
                         return

@@ -81,6 +81,23 @@ class _Tagged:
         self.from_name = from_name
 
 
+#: events the QoS shed gate must NEVER discard: dropping a barrier stalls
+#: checkpoint alignment, dropping a watermark/trigger stalls windows —
+#: shedding is a DATA-plane relief valve only
+_CONTROL_EVENTS = (Barrier, Watermark, EOF, Trigger, PreTrigger, ErrorEvent)
+
+
+def _item_rows(item: Any) -> int:
+    """Row count an item represents, for drop accounting (a ColumnBatch
+    speaks for all its rows; a bare emission list for its elements)."""
+    n = getattr(item, "n", None)
+    if isinstance(n, int) and n > 0:
+        return n
+    if type(item) is list:
+        return max(len(item), 1)
+    return 1
+
+
 class Node:
     def __init__(
         self,
@@ -122,6 +139,15 @@ class Node:
         # span attributes for the CURRENT dispatch (set by subclasses,
         # e.g. the sink's e2e latency), attached to the recorded span
         self._span_attrs: Optional[dict] = None
+        # QoS shed gate (runtime/control.py): fraction of incoming DATA
+        # items discarded before enqueue when this rule is breaching its
+        # SLO. Deterministic accumulator pattern (not random) so tests
+        # and replay see the same drop positions; every shed row counts
+        # in the drop taxonomy under reason="shed_qos". Concurrent put()
+        # races on the accumulator are telemetry-grade: the achieved
+        # fraction can skew by one item, never lose the accounting.
+        self._shed_frac = 0.0
+        self._shed_acc = 0.0
 
     # ------------------------------------------------------------------ wiring
     def connect(self, downstream: "Node") -> "Node":
@@ -130,8 +156,25 @@ class Node:
         return downstream
 
     # ------------------------------------------------------------------- input
+    def set_shed_fraction(self, frac: float) -> None:
+        """Install/clear the QoS shed gate (control plane only). 0 = off;
+        clearing also resets the accumulator so a later re-shed starts
+        from a clean phase."""
+        self._shed_frac = max(0.0, min(float(frac), 1.0))
+        if self._shed_frac == 0.0:
+            self._shed_acc = 0.0
+
     def put(self, item: Any, from_name: Optional[str] = None) -> None:
         """Enqueue with drop-oldest on overflow (node.go:140-196)."""
+        if self._shed_frac > 0.0 and not isinstance(item, _CONTROL_EVENTS):
+            self._shed_acc += self._shed_frac
+            if self._shed_acc >= 1.0:
+                self._shed_acc -= 1.0
+                # SLO-driven shedding (runtime/control.py): THIS rule's
+                # input is relieved, by design, with a taxonomy reason —
+                # never the global drop-oldest path below
+                self.stats.inc_dropped("shed_qos", n=_item_rows(item))
+                return
         entry = _Tagged(item, from_name) if from_name is not None else item
         # enqueue-clock appended BEFORE the queue insert: the worker may
         # dequeue the instant the item lands, and a missing time would

@@ -410,6 +410,26 @@ class SourceNode(Node):
             return None
         return pool.in_flight, pool.queue_depth
 
+    def resize_ingest(self, pool_size=None, ring_depth=None):
+        """QoS auto-sizing hook (runtime/control.py): adjust the decode
+        pool and/or ring of an already-pooled source. Returns the applied
+        {pool_size, ring_depth}, or None for an inline source — the
+        control plane never converts a decode_pool_size=0 source to
+        pooled (that path is bit-for-bit deterministic by contract)."""
+        if self.decode_pool_size <= 0:
+            return None
+        if pool_size is not None:
+            self.decode_pool_size = max(1, int(pool_size))
+            if self._pool is not None:
+                self.decode_pool_size = self._pool.resize(
+                    self.decode_pool_size)
+        if ring_depth is not None:
+            self.ring_depth = max(1, int(ring_depth))
+            if self._pool is not None:
+                self.ring_depth = self._pool.set_ring_depth(self.ring_depth)
+        return {"pool_size": self.decode_pool_size,
+                "ring_depth": self.ring_depth}
+
     def register_prep_spec(self, spec) -> None:
         """Plan-time upload-spec registration: (key_name, columns,
         micro_batch) from the planner, so the pool's upload stage serves

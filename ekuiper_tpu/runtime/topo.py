@@ -77,6 +77,43 @@ class Topo:
         into the private open()/close()-managed list)."""
         return list(self._live_shared)
 
+    def entry_nodes(self) -> List[Node]:
+        """This rule's first OWN nodes on the data path: the attach
+        points of shared sources plus every direct consumer of a private
+        source. The QoS control plane installs per-rule shed gates here —
+        upstream of them sits shared (multi-rule) or connector-owned
+        work, downstream is all this rule's private pipeline, so a gate
+        at the entry sheds exactly one rule's input."""
+        out: List[Node] = []
+        seen: set = set()
+        for _ref, entry in self.shared:
+            if id(entry) not in seen:
+                seen.add(id(entry))
+                out.append(entry)
+        for src in self.sources:
+            for n in src.outputs:
+                if id(n) not in seen:
+                    seen.add(id(n))
+                    out.append(n)
+        return out
+
+    def set_shed(self, fraction: float) -> None:
+        """Install (or clear, fraction=0) the rule-scoped shed gate on
+        every entry node (runtime/control.py SLO-driven shedding)."""
+        for node in self.entry_nodes():
+            node.set_shed_fraction(fraction)
+
+    def shed_fraction(self) -> float:
+        """The currently installed shed fraction (max across entries)."""
+        return max((n._shed_frac for n in self.entry_nodes()),
+                   default=0.0)
+
+    def shed_rows(self) -> int:
+        """Rows discarded by the shed gate so far (reason="shed_qos"
+        across entry nodes) — the control plane's per-rule counter."""
+        return sum(n.stats.dropped.get("shed_qos", 0)
+                   for n in self.entry_nodes())
+
     def observe_e2e(self, lat_ms: int) -> None:
         """One ingest→emit latency sample (ms), recorded by sink nodes."""
         self.e2e_hist.record(lat_ms)

@@ -87,7 +87,9 @@ def start_up(config_path: str | None = None, block: bool = True):
     def shutdown(*_args) -> None:
         logger.info("shutting down")
         from ..observability import health
+        from ..runtime import control
 
+        control.reset()  # stop the QoS controller's recurring timer
         health.reset()  # stop the evaluator's recurring timer
         api.rules.stop_all()
         PortableManager.global_instance().kill_all()  # server.go:329 KillAll

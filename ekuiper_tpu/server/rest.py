@@ -30,6 +30,7 @@ from urllib.parse import parse_qs, urlparse
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import __version__ as _version
+from ..runtime.control import AdmissionRejected
 from ..sql import ast
 from ..sql.parser import parse
 from ..utils.infra import EngineError, ParseError, PlanError, logger
@@ -198,6 +199,10 @@ class RestApi:
           lambda m: self.diagnostics_health())
         r("POST", r"^/diagnostics/profile$",
           lambda m, body=None: self.diagnostics_profile(body or {}))
+        # QoS control plane: admission counters + queue, shed state,
+        # autosize log (runtime/control.py)
+        r("GET", r"^/diagnostics/control$",
+          lambda m: self.diagnostics_control())
         r("POST", r"^/rules/(?P<id>[^/]+)/trace/start$",
           lambda m, body=None: self._tracer().enable(
               m["id"], (body or {}).get("strategy", "always"))
@@ -257,6 +262,14 @@ class RestApi:
         from ..observability import health as _health
 
         self.health_evaluator = _health.install(self._health_rules)
+        # QoS controller: acts on the evaluator's verdicts — admission
+        # queue retries, per-rule SLO shedding, decode-pool autosizing
+        from ..runtime import control as _control
+
+        self.qos_controller = _control.install(
+            self._health_rules, start_fn=self.rules.start,
+            unqueue_fn=lambda rid: self.store.kv(
+                "admission_queue").delete(rid))
 
     # ----------------------------------------------------- data import/export
     def data_import(self, m, body: Optional[dict] = None,
@@ -521,6 +534,14 @@ class RestApi:
             kind=query.get("kind") or None,
             rule=query.get("rule") or None, limit=limit, since=since)
 
+    def diagnostics_control(self) -> Dict[str, Any]:
+        """GET /diagnostics/control — the QoS control plane's admission
+        counters/queue, per-rule shed state, and autosize log."""
+        from ..runtime import control
+
+        ctl = control.controller() or self.qos_controller
+        return ctl.diagnostics()
+
     @staticmethod
     def diagnostics_memory() -> Dict[str, Any]:
         """GET /diagnostics/memory — per-component byte probes plus the
@@ -647,10 +668,19 @@ class RestApi:
             raise ParseError("body must contain a sql field")
         return self.streams.exec_stmt(body["sql"])
 
-    def create_rule(self, m, body: Optional[dict] = None) -> str:
+    def create_rule(self, m, body: Optional[dict] = None) -> Any:
         if not body:
             raise ParseError("rule json body required")
         rule_id = self.rules.create(body)
+        from ..runtime import control
+
+        ctl = control.controller()
+        queued = ctl.queued(rule_id) if ctl is not None else None
+        if queued is not None:
+            return {"id": rule_id, "admission": "queued",
+                    "reason": queued.get("reason", ""),
+                    "message": f"Rule {rule_id} was created and queued "
+                               "by admission control."}
         return f"Rule {rule_id} was created successfully."
 
     def update_rule(self, m, body: Optional[dict] = None) -> str:
@@ -683,6 +713,12 @@ class RestApi:
                 return code, result
             except (ParseError, PlanError) as exc:
                 return 400, {"error": str(exc)}
+            except AdmissionRejected as exc:
+                # structured refusal (reason + price), not an opaque
+                # error string — 429: the engine is declining load, the
+                # rule definition itself may be perfectly valid
+                return 429, {"error": str(exc),
+                             "admission": exc.decision}
             except EngineError as exc:
                 return 400, {"error": str(exc)}
             except Exception as exc:  # noqa: BLE001

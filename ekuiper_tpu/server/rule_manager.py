@@ -23,20 +23,53 @@ class RuleRegistry:
     # ---------------------------------------------------------------- recovery
     def recover(self) -> None:
         """Start rules marked running at last shutdown (boot recovery,
-        reference: server.go rule restore)."""
+        reference: server.go rule restore). Rules parked in the
+        persisted admission queue are re-enqueued with the controller —
+        they were promised a start 'when pressure clears', and the
+        in-memory queue died with the process."""
         run_table = self.store.kv("rule_run_state")
-        for rule_id in self.processor.list():
+        aq_table = self.store.kv("admission_queue")
+        live = set(self.processor.list())
+        for rule_id in live:
             try:
                 rule = self.processor.get(rule_id)
                 rs = RuleState(rule, self.store)
                 with self._lock:
                     self._rules[rule_id] = rs
+                queued, q_ok = aq_table.get_ok(rule_id)
+                if q_ok and queued is not None:
+                    from ..runtime import control
+
+                    ctl = control.controller()
+                    if ctl is not None and ctl.enqueue(rule_id, {
+                            "reason": (queued or {}).get("reason", ""),
+                            "price": (queued or {}).get("price", {})}):
+                        continue  # retried at control ticks
+                    # no controller to honor the promise: start it now
+                    # rather than strand it as pseudo-stopped
+                    aq_table.delete(rule_id)
+                    rs.start()
+                    run_table.set(rule_id, True)
+                    continue
                 started, _ = run_table.get_ok(rule_id)
                 auto_start = rule.options.get("triggered", True)
                 if started if started is not None else auto_start:
+                    # rebuild the admission ledger: the committed fold
+                    # budget died with the process, and enforcing it
+                    # against zero would over-admit a full engine. No
+                    # gating here — boot recovery never refuses a rule
+                    # that was already admitted.
+                    self._bill(rule)
                     rs.start()
             except Exception as exc:
                 logger.error("recover rule %s failed: %s", rule_id, exc)
+        # queue entries for rules whose definition vanished are stale
+        try:
+            for rule_id in list(aq_table.keys()):
+                if rule_id not in live:
+                    aq_table.delete(rule_id)
+        except Exception:
+            pass
 
     # -------------------------------------------------------------------- CRUD
     def create(self, rule_json: Dict[str, Any]) -> str:
@@ -55,14 +88,86 @@ class RuleRegistry:
 
             sharing.undeclare(rule.id)
             raise
+        # admission control (runtime/control.py): price the rule against
+        # the sharing cost model + live HBM/compile telemetry BEFORE it
+        # starts. reject rolls the definition back with a STRUCTURED
+        # decision; queue keeps the definition but defers the start to
+        # the controller's next clear tick.
+        from ..runtime import control
+
+        triggered = rule.options.get("triggered", True)
+        decision = {"decision": "accept"}
+        if triggered:
+            decision = control.admit_rule(rule, self.store)
+        if decision["decision"] == "reject":
+            self.processor.drop(rule.id)
+            from ..planner import sharing
+
+            sharing.undeclare(rule.id)
+            raise control.AdmissionRejected(decision)
         with self._lock:
             self._rules[rule.id] = rs
-        if rule.options.get("triggered", True):
+        if decision["decision"] == "queue":
+            ctl = control.controller()
+            if ctl is not None and ctl.enqueue(rule.id, decision):
+                self.store.kv("rule_run_state").set(rule.id, False)
+                # persist the queue slot: a restart before pressure
+                # clears must re-enqueue this rule (recover()), not
+                # strand it indistinguishable from a user-stopped one
+                self.store.kv("admission_queue").set(rule.id, {
+                    "reason": decision.get("reason", ""),
+                    "price": decision.get("price", {}),
+                })
+                return rule.id
+            # no controller to retry it (or queue full): a queued rule
+            # nobody will ever start is a silent reject — refuse loudly,
+            # and COUNT it as the reject it became (enqueue never
+            # counted a queue for it)
+            if ctl is not None:
+                ctl.note_admission("reject")
+                from ..runtime.events import recorder
+
+                recorder().record(
+                    "admission", rule=rule.id, severity="warn",
+                    decision="reject",
+                    reason="admission queue unavailable")
+            self.processor.drop(rule.id)
+            from ..planner import sharing
+
+            sharing.undeclare(rule.id)
+            with self._lock:
+                self._rules.pop(rule.id, None)
+            raise control.AdmissionRejected({
+                **decision, "decision": "reject",
+                "reason": decision.get("reason", "")
+                + " (admission queue unavailable)"})
+        if triggered:
+            ctl = control.controller()
+            if ctl is not None:
+                ctl.commit(rule.id, float(
+                    (decision.get("price") or {})
+                    .get("fold_us_per_s", 0.0)))
             rs.start()
             self.store.kv("rule_run_state").set(rule.id, True)
         return rule.id
 
     def update(self, rule_json: Dict[str, Any]) -> None:
+        # re-price the NEW definition before applying it: an update can
+        # turn a cheap rule into one that blows the budgets. Updates are
+        # never queued (allow_queue=False — the old definition keeps
+        # running, there is nothing to defer) and the ledger is only
+        # re-billed AFTER the processor accepts the new definition: a
+        # parse-rejected update must not leave the ledger billing a
+        # definition that never applied.
+        from ..runtime import control
+
+        candidate = RuleDef.from_dict(rule_json)
+        decision = None
+        if candidate.id:
+            decision = control.admit_rule(candidate, self.store,
+                                          allow_queue=False)
+            if decision["decision"] == "reject":
+                raise control.AdmissionRejected(decision)
         rule = self.processor.update(rule_json)
         # drop stale sharing candidacy (the SQL/options may have changed
         # its store key); the restart below re-declares under the new one
@@ -77,10 +182,29 @@ class RuleRegistry:
             was_running = rs.state in (
                 RunState.RUNNING, RunState.STARTING, RunState.SCHEDULED)
             rs.stop()
+            # stop is ASYNC (FSM action queue): the old topo must release
+            # its shared-source attachment before the new RuleState plans,
+            # or the new start races "already attached" and dies
+            # stopped_by_error — under rule-churn storms this silently
+            # killed updated rules
+            import time as _time
+
+            deadline = _time.monotonic() + 10.0
+            while _time.monotonic() < deadline and rs.state not in (
+                    RunState.STOPPED, RunState.STOPPED_BY_ERR):
+                _time.sleep(0.005)
             new_rs = RuleState(rule, self.store)
             with self._lock:
                 self._rules[rule.id] = new_rs
             if was_running:
+                # only a definition that will actually RUN is billed —
+                # updating a stopped rule must not consume fold budget
+                if decision is not None:
+                    ctl = control.controller()
+                    if ctl is not None:
+                        ctl.commit(rule.id, float(
+                            (decision.get("price") or {})
+                            .get("fold_us_per_s", 0.0)))
                 new_rs.start()
         else:
             with self._lock:
@@ -93,13 +217,36 @@ class RuleRegistry:
             rs.stop()
         self.processor.drop(rule_id)
         self.store.kv("rule_run_state").delete(rule_id)
+        self.store.kv("admission_queue").delete(rule_id)
         # a deleted rule must stop counting as a sharing peer (ghost
         # declarations would make a later lone rule share with nobody)
         from ..planner import sharing
 
         sharing.undeclare(rule_id)
+        # ...and must release its admission ledger entry / queue slot
+        from ..runtime import control
+
+        ctl = control.controller()
+        if ctl is not None:
+            ctl.release(rule_id)
 
     # --------------------------------------------------------------- lifecycle
+    def _bill(self, rule) -> None:
+        """Record a rule's priced fold cost in the admission ledger
+        (no gating). The ledger tracks RUNNING rules: create-triggered,
+        operator start, queue drain, and boot recovery all bill;
+        stop/delete release."""
+        from ..runtime import control
+
+        ctl = control.controller()
+        if ctl is None:
+            return
+        try:
+            price = control.price_rule(rule, self.store)
+            ctl.commit(rule.id, float(price.get("fold_us_per_s", 0.0)))
+        except Exception:
+            pass
+
     def _get(self, rule_id: str) -> RuleState:
         with self._lock:
             rs = self._rules.get(rule_id)
@@ -112,12 +259,32 @@ class RuleRegistry:
         return rs
 
     def start(self, rule_id: str) -> None:
+        # an operator start overrides a pending admission queue slot —
+        # claim() pops it and commits its price atomically so the
+        # controller won't start it a second time later
+        from ..runtime import control
+
+        ctl = control.controller()
+        if ctl is not None and ctl.claim(rule_id) is None:
+            # not queued (e.g. created triggered=false, or stopped then
+            # restarted): the ledger must still bill what now runs
+            self._bill(self._get(rule_id).rule)
+        self.store.kv("admission_queue").delete(rule_id)
         self._get(rule_id).start()
         self.store.kv("rule_run_state").set(rule_id, True)
 
     def stop(self, rule_id: str) -> None:
         self._get(rule_id).stop()
         self.store.kv("rule_run_state").set(rule_id, False)
+        # a stopped rule costs nothing: release its ledger entry (and
+        # any pending queue slot — an operator stop cancels the promise
+        # to start it later)
+        from ..runtime import control
+
+        ctl = control.controller()
+        if ctl is not None:
+            ctl.release(rule_id)
+        self.store.kv("admission_queue").delete(rule_id)
 
     def restart(self, rule_id: str) -> None:
         self._get(rule_id).restart()

@@ -1,0 +1,75 @@
+"""Jittered exponential backoff — the ONE retry-delay policy for
+connector reconnect loops (io/mqtt_native.py, io/zmq_native.py,
+io/kafka_io.py).
+
+Fixed-sleep retries synchronize: a broker restart makes every client
+redial on the same beat, and the reconnect stampede is itself the next
+outage (the classic thundering herd). Every reconnect path therefore
+computes its delay here — exponential growth with a hard cap, plus
+"equal jitter" (half the computed delay fixed, half uniform random), so
+a fleet's retries spread over the window instead of arriving together.
+
+The delay sequence is a pure function of (attempt, rng): tests inject a
+seeded `random.Random` and assert the schedule deterministically, no
+sleeping involved — the caller owns the actual wait (connectors block on
+their `threading.Event` stop flags so close() interrupts a backoff
+immediately; that part is wall-clock by design and lives outside the
+engine clock).
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+
+def backoff_delay_s(attempt: int, base_s: float = 0.1,
+                    cap_s: float = 30.0, factor: float = 2.0,
+                    rng: Optional[random.Random] = None) -> float:
+    """Delay before retry `attempt` (1-based): equal-jitter exponential
+    backoff. attempt<=1 starts at `base_s`; growth is `factor`-fold per
+    attempt, capped at `cap_s`; the returned delay is uniform in
+    [raw/2, raw] so concurrent retriers spread while every delay keeps a
+    meaningful floor (full jitter can return ~0 and hot-spin a dead
+    broker)."""
+    raw = min(base_s * (factor ** max(int(attempt) - 1, 0)), cap_s)
+    r = (rng or random).uniform(0.5, 1.0)
+    return raw * r
+
+
+class Backoff:
+    """Stateful wrapper for reconnect loops: `next_s()` advances the
+    schedule, `reset()` rewinds it after a successful (re)connect,
+    `wait(stop)` sleeps the next delay interruptibly against a
+    `threading.Event` (returns True when the stop flag fired — the
+    caller's signal to bail out of its retry loop)."""
+
+    def __init__(self, base_s: float = 0.1, cap_s: float = 30.0,
+                 factor: float = 2.0,
+                 rng: Optional[random.Random] = None) -> None:
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.factor = float(factor)
+        self._rng = rng
+        self.attempt = 0
+
+    def next_s(self) -> float:
+        self.attempt += 1
+        return backoff_delay_s(self.attempt, self.base_s, self.cap_s,
+                               self.factor, rng=self._rng)
+
+    def reset(self) -> None:
+        self.attempt = 0
+
+    def wait(self, stop: Optional[threading.Event] = None) -> bool:
+        """Block for the next delay; a set/firing `stop` event cuts the
+        wait short. Returns True when stopped."""
+        delay = self.next_s()
+        if stop is not None:
+            return stop.wait(delay)
+        # kuiperlint exempt by scope (utils/ is not clock-disciplined);
+        # connector retries are wall-clock by design
+        import time as _time
+
+        _time.sleep(delay)
+        return False

@@ -44,7 +44,8 @@ class StatManager:
         # drop taxonomy: data discarded BY DESIGN (backpressure, late
         # rows, undecodable payloads) counts here with a reason label —
         # never in `exceptions`, which means operator ERRORS. Reasons:
-        # buffer_full / pane_recycle / decode_error / stale_watermark.
+        # buffer_full / pane_recycle / decode_error / stale_watermark /
+        # shed_qos (SLO-driven shedding, runtime/control.py).
         self.dropped: Dict[str, int] = {}
         self.last_exception: str = ""
         self.last_exception_time: int = 0

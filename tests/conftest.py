@@ -44,7 +44,7 @@ def fresh_engine_state():
     """Fresh mock clock + in-memory store + empty subtopo/shared-fold
     pools per test."""
     from ekuiper_tpu.planner import sharing
-    from ekuiper_tpu.runtime import nodes_sharedfold, subtopo
+    from ekuiper_tpu.runtime import control, nodes_sharedfold, subtopo
 
     from ekuiper_tpu.observability import (devwatch, health, kernwatch,
                                            memwatch)
@@ -57,7 +57,9 @@ def fresh_engine_state():
     sharing.reset()
     recorder().clear()
     health.reset()
+    control.reset()
     yield clock
+    control.reset()
     health.reset()
     nodes_sharedfold.reset()
     subtopo.reset()

@@ -1,0 +1,99 @@
+"""Jittered exponential backoff (utils/backoff.py) + its connector
+wiring. The schedule is a pure function of (attempt, rng), so every
+assertion here is deterministic with a seeded RNG and nothing sleeps
+(connector waits run against stop events the tests pre-set)."""
+import random
+import threading
+
+from ekuiper_tpu.utils.backoff import Backoff, backoff_delay_s
+
+
+class TestBackoffDelay:
+    def test_exponential_growth_and_cap(self):
+        rng = random.Random(7)
+        raws = [backoff_delay_s(a, base_s=1.0, cap_s=30.0, rng=rng)
+                for a in range(1, 10)]
+        # every delay sits in [raw/2, raw] of its attempt's raw value
+        for a, d in enumerate(raws, start=1):
+            raw = min(1.0 * 2 ** (a - 1), 30.0)
+            assert raw / 2 <= d <= raw
+        # cap: attempts far out never exceed cap_s
+        assert backoff_delay_s(50, base_s=1.0, cap_s=30.0,
+                               rng=random.Random(1)) <= 30.0
+
+    def test_jitter_spreads_concurrent_retriers(self):
+        # two clients at the SAME attempt must (almost surely) pick
+        # different delays — the whole point vs fixed sleeps
+        d1 = backoff_delay_s(4, rng=random.Random(1))
+        d2 = backoff_delay_s(4, rng=random.Random(2))
+        assert d1 != d2
+
+    def test_floor_never_zero(self):
+        # equal jitter keeps >= raw/2: full jitter could return ~0 and
+        # hot-spin a dead broker
+        for seed in range(20):
+            assert backoff_delay_s(1, base_s=0.1,
+                                   rng=random.Random(seed)) >= 0.05
+
+
+class TestBackoffObject:
+    def test_schedule_advances_and_resets(self):
+        bo = Backoff(base_s=1.0, cap_s=30.0, rng=random.Random(3))
+        first = bo.next_s()
+        second = bo.next_s()
+        assert first <= 1.0 and second <= 2.0 and second > 0.5
+        assert bo.attempt == 2
+        bo.reset()
+        assert bo.attempt == 0
+        assert bo.next_s() <= 1.0
+
+    def test_wait_interrupted_by_stop(self):
+        bo = Backoff(base_s=60.0, rng=random.Random(0))
+        stop = threading.Event()
+        stop.set()
+        # a set stop event returns True immediately — close() must be
+        # able to interrupt a capped 60s backoff
+        assert bo.wait(stop) is True
+
+
+class TestConnectorWiring:
+    def test_kafka_retry_deadline_is_jittered(self):
+        """_note_failure's per-partition deadline must land inside the
+        jittered window of the attempt's raw exponential delay."""
+        import time
+
+        from ekuiper_tpu.io.kafka_io import KafkaSource
+
+        src = KafkaSource()
+        src.topic = "t"
+        fails, retry_at = {}, {}
+        t0 = time.monotonic()
+        src._note_failure(fails, retry_at, 0, 42, RuntimeError("x"))
+        src._note_failure(fails, retry_at, 0, 42, RuntimeError("x"))
+        assert fails[0] == 2
+        # attempt 2: raw = 2s -> deadline within (t0+1.0, t0+2.0+eps)
+        delta = retry_at[0] - t0
+        assert 1.0 <= delta <= 2.1
+
+    def test_zmq_sub_uses_backoff(self):
+        import inspect
+
+        from ekuiper_tpu.io import zmq_native
+
+        src = inspect.getsource(zmq_native.SubClient._run)
+        assert "Backoff" in src and "backoff.wait" in src
+
+    def test_mqtt_reconnect_uses_backoff(self):
+        import inspect
+
+        from ekuiper_tpu.io import mqtt_native
+
+        src = inspect.getsource(mqtt_native.NativeMqttClient._reconnect) \
+            if hasattr(mqtt_native, "NativeMqttClient") else ""
+        if not src:  # class name may differ — find the method on any class
+            for name in dir(mqtt_native):
+                obj = getattr(mqtt_native, name)
+                if isinstance(obj, type) and hasattr(obj, "_reconnect"):
+                    src = inspect.getsource(obj._reconnect)
+                    break
+        assert "Backoff" in src and "bo.wait" in src

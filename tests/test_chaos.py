@@ -1,0 +1,112 @@
+"""Chaos harness (tools/chaos.py) — a deterministic mini-storm: the
+same shapes the churn_soak bench phase runs for a minute, compressed
+into manual health/control ticks under the mock clock."""
+import time
+
+import pytest
+
+from ekuiper_tpu.store import kv
+from tools.chaos import DROP_TAXONOMY, ChaosHarness
+
+
+@pytest.fixture
+def api():
+    from ekuiper_tpu.server.rest import RestApi
+
+    api = RestApi(kv.get_store())
+    # deterministic: manual ticks only
+    api.health_evaluator.stop()
+    api.qos_controller.stop()
+    yield api
+    api.rules.stop_all()
+
+
+def _wait_running(api, rid, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rs = api.rules.state(rid)
+        if rs is not None and rs.topo is not None:
+            return rs
+        time.sleep(0.02)
+    raise AssertionError(f"{rid} never opened a topo")
+
+
+class TestChaosHarness:
+    def test_storm_end_to_end(self, api, mock_clock):
+        h = ChaosHarness(api)
+        h.ensure_stream()
+        work = h.workload_rules(2, window_s=10)
+        victim = h.victim_rule()
+        for rid in work + [victim]:
+            _wait_running(api, rid)
+        # a few churn steps: create/update/delete all through REST
+        for _ in range(12):
+            h.churn_step(target_live=4)
+        assert h.counters["created"] >= 4
+        assert h.counters["create_failed"] == 0
+        # skewed publishing reaches the rules (shared source fan-out);
+        # the victim's 2-deep buffers overflow with taxonomy reasons
+        for i in range(30):
+            h.publish_skew(200, hot_key=i % 3, n_keys=16)
+        for rid in work:
+            rs = api.rules.state(rid)
+            rs.topo.wait_idle(5.0)
+        drops = h.drops_by_reason()
+        assert h.unexplained_drops() == {}
+        for agg in drops.values():
+            assert set(agg) <= DROP_TAXONOMY
+        # victim breaches via drop burn -> the controller sheds IT, by
+        # qos class, while the critical workload rules stay untouched.
+        # The overflow is driven deterministically (mock-clock ticks see
+        # the exact same deltas the live storm produces statistically).
+        victim_entry = api.rules.state(victim).topo.entry_nodes()[0]
+        for _ in range(4):
+            victim_entry.stats.inc_dropped("buffer_full", n=500)
+            api.health_evaluator.tick()
+            api.qos_controller.tick()
+        verdict = api.health_evaluator.verdicts().get(victim)
+        assert verdict is not None
+        assert verdict["state"] == "breaching"
+        ctl = api.qos_controller
+        assert ctl.shed_state()[victim]["level"] >= 1
+        assert ctl.shed_state()[victim]["qos"] == "low"
+        # the installed gate now counts shed rows under the taxonomy
+        for _ in range(50):
+            victim_entry.put({"x": 1})
+        assert victim_entry.stats.dropped.get("shed_qos", 0) > 0
+        ctl.tick()
+        assert ctl.shed_totals().get((victim, "low"), 0) > 0
+        for rid in work:
+            assert ctl.shed_state()[rid]["qos"] == "critical"
+            assert ctl.shed_state()[rid]["level"] == 0
+        summary = h.summary()
+        assert summary["admission"]["accept"] >= 5
+        assert "unexplained_drops" in summary
+
+    def test_kill_restore_brings_rules_back(self, api, mock_clock):
+        h = ChaosHarness(api, stream="chaosk", topic="chaosk/t")
+        h.ensure_stream()
+        work = h.workload_rules(2, window_s=10)
+        for rid in work:
+            _wait_running(api, rid)
+        running = h.hard_kill()
+        assert set(running) >= set(work)
+        for rid in work:
+            assert api.rules.state(rid).topo is None
+        rec = h.recover(running)
+        assert rec["recovered"] == rec["expected"]
+        assert rec["missing"] == []
+        for rid in work:
+            assert api.rules.state(rid).topo is not None
+
+    def test_structured_rejection_surfaces(self, api, monkeypatch):
+        h = ChaosHarness(api, stream="chaosr", topic="chaosr/t")
+        h.ensure_stream()
+        monkeypatch.setenv("KUIPER_ADMISSION_FOLD_BUDGET_US_PER_S", "1")
+        rid = h._create({
+            "id": "fatty",
+            "sql": ("SELECT deviceId, avg(v) AS a FROM chaosr "
+                    "GROUP BY deviceId, TUMBLINGWINDOW(ss, 5)"),
+            "actions": [{"nop": {}}]})
+        assert rid is None  # structured 429, counted, not raised
+        assert h.counters["create_rejected"] == 1

@@ -1,0 +1,687 @@
+"""QoS control plane (runtime/control.py): shed gate mechanics, the
+controller's ladder/hysteresis/autosize loops, admission pricing and
+decisions, and the REST/metrics surfaces."""
+import os
+import threading
+import time
+
+import pytest
+
+from ekuiper_tpu.runtime import control
+from ekuiper_tpu.runtime.control import (QoSController, SHED_LADDERS,
+                                         AdmissionRejected,
+                                         parse_qos_class)
+from ekuiper_tpu.runtime.events import (EOF, Barrier, PreTrigger, Trigger,
+                                        Watermark, recorder)
+from ekuiper_tpu.runtime.node import Node
+from ekuiper_tpu.store import kv
+
+
+class Batch:
+    """Row-carrying data item (ColumnBatch stand-in)."""
+
+    def __init__(self, n=10):
+        self.n = n
+
+
+# ------------------------------------------------------------- shed gate
+class TestShedGate:
+    def test_fraction_drops_deterministically(self):
+        n = Node("t")
+        n.set_shed_fraction(0.5)
+        for _ in range(10):
+            n.put({"x": 1})
+        assert n.stats.dropped.get("shed_qos") == 5
+        assert n.inq.qsize() == 5
+
+    def test_rows_counted_not_items(self):
+        n = Node("t")
+        n.set_shed_fraction(1.0)
+        n.put(Batch(n=128))
+        assert n.stats.dropped["shed_qos"] == 128
+        n.put([1, 2, 3])
+        assert n.stats.dropped["shed_qos"] == 131
+
+    def test_control_events_never_shed(self):
+        n = Node("t")
+        n.set_shed_fraction(1.0)
+        for ev in (Barrier(checkpoint_id=1), Watermark(ts=1), EOF(),
+                   Trigger(ts=1), PreTrigger(ts=1)):
+            n.put(ev)
+        assert "shed_qos" not in n.stats.dropped
+        assert n.inq.qsize() == 5
+
+    def test_clear_resets_accumulator(self):
+        n = Node("t")
+        n.set_shed_fraction(0.9)
+        n.put({"x": 1})  # acc 0.9, kept
+        n.set_shed_fraction(0.0)
+        n.set_shed_fraction(0.9)
+        n.put({"x": 1})  # acc restarts at 0.9, kept again
+        assert "shed_qos" not in n.stats.dropped
+
+    def test_zero_fraction_is_free_path(self):
+        n = Node("t")
+        for _ in range(5):
+            n.put({"x": 1})
+        assert n.inq.qsize() == 5
+
+
+# ------------------------------------------------------------- fake topo
+class FakeTopo:
+    def __init__(self, pooled_source=None):
+        self.entry = Node("entry")
+        self.sources = [pooled_source] if pooled_source is not None else []
+        self.shared = [(None, self.entry)]
+
+    def entry_nodes(self):
+        return [self.entry]
+
+    def set_shed(self, frac):
+        self.entry.set_shed_fraction(frac)
+
+    def shed_fraction(self):
+        return self.entry._shed_frac
+
+    def shed_rows(self):
+        return self.entry.stats.dropped.get("shed_qos", 0)
+
+    def live_shared(self):
+        return []
+
+
+class FakePooledSource:
+    """SourceNode stand-in with the resize_ingest contract."""
+
+    def __init__(self, pool=2, ring=2):
+        self.name = "src"
+        self.decode_pool_size = pool
+        self.ring_depth = ring
+
+    def resize_ingest(self, pool_size=None, ring_depth=None):
+        if self.decode_pool_size <= 0:
+            return None
+        if pool_size is not None:
+            self.decode_pool_size = max(1, int(pool_size))
+        if ring_depth is not None:
+            self.ring_depth = max(1, int(ring_depth))
+        return {"pool_size": self.decode_pool_size,
+                "ring_depth": self.ring_depth}
+
+
+def make_ctl(topo, options, verdict_box):
+    return QoSController(
+        lambda: [("r1", topo, options)],
+        verdicts_fn=lambda: dict(verdict_box),
+        interval_ms=1000)
+
+
+# ------------------------------------------------------- ladder/hysteresis
+class TestShedLadder:
+    def test_escalates_after_up_ticks_only(self):
+        topo = FakeTopo()
+        box = {"r1": {"state": "breaching"}}
+        ctl = make_ctl(topo, {"qosClass": "standard"}, box)
+        ctl.tick()
+        assert topo.shed_fraction() == 0.0  # 1 breaching tick < up_ticks
+        ctl.tick()
+        assert topo.shed_fraction() == SHED_LADDERS["standard"][0]
+
+    def test_full_ladder_then_recovery(self):
+        topo = FakeTopo()
+        box = {"r1": {"state": "breaching"}}
+        ctl = make_ctl(topo, {"qosClass": "low"}, box)
+        for _ in range(8):
+            ctl.tick()
+        assert topo.shed_fraction() == SHED_LADDERS["low"][3]  # maxed
+        box["r1"] = {"state": "healthy"}
+        for _ in range(3):
+            ctl.tick()
+        assert topo.shed_fraction() == SHED_LADDERS["low"][2]  # one step
+        for _ in range(12):
+            ctl.tick()
+        assert topo.shed_fraction() == 0.0
+
+    def test_degraded_holds_level(self):
+        topo = FakeTopo()
+        box = {"r1": {"state": "breaching"}}
+        ctl = make_ctl(topo, {}, box)
+        ctl.tick()
+        ctl.tick()
+        frac = topo.shed_fraction()
+        assert frac > 0
+        box["r1"] = {"state": "degraded"}
+        for _ in range(6):
+            ctl.tick()
+        assert topo.shed_fraction() == frac
+
+    def test_critical_never_shed(self):
+        topo = FakeTopo()
+        box = {"r1": {"state": "breaching"}}
+        ctl = make_ctl(topo, {"qosClass": "critical"}, box)
+        for _ in range(6):
+            ctl.tick()
+        assert topo.shed_fraction() == 0.0
+        assert "shed_qos" not in topo.entry.stats.dropped
+
+    def test_shed_events_carry_severity(self):
+        topo = FakeTopo()
+        box = {"r1": {"state": "breaching"}}
+        ctl = make_ctl(topo, {}, box)
+        for _ in range(2):
+            ctl.tick()
+        box["r1"] = {"state": "healthy"}
+        for _ in range(3):
+            ctl.tick()
+        evs = recorder().events(kind="shed")
+        assert [e["severity"] for e in evs] == ["warn", "info"]
+        assert evs[0]["level"] == 1 and evs[1]["level"] == 0
+        assert evs[0]["qos"] == "standard"
+
+    def test_shed_totals_survive_topo_restart(self):
+        topo = FakeTopo()
+        box = {"r1": {"state": "breaching"}}
+        ctl = make_ctl(topo, {}, box)
+        ctl.tick()
+        ctl.tick()  # level 1 installed
+        for _ in range(20):
+            topo.entry.put({"x": 1})
+        ctl.tick()  # fold drops into totals
+        before = ctl.shed_totals()[("r1", "standard")]
+        assert before > 0
+        # "restart": fresh entry node (counters reset), same rule
+        topo.entry = Node("entry")
+        ctl.tick()  # re-baselines without negative delta
+        assert ctl.shed_totals()[("r1", "standard")] == before
+        # and the gate is re-asserted on the new topo's entry
+        assert topo.shed_fraction() > 0
+
+    def test_track_grace_over_restart_window(self):
+        topo = FakeTopo()
+        box = {"r1": {"state": "breaching"}}
+        holder = [("r1", topo, {})]
+        ctl = QoSController(lambda: list(holder),
+                            verdicts_fn=lambda: dict(box))
+        ctl.tick()
+        ctl.tick()
+        assert ctl.shed_state()["r1"]["level"] == 1
+        holder.clear()  # rule mid-restart: no live topo
+        for _ in range(5):
+            ctl.tick()
+        assert "r1" in ctl.shed_state()  # grace keeps the track
+        for _ in range(10):
+            ctl.tick()
+        assert "r1" not in ctl.shed_state()  # gone for good -> swept
+
+
+# ---------------------------------------------------------------- autosize
+class TestAutosize:
+    def _verdict(self, stage, state="degraded"):
+        return {"state": state, "bottleneck": {"stage": stage,
+                                               "share": 0.8}}
+
+    def test_decode_bottleneck_grows_pool(self):
+        src = FakePooledSource(pool=2)
+        topo = FakeTopo(pooled_source=src)
+        box = {"r1": self._verdict("decode")}
+        ctl = make_ctl(topo, {}, box)
+        ctl.tick()
+        assert src.decode_pool_size == 3
+        assert ctl.autosize_events == 1
+        evs = recorder().events(kind="autosize")
+        assert evs and evs[0]["action"] == "grow_pool"
+
+    def test_cooldown_rate_limits(self):
+        src = FakePooledSource(pool=2)
+        topo = FakeTopo(pooled_source=src)
+        box = {"r1": self._verdict("decode")}
+        ctl = make_ctl(topo, {}, box)
+        for _ in range(4):
+            ctl.tick()
+        assert src.decode_pool_size == 3  # one action per cooldown run
+        for _ in range(4):
+            ctl.tick()
+        assert src.decode_pool_size == 4
+
+    def test_upload_bottleneck_grows_ring_and_bound(self, monkeypatch):
+        monkeypatch.setenv("KUIPER_AUTOSIZE_MAX_RING", "3")
+        src = FakePooledSource(ring=2)
+        topo = FakeTopo(pooled_source=src)
+        box = {"r1": self._verdict("upload")}
+        ctl = make_ctl(topo, {}, box)
+        for _ in range(20):
+            ctl.tick()
+        assert src.ring_depth == 3  # capped at the bound
+
+    def test_sustained_health_shrinks_back(self):
+        src = FakePooledSource(pool=2)
+        topo = FakeTopo(pooled_source=src)
+        box = {"r1": self._verdict("decode")}
+        ctl = make_ctl(topo, {}, box)
+        ctl.tick()
+        assert src.decode_pool_size == 3
+        box["r1"] = {"state": "healthy"}
+        for _ in range(20):
+            ctl.tick()
+        assert src.decode_pool_size == 2  # back to the configured size
+
+    def test_inline_source_untouched(self):
+        src = FakePooledSource(pool=0)
+        topo = FakeTopo(pooled_source=src)
+        box = {"r1": self._verdict("decode")}
+        ctl = make_ctl(topo, {}, box)
+        for _ in range(4):
+            ctl.tick()
+        assert src.decode_pool_size == 0
+        assert ctl.autosize_events == 0
+
+
+# --------------------------------------------------------------- admission
+def _mk_stream(store, name="ctrl", topic="ctrl/t"):
+    from ekuiper_tpu.server.processors import StreamProcessor
+
+    StreamProcessor(store).exec_stmt(
+        f'CREATE STREAM {name} (deviceId STRING, v FLOAT) '
+        f'WITH (DATASOURCE="{topic}", TYPE="memory", FORMAT="JSON")')
+
+
+def _rule(rid="adm1", sql=None, options=None):
+    from ekuiper_tpu.planner.planner import RuleDef
+
+    return RuleDef(
+        id=rid,
+        sql=sql or ("SELECT deviceId, avg(v) AS a FROM ctrl "
+                    "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"),
+        actions=[{"nop": {}}], options=options or {})
+
+
+class TestAdmission:
+    def test_accepts_by_default(self):
+        store = kv.get_store()
+        _mk_stream(store)
+        d = control.admit_rule(_rule(), store)
+        assert d["decision"] == "accept"
+        assert d["price"]["fold_us_per_s"] > 0
+        assert d["price"]["path"] in ("device-private", "device-shared")
+
+    def test_price_degrades_on_unparseable_rule(self):
+        store = kv.get_store()
+        d = control.admit_rule(_rule(sql="NOT EVEN SQL"), store)
+        assert d["decision"] == "accept"  # pricing failure != rejection
+
+    def test_fold_budget_rejects_structured(self, monkeypatch):
+        store = kv.get_store()
+        _mk_stream(store)
+        monkeypatch.setenv("KUIPER_ADMISSION_FOLD_BUDGET_US_PER_S", "1")
+        d = control.admit_rule(_rule(), store)
+        assert d["decision"] == "reject"
+        assert "budget" in d["reason"]
+        assert d["price"]["fold_us_per_s"] > 1
+
+    def test_hbm_budget_rejects(self, monkeypatch):
+        store = kv.get_store()
+        _mk_stream(store)
+        from ekuiper_tpu.observability import memwatch
+
+        owner = object.__new__(Node)  # any weakref-able owner
+        memwatch.register("test_blob", owner, lambda o: 512 * 1024 * 1024,
+                          rule="x")
+        monkeypatch.setenv("KUIPER_HBM_BUDGET_MB", "256")
+        d = control.admit_rule(_rule(), store)
+        assert d["decision"] == "reject"
+        assert "HBM" in d["reason"]
+        assert d["price"]["hbm_current_bytes"] >= 512 * 1024 * 1024
+
+    def test_kill_switch(self, monkeypatch):
+        store = kv.get_store()
+        monkeypatch.setenv("KUIPER_ADMISSION", "0")
+        monkeypatch.setenv("KUIPER_ADMISSION_FOLD_BUDGET_US_PER_S", "1")
+        d = control.admit_rule(_rule(), store)
+        assert d["decision"] == "accept"
+
+    def test_update_not_double_billed(self, monkeypatch):
+        store = kv.get_store()
+        _mk_stream(store)
+        ctl = control.install(lambda: [], start=False)
+        d = control.admit_rule(_rule("same"), store)
+        ctl.commit("same", d["price"]["fold_us_per_s"])
+        # budget covers exactly one copy of the rule: re-admitting the
+        # SAME id must subtract its own committed cost first
+        monkeypatch.setenv(
+            "KUIPER_ADMISSION_FOLD_BUDGET_US_PER_S",
+            str(d["price"]["fold_us_per_s"] + 1))
+        d2 = control.admit_rule(_rule("same"), store)
+        assert d2["decision"] == "accept"
+        d3 = control.admit_rule(_rule("other"), store)
+        assert d3["decision"] == "reject"
+
+    def test_queue_on_breaching_pressure_then_drain(self, monkeypatch):
+        store = kv.get_store()
+        _mk_stream(store)
+        box = {"x": {"state": "breaching"}}
+        started = []
+        ctl = control.install(lambda: [], start_fn=started.append,
+                              start=False)
+        ctl._verdicts_fn = lambda: dict(box)
+        monkeypatch.setenv("KUIPER_ADMISSION_DEFER_BREACHING", "1")
+        d = control.admit_rule(_rule("qd1"), store)
+        assert d["decision"] == "queue"
+        assert ctl.enqueue("qd1", d)
+        ctl.tick()
+        assert not started  # pressure still on: held
+        assert ctl.queued("qd1")["attempts"] == 1
+        box.clear()
+        ctl.tick()
+        assert started == ["qd1"]
+        assert ctl.queued("qd1") is None
+        assert ctl.admission_counts()["accept"] >= 1
+        evs = recorder().events(kind="admission")
+        assert any(e.get("dequeued") for e in evs)
+
+    def test_queue_capacity_bounded(self):
+        ctl = control.install(lambda: [], start=False)
+        for i in range(control.ADMISSION_QUEUE_CAP):
+            assert ctl.enqueue(f"r{i}", {"reason": "x", "price": {}})
+        assert not ctl.enqueue("overflow", {"reason": "x", "price": {}})
+
+    def test_rejected_exception_carries_decision(self):
+        exc = AdmissionRejected({"decision": "reject", "reason": "why",
+                                 "price": {"fold_us_per_s": 9}})
+        assert exc.decision["price"]["fold_us_per_s"] == 9
+        assert "why" in str(exc)
+
+
+# ------------------------------------------------------------ REST surface
+class TestRestSurface:
+    def _api(self):
+        from ekuiper_tpu.server.rest import RestApi
+
+        api = RestApi(kv.get_store())
+        # manual ticks only — deterministic
+        api.health_evaluator.stop()
+        api.qos_controller.stop()
+        return api
+
+    def test_create_reject_is_429_structured(self, monkeypatch):
+        api = self._api()
+        _mk_stream(api.store, "r1s", "r1s/t")
+        monkeypatch.setenv("KUIPER_ADMISSION_FOLD_BUDGET_US_PER_S", "1")
+        code, out = api.dispatch("POST", "/rules", {
+            "id": "rj", "sql": ("SELECT deviceId, avg(v) AS a FROM r1s "
+                                "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"),
+            "actions": [{"nop": {}}]}, {})
+        assert code == 429
+        assert out["admission"]["decision"] == "reject"
+        assert out["admission"]["price"]["fold_us_per_s"] > 0
+        # rolled back: the definition must not linger
+        assert all(e["id"] != "rj" for e in api.rules.list())
+        from ekuiper_tpu.planner import sharing
+
+        assert not any("rj" in d for d in sharing._declared.values())
+
+    def test_diagnostics_control_shape(self):
+        api = self._api()
+        code, out = api.dispatch("GET", "/diagnostics/control", None, {})
+        assert code == 200
+        assert "decisions" in out["admission"]
+        assert "shedding" in out and "autosize" in out
+
+    def test_delete_releases_ledger(self, monkeypatch):
+        api = self._api()
+        _mk_stream(api.store, "r2s", "r2s/t")
+        code, _ = api.dispatch("POST", "/rules", {
+            "id": "led", "sql": ("SELECT deviceId, avg(v) AS a FROM r2s "
+                                 "GROUP BY deviceId, "
+                                 "TUMBLINGWINDOW(ss, 10)"),
+            "actions": [{"nop": {}}], "options": {"triggered": False}}, {})
+        assert code == 201
+        ctl = control.controller()
+        ctl.commit("led", 123.0)
+        api.dispatch("DELETE", "/rules/led", None, {})
+        assert ctl.committed_us_per_s() == 0.0
+
+    def test_prometheus_families_render(self):
+        api = self._api()
+        ctl = control.controller()
+        ctl.note_admission("reject")
+        ctl._shed_totals[("r", "low")] = 7
+        from ekuiper_tpu.observability import prometheus
+
+        text = prometheus.render(api.rules)
+        assert 'kuiper_admission_total{decision="reject"} 1' in text
+        assert 'kuiper_shed_total{rule="r",qos="low"} 7' in text
+        assert "kuiper_autosize_events_total 0" in text
+
+
+# ---------------------------------------------------------- pool plumbing
+class TestDecodePoolResize:
+    def _pool(self, size=1, ring=2):
+        from ekuiper_tpu.runtime.ingest import DecodePool
+
+        out = []
+        pool = DecodePool(size, ring, decode_fn=lambda j: j,
+                          emit_fn=out.append, name="t")
+        return pool, out
+
+    def test_grow_keeps_order(self):
+        pool, out = self._pool(size=1)
+        for i in range(5):
+            pool.submit(i)
+        assert pool.resize(4) == 4
+        for i in range(5, 40):
+            pool.submit(i)
+        assert pool.drain(timeout=10)
+        assert out == list(range(40))
+        pool.close()
+
+    def test_shrink_retires_and_still_drains(self):
+        pool, out = self._pool(size=4)
+        assert pool.resize(1) == 1
+        for i in range(20):
+            pool.submit(i)
+        assert pool.drain(timeout=10)
+        assert out == list(range(20))
+        pool.close()
+        # retired workers exit; close joins the rest
+        time.sleep(0.1)
+        alive = [t for t in pool._threads if t.is_alive()]
+        assert not alive
+
+    def test_ring_depth_grow_unblocks_submitter(self):
+        from ekuiper_tpu.runtime.ingest import DecodePool
+
+        gate = threading.Event()
+        out = []
+        pool = DecodePool(1, 1, decode_fn=lambda j: (gate.wait(5), j)[1],
+                          emit_fn=out.append, name="t")
+        pool.submit(0)
+        done = []
+
+        def second():
+            pool.submit(1)  # blocks: ring depth 1, job 0 in flight
+            done.append(True)
+
+        t = threading.Thread(target=second, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        assert not done
+        pool.set_ring_depth(3)
+        t.join(timeout=5)
+        assert done
+        gate.set()
+        assert pool.drain(timeout=10)
+        assert out == [0, 1]
+        pool.close()
+
+
+class TestReviewRegressions:
+    """Fixes from the PR's review pass, each pinned."""
+
+    def test_class_change_to_critical_clears_live_shed(self):
+        # a rule UPDATE that flips qosClass to critical while a shed
+        # level is live must clamp the level (not IndexError) and the
+        # re-assert must clear the installed gate
+        topo = FakeTopo()
+        box = {"r1": {"state": "breaching"}}
+        opts = {"qosClass": "low"}
+        ctl = QoSController(lambda: [("r1", topo, opts)],
+                            verdicts_fn=lambda: dict(box))
+        for _ in range(4):
+            ctl.tick()
+        assert topo.shed_fraction() > 0
+        opts["qosClass"] = "critical"
+        ctl.tick()  # must not raise
+        assert topo.shed_fraction() == 0.0
+        assert ctl.shed_state()["r1"]["level"] == 0
+        ctl.diagnostics()  # must not raise either
+
+    def test_queue_drain_regates_budgets(self, monkeypatch):
+        # two rules queued during one storm each passed the gates
+        # against a ledger excluding the other — at dequeue the gates
+        # re-run, so only what fits the budget starts
+        started = []
+        ctl = control.install(lambda: [], start_fn=started.append,
+                              start=False)
+        monkeypatch.setenv("KUIPER_ADMISSION_FOLD_BUDGET_US_PER_S",
+                           "100")
+        price = {"fold_us_per_s": 60.0, "hbm_current_bytes": 0,
+                 "hbm_projected_bytes": 0}
+        assert ctl.enqueue("a", {"reason": "storm", "price": dict(price)})
+        assert ctl.enqueue("b", {"reason": "storm", "price": dict(price)})
+        ctl.tick()
+        assert started == ["a"]
+        assert ctl.queued("b") is None  # rejected at dequeue, not held
+        assert ctl.admission_counts()["reject"] == 1
+        assert ctl.committed_us_per_s() == 60.0
+
+    def test_update_never_counts_queue(self, monkeypatch):
+        store = kv.get_store()
+        _mk_stream(store, "upq", "upq/t")
+        box = {"x": {"state": "breaching"}}
+        ctl = control.install(lambda: [], start=False)
+        ctl._verdicts_fn = lambda: dict(box)
+        monkeypatch.setenv("KUIPER_ADMISSION_DEFER_BREACHING", "1")
+        d = control.admit_rule(_rule("u1"), store, allow_queue=False)
+        assert d["decision"] == "accept"
+        assert ctl.admission_counts()["queue"] == 0
+        assert not recorder().events(kind="admission")
+
+    def test_failed_update_does_not_rebill_ledger(self):
+        from ekuiper_tpu.server.rule_manager import RuleRegistry
+        from ekuiper_tpu.utils.infra import PlanError
+
+        store = kv.get_store()
+        _mk_stream(store, "upl", "upl/t")
+        reg = RuleRegistry(store)
+        ctl = control.install(lambda: [], start=False)
+        ctl.commit("ghost", 10.0)  # stale billing for a vanished rule
+        with pytest.raises(PlanError):
+            # processor rejects the update (unknown id) AFTER admission
+            # priced it — the ledger must keep the pre-update value
+            reg.update({"id": "ghost", "sql": "SELECT deviceId FROM upl",
+                        "actions": [{"nop": {}}]})
+        assert ctl.committed_us_per_s() == 10.0
+
+    def test_claim_pops_and_commits_once(self):
+        ctl = control.install(lambda: [], start=False)
+        assert ctl.enqueue("c1", {"reason": "x",
+                                  "price": {"fold_us_per_s": 7.0}})
+        entry = ctl.claim("c1")
+        assert entry is not None
+        assert ctl.committed_us_per_s() == 7.0
+        assert ctl.claim("c1") is None  # second claim is a no-op
+        assert ctl.queued("c1") is None
+
+
+class TestLedgerLifecycle:
+    """Round-2 review: the committed ledger must track RUNNING rules
+    through every lifecycle path, not just create-triggered ones."""
+
+    def _registry(self):
+        from ekuiper_tpu.server.rule_manager import RuleRegistry
+
+        store = kv.get_store()
+        _mk_stream(store, "led", "led/t")
+        return RuleRegistry(store), store
+
+    def _dev_rule(self, rid, triggered=True):
+        return {"id": rid,
+                "sql": ("SELECT deviceId, avg(v) AS a FROM led "
+                        "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"),
+                "actions": [{"nop": {}}],
+                "options": {"triggered": triggered}}
+
+    def test_recover_rebuilds_ledger(self):
+        reg, store = self._registry()
+        ctl = control.install(lambda: [], start=False)
+        reg.create(self._dev_rule("lr1"))
+        billed = ctl.committed_us_per_s()
+        assert billed > 0
+        # "restart": fresh controller (empty ledger) + recover
+        ctl2 = control.install(lambda: [], start=False)
+        assert ctl2.committed_us_per_s() == 0.0
+        reg.recover()
+        assert ctl2.committed_us_per_s() == pytest.approx(billed)
+        reg.stop_all()
+
+    def test_untriggered_start_bills_and_stop_releases(self):
+        reg, store = self._registry()
+        ctl = control.install(lambda: [], start=False)
+        reg.create(self._dev_rule("lu1", triggered=False))
+        assert ctl.committed_us_per_s() == 0.0  # defined, not running
+        reg.start("lu1")
+        assert ctl.committed_us_per_s() > 0  # running -> billed
+        reg.stop("lu1")
+        assert ctl.committed_us_per_s() == 0.0  # stopped -> released
+        reg.stop_all()
+
+    def test_dequeue_regates_live_hbm(self, monkeypatch):
+        from ekuiper_tpu.observability import memwatch
+
+        started = []
+        unqueued = []
+        ctl = control.install(lambda: [], start_fn=started.append,
+                              unqueue_fn=unqueued.append, start=False)
+        monkeypatch.setenv("KUIPER_HBM_BUDGET_MB", "1")
+        # enqueue-time snapshot was UNDER budget...
+        ctl.enqueue("hq1", {"reason": "storm", "price": {
+            "fold_us_per_s": 0.0, "hbm_current_bytes": 0,
+            "hbm_projected_bytes": 0}})
+        # ...but HBM grew past it during the queue period
+        owner = object.__new__(Node)
+        memwatch.register("hb_blob", owner,
+                          lambda o: 8 * 1024 * 1024, rule="x")
+        ctl.tick()
+        assert started == []  # NOT started over budget
+        assert ctl.queued("hq1") is None
+        assert ctl.admission_counts()["reject"] == 1
+        assert unqueued == ["hq1"]  # persisted slot cleanup hook fired
+
+    def test_queue_full_downgrade_counts_reject_not_queue(
+            self, monkeypatch):
+        from ekuiper_tpu.server.rest import RestApi
+
+        api = RestApi(kv.get_store())
+        api.health_evaluator.stop()
+        api.qos_controller.stop()
+        _mk_stream(api.store, "ledf", "ledf/t")
+        ctl = control.controller()
+        for i in range(control.ADMISSION_QUEUE_CAP):
+            assert ctl.enqueue(f"filler{i}", {"reason": "x", "price": {}})
+        queue_count = ctl.admission_counts()["queue"]
+        monkeypatch.setenv("KUIPER_ADMISSION_DEFER_BREACHING", "1")
+        ctl._verdicts_fn = lambda: {"x": {"state": "breaching"}}
+        code, out = api.dispatch("POST", "/rules", {
+            "id": "overflowed", "sql": "SELECT deviceId FROM ledf",
+            "actions": [{"nop": {}}]}, {})
+        assert code == 429
+        counts = ctl.admission_counts()
+        assert counts["queue"] == queue_count  # NOT counted as queued
+        assert counts["reject"] == 1
+
+
+def test_parse_qos_class():
+    assert parse_qos_class(None) == "standard"
+    assert parse_qos_class({"qosClass": "LOW"}) == "low"
+    assert parse_qos_class({"qos_class": "critical"}) == "critical"
+    assert parse_qos_class({"qosClass": "goldplated"}) == "standard"

@@ -51,6 +51,10 @@ HEADLINE = (
     ("headline.value", 0.10),
     ("phases.full_pipe.rows_per_sec", 0.15),
     ("phases.full_pipe.e2e_p99_ms", 0.50),
+    # QoS churn soak (ISSUE 9): healthy-rule emit p99 under sustained
+    # rule churn + skew shifts + backpressure — same loose tail
+    # tolerance as the full-pipe p99 (one GC pause moves a p99)
+    ("phases.churn_soak.soak_p99_ms", 0.50),
 )
 
 #: default noise tolerance for every non-headline comparison

@@ -1,0 +1,373 @@
+#!/usr/bin/env python
+"""chaos — fault-injection churn harness for the QoS control plane.
+
+Drives an in-process engine (RestApi over the memory connector) through
+the failure shapes ROADMAP item 5 names, so the `churn_soak` bench phase
+and the control-plane tests exercise the SAME storm:
+
+- **rule churn**: create/update/delete cycles over a fleet of host-path
+  rules (hundreds over a soak) while a small set of device-path workload
+  rules keeps folding — admission control prices every create;
+- **hot-key skew shift**: a zipf-flavored publisher whose hot key moves,
+  the cardinality/imbalance shape that breaks static tuning;
+- **backpressure waves**: periodic burst publishes that overflow node
+  buffers (drop-oldest) and light up the queue-depth high-water marks;
+- **kill/restore mid-storm**: a hard topo teardown (NO stop-time state
+  save — recovery must come from the last checkpoint barrier) followed
+  by `RuleRegistry.recover()`.
+
+Everything the harness observes comes from the public surfaces (REST
+dispatch, StatManager drop taxonomy, flight recorder, controller
+diagnostics), so a green summary here is the same evidence kuiperdiag
+would collect postmortem.
+
+CLI (a compressed self-contained storm, mostly for manual poking):
+  python tools/chaos.py [--seconds 20] [--churn-rules 40] [--json]
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: the engine's closed drop taxonomy — a reason outside this set is an
+#: UNEXPLAINED drop and fails the soak (utils/metrics.py + node.py +
+#: runtime/control.py shed gate)
+DROP_TAXONOMY = frozenset({
+    "buffer_full", "pane_recycle", "decode_error", "stale_watermark",
+    "shed_qos",
+})
+
+
+class ChaosHarness:
+    """One storm over one in-process RestApi. All rule CRUD goes through
+    REST dispatch so admission control prices it exactly as production
+    traffic would."""
+
+    def __init__(self, api, stream: str = "chaos",
+                 topic: str = "chaos/t", seed: int = 23) -> None:
+        self.api = api
+        self.stream = stream
+        self.topic = topic
+        self.rng = random.Random(seed)
+        self.counters: Dict[str, int] = {
+            "created": 0, "updated": 0, "deleted": 0,
+            "create_rejected": 0, "create_queued": 0, "create_failed": 0,
+        }
+        self._churn_ids: List[str] = []
+        self._churn_seq = 0
+
+    # ------------------------------------------------------------- setup
+    def ensure_stream(self) -> None:
+        code, out = self.api.dispatch("POST", "/streams", {
+            "sql": f"CREATE STREAM {self.stream} "
+                   "(deviceId STRING, v FLOAT) "
+                   f'WITH (DATASOURCE="{self.topic}", TYPE="memory", '
+                   'FORMAT="JSON")'}, {})
+        if code not in (200, 201) and "already" not in str(out):
+            raise RuntimeError(f"stream create failed: {out}")
+
+    def _create(self, rule_json: Dict[str, Any]) -> Optional[str]:
+        code, out = self.api.dispatch("POST", "/rules", rule_json, {})
+        if code in (200, 201):
+            self.counters["created"] += 1
+            if isinstance(out, dict) and out.get("admission") == "queued":
+                self.counters["create_queued"] += 1
+            return rule_json["id"]
+        if code == 429:
+            # structured admission rejection — the decision payload is
+            # the contract under test (reason + price, not a bare error)
+            self.counters["create_rejected"] += 1
+            adm = (out or {}).get("admission") or {}
+            if not adm.get("reason") or "price" not in adm:
+                raise RuntimeError(
+                    f"unstructured admission rejection: {out}")
+            return None
+        self.counters["create_failed"] += 1
+        raise RuntimeError(f"rule create failed ({code}): {out}")
+
+    def workload_rules(self, n: int = 4, window_s: int = 1,
+                       slo_p99_ms: int = 5000) -> List[str]:
+        """Correlated device-path rules (they share one pane fold, so N
+        rules cost ~1 compile on CPU) with a healthy SLO."""
+        ids = []
+        for i in range(n):
+            rid = f"chaos_work{i}"
+            self._create({
+                "id": rid,
+                "sql": ("SELECT deviceId, avg(v) AS a, count(*) AS c "
+                        f"FROM {self.stream} GROUP BY deviceId, "
+                        f"TUMBLINGWINDOW(ss, {window_s})"),
+                "actions": [{"nop": {}}],
+                # critical: the workload fleet is the "healthy rules
+                # must HOLD their p99" control group — exempt from
+                # shedding, relieved by the victim/churn sheds instead
+                "options": {"qosClass": "critical",
+                            "slo": {"latencyP99Ms": slo_p99_ms}},
+            })
+            ids.append(rid)
+        return ids
+
+    def victim_rule(self, rid: str = "chaos_victim") -> str:
+        """A private device rule with an unmeetable SLO (p99 <= 1ms) and
+        the `low` qos class: it WILL breach under load, and the control
+        plane must shed ITS input while the workload rules hold."""
+        self._create({
+            "id": rid,
+            "sql": ("SELECT deviceId, avg(v) AS a FROM "
+                    f"{self.stream} GROUP BY deviceId, "
+                    "TUMBLINGWINDOW(ss, 1)"),
+            "actions": [{"nop": {}}],
+            # bufferLength 2: under storm load its queues overflow
+            # constantly, so DROP burn breaches it deterministically
+            # even when its (compile-delayed) window emissions are too
+            # sparse for the latency windows to accrue consecutively
+            "options": {"sharedFold": False, "qosClass": "low",
+                        "bufferLength": 2,
+                        "slo": {"latencyP99Ms": 1, "target": 0.99,
+                                "maxDropRatio": 0.00001}},
+        })
+        return rid
+
+    def checkpoint_rule(self, rid: str = "chaos_ckpt") -> str:
+        """qos=1 rule whose state survives the hard kill through the
+        checkpoint path (not the graceful stop-time save)."""
+        self._create({
+            "id": rid,
+            "sql": (f"SELECT deviceId, count(*) AS c FROM {self.stream} "
+                    "GROUP BY deviceId, TUMBLINGWINDOW(ss, 2)"),
+            "actions": [{"nop": {}}],
+            # e2e of a 2s window is ~2s by construction — the SLO must
+            # bound the TAIL beyond that, not the window dwell itself
+            "options": {"qos": 1, "checkpointInterval": 1000,
+                        "qosClass": "high",
+                        "slo": {"latencyP99Ms": 10_000}},
+        })
+        return rid
+
+    # ------------------------------------------------------------- churn
+    def churn_step(self, target_live: int = 40) -> None:
+        """One create/update/delete step over the host-path churn fleet,
+        biased to keep ~target_live rules alive."""
+        op = self.rng.random()
+        if not self._churn_ids or (op < 0.5
+                                   and len(self._churn_ids) < target_live):
+            self._churn_seq += 1
+            rid = f"chaos_churn{self._churn_seq}"
+            thr = round(self.rng.uniform(-1.0, 1.0), 3)
+            if self._create({
+                "id": rid,
+                "sql": (f"SELECT deviceId, v FROM {self.stream} "
+                        f"WHERE v > {thr}"),
+                "actions": [{"nop": {}}],
+                "options": {"qosClass": "low"},
+            }) is not None:
+                self._churn_ids.append(rid)
+        elif op < 0.75 and self._churn_ids:
+            rid = self.rng.choice(self._churn_ids)
+            thr = round(self.rng.uniform(-1.0, 1.0), 3)
+            code, out = self.api.dispatch("PUT", f"/rules/{rid}", {
+                "id": rid,
+                "sql": (f"SELECT deviceId, v FROM {self.stream} "
+                        f"WHERE v > {thr}"),
+                "actions": [{"nop": {}}],
+                "options": {"qosClass": "low"},
+            }, {})
+            if code == 200:
+                self.counters["updated"] += 1
+        else:
+            rid = self._churn_ids.pop(
+                self.rng.randrange(len(self._churn_ids)))
+            code, _out = self.api.dispatch("DELETE", f"/rules/{rid}",
+                                           None, {})
+            if code == 200:
+                self.counters["deleted"] += 1
+
+    # ---------------------------------------------------------- publishing
+    def publish_skew(self, rows: int, hot_key: int, n_keys: int = 256,
+                     hot_share: float = 0.8) -> None:
+        """One skewed drain: `hot_share` of rows hit `hot_key`, the rest
+        spread uniformly — shift `hot_key` between calls to model a skew
+        shift."""
+        from ekuiper_tpu.io import memory as mem
+
+        payloads = []
+        for _ in range(rows):
+            if self.rng.random() < hot_share:
+                k = hot_key
+            else:
+                k = self.rng.randrange(n_keys)
+            payloads.append(json.dumps({
+                "deviceId": f"dev_{k}",
+                "v": round(self.rng.gauss(0.0, 1.0), 3),
+            }).encode())
+        mem.publish(self.topic, payloads)
+
+    def backpressure_wave(self, rows: int = 20_000,
+                          n_keys: int = 256) -> None:
+        """A burst big enough to overflow 1024-deep node buffers — the
+        drop-oldest path must absorb it WITH taxonomy reasons."""
+        self.publish_skew(rows, hot_key=self.rng.randrange(n_keys),
+                          n_keys=n_keys, hot_share=0.3)
+
+    # -------------------------------------------------------- kill/restore
+    def hard_kill(self) -> List[str]:
+        """Tear every live topo down WITHOUT the graceful stop-time state
+        save — the crash shape. Returns the rule ids that were running
+        (recover() must bring them back from their checkpoints)."""
+        from ekuiper_tpu.runtime.rule import RunState
+
+        running = []
+        for entry in self.api.rules.list():
+            rid = entry["id"]
+            rs = self.api.rules.state(rid)
+            if rs is None or rs.topo is None:
+                continue
+            running.append(rid)
+            rs._stop_supervision.set()
+            topo = rs.topo
+            topo.close()  # node teardown only — NO save_state_now()
+            with rs._lock:
+                rs.topo = None
+                rs.state = RunState.STOPPED
+        return running
+
+    def recover(self, expect_running: List[str],
+                timeout_s: float = 20.0) -> Dict[str, Any]:
+        """Boot-style recovery over the same store; waits for every
+        expected rule's topo to come back."""
+        self.api.rules.recover()
+        deadline = time.time() + timeout_s
+        missing = list(expect_running)
+        while missing and time.time() < deadline:
+            missing = [rid for rid in expect_running
+                       if (self.api.rules.state(rid) is None
+                           or self.api.rules.state(rid).topo is None)]
+            time.sleep(0.05)
+        return {"expected": len(expect_running),
+                "recovered": len(expect_running) - len(missing),
+                "missing": missing}
+
+    # ------------------------------------------------------------- summary
+    def drops_by_reason(self) -> Dict[str, Dict[str, int]]:
+        """{rule: {reason: n}} across every live node (own + shared)."""
+        out: Dict[str, Dict[str, int]] = {}
+        for entry in self.api.rules.list():
+            rid = entry["id"]
+            rs = self.api.rules.state(rid)
+            if rs is None or rs.topo is None:
+                continue
+            agg: Dict[str, int] = {}
+            nodes = list(rs.topo.all_nodes())
+            for st, _ in rs.topo.live_shared():
+                nodes.extend(getattr(st, "nodes", []))
+            for n in nodes:
+                for reason, c in n.stats.dropped.items():
+                    agg[reason] = agg.get(reason, 0) + c
+            if agg:
+                out[rid] = agg
+        return out
+
+    def unexplained_drops(self) -> Dict[str, Dict[str, int]]:
+        """Drop counts whose reason is outside the taxonomy — must be
+        empty for a green soak."""
+        bad: Dict[str, Dict[str, int]] = {}
+        for rid, agg in self.drops_by_reason().items():
+            unknown = {r: c for r, c in agg.items()
+                       if r not in DROP_TAXONOMY and c > 0}
+            if unknown:
+                bad[rid] = unknown
+        return bad
+
+    def e2e_p99_ms(self, rule_ids: List[str]) -> Dict[str, float]:
+        out = {}
+        for rid in rule_ids:
+            rs = self.api.rules.state(rid)
+            if rs is None or rs.topo is None:
+                continue
+            snap = rs.topo.e2e_hist.snapshot()
+            if snap.get("count"):
+                out[rid] = float(snap["p99"])
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        from ekuiper_tpu.runtime import control
+
+        ctl = control.controller()
+        out: Dict[str, Any] = {
+            "churn": dict(self.counters),
+            "live_rules": len(self.api.rules.list()),
+            "drops_by_reason": self.drops_by_reason(),
+            "unexplained_drops": self.unexplained_drops(),
+        }
+        if ctl is not None:
+            out["admission"] = ctl.admission_counts()
+            out["shed_totals"] = {
+                f"{rid}|{qos}": n
+                for (rid, qos), n in sorted(ctl.shed_totals().items())}
+            out["autosize_events"] = ctl.autosize_events
+        return out
+
+
+def _cli() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seconds", type=float, default=20.0)
+    ap.add_argument("--churn-rules", type=int, default=40)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # health cadence must be >= the workload window (1s): the burn
+    # windows decay between ticks, and a tick that lands between two
+    # window emissions sees zero samples -> burn 0 -> the FSM never
+    # accrues consecutive breaching ticks
+    os.environ.setdefault("KUIPER_HEALTH_INTERVAL_MS", "1500")
+    os.environ.setdefault("KUIPER_CONTROL_INTERVAL_MS", "500")
+    from ekuiper_tpu.server.rest import RestApi
+    from ekuiper_tpu.store import kv
+
+    api = RestApi(kv.get_store())
+    h = ChaosHarness(api)
+    h.ensure_stream()
+    work = h.workload_rules(4)
+    victim = h.victim_rule()
+    ck = h.checkpoint_rule()
+    deadline = time.time() + args.seconds
+    hot = 0
+    last_shift = time.time()
+    killed_at = time.time() + args.seconds / 2
+    killed = False
+    while time.time() < deadline:
+        h.churn_step(target_live=args.churn_rules)
+        h.publish_skew(2000, hot_key=hot)
+        if time.time() - last_shift >= 5.0:
+            hot = (hot + 17) % 256  # one discrete shift per interval
+            last_shift = time.time()
+        if not killed and time.time() >= killed_at:
+            running = h.hard_kill()
+            rec = h.recover(running)
+            print(f"# kill/restore: {rec}", file=sys.stderr)
+            killed = True
+        time.sleep(0.05)
+    out = h.summary()
+    out["e2e_p99_ms"] = h.e2e_p99_ms(work + [victim, ck])
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+    else:
+        print(json.dumps(out, default=str))
+    ok = not out["unexplained_drops"]
+    # hard exit (kuiperdiag --smoke precedent): daemon node threads +
+    # live jax state can segfault interpreter teardown after the verdict
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    sys.exit(_cli())

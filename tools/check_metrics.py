@@ -143,9 +143,21 @@ def _synthetic_scrape() -> str:
 
     hev = health.install(lambda: [("lint_rule", Topo(), {})], start=False)
     hev.tick()
+    # QoS control plane (runtime/control.py): an installed controller
+    # with one decision of each kind, a shed total, and an autosize
+    # event so kuiper_admission_total / kuiper_shed_total /
+    # kuiper_autosize_events_total all render samples
+    from ekuiper_tpu.runtime import control
+
+    ctl = control.install(lambda: [], start=False)
+    for decision in ("accept", "reject", "queue"):
+        ctl.note_admission(decision)
+    ctl._shed_totals[("lint_rule", "standard")] = 42
+    ctl.autosize_events = 1
     try:
         return render(Registry())
     finally:
+        control.reset()
         health.reset()
         nodes_sharedfold._stores.pop("__lint__", None)
         devwatch.registry().clear()

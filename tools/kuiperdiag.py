@@ -47,7 +47,8 @@ Post = Callable[[str, dict], Tuple[int, Any]]
 
 #: sections (beyond per-rule detail) a valid bundle must carry
 REQUIRED_SECTIONS = ("server", "rules", "metrics", "events", "memory",
-                     "xla", "kernels", "health", "configs", "versions")
+                     "xla", "kernels", "health", "control", "configs",
+                     "versions")
 
 
 def _versions() -> Dict[str, Any]:
@@ -114,6 +115,7 @@ def collect(fetch: Fetch, events_limit: int = 1000,
     bundle["xla"] = get("/diagnostics/xla")
     bundle["kernels"] = get("/diagnostics/kernels")
     bundle["health"] = get("/diagnostics/health")
+    bundle["control"] = get("/diagnostics/control")
     bundle["configs"] = get("/configs")
     if profile_ms > 0 and post is not None:
         body = {"duration_ms": profile_ms}
@@ -268,6 +270,15 @@ def smoke() -> int:
         if not (bundle.get("rule_details", {}).get(rid, {})
                 .get("health", {}).get("state")):
             problems.append(f"rule_details[{rid}].health.state")
+        # QoS control plane: the section must carry the admission
+        # decision counters and the shed/autosize views (all may be
+        # zero this early — shape is what a postmortem needs)
+        ctl = bundle.get("control") or {}
+        decisions = (ctl.get("admission") or {}).get("decisions")
+        if not isinstance(decisions, dict) or "accept" not in decisions:
+            problems.append("control.admission.decisions")
+        if "shedding" not in ctl or "autosize" not in ctl:
+            problems.append("control.shedding/autosize")
         # kernel observatory: the section must name the device and carry
         # the site list (sampling may legitimately be empty this early)
         kern = bundle.get("kernels") or {}
