@@ -747,11 +747,13 @@ def _churn_soak_main() -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     # fast control cadence: both intervals are read at module import,
     # which happens below — this subprocess is fresh
-    # health cadence >= the 1s workload window: a tick between two
-    # window emissions sees zero new e2e samples, the decayed burn
-    # windows read 0, and the FSM never accrues consecutive breaching
-    # ticks (the flap is by design — burn is a rate over the tick)
-    os.environ.setdefault("KUIPER_HEALTH_INTERVAL_MS", "1500")
+    # SUB-SECOND health cadence, below the 1s workload window: the burn
+    # windows are sample-count-aware now (observability/health.py
+    # _weighted_burn + observation-indexed decay), so a tick landing
+    # between two window emissions holds its evidence instead of
+    # decaying to zero and flapping the verdict — the 1500ms pin this
+    # phase used to need is exactly the flap this soak now regresses
+    os.environ.setdefault("KUIPER_HEALTH_INTERVAL_MS", "900")
     os.environ.setdefault("KUIPER_CONTROL_INTERVAL_MS", "500")
     child_budget = float(os.environ.get("BENCH_CHILD_BUDGET_S", "0") or 0)
     dog = PhaseWatchdog()
@@ -764,7 +766,12 @@ def _churn_soak_main() -> None:
 
     mem.reset()
     api = RestApi(kv.get_store())
-    h = ChaosHarness(api)
+    # pool=2: the device-path rules ride POOLED sources so the storm
+    # drives the decode pool + ingest ring end-to-end and the autosize
+    # actuator has something real to resize (inline memory sources are
+    # contractually never converted — the old soak could not see a
+    # single autosize event)
+    h = ChaosHarness(api, pool=2)
     h.ensure_stream()
     work = h.workload_rules(4, window_s=1, slo_p99_ms=5000)
     victim = h.victim_rule()
@@ -878,7 +885,15 @@ def _churn_soak_main() -> None:
            admission_structured=admission_structured,
            recovered=recover_stats.get("recovered", 0),
            recover_expected=recover_stats.get("expected", 0),
-           autosize_events=s.get("autosize_events", 0))
+           pooled_sources=True,
+           autosize_events=s.get("autosize_events", 0),
+           # the actions themselves (node, grow/shrink, applied sizes):
+           # the evidence the autosize path actually ran end-to-end
+           autosize_actions=[
+               {k: v for k, v in a.items() if k != "ts_ms"}
+               for a in ((api.qos_controller.diagnostics()
+                          .get("autosize") or {}).get("recent") or [])
+           ][-8:])
     dog.disarm()
     # daemon node threads + live jax state can segfault interpreter
     # teardown; the records are flushed — exit hard (kuiperdiag
@@ -1317,6 +1332,29 @@ def _kernel_fields() -> dict:
     return kernwatch.bench_summary()
 
 
+def _jitcert_fields() -> dict:
+    """The compile-contract verdict for the phase (observability/
+    jitcert.py): every devwatch-observed signature must sit inside the
+    registered certificates. `clean=False` names the escapees — the
+    acceptance gate for new jit sites (ISSUE 10) is zero observed
+    signatures outside the certified set on full_pipe and
+    multi_rule_shared."""
+    from ekuiper_tpu.observability import jitcert
+
+    d = jitcert.diff_live()
+    return {
+        "clean": d["clean"],
+        "observed_signatures": d["observed_signatures"],
+        "certified_signatures": d["certified_signatures"],
+        "sites_observed": d["sites_observed"],
+        "sites_open": d["sites_open"],
+        "uncertified": [
+            {"op": u["op"], "rule": u["rule"],
+             "signature": u["signature"][:300]}
+            for u in d["uncertified"][:16]],
+    }
+
+
 def _kernel_split_probe():
     """Device-time decomposition over the jit registry: returns
     `finish() -> dict` computing per-op deltas of sampled dispatch /
@@ -1495,6 +1533,7 @@ def _full_pipe_main() -> None:
                devwatch_overhead=_devwatch_overhead(fused),
                kernwatch_overhead=_kernwatch_overhead(fused),
                kernels=_kernel_fields(),
+               jitcert=_jitcert_fields(),
                compile_count=run_segment.compile_count,
                device_bytes_peak=run_segment.device_bytes_peak,
                stages={"source": _stage_summary(src),
@@ -1567,6 +1606,7 @@ def _full_pipe_contended_main() -> None:
                pool=src.decode_pool_size, shards=src._decode_shards,
                prep_batches=(prep.n_precomputed if prep else 0),
                kernels=_kernel_fields(),
+               jitcert=_jitcert_fields(),
                compile_count=run_segment.compile_count,
                device_bytes_peak=run_segment.device_bytes_peak,
                stages={"source": _stage_summary(src),
@@ -1778,6 +1818,7 @@ def bench_multi_rule_shared(batches, kt_slots) -> None:
            speedup=speedup, fold_dedup_ratio=dedup,
            parity_windows=parity_windows, n_rules=n_rules,
            pane_ms=pane,
+           jitcert=_jitcert_fields(),
            **_health_fields(
                _HealthTopoShim(shared.pipeline_nodes() + entries),
                shared, s_el, rule_id="r0"))

@@ -10,8 +10,11 @@ renders a VERDICT per rule: *this rule is breaching its SLO, the
 bottleneck is the upload stage, and event time is falling behind*.
 
 The `HealthEvaluator` ticks on the engine clock (mock-clock friendly:
-tests drive `tick()` directly or advance the clock) and computes, per
-running rule:
+tests drive `tick()` directly or advance the clock) — the burn windows
+are sample-count-aware (observation-indexed decay bounded by
+IDLE_HOLD_TICKS, evidence-weighted burns via `_weighted_burn`), so
+sub-second cadences judge slow-emitting rules without verdict flap —
+and computes, per running rule:
 
 - **SLO burn rate** — multi-window (fast/slow) burn against a per-rule
   latency + drop SLO. Each tick the delta of the rule's cumulative e2e
@@ -142,6 +145,14 @@ BREACH_BURN = 6.0
 #: geometric window decay per tick: fast ≈ 2-tick memory, slow ≈ 8-tick
 FAST_DECAY = 0.5
 SLOW_DECAY = 0.875
+#: evidence-hold bound: zero-sample ticks HOLD the burn windows (a
+#: sub-second evaluator must not flush a slow emitter's evidence
+#: between window emissions), but only this many in a row — past it
+#: the decay resumes so a rule whose traffic STOPS entirely (dead
+#: broker, disconnected source) ages back to healthy instead of
+#: freezing at its last verdict forever (which would also permanently
+#: trip KUIPER_ADMISSION_DEFER_BREACHING)
+IDLE_HOLD_TICKS = 16
 #: default evaluator cadence (engine clock)
 DEFAULT_INTERVAL_MS = int(os.environ.get("KUIPER_HEALTH_INTERVAL_MS",
                                          "5000") or 5000)
@@ -156,7 +167,7 @@ class _RuleTrack:
                  "prev_queue", "prev_kern", "fast_drops", "slow_drops",
                  "fast_in", "slow_in", "state", "state_since_ms",
                  "ticks_in_state", "up_pend", "up_level", "down_pend",
-                 "verdict", "peak_burn")
+                 "verdict", "peak_burn", "lat_idle", "drop_idle")
 
     def __init__(self, now_ms: int) -> None:
         self.fast_hist = LatencyHistogram()
@@ -177,6 +188,8 @@ class _RuleTrack:
         self.down_pend = 0
         self.verdict: Optional[Dict[str, Any]] = None
         self.peak_burn = 0.0
+        self.lat_idle = 0   # consecutive zero-sample ticks (latency)
+        self.drop_idle = 0  # consecutive zero-traffic ticks (drops)
 
 
 def _viol_fraction(hist: LatencyHistogram, bound_ms: int) -> Tuple[float, int]:
@@ -188,6 +201,21 @@ def _viol_fraction(hist: LatencyHistogram, bound_ms: int) -> Tuple[float, int]:
     if count <= 0:
         return 0.0, 0
     return (count - cum[0]) / count, count
+
+
+def _weighted_burn(violations: float, mass: float, budget: float) -> float:
+    """Sample-count-aware burn: `violations` bad samples out of `mass`
+    observed, against an error budget. The violating fraction is taken
+    over at least the budget's own resolution (1/budget samples): a
+    window too sparse to statistically resolve the budget cannot claim
+    a full-rate burn off one or two samples — the exact flap churn_soak
+    had to pin KUIPER_HEALTH_INTERVAL_MS=1500 to dodge (a sub-second
+    evaluator tick between two window emissions saw a 1-sample window
+    and swung the verdict on it). Unseen samples are presumed good —
+    burn under-claims on thin evidence, never over-claims."""
+    budget = max(budget, 1e-6)
+    n_min = 1.0 / budget + 1.0
+    return (violations / max(mass, n_min)) / budget
 
 
 class HealthEvaluator:
@@ -326,6 +354,7 @@ class HealthEvaluator:
 
         # ---- latency window delta → fast/slow burn
         hist = getattr(topo, "e2e_hist", None)
+        delta_n = 0
         if hist is not None:
             cur = hist.bucket_counts()
             prev = tr.prev_e2e
@@ -336,17 +365,30 @@ class HealthEvaluator:
             else:
                 delta = [max(c - p, 0) for c, p in zip(cur, prev)]
             tr.prev_e2e = cur
+            delta_n = sum(delta)
             tr.fast_hist.record_bucket_counts(delta)
             tr.slow_hist.record_bucket_counts(delta)
         budget = max(1.0 - slo["target"], 1e-6)
         bound = slo["latency_p99_ms"]
         frac_f, n_f = _viol_fraction(tr.fast_hist, bound)
         frac_s, n_s = _viol_fraction(tr.slow_hist, bound)
-        lat_burn_f = frac_f / budget
-        lat_burn_s = frac_s / budget
-        # snapshot the window percentiles, then decay toward next tick
-        fast_snap = tr.fast_hist.snapshot_and_decay(self.fast_decay)
-        slow_snap = tr.slow_hist.snapshot_and_decay(self.slow_decay)
+        # burn is weighted by the samples each window actually observed
+        # (sparse windows cannot resolve the budget — see _weighted_burn)
+        lat_burn_f = _weighted_burn(frac_f * n_f, n_f, budget)
+        lat_burn_s = _weighted_burn(frac_s * n_s, n_s, budget)
+        # snapshot the window percentiles, then decay toward next tick —
+        # ONLY on ticks that observed samples: the windows index the last
+        # N observations, not wall ticks, so an evaluator outpacing a
+        # slow-emitting rule holds its evidence instead of flushing it
+        # to zero between emissions (the verdict-flap class). The hold
+        # is BOUNDED (IDLE_HOLD_TICKS): a rule whose traffic stops
+        # entirely resumes decaying and ages back to healthy
+        tr.lat_idle = 0 if delta_n else tr.lat_idle + 1
+        hold_lat = 0 < tr.lat_idle <= IDLE_HOLD_TICKS
+        lat_decay_f = 1.0 if hold_lat else self.fast_decay
+        lat_decay_s = 1.0 if hold_lat else self.slow_decay
+        fast_snap = tr.fast_hist.snapshot_and_decay(lat_decay_f)
+        slow_snap = tr.slow_hist.snapshot_and_decay(lat_decay_s)
 
         # ---- node walk: stage deltas, drops, queue peaks
         nodes = list(getattr(topo, "all_nodes", lambda: [])())
@@ -443,21 +485,27 @@ class HealthEvaluator:
             queue_peaks[node.name] = peak
         tr.prev_nodes = new_prev
 
-        # ---- drop burn (same fast/slow decayed windows, scalar form)
+        # ---- drop burn (same fast/slow decayed windows, scalar form,
+        # same sample-count weighting and observation-indexed decay)
         drops_d = max(drops_d, 0)
         ins_d = max(ins_d, 0)
         tr.fast_drops += drops_d
         tr.slow_drops += drops_d
         tr.fast_in += ins_d
         tr.slow_in += ins_d
+        drop_budget = max(slo["max_drop_ratio"], 1e-6)
         drop_ratio_f = tr.fast_drops / max(tr.fast_in, tr.fast_drops, 1.0)
         drop_ratio_s = tr.slow_drops / max(tr.slow_in, tr.slow_drops, 1.0)
-        drop_burn_f = drop_ratio_f / max(slo["max_drop_ratio"], 1e-6)
-        drop_burn_s = drop_ratio_s / max(slo["max_drop_ratio"], 1e-6)
-        tr.fast_drops *= self.fast_decay
-        tr.fast_in *= self.fast_decay
-        tr.slow_drops *= self.slow_decay
-        tr.slow_in *= self.slow_decay
+        drop_burn_f = _weighted_burn(
+            tr.fast_drops, max(tr.fast_in, tr.fast_drops, 1.0), drop_budget)
+        drop_burn_s = _weighted_burn(
+            tr.slow_drops, max(tr.slow_in, tr.slow_drops, 1.0), drop_budget)
+        tr.drop_idle = 0 if (drops_d or ins_d) else tr.drop_idle + 1
+        if not 0 < tr.drop_idle <= IDLE_HOLD_TICKS:
+            tr.fast_drops *= self.fast_decay
+            tr.fast_in *= self.fast_decay
+            tr.slow_drops *= self.slow_decay
+            tr.slow_in *= self.slow_decay
 
         # ---- bottleneck attribution + backpressure direction
         total_us = sum(stage_us.values())
@@ -615,6 +663,9 @@ class HealthEvaluator:
                 "window_fast": fast_snap, "window_slow": slow_snap,
                 "violating_fast": round(frac_f, 4) if n_f else 0.0,
                 "violating_slow": round(frac_s, 4) if n_s else 0.0,
+                # window evidence mass — what the burns were weighted by
+                "samples_fast": n_f, "samples_slow": n_s,
+                "tick_samples": delta_n,
             },
             "drops": {
                 "tick_dropped": drops_d, "tick_offered": ins_d,

@@ -153,6 +153,13 @@ class DeviceGroupBy:
             self._hh_fin = watched_jit(self._hh_finalize_impl,
                                        op=self._watch_op("hh_finalize"),
                                        kind="boundary")
+        # bind this kernel to its compile contract: jitcert derives the
+        # closed signature set every site above may be traced with, and
+        # the runtime diff (bench rounds, /diagnostics/xla) holds the
+        # observed devwatch signatures to it
+        from ..observability import jitcert
+
+        jitcert.register_kernel(self)
 
     #: kuiper_xla_* metric prefix for this kernel's jit sites; subclasses
     #: override (multirule / sharded) so recompiles attribute to the

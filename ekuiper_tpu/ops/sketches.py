@@ -222,6 +222,19 @@ def hh_candidates(hh, k2: int):
 
 
 # ----------------------------------------------------------------- count-min
+#: pow-2 pad floor for count-min value batches — one executable serves
+#: every batch up to the floor, doublings cover the rest (jitcert
+#: certifies the ladder as this site's closed signature set)
+SKETCH_PAD_FLOOR = 256
+
+
+def _pad_pow2(n: int) -> int:
+    b = SKETCH_PAD_FLOOR
+    while b < n:
+        b <<= 1
+    return b
+
+
 class CountMinSketch:
     """Window-level device count-min sketch with host candidate tracking for
     heavy hitters (top-k most frequent values).
@@ -230,6 +243,9 @@ class CountMinSketch:
     Host: candidate set of distinct values seen (bounded), whose estimated
     counts are read from the sketch at emit time.
     """
+
+    #: jitcert/devwatch site family for this kernel's jit sites
+    watch_prefix = "sketch"
 
     def __init__(self, depth: int = 4, width: int = 8192, max_candidates: int = 4096) -> None:
         import jax
@@ -241,7 +257,7 @@ class CountMinSketch:
         self.counts = jnp.zeros((depth, width), dtype=jnp.float32)
         self.candidates: dict = {}
         from ..observability.devwatch import watched_jit
-        from ..observability import memwatch
+        from ..observability import jitcert, memwatch
 
         self._update = watched_jit(self._update_impl, op="sketch.update",
                                    donate_argnums=(0,))
@@ -252,6 +268,7 @@ class CountMinSketch:
         memwatch.register(
             "sketch", self,
             lambda sk: int(sk.counts.nbytes) + 96 * len(sk.candidates))
+        jitcert.register_kernel(self)
 
     def _hashes(self, values):
         import jax.numpy as jnp
@@ -281,9 +298,20 @@ class CountMinSketch:
         import jax.numpy as jnp
 
         arr = np.asarray(values, dtype=np.float32)
-        v = jnp.asarray(arr)
-        w = jnp.ones(len(values), dtype=jnp.float32)
-        self.counts = self._update(self.counts, v, w)
+        n = len(arr)
+        # value batches pad to the next power of two with weight-0 rows
+        # (scatter-add of 0 is the identity), so this site's signature
+        # set is the closed pad ladder jitcert certifies — raw lengths
+        # would compile one executable per distinct batch size, the
+        # exact storm class devwatch exists to flag. Candidate tracking
+        # below reads arr[:n]: the 0.0 pad rows are device-only filler
+        # and must never become a phantom candidate value.
+        b = _pad_pow2(n)
+        padded = np.pad(arr, (0, b - n)) if b > n else arr
+        w = np.zeros(b, dtype=np.float32)
+        w[:n] = 1.0
+        self.counts = self._update(self.counts, jnp.asarray(padded),
+                                   jnp.asarray(w))
         new = [
             float(x) for x in np.unique(arr) if float(x) not in self.candidates
         ]
@@ -301,17 +329,27 @@ class CountMinSketch:
                         count=len(self.candidates)),
             np.asarray(new, dtype=np.float32),
         ])
-        ests = np.asarray(self._query(self.counts, jnp.asarray(cand)))
+        ests = self._query_padded(cand)
         keep = np.argsort(-ests)[: self.max_candidates]
         self.candidates = {float(cand[i]): True for i in keep}
+
+    def _query_padded(self, cand: np.ndarray) -> np.ndarray:
+        """Point-query estimates for `cand`, padded to the certified
+        pow-2 ladder (pad rows are sliced off the result)."""
+        import jax.numpy as jnp
+
+        n = len(cand)
+        b = _pad_pow2(n)
+        if b > n:
+            cand = np.pad(cand, (0, b - n))
+        return np.asarray(self._query(self.counts,
+                                      jnp.asarray(cand)))[:n]
 
     def heavy_hitters(self, k: int):
         if not self.candidates:
             return []
         cand = np.fromiter(self.candidates.keys(), dtype=np.float32)
-        import jax.numpy as jnp
-
-        ests = np.asarray(self._query(self.counts, jnp.asarray(cand)))
+        ests = self._query_padded(cand)
         order = np.argsort(-ests)[:k]
         return [(float(cand[i]), float(ests[i])) for i in order]
 

@@ -47,6 +47,9 @@ Configuration (all read at decision time, so tests/bench set per-case):
                                         exceeds it (0 = off)
   KUIPER_ADMISSION_FOLD_BUDGET_US_PER_S reject when the committed fold
                                         ledger + price exceeds it (0=off)
+  KUIPER_ADMISSION_SIG_BUDGET           reject when the candidate's
+                                        jitcert-certified signature count
+                                        exceeds it (0 = off)
   KUIPER_ADMISSION_DEFER_BREACHING      queue new rules while >= N rules
                                         are breaching (0 = off)
   KUIPER_ADMISSION_DEFER_STORMS=0       stop queueing on compile storms
@@ -136,6 +139,14 @@ def price_rule(rule, store) -> Dict[str, Any]:
         "hbm_current_bytes": 0,
         "hbm_trend_bytes_per_min": 0.0,
         "compile_storms_total": 0,
+        # compile load priced STATICALLY off the jitcert certificate:
+        # the closed signature set this rule's kernel may trace at its
+        # construction capacity (observability/jitcert.py) — admission
+        # no longer waits for devwatch's live storm edge to learn a
+        # candidate is compile-heavy. None = UNKNOWN (pricing failed /
+        # unpriceable plan): gates treat unknown as compile load, so an
+        # estimation failure can never open the storm-bypass
+        "certified_new_signatures": None,
     }
     from ..observability import devwatch, memwatch
     from ..planner import sharing
@@ -175,6 +186,7 @@ def price_rule(rule, store) -> Dict[str, Any]:
         if plan is None:
             price["path"] = "host"
             price["fold_us_per_s"] = round(HOST_BATCH_US * batches_per_s, 1)
+            price["certified_new_signatures"] = 0  # no device kernel
         else:
             n_specs = len(plan.specs)
             explain = {}
@@ -186,16 +198,37 @@ def price_rule(rule, store) -> Dict[str, Any]:
             if share.get("decision") == "shared":
                 # marginal cost of joining the fleet: the emit-combine
                 # overhead the sharing model already estimated — the
-                # fold itself is already being paid for
+                # fold itself is already being paid for, and the store's
+                # executables already exist (0 certified new signatures)
                 price["path"] = "device-shared"
                 price["fold_us_per_s"] = float(
                     (share.get("estimates") or {})
                     .get("emit_overhead_us_per_s", 0.0))
+                price["certified_new_signatures"] = 0
             else:
                 price["path"] = "device-private"
                 price["fold_us_per_s"] = round(
                     (sharing.FOLD_DISPATCH_US
                      + sharing.FOLD_SPEC_US * n_specs) * batches_per_s, 1)
+                try:
+                    from ..observability import jitcert
+
+                    # pane count does not enter: it changes signature
+                    # SHAPES, not the executable count the budget gates
+                    # on (one executable per capacity step either way)
+                    price["certified_new_signatures"] = \
+                        jitcert.estimate_plan_signatures(
+                            plan, 1, opts.micro_batch_rows,
+                            opts.key_slots)
+                except Exception as exc:
+                    # leave the UNKNOWN sentinel: failing open here
+                    # would both disarm the signature budget and route
+                    # a compile-heavy candidate through the storm
+                    # bypass — the exact class the gate exists to defer
+                    logger.warning(
+                        "jitcert pricing failed for rule %s: %s",
+                        rule.id, exc)
+                    price["certify_error"] = str(exc)[:200]
             # projected window-state claim: one f32 slot per key per agg
             # spec, times the pane/staging multiplier (documented in
             # docs/RESILIENCE.md — a bound, not an allocation)
@@ -237,6 +270,22 @@ def _static_gates(price: Dict[str, Any],
                     f"{committed_us_per_s:.0f}us/s already committed "
                     f"exceeds the {fold_budget:.0f}us/s budget "
                     "(KUIPER_ADMISSION_FOLD_BUDGET_US_PER_S)"),
+                "price": price,
+            }
+    sig_budget = int(_env_float("KUIPER_ADMISSION_SIG_BUDGET"))
+    if sig_budget > 0:
+        certified = price.get("certified_new_signatures")
+        # unknown (None) passes THIS gate — rejecting on a pricing
+        # failure would make every unpriceable host rule a 429; the
+        # storm gate below stays conservative for unknowns instead
+        if certified is not None and int(certified) > sig_budget:
+            return {
+                "decision": "reject",
+                "reason": (
+                    f"certified compile surface of {certified} XLA "
+                    f"signatures exceeds the {sig_budget}-signature "
+                    "budget (KUIPER_ADMISSION_SIG_BUDGET; jitcert "
+                    "certificate at construction capacity)"),
                 "price": price,
             }
     return None
@@ -487,11 +536,18 @@ class QoSController:
                     logger.warning(
                         "queued rule %s failed to start: %s", rid, exc)
 
-    def _pressure(self) -> tuple:
+    def _pressure(self, price: Optional[Dict[str, Any]] = None) -> tuple:
         """(defer?, reason) — the transient conditions that QUEUE a new
-        rule instead of accepting or rejecting it outright."""
+        rule instead of accepting or rejecting it outright. A candidate
+        whose jitcert certificate prices ZERO new signatures (shared /
+        host path) adds no compile load and is never storm-deferred;
+        an UNKNOWN count (None — pricing failed) defers like compile
+        load, never bypasses."""
+        certified = (price or {}).get("certified_new_signatures")
+        adds_compile_load = (price is None or certified is None
+                             or int(certified) > 0)
         if os.environ.get("KUIPER_ADMISSION_DEFER_STORMS", "1") != "0" \
-                and self.storm_active():
+                and adds_compile_load and self.storm_active():
             return True, ("an XLA compile storm is active; new compile "
                           "load is deferred until it clears")
         breach_gate = int(_env_float("KUIPER_ADMISSION_DEFER_BREACHING"))
@@ -850,7 +906,7 @@ def admit_rule(rule, store, allow_queue: bool = True) -> Dict[str, Any]:
             committed -= ctl._committed.get(rule.id, 0.0)
     decision = _static_gates(price, max(committed, 0.0))
     if decision is None and ctl is not None and allow_queue:
-        defer, reason = ctl._pressure()
+        defer, reason = ctl._pressure(price)
         if defer:
             decision = {"decision": "queue", "reason": reason,
                         "price": price}

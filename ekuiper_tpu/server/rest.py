@@ -552,12 +552,20 @@ class RestApi:
 
     @staticmethod
     def diagnostics_xla() -> Dict[str, Any]:
-        """GET /diagnostics/xla — per-site compile/cache-hit accounting."""
-        from ..observability import devwatch
+        """GET /diagnostics/xla — per-site compile/cache-hit accounting
+        plus the jitcert compile-contract diff: every observed signature
+        outside a site's certified set is reported individually (an
+        uncertified signature is the report, not a counter)."""
+        from ..observability import devwatch, jitcert
 
         reg = devwatch.registry()
-        return {"totals": reg.totals(),
-                "sites": [w.snapshot() for w in reg.watches()]}
+        out = {"totals": reg.totals(),
+               "sites": [w.snapshot() for w in reg.watches()]}
+        try:
+            out["jitcert"] = jitcert.diff_live()
+        except Exception as exc:  # diagnostics degrade, never 500
+            out["jitcert"] = {"error": str(exc)}
+        return out
 
     @staticmethod
     def diagnostics_kernels() -> Dict[str, Any]:

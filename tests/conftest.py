@@ -46,8 +46,8 @@ def fresh_engine_state():
     from ekuiper_tpu.planner import sharing
     from ekuiper_tpu.runtime import control, nodes_sharedfold, subtopo
 
-    from ekuiper_tpu.observability import (devwatch, health, kernwatch,
-                                           memwatch)
+    from ekuiper_tpu.observability import (devwatch, health, jitcert,
+                                           kernwatch, memwatch)
     from ekuiper_tpu.runtime.events import recorder
 
     clock = timex.set_mock_clock(0)
@@ -68,6 +68,7 @@ def fresh_engine_state():
     devwatch.registry().clear()
     kernwatch.reset()
     memwatch.registry().clear()
+    jitcert.reset()
     timex.use_real_clock()
     # dynamic lock-order teardown check: the acquisition graph
     # accumulates across tests (a consistent GLOBAL order is the

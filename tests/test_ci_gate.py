@@ -1,0 +1,40 @@
+"""tools/ci_gate.py — the one-command static-analysis verdict must run
+green on the tree (tier-1, the same contract as each gate individually)
+and fail loudly when any gate fails."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run(*args, timeout=600):
+    return subprocess.run(
+        [sys.executable, "tools/ci_gate.py", *args],
+        capture_output=True, text=True, timeout=timeout, cwd=str(REPO))
+
+
+def test_full_gate_green_with_json_verdict():
+    """THE gate: kuiperlint + jitcert certify/diff + check_metrics +
+    benchdiff --smoke, one JSON verdict, exit 0."""
+    proc = _run("--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    verdict = json.loads(proc.stdout)
+    assert verdict["ok"] is True
+    names = {g["gate"] for g in verdict["gates"]}
+    assert names == {"kuiperlint", "jitcert_certify", "jitcert_diff",
+                     "check_metrics", "benchdiff_smoke"}
+    assert all(g["ok"] and g["returncode"] == 0
+               for g in verdict["gates"])
+
+
+def test_skip_and_unknown_gate():
+    proc = _run("--json", "--skip",
+                "jitcert_diff,benchdiff_smoke,check_metrics,kuiperlint")
+    assert proc.returncode == 0
+    verdict = json.loads(proc.stdout)
+    assert [g["gate"] for g in verdict["gates"]] == ["jitcert_certify"]
+    assert "benchdiff_smoke" in verdict["skipped"]
+    proc = _run("--skip", "no-such-gate")
+    assert proc.returncode == 2

@@ -391,6 +391,114 @@ class TestAdmission:
         assert "why" in str(exc)
 
 
+class TestCertifiedSignaturePricing:
+    """ISSUE 10: admission prices a candidate's jitcert-certified
+    new-signature count instead of waiting for the live storm edge."""
+
+    def test_private_device_rule_prices_certificate(self):
+        store = kv.get_store()
+        _mk_stream(store)
+        d = control.admit_rule(_rule(), store)
+        assert d["price"]["path"] == "device-private"
+        n = d["price"]["certified_new_signatures"]
+        assert n > 0
+        # machine-checkable: re-deriving from the same plan-time
+        # declarations reproduces the count admission priced
+        from ekuiper_tpu.observability import jitcert
+        from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+        from ekuiper_tpu.planner.planner import merged_options
+        from ekuiper_tpu.sql.parser import parse_select
+
+        rule = _rule()
+        opts = merged_options(rule)
+        plan = extract_kernel_plan(parse_select(rule.sql))
+        assert n == jitcert.estimate_plan_signatures(
+            plan, 1, opts.micro_batch_rows, opts.key_slots)
+
+    def test_pane_count_does_not_change_executable_count(self):
+        """Hopping windows widen signature SHAPES, not the executable
+        count admission budgets — a hopping twin prices identically to
+        its tumbling sibling (and the estimator is pane-invariant, so
+        price_rule passes n_panes=1 without a window inspection)."""
+        store = kv.get_store()
+        _mk_stream(store)
+        tumble = control.admit_rule(_rule(), store)
+        hop = control.admit_rule(_rule(
+            rid="adm_hop",
+            sql=("SELECT deviceId, avg(v) AS a FROM ctrl GROUP BY "
+                 "deviceId, HOPPINGWINDOW(ss, 40, 10)")), store)
+        assert (hop["price"]["certified_new_signatures"]
+                == tumble["price"]["certified_new_signatures"])
+        from ekuiper_tpu.observability import jitcert
+        from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+        from ekuiper_tpu.sql.parser import parse_select
+
+        plan = extract_kernel_plan(parse_select(_rule().sql))
+        assert (jitcert.estimate_plan_signatures(plan, 1, 512, 1024)
+                == jitcert.estimate_plan_signatures(plan, 8, 512, 1024))
+
+    def test_pricing_failure_is_unknown_not_zero(self, monkeypatch):
+        """An estimate crash must leave the UNKNOWN sentinel (None):
+        failing open to 0 would both disarm the signature budget and
+        route a compile-heavy candidate through the storm bypass."""
+        store = kv.get_store()
+        _mk_stream(store)
+        from ekuiper_tpu.observability import jitcert
+
+        def boom(*a, **k):
+            raise RuntimeError("no derivation")
+
+        monkeypatch.setattr(jitcert, "estimate_plan_signatures", boom)
+        ctl = control.install(lambda: [], start=False)
+        ctl._storm_active = True
+        d = control.admit_rule(_rule("adm_unknown"), store)
+        assert d["price"]["certified_new_signatures"] is None
+        assert "certify_error" in d["price"]
+        # unknown defers like compile load during a storm
+        assert d["decision"] == "queue"
+        # ...but does not trip the signature budget (that would 429
+        # every unpriceable rule)
+        control.reset()
+        monkeypatch.setenv("KUIPER_ADMISSION_SIG_BUDGET", "1")
+        d = control.admit_rule(_rule("adm_unknown2"), store)
+        assert d["decision"] == "accept"
+
+    def test_sig_budget_rejects_structured(self, monkeypatch):
+        store = kv.get_store()
+        _mk_stream(store)
+        monkeypatch.setenv("KUIPER_ADMISSION_SIG_BUDGET", "1")
+        d = control.admit_rule(_rule(), store)
+        assert d["decision"] == "reject"
+        assert "signature" in d["reason"]
+        assert d["price"]["certified_new_signatures"] > 1
+
+    def test_host_path_rule_prices_zero_signatures(self, monkeypatch):
+        store = kv.get_store()
+        _mk_stream(store)
+        monkeypatch.setenv("KUIPER_ADMISSION_SIG_BUDGET", "1")
+        d = control.admit_rule(
+            _rule(rid="adm_host",
+                  sql="SELECT deviceId, v FROM ctrl WHERE v > 1"), store)
+        assert d["price"]["certified_new_signatures"] == 0
+        assert d["decision"] == "accept"  # no compile surface, no gate
+
+    def test_zero_sig_candidate_bypasses_storm_deferral(self):
+        """A storm defers new COMPILE load — a candidate whose
+        certificate prices zero new signatures adds none and must be
+        admitted straight through."""
+        store = kv.get_store()
+        _mk_stream(store)
+        ctl = control.install(lambda: [], start=False)
+        ctl._storm_active = True
+        dev = control.admit_rule(_rule("adm_dev"), store)
+        assert dev["decision"] == "queue"
+        assert "storm" in dev["reason"]
+        host = control.admit_rule(
+            _rule(rid="adm_host2",
+                  sql="SELECT deviceId, v FROM ctrl WHERE v > 1"), store)
+        assert host["decision"] == "accept"
+
+
 # ------------------------------------------------------------ REST surface
 class TestRestSurface:
     def _api(self):

@@ -727,10 +727,13 @@ class TestReviewHardening:
         ev = _evaluator(topo, up_ticks=1)
         ev.tick()  # create the track
         tr = ev._tracks["r1"]
-        # latency: fast window 100% violating, slow window 0.5% (< 1%
-        # budget) — a spike the slow window has already absorbed
-        tr.fast_hist.record(5_000)
-        for _ in range(200):
+        # latency: fast window 100% violating, slow window far under the
+        # 1% budget — a spike the slow window has already absorbed. The
+        # fast window carries real sample mass (burn is weighted by
+        # samples observed per window; a 1-sample window can't burn)
+        for _ in range(150):
+            tr.fast_hist.record(5_000)
+        for _ in range(20_000):
             tr.slow_hist.record(2)
         tr.slow_hist.record(5_000)
         # drops: slow window still remembers a burst the fast window has
@@ -764,6 +767,117 @@ class TestReviewHardening:
         node.stats.health_sample = real_sample
         v = ev.tick()["r1"]  # recovery: delta vs ORIGINAL baseline
         assert v["bottleneck"]["stage_us"].get("fold", 0) == 500
+
+
+class TestSampleCountAwareBurn:
+    """ISSUE 10 satellite: when the evaluator ticks faster than a rule
+    emits, the burn windows must hold their evidence between emissions
+    instead of decaying to zero and flapping the verdict (churn_soak had
+    to pin KUIPER_HEALTH_INTERVAL_MS=1500 to dodge exactly this)."""
+
+    def _slow_emitter(self, options=None, **kw):
+        topo = FakeTopo([FakeNode("src", "source")])
+        # sub-second cadence: the interval only matters for the timer;
+        # driving tick() directly models an evaluator far outpacing the
+        # rule's ~per-window emission rate
+        ev = _evaluator(topo, options=options, interval_ms=200, **kw)
+        return topo, ev
+
+    def test_breaching_slow_emitter_holds_across_empty_ticks(self):
+        """A rule emitting a violating window every 5th evaluator tick
+        must reach breaching and STAY there — empty ticks carry no new
+        evidence and must not decay the verdict toward healthy."""
+        topo, ev = self._slow_emitter(
+            options={"slo": {"latencyP99Ms": 100, "target": 0.9}})
+        states = []
+        for i in range(20):
+            if i % 5 == 0:  # one window emission: all samples violating
+                for _ in range(20):
+                    topo.e2e_hist.record(5_000)
+            states.append(ev.tick()["r1"]["state"])
+        assert BREACHING in states
+        # once breaching, the verdict never steps down during the run —
+        # pre-fix, the 4 empty ticks between emissions decayed the
+        # windows to zero samples and the FSM flapped down every cycle
+        first = states.index(BREACHING)
+        assert set(states[first:]) == {BREACHING}
+
+    def test_healthy_slow_emitter_stays_healthy(self):
+        topo, ev = self._slow_emitter()
+        for i in range(20):
+            if i % 5 == 0:
+                topo.e2e_hist.record(2)
+                topo.e2e_hist.record(3)
+            assert ev.tick()["r1"]["state"] == HEALTHY
+
+    def test_single_stray_violation_cannot_degrade(self):
+        """One violating sample in an otherwise-empty window is below
+        the budget's statistical resolution (~1/budget samples) — the
+        weighted burn must stay under the degrade line no matter how
+        many sub-second ticks re-read the held window."""
+        topo, ev = self._slow_emitter()  # default target 0.99
+        topo.e2e_hist.record(5_000)
+        for _ in range(10):
+            v = ev.tick()["r1"]
+            assert v["state"] == HEALTHY
+            assert v["burn_rate"]["latency_fast"] < 1.0
+
+    def test_empty_ticks_do_not_decay_drop_windows(self):
+        src = FakeNode("src", "source")
+        topo = FakeTopo([src])
+        ev = _evaluator(topo)
+        src.stats.inc_in(1000)
+        src.stats.inc_dropped("buffer_full", n=500)
+        ev.tick()
+        states = [ev.tick()["r1"]["state"] for _ in range(8)]
+        # no new traffic at all: the drop evidence holds, the verdict
+        # does not silently relax back to healthy
+        assert states[-1] == BREACHING
+
+    def test_dead_traffic_rule_ages_out_of_breaching(self):
+        """The evidence hold is BOUNDED (IDLE_HOLD_TICKS): a rule whose
+        traffic stops entirely — dead broker, disconnected source —
+        must age back to healthy instead of freezing at breaching
+        forever (which would permanently trip the breach-defer
+        admission gate and keep the shed plane acting on a dead
+        rule)."""
+        src = FakeNode("src", "source")
+        topo = FakeTopo([src])
+        ev = _evaluator(topo)
+        src.stats.inc_in(1000)
+        src.stats.inc_dropped("buffer_full", n=500)
+        ev.tick()
+        assert ev.tick()["r1"]["state"] == BREACHING
+        states = [ev.tick()["r1"]["state"] for _ in range(40)]
+        # held well past the flap horizon (sub-second-cadence evidence),
+        # then decays out and steps down through the FSM
+        assert states[health.IDLE_HOLD_TICKS - 2] == BREACHING
+        assert states[-1] == HEALTHY
+
+    def test_dead_latency_evidence_ages_out(self):
+        topo, ev = self._slow_emitter(
+            options={"slo": {"latencyP99Ms": 100, "target": 0.9}})
+        for _ in range(40):
+            topo.e2e_hist.record(5_000)
+        ev.tick()
+        assert ev.tick()["r1"]["state"] == BREACHING
+        states = [ev.tick()["r1"]["state"] for _ in range(40)]
+        assert states[-1] == HEALTHY
+
+    def test_window_sample_mass_is_reported(self):
+        topo, ev = self._slow_emitter()
+        for _ in range(7):
+            topo.e2e_hist.record(2)
+        v = ev.tick()["r1"]
+        assert v["latency"]["tick_samples"] == 7
+        assert v["latency"]["samples_fast"] == 7
+        # the observing tick decayed the window toward the next one
+        # (7 -> 3); empty ticks HOLD that mass instead of halving it
+        # again and again toward zero
+        for _ in range(3):
+            v = ev.tick()["r1"]
+            assert v["latency"]["tick_samples"] == 0
+            assert v["latency"]["samples_fast"] == 3
 
 
 class TestSeedingSingleFlight:

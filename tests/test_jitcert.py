@@ -1,0 +1,283 @@
+"""jitcert (observability/jitcert.py + tools/jitcert): compile-contract
+certificates — derivation math, the runtime observed-vs-certified diff,
+registry lifetime, the sketch pad ladder, and the CLI gates. CPU jax,
+tier-1."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from ekuiper_tpu.observability import devwatch, jitcert
+from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+from ekuiper_tpu.ops.groupby import DeviceGroupBy, slot_dtype
+from ekuiper_tpu.sql.parser import parse_select
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _plan(sql="SELECT deviceId, avg(v) AS a, count(*) AS c FROM s "
+              "GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)"):
+    plan = extract_kernel_plan(parse_select(sql))
+    assert plan is not None
+    return plan
+
+
+def _gb(capacity=32, n_panes=1, micro_batch=16, sql=None):
+    return DeviceGroupBy(_plan(sql) if sql else _plan(),
+                         capacity=capacity, n_panes=n_panes,
+                         micro_batch=micro_batch)
+
+
+def _cert(gb, op):
+    certs = {c.op: c for c in jitcert.certificates_for(gb)}
+    return certs[op]
+
+
+# ------------------------------------------------------------- derivations
+class TestDerivations:
+    def test_deterministic_and_machine_checkable(self):
+        gb = _gb()
+        a = jitcert.certificates_for(gb)
+        b = jitcert.certificates_for(gb)
+        assert [c.op for c in a] == [c.op for c in b]
+        for ca, cb in zip(a, b):
+            assert ca.signatures == cb.signatures
+            assert ca.params == cb.params
+            assert not ca.truncated
+            assert ca.signatures  # never empty
+            assert ca.derivation  # carries its reasoning
+
+    def test_capacity_ladder_spans_growth(self):
+        gb = _gb(capacity=32)
+        fold = _cert(gb, "groupby.fold")
+        caps = {32 << i for i in range(jitcert.MAX_GROWS + 1)}
+        seen = set()
+        for sig in fold.signatures:
+            for leaf in sig.split("|"):
+                if leaf.startswith("float32[1,") and leaf.count(",") == 1:
+                    seen.add(int(leaf[len("float32[1,"):-1]))
+        assert seen == caps
+
+    def test_slot_dtype_boundary(self):
+        """Certified slots carry BOTH wire dtypes (cached uint16 arrays
+        outlive a grow; int32 appears past 65,535) — and the boundary
+        function itself is what the derivation mirrors."""
+        assert slot_dtype(65535) is np.uint16
+        assert slot_dtype(65536) is np.int32
+        gb = _gb(micro_batch=16)
+        fold = _cert(gb, "groupby.fold")
+        assert any("uint16[16]" in s for s in fold.signatures)
+        assert any("int32[16]" in s for s in fold.signatures)
+
+    def test_mask_subsets_and_pane_forms(self):
+        gb = _gb(micro_batch=16)
+        fold = _cert(gb, "groupby.fold")
+        # event-time per-row pane vector and scalar pane both certified
+        assert any(s.endswith("uint8[16]") for s in fold.signatures)
+        assert any(s.endswith("int32[]") for s in fold.signatures)
+        # the avg(v) plan has one column: signatures with and without
+        # its validity mask must both be legal
+        assert any("bool[16]" in s for s in fold.signatures)
+        assert any("bool[16]" not in s for s in fold.signatures)
+
+    def test_boundary_tails(self):
+        gb = _gb(n_panes=4)
+        fin = _cert(gb, "groupby.finalize")
+        assert all(s.endswith("True|True|True|True")
+                   for s in fin.signatures)
+        dyn = _cert(gb, "groupby.finalize_dyn")
+        assert all(s.endswith("bool[4]") for s in dyn.signatures)
+        reset = _cert(gb, "groupby.reset_pane")
+        assert all(s.endswith("int32[]") for s in reset.signatures)
+
+    def test_hh_plan_certifies_hh_finalize(self):
+        gb = _gb(sql="SELECT deviceId, heavy_hitters(tag, 2) AS h FROM s "
+                     "GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)")
+        ops = {c.op for c in jitcert.certificates_for(gb)}
+        assert "groupby.hh_finalize" in ops
+
+    def test_unknown_kernel_raises(self):
+        with pytest.raises(ValueError, match="no jitcert derivation"):
+            jitcert.certificates_for(object())
+
+    def test_estimate_plan_signatures(self):
+        plan = _plan()
+        n = jitcert.estimate_plan_signatures(plan, 1, 4096, 16384)
+        assert n > 0
+        # hopping panes widen the surface, never shrink it
+        n4 = jitcert.estimate_plan_signatures(plan, 4, 4096, 16384)
+        assert n4 >= n
+        # deterministic
+        assert n == jitcert.estimate_plan_signatures(plan, 1, 4096, 16384)
+
+    WIDE_SQL = ("SELECT deviceId, "
+                + ", ".join(f"avg(c{i}) AS a{i}" for i in range(7))
+                + " FROM s GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)")
+
+    def test_wide_rule_prices_its_true_surface(self):
+        """Review regression: a 7-column rule's mask-subset enumeration
+        truncates (2^7 > MASK_SUBSET_CAP), but admission must price the
+        TRUE product-formula surface — otherwise the signature budget
+        inverts, admitting the compile-heaviest rules while rejecting
+        narrower honest ones."""
+        wide = jitcert.estimate_plan_signatures(
+            _plan(self.WIDE_SQL), 1, 4096, 16384)
+        narrow = jitcert.estimate_plan_signatures(_plan(), 1, 4096, 16384)
+        assert wide >= (1 << 7)  # at least the 2^7 mask subsets
+        assert wide > narrow
+        fold = _cert(_gb(sql=self.WIDE_SQL), "groupby.fold")
+        assert fold.truncated
+        assert fold.full_count > len(fold.signatures)
+
+    def test_truncated_site_surfaces_as_open_not_silent(self):
+        """Review regression: a truncated certificate cannot be
+        enforced — the diff must SAY so (sites_open + open_sites), not
+        silently skip the site while reporting full coverage."""
+        gb = _gb(sql=self.WIDE_SQL)
+        state = gb.init_state()
+        cols = {f"c{i}": np.arange(10, dtype=np.float64)
+                for i in range(7)}
+        state = gb.fold(state, cols, np.arange(10, dtype=np.int32) % 4)
+        d = jitcert.diff_live()
+        assert d["sites_open"] >= 1
+        assert any(o["op"] == "groupby.fold" and "truncated"
+                   in o["reason"] for o in d["open_sites"])
+
+
+# ------------------------------------------------------------ runtime diff
+class TestRuntimeDiff:
+    def _drive(self, gb, n_keys=4):
+        state = gb.init_state()
+        cols = {"v": np.arange(10, dtype=np.float64)}
+        slots = np.arange(10, dtype=np.int32) % n_keys
+        state = gb.fold(state, cols, slots)
+        gb.finalize(state, n_keys)
+        return state
+
+    def test_clean_on_certified_workload(self):
+        gb = _gb()
+        self._drive(gb)
+        d = jitcert.diff_live()
+        assert d["clean"]
+        assert d["sites_observed"] >= 2
+        assert d["observed_signatures"] >= 2
+        assert d["certified_signatures"] > 0
+        assert d["uncertified"] == []
+
+    def test_growth_respecialization_stays_certified(self):
+        gb = _gb(capacity=32)
+        state = self._drive(gb)
+        state = gb.grow(state, 64)
+        cols = {"v": np.arange(10, dtype=np.float64)}
+        state = gb.fold(state, cols, np.arange(10, dtype=np.int32) % 4)
+        gb.finalize(state, 4)
+        assert jitcert.diff_live()["clean"]
+
+    def test_observed_outside_certificate_is_reported(self):
+        """The report IS the signature: drive a pane-mask combination
+        the static-tuple certificate does not admit (all-True only) and
+        the diff must name the op, rule, and offending signature."""
+        gb = _gb(n_panes=2)
+        state = gb.init_state()
+        # direct static-route call with a SUBSET mask — every engine
+        # caller routes subsets through the traced-mask twin, so this
+        # is exactly an uncertified executable
+        gb._finalize(state, (True, False))
+        d = jitcert.diff_live()
+        assert not d["clean"]
+        bad = [u for u in d["uncertified"]
+               if u["op"] == "groupby.finalize"]
+        assert bad and bad[0]["signature"].endswith("True|False")
+        assert "outside the certified set" in bad[0]["reason"]
+
+    def test_uncovered_site_is_reported(self):
+        gb = _gb()
+        self._drive(gb)
+        jitcert.reset()  # certificates gone, observations remain
+        d = jitcert.diff_live()
+        assert not d["clean"]
+        assert d["sites_uncovered"] >= 1
+        assert any("no certificate registered" in u["reason"]
+                   for u in d["uncertified"])
+
+    def test_registry_weakref_lifetime(self):
+        import gc
+
+        gb = _gb()
+        assert any(op == "groupby.fold"
+                   for (op, _r) in jitcert.live_certificates())
+        del gb
+        gc.collect()
+        assert not jitcert.live_certificates()
+
+    def test_rule_attribution_fallback_pools_by_op(self):
+        """An OpWatch whose rule tag drifted from the registration
+        (restart) still diffs against the op's pooled certificates."""
+        gb = _gb()
+        self._drive(gb)
+        for w in devwatch.registry().watches():
+            w.rule = "restarted_rule"
+        assert jitcert.diff_live()["clean"]
+
+
+# ------------------------------------------------------------ sketch ladder
+class TestSketchPadLadder:
+    def test_counts_unaffected_by_padding(self):
+        from ekuiper_tpu.ops.sketches import CountMinSketch
+
+        sk = CountMinSketch(depth=2, width=128, max_candidates=64)
+        sk.update(np.array([1.0] * 5 + [2.0] * 3, dtype=np.float32))
+        hh = dict(sk.heavy_hitters(2))
+        assert hh[1.0] >= 5 and hh[2.0] >= 3
+        # zero-weight pad rows must not inflate any estimate
+        assert hh[1.0] < 5 + 8  # cm overestimates, but not by the pad
+        # review regression: the 0.0 pad filler must never become a
+        # phantom CANDIDATE (it would burn a max_candidates slot and
+        # could surface with a nonzero collided estimate)
+        assert 0.0 not in sk.candidates
+
+    def test_update_signatures_ride_pow2_ladder(self):
+        from ekuiper_tpu.ops.sketches import CountMinSketch, _pad_pow2
+
+        assert _pad_pow2(1) == 256
+        assert _pad_pow2(256) == 256
+        assert _pad_pow2(257) == 512
+        sk = CountMinSketch(depth=2, width=64)
+        for n in (3, 200, 300, 600):
+            sk.update(np.arange(n, dtype=np.float32))
+        d = jitcert.diff_live()
+        assert d["clean"]
+        obs = [w for w in devwatch.registry().watches()
+               if w.op == "sketch.update"]
+        sigs = set().union(*(w.signature_dump() for w in obs))
+        # 3+200 share the 256 bucket; 300 and 600 take 512 and 1024
+        assert len(sigs) == 3
+
+
+# -------------------------------------------------------------------- CLI
+class TestCli:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.jitcert", *args],
+            capture_output=True, text=True, timeout=300, cwd=str(REPO))
+
+    def test_certify_gate(self):
+        proc = self._run("certify", "--json")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        assert report["ok"]
+        assert report["total_signatures"] > 0
+        # every non-sharded derivation is exercised by the battery
+        assert set(report["ops_certified"]) >= {
+            op for op in jitcert.SITE_DERIVATIONS
+            if not op.startswith("sharded.")}
+
+    def test_diff_gate(self):
+        proc = self._run("diff", "--json")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        assert report["clean"]
+        assert report["observed_signatures"] > 0

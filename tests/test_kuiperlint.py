@@ -79,8 +79,8 @@ class TestTreeGate:
     def test_every_documented_pass_registered(self):
         names = set(kuiperlint.all_passes())
         assert {"clock-discipline", "jit-coverage", "lock-order",
-                "host-sync", "donation-safety",
-                "metric-hygiene"} <= names
+                "host-sync", "donation-safety", "metric-hygiene",
+                "cert-coverage", "sig-stability"} <= names
 
 
 # --------------------------------------------------------- clock-discipline
@@ -185,7 +185,7 @@ class TestJitCoverage:
         assert lint_tree(tmp_path, {
             "ekuiper_tpu/ops/ok.py":
                 "from ekuiper_tpu.observability.devwatch import"
-                " watched_jit\nfold = watched_jit(lambda s: s, op='f')\n",
+                " watched_jit\nfold = watched_jit(lambda s: s, op='groupby.fold')\n",
             "ekuiper_tpu/observability/devwatch.py":
                 "import jax\n_impl = jax.jit(lambda s: s)\n",
         }) == []
@@ -200,6 +200,266 @@ class TestJitCoverage:
 
 
 # --------------------------------------------------------------- lock-order
+class TestCertCoverage:
+    """ISSUE 10: every watched_jit site in ops//parallel/ must resolve
+    to a registered jitcert derivation."""
+
+    def test_rogue_op_fires(self, tmp_path):
+        vs = lint_tree(tmp_path, {
+            "ekuiper_tpu/ops/m.py": """\
+                from ekuiper_tpu.observability.devwatch import watched_jit
+                f = watched_jit(lambda s: s, op="rogue.site")
+            """,
+        }, rules=["cert-coverage"])
+        assert [v.rule for v in vs] == ["cert-coverage"]
+        assert "rogue.site" in vs[0].message
+
+    def test_unresolvable_op_fires(self, tmp_path):
+        vs = lint_tree(tmp_path, {
+            "ekuiper_tpu/ops/m.py": """\
+                from ekuiper_tpu.observability.devwatch import watched_jit
+                name = "dyn" + "amic"
+                f = watched_jit(lambda s: s, op=name)
+            """,
+        }, rules=["cert-coverage"])
+        assert [v.rule for v in vs] == ["cert-coverage"]
+        assert "not statically resolvable" in vs[0].message
+
+    def test_watch_op_with_literal_prefix_resolves(self, tmp_path):
+        assert lint_tree(tmp_path, {
+            "ekuiper_tpu/ops/m.py": """\
+                from ekuiper_tpu.observability.devwatch import watched_jit
+
+                class K:
+                    watch_prefix = "groupby"
+
+                    def _watch_op(self, s):
+                        return f"{self.watch_prefix}.{s}"
+
+                    def _fold_impl(self, state):
+                        return state
+
+                    def build(self):
+                        return watched_jit(self._fold_impl,
+                                           op=self._watch_op("fold"))
+            """,
+        }, rules=["cert-coverage"]) == []
+
+    def test_watch_prefix_chases_same_file_base(self, tmp_path):
+        """ShardedGroupBy-style: the subclass overrides watch_prefix;
+        a subclass WITHOUT one inherits the base's literal."""
+        assert lint_tree(tmp_path, {
+            "ekuiper_tpu/parallel/m.py": """\
+                from ekuiper_tpu.observability.devwatch import watched_jit
+
+                class Base:
+                    watch_prefix = "sharded"
+
+                    def _watch_op(self, s):
+                        return f"{self.watch_prefix}.{s}"
+
+                class Sub(Base):
+                    def _step(self, state):
+                        return state
+
+                    def build(self):
+                        return watched_jit(self._step,
+                                           op=self._watch_op("fold_step"))
+            """,
+        }, rules=["cert-coverage"]) == []
+
+    def test_outside_scope_ignored(self, tmp_path):
+        assert lint_tree(tmp_path, {
+            "ekuiper_tpu/runtime/m.py": """\
+                from ekuiper_tpu.observability.devwatch import watched_jit
+                f = watched_jit(lambda s: s, op="rogue.site")
+            """,
+        }, rules=["cert-coverage"]) == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        assert lint_tree(tmp_path, {
+            "ekuiper_tpu/ops/m.py": """\
+                from ekuiper_tpu.observability.devwatch import watched_jit
+                # kuiperlint: ignore[cert-coverage]: experimental site, certified next PR
+                f = watched_jit(lambda s: s, op="rogue.site")
+            """,
+        }, rules=["cert-coverage"]) == []
+
+
+class TestSigStability:
+    """ISSUE 10: signature-unstable idioms inside jit bodies."""
+
+    def test_traced_value_branch_fires(self, tmp_path):
+        vs = lint_tree(tmp_path, {
+            "ekuiper_tpu/ops/m.py": """\
+                from ekuiper_tpu.observability.devwatch import watched_jit
+
+                def _impl(state, n):
+                    if n > 3:
+                        return state
+                    return state
+
+                f = watched_jit(_impl, op="groupby.fold")
+            """,
+        }, rules=["sig-stability"])
+        assert [v.rule for v in vs] == ["sig-stability"]
+        assert "branches on traced value 'n'" in vs[0].message
+
+    def test_len_slice_fires(self, tmp_path):
+        vs = lint_tree(tmp_path, {
+            "ekuiper_tpu/ops/m.py": """\
+                from ekuiper_tpu.observability.devwatch import watched_jit
+
+                def _impl(state, rows):
+                    return state[:len(rows)]
+
+                f = watched_jit(_impl, op="groupby.fold")
+            """,
+        }, rules=["sig-stability"])
+        assert [v.rule for v in vs] == ["sig-stability"]
+        assert "len()" in vs[0].message
+
+    def test_scalar_closure_capture_fires(self, tmp_path):
+        vs = lint_tree(tmp_path, {
+            "ekuiper_tpu/ops/m.py": """\
+                from ekuiper_tpu.observability.devwatch import watched_jit
+
+                def build():
+                    out = []
+                    for i in range(3):
+                        out.append(watched_jit(lambda s: s * i,
+                                               op="groupby.fold"))
+                    return out
+            """,
+        }, rules=["sig-stability"])
+        assert [v.rule for v in vs] == ["sig-stability"]
+        assert "loop variable 'i'" in vs[0].message
+
+    def test_taint_propagates_through_helper(self, tmp_path):
+        """The entry body delegates to a same-class helper; branching on
+        the traced value there must still fire."""
+        vs = lint_tree(tmp_path, {
+            "ekuiper_tpu/ops/m.py": """\
+                from ekuiper_tpu.observability.devwatch import watched_jit
+
+                class K:
+                    def _impl(self, state, n):
+                        return self._helper(state, n)
+
+                    def _helper(self, st, count):
+                        if count > 2:
+                            return st
+                        return st
+
+                    def build(self):
+                        return watched_jit(self._impl, op="groupby.fold")
+            """,
+        }, rules=["sig-stability"])
+        assert [v.rule for v in vs] == ["sig-stability"]
+
+    def test_static_forms_stay_legal(self, tmp_path):
+        """Structure/shape tests and config closures are the engine's
+        normal idiom (DeviceGroupBy._fold_core, sharded factories)."""
+        assert lint_tree(tmp_path, {
+            "ekuiper_tpu/ops/m.py": """\
+                from ekuiper_tpu.observability.devwatch import watched_jit
+
+                class K:
+                    def __init__(self, plan, mesh):
+                        self.plan = plan
+                        self.mesh = mesh
+
+                    def _impl(self, state, mask, pane_idx):
+                        if mask is not None:
+                            state = state + 1
+                        if getattr(pane_idx, "ndim", 0) == 1:
+                            state = state + 2
+                        if state.shape[0] > 4:
+                            state = state + 3
+                        if self.plan is not None:
+                            state = state + 4
+                        for comp in sorted(state.keys()):
+                            pass
+                        return state
+
+                    def build(self):
+                        plan = self.plan
+                        specs = {"a": 1}
+
+                        def step(state, mask):
+                            if plan is not None:
+                                return self._impl(state, mask, 0)
+                            return state
+
+                        return watched_jit(step, op="groupby.fold")
+            """,
+        }, rules=["sig-stability"]) == []
+
+    def test_untainted_helper_params_stay_legal(self, tmp_path):
+        """A helper called with a STATIC argument (loop var over plan
+        config) may branch on it — only traced positions taint."""
+        assert lint_tree(tmp_path, {
+            "ekuiper_tpu/ops/m.py": """\
+                from ekuiper_tpu.observability.devwatch import watched_jit
+
+                class K:
+                    def _impl(self, state):
+                        for comp in ("mn", "mx"):
+                            state = self._merged(state, comp)
+                        return state
+
+                    def _merged(self, state, comp):
+                        if comp == "mn":
+                            return state
+                        return state
+
+                    def build(self):
+                        return watched_jit(self._impl, op="groupby.fold")
+            """,
+        }, rules=["sig-stability"]) == []
+
+    def test_sibling_nested_function_does_not_poison_closure_check(
+            self, tmp_path):
+        """Review regression: a sibling nested function's loop variable
+        is a different scope — a jit body referencing an identically
+        named enclosing CONFIG binding must not be flagged."""
+        assert lint_tree(tmp_path, {
+            "ekuiper_tpu/ops/m.py": """\
+                from ekuiper_tpu.observability.devwatch import watched_jit
+
+                def build(plan):
+                    def unrelated():
+                        for i in range(3):
+                            pass
+                        scale = 2.0
+                        return scale
+
+                    i = plan
+                    scale = plan
+
+                    def step(state):
+                        return state + i + scale
+
+                    return watched_jit(step, op="groupby.fold")
+            """,
+        }, rules=["sig-stability"]) == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        assert lint_tree(tmp_path, {
+            "ekuiper_tpu/ops/m.py": """\
+                from ekuiper_tpu.observability.devwatch import watched_jit
+
+                def _impl(state, n):
+                    # kuiperlint: ignore[sig-stability]: bounded two-way respecialization, certified
+                    if n > 3:
+                        return state
+                    return state
+
+                f = watched_jit(_impl, op="groupby.fold")
+            """,
+        }, rules=["sig-stability"]) == []
+
+
 class TestLockOrder:
     ABBA = """\
         import threading
@@ -325,6 +585,162 @@ class TestLockOrder:
 
 
 # ---------------------------------------------------------------- host-sync
+class TestLockOrderExplicitAcquire:
+    """ISSUE 10 satellite: the pass must see explicit `lock.acquire()` /
+    `try: ... finally: lock.release()` acquisitions, not only `with`."""
+
+    ABBA = {
+        "ekuiper_tpu/runtime/m.py": """\
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def f1():
+                A.acquire()
+                try:
+                    with B:
+                        pass
+                finally:
+                    A.release()
+
+            def f2():
+                with B:
+                    with A:
+                        pass
+        """,
+    }
+
+    def test_acquire_release_abba_fires(self, tmp_path):
+        vs = lint_tree(tmp_path, dict(self.ABBA), rules=["lock-order"])
+        assert [v.rule for v in vs] == ["lock-order"]
+        assert "cycle" in vs[0].message
+
+    def test_release_ends_the_hold(self, tmp_path):
+        assert lint_tree(tmp_path, {
+            "ekuiper_tpu/runtime/m.py": """\
+                import threading
+
+                A = threading.Lock()
+                B = threading.Lock()
+
+                def f1():
+                    A.acquire()
+                    A.release()
+                    B.acquire()
+                    B.release()
+
+                def f2():
+                    with B:
+                        with A:
+                            pass
+            """,
+        }, rules=["lock-order"]) == []
+
+    def test_self_attr_acquire_in_method(self, tmp_path):
+        vs = lint_tree(tmp_path, {
+            "ekuiper_tpu/runtime/m.py": """\
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._mu = threading.Lock()
+                        self._nu = threading.Lock()
+
+                    def f1(self):
+                        self._mu.acquire()
+                        try:
+                            with self._nu:
+                                pass
+                        finally:
+                            self._mu.release()
+
+                    def f2(self):
+                        with self._nu:
+                            with self._mu:
+                                pass
+            """,
+        }, rules=["lock-order"])
+        assert [v.rule for v in vs] == ["lock-order"]
+
+    def test_nonblocking_try_lock_skipped(self, tmp_path):
+        """acquire(blocking=False) cannot deadlock an ABBA square — the
+        health.py profile-capture idiom must stay legal."""
+        assert lint_tree(tmp_path, {
+            "ekuiper_tpu/runtime/m.py": """\
+                import threading
+
+                A = threading.Lock()
+                B = threading.Lock()
+
+                def f1():
+                    if not A.acquire(blocking=False):
+                        return
+                    with B:
+                        pass
+                    A.release()
+
+                def f2():
+                    with B:
+                        with A:
+                            pass
+            """,
+        }, rules=["lock-order"]) == []
+
+    def test_pragma_on_witness_edge_suppresses(self, tmp_path):
+        files = {
+            "ekuiper_tpu/runtime/m.py": """\
+                import threading
+
+                A = threading.Lock()
+                B = threading.Lock()
+
+                def f1():
+                    A.acquire()
+                    try:
+                        # kuiperlint: ignore[lock-order]: A is init-only here, no concurrent f2 yet
+                        with B:
+                            pass
+                    finally:
+                        A.release()
+
+                def f2():
+                    with B:
+                        with A:
+                            pass
+            """,
+        }
+        assert lint_tree(tmp_path, files, rules=["lock-order"]) == []
+
+    def test_acquire_inside_with_outlives_the_block(self, tmp_path):
+        """Review regression: `with A: B.acquire()` holds B past the
+        with exit — the B->C edge taken afterwards must be recorded
+        (the with-scoped copy used to swallow it)."""
+        vs = lint_tree(tmp_path, {
+            "ekuiper_tpu/runtime/m.py": """\
+                import threading
+
+                A = threading.Lock()
+                B = threading.Lock()
+                C = threading.Lock()
+
+                def f1():
+                    with A:
+                        B.acquire()
+                    with C:
+                        pass
+                    B.release()
+
+                def f2():
+                    with C:
+                        with B:
+                            pass
+            """,
+        }, rules=["lock-order"])
+        assert [v.rule for v in vs] == ["lock-order"]
+        assert "m.B" in vs[0].message and "m.C" in vs[0].message
+
+
 class TestHostSync:
     def test_seeded_violations_fire(self, tmp_path):
         vs = lint_tree(tmp_path, {
@@ -371,7 +787,8 @@ class TestDonationSafety:
 
                 class Agg:
                     def __init__(self, f):
-                        self._fold = watched_jit(f, donate_argnums=0)
+                        self._fold = watched_jit(f, op="groupby.fold",
+                                                donate_argnums=0)
 
                     def step(self, state, xs):
                         out = self._fold(state, xs)
@@ -388,7 +805,8 @@ class TestDonationSafety:
 
                 class Agg:
                     def __init__(self, f):
-                        self._fold = watched_jit(f, donate_argnums=0)
+                        self._fold = watched_jit(f, op="groupby.fold",
+                                                donate_argnums=0)
 
                     def step(self, state, xs):
                         state = self._fold(state, xs)
@@ -403,7 +821,8 @@ class TestDonationSafety:
 
                 class Agg:
                     def __init__(self, f):
-                        self._fold = watched_jit(f, donate_argnums=(0, 1))
+                        self._fold = watched_jit(f, op="groupby.fold",
+                                                donate_argnums=(0, 1))
 
                     def step(self, xs):
                         out = self._fold(self.state, xs)
@@ -419,7 +838,8 @@ class TestDonationSafety:
 
                 class Agg:
                     def __init__(self, f):
-                        self._fold = watched_jit(f, donate_argnums=0)
+                        self._fold = watched_jit(f, op="groupby.fold",
+                                                donate_argnums=0)
 
                     def step(self, state, xs):
                         out = self._fold(state, xs)

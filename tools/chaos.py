@@ -50,10 +50,18 @@ class ChaosHarness:
     traffic would."""
 
     def __init__(self, api, stream: str = "chaos",
-                 topic: str = "chaos/t", seed: int = 23) -> None:
+                 topic: str = "chaos/t", seed: int = 23,
+                 pool: int = 0) -> None:
         self.api = api
         self.stream = stream
         self.topic = topic
+        # pool > 0 runs the device-path rules over POOLED sources
+        # (decode_pool_size>0): the storm then exercises the decode
+        # pool / ingest ring end-to-end, which is what the QoS
+        # autosize actuator resizes — inline sources (the default) are
+        # contractually never converted, so a soak over them can never
+        # see an autosize event
+        self.pool = int(pool)
         self.rng = random.Random(seed)
         self.counters: Dict[str, int] = {
             "created": 0, "updated": 0, "deleted": 0,
@@ -71,6 +79,15 @@ class ChaosHarness:
                    'FORMAT="JSON")'}, {})
         if code not in (200, 201) and "already" not in str(out):
             raise RuntimeError(f"stream create failed: {out}")
+
+    def _opts(self, options: Dict[str, Any]) -> Dict[str, Any]:
+        """Rule options + the harness's source-pool configuration. The
+        pool knobs are part of the subtopo key, so rules created with
+        the same values share one pooled source pipeline."""
+        if self.pool > 0:
+            options = {"decodePoolSize": self.pool,
+                       "ingestRingDepth": 2, **options}
+        return options
 
     def _create(self, rule_json: Dict[str, Any]) -> Optional[str]:
         code, out = self.api.dispatch("POST", "/rules", rule_json, {})
@@ -107,8 +124,9 @@ class ChaosHarness:
                 # critical: the workload fleet is the "healthy rules
                 # must HOLD their p99" control group — exempt from
                 # shedding, relieved by the victim/churn sheds instead
-                "options": {"qosClass": "critical",
-                            "slo": {"latencyP99Ms": slo_p99_ms}},
+                "options": self._opts(
+                    {"qosClass": "critical",
+                     "slo": {"latencyP99Ms": slo_p99_ms}}),
             })
             ids.append(rid)
         return ids
@@ -127,10 +145,11 @@ class ChaosHarness:
             # constantly, so DROP burn breaches it deterministically
             # even when its (compile-delayed) window emissions are too
             # sparse for the latency windows to accrue consecutively
-            "options": {"sharedFold": False, "qosClass": "low",
-                        "bufferLength": 2,
-                        "slo": {"latencyP99Ms": 1, "target": 0.99,
-                                "maxDropRatio": 0.00001}},
+            "options": self._opts(
+                {"sharedFold": False, "qosClass": "low",
+                 "bufferLength": 2,
+                 "slo": {"latencyP99Ms": 1, "target": 0.99,
+                         "maxDropRatio": 0.00001}}),
         })
         return rid
 
@@ -144,9 +163,10 @@ class ChaosHarness:
             "actions": [{"nop": {}}],
             # e2e of a 2s window is ~2s by construction — the SLO must
             # bound the TAIL beyond that, not the window dwell itself
-            "options": {"qos": 1, "checkpointInterval": 1000,
-                        "qosClass": "high",
-                        "slo": {"latencyP99Ms": 10_000}},
+            "options": self._opts(
+                {"qos": 1, "checkpointInterval": 1000,
+                 "qosClass": "high",
+                 "slo": {"latencyP99Ms": 10_000}}),
         })
         return rid
 

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""ci_gate — one tier-1-safe entry point for the static-analysis suite.
+
+Runs, in order, each gate the repo already trusts individually and
+folds their outcomes into ONE JSON verdict (exit 0 iff every gate
+passed):
+
+  kuiperlint        python -m tools.kuiperlint ekuiper_tpu/   (8 passes)
+  jitcert certify   derivations deterministic, closed, exercised
+  jitcert diff      observed XLA signatures ⊆ certificates (CPU battery)
+  check_metrics     Prometheus catalog lint (synthetic scrape vs docs)
+  benchdiff --smoke trajectory-gate self-test (synthetic artifacts)
+
+Usage:
+  python tools/ci_gate.py [--json] [--skip GATE[,GATE...]]
+
+Every gate runs in a subprocess with CPU jax so a crash in one cannot
+take the verdict down with it; per-gate stdout tails are carried in the
+JSON for postmortems. tests/test_ci_gate.py runs the full gate in
+tier-1. docs/STATIC_ANALYSIS.md § CI gate.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: gate name -> argv (cwd=REPO, CPU jax)
+GATES: Dict[str, List[str]] = {
+    "kuiperlint": [sys.executable, "-m", "tools.kuiperlint",
+                   "ekuiper_tpu/"],
+    "jitcert_certify": [sys.executable, "-m", "tools.jitcert", "certify"],
+    "jitcert_diff": [sys.executable, "-m", "tools.jitcert", "diff"],
+    "check_metrics": [sys.executable, "tools/check_metrics.py"],
+    "benchdiff_smoke": [sys.executable, "tools/benchdiff.py", "--smoke"],
+}
+
+#: per-gate wall bound — generous; the whole gate must stay tier-1-safe
+GATE_TIMEOUT_S = 420
+
+
+def run_gate(name: str, argv: List[str]) -> Dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=GATE_TIMEOUT_S, cwd=REPO, env=env)
+        rc = proc.returncode
+        out = (proc.stdout or "") + (proc.stderr or "")
+    except subprocess.TimeoutExpired as exc:
+        rc = 124
+        out = (f"timeout after {GATE_TIMEOUT_S}s\n"
+               f"{exc.stdout or ''}{exc.stderr or ''}")
+    except OSError as exc:
+        rc = 127
+        out = str(exc)
+    return {
+        "gate": name,
+        "ok": rc == 0,
+        "returncode": rc,
+        "seconds": round(time.perf_counter() - t0, 2),
+        # enough tail for a postmortem without ballooning the verdict
+        "output_tail": out[-2000:],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true",
+                    help="print the full JSON verdict")
+    ap.add_argument("--skip", default="",
+                    help="comma-separated gate names to skip "
+                         f"(of: {', '.join(GATES)})")
+    args = ap.parse_args(argv)
+    skip = {s.strip() for s in args.skip.split(",") if s.strip()}
+    unknown = skip - set(GATES)
+    if unknown:
+        print(f"ci_gate: unknown gate(s) in --skip: "
+              f"{', '.join(sorted(unknown))}", file=sys.stderr)
+        return 2
+    results = [run_gate(name, cmd) for name, cmd in GATES.items()
+               if name not in skip]
+    verdict = {
+        "ok": all(r["ok"] for r in results),
+        "gates": results,
+        "skipped": sorted(skip),
+        "total_seconds": round(sum(r["seconds"] for r in results), 2),
+    }
+    if args.json:
+        print(json.dumps(verdict, indent=2))
+    else:
+        for r in results:
+            mark = "ok " if r["ok"] else "FAIL"
+            print(f"  [{mark}] {r['gate']:<16} rc={r['returncode']} "
+                  f"({r['seconds']}s)")
+            if not r["ok"]:
+                tail = r["output_tail"].strip().splitlines()[-8:]
+                for line in tail:
+                    print(f"         {line}")
+        state = "OK" if verdict["ok"] else "FAILED"
+        print(f"ci_gate: {state} ({len(results)} gate(s), "
+              f"{verdict['total_seconds']}s)")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,210 @@
+"""jitcert CLI — certify + diff the engine's compile contracts headlessly.
+
+Two subcommands, both tier-1-safe on CPU jax (tools/ci_gate.py runs
+them; tests/test_jitcert.py asserts on them):
+
+  python -m tools.jitcert certify [--json]
+      Derive certificates for a canonical battery of kernel shapes
+      (tumbling / hopping / multirule / heavy-hitters / sketch) and
+      verify each one is MACHINE-CHECKABLE: re-deriving from the
+      recorded params reproduces the signature set bit-for-bit, the set
+      is closed (not truncated), and every SITE_DERIVATIONS op is
+      exercised by at least one battery kernel. Exit 1 on any failure.
+
+  python -m tools.jitcert diff [--json]
+      Drive the same battery through real folds/finalizes on CPU jax,
+      then diff devwatch's OBSERVED signatures against the registered
+      certificates (observability/jitcert.py diff_live). Exit 1 when
+      any observed signature falls outside its certificate — the same
+      gate bench rounds and /diagnostics/xla apply to live engines.
+
+The battery intentionally exercises the signature axes the derivations
+encode: capacity growth across the slot-dtype boundary, validity-mask
+presence flips, event-time pane vectors, masked edge refolds, dynamic
+pane masks, and the sketch's pow-2 value pad ladder.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))  # repo root
+
+
+def _battery():
+    """Construct the canonical kernel battery. Imports jax lazily so
+    `certify --help` works anywhere."""
+    import numpy as np  # noqa: F401
+
+    from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+    from ekuiper_tpu.ops.groupby import DeviceGroupBy
+    from ekuiper_tpu.ops.sketches import CountMinSketch
+    from ekuiper_tpu.parallel.multirule import (BatchedGroupBy,
+                                                build_rule_batch)
+    from ekuiper_tpu.sql.parser import parse_select
+
+    def plan(sql):
+        p = extract_kernel_plan(parse_select(sql))
+        assert p is not None, sql
+        return p
+
+    tumbling = plan("SELECT deviceId, avg(v) AS a, count(*) AS c "
+                    "FROM s GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)")
+    hopping = plan("SELECT deviceId, min(v) AS mn, max(v) AS mx FROM s "
+                   "GROUP BY deviceId, HOPPINGWINDOW(ss, 4, 1)")
+    hh = plan("SELECT deviceId, heavy_hitters(tag, 2) AS hh FROM s "
+              "GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)")
+    mr_sqls = [
+        f"SELECT deviceId, count(*) AS c FROM s WHERE v > {t} "
+        "GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)" for t in (1.0, 2.0)]
+    mr_spec = build_rule_batch(
+        ["jc_r1", "jc_r2"],
+        [parse_select(q) for q in mr_sqls])
+    return {
+        "groupby_tumbling": DeviceGroupBy(tumbling, capacity=32,
+                                          n_panes=1, micro_batch=16),
+        "groupby_hopping": DeviceGroupBy(hopping, capacity=32, n_panes=4,
+                                         micro_batch=16),
+        "groupby_hh": DeviceGroupBy(hh, capacity=32, n_panes=1,
+                                    micro_batch=16),
+        "multirule": BatchedGroupBy(mr_spec, capacity=32, n_panes=1,
+                                    micro_batch=16),
+        "sketch": CountMinSketch(depth=2, width=64, max_candidates=16),
+    }
+
+
+def certify(as_json: bool = False) -> int:
+    from ekuiper_tpu.observability import jitcert
+
+    kernels = _battery()
+    report: Dict[str, Any] = {"kernels": {}, "problems": []}
+    ops_seen: set = set()
+    for name, kernel in kernels.items():
+        certs = jitcert.certificates_for(kernel)
+        recheck = jitcert.certificates_for(kernel)
+        entries: List[Dict[str, Any]] = []
+        for c, c2 in zip(certs, recheck):
+            ops_seen.add(c.op)
+            entry = c.to_json()
+            if c.truncated:
+                report["problems"].append(
+                    f"{name}:{c.op} certificate is truncated (open set)")
+            if c.signatures != c2.signatures:
+                report["problems"].append(
+                    f"{name}:{c.op} derivation is not deterministic")
+            if not c.signatures:
+                report["problems"].append(
+                    f"{name}:{c.op} derived an empty signature set")
+            entries.append(entry)
+        report["kernels"][name] = entries
+    # sharded ops have no CPU-constructible battery kernel (they need a
+    # ("rows","keys") mesh); their derivations are exercised through the
+    # shared _derive_* builders above — coverage here checks the TABLE
+    # is consistent, the multichip bench phase exercises them live
+    unexercised = {
+        op for op in jitcert.SITE_DERIVATIONS
+        if op not in ops_seen and not op.startswith("sharded.")}
+    for op in sorted(unexercised):
+        report["problems"].append(
+            f"SITE_DERIVATIONS op {op} not exercised by the battery")
+    report["ok"] = not report["problems"]
+    report["ops_certified"] = sorted(ops_seen)
+    report["total_signatures"] = sum(
+        e["n_signatures"] for entries in report["kernels"].values()
+        for e in entries)
+    if as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        state = "OK" if report["ok"] else "FAILED"
+        print(f"jitcert certify: {state} — {len(ops_seen)} site "
+              f"families, {report['total_signatures']} certified "
+              f"signatures across {len(kernels)} battery kernels"
+              + ("" if report["ok"]
+                 else "\n  " + "\n  ".join(report["problems"])))
+    return 0 if report["ok"] else 1
+
+
+def _drive(kernels) -> None:
+    """Exercise every battery kernel's jit sites across the signature
+    axes the certificates promise to close."""
+    import numpy as np
+
+    from ekuiper_tpu.ops.groupby import DeviceGroupBy
+
+    def feed(gb: DeviceGroupBy, with_masks: bool, pane_vec: bool,
+             n_keys: int = 8):
+        cols = {}
+        valid = {}
+        n = 10
+        for name in gb.plan.columns:
+            if name.startswith("__hhc__"):
+                cols[name] = np.arange(n, dtype=np.float32) % 3
+            else:
+                cols[name] = np.arange(n, dtype=np.float64)
+            if with_masks:
+                valid[name] = np.ones(n, dtype=np.bool_)
+        slots = (np.arange(n, dtype=np.int32) % n_keys)
+        pane = (np.zeros(n, dtype=np.int64) if pane_vec else 0)
+        return cols, valid, slots, pane
+
+    for name, gb in kernels.items():
+        if name == "sketch":
+            gb.update(np.arange(10, dtype=np.float32))
+            gb.update(np.arange(300, dtype=np.float32))  # next pad bucket
+            gb.heavy_hitters(3)
+            continue
+        state = gb.init_state()
+        cols, valid, slots, pane = feed(gb, with_masks=False,
+                                        pane_vec=False)
+        state = gb.fold(state, cols, slots, pane_idx=pane)
+        cols, valid, slots, pane = feed(gb, with_masks=True,
+                                        pane_vec=gb.n_panes > 1)
+        state = gb.fold(state, cols, slots, valid=valid, pane_idx=pane)
+        outs, act = gb.finalize(state, 8)
+        if gb.n_panes > 1:
+            outs, act = gb.finalize(state, 8, panes=[0, 1])
+        state = gb.reset_pane(state, 0)
+        # capacity growth across a doubling: re-specialization must stay
+        # inside the certified ladder
+        state = gb.grow(state, gb.capacity * 2)
+        cols, valid, slots, pane = feed(gb, with_masks=False,
+                                        pane_vec=False)
+        state = gb.fold(state, cols, slots, pane_idx=pane)
+        outs, act = gb.finalize(state, 8)
+
+
+def diff(as_json: bool = False) -> int:
+    from ekuiper_tpu.observability import jitcert
+
+    kernels = _battery()
+    _drive(kernels)
+    report = jitcert.diff_live()
+    if as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        state = "OK" if report["clean"] else "FAILED"
+        print(f"jitcert diff: {state} — {report['observed_signatures']} "
+              f"observed signatures over {report['sites_observed']} live "
+              f"sites, {report['certified_signatures']} certified"
+              + ("" if report["clean"] else "\n  " + "\n  ".join(
+                  f"{u['op']} [{u['rule'] or '__engine__'}]: "
+                  f"{u['signature'][:140]}"
+                  for u in report["uncertified"])))
+    return 0 if report["clean"] else 1
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="tools.jitcert", description=__doc__.splitlines()[0])
+    ap.add_argument("command", choices=["certify", "diff"])
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.command == "certify":
+        return certify(as_json=args.json)
+    return diff(as_json=args.json)

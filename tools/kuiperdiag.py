@@ -279,6 +279,25 @@ def smoke() -> int:
             problems.append("control.admission.decisions")
         if "shedding" not in ctl or "autosize" not in ctl:
             problems.append("control.shedding/autosize")
+        # jitcert compile-contract diff: the xla section must carry the
+        # certificate diff with a verdict and the uncertified report
+        # list (empty on a healthy engine — observed ⊆ certified)
+        jc = (bundle.get("xla") or {}).get("jitcert") or {}
+        if "clean" not in jc or not isinstance(jc.get("uncertified"),
+                                               list):
+            problems.append("xla.jitcert diff shape")
+        elif jc.get("sites_certified", 0) <= 0:
+            problems.append("xla.jitcert.sites_certified (live rule has "
+                            "no registered certificates)")
+        elif jc.get("sites_open", 0) > 0:
+            problems.append(
+                "xla.jitcert open (unenforced) sites: "
+                + "; ".join(f"{u['op']}" for u in jc["open_sites"][:3]))
+        elif not jc["clean"]:
+            problems.append(
+                "xla.jitcert uncertified signatures: "
+                + "; ".join(f"{u['op']}: {u['signature'][:80]}"
+                            for u in jc["uncertified"][:3]))
         # kernel observatory: the section must name the device and carry
         # the site list (sampling may legitimately be empty this early)
         kern = bundle.get("kernels") or {}

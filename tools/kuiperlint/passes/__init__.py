@@ -3,5 +3,6 @@ from . import clock  # noqa: F401
 from . import donation  # noqa: F401
 from . import hostsync  # noqa: F401
 from . import jit  # noqa: F401
+from . import jitcert  # noqa: F401
 from . import locks  # noqa: F401
 from . import metric_hygiene  # noqa: F401

@@ -1,0 +1,496 @@
+"""jitcert static passes — compile-contract enforcement at lint time.
+
+Two rules close the loop that ekuiper_tpu/observability/jitcert.py opens:
+
+* **cert-coverage** — every `watched_jit` site in ops/ and parallel/
+  must resolve (statically) to an op name with a registered certificate
+  derivation (`jitcert.SITE_DERIVATIONS`). A jit site nobody can derive
+  a closed signature set for is exactly the site whose recompile storm
+  devwatch will one day flag at runtime — fail it at lint time instead.
+  Op names resolve from the `op=` keyword: a string literal, or
+  `self._watch_op("<suffix>")` combined with the enclosing class's (or a
+  same-file base class's) literal `watch_prefix`.
+
+* **sig-stability** — signature-unstable idioms inside jit-traced bodies
+  (the functions handed to watched_jit, plus same-file helpers they pass
+  traced values into):
+    - branching (`if`/`while`/ternary/`assert`) on a traced value —
+      trace-time control flow silently specializes one executable per
+      branch outcome. Structure tests (`x is None`), shape reads
+      (`x.shape/.ndim/.dtype`, `getattr(x, "ndim", ...)`, `len(x)`,
+      `isinstance(x, ...)`) are static under tracing and stay legal.
+    - `len(...)`-derived slicing inside a jit body — `arr[:len(rows)]`
+      compiles one executable per batch length; pad to the declared
+      micro-batch bucket instead (runtime/ingest.py builders).
+    - Python-scalar closure capture: a jit body capturing an enclosing
+      function's loop variable or literal-scalar local bakes the value
+      at trace time (stale after rebind; one executable per distinct
+      value when it feeds shapes). Capturing plan-time config objects /
+      enclosing parameters is the normal factory idiom and stays legal.
+
+Taint propagation is positional and same-file only (conservative): the
+entry body's parameters are traced; a call `self._helper(a, b)` taints
+the helper's parameters that receive tainted arguments; functions passed
+to `jax.vmap` / `shard_map` / `jax.lax.*` combinators are traced with
+every parameter tainted.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import ImportMap, LintFile, Pass, Report, register
+
+#: attribute/getattr reads that are static under tracing
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+#: calls whose result on a traced value is static under tracing
+_STATIC_CALLS = {"len", "isinstance", "getattr", "type", "sorted", "list",
+                 "range", "enumerate"}
+#: combinators whose function argument is traced (all params tainted)
+_TRACED_COMBINATORS = {"jax.vmap", "vmap", "shard_map", "jax.lax.scan",
+                       "jax.lax.map", "jax.checkpoint", "functools.partial"}
+
+
+def _site_scope() -> Tuple[str, ...]:
+    return ("ekuiper_tpu/ops/**", "ekuiper_tpu/parallel/**")
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef) -> None:
+        self.node = node
+        self.watch_prefix: Optional[str] = None
+        self.bases = [b.id for b in node.bases if isinstance(b, ast.Name)]
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        for child in node.body:
+            if isinstance(child, ast.Assign):
+                for t in child.targets:
+                    if (isinstance(t, ast.Name) and t.id == "watch_prefix"
+                            and isinstance(child.value, ast.Constant)
+                            and isinstance(child.value.value, str)):
+                        self.watch_prefix = child.value.value
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[child.name] = child
+
+
+def _classes(tree: ast.AST) -> Dict[str, _ClassInfo]:
+    return {n.name: _ClassInfo(n)
+            for n in ast.walk(tree) if isinstance(n, ast.ClassDef)}
+
+
+def _resolve_prefix(cls: Optional[_ClassInfo],
+                    classes: Dict[str, _ClassInfo]) -> Optional[str]:
+    """watch_prefix of a class, chasing same-file bases (ShardedGroupBy
+    overrides DeviceGroupBy's; BatchedGroupBy too)."""
+    seen: Set[str] = set()
+    while cls is not None:
+        if cls.watch_prefix is not None:
+            return cls.watch_prefix
+        nxt = None
+        for b in cls.bases:
+            if b in classes and b not in seen:
+                seen.add(b)
+                nxt = classes[b]
+                break
+        cls = nxt
+    return None
+
+
+def _watched_jit_calls(tree: ast.AST, imports: ImportMap):
+    """Yield (call_node, enclosing_class_name) for every watched_jit()."""
+    def walk(node, cls_name):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+                continue
+            if isinstance(child, ast.Call):
+                target = imports.resolve_call(child.func)
+                if target is not None and (
+                        target == "watched_jit"
+                        or target.endswith(".watched_jit")):
+                    yield_list.append((child, cls_name))
+            walk(child, cls_name)
+
+    yield_list: List[Tuple[ast.Call, Optional[str]]] = []
+    walk(tree, None)
+    return yield_list
+
+
+@register
+class CertCoverage(Pass):
+    name = "cert-coverage"
+    description = ("every watched_jit site in ops//parallel/ must have a "
+                   "jitcert certificate derivation")
+    scope = _site_scope()
+
+    def visit(self, f: LintFile, report: Report) -> None:
+        try:
+            from ekuiper_tpu.observability.jitcert import SITE_DERIVATIONS
+        except Exception as exc:  # pragma: no cover - import env issue
+            report.add_at(self.name, f.path, 1, 1,
+                          f"cannot import jitcert derivations: {exc}")
+            return
+        imports = ImportMap(f.tree)
+        classes = _classes(f.tree)
+        for call, cls_name in _watched_jit_calls(f.tree, imports):
+            op = self._op_name(call, cls_name, classes)
+            if op is None:
+                report.add(
+                    self.name, f, call,
+                    "watched_jit site's op name is not statically "
+                    "resolvable — use a string literal or "
+                    'self._watch_op("<suffix>") with a literal '
+                    "watch_prefix so jitcert can bind a certificate")
+                continue
+            if isinstance(op, tuple):  # suffix with unresolved prefix
+                suffix = op[1]
+                if any(k.endswith(f".{suffix}")
+                       for k in SITE_DERIVATIONS):
+                    continue
+                report.add(
+                    self.name, f, call,
+                    f"no jitcert derivation matches *.{suffix} — "
+                    "register one in ekuiper_tpu/observability/"
+                    "jitcert.py SITE_DERIVATIONS")
+                continue
+            if op not in SITE_DERIVATIONS:
+                report.add(
+                    self.name, f, call,
+                    f"watched_jit site {op!r} has no certificate "
+                    "derivation — register one in ekuiper_tpu/"
+                    "observability/jitcert.py SITE_DERIVATIONS "
+                    "(docs/STATIC_ANALYSIS.md § certifying a new site)")
+
+    @staticmethod
+    def _op_name(call: ast.Call, cls_name: Optional[str],
+                 classes: Dict[str, _ClassInfo]):
+        """The site's op: a str (fully resolved), (None, suffix) when
+        only the suffix resolved, or None (unresolvable)."""
+        op_kw = None
+        for kw in call.keywords:
+            if kw.arg == "op":
+                op_kw = kw.value
+        if op_kw is None and len(call.args) >= 2:
+            op_kw = call.args[1]
+        if op_kw is None:
+            return None
+        if isinstance(op_kw, ast.Constant) and isinstance(op_kw.value, str):
+            return op_kw.value
+        # self._watch_op("suffix") -> watch_prefix + "." + suffix
+        if (isinstance(op_kw, ast.Call)
+                and isinstance(op_kw.func, ast.Attribute)
+                and op_kw.func.attr == "_watch_op"
+                and op_kw.args
+                and isinstance(op_kw.args[0], ast.Constant)
+                and isinstance(op_kw.args[0].value, str)):
+            suffix = op_kw.args[0].value
+            prefix = _resolve_prefix(classes.get(cls_name or ""), classes)
+            if prefix is not None:
+                return f"{prefix}.{suffix}"
+            return (None, suffix)
+        return None
+
+
+# ------------------------------------------------------------ sig-stability
+class _FnAnalysis:
+    __slots__ = ("fn", "cls_name", "tainted", "encl")
+
+    def __init__(self, fn, cls_name, tainted, encl) -> None:
+        self.fn = fn
+        self.cls_name = cls_name
+        self.tainted: Set[str] = tainted
+        self.encl = encl  # enclosing FunctionDef for closures, or None
+
+
+@register
+class SigStability(Pass):
+    name = "sig-stability"
+    description = ("signature-unstable idioms inside jit-traced bodies "
+                   "(traced-value branching, len()-derived slicing, "
+                   "scalar closure capture)")
+    scope = _site_scope()
+
+    def visit(self, f: LintFile, report: Report) -> None:
+        imports = ImportMap(f.tree)
+        classes = _classes(f.tree)
+        self._tree = f.tree
+        # enclosing-function map for every FunctionDef/Lambda
+        encl: Dict[ast.AST, Optional[ast.AST]] = {}
+        self._map_enclosing(f.tree, None, encl)
+        entries = self._entry_bodies(f.tree, imports, classes, encl)
+        analyzed: Set[Tuple[int, frozenset]] = set()
+        queue = list(entries)
+        while queue:
+            an = queue.pop()
+            key = (id(an.fn), frozenset(an.tainted))
+            if key in analyzed:
+                continue
+            analyzed.add(key)
+            self._check_body(an, f, report, imports)
+            queue.extend(self._expand_calls(an, classes, imports, encl))
+
+    # ------------------------------------------------------- entry finding
+    def _entry_bodies(self, tree, imports, classes, encl):
+        out: List[_FnAnalysis] = []
+        for call, cls_name in _watched_jit_calls(tree, imports):
+            if not call.args:
+                continue
+            fn = self._resolve_fn(call.args[0], cls_name, classes, call,
+                                  encl)
+            if fn is None:
+                continue
+            params = self._params(fn)
+            out.append(_FnAnalysis(fn, cls_name, set(params),
+                                   encl.get(fn)))
+        return out
+
+    @staticmethod
+    def _params(fn) -> List[str]:
+        if isinstance(fn, ast.Lambda):
+            args = fn.args
+        else:
+            args = fn.args
+        names = [a.arg for a in args.posonlyargs + args.args
+                 + args.kwonlyargs]
+        return [n for n in names if n != "self"]
+
+    def _resolve_fn(self, expr, cls_name, classes, call, encl):
+        """First arg of watched_jit -> a FunctionDef/Lambda in this file:
+        self._x_impl (method), bare name (local def), or inline lambda."""
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and cls_name):
+            cls = classes.get(cls_name)
+            seen: Set[str] = set()
+            while cls is not None:
+                m = cls.methods.get(expr.attr)
+                if m is not None:
+                    return m
+                nxt = None
+                for b in cls.bases:
+                    if b in classes and b not in seen:
+                        seen.add(b)
+                        nxt = classes[b]
+                        break
+                cls = nxt
+            return None
+        if isinstance(expr, ast.Name):
+            # nearest enclosing scope holding a def of that name, then
+            # the module's top level (module-scope jit sites)
+            scope = encl.get(call)
+            while scope is not None:
+                for n in ast.walk(scope):
+                    if (isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))
+                            and n.name == expr.id):
+                        return n
+                scope = encl.get(scope)
+            for n in ast.walk(getattr(self, "_tree", ast.Module(body=[],
+                                                                type_ignores=[]))):
+                if (isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and n.name == expr.id):
+                    return n
+        return None
+
+    def _map_enclosing(self, node, current, encl):
+        for child in ast.iter_child_nodes(node):
+            encl[child] = current
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                self._map_enclosing(child, child, encl)
+            else:
+                self._map_enclosing(child, current, encl)
+
+    # ----------------------------------------------------------- expansion
+    def _expand_calls(self, an, classes, imports, encl):
+        """Same-file helpers receiving tainted values become analysis
+        targets with positionally-tainted params; functions handed to
+        vmap/shard_map trace with every param tainted."""
+        out: List[_FnAnalysis] = []
+        body = (an.fn.body if isinstance(an.fn.body, list)
+                else [an.fn.body])
+        for node in [n for stmt in body for n in ast.walk(stmt)]:
+            if not isinstance(node, ast.Call):
+                continue
+            target = imports.resolve_call(node.func)
+            if target in _TRACED_COMBINATORS or (
+                    target is not None
+                    and target.startswith("jax.lax.")):
+                for arg in node.args:
+                    fn = self._resolve_fn(arg, an.cls_name, classes,
+                                          node, encl)
+                    if fn is not None:
+                        out.append(_FnAnalysis(
+                            fn, an.cls_name, set(self._params(fn)),
+                            encl.get(fn)))
+                continue
+            fn = None
+            if (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self" and an.cls_name):
+                fn = self._resolve_fn(node.func, an.cls_name, classes,
+                                      node, encl)
+            elif isinstance(node.func, ast.Name):
+                fn = self._resolve_fn(node.func, an.cls_name, classes,
+                                      node, encl)
+            if fn is None or fn is an.fn:
+                continue
+            params = self._params(fn)
+            tainted: Set[str] = set()
+            for i, arg in enumerate(node.args):
+                if i < len(params) and self._is_tainted(arg, an.tainted):
+                    tainted.add(params[i])
+            for kw in node.keywords:
+                if kw.arg in params and self._is_tainted(kw.value,
+                                                         an.tainted):
+                    tainted.add(kw.arg)
+            if tainted:
+                out.append(_FnAnalysis(fn, an.cls_name, tainted,
+                                       encl.get(fn)))
+        return out
+
+    @staticmethod
+    def _is_tainted(expr, tainted: Set[str]) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in tainted
+                   for n in ast.walk(expr))
+
+    # -------------------------------------------------------------- checks
+    def _check_body(self, an, f: LintFile, report: Report,
+                    imports: ImportMap) -> None:
+        fn = an.fn
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        # local taint: names assigned FROM tainted expressions inside the
+        # body stay untracked (conservative: direct param uses only),
+        # EXCEPT len()-derived names, which feed the slicing check
+        len_names: Set[str] = set()
+        for stmt in [n for s in body for n in ast.walk(s)]:
+            if (isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Call)
+                    and imports.resolve_call(stmt.value.func) == "len"):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        len_names.add(t.id)
+        for node in [n for s in body for n in ast.walk(s)]:
+            if isinstance(node, (ast.If, ast.While)):
+                self._check_test(node.test, an, f, report)
+            elif isinstance(node, ast.IfExp):
+                self._check_test(node.test, an, f, report)
+            elif isinstance(node, ast.Assert):
+                self._check_test(node.test, an, f, report)
+            elif isinstance(node, ast.Subscript):
+                self._check_slice(node, an, f, report, imports,
+                                  len_names)
+        if an.encl is not None:
+            self._check_closure(an, f, report)
+
+    def _check_test(self, test, an, f, report) -> None:
+        for name in self._unstable_names(test, an.tainted):
+            report.add(
+                self.name, f, test,
+                f"jit body branches on traced value {name!r} — "
+                "trace-time control flow compiles one executable per "
+                "outcome (shape/structure tests are legal; use "
+                "jnp.where/lax.cond for value-dependent paths)")
+            return  # one finding per test
+
+    @classmethod
+    def _unstable_names(cls, test, tainted: Set[str]) -> List[str]:
+        """Tainted Names in `test` that are not wrapped in a
+        static-under-tracing form."""
+        allowed: Set[int] = set()
+        for node in ast.walk(test):
+            if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+                for sub in ast.walk(node):
+                    allowed.add(id(sub))
+            elif isinstance(node, ast.Call):
+                fname = None
+                if isinstance(node.func, ast.Name):
+                    fname = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    fname = node.func.attr
+                if fname in _STATIC_CALLS:
+                    for sub in ast.walk(node):
+                        allowed.add(id(sub))
+            elif isinstance(node, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in node.ops):
+                for sub in ast.walk(node):
+                    allowed.add(id(sub))
+        return [n.id for n in ast.walk(test)
+                if isinstance(n, ast.Name) and n.id in tainted
+                and id(n) not in allowed]
+
+    def _check_slice(self, node: ast.Subscript, an, f, report,
+                     imports, len_names: Set[str]) -> None:
+        sl = node.slice
+        bad = False
+        for sub in ast.walk(sl):
+            if (isinstance(sub, ast.Call)
+                    and imports.resolve_call(sub.func) == "len"):
+                bad = True
+            elif isinstance(sub, ast.Name) and sub.id in len_names:
+                bad = True
+        if bad and self._is_tainted(node.value, an.tainted):
+            report.add(
+                self.name, f, node,
+                "len()-derived slice of a traced value inside a jit "
+                "body — one executable per batch length; pad to the "
+                "declared micro-batch bucket instead "
+                "(runtime/ingest.py pad_col_for_device)")
+
+    def _check_closure(self, an, f, report) -> None:
+        """Flag captures of enclosing-scope loop variables / literal
+        scalars (baked at trace time)."""
+        encl = an.encl
+        local_binds: Set[str] = set(self._params(an.fn))
+        body = (an.fn.body if isinstance(an.fn.body, list)
+                else [an.fn.body])
+        for node in [n for s in body for n in ast.walk(s)]:
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.For)):
+                tgts = (node.targets if isinstance(node, ast.Assign)
+                        else [node.target])
+                for t in tgts:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            local_binds.add(sub.id)
+        # suspicious enclosing bindings: loop targets + literal scalars,
+        # collected from the enclosing function's OWN scope only — a
+        # sibling nested function's loop variables/locals are a
+        # different scope and must not poison this body's capture check
+        # (ast.walk cannot prune, so walk with an explicit stack)
+        suspicious: Dict[str, str] = {}
+        stack = list(ast.iter_child_nodes(encl))
+        own_scope: List[ast.AST] = []
+        while stack:
+            node = stack.pop()
+            own_scope.append(node)
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(node))
+        for node in own_scope:
+            if isinstance(node, ast.For):
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name):
+                        suspicious[sub.id] = "loop variable"
+            elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Constant) and isinstance(
+                    node.value.value, (int, float, str, bool)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        suspicious[t.id] = "literal scalar"
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name):
+                suspicious[node.target.id] = "mutated scalar"
+        for node in [n for s in body for n in ast.walk(s)]:
+            if (isinstance(node, ast.Name) and node.id in suspicious
+                    and node.id not in local_binds
+                    and node.id not in an.tainted):
+                report.add(
+                    self.name, f, node,
+                    f"jit body captures enclosing {suspicious[node.id]} "
+                    f"{node.id!r} — the value bakes into the trace "
+                    "(stale after rebind, re-specializes per value); "
+                    "pass it as a kernel argument or bind it via a "
+                    "default/functools.partial at definition time")
+                return

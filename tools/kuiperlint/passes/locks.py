@@ -13,10 +13,16 @@ skipped, never guessed):
   to `self.X` (node id `module.Class.X`) or a module-level name
   (`module.X`). `Condition(existing_lock)` aliases to the lock it wraps
   — taking the condition IS taking the lock.
-* An EDGE A -> B is added when `with B` appears lexically inside
-  `with A`, or when a call made while holding A resolves (same-class
-  method, same-module function, or imported module function) to a
-  function whose transitive acquire set contains B.
+* An ACQUISITION is `with <lock>` (scoped to the with body) or an
+  explicit `<lock>.acquire()` statement, held through the following
+  statements (including `try:` bodies) until the matching
+  `<lock>.release()` — the `acquire(); try: ... finally: release()`
+  idiom. Non-blocking tries (`acquire(blocking=False)`) are skipped: a
+  failed try-lock cannot deadlock an ABBA square.
+* An EDGE A -> B is added when B is acquired while A is held, or when a
+  call made while holding A resolves (same-class method, same-module
+  function, or imported module function) to a function whose transitive
+  acquire set contains B.
 * A cycle in the resulting graph means two code paths can take the same
   locks in opposite orders; the report names the cycle and one witness
   site per edge.
@@ -191,8 +197,44 @@ class LockOrder(Pass):
     def _walk_fn(self, body, held: List[str], info: _FnInfo, f: LintFile,
                  mod: str, cls_name: Optional[str],
                  imports: _Imports) -> None:
+        # a LOCAL mutable copy: explicit `<lock>.acquire()` statements
+        # extend the held set for the REST of this statement sequence
+        # (and its nested bodies — the shared list flows into compound
+        # statements), `<lock>.release()` retires them; `with` blocks
+        # keep their lexical scoping via the copy made per call
+        held = list(held)
         for stmt in body:
             self._walk_stmt(stmt, held, info, f, mod, cls_name, imports)
+
+    def _explicit_lock_call(self, node: ast.AST, mod: str,
+                            cls_name: Optional[str]):
+        """(kind, lock_id, call) for a statement-level explicit
+        `<lock>.acquire()` / `<lock>.release()`, else None. Kind is
+        'acquire' | 'release'; non-blocking acquires return None."""
+        call = None
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            call = node.value
+        elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                         ast.Call):
+            call = node.value
+        if call is None or not isinstance(call.func, ast.Attribute):
+            return None
+        kind = call.func.attr
+        if kind not in ("acquire", "release"):
+            return None
+        lock_id = self._expr_lock_id(call.func.value, mod, cls_name)
+        if lock_id is None:
+            return None
+        if kind == "acquire":
+            for i, a in enumerate(call.args):
+                if i == 0 and isinstance(a, ast.Constant) and a.value is False:
+                    return None  # non-blocking try-lock
+            for kw in call.keywords:
+                if (kw.arg == "blocking"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False):
+                    return None
+        return kind, lock_id, call
 
     def _walk_stmt(self, node: ast.AST, held: List[str], info: _FnInfo,
                    f: LintFile, mod: str, cls_name: Optional[str],
@@ -200,6 +242,22 @@ class LockOrder(Pass):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
             return  # nested defs get their own scope via _functions()
+        explicit = self._explicit_lock_call(node, mod, cls_name)
+        if explicit is not None:
+            kind, lock_id, call = explicit
+            # argument expressions run before the acquisition
+            for a in list(call.args) + [kw.value for kw in call.keywords]:
+                self._scan_calls(a, held, info, f, mod, cls_name, imports)
+            if kind == "acquire":
+                info.acquires.add(lock_id)
+                for h in held:
+                    if h != lock_id:
+                        self.edges.setdefault((h, lock_id),
+                                              (f.path, call.lineno))
+                held.append(lock_id)
+            elif lock_id in held:
+                held.remove(lock_id)
+            return
         if isinstance(node, (ast.With, ast.AsyncWith)):
             acquired: List[str] = []
             for item in node.items:
@@ -215,8 +273,18 @@ class LockOrder(Pass):
                         self.edges.setdefault(
                             (h, lock_id), (f.path, item.context_expr.lineno))
                 acquired.append(lock_id)
-            self._walk_fn(node.body, held + acquired, info, f, mod,
-                          cls_name, imports)
+            # the with's OWN acquisitions scope to its body, but an
+            # explicit `<lock>.acquire()` INSIDE the body outlives the
+            # block — walk the body on a working list, then carry its
+            # net effect (minus the with-scoped locks) back out
+            inner = held + acquired
+            for stmt in node.body:
+                self._walk_stmt(stmt, inner, info, f, mod, cls_name,
+                                imports)
+            for lock_id in acquired:
+                if lock_id in inner:
+                    inner.remove(lock_id)
+            held[:] = inner
             return
         # non-with statement: record calls (with held context), then
         # recurse into compound-statement bodies — including non-stmt
