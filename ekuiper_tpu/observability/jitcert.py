@@ -355,6 +355,84 @@ def _derive_boundary(ks: KernelShape, op: str, rule: Optional[str],
                     full_count=grows + 1)
 
 
+def _ring_leaves(comps: Dict[str, Tuple[int, int]], capacity: int,
+                 ring_slots: int) -> List[str]:
+    """Signature leaves of a sliding-ring state pytree
+    (ops/slidingring.py): running window totals (`tot_*`, [capacity,...])
+    for add-combine components, two-stack back/front partials
+    (`back_*` [capacity,...] + `front_*` [ring_slots, capacity,...]) for
+    min/max-combine ones; dict keys sort."""
+    from ..ops.slidingring import ADD_COMBINE
+
+    entries: Dict[str, Tuple[int, ...]] = {}
+    for comp in sorted(list(comps) + ["act"]):
+        if comp == "act":
+            dims: Tuple[int, ...] = ()
+        else:
+            k, wide = comps[comp]
+            dims = (k,) + ((wide,) if wide else ())
+        if comp in ADD_COMBINE:
+            entries[f"tot_{comp}"] = (capacity,) + dims
+        else:
+            entries[f"back_{comp}"] = (capacity,) + dims
+            entries[f"front_{comp}"] = (ring_slots, capacity) + dims
+    return [_arr("float32", *entries[k]) for k in sorted(entries)]
+
+
+def _derive_ring(ks: KernelShape, op: str, rule: Optional[str],
+                 ring_slots: int, tail: str,
+                 grows: int = MAX_GROWS) -> SiteCert:
+    """slidingring advance/flip/query: ring state + pane state over the
+    capacity ladder, with plan-time-fixed ring geometry. `tail` is one of:
+    advance — scalar closed/evict slot indices + on flags,
+    flip    — int32[R] age-ordered slot rotation + bool[R] validity,
+    query   — body/front flags + front row index + QUERY_ADJ adjustment
+              slot/weight/include vectors."""
+    from ..ops.slidingring import QUERY_ADJ
+
+    sigs: List[str] = []
+    deriv = [
+        f"capacity ladder: {ks.base_capacity} x2^0..{grows} "
+        "(ops/keytable.py doubling; ring grows in lockstep)",
+        f"ring slots fixed at plan time: {ring_slots} "
+        "(ops/slidingring.py plan_ring_layout)",
+        "components split by combine class: subtract-on-evict totals "
+        "(n/s1/s2/hist/hh/act) vs two-stack front/back partials "
+        "(mn/mx/hll)",
+    ]
+    for cap in _ladder(ks.base_capacity, grows):
+        ring = _ring_leaves(ks.comps, cap, ring_slots)
+        pane = _state_leaves(ks.comps, ks.n_panes, cap)
+        if tail == "advance":
+            t = [_arr("int32"), _arr("bool"), _arr("int32"), _arr("bool")]
+        elif tail == "flip":
+            t = [_arr("int32", ring_slots), _arr("bool", ring_slots)]
+        elif tail == "query":
+            t = [_arr("bool"), _arr("bool"), _arr("int32"),
+                 _arr("int32", QUERY_ADJ), _arr("float32", QUERY_ADJ),
+                 _arr("bool", QUERY_ADJ)]
+        else:  # pragma: no cover - derivation bug
+            raise ValueError(f"unknown ring tail {tail!r}")
+        sigs.append(_sig(ring + pane + t))
+    if tail == "advance":
+        deriv.append("tail: scalar closed/evict pane slots + on flags "
+                     "(one executable per capacity)")
+    elif tail == "flip":
+        deriv.append(f"tail: int32[{ring_slots}] slot rotation + "
+                     f"bool[{ring_slots}] validity (the amortized rebuild)")
+    else:
+        deriv.append(f"tail: body/front flags, front row, and "
+                     f"{QUERY_ADJ} pane-slice adjustment slots "
+                     "(constant-time trigger)")
+    return SiteCert(op, rule, "_derive_ring",
+                    {"base_capacity": ks.base_capacity, "grows": grows,
+                     "ring_slots": ring_slots, "n_panes": ks.n_panes,
+                     "tail": tail, "query_adj": QUERY_ADJ,
+                     "comps": {c: list(v) for c, v in ks.comps.items()}},
+                    frozenset(sigs), deriv, len(sigs) > ENUM_CAP,
+                    full_count=grows + 1)
+
+
 def _derive_sketch(op: str, rule: Optional[str], depth: int, width: int,
                    query_only: bool = False) -> SiteCert:
     """count-min update/query: the value batch pads to the next power of
@@ -395,6 +473,8 @@ def _groupby_certs(kernel, prefix: str, rule: Optional[str]
         _derive_boundary(ks, f"{prefix}.finalize", rule, "static_all"),
         _derive_boundary(ks, f"{prefix}.finalize_dyn", rule, "pane_mask"),
         _derive_boundary(ks, f"{prefix}.components", rule, "static_all"),
+        _derive_boundary(ks, f"{prefix}.components_dyn", rule,
+                         "pane_mask"),
         _derive_boundary(ks, f"{prefix}.reset_pane", rule, "pane_scalar"),
         _derive_boundary(ks, f"{prefix}.absorb", rule, "shadow"),
     ]
@@ -428,10 +508,27 @@ def _sharded_certs(kernel, rule: Optional[str]) -> List[SiteCert]:
     ]
 
 
+def _sliding_ring_certs(kernel, rule: Optional[str]) -> List[SiteCert]:
+    ks = _kernel_shape(kernel.gb)
+    # the ring pins its OWN base capacity at registration (it is created
+    # alongside the group-by kernel, but battery/admission constructions
+    # may differ)
+    ks.base_capacity = int(getattr(kernel, "_jitcert_base_capacity",
+                                   kernel.capacity))
+    slots = int(kernel.n_ring_panes)
+    return [
+        _derive_ring(ks, "slidingring.advance", rule, slots, "advance"),
+        _derive_ring(ks, "slidingring.flip", rule, slots, "flip"),
+        _derive_ring(ks, "slidingring.query", rule, slots, "query"),
+    ]
+
+
 def certificates_for(kernel, rule: Optional[str] = None) -> List[SiteCert]:
     """Derive every certificate a kernel object's jit sites are bound by.
     Dispatches on the same `watch_prefix` devwatch attribution uses."""
     prefix = getattr(kernel, "watch_prefix", None)
+    if prefix == "slidingring":
+        return _sliding_ring_certs(kernel, rule)
     if prefix == "multirule":
         return _multirule_certs(kernel, rule)
     if prefix == "sharded":
@@ -460,6 +557,7 @@ SITE_DERIVATIONS: Dict[str, str] = {
     "groupby.finalize": "_derive_boundary(static_all)",
     "groupby.finalize_dyn": "_derive_boundary(pane_mask)",
     "groupby.components": "_derive_boundary(static_all)",
+    "groupby.components_dyn": "_derive_boundary(pane_mask)",
     "groupby.reset_pane": "_derive_boundary(pane_scalar)",
     "groupby.absorb": "_derive_boundary(shadow)",
     "groupby.hh_finalize": "_derive_boundary(pane_mask)",
@@ -475,6 +573,9 @@ SITE_DERIVATIONS: Dict[str, str] = {
     "sharded.absorb": "_derive_boundary(shadow)",
     "sketch.update": "_derive_sketch",
     "sketch.query": "_derive_sketch(query_only)",
+    "slidingring.advance": "_derive_ring(advance)",
+    "slidingring.flip": "_derive_ring(flip)",
+    "slidingring.query": "_derive_ring(query)",
 }
 
 
@@ -641,7 +742,8 @@ def diff_live(max_findings: int = 64) -> Dict[str, Any]:
 
 # --------------------------------------------------- admission estimation
 def estimate_plan_signatures(plan, n_panes: int, micro_batch: int,
-                             capacity: int) -> int:
+                             capacity: int,
+                             sliding_ring_slots: int = 0) -> int:
     """Certified signature count a candidate device rule adds at its
     CONSTRUCTION capacity (growth steps respecialize later, paced by key
     cardinality, not admission) — the compile load admission prices
@@ -650,7 +752,9 @@ def estimate_plan_signatures(plan, n_panes: int, micro_batch: int,
     set: a wide-column rule whose subset enumeration truncates must
     price its TRUE 2^n surface, or the signature budget inverts —
     admitting the compile-heaviest rules while rejecting narrower
-    ones."""
+    ones. `sliding_ring_slots` > 0 prices a DABA sliding rule's extra
+    surface (slidingring.advance/flip/query + the components_dyn
+    fallback) so the budget cannot under-price sliding candidates."""
     ks = shape_from_plan(plan, n_panes, micro_batch, capacity)
     certs = [
         _derive_fold(ks, "groupby.fold", None, grows=0),
@@ -666,4 +770,12 @@ def estimate_plan_signatures(plan, n_panes: int, micro_batch: int,
     if ks.host_finalize_only:
         certs.append(_derive_boundary(ks, "groupby.hh_finalize", None,
                                       "pane_mask", grows=0))
+    elif sliding_ring_slots > 0:
+        certs.append(_derive_boundary(ks, "groupby.components_dyn", None,
+                                      "pane_mask", grows=0))
+        for op, tail in (("slidingring.advance", "advance"),
+                         ("slidingring.flip", "flip"),
+                         ("slidingring.query", "query")):
+            certs.append(_derive_ring(ks, op, None, sliding_ring_slots,
+                                      tail, grows=0))
     return sum(c.full_count for c in certs)

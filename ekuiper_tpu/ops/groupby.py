@@ -138,6 +138,13 @@ class DeviceGroupBy:
                                        op=self._watch_op("components"),
                                        kind="boundary",
                                        static_argnums=(1,))
+        # traced-pane-mask components twin: the sliding ring's exact
+        # fallback (delayed emissions, recycled panes) merges an arbitrary
+        # live-pane subset into the SAME stacked components layout with
+        # one compiled executable per capacity
+        self._components_dyn = watched_jit(self._components_dyn_impl,
+                                           op=self._watch_op("components_dyn"),
+                                           kind="boundary")
         self._reset_pane = watched_jit(self._reset_pane_impl,
                                        op=self._watch_op("reset_pane"),
                                        kind="boundary",
@@ -500,6 +507,23 @@ class DeviceGroupBy:
         return self._components_body(
             state, np.array(pane_mask_tuple, dtype=np.bool_))
 
+    def _components_dyn_impl(self, state, pane_mask):
+        return self._components_body(state, pane_mask)
+
+    def components_begin_dyn(self, state: Dict[str, Any],
+                             pane_mask: np.ndarray):
+        """Dispatch the traced-mask components merge over an arbitrary
+        live-pane subset and start the async copy; returns a
+        PendingFinalize sharing prefinalize_merge's host tail. The
+        sliding ring's exact fallback route (runtime/nodes_fused.py)."""
+        import jax.numpy as jnp
+
+        from .prefinalize import begin_pending
+
+        out = self._components_dyn(
+            state, jnp.asarray(pane_mask, dtype=jnp.bool_))
+        return begin_pending(out, self.capacity, self._components_layout())
+
     def _components_body(self, state, pane_mask):
         import jax.numpy as jnp
 
@@ -525,16 +549,10 @@ class DeviceGroupBy:
         device→host copy; returns a PendingFinalize. Non-blocking: the jax
         program sees an immutable snapshot of `state`, so subsequent folds
         don't disturb it."""
-        import jax
-
-        from .prefinalize import PendingFinalize
+        from .prefinalize import begin_pending
 
         out = self._components(state, self._pane_mask(panes))
-        try:
-            out.copy_to_host_async()
-        except AttributeError:
-            pass
-        return PendingFinalize(out, self.capacity, self._components_layout())
+        return begin_pending(out, self.capacity, self._components_layout())
 
     def _final_from_components(
         self, comb: Dict[str, np.ndarray], n_keys: int,

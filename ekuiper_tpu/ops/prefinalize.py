@@ -410,6 +410,17 @@ class IdentityFinalize:
         return self._comps
 
 
+def begin_pending(stacked, capacity: int, layout) -> "PendingFinalize":
+    """Start the async device→host copy of a dispatched components array
+    and wrap it — the ONE async-fetch protocol shared by the prefinalize,
+    components_dyn, and sliding-ring dispatch sites."""
+    try:
+        stacked.copy_to_host_async()
+    except AttributeError:
+        pass
+    return PendingFinalize(stacked, capacity, layout)
+
+
 class PendingFinalize:
     """Handle for an in-flight device components fetch, created one RTT
     before the window boundary.

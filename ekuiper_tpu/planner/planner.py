@@ -92,6 +92,7 @@ def merged_options(rule: RuleDef) -> RuleOptionConfig:
         "ingestRingDepth": "ingest_ring_depth",
         "ingestPrepUpload": "ingest_prep_upload",
         "slidingDevRingMb": "sliding_dev_ring_mb",
+        "slidingImpl": "sliding_impl",
         "sharedFold": "shared_fold",
     }
     for k, v in rule.options.items():
@@ -853,6 +854,14 @@ def _build_device_chain(
             mesh = mesh_from_options(mesh_cfg)
         except Exception as exc:
             raise PlanError(f"cannot build device mesh {mesh_cfg}: {exc}")
+    # sliding ring geometry is chosen HERE, at plan time, from the
+    # window/delay/pane declarations (ops/slidingring.py) — the node and
+    # the jitcert certificates both consume the same layout
+    ring_layout = None
+    if stmt.window.window_type == ast.WindowType.SLIDING_WINDOW:
+        from ..ops.slidingring import ring_layout_for
+
+        ring_layout = ring_layout_for(stmt.window, kernel_plan)
     fused = FusedWindowAggNode(
         "window_agg", stmt.window, kernel_plan, dims,
         capacity=opts.key_slots, micro_batch=opts.micro_batch_rows,
@@ -864,6 +873,8 @@ def _build_device_chain(
         is_event_time=opts.is_event_time,
         late_tolerance_ms=opts.late_tolerance_ms,
         dev_ring_budget_mb=opts.sliding_dev_ring_mb,
+        sliding_impl=opts.sliding_impl,
+        ring_layout=ring_layout,
     )
     topo.add_op(fused)
     # hand the kernel-input shape to the source's ingest prep at PLAN time

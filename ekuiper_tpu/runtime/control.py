@@ -212,14 +212,28 @@ def price_rule(rule, store) -> Dict[str, Any]:
                      + sharing.FOLD_SPEC_US * n_specs) * batches_per_s, 1)
                 try:
                     from ..observability import jitcert
+                    from ..sql import ast as _ast
 
                     # pane count does not enter: it changes signature
                     # SHAPES, not the executable count the budget gates
-                    # on (one executable per capacity step either way)
+                    # on (one executable per capacity step either way).
+                    # DABA sliding rules price their ring sites too
+                    # (advance/flip/query + components_dyn) — without
+                    # this the budget under-prices sliding candidates
+                    ring_slots = 0
+                    if (stmt.window is not None
+                            and stmt.window.window_type
+                            == _ast.WindowType.SLIDING_WINDOW
+                            and opts.sliding_impl == "daba"):
+                        from ..ops.slidingring import ring_layout_for
+
+                        ring_slots = ring_layout_for(
+                            stmt.window, plan).n_ring_panes
                     price["certified_new_signatures"] = \
                         jitcert.estimate_plan_signatures(
                             plan, 1, opts.micro_batch_rows,
-                            opts.key_slots)
+                            opts.key_slots,
+                            sliding_ring_slots=ring_slots)
                 except Exception as exc:
                     # leave the UNKNOWN sentinel: failing open here
                     # would both disarm the signature budget and route

@@ -83,7 +83,9 @@ class FusedWindowAggNode(Node):
         tail_mode: str = "device",  # window-tail rows: "device" | "host"
         is_event_time: bool = False,  # watermark-driven panes (see below)
         late_tolerance_ms: int = 0,
-        dev_ring_budget_mb: int = 256,  # sliding _dev_ring HBM cap
+        dev_ring_budget_mb: int = 256,  # sliding device-state HBM cap
+        sliding_impl: str = "daba",  # "daba" rings | "refold" legacy path
+        ring_layout=None,  # ops.slidingring.RingLayout chosen at plan time
         **kw,
     ) -> None:
         super().__init__(name, op_type="op", **kw)
@@ -156,24 +158,24 @@ class FusedWindowAggNode(Node):
             # Positive refolds only — every agg kind stays exact (no
             # subtraction), min/max/hll included.
             self.delay_ms = window.delay_ms()
-            # finer buckets shrink the per-trigger edge refolds (≤2 buckets
-            # of rows re-uploaded); bounded by the uint8 pane budget AND by
-            # HBM: wide sketch components (hist=512, hll=64 registers) pay
-            # panes×capacity×width×4B of state, so they get coarser buckets
-            from ..ops.aggspec import WIDE_COMPONENTS
+            # ring geometry is a PLAN-time decision (the planner passes the
+            # layout it chose; direct construction derives the same one):
+            # finer buckets shrink the per-trigger edge corrections,
+            # bounded by the uint8 pane budget AND by HBM — see
+            # ops/slidingring.py plan_ring_layout
+            from ..ops.slidingring import ring_layout_for
 
-            wide = any(set(s.components) & WIDE_COMPONENTS
-                       for s in plan.specs)
-            target = 48 if wide else 128
-            self.bucket_ms = max(self.length_ms // target, 25,
-                                 -(-(self.length_ms + self.delay_ms) // 250))
-            span = -(-(self.length_ms + self.delay_ms) // self.bucket_ms)
-            self.n_ring_panes = span + 3
-            self.n_panes = self.n_ring_panes + 1  # +1 scratch pane
-            if self.n_panes > 255:
+            if ring_layout is None:
+                ring_layout = ring_layout_for(window, plan)
+            self._ring_layout = ring_layout
+            self.bucket_ms = ring_layout.bucket_ms
+            self.n_ring_panes = ring_layout.n_ring_panes
+            self.n_panes = ring_layout.n_panes
+            self._scratch_pane = ring_layout.scratch_pane
+            if sliding_impl not in ("daba", "refold"):
                 raise ValueError(
-                    f"sliding window needs {self.n_panes} panes (max 255)")
-            self._scratch_pane = self.n_ring_panes
+                    f"slidingImpl must be 'daba' or 'refold', "
+                    f"got {sliding_impl!r}")
             self._pane_bucket: Dict[int, int] = {}  # pane -> bucket held
             self._ring: Dict[int, list] = {}  # bucket -> [(cols,valid,slots,ts)]
             # device-side cache of the SAME segments (pre-padded fold
@@ -268,6 +270,14 @@ class FusedWindowAggNode(Node):
         if self._hh_cols and capacity > 2048:
             capacity = 2048
         self.gb = self._make_gb(plan, capacity, micro_batch, mesh)
+        # sliding implementation: DABA rings by default (constant-time
+        # trigger emission, ops/slidingring.py), the legacy refold path as
+        # the parity/escape-hatch fallback (`slidingImpl` rule option)
+        self.ring = None
+        self._ring_dev = None
+        self.sliding_impl: Optional[str] = None
+        if self.wt == ast.WindowType.SLIDING_WINDOW:
+            self.sliding_impl = self._choose_sliding_impl(sliding_impl)
         # sharded path may round capacity up for even shard division
         self.kt = KeyTable(self.gb.capacity)
         # shared-source fan-out slot reuse: None = undecided, True = our kt
@@ -427,6 +437,13 @@ class FusedWindowAggNode(Node):
         if self.wt == ast.WindowType.SLIDING_WINDOW:
             memwatch.register("dev_ring", self,
                               lambda n: n._dev_ring_bytes, rule=rule)
+            if self.sliding_impl == "daba":
+                # the DABA partials replace the _dev_ring batch cache in
+                # HBM — they get their own kuiper_device_bytes row so
+                # /diagnostics/memory sees the ring state, not a silently
+                # double-budgeted dev_ring
+                memwatch.register("sliding_ring", self,
+                                  lambda n: n.ring_dev_bytes(), rule=rule)
         # register the trigger timer BEFORE the (slow) warmup compile so the
         # first window boundary is anchored at open time, not compile-end
         if not self.is_event_time and self.wt in (
@@ -460,20 +477,29 @@ class FusedWindowAggNode(Node):
                 dummy = self.gb.fold(dummy, cols, slots, pane_idx=0)
                 self.gb.finalize(dummy, 1, panes=[0])
                 if self.wt == ast.WindowType.SLIDING_WINDOW:
-                    # compile the mask-only edge refold (fold_masked) with
-                    # the exact runtime pytree: pre-padded device inputs +
-                    # (mb,) bool mask — a first real trigger must not pay
-                    # a 20-40s jit stall mid-stream. force=True bypasses
-                    # the small-batch HBM guard, which would silently
-                    # reject this 1-row batch and skip the compile
-                    dev = self._upload_sliding_inputs(
-                        {n: np.zeros(1, dtype=np.float32)
-                         for n in self.plan.columns},
-                        {}, np.zeros(1, dtype=np.int32), force=True)
-                    if dev is not None:
-                        mask = np.zeros(self.gb.micro_batch, dtype=np.bool_)
-                        dummy = self.gb.fold_masked(
-                            dummy, dev[3], dev[2], mask, self.n_ring_panes)
+                    # implementation-aware trigger-path warmup: the DABA
+                    # rounds warm the ring kernels, the refold rounds warm
+                    # fold_masked — never a dead kernel's executable
+                    if self.sliding_impl == "daba":
+                        self._warmup_ring(dummy)
+                    else:
+                        # compile the mask-only edge refold (fold_masked)
+                        # with the exact runtime pytree: pre-padded device
+                        # inputs + (mb,) bool mask — a first real trigger
+                        # must not pay a 20-40s jit stall mid-stream.
+                        # force=True bypasses the small-batch HBM guard,
+                        # which would silently reject this 1-row batch and
+                        # skip the compile
+                        dev = self._upload_sliding_inputs(
+                            {n: np.zeros(1, dtype=np.float32)
+                             for n in self.plan.columns},
+                            {}, np.zeros(1, dtype=np.int32), force=True)
+                        if dev is not None:
+                            mask = np.zeros(self.gb.micro_batch,
+                                            dtype=np.bool_)
+                            dummy = self.gb.fold_masked(
+                                dummy, dev[3], dev[2], mask,
+                                self.n_ring_panes)
             else:
                 dummy = self.gb.fold(dummy, cols, slots,
                                      pane_idx=self.cur_pane)
@@ -490,6 +516,26 @@ class FusedWindowAggNode(Node):
             self.gb.reset_pane(dummy, self.cur_pane)
         except Exception as exc:
             logger.debug("fused warmup failed (non-fatal): %s", exc)
+
+    def _warmup_ring(self, dummy) -> None:
+        """Compile the DABA trigger path (advance/flip/query + the
+        traced-mask components fallback) on throwaway state."""
+        from ..ops.slidingring import QUERY_ADJ
+
+        if self._ring_dev is None:  # follow a checkpoint-restored capacity
+            self.ring.capacity = int(self.gb.capacity)
+        ring = self.ring.init_state()
+        ring = self.ring.advance(ring, dummy, 0, True, 0, False)
+        ring = self.ring.flip(ring, dummy, 0,
+                              np.zeros(self.n_ring_panes, dtype=np.bool_))
+        pend = self.ring.query_begin(
+            ring, dummy, body_on=False, f_on=False, f_slot=0,
+            adj_slots=np.zeros(QUERY_ADJ, dtype=np.int32),
+            adj_weights=np.zeros(QUERY_ADJ, dtype=np.float32),
+            adj_mm=np.zeros(QUERY_ADJ, dtype=np.bool_))
+        pend.get()
+        self.gb.components_begin_dyn(
+            dummy, np.zeros(self.gb.n_panes, dtype=np.bool_)).get()
 
     def on_close(self) -> None:
         if self._timer is not None:
@@ -1219,6 +1265,28 @@ class FusedWindowAggNode(Node):
                     self._deliver_pf(pipeline, frozen, backup, n_keys, wr,
                                      t_issue)
                     continue
+                if kind == "ring":
+                    # sliding DABA trigger: fetch the O(1) body combine,
+                    # merge the host edge shadow, final values in numpy —
+                    # the same component tail as the prefinalize emit
+                    pending, shadow = stacked_dev
+                    outs, act = self.gb.prefinalize_merge(
+                        pending, shadow, n_keys)
+                    self.last_emit_info = {
+                        "source": "device-ring",
+                        "fetch_ms": (pending.fetch_ms()
+                                     if hasattr(pending, "fetch_ms") else
+                                     (_time.perf_counter() - t_issue)
+                                     * 1000.0),
+                        "ages_ms": [],
+                    }
+                    active = np.nonzero(act > 0)[0]
+                    if len(active):
+                        if self.direct_emit is not None:
+                            self._emit_direct(outs, active, wr)
+                        else:
+                            self._emit_grouped(outs, active, wr)
+                    continue
                 # kuiperlint: ignore[host-sync]: emit worker thread — THE intended sync point; the fold thread already dispatched and moved on
                 arr = np.asarray(stacked_dev)
                 if kind == "mr":
@@ -1300,6 +1368,124 @@ class FusedWindowAggNode(Node):
                 q.all_tasks_done.wait(remaining)
 
     # ------------------------------------------------------------- sliding
+    def _choose_sliding_impl(self, requested: str) -> str:
+        """Resolve the sliding implementation at construction: DABA rings
+        when the kernel supports the component-merge tail (plain
+        DeviceGroupBy — sharded folds and heavy_hitters finalizes keep the
+        exact refold path) and the ring's static HBM footprint fits the
+        sliding_dev_ring_mb budget; the refold path otherwise."""
+        if requested != "daba":
+            return "refold"
+        if getattr(self.gb, "watch_prefix", "") != "groupby" or \
+                not getattr(self.gb, "supports_prefinalize", False) or \
+                getattr(self.gb, "_host_finalize_only", False):
+            logger.info(
+                "%s: sliding ring unavailable for this kernel form "
+                "(sharded/heavy_hitters) — using the refold path",
+                self.name)
+            return "refold"
+        from ..ops.slidingring import SlidingRing
+
+        try:
+            ring = SlidingRing(self.gb, self._ring_layout)
+        except ValueError as exc:
+            logger.warning("%s: sliding ring rejected (%s) — using the "
+                           "refold path", self.name, exc)
+            return "refold"
+        est = ring.estimate_bytes(self.gb.capacity)
+        if est > self.dev_ring_budget_bytes:
+            logger.warning(
+                "%s: sliding ring needs %.1fMB > slidingDevRingMb=%.0fMB "
+                "budget — using the refold path (raise the budget or "
+                "coarsen the window to enable DABA rings)",
+                self.name, est / 2**20, self.dev_ring_budget_bytes / 2**20)
+            return "refold"
+        self.ring = ring
+        self._ring_reset_tracking()
+        # the running total retains one spare bucket beyond the window
+        # span: eviction must subtract a pane BEFORE its slot can be
+        # recycled by bucket b+R in the same fold call (R = span + 3)
+        self._span_tot = self._ring_layout.span_buckets + 1
+        return "daba"
+
+    def _ring_reset_tracking(self) -> None:
+        """Host-side ring bookkeeping to a cold (dirty) state: the next
+        trigger rebuilds the device partials from the panes in one flip."""
+        from collections import deque as _deque
+
+        self._rg_head = -1       # newest bucket any row has folded into
+        self._rg_closed = -1     # last bucket absorbed into the partials
+        self._rg_dirty = True    # cache needs a flip before serving
+        self._rg_flip_lo = -1    # front-stack span [flip_lo, flip_hi]
+        self._rg_flip_hi = -1
+        self._rg_closes = 0      # advance count (drift re-anchor cadence)
+        self._rg_anchor = 0
+        self._rg_tot = _deque()  # (bucket, slot, absorbed) in the total
+
+    def _ring_state_now(self):
+        """The live device ring state, lazily allocated and kept at the
+        kernel's (possibly grown) key capacity."""
+        if self._ring_dev is None:
+            self.ring.capacity = int(self.gb.capacity)
+            self._ring_dev = self.ring.init_state()
+        elif self.ring.capacity < self.gb.capacity:
+            self._ring_dev = self.ring.grow(self._ring_dev,
+                                            self.gb.capacity)
+        return self._ring_dev
+
+    def ring_dev_bytes(self) -> int:
+        """memwatch probe: live HBM bytes of the DABA ring partials."""
+        if self._ring_dev is None:
+            return 0
+        from ..ops.slidingring import SlidingRing
+
+        return SlidingRing.state_nbytes(self._ring_dev)
+
+    def _ring_advance_buckets(self, buckets: np.ndarray) -> None:
+        """Bucket-close maintenance after a fold: absorb newly closed
+        panes into the running partials (O(1) device work per bucket,
+        ~1/bucket_ms per second — off the trigger path). Late rows into
+        already-absorbed buckets and time gaps mark the cache dirty; the
+        next trigger heals it with one flip (the panes stay the truth)."""
+        ubs = np.unique(buckets).tolist()
+        nh = int(ubs[-1])
+        if self._rg_closed >= 0 and int(ubs[0]) <= self._rg_closed:
+            self._rg_dirty = True
+        if nh <= self._rg_head:
+            return
+        if self._rg_head < 0 or nh - self._rg_head > 8:
+            # cold start or a time gap: skip per-bucket advances and let
+            # the next trigger rebuild everything in one flip
+            self._rg_dirty = True
+            self._rg_tot.clear()
+            self._rg_head = nh
+            self._rg_closed = nh - 1
+            return
+        for b in range(self._rg_head, nh):
+            self._ring_close_bucket(b)
+        self._rg_head = nh
+
+    def _ring_close_bucket(self, b: int) -> None:
+        slot = b % self.n_ring_panes
+        on = self._pane_bucket.get(slot) == b
+        ev_slot, ev_on = 0, False
+        self._rg_tot.append((b, slot, on))
+        if len(self._rg_tot) > self._span_tot:
+            ob, oslot, oon = self._rg_tot.popleft()
+            if oon and self._pane_bucket.get(oslot) != ob:
+                # the evicted bucket's pane was already recycled (burst
+                # batch) — its contribution cannot be subtracted; rebuild
+                # from the panes at the next trigger instead
+                self._rg_dirty = True
+            else:
+                ev_slot, ev_on = oslot, bool(oon)
+        if not self._rg_dirty:
+            self._ring_dev = self.ring.advance(
+                self._ring_state_now(), self.state, slot, bool(on),
+                ev_slot, ev_on)
+        self._rg_closes += 1
+        self._rg_closed = b
+
     def _fold_sliding(self, sub: ColumnBatch) -> int:
         """Sliding device path: fold rows into time panes keyed by row
         timestamp, mirror them into the host ring (for edge-bucket refolds
@@ -1378,9 +1564,13 @@ class FusedWindowAggNode(Node):
                 t for t in self._dev_ring_fifo if t[0] >= floor_b)
         import time as _time
 
+        daba = self.sliding_impl == "daba"
         t0 = _time.perf_counter()
         cols, valid, slots = self._build_kernel_inputs(sub)
-        dev = self._upload_sliding_inputs(cols, valid, slots)
+        # the DABA path needs no device batch cache: triggers combine
+        # running partials, edges fold on host from the row ring
+        dev = (None if daba
+               else self._upload_sliding_inputs(cols, valid, slots))
         pane_vec = (buckets % self.n_ring_panes).astype(np.uint8)
         fold_cols, fold_valid, fold_slots, n_rows = (
             (dev[0], dev[1], dev[2], sub.n) if dev is not None
@@ -1407,19 +1597,22 @@ class FusedWindowAggNode(Node):
                 slots[sel], ts[sel],
             ) if not m.all() else (cols, valid, slots, ts)
             self._ring.setdefault(int(b), []).append(seg)
-            # aligned device entry: whole-batch refs + this bucket's row
-            # mask (the refold ANDs the window time cut into it)
-            entry = None if dev is None else (dev[3], dev[2], m, ts)
-            lst = self._dev_ring.setdefault(int(b), [])
-            lst.append(entry)
-            if entry is not None:
-                nb = self._dev_entry_nbytes(entry)
-                self._dev_ring_bytes += nb
-                self._dev_ring_fifo.append((int(b), len(lst) - 1, nb))
-                self._dev_ring_evict()
+            if not daba:
+                # aligned device entry: whole-batch refs + this bucket's
+                # row mask (the refold ANDs the window time cut into it)
+                entry = None if dev is None else (dev[3], dev[2], m, ts)
+                lst = self._dev_ring.setdefault(int(b), [])
+                lst.append(entry)
+                if entry is not None:
+                    nb = self._dev_entry_nbytes(entry)
+                    self._dev_ring_bytes += nb
+                    self._dev_ring_fifo.append((int(b), len(lst) - 1, nb))
+                    self._dev_ring_evict()
             bmax = int(ts[sel].max())
             if bmax > self._bucket_max_ts.get(int(b), -1):
                 self._bucket_max_ts[int(b)] = bmax
+        if daba:
+            self._ring_advance_buckets(buckets)
         # trigger rows: vectorized OVER(WHEN ...) on the raw batch columns;
         trig_mask = _host_mask(self._trigger_host, sub.columns, sub.n)
         for i in np.nonzero(trig_mask)[0].tolist():
@@ -1536,6 +1729,8 @@ class FusedWindowAggNode(Node):
 
     def _emit_sliding(self, t: int) -> None:
         """Emit the exact window (t-L, t+delay] for trigger time t."""
+        if self.sliding_impl == "daba":
+            return self._emit_sliding_ring(t)
         n_keys = self.kt.n_keys
         if n_keys == 0:
             return
@@ -1643,6 +1838,198 @@ class FusedWindowAggNode(Node):
                 WindowRange(lo, hi))
         if used_scratch:
             self.state = self.gb.reset_pane(self.state, self._scratch_pane)
+
+    # ---------------------------------------------------- sliding (DABA)
+    def _emit_sliding_ring(self, t: int) -> None:
+        """DABA-ring emission for trigger time t: the full-pane window
+        body is ONE device combine of the ring's running partials (plus at
+        most QUERY_ADJ pane slices); the partial edge buckets fold on HOST
+        from the row ring into a HostShadow merged by the emit worker — no
+        per-trigger device refold of cached batch history, no
+        window-length pane merge. Exactness matches the refold path: the
+        panes remain the ground truth and every off-discipline shape
+        (delay, recycled panes, restores) takes an exact fallback."""
+        import time as _time
+
+        from ..ops.prefinalize import HostShadow, IdentityFinalize
+
+        n_keys = self.kt.n_keys
+        if n_keys == 0:
+            return
+        lo = t - self.length_ms  # exclusive
+        hi = t + self.delay_ms  # inclusive
+        b_lo, b_hi = lo // self.bucket_ms, hi // self.bucket_ms
+        shadow = HostShadow(self.plan, self.gb.comp_specs, self.kt.capacity)
+        include_head = False
+        if b_lo == b_hi:
+            # window inside one bucket: the host edge fold IS the window
+            self._shadow_ring_rows(shadow, b_lo, lo_excl=lo, hi_incl=hi)
+            body = None
+        else:
+            self._shadow_ring_rows(shadow, b_lo, lo_excl=lo)
+            body = (b_lo + 1, b_hi - 1)
+            # high edge served straight from the live PANE when exact: it
+            # holds precisely bucket b_hi's rows folded so far, which
+            # equals (b_hi*B, hi] when no received row exceeds hi
+            if (self._pane_bucket.get(b_hi % self.n_ring_panes) == b_hi
+                    and self._bucket_max_ts.get(b_hi, hi + 1) <= hi):
+                include_head = True
+            else:
+                self._shadow_ring_rows(shadow, b_hi, hi_incl=hi)
+        pending = self._ring_body_query(body, include_head, b_hi, shadow)
+        if pending is None:
+            pending = IdentityFinalize(self.gb.comp_specs, self.kt.capacity)
+        self._ensure_emit_worker()
+        self._emit_q.put(("ring", (pending, shadow), n_keys,
+                          WindowRange(lo, hi), _time.perf_counter(),
+                          self._cur_ingest_ms))
+
+    def _shadow_ring_rows(self, shadow, b: int, lo_excl: Optional[int] = None,
+                          hi_incl: Optional[int] = None) -> None:
+        """Numpy-fold bucket b's retained rows (optionally time-cut) into
+        the trigger's HostShadow — bounded by ONE bucket of rows, not the
+        window history."""
+        for cols, valid, slots, ts in self._ring.get(b, []):
+            m = np.ones(len(ts), dtype=np.bool_)
+            if lo_excl is not None:
+                m &= ts > lo_excl
+            if hi_incl is not None:
+                m &= ts <= hi_incl
+            if not m.any():
+                continue
+            if m.all():
+                shadow.fold(cols, slots, valid)
+            else:
+                sel = np.nonzero(m)[0]
+                shadow.fold({k: v[sel] for k, v in cols.items()},
+                            slots[sel],
+                            {k: v[sel] for k, v in valid.items()})
+
+    def _ring_body_query(self, body, include_head: bool, b_hi: int,
+                         shadow):
+        """Dispatch the device body combine for one trigger: the O(1)
+        ring query when the running partials cover the body, a one-off
+        flip (rebuild from panes) when they don't, and the traced-mask
+        components fallback for shapes outside the in-order discipline
+        (delayed emissions, recycled panes). Returns a PendingFinalize or
+        None (empty body, nothing on device)."""
+        from ..ops.slidingring import QUERY_ADJ
+
+        head_slot = b_hi % self.n_ring_panes
+        if body is None:
+            return None
+        j, e = body
+        if j > e:
+            if not include_head:
+                return None
+            adj_slots = np.zeros(QUERY_ADJ, dtype=np.int32)
+            adj_w = np.zeros(QUERY_ADJ, dtype=np.float32)
+            adj_mm = np.zeros(QUERY_ADJ, dtype=np.bool_)
+            adj_slots[0] = head_slot
+            adj_w[0] = 1.0
+            adj_mm[0] = True
+            return self.ring.query_begin(
+                self._ring_state_now(), self.state, body_on=False,
+                f_on=False, f_slot=0, adj_slots=adj_slots,
+                adj_weights=adj_w, adj_mm=adj_mm)
+        if self._rg_closed == e and self._rg_head == b_hi:
+            ok = not self._rg_dirty and self._ring_fast_ok(j)
+            if not ok:
+                self._ring_flip(j, e)
+                ok = not self._rg_dirty and self._ring_fast_ok(j)
+            if ok:
+                return self._ring_query_fast(j, include_head, head_slot)
+        return self._ring_query_dyn(j, e, include_head, head_slot, shadow)
+
+    def _ring_fast_ok(self, j: int) -> bool:
+        """Can the running partials serve a body starting at bucket j?"""
+        from ..ops.slidingring import QUERY_ADJ
+
+        if self._rg_closes - self._rg_anchor > 4 * self._span_tot:
+            # periodic re-anchor: rebuild the float totals from the panes
+            # before subtract-on-evict drift can accumulate
+            return False
+        if self.ring.mm_comps:
+            if self._rg_flip_lo < 0 or j < self._rg_flip_lo \
+                    or j > self._rg_flip_hi + 1:
+                return False
+        if not self._rg_tot or self._rg_tot[0][0] > j:
+            return False  # the total no longer covers the window start
+        n_sub = sum(1 for (b, _s, on) in self._rg_tot if b < j and on)
+        return n_sub <= QUERY_ADJ - 1
+
+    def _ring_flip(self, j: int, e: int) -> None:
+        """Rebuild every running partial from the live panes over [j, e]
+        (one fused device scan — the amortized DABA flip). A bucket whose
+        pane was recycled while its rows are still retained cannot flip
+        (the pane is gone); the caller then takes the dyn fallback."""
+        from collections import deque as _deque
+
+        valid = np.zeros(self.n_ring_panes, dtype=np.bool_)
+        tot_entries = []
+        for b in range(j, e + 1):
+            s = b % self.n_ring_panes
+            live = self._pane_bucket.get(s) == b
+            if not live and b in self._ring:
+                return  # rows exist but the pane is gone — dyn fallback
+            valid[b - j] = live
+            tot_entries.append((b, s, live))
+        self._ring_dev = self.ring.flip(
+            self._ring_state_now(), self.state, j % self.n_ring_panes,
+            valid)
+        self._rg_tot = _deque(tot_entries)
+        self._rg_flip_lo, self._rg_flip_hi = j, e
+        self._rg_anchor = self._rg_closes
+        self._rg_dirty = False
+
+    def _ring_query_fast(self, j: int, include_head: bool,
+                         head_slot: int):
+        """The constant-time trigger: combine(front[j], back) for the
+        two-stack components, the running total ± at most two trailing
+        pane slices for the additive ones, plus the live head pane."""
+        from ..ops.slidingring import QUERY_ADJ
+
+        adj_slots = np.zeros(QUERY_ADJ, dtype=np.int32)
+        adj_w = np.zeros(QUERY_ADJ, dtype=np.float32)
+        adj_mm = np.zeros(QUERY_ADJ, dtype=np.bool_)
+        k = 0
+        for b, s, on in self._rg_tot:
+            if b < j and on:
+                adj_slots[k] = s
+                adj_w[k] = -1.0
+                k += 1
+        if include_head:
+            adj_slots[k] = head_slot
+            adj_w[k] = 1.0
+            adj_mm[k] = True
+        f_on = bool(self.ring.mm_comps) and j <= self._rg_flip_hi
+        return self.ring.query_begin(
+            self._ring_state_now(), self.state, body_on=True, f_on=f_on,
+            f_slot=j % self.n_ring_panes, adj_slots=adj_slots,
+            adj_weights=adj_w, adj_mm=adj_mm)
+
+    def _ring_query_dyn(self, j: int, e: int, include_head: bool,
+                        head_slot: int, shadow):
+        """Exact fallback body: merge the window's live panes under a
+        traced mask (one executable, O(window span) reads — only for
+        off-discipline triggers); buckets whose pane was recycled refold
+        their retained rows on host into the trigger's shadow."""
+        pane_mask = np.zeros(self.gb.n_panes, dtype=np.bool_)
+        missing = 0
+        for b in range(j, e + 1):
+            s = b % self.n_ring_panes
+            if self._pane_bucket.get(s) == b:
+                pane_mask[s] = True
+            elif b in self._ring:
+                self._shadow_ring_rows(shadow, b)
+                missing += 1
+        if missing:
+            self.stats.inc_exception("sliding pane recycled; ring refold")
+        if include_head:
+            pane_mask[head_slot] = True
+        if not pane_mask.any():
+            return None
+        return self.gb.components_begin_dyn(self.state, pane_mask)
 
     # ---------------------------------------------------------------- trigger
     def on_pre_trigger(self, pre: PreTrigger) -> None:
@@ -2176,6 +2563,15 @@ class FusedWindowAggNode(Node):
                               for b, segs in self._ring.items()}
             self._dev_ring_bytes = 0
             self._dev_ring_fifo.clear()
+            if self.sliding_impl == "daba":
+                # the ring partials are caches of the pane state — never
+                # checkpointed; a restore starts dirty and the first
+                # trigger rebuilds them from the restored panes in one flip
+                self._ring_dev = None
+                self._ring_reset_tracking()
+                self._rg_head = self._ring_max_bucket
+                self._rg_closed = (self._rg_head - 1
+                                   if self._rg_head >= 0 else -1)
             # re-arm delayed emissions that were pending at the checkpoint
             # (past-due ones fire immediately) — without this, windows for
             # triggers inside the restart gap would silently never emit

@@ -60,10 +60,16 @@ class RuleOptionConfig:
     # of batch k and the fused worker's upload stage collapses to share-
     # cache hits. Off = pool decodes only, fused node preps inline.
     ingest_prep_upload: bool = True
-    # HBM budget for the sliding-window device-side fold-input cache
-    # (nodes_fused.py _dev_ring); oldest entries fall back to exact host
-    # refolds past the cap
+    # HBM budget for sliding-window device state beyond the panes: the
+    # DABA ring partials (ops/slidingring.py — allocation refused past the
+    # cap, rule falls back to refold) and the refold impl's _dev_ring
+    # fold-input cache (FIFO-evicted past the cap, refolds fall back to
+    # exact host uploads)
     sliding_dev_ring_mb: int = 256
+    # sliding trigger emission: "daba" = constant-time two-stack rings
+    # (ops/slidingring.py, default); "refold" = legacy pane-merge +
+    # edge-refold path (parity baseline / escape hatch)
+    sliding_impl: str = "daba"
     key_slots: int = 16384  # group-by hash-slot table size per rule
     use_device_kernel: bool = True  # fuse window+agg into a jitted kernel when possible
     # pre-issue the window finalize this long before the boundary so the

@@ -379,9 +379,11 @@ class TestSlidingBurst:
 
 
 class TestDevRingBudget:
-    """HBM budget on the sliding device-input cache (_dev_ring): past the
-    cap the oldest entries drop to None and refolds take the exact host
-    path — output parity must hold at ANY budget."""
+    """HBM budget on the refold impl's device-input cache (_dev_ring):
+    past the cap the oldest entries drop to None and refolds take the
+    exact host path — output parity must hold at ANY budget. Pinned to
+    slidingImpl=refold: the DABA default keeps no batch cache at all
+    (tests/test_sliding_ring.py covers its budget fallback)."""
 
     def _run_with_budget(self, budget_bytes):
         stmt = parse_select(SQL)
@@ -389,7 +391,8 @@ class TestDevRingBudget:
         node = FusedWindowAggNode(
             "sb", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
             capacity=64, micro_batch=128,
-            direct_emit=build_direct_emit(stmt, plan, ["deviceId"]))
+            direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+            sliding_impl="refold")
         if budget_bytes is not None:
             node.dev_ring_budget_bytes = budget_bytes
         node.state = node.gb.init_state()
@@ -440,7 +443,8 @@ class TestWarmupForce:
         node = FusedWindowAggNode(
             "sw", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
             capacity=64, micro_batch=128,
-            direct_emit=build_direct_emit(stmt, plan, ["deviceId"]))
+            direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+            sliding_impl="refold")
         node.state = node.gb.init_state()
         cols = {n: np.zeros(1, dtype=np.float32) for n in plan.columns}
         slots = np.zeros(1, dtype=np.int32)

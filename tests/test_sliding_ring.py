@@ -1,0 +1,444 @@
+"""DABA sliding rings (ISSUE 11): parity of the constant-time sliding
+implementation (ops/slidingring.py, `slidingImpl=daba`) against the
+legacy refold-on-trigger path (`slidingImpl=refold`) — same batches, same
+triggers, same emitted windows, across window shapes, aggregate classes,
+clock modes, eviction pressure, and kill/restore.
+
+The refold path is the exactness baseline (tests/test_sliding_device.py
+proves it against ground truth); this suite proves the DABA rings match
+it, so the default swap cannot silently change semantics."""
+import json
+
+import numpy as np
+import pytest
+
+from ekuiper_tpu.data.batch import ColumnBatch
+from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+from ekuiper_tpu.ops.emit import build_direct_emit
+from ekuiper_tpu.ops.slidingring import (ADD_COMBINE, MAX_COMBINE,
+                                         MIN_COMBINE, SlidingRing,
+                                         plan_ring_layout)
+from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+from ekuiper_tpu.sql.parser import parse_select
+
+SQL_INV = ("SELECT deviceId, count(*) AS c, sum(temp) AS s, "
+           "avg(temp) AS a, stddev(temp) AS sd FROM s GROUP BY deviceId, "
+           "SLIDINGWINDOW(ss, 2) OVER (WHEN temp > 90)")
+SQL_MM = ("SELECT deviceId, min(temp) AS mn, max(temp) AS mx, "
+          "count(*) AS c FROM s GROUP BY deviceId, "
+          "SLIDINGWINDOW(ss, 2) OVER (WHEN temp > 90)")
+SQL_SKETCH = ("SELECT deviceId, percentile_approx(temp, 0.9) AS p90, "
+              "distinct_count_approx(temp) AS dc FROM s GROUP BY deviceId, "
+              "SLIDINGWINDOW(ss, 2) OVER (WHEN temp > 90)")
+
+# identical fold inputs -> identical integer counts and min/max picks;
+# float accumulations (sum/avg/stddev) compare loose, sketch FINAL values
+# looser still (the refold path finalizes on device f32, the ring path
+# in the numpy twins — same bins/registers, ±ulp value math)
+EXACT_FIELDS = {"c", "mn", "mx"}
+
+
+def mknode(sql, impl, capacity=64, micro_batch=128):
+    stmt = parse_select(sql)
+    plan = extract_kernel_plan(stmt)
+    assert plan is not None, sql
+    node = FusedWindowAggNode(
+        f"sr_{impl}", stmt.window, plan,
+        dims=[d.expr for d in stmt.dimensions],
+        capacity=capacity, micro_batch=micro_batch,
+        direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+        sliding_impl=impl)
+    node.state = node.gb.init_state()
+    got = []
+    node.broadcast = lambda item: got.append(item)
+    return node, got
+
+
+def flat(items):
+    msgs = []
+    for item in items:
+        if isinstance(item, ColumnBatch):
+            msgs.extend(item.to_messages())
+        elif isinstance(item, list):
+            msgs.extend(item)
+        else:
+            msgs.append(item.message if hasattr(item, "message") else item)
+    return msgs
+
+
+def per_trigger(items):
+    return [{m["deviceId"]: m for m in flat([item])} for item in items]
+
+
+def run_pair(sql, batches, **kw):
+    """Drive the SAME batches through both impls; returns per-trigger
+    emission lists (daba, refold) plus the daba node."""
+    node_d, got_d = mknode(sql, "daba", **kw)
+    node_r, got_r = mknode(sql, "refold", **kw)
+    assert node_d.sliding_impl == "daba"
+    assert node_r.sliding_impl == "refold"
+    for b in batches:
+        node_d.process(b)
+        node_r.process(b)
+    node_d._drain_async_emits()
+    node_r._drain_async_emits()
+    return per_trigger(got_d), per_trigger(got_r), node_d
+
+
+def assert_parity(trig_d, trig_r):
+    assert len(trig_d) == len(trig_r) >= 1
+    for td, tr in zip(trig_d, trig_r):
+        assert set(td) == set(tr)
+        for key, mr in tr.items():
+            md = td[key]
+            for f, vr in mr.items():
+                vd = md[f]
+                if vr is None or vd is None or isinstance(vr, str):
+                    assert vd == vr, (key, f, vd, vr)
+                elif f in EXACT_FIELDS:
+                    assert vd == vr, (key, f, vd, vr)
+                elif f == "dc":  # hll estimate rounds to an integer
+                    assert abs(vd - vr) <= 1, (key, f, vd, vr)
+                else:
+                    np.testing.assert_allclose(
+                        vd, vr, rtol=1e-4, atol=1e-4,
+                        err_msg=f"{key}.{f}")
+
+
+def trigger_batches(trigger_ts, keys=5, rows=48, t0=10_000, step=100,
+                    n_batches=12, seed=3):
+    """Monotone timestamped batches; for each requested trigger time the
+    row closest to it (within its batch span) carries the trigger temp
+    (>90), everything else stays below it — deterministic cadences."""
+    rng = np.random.default_rng(seed)
+    out = []
+    t = t0
+    for _ in range(n_batches):
+        ids = np.array([f"d{i}" for i in rng.integers(0, keys, rows)],
+                       dtype=np.object_)
+        temp = rng.uniform(0, 88, rows).astype(np.float32)
+        ts = t + np.sort(rng.integers(0, step, rows)).astype(np.int64)
+        for tv in trigger_ts:
+            if t <= tv < t + step:
+                temp[int(np.argmin(np.abs(ts - tv)))] = 95.0
+        out.append(ColumnBatch(
+            n=rows, columns={"deviceId": ids, "temp": temp},
+            timestamps=ts, emitter="s"))
+        t += step
+    return out
+
+
+def endspike_batches(n_batches=3, rows=32, keys=4, t0=10_000, step=100,
+                     seed=2):
+    """Batches whose LAST row of the LAST batch is the trigger — the
+    trigger lands in the head bucket (the ring's fast-path shape)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    t = t0
+    for i in range(n_batches):
+        ids = np.array([f"d{j}" for j in rng.integers(0, keys, rows)],
+                       dtype=np.object_)
+        temp = rng.uniform(0, 88, rows).astype(np.float32)
+        ts = t + np.sort(rng.integers(0, step, rows)).astype(np.int64)
+        if i == n_batches - 1:
+            temp[-1] = 99.0
+            ts[-1] = max(int(ts[-1]), int(ts.max()))
+        out.append(ColumnBatch(
+            n=rows, columns={"deviceId": ids, "temp": temp},
+            timestamps=ts, emitter="s"))
+        t += step
+    return out
+
+
+def random_trigger_batches(seed=7, n_batches=12, rows=48, keys=5,
+                           t0=10_000, step=100, spike_every=17):
+    rng = np.random.default_rng(seed)
+    out = []
+    t = t0
+    k = 0
+    for _ in range(n_batches):
+        ids = np.array([f"d{i}" for i in rng.integers(0, keys, rows)],
+                       dtype=np.object_)
+        temp = rng.uniform(0, 88, rows).astype(np.float32)
+        ts = t + np.sort(rng.integers(0, step, rows)).astype(np.int64)
+        for i in range(rows):
+            k += 1
+            if k % spike_every == 0:
+                temp[i] = 99.0
+        out.append(ColumnBatch(
+            n=rows, columns={"deviceId": ids, "temp": temp},
+            timestamps=ts, emitter="s"))
+        t += step
+    return out
+
+
+class TestWindowShapes:
+    """DABA vs refold across the three trigger cadences: tumbling-
+    degenerate (disjoint windows), hopping (regular overlap), and true
+    sliding (arbitrary trigger times)."""
+
+    def test_tumbling_degenerate(self):
+        # one trigger every window length: windows tile without overlap
+        trig = [12_000, 14_000, 16_000, 18_000]
+        batches = trigger_batches(trig, n_batches=85, step=100)
+        trig_d, trig_r, _ = run_pair(SQL_INV, batches)
+        assert_parity(trig_d, trig_r)
+
+    def test_hopping_shape(self):
+        # trigger every 500ms on a 2s window: 4x overlap, hopping-like
+        trig = list(range(12_000, 18_001, 500))
+        batches = trigger_batches(trig, n_batches=85, step=100)
+        trig_d, trig_r, _ = run_pair(SQL_INV, batches)
+        assert_parity(trig_d, trig_r)
+
+    def test_true_sliding_invertible(self):
+        trig_d, trig_r, node = run_pair(
+            SQL_INV, random_trigger_batches(seed=7, n_batches=30))
+        assert_parity(trig_d, trig_r)
+        # the DABA node kept NO device batch cache: the refold-era
+        # _dev_ring stays empty (the stall class it carried is gone)
+        assert node._dev_ring_bytes == 0
+        assert not any(e is not None
+                       for lst in node._dev_ring.values() for e in lst)
+
+    def test_true_sliding_min_max(self):
+        trig_d, trig_r, node = run_pair(
+            SQL_MM, random_trigger_batches(seed=11, n_batches=30))
+        assert_parity(trig_d, trig_r)
+        assert node.ring is not None and node.ring.mm_comps == ["mn", "mx"]
+
+    def test_true_sliding_sketches(self):
+        trig_d, trig_r, _ = run_pair(
+            SQL_SKETCH, random_trigger_batches(seed=13, n_batches=30))
+        assert_parity(trig_d, trig_r)
+
+    def test_delay_windows(self):
+        """SLIDINGWINDOW(ss, 2, 1): delayed emission takes the exact
+        fallback on the DABA path — parity must hold regardless."""
+        from ekuiper_tpu.utils import timex
+
+        sql = ("SELECT deviceId, count(*) AS c, max(temp) AS mx FROM s "
+               "GROUP BY deviceId, SLIDINGWINDOW(ss, 2, 1) "
+               "OVER (WHEN temp > 90)")
+        batches = random_trigger_batches(seed=5, n_batches=20)
+        node_d, got_d = mknode(sql, "daba")
+        node_r, got_r = mknode(sql, "refold")
+        clock = timex.get_clock()
+        for b in batches:
+            clock.set(int(b.timestamps[-1]))
+            node_d.process(b)
+            node_r.process(b)
+        # fire every pending delayed emission on both nodes
+        clock.advance(5_000)
+        for node in (node_d, node_r):
+            for t in sorted(node._pending_slides):
+                node._pending_slides.pop(t, None)
+                node._emit_sliding(t)
+            node._drain_async_emits()
+        assert_parity(per_trigger(got_d), per_trigger(got_r))
+
+
+class TestClockModes:
+    def test_processing_time_mock_clock(self, mock_clock):
+        """Batches WITHOUT timestamps stamp at now_ms — drive the mock
+        clock so both impls bucket identically."""
+        rng = np.random.default_rng(23)
+        node_d, got_d = mknode(SQL_INV, "daba")
+        node_r, got_r = mknode(SQL_INV, "refold")
+        mock_clock.set(50_000)
+        for i in range(40):
+            rows = 32
+            ids = np.array([f"d{j}" for j in rng.integers(0, 4, rows)],
+                           dtype=np.object_)
+            temp = rng.uniform(0, 88, rows).astype(np.float32)
+            if i % 7 == 6:
+                temp[-1] = 97.0
+            b = ColumnBatch(n=rows,
+                            columns={"deviceId": ids, "temp": temp},
+                            emitter="s")
+            node_d.process(b)
+            node_r.process(b)
+            mock_clock.advance(100)
+        node_d._drain_async_emits()
+        node_r._drain_async_emits()
+        assert_parity(per_trigger(got_d), per_trigger(got_r))
+
+
+class TestEviction:
+    def test_evict_past_capacity(self):
+        """A stream longer than the pane ring retention: old buckets
+        recycle, the running totals evict in lockstep, and every emitted
+        window still matches the refold path (which refolds from its row
+        ring). 100+ buckets on a ~83-slot ring."""
+        batches = random_trigger_batches(seed=31, n_batches=90, rows=24,
+                                         spike_every=29)
+        trig_d, trig_r, node = run_pair(SQL_INV, batches)
+        span_ms = 90 * 100
+        assert span_ms // node.bucket_ms > node.n_ring_panes
+        assert_parity(trig_d, trig_r)
+
+    def test_gap_jump_rebuilds(self):
+        """A time gap far wider than the advance hysteresis marks the
+        ring dirty; the next trigger rebuilds from the panes (flip) and
+        stays exact."""
+        b1 = trigger_batches([10_250], n_batches=3, t0=10_000)
+        b2 = trigger_batches([28_250], n_batches=3, t0=28_000, seed=9)
+        trig_d, trig_r, _ = run_pair(SQL_INV, b1 + b2)
+        assert len(trig_d) == 2
+        assert_parity(trig_d, trig_r)
+
+    def test_late_rows_mark_dirty_and_stay_exact(self):
+        """Rows folding into already-absorbed buckets taint the running
+        partials; the next trigger must rebuild rather than serve them."""
+        def b(ts_list, temps):
+            k = len(ts_list)
+            return ColumnBatch(
+                n=k,
+                columns={"deviceId": np.array(["d0"] * k, dtype=np.object_),
+                         "temp": np.asarray(temps, dtype=np.float32)},
+                timestamps=np.asarray(ts_list, dtype=np.int64), emitter="s")
+
+        node_d, got_d = mknode(SQL_INV, "daba")
+        node_r, got_r = mknode(SQL_INV, "refold")
+        for node in (node_d, node_r):
+            node.process(b([10_000, 10_100, 10_200], [50.0, 50.0, 50.0]))
+            # 8 buckets behind the head: folds into a closed bucket
+            node.process(b([10_150], [50.0]))
+            node.process(b([10_400], [95.0]))  # trigger
+            node._drain_async_emits()
+        td, tr = per_trigger(got_d), per_trigger(got_r)
+        assert_parity(td, tr)
+        assert td[0]["d0"]["c"] == 5  # the late row counted
+
+
+class TestKillRestore:
+    @pytest.mark.parametrize("impl", ["daba", "refold"])
+    def test_snapshot_roundtrip_within_impl(self, impl):
+        batches = random_trigger_batches(seed=17, n_batches=16)
+        # uninterrupted reference
+        ref_node, ref_got = mknode(SQL_INV, impl)
+        for b in batches:
+            ref_node.process(b)
+        ref_node._drain_async_emits()
+        # kill after batch 8, restore, continue
+        n1, got1 = mknode(SQL_INV, impl)
+        for b in batches[:8]:
+            n1.process(b)
+        n1._drain_async_emits()
+        snap = json.loads(json.dumps(n1.snapshot_state()))
+        n2, got2 = mknode(SQL_INV, impl)
+        n2.restore_state(snap)
+        for b in batches[8:]:
+            n2.process(b)
+        n2._drain_async_emits()
+        ref = per_trigger(ref_got)
+        after = per_trigger(got2)
+        assert len(after) >= 1
+        assert len(ref) == len(per_trigger(got1)) + len(after)
+        # post-restore windows (some straddle the checkpoint) match the
+        # uninterrupted run
+        assert_parity(after, ref[-len(after):])
+
+    def test_cross_impl_restore(self):
+        """A refold-era checkpoint restores into a DABA node (and back):
+        the pane state layout is shared, the ring partials rebuild from
+        the restored panes on the first trigger."""
+        batches = random_trigger_batches(seed=19, n_batches=16)
+        for src, dst in (("refold", "daba"), ("daba", "refold")):
+            n1, _ = mknode(SQL_INV, src)
+            for b in batches[:8]:
+                n1.process(b)
+            n1._drain_async_emits()
+            snap = json.loads(json.dumps(n1.snapshot_state()))
+            n2, got2 = mknode(SQL_INV, dst)
+            n2.restore_state(snap)
+            nr, gotr = mknode(SQL_INV, "refold")
+            nr.restore_state(json.loads(json.dumps(snap)))
+            for b in batches[8:]:
+                n2.process(b)
+                nr.process(b)
+            n2._drain_async_emits()
+            nr._drain_async_emits()
+            assert_parity(per_trigger(got2), per_trigger(gotr))
+
+
+class TestRingGuardrails:
+    def test_budget_fallback_to_refold(self):
+        """A ring whose static footprint exceeds slidingDevRingMb must
+        refuse the DABA allocation and keep the refold path."""
+        stmt = parse_select(SQL_SKETCH)
+        plan = extract_kernel_plan(stmt)
+        node = FusedWindowAggNode(
+            "tiny", stmt.window, plan,
+            dims=[d.expr for d in stmt.dimensions],
+            capacity=64, micro_batch=128,
+            direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+            dev_ring_budget_mb=0, sliding_impl="daba")
+        assert node.sliding_impl == "refold"
+        assert node.ring is None
+
+    def test_memwatch_probe_registered(self):
+        from ekuiper_tpu.observability import memwatch
+
+        node, _ = mknode(SQL_INV, "daba")
+        node.on_open()
+        comps = {r["component"]
+                 for r in memwatch.registry().snapshot()
+                 if r["component"].startswith(("sliding", "dev_ring"))}
+        assert "sliding_ring" in comps and "dev_ring" in comps
+        # bytes appear once the ring allocates (first served trigger)
+        for b in endspike_batches():
+            node.process(b)
+        node._drain_async_emits()
+        assert node.ring_dev_bytes() > 0
+        rows = {r["component"]: r["bytes"]
+                for r in memwatch.registry().snapshot()
+                if r["component"] == "sliding_ring"}
+        assert rows.get("sliding_ring", 0) > 0
+        # and the refold-era cache stays unbudgeted/empty under daba
+        assert node._dev_ring_bytes == 0
+
+    def test_estimate_matches_allocation(self):
+        node, _ = mknode(SQL_INV, "daba")
+        for b in endspike_batches():
+            node.process(b)
+        node._drain_async_emits()
+        est = node.ring.estimate_bytes(node.gb.capacity)
+        assert node.ring_dev_bytes() == est
+
+    def test_combine_classes_are_total(self):
+        """Every device component must have a ring combine class —
+        a new component without one must fail loudly at plan time."""
+        from ekuiper_tpu.ops.groupby import _INIT
+
+        for comp in _INIT:
+            assert (comp in ADD_COMBINE or comp in MIN_COMBINE
+                    or comp in MAX_COMBINE), comp
+
+    def test_admission_prices_ring_sites(self):
+        """QoS admission must price a DABA sliding rule's extra compile
+        surface (3 ring sites + components_dyn), not just the shared
+        group-by sites — the signature budget would otherwise invert."""
+        from ekuiper_tpu.observability import jitcert
+
+        plan = extract_kernel_plan(parse_select(SQL_INV))
+        base = jitcert.estimate_plan_signatures(plan, 1, 128, 64)
+        ring = jitcert.estimate_plan_signatures(plan, 1, 128, 64,
+                                                sliding_ring_slots=83)
+        assert ring == base + 4
+
+    def test_rule_option_plumbs(self):
+        from ekuiper_tpu.planner.planner import RuleDef, merged_options
+
+        opts = merged_options(RuleDef(id="r", sql="",
+                                      options={"slidingImpl": "refold"}))
+        assert opts.sliding_impl == "refold"
+        assert merged_options(RuleDef(id="r", sql="")).sliding_impl == "daba"
+
+    def test_layout_is_plan_time(self):
+        layout = plan_ring_layout(2_000, 0, wide=False)
+        assert layout.n_panes == layout.n_ring_panes + 1
+        assert layout.span_buckets == -(-2_000 // layout.bucket_ms)
+        node, _ = mknode(SQL_INV, "daba")
+        assert node.bucket_ms == layout.bucket_ms
+        assert node.n_ring_panes == layout.n_ring_panes

@@ -55,6 +55,11 @@ HEADLINE = (
     # rule churn + skew shifts + backpressure — same loose tail
     # tolerance as the full-pipe p99 (one GC pause moves a p99)
     ("phases.churn_soak.soak_p99_ms", 0.50),
+    # sliding DABA rings (ISSUE 11): trigger→sink emit tail on the
+    # constant-time sliding path, saturated + paced twins — a sliding
+    # latency regression gates ci_gate every round, not report-only
+    ("phases.sliding_saturated.emit_p99_ms", 0.50),
+    ("phases.sliding_paced.emit_p99_ms", 0.50),
 )
 
 #: default noise tolerance for every non-headline comparison

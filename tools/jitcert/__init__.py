@@ -51,10 +51,23 @@ def _battery():
         assert p is not None, sql
         return p
 
+    from ekuiper_tpu.ops.slidingring import RingLayout, SlidingRing
+
     tumbling = plan("SELECT deviceId, avg(v) AS a, count(*) AS c "
                     "FROM s GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)")
     hopping = plan("SELECT deviceId, min(v) AS mn, max(v) AS mx FROM s "
                    "GROUP BY deviceId, HOPPINGWINDOW(ss, 4, 1)")
+    # sliding ring battery kernel: additive (count/hist) + two-stack
+    # (min) components over a small plan-time ring geometry
+    sliding = plan("SELECT deviceId, count(*) AS c, min(v) AS mn, "
+                   "percentile_approx(v, 0.5) AS p FROM s GROUP BY "
+                   "deviceId, SLIDINGWINDOW(ss, 2) OVER (WHEN v > 90)")
+    sliding_gb = DeviceGroupBy(sliding, capacity=32, n_panes=5,
+                               micro_batch=16)
+    sliding_ring = SlidingRing(
+        sliding_gb,
+        RingLayout(bucket_ms=500, n_ring_panes=4, n_panes=5,
+                   span_buckets=3, scratch_pane=4))
     hh = plan("SELECT deviceId, heavy_hitters(tag, 2) AS hh FROM s "
               "GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)")
     mr_sqls = [
@@ -73,6 +86,7 @@ def _battery():
         "multirule": BatchedGroupBy(mr_spec, capacity=32, n_panes=1,
                                     micro_batch=16),
         "sketch": CountMinSketch(depth=2, width=64, max_candidates=16),
+        "sliding_ring": sliding_ring,
     }
 
 
@@ -155,6 +169,34 @@ def _drive(kernels) -> None:
             gb.update(np.arange(10, dtype=np.float32))
             gb.update(np.arange(300, dtype=np.float32))  # next pad bucket
             gb.heavy_hitters(3)
+            continue
+        if name == "sliding_ring":
+            ring_kernel = gb
+            gb2 = ring_kernel.gb
+            state = gb2.init_state()
+            cols, valid, slots, pane = feed(gb2, with_masks=False,
+                                            pane_vec=False)
+            state = gb2.fold(state, cols, slots, pane_idx=pane)
+            ring = ring_kernel.init_state()
+            ring = ring_kernel.advance(ring, state, 0, True, 1, False)
+            ring = ring_kernel.flip(
+                ring, state, 0,
+                np.ones(ring_kernel.n_ring_panes, dtype=np.bool_))
+            from ekuiper_tpu.ops.slidingring import QUERY_ADJ
+
+            adj = np.zeros(QUERY_ADJ, dtype=np.int32)
+            ring_kernel.query_begin(
+                ring, state, body_on=True, f_on=True, f_slot=0,
+                adj_slots=adj,
+                adj_weights=np.zeros(QUERY_ADJ, dtype=np.float32),
+                adj_mm=np.zeros(QUERY_ADJ, dtype=np.bool_)).get()
+            gb2.components_begin_dyn(
+                state, np.zeros(gb2.n_panes, dtype=np.bool_)).get()
+            # capacity growth across a doubling: ring re-specialization
+            # must stay inside the certified ladder
+            state = gb2.grow(state, gb2.capacity * 2)
+            ring = ring_kernel.grow(ring, gb2.capacity)
+            ring = ring_kernel.advance(ring, state, 0, True, 1, False)
             continue
         state = gb.init_state()
         cols, valid, slots, pane = feed(gb, with_masks=False,

@@ -41,11 +41,14 @@ def main() -> None:
            "SLIDINGWINDOW(ss, 10) OVER (WHEN temperature > 44.5)")
     stmt = parse_select(sql)
     plan = extract_kernel_plan(stmt)
+    # this probe decomposes the LEGACY refold trigger path (scratch
+    # refolds / fold_masked) — pin slidingImpl=refold so it keeps probing
+    # that path now that DABA rings are the default (ops/slidingring.py)
     node = FusedWindowAggNode(
         "slide", stmt.window, plan, dims=[d.expr for d in stmt.dimensions],
         capacity=CAP, micro_batch=BATCH,
         direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
-        emit_columnar=True)
+        emit_columnar=True, sliding_impl="refold")
     node.state = node.gb.init_state()
     print(f"bucket_ms={node.bucket_ms} ring_panes={node.n_ring_panes}",
           file=sys.stderr)
