@@ -73,14 +73,18 @@ STATE_LEVEL = {HEALTHY: 0, DEGRADED: 1, BREACHING: 2}
 _LEVEL_STATE = {v: k for k, v in STATE_LEVEL.items()}
 
 #: canonical bottleneck taxonomy (TiLT-style stage decomposition of the
-#: ingest→emit path); "other" absorbs host-op busy time that belongs to
-#: none of the named stages (projections, filters, joins)
-STAGES = ("decode", "upload", "fold", "emit_combine", "sink", "other")
+#: ingest→emit path); "host_expr" is host-side expression evaluation
+#: (FilterNode vectorized/row WHERE, the row-interpreter fallback seam —
+#: sql/expr_ir.py compiles these onto the device for fused rules);
+#: "other" absorbs host-op busy time that belongs to none of the named
+#: stages (projections, joins)
+STAGES = ("decode", "upload", "fold", "emit_combine", "sink",
+          "host_expr", "other")
 
 #: node-local stage labels → canonical taxonomy
 _STAGE_CANON = {"decode": "decode", "ring": "decode",
                 "upload": "upload", "prep": "upload",
-                "fold": "fold"}
+                "fold": "fold", "host_expr": "host_expr"}
 
 #: classes whose UNSTAGED busy time is boundary work (finalize + window
 #: combine + emission) rather than row processing

@@ -147,15 +147,19 @@ def _state_leaves(comps: Dict[str, Tuple[int, int]], n_panes: int,
 
 def _col_leaves(columns: List[str], mb: int,
                 mask_subset: FrozenSet[str],
-                masks_always: bool = False) -> List[str]:
-    """Leaves of the kernel-columns dict: float32[mb] per column plus a
-    bool[mb] validity mask per column in `mask_subset` (absent masks are
-    None and vanish from the pytree — the sharded path materializes all
-    of them, `masks_always`)."""
+                masks_always: bool = False,
+                col_dtypes: Optional[Dict[str, str]] = None) -> List[str]:
+    """Leaves of the kernel-columns dict: one [mb] array per column
+    (float32 unless the plan's expression IR declared another dtype —
+    int32 string-dict codes / rebased ts32, KernelPlan.col_dtypes) plus
+    a bool[mb] validity mask per column in `mask_subset` (absent masks
+    are None and vanish from the pytree — the sharded path materializes
+    all of them, `masks_always`)."""
+    dts = col_dtypes or {}
     present = set(columns) if masks_always else set(mask_subset)
     keys = sorted(list(columns) + [f"__valid_{c}" for c in present])
     return [_arr("bool", mb) if k.startswith("__valid_")
-            else _arr("float32", mb) for k in keys]
+            else _arr(dts.get(k, "float32"), mb) for k in keys]
 
 
 def _mask_subsets(columns: List[str]) -> Tuple[List[FrozenSet[str]], bool]:
@@ -184,6 +188,9 @@ class KernelShape:
     base_capacity: int
     lead_rules: Optional[int] = None    # multirule rule axis
     host_finalize_only: bool = False    # heavy_hitters plans
+    #: expression-IR column dtype overrides (KernelPlan.col_dtypes):
+    #: int32 string-dict / ts32 columns change the fold leaves
+    col_dtypes: Dict[str, str] = field(default_factory=dict)
 
 
 def _kernel_shape(kernel) -> KernelShape:
@@ -205,6 +212,9 @@ def _kernel_shape(kernel) -> KernelShape:
         lead_rules=getattr(kernel, "n_rules", None),
         host_finalize_only=bool(getattr(kernel, "_host_finalize_only",
                                         False)),
+        col_dtypes={k: v for k, v in sorted(
+            getattr(kernel.plan, "col_dtypes", {}).items())
+            if v != "float32"},
     )
 
 
@@ -229,6 +239,8 @@ def shape_from_plan(plan, n_panes: int, micro_batch: int,
         micro_batch=int(micro_batch), base_capacity=int(capacity),
         host_finalize_only=any(s.kind == "heavy_hitters"
                                for s in plan.specs),
+        col_dtypes={k: v for k, v in sorted(
+            getattr(plan, "col_dtypes", {}).items()) if v != "float32"},
     )
 
 
@@ -278,11 +290,19 @@ def _derive_fold(ks: KernelShape, op: str, rule: Optional[str],
         deriv.append("slots: uint16 under the 65,535 slot_dtype boundary "
                      "(legal at every step: cached pre-grow arrays stay "
                      "valid), int32 above it")
+    if ks.col_dtypes:
+        deriv.append(
+            "expression-IR column dtypes: "
+            + ", ".join(f"{k}={v}" for k, v in sorted(
+                ks.col_dtypes.items()))
+            + " (KernelPlan.col_dtypes — __sd_* dict codes / __ts32_* "
+            "rebased event time)")
     for cap in _ladder(ks.base_capacity, grows):
         state = _state_leaves(ks.comps, ks.n_panes, cap, ks.lead_rules)
         for subset in subsets:
             cols = _col_leaves(ks.columns, ks.micro_batch, subset,
-                               masks_always=sharded)
+                               masks_always=sharded,
+                               col_dtypes=ks.col_dtypes)
             for sd in slot_dts:
                 for gate in row_gates:
                     for pane in panes:
@@ -300,6 +320,7 @@ def _derive_fold(ks: KernelShape, op: str, rule: Optional[str],
                      "micro_batch": ks.micro_batch, "n_panes": ks.n_panes,
                      "columns": ks.columns, "masked": masked,
                      "sharded": sharded, "lead_rules": ks.lead_rules,
+                     "col_dtypes": dict(ks.col_dtypes),
                      "comps": {c: list(v) for c, v in ks.comps.items()}},
                     frozenset(sigs[:ENUM_CAP]), deriv, truncated,
                     full_count=full)

@@ -220,6 +220,20 @@ def render(rule_registry) -> str:
     devwatch.render_prometheus(out, _esc)
     kernwatch.render_prometheus(out, _esc)
     memwatch.render_prometheus(out, _esc)
+    # expression host fallbacks (sql/compiler.py counters): plan-time
+    # count of expressions routed to the row interpreter, by structured
+    # NotVectorizable reason — the metric the health plane's bottleneck
+    # attribution pairs with the "host_expr" stage
+    from ..sql.compiler import host_fallback_counts
+
+    _family(out, "kuiper_expr_host_fallback_total", "counter",
+            "expressions that fell back to the host row interpreter at "
+            "plan time, by NotVectorizable reason")
+    for reason, n in sorted((host_fallback_counts()
+                             or {"none": 0}).items()):
+        out.append(
+            f'kuiper_expr_host_fallback_total{{reason="{_esc(reason)}"}} '
+            f"{n}")
     # health plane (observability/health.py): per-rule verdict, SLO burn
     # rate, watermark lag, bottleneck stage — computed at evaluator ticks,
     # rendered from the last verdicts (a scrape never forces a tick)

@@ -9,13 +9,15 @@ plain adds/mins/maxes.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+import threading
+from dataclasses import dataclass, field, replace as _dc_replace
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from ..sql import ast
-from ..sql.compiler import CompiledExpr, try_compile
+from ..sql import ast, expr_ir
+from ..sql.compiler import CompiledExpr
+from ..sql.expr_ir import NotVectorizable
 
 # aggregate name -> components needed by finalize
 DEVICE_AGGS: Dict[str, Set[str]] = {
@@ -252,6 +254,22 @@ class KernelPlan:
     filter: Optional[CompiledExpr]  # WHERE clause (device)
     columns: Set[str] = field(default_factory=set)  # numeric columns to upload
     filter_host: Optional[CompiledExpr] = None  # numpy twin of `filter`
+    #: per-kernel-column upload dtype ("float32" default; "int32" for the
+    #: expression IR's dictionary-code / rebased-ts32 derived columns) —
+    #: consumed by the fold upload (ops/groupby.py) and the jitcert fold
+    #: derivations (bounded signature families include the dtype)
+    col_dtypes: Dict[str, str] = field(default_factory=dict)
+    #: expression-IR derived columns (sql/expr_ir.py DerivedCol): host
+    #: prep producing the __sd_*/__ts32_* device columns
+    derived: Tuple[Any, ...] = ()
+    #: stable hash of every compiled expression's IR — part of the
+    #: ingest-prep upload share keys (runtime/ingest.py), so two plans
+    #: whose expressions differ can never alias a pre-uploaded column
+    expr_tag: str = ""
+    #: predicate lifting (planner/sharing.py): index of the synthetic
+    #: `count(*) FILTER(WHERE <rule predicate>)` activity spec a lifted
+    #: member reads its group-existence from (None = the global `act`)
+    act_idx: Optional[int] = None
 
     @property
     def host_foldable(self) -> bool:
@@ -267,6 +285,44 @@ class KernelPlan:
         return True
 
 
+_tl = threading.local()
+
+
+def take_expr_fallbacks() -> List[Dict[str, str]]:
+    """Structured NotVectorizable reasons recorded by the LAST
+    extract_kernel_plan call on this thread (cleared on read) — the
+    planner turns them into `kuiper_expr_host_fallback_total` samples
+    and the explain "expressions" section."""
+    out = getattr(_tl, "expr_fallbacks", [])
+    _tl.expr_fallbacks = []
+    return out
+
+
+def _note_fallback(kind: str, expr: Optional[ast.Expr],
+                   exc: NotVectorizable) -> None:
+    notes = getattr(_tl, "expr_fallbacks", None)
+    if notes is None:
+        notes = _tl.expr_fallbacks = []
+    notes.append({"kind": kind,
+                  "expr": _expr_key(expr) if expr is not None else "",
+                  "reason": getattr(exc, "reason", "other"),
+                  "detail": str(exc)})
+
+
+def _compile_device(expr: ast.Expr, want: str, kind: str,
+                    anchor_ms: int, str_seed=None
+                    ) -> Optional[expr_ir.CompiledIR]:
+    """Device-compile one expression via the IR; a failure records the
+    structured reason (the whole rule then takes the host path)."""
+    try:
+        return expr_ir.compile_expr_ir(expr, mode="device", want=want,
+                                       anchor_ms=anchor_ms,
+                                       str_seed=str_seed)
+    except NotVectorizable as exc:
+        _note_fallback(kind, expr, exc)
+        return None
+
+
 def extract_kernel_plan(
     stmt: ast.SelectStatement, where_on_device: bool = True
 ) -> Optional[KernelPlan]:
@@ -275,9 +331,38 @@ def extract_kernel_plan(
     Returns None if any aggregate (or its argument expression) is not
     device-eligible — the planner then uses the host window path.
     """
+    _tl.expr_fallbacks = []
     calls = _collect_agg_calls(stmt)
     if not calls:
         return None
+    # one temporal anchor per plan: every ts32 derivation and rebased
+    # literal of this rule shares it (and the IR hashes reflect it)
+    anchor_ms = expr_ir.plan_anchor_ms()
+    # plan-level string-dictionary seed: union the string constants of
+    # every compilable piece, so WHERE + agg args + FILTERs derive ONE
+    # __sd_* column per raw column (one host encode, one upload)
+    str_seed: Dict[str, Set[str]] = {}
+    seed_roots: List[ast.Expr] = []
+    if stmt.condition is not None and where_on_device:
+        seed_roots.append(stmt.condition)
+    for c in calls:
+        if c.args and not isinstance(c.args[0], ast.Wildcard):
+            seed_roots.append(c.args[0])
+        if c.filter is not None:
+            seed_roots.append(c.filter)
+    for root in seed_roots:
+        for col, vals in expr_ir.collect_str_consts(root).items():
+            str_seed.setdefault(col, set()).update(vals)
+    col_dtypes: Dict[str, str] = {}
+    derived: Dict[str, Any] = {}
+    ir_keys: List[str] = []
+
+    def absorb(ce: expr_ir.CompiledIR) -> None:
+        col_dtypes.update(ce.col_dtypes)
+        for d in ce.derived:
+            derived[d.name] = d
+        ir_keys.append(ce.ir_key)
+
     specs: List[AggSpec] = []
     columns: Set[str] = set()
     for call in calls:
@@ -336,20 +421,30 @@ def extract_kernel_plan(
                     lambda cols, _h=hcol: cols[_h], {hcol}, "host"
                 )
             else:
-                arg_ce = try_compile(call.args[0], mode="device")
+                arg_ce = _compile_device(call.args[0], "number",
+                                         f"agg-arg:{call.name}", anchor_ms,
+                                         str_seed=str_seed)
                 if arg_ce is None:
                     return None
-                arg_host = try_compile(call.args[0], mode="host")
+                absorb(arg_ce)
+                arg_host = expr_ir.try_compile_ir(
+                    call.args[0], mode="host", want="number",
+                    anchor_ms=anchor_ms, str_seed=str_seed)
             columns |= arg_ce.columns
         else:
             arg_host = None
         filter_ce: Optional[CompiledExpr] = None
         filter_host: Optional[CompiledExpr] = None
         if call.filter is not None:
-            filter_ce = try_compile(call.filter, mode="device")
+            filter_ce = _compile_device(call.filter, "bool",
+                                        f"agg-filter:{call.name}",
+                                        anchor_ms, str_seed=str_seed)
             if filter_ce is None:
                 return None
-            filter_host = try_compile(call.filter, mode="host")
+            absorb(filter_ce)
+            filter_host = expr_ir.try_compile_ir(
+                call.filter, mode="host", want="bool", anchor_ms=anchor_ms,
+                str_seed=str_seed)
             columns |= filter_ce.columns
         specs.append(
             AggSpec(
@@ -367,13 +462,149 @@ def extract_kernel_plan(
     where_ce: Optional[CompiledExpr] = None
     where_host: Optional[CompiledExpr] = None
     if stmt.condition is not None and where_on_device:
-        where_ce = try_compile(stmt.condition, mode="device")
+        where_ce = _compile_device(stmt.condition, "bool", "where",
+                                   anchor_ms, str_seed=str_seed)
         if where_ce is None:
             return None  # caller may retry with host-side where
-        where_host = try_compile(stmt.condition, mode="host")
+        absorb(where_ce)
+        where_host = expr_ir.try_compile_ir(
+            stmt.condition, mode="host", want="bool", anchor_ms=anchor_ms,
+            str_seed=str_seed)
         columns |= where_ce.columns
-    return KernelPlan(specs=specs, filter=where_ce, columns=columns,
-                      filter_host=where_host)
+    return KernelPlan(
+        specs=specs, filter=where_ce, columns=columns,
+        filter_host=where_host, col_dtypes=col_dtypes,
+        derived=tuple(sorted(derived.values(), key=lambda d: d.name)),
+        expr_tag=expr_ir.ir_hash(ir_keys) if ir_keys else "")
+
+
+def conj(a: Optional[ast.Expr], b: Optional[ast.Expr]) -> Optional[ast.Expr]:
+    """AND-conjunction of two optional predicates."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return ast.BinaryExpr("AND", a, b)
+
+
+def lift_predicate(plan: KernelPlan,
+                   condition: Optional[ast.Expr]
+                   ) -> Optional[KernelPlan]:
+    """Predicate lifting for the shared pane fold (planner/sharing.py,
+    per "On the Semantic Overlap of Operators in Stream Processing
+    Engines"): the rule-level WHERE moves out of the plan's base filter
+    and into every spec's FILTER mask, plus a synthetic
+    `count(*) FILTER(WHERE <predicate>)` activity spec the member's emit
+    reads its group existence from. Fold output for the original specs
+    is byte-identical to the private plan's (the same base∧filter mask
+    composition in ops/groupby.py _fold_core), but the plan no longer
+    gates the SHARED fold — rules that differ only in predicate can
+    union into one pooled fold.
+
+    Spec order is preserved (direct-emit indices stay valid); the
+    activity spec appends at the end, its index in `act_idx`.
+
+    Returns None when the conjunction does not device-compile (the
+    pieces compiled separately but conflict when conjoined — e.g. a
+    column typed temporal by the WHERE and numeric by a FILTER): the
+    caller must then keep the fold PRIVATE. An unlifted filtered plan
+    must never enter a pooled union — its base filter would gate every
+    peer's rows.
+    """
+    if condition is None:
+        # nothing to lift: the plan folds every row, the global `act`
+        # is this rule's own activity — share as-is
+        return plan
+    anchor_ms = expr_ir.plan_anchor_ms()
+    # plan-level dictionary seed across WHERE + every FILTER, so the
+    # lifted plan derives ONE __sd_* column per raw column (the same
+    # one-encode/one-upload invariant extract_kernel_plan keeps)
+    str_seed: Dict[str, Set[str]] = {}
+    for d in plan.derived:
+        # the plan's existing dictionaries (agg args / CASE constants)
+        # seed the lift, so the lifted filters resolve to the SAME
+        # __sd_* columns the arg closures already reference
+        if d.kind == "strdict":
+            str_seed.setdefault(d.raw, set()).update(d.values)
+    for root in [condition] + [s.call.filter for s in plan.specs
+                               if s.call.filter is not None]:
+        for col, vals in expr_ir.collect_str_consts(root).items():
+            str_seed.setdefault(col, set()).update(vals)
+    try:
+        new_specs: List[AggSpec] = []
+        for spec in plan.specs:
+            f_ast = conj(condition, spec.call.filter)
+            filter_ce = expr_ir.compile_expr_ir(
+                f_ast, mode="device", want="bool", anchor_ms=anchor_ms,
+                str_seed=str_seed)
+            filter_host = expr_ir.try_compile_ir(
+                f_ast, mode="host", want="bool", anchor_ms=anchor_ms,
+                str_seed=str_seed)
+            new_specs.append(_dc_replace(
+                spec, call=_dc_replace(spec.call, filter=f_ast),
+                filter=filter_ce, filter_host=filter_host))
+        act_filter = expr_ir.compile_expr_ir(
+            condition, mode="device", want="bool", anchor_ms=anchor_ms,
+            str_seed=str_seed)
+        act_host = expr_ir.try_compile_ir(
+            condition, mode="host", want="bool", anchor_ms=anchor_ms,
+            str_seed=str_seed)
+    except NotVectorizable:
+        return None
+    act_call = ast.Call(name="count", args=[ast.Wildcard()],
+                        filter=condition)
+    new_specs.append(AggSpec(
+        call=act_call, kind="count", components={"n"}, arg=None,
+        filter=act_filter, filter_host=act_host))
+    col_dtypes = dict(plan.col_dtypes)
+    derived = {d.name: d for d in plan.derived}
+    columns = set(plan.columns)
+    ir_keys = []
+    for ce in [s.filter for s in new_specs if s.filter is not None]:
+        col_dtypes.update(ce.col_dtypes)
+        for d in ce.derived:
+            derived[d.name] = d
+        columns |= ce.columns
+        ir_keys.append(ce.ir_key)
+    return KernelPlan(
+        specs=new_specs, filter=None, columns=columns, filter_host=None,
+        col_dtypes=col_dtypes,
+        derived=tuple(sorted(derived.values(), key=lambda d: d.name)),
+        expr_tag=expr_ir.ir_hash([plan.expr_tag] + ir_keys),
+        act_idx=len(new_specs) - 1)
+
+
+def explain_expressions(stmt: ast.SelectStatement) -> Dict[str, Any]:
+    """The "expressions" section of GET /rules/{id}/explain: per-piece
+    device-compilation status with structured NotVectorizable reasons —
+    names host expression eval instead of an opaque host-path verdict."""
+    anchor_ms = expr_ir.plan_anchor_ms()
+    pieces: List[Tuple[str, Optional[ast.Expr], str]] = []
+    if stmt.condition is not None:
+        pieces.append(("where", stmt.condition, "bool"))
+    for call in _collect_agg_calls(stmt):
+        if call.args and not isinstance(call.args[0], ast.Wildcard):
+            pieces.append((f"agg-arg:{call.name}", call.args[0], "number"))
+        if call.filter is not None:
+            pieces.append((f"agg-filter:{call.name}", call.filter, "bool"))
+    out: List[Dict[str, Any]] = []
+    n_host = 0
+    for kind, expr, want in pieces:
+        entry: Dict[str, Any] = {"kind": kind, "expr": _expr_key(expr)}
+        try:
+            ce = expr_ir.compile_expr_ir(expr, mode="device", want=want,
+                                         anchor_ms=anchor_ms)
+            entry["path"] = "device"
+            if ce.derived:
+                entry["derived"] = [d.name for d in ce.derived]
+        except NotVectorizable as exc:
+            entry["path"] = "host"
+            entry["reason"] = getattr(exc, "reason", "other")
+            entry["detail"] = str(exc)
+            n_host += 1
+        out.append(entry)
+    return {"pieces": out, "host_fallbacks": n_host,
+            "path": "host" if n_host else "device"}
 
 
 def _collect_agg_calls(stmt: ast.SelectStatement) -> List[ast.Call]:
@@ -414,6 +645,23 @@ def _expr_key(e: Optional[ast.Expr]) -> str:
         return f"({_expr_key(e.lhs)}{e.op}{_expr_key(e.rhs)})"
     if isinstance(e, ast.UnaryExpr):
         return f"({e.op}{_expr_key(e.expr)})"
+    if isinstance(e, ast.BetweenExpr):
+        neg = "!" if e.negate else ""
+        return (f"({_expr_key(e.value)} {neg}BETWEEN "
+                f"{_expr_key(e.lo)},{_expr_key(e.hi)})")
+    if isinstance(e, ast.InExpr):
+        neg = "!" if e.negate else ""
+        return (f"({_expr_key(e.value)} {neg}IN "
+                f"[{','.join(_expr_key(v) for v in e.values)}])")
+    if isinstance(e, ast.LikeExpr):
+        neg = "!" if e.negate else ""
+        return f"({_expr_key(e.value)} {neg}LIKE {_expr_key(e.pattern)})"
+    if isinstance(e, ast.CaseExpr):
+        base = _expr_key(e.value) if e.value is not None else ""
+        whens = ";".join(f"{_expr_key(w.cond)}->{_expr_key(w.result)}"
+                         for w in e.whens)
+        els = _expr_key(e.else_expr) if e.else_expr is not None else ""
+        return f"CASE({base};{whens};{els})"
     if isinstance(e, ast.Wildcard):
         return "*"
     return repr(e)

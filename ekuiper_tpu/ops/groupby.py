@@ -51,6 +51,23 @@ def _wide_size(comp: str) -> int:
     return _WIDE_SIZE[comp]
 
 
+def col_np_dtype(plan: KernelPlan, name: str):
+    """Upload dtype for one kernel column: float32 unless the plan's
+    expression IR declared otherwise (int32 string-dict codes / rebased
+    ts32 — KernelPlan.col_dtypes). THE one mapping shared by the fold
+    upload, the ingest prep pre-upload, warmups, and the jitcert fold
+    derivations."""
+    return np.dtype(getattr(plan, "col_dtypes", {}).get(name, "float32"))
+
+
+def warmup_cols(plan: KernelPlan, n: int = 1) -> Dict[str, np.ndarray]:
+    """Dtype-correct zero columns for a warmup fold — the throwaway
+    batch must present the same column dtypes real batches will, or the
+    warmup compiles an executable no real fold ever hits."""
+    return {name: np.zeros(n, dtype=col_np_dtype(plan, name))
+            for name in plan.columns}
+
+
 def slot_dtype(capacity: int):
     """Slot-vector wire dtype for a key capacity — the ONE place holding
     the uint16/int32 boundary. Slots ship as uint16 while every assignable
@@ -272,7 +289,8 @@ class DeviceGroupBy:
                     dev_cols["__valid_" + name] = valid.get(name)
                     continue
                 # kuiperlint: ignore[host-sync]: `c` is a HOST column here (device arrays took the pre-padded branch above) — this is H2D staging, not a sync
-                arr = np.asarray(c[start:end], dtype=np.float32)
+                arr = np.asarray(c[start:end],
+                                 dtype=col_np_dtype(self.plan, name))
                 if pad:
                     arr = np.pad(arr, (0, pad))
                 dev_cols[name] = jnp.asarray(arr)

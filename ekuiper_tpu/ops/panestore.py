@@ -44,15 +44,22 @@ def pane_gcd(values_ms: Iterable[int]) -> int:
 
 def union_plan(plans: Sequence[KernelPlan]) -> Tuple[KernelPlan, List[List[int]]]:
     """Union N rules' kernel plans into one foldable plan, deduplicating
-    aggregate specs by call key (avg(x) wanted by 5 rules folds once).
-    Returns (union, maps) where maps[r][i] is the union spec index of rule
-    r's spec i. The WHERE filter must be identical across members (the
-    planner keys sharing on the WHERE expression), so the first plan's
-    filter speaks for all."""
+    aggregate specs by call key (avg(x) wanted by 5 rules folds once; a
+    predicate-lifted spec's key includes its FILTER, so identical-WHERE
+    peers still dedup while different-WHERE peers coexist as distinct
+    masked specs over ONE fold). Returns (union, maps) where maps[r][i]
+    is the union spec index of rule r's spec i. Statement-level WHERE
+    filters must be identical across members — predicate lifting
+    (ops/aggspec.py lift_predicate) rewrites them into per-spec FILTER
+    masks and leaves the plan filter None, so in the sharing path the
+    first plan's filter (None) speaks for all."""
     specs: List = []
     index: Dict[str, int] = {}
     columns: set = set()
+    col_dtypes: Dict[str, str] = {}
+    derived: Dict[str, object] = {}
     maps: List[List[int]] = []
+    tags: List[str] = []
     for plan in plans:
         m: List[int] = []
         for spec in plan.specs:
@@ -63,11 +70,21 @@ def union_plan(plans: Sequence[KernelPlan]) -> Tuple[KernelPlan, List[List[int]]
                 specs.append(spec)
             m.append(at)
         columns |= plan.columns
+        col_dtypes.update(getattr(plan, "col_dtypes", {}))
+        for d in getattr(plan, "derived", ()):
+            derived[d.name] = d
+        if getattr(plan, "expr_tag", ""):
+            tags.append(plan.expr_tag)
         maps.append(m)
     first = plans[0]
+    from ..sql.expr_ir import ir_hash
+
     return (
         KernelPlan(specs=specs, filter=first.filter, columns=columns,
-                   filter_host=first.filter_host),
+                   filter_host=first.filter_host, col_dtypes=col_dtypes,
+                   derived=tuple(sorted(derived.values(),
+                                        key=lambda d: d.name)),
+                   expr_tag=ir_hash(tags) if tags else ""),
         maps,
     )
 
@@ -91,7 +108,17 @@ def build_value_columns(
     so there is no __hhc__ branch here."""
     cols: Dict[str, np.ndarray] = {}
     valid: Dict[str, np.ndarray] = {}
+    if getattr(plan, "derived", ()):
+        # expression-IR derived columns (__sd_*/__ts32_* — predicate-
+        # lifted WHERE masks ride these): host prep with self-describing
+        # null sentinels, sql/expr_ir.py
+        from ..sql.expr_ir import materialize_derived
+
+        materialize_derived(plan.derived, cols, sub,
+                            expr_tag=getattr(plan, "expr_tag", ""))
     for name in plan.columns:
+        if name in cols:
+            continue  # derived expr column, just materialized
         if name.startswith(HLL_COL_PREFIX):
             raw_name = name[len(HLL_COL_PREFIX):]
             col = sub.columns.get(raw_name)
@@ -186,8 +213,9 @@ class PaneStore:
         jit latency. Never touches self.state (it may hold restored
         partials)."""
         try:
-            cols = {name: np.zeros(1, dtype=np.float32)
-                    for name in self.plan.columns}
+            from .groupby import warmup_cols
+
+            cols = warmup_cols(self.plan)
             slots = np.zeros(1, dtype=np.int32)
             dummy = self.gb.init_state()
             dummy = self.gb.fold(dummy, dict(cols), slots, pane_idx=0)

@@ -44,7 +44,7 @@ one flip. Exactness never depends on the cache being fresh.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -77,33 +77,90 @@ class RingLayout:
     scratch_pane: int   # scratch slot index (refold edge folds)
 
 
-def plan_ring_layout(length_ms: int, delay_ms: int,
-                     wide: bool) -> RingLayout:
+def plan_ring_layout(length_ms: int, delay_ms: int, wide: bool,
+                     budget_bytes: Optional[int] = None,
+                     mm_slot_bytes: int = 0,
+                     fixed_bytes: int = 0) -> RingLayout:
     """Ring geometry for a sliding window: finer buckets shrink the edge
     corrections (≤1 bucket of rows host-folded per trigger edge); bounded
-    by the uint8 pane budget AND by HBM — wide sketch components
-    (hist=512, hll=64 registers) pay panes×capacity×width×4B of state, so
-    they get coarser buckets."""
-    target = 48 if wide else 128
-    bucket_ms = max(length_ms // target, 25,
-                    -(-(length_ms + delay_ms) // 250))
-    span = -(-(length_ms + delay_ms) // bucket_ms)
-    n_ring = span + 3
-    n_panes = n_ring + 1  # +1 scratch pane (refold impl edge folds)
-    if n_panes > 255:
-        raise ValueError(
-            f"sliding window needs {n_panes} panes (max 255)")
-    return RingLayout(bucket_ms=int(bucket_ms), n_ring_panes=int(n_ring),
-                      n_panes=int(n_panes), span_buckets=int(span),
-                      scratch_pane=int(n_ring))
+    by the uint8 pane budget AND by HBM. Wide sketch components
+    (hist=512, hll=64 registers) pay panes×capacity×width×4B of
+    front-stack state, so they start coarser — and when `budget_bytes`
+    is given (the slidingDevRingMb budget), the bucket target walks DOWN
+    a ladder until the ring's static footprint fits: a wide-hll sliding
+    rule coarsens its ring instead of silently refolding (ROADMAP item-2
+    remnant). `mm_slot_bytes` is the per-ring-slot front-stack cost at
+    the plan's key capacity; `fixed_bytes` the slot-count-independent
+    part (running totals + back stacks)."""
+    targets = (48,) if wide else (128,)
+    if budget_bytes is not None:
+        targets = (48, 32, 24, 16, 12, 8) if wide \
+            else (128, 64, 48, 32, 24, 16, 12, 8)
+    layout = None
+    for target in targets:
+        bucket_ms = max(length_ms // target, 25,
+                        -(-(length_ms + delay_ms) // 250))
+        span = -(-(length_ms + delay_ms) // bucket_ms)
+        n_ring = span + 3
+        n_panes = n_ring + 1  # +1 scratch pane (refold impl edge folds)
+        if n_panes > 255:
+            raise ValueError(
+                f"sliding window needs {n_panes} panes (max 255)")
+        layout = RingLayout(
+            bucket_ms=int(bucket_ms), n_ring_panes=int(n_ring),
+            n_panes=int(n_panes), span_buckets=int(span),
+            scratch_pane=int(n_ring))
+        if budget_bytes is None:
+            return layout
+        est = fixed_bytes + (1 + n_ring) * mm_slot_bytes
+        if est <= budget_bytes:
+            return layout
+    return layout  # coarsest rung; the node's own budget check decides
 
 
-def ring_layout_for(window, plan) -> RingLayout:
-    """Layout from the parsed window + kernel plan (the planner's entry)."""
+def _plan_ring_bytes(plan, capacity: int):
+    """(mm_slot_bytes, fixed_bytes) of a plan's ring state at `capacity`
+    — the same component arithmetic SlidingRing.estimate_bytes uses,
+    computed WITHOUT constructing the kernel (plan-time layout choice)."""
+    from .aggspec import WIDE_COMPONENTS
+    from .groupby import _wide_size
+
+    comp_specs: dict = {}
+    for i, spec in enumerate(plan.specs):
+        for comp in spec.components:
+            comp_specs.setdefault(comp, []).append(i)
+    mm_slot = 0
+    fixed = 0
+    for comp in sorted(list(comp_specs) + ["act"]):
+        k = len(comp_specs.get(comp, ()))
+        dims = 1 if comp == "act" else (
+            k * (_wide_size(comp) if comp in WIDE_COMPONENTS else 1))
+        per = capacity * dims * 4
+        if comp in ADD_COMBINE:
+            fixed += per              # tot_<comp>
+        else:
+            # back_<comp> + front_<comp>: one per-slot unit covers the
+            # back stack too, matching SlidingRing.estimate_bytes's
+            # per×(1+n_ring) exactly (the regression test pins parity)
+            mm_slot += per
+    return mm_slot, fixed
+
+
+def ring_layout_for(window, plan, capacity: Optional[int] = None,
+                    budget_mb: Optional[int] = None) -> RingLayout:
+    """Layout from the parsed window + kernel plan (the planner's entry).
+    With `capacity` + `budget_mb` the layout is budget-aware: the ring
+    coarsens until its static HBM estimate fits slidingDevRingMb."""
     from .aggspec import WIDE_COMPONENTS
 
     wide = any(set(s.components) & WIDE_COMPONENTS for s in plan.specs)
-    return plan_ring_layout(window.length_ms(), window.delay_ms(), wide)
+    if capacity is None or budget_mb is None:
+        return plan_ring_layout(window.length_ms(), window.delay_ms(),
+                                wide)
+    mm_slot, fixed = _plan_ring_bytes(plan, int(capacity))
+    return plan_ring_layout(window.length_ms(), window.delay_ms(), wide,
+                            budget_bytes=int(budget_mb) << 20,
+                            mm_slot_bytes=mm_slot, fixed_bytes=fixed)
 
 
 class SlidingRing:

@@ -396,6 +396,20 @@ def plan_rule(rule: RuleDef, store) -> Topo:
 
     needed = referenced_columns(stmt)
     kernel_plan = device_path_eligible(stmt, opts)
+    # expression host fallbacks: when the ONLY thing keeping this rule
+    # off the fused device path is an uncompilable expression, count it
+    # (kuiper_expr_host_fallback_total{reason}) so the health plane can
+    # name host expression eval instead of binning it as "other"
+    from ..ops.aggspec import take_expr_fallbacks
+    from ..sql.compiler import record_host_fallback
+
+    expr_notes = take_expr_fallbacks()
+    if kernel_plan is None and expr_notes:
+        for note in expr_notes:
+            record_host_fallback(note["reason"])
+        logger.info(
+            "rule %s: host expression path — %s", rule.id,
+            "; ".join(f"{n['kind']}: {n['reason']}" for n in expr_notes))
 
     # shared pane fold (planner/sharing.py): correlated rules over one
     # stream fold once into a pooled pane store and combine per window —
@@ -861,7 +875,12 @@ def _build_device_chain(
     if stmt.window.window_type == ast.WindowType.SLIDING_WINDOW:
         from ..ops.slidingring import ring_layout_for
 
-        ring_layout = ring_layout_for(stmt.window, kernel_plan)
+        # budget-aware geometry: wide sketch plans (hll front stacks)
+        # coarsen their buckets until the ring's static HBM footprint
+        # fits slidingDevRingMb, instead of silently refolding
+        ring_layout = ring_layout_for(
+            stmt.window, kernel_plan, capacity=opts.key_slots,
+            budget_mb=opts.sliding_dev_ring_mb)
     fused = FusedWindowAggNode(
         "window_agg", stmt.window, kernel_plan, dims,
         capacity=opts.key_slots, micro_batch=opts.micro_batch_rows,
@@ -1065,4 +1084,15 @@ def explain(rule: RuleDef, store) -> Dict[str, Any]:
     out: Dict[str, Any] = {"path": path, "operators": ops}
     if sharing_info is not None:
         out["sharing"] = sharing_info
+    # structured expression-compilation report: which WHERE/arg/FILTER
+    # pieces device-compile and which fall back to the row interpreter
+    # (with NotVectorizable reason slugs) — so "path: host" is
+    # attributable instead of opaque
+    from ..ops.aggspec import explain_expressions, take_expr_fallbacks
+
+    try:
+        out["expressions"] = explain_expressions(stmt)
+    except Exception as exc:  # explain must never fail on the probe
+        out["expressions"] = {"error": str(exc)}
+    take_expr_fallbacks()  # drop probe-recorded notes (explain is read-only)
     return out

@@ -15,9 +15,15 @@ already uses for hopping windows (arxiv 2009.13768).
 This module decides WHEN that rewrite pays off and wires it up:
 
 - **Correlation test** — same stream config (subtopo key), same GROUP BY
-  key set, same (or absent) WHERE, unionable device aggregate specs,
-  tumbling/hopping windows whose length/interval are multiples of the
-  common pane. Everything else keeps a private fold.
+  key set, unionable device aggregate specs, tumbling/hopping windows
+  whose length/interval are multiples of the common pane. WHERE clauses
+  need NOT match: each member's predicate lifts into per-spec device
+  FILTER masks + a private activity spec over the pooled fold
+  (ops/aggspec.py lift_predicate, per "On the Semantic Overlap of
+  Operators in Stream Processing Engines") — identical-WHERE peers
+  still dedup their specs outright, different-WHERE peers coexist as
+  masked specs in ONE fold dispatch. Everything else keeps a private
+  fold.
 - **Cost model** — sharing saves one whole fold dispatch per batch per
   member rule, and costs a finer-grained pane merge at each member's
   window emit. The rewrite happens only when the estimated per-second
@@ -41,7 +47,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-from ..ops.aggspec import KernelPlan, _expr_key
+from ..ops.aggspec import KernelPlan
 from ..sql import ast
 from ..utils.infra import logger
 
@@ -165,22 +171,41 @@ def _window_ms(w: ast.Window) -> tuple:
 def store_key(subtopo_key: str, stmt: ast.SelectStatement, opts) -> str:
     """Identity of a shareable pane store: the stream pipeline plus every
     plan facet that must match bit-for-bit across members — the GROUP BY
-    key set, the WHERE clause (it gates the shared fold itself), and the
-    time domain."""
+    key set and the time domain. The WHERE clause is deliberately NOT a
+    facet any more: predicate lifting (ops/aggspec.py lift_predicate)
+    turns each member's WHERE into per-spec device FILTER masks over one
+    pooled fold, so rules that differ only in predicate share a store
+    (PAPERS.md "On the Semantic Overlap of Operators in Stream
+    Processing Engines")."""
     dims = ",".join(d.expr.name for d in stmt.dimensions
                     if isinstance(d.expr, ast.FieldRef))
     return (f"{subtopo_key}|fold|dims={dims}"
-            f"|where={_expr_key(stmt.condition)}"
             f"|evt={int(opts.is_event_time)}:{opts.late_tolerance_ms}")
 
 
 def decide(stmt: ast.SelectStatement, opts, plan: KernelPlan,
            subtopo_key: str, rule_id: str,
-           has_direct_emit: bool = True) -> Decision:
+           has_direct_emit: bool = True,
+           lifted: Optional[KernelPlan] = None) -> Decision:
     """The sharing decision for one rule. Pure: consults live stores and
     plan-time declarations but mutates neither (explain/probe call this
-    repeatedly)."""
+    repeatedly). `plan` is the rule's private plan; `lifted` its
+    predicate-lifted form (computed here when absent) — declarations,
+    union coverage, and the cost model all run on the LIFTED plan, the
+    shape that would actually join the pooled fold."""
+    from ..ops.aggspec import lift_predicate
+
+    if lifted is None:
+        lifted = lift_predicate(plan, stmt.condition)
     key = store_key(subtopo_key, stmt, opts)
+    if lifted is None:
+        # the WHERE∧FILTER conjunction does not device-compile (pieces
+        # conflicted when conjoined): an unlifted filtered plan must
+        # never enter a pooled union — stay private, don't declare
+        return Decision(
+            False, "predicate lift not compilable (WHERE/FILTER "
+            "conjunction has no device form) — private fold", key)
+    plan = lifted
 
     def no(reason: str, est: Optional[dict] = None) -> Decision:
         return Decision(False, reason, key, est or {})
@@ -357,14 +382,21 @@ def try_plan_shared(topo, stmt: ast.SelectStatement, kernel_plan: KernelPlan,
         return None
     dims = [d.expr.name for d in stmt.dimensions]
     direct = build_direct_emit(stmt, kernel_plan, dims)
+    # predicate lifting: the member's WHERE becomes per-spec device
+    # FILTER masks + a private activity spec over the pooled fold
+    # (ops/aggspec.py lift_predicate) — this LIFTED plan is what the
+    # rule declares, joins, and emits from
+    from ..ops.aggspec import lift_predicate
+
+    lifted = lift_predicate(kernel_plan, stmt.condition)
     decision = decide(stmt, opts, kernel_plan, subkey, rule.id,
-                      has_direct_emit=direct is not None)
+                      has_direct_emit=direct is not None, lifted=lifted)
     length, interval = _window_ms(stmt.window)
     if decision.eligible:
         # candidacy is declared even when this rule stays private (no
         # peers yet / cost) so later correlated rules see it as a peer
         # and the store's pane GCD covers its windows
-        declare(decision.store_key, rule.id, length, interval, kernel_plan)
+        declare(decision.store_key, rule.id, length, interval, lifted)
     if not decision.share:
         loud = explicit or opts.qos > 0
         log = logger.warning if loud else logger.debug
@@ -390,11 +422,11 @@ def try_plan_shared(topo, stmt: ast.SelectStatement, kernel_plan: KernelPlan,
         decision.store_key, subkey, build_nodes, display, opts,
         opts.is_event_time, opts.late_tolerance_ms,
         fallback_decl={"length_ms": length, "interval_ms": interval,
-                       "plan": kernel_plan})
+                       "plan": lifted})
     spec = sf.MemberSpec(
         rule_id=rule.id, length_ms=length, interval_ms=interval,
-        plan=kernel_plan, direct_emit=direct, dims=dims,
-        emit_columnar=opts.emit_columnar)
+        plan=lifted, direct_emit=direct, dims=dims,
+        emit_columnar=opts.emit_columnar, act_idx=lifted.act_idx)
     entry = sf.SharedEmitNode(f"{rule.id}_shared_emit",
                               buffer_length=opts.buffer_length)
     topo.add_op(entry)

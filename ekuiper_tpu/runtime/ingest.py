@@ -282,16 +282,19 @@ class DecodePool:
                         self._drained.notify_all()
 
 
-def pad_col_for_device(host, vm, mb: int):
+def pad_col_for_device(host, vm, mb: int, dtype: str = "float32"):
     """Canonical pad + device upload for one kernel column — the ONE
-    builder behind the share key ("dcol", name, mb). Both the prep ctx
-    (pool-side pre-upload) and nodes_fused._shared_device_inputs (inline
-    fallback) call this, so a cache hit can never serve a differently
-    built array than the inline path would have made."""
+    builder behind the share keys ("dcol", name, mb) and
+    ("dexpr", expr_tag, name, mb). Both the prep ctx (pool-side
+    pre-upload) and nodes_fused._shared_device_inputs (inline fallback)
+    call this, so a cache hit can never serve a differently built array
+    than the inline path would have made. `dtype` follows the plan's
+    per-column map (ops/groupby.py col_np_dtype): float32 for plain
+    numeric columns, int32 for the expression IR's derived columns."""
     import jax.numpy as jnp
     import numpy as np
 
-    arr = np.asarray(host, dtype=np.float32)
+    arr = np.asarray(host, dtype=np.dtype(dtype))
     if len(arr) < mb:
         arr = np.pad(arr, (0, mb - len(arr)))
     dm = None
@@ -350,6 +353,9 @@ class IngestPrepCtx:
         # (key_name|None, micro_batch) -> set of kernel column names;
         # key_name None = columns-only spec (multi-dim consumers)
         self._specs: Dict[Tuple[Optional[str], int], set] = {}
+        # (expr_tag, micro_batch) -> DerivedCol tuple (expression-IR
+        # prep columns pre-encoded + pre-uploaded by the pool)
+        self._derived: Dict[Tuple[str, int], tuple] = {}
         # telemetry: batches/columns pre-uploaded by the pool (bench + tests)
         self.n_precomputed = 0
         self.n_precomputed_cols = 0
@@ -377,14 +383,21 @@ class IngestPrepCtx:
 
     # ------------------------------------------------------- upload stage
     def register_upload(self, key_name: Optional[str], columns,
-                        micro_batch: int) -> None:
+                        micro_batch: int, derived=None) -> None:
         """A fused consumer declares what precompute() should build. Merged
         by (key_name, micro_batch): heterogeneous consumers of one stream
-        union their column needs — one upload serves all of them."""
+        union their column needs — one upload serves all of them.
+        `derived` is an optional (expr_tag, DerivedCol tuple): the
+        consumer's expression-IR prep columns (sql/expr_ir.py), encoded
+        + pre-uploaded under share keys that include the IR hash so two
+        plans with different expressions can never alias an upload."""
         with self.lock:
             spec = self._specs.setdefault(
                 (key_name, int(micro_batch)), set())
             spec.update(columns)
+            if derived:
+                tag, dcols = derived
+                self._derived[(tag, int(micro_batch))] = tuple(dcols)
 
     def precompute(self, batch) -> int:
         """Build padded device inputs for `batch` under the fused node's
@@ -394,7 +407,8 @@ class IngestPrepCtx:
 
         with self.lock:
             specs = [(k, set(v)) for k, v in self._specs.items()]
-        if not specs or getattr(batch, "n", 0) == 0:
+            derived = list(self._derived.items())
+        if (not specs and not derived) or getattr(batch, "n", 0) == 0:
             return 0
         try:
             import jax.numpy as jnp  # noqa: F401 — availability probe
@@ -425,6 +439,21 @@ class IngestPrepCtx:
                 batch.share(("dcol", name, mb),
                             lambda h=col, v=vm, m=mb:
                             pad_col_for_device(h, v, m))
+                n_up += 1
+        for (tag, mb), dcols in derived:
+            if batch.n > mb:
+                continue
+            for d in dcols:
+                # encode once per batch (shared across consumers with the
+                # same IR), then pad+upload under the tagged share key —
+                # the fused node's inline twin uses the SAME builders
+                host = batch.share(
+                    ("dexpr_host", tag, d.name),
+                    lambda _d=d, _b=batch: _d.encode(
+                        _b.columns.get(_d.raw), _b.n))
+                batch.share(("dexpr", tag, d.name, mb),
+                            lambda h=host, m=mb, _dt=d.dtype:
+                            pad_col_for_device(h, None, m, dtype=_dt))
                 n_up += 1
         if n_up:
             with self.lock:

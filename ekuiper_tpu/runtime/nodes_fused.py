@@ -166,7 +166,9 @@ class FusedWindowAggNode(Node):
             from ..ops.slidingring import ring_layout_for
 
             if ring_layout is None:
-                ring_layout = ring_layout_for(window, plan)
+                ring_layout = ring_layout_for(
+                    window, plan, capacity=capacity,
+                    budget_mb=dev_ring_budget_mb)
             self._ring_layout = ring_layout
             self.bucket_ms = ring_layout.bucket_ms
             self.n_ring_panes = ring_layout.n_ring_panes
@@ -462,9 +464,11 @@ class FusedWindowAggNode(Node):
         try:
             # no valid masks: matches the common typed-schema batch pytree so
             # the compiled executable is the one real folds will hit
-            cols = {
-                name: np.zeros(1, dtype=np.float32) for name in self.plan.columns
-            }
+            # (dtype-correct per column — expression-IR derived columns
+            # are int32, ops/groupby.py col_np_dtype)
+            from ..ops.groupby import warmup_cols
+
+            cols = warmup_cols(self.plan)
             slots = np.zeros(1, dtype=np.int32)
             dummy = self.gb.init_state()
             if self.is_event_time or self.wt == ast.WindowType.SLIDING_WINDOW:
@@ -491,8 +495,7 @@ class FusedWindowAggNode(Node):
                         # which would silently reject this 1-row batch and
                         # skip the compile
                         dev = self._upload_sliding_inputs(
-                            {n: np.zeros(1, dtype=np.float32)
-                             for n in self.plan.columns},
+                            warmup_cols(self.plan),
                             {}, np.zeros(1, dtype=np.int32), force=True)
                         if dev is not None:
                             mask = np.zeros(self.gb.micro_batch,
@@ -689,18 +692,27 @@ class FusedWindowAggNode(Node):
         return len(dirty) / max(self.n_panes, 1)
 
     def prep_spec(self):
-        """(key_name, kernel columns, micro_batch) for the ingest prep's
-        upload stage — the ONE definition of what precompute() should
-        build for this node (the planner registers it at plan time, the
-        first _shared_device_inputs call covers un-plumbed paths)."""
+        """(key_name, kernel columns, micro_batch, derived) for the
+        ingest prep's upload stage — the ONE definition of what
+        precompute() should build for this node (the planner registers
+        it at plan time, the first _shared_device_inputs call covers
+        un-plumbed paths). `derived` is (expr_tag, DerivedCol tuple):
+        the expression IR's host-derived columns, pre-encoded and
+        pre-uploaded by the pool under share keys that include the IR
+        hash — plans whose expressions differ can never alias."""
+        from ..sql.expr_ir import is_derived_expr_col
+
         key_name = (self.dims[0].name
                     if len(self.dims) == 1
                     and getattr(self.dims[0], "name", None) else None)
         return (key_name,
                 [n for n in self.plan.columns
                  if not n.startswith(HLL_COL_PREFIX)
-                 and not n.startswith(HH_COL_PREFIX)],
-                self.gb.micro_batch)
+                 and not n.startswith(HH_COL_PREFIX)
+                 and not is_derived_expr_col(n)],
+                self.gb.micro_batch,
+                ((self.plan.expr_tag, self.plan.derived)
+                 if self.plan.derived else None))
 
     def _shared_device_inputs(self, sub: ColumnBatch, cols, valid, slots):
         """One device upload per column/slot vector for ALL fan-out
@@ -725,13 +737,29 @@ class FusedWindowAggNode(Node):
                 reg(*self.prep_spec())
         # canonical builders shared with the prep ctx's pool-side
         # pre-upload (runtime/ingest.py): same keys, same bytes
+        from ..sql.expr_ir import is_derived_expr_col
         from .ingest import pad_col_for_device, pad_slots_for_device
 
         dcols: Dict[str, Any] = {}
         dvalid: Dict[str, Any] = {}
+        expr_tag = getattr(self.plan, "expr_tag", "")
         for name in self.plan.columns:
             if name.startswith(HLL_COL_PREFIX) or \
                     name.startswith(HH_COL_PREFIX):
+                continue
+            if is_derived_expr_col(name):
+                # expression-IR derived column (already materialized in
+                # `cols` by _build_kernel_inputs): share key carries the
+                # plan's IR hash — a peer plan with different
+                # expressions derives different bytes under a different
+                # key, never a false cache hit
+                host = cols[name]
+                dt = str(host.dtype)
+                dv, _ = sub.share(("dexpr", expr_tag, name, mb),
+                                  lambda h=host, d=dt:
+                                  pad_col_for_device(h, None, mb,
+                                                     dtype=d))
+                dcols[name] = dv
                 continue
             src_col = sub.columns.get(name)
             if src_col is None or src_col.dtype == np.object_:
@@ -784,7 +812,18 @@ class FusedWindowAggNode(Node):
                 self.kt.encode_column(np.array(["__all__"], dtype=np.object_))
         cols: Dict[str, np.ndarray] = {}
         valid: Dict[str, np.ndarray] = {}
+        # expression-IR derived columns (__sd_*/__ts32_*): dictionary
+        # codes + rebased event time, host prep with self-describing
+        # null sentinels (sql/expr_ir.py) — built once per batch here,
+        # shared by the device upload AND the host shadows
+        if self.plan.derived:
+            from ..sql.expr_ir import materialize_derived
+
+            materialize_derived(self.plan.derived, cols, sub,
+                                expr_tag=self.plan.expr_tag)
         for name in self.plan.columns:
+            if name in cols:
+                continue  # derived expr column, just materialized
             if name.startswith(HLL_COL_PREFIX):
                 # derived hashed copy for hll; raw column stays numeric for
                 # any other spec / WHERE / FILTER that shares it
@@ -1645,11 +1684,13 @@ class FusedWindowAggNode(Node):
 
         from ..ops.aggspec import materialize_hll_columns
 
+        from ..ops.groupby import col_np_dtype
+
         cols = materialize_hll_columns(self.plan.columns, cols, n)
         pad = mb - n
         dev_cols, dev_valid, dev_all = {}, {}, {}
         for name in self.plan.columns:
-            arr = np.asarray(cols[name], dtype=np.float32)
+            arr = np.asarray(cols[name], dtype=col_np_dtype(self.plan, name))
             if pad:
                 arr = np.pad(arr, (0, pad))
             d = jnp.asarray(arr)

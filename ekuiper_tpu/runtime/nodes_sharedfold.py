@@ -56,6 +56,11 @@ class MemberSpec:
     direct_emit: Any  # ops/emit.py DirectEmitPlan
     dims: List[str] = field(default_factory=list)
     emit_columnar: bool = True
+    #: predicate lifting (ops/aggspec.py lift_predicate): index (into
+    #: `plan.specs`) of the synthetic `count(*) FILTER(WHERE <pred>)`
+    #: activity spec this member's group existence reads from; None =
+    #: the store's global `act` (member folds every row)
+    act_idx: Any = None
 
 
 class _Member:
@@ -216,15 +221,22 @@ class SharedFoldNode(Node):
         return m.last_end_ms if m is not None else None
 
     def _prep_spec(self):
-        """(key_name, kernel columns, micro_batch) for the shared ingest
-        prep's upload stage — the union plan's one declaration of what
-        precompute() should pre-upload for this store."""
+        """(key_name, kernel columns, micro_batch, derived) for the
+        shared ingest prep's upload stage — the union plan's one
+        declaration of what precompute() should pre-upload for this
+        store (incl. the members' predicate-lift derived columns, keyed
+        by the union's expression-IR hash)."""
+        from ..sql.expr_ir import is_derived_expr_col
+
         key_name = self.dims[0] if len(self.dims) == 1 else None
         return (key_name,
                 [n for n in self.plan.columns
                  if not n.startswith(HLL_COL_PREFIX)
-                 and not n.startswith(HH_COL_PREFIX)],
-                self.store.gb.micro_batch)
+                 and not n.startswith(HH_COL_PREFIX)
+                 and not is_derived_expr_col(n)],
+                self.store.gb.micro_batch,
+                ((self.plan.expr_tag, self.plan.derived)
+                 if getattr(self.plan, "derived", ()) else None))
 
     # --------------------------------------------------------- attach/detach
     def attach_rule(self, spec: MemberSpec, entry: Node, topo: Any) -> bool:
@@ -544,13 +556,24 @@ class SharedFoldNode(Node):
         if ctx is None or sub.n > mb or \
                 not getattr(self.store.gb, "accepts_device_inputs", False):
             return None
+        from ..sql.expr_ir import is_derived_expr_col
         from .ingest import pad_col_for_device, pad_slots_for_device
 
         dcols: Dict[str, Any] = {}
         dvalid: Dict[str, Any] = {}
+        expr_tag = getattr(self.plan, "expr_tag", "")
         for name in self.plan.columns:
             if name.startswith(HLL_COL_PREFIX) or \
                     name.startswith(HH_COL_PREFIX):
+                continue
+            if is_derived_expr_col(name):
+                host = cols[name]
+                dt = str(host.dtype)
+                dv, _ = sub.share(("dexpr", expr_tag, name, mb),
+                                  lambda h=host, d=dt:
+                                  pad_col_for_device(h, None, mb,
+                                                     dtype=d))
+                dcols[name] = dv
                 continue
             src_col = sub.columns.get(name)
             if src_col is None or src_col.dtype == np.object_:
@@ -698,6 +721,13 @@ class SharedFoldNode(Node):
             outs, act = self.store.combine(panes, n_keys)
             if cache is not None:
                 cache[ckey] = (outs, act)
+        if m.spec.act_idx is not None:
+            # predicate-lifted member: group existence is this member's
+            # own `count(*) FILTER(WHERE <pred>)` column — a key whose
+            # rows all failed the member's predicate must not emit a
+            # group (byte parity with the private plan's post-WHERE act)
+            # kuiperlint: ignore[host-sync]: `outs` are HOST numpy arrays (store.combine already fetched+sliced them) — no device value in reach
+            act = np.asarray(outs[m.spec_map[int(m.spec.act_idx)]])
         active = np.nonzero(act > 0)[0]
         n_groups = len(active)
         if n_groups:

@@ -17,19 +17,42 @@ build plan calls for (SURVEY §7 hard part e).
 """
 from __future__ import annotations
 
+import threading
+from collections import Counter
 from typing import Any, Callable, Dict, List, Optional, Set
 
 import numpy as np
 
 from ..functions import registry
 from . import ast
-
-
-class NotVectorizable(Exception):
-    pass
-
+from .expr_ir import NotVectorizable  # shared exception (structured reason)
 
 Cols = Dict[str, Any]
+
+# ---------------------------------------------------------- host fallbacks
+#: plan-time count of expressions that could not device-compile, by
+#: structured NotVectorizable reason — rendered as
+#: `kuiper_expr_host_fallback_total{reason}` (docs/OBSERVABILITY.md) so
+#: the health plane can name host expression eval instead of binning the
+#: cost as "other"
+_fallback_lock = threading.Lock()
+_host_fallbacks: Counter = Counter()
+
+
+def record_host_fallback(reason: str) -> None:
+    with _fallback_lock:
+        _host_fallbacks[reason or "other"] += 1
+
+
+def host_fallback_counts() -> Dict[str, int]:
+    with _fallback_lock:
+        return dict(_host_fallbacks)
+
+
+def reset_host_fallbacks() -> None:
+    """Test hook."""
+    with _fallback_lock:
+        _host_fallbacks.clear()
 
 
 # device-safe function table: name -> builder(xp, *arg_closures) -> closure
@@ -308,6 +331,14 @@ class CompiledExpr:
 
 
 def compile_expr(expr: ast.Expr, mode: str = "host", xp=None) -> CompiledExpr:
+    if mode == "device" and xp is None:
+        # device compilation routes through the typed expression IR
+        # (sql/expr_ir.py): null-aware closures, CASE/IN/temporal/string
+        # operator classes, bounded signature families. The returned
+        # CompiledIR is call-compatible with CompiledExpr.
+        from .expr_ir import compile_expr_ir
+
+        return compile_expr_ir(expr, mode="device", want="auto")
     c = Compiler(mode=mode, xp=xp)
     fn = c.compile(expr)
     return CompiledExpr(fn, c.referenced, mode)

@@ -1,0 +1,1307 @@
+"""Columnar expression IR — device-compiled WHERE / projection / scalar
+expressions for the fused filter→project→aggregate kernel (ROADMAP item 4,
+in the spirit of TiLT's compiled time-centric query IR, arxiv 2301.12030).
+
+`sql/compiler.py` mode="device" historically rejected every operator class
+that was not plain float arithmetic (CASE over strings, temporal
+functions, IN, string equality), so whole rules fell back to the host row
+interpreter (`sql/eval.py`) — the per-row `NotVectorizable` tax the bench
+attributes as host expression eval. This module closes that gap with a
+small typed IR:
+
+- **Lowering** (`Lowerer`): ast.Expr → typed IR with a column-type
+  inference pass (NUM / STR / TS / BOOL). Types are inferred from usage:
+  a column compared against a string literal is a string column; a
+  column fed to `hour()`/`year()` (or compared against an epoch-ms-sized
+  integer literal) is an int64 event-time column; everything else is
+  float32 numeric. Conflicting usage is NotVectorizable, never a guess.
+- **Null discipline**: every IR node evaluates to `(value, null_mask)`
+  and boolean logic follows the row interpreter's exact semantics
+  (`sql/eval.py`): Kleene AND/OR/NOT, `NULL = NULL` true / `NULL = x`
+  false, ordered comparisons with NULL are false, arithmetic/BETWEEN/IN
+  propagate NULL, and a WHERE that evaluates to NULL drops the row. The
+  expression-parity suite (tests/test_expr_ir.py) pins device == host
+  twin == row interpreter across these classes.
+- **Padding discipline** (jitcert): expressions compile to *bounded*
+  signature families. Operand columns keep the kernel's micro-batch
+  pad; IN constant vectors pad to a pow-2 ladder (`IN_PAD_LADDER`) with
+  a never-matching sentinel; string predicates ride dictionary-encoded
+  int32 code columns (`__sd_*`); temporal expressions ride a rebased
+  int32 column (`__ts32_*`). The per-column dtype map travels on the
+  kernel plan (`KernelPlan.col_dtypes`) into the jitcert fold
+  derivations — signature families stay closed.
+- **Host prep seam**: string and temporal columns derive on the host
+  (vectorized numpy, the same discipline as the `__hll__`/`__hhc__`
+  derived columns) via `DerivedCol.encode`; the device kernel only ever
+  sees fixed-dtype numeric arrays. Derived columns carry
+  self-describing null sentinels (`-1` string code, INT32_MIN ts32) so
+  the device closure, the host twin, and the prefinalize host shadow
+  agree without extra mask plumbing.
+
+Two symmetric backends come from ONE lowering: `mode="device"` binds the
+closures to jax.numpy (pure and jit-safe, composed into
+`ops/groupby.py`'s fused fold), `mode="host"` to numpy (the twins the
+latency-hiding emit shadows fold with). docs/EXPRESSIONS.md documents
+the node set, the padding/bucketing discipline, and the fallback seam.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from . import ast
+
+# ------------------------------------------------------------------ errors
+
+
+class NotVectorizable(Exception):
+    """Expression (or sub-expression) has no vectorized compilation.
+
+    `reason` is a stable slug (the label of
+    `kuiper_expr_host_fallback_total` and the `/rules/{id}/explain`
+    "expressions" section); the message stays human-oriented.
+    """
+
+    def __init__(self, msg: str, reason: str = "other") -> None:
+        super().__init__(msg)
+        self.reason = reason
+
+
+# ------------------------------------------------------------- type lattice
+NUM = "num"      # float32 device column / python number
+STR = "str"      # dictionary-encoded int32 code column
+TS = "ts"        # rebased int32 event-time column (epoch ms - anchor)
+BOOL = "bool"
+
+#: integer literals at/above this magnitude cannot survive the float32
+#: upload (24-bit mantissa) — a bare column compared against one is
+#: typed as an int64 event-time column and rides the rebased ts32 path
+TS_LITERAL_MIN = 2 ** 31
+
+#: rebased ts32 usable range; values outside become the null sentinel
+#: (the device temporal domain is ~±24 days around the plan anchor —
+#: docs/EXPRESSIONS.md "temporal domain")
+_TS_MAX = 2 ** 31 - 8
+TS_NULL = -(2 ** 31)  # int32 min: the ts32 null sentinel
+
+#: string-dict code sentinels: -1 = NULL, -2 = a real value that matches
+#: no constant of the dict (never equal to any code >= 0)
+SD_NULL = -1
+SD_OTHER = -2
+
+#: IN constant vectors pad to the smallest fitting rung of this pow-2
+#: ladder — the "bucketed operand shapes" discipline behind jitcert's
+#: bounded-signature claim; wider lists fall back to the host row path
+IN_PAD_LADDER = (4, 8, 16, 32, 64, 128, 256)
+
+_MS_DAY = 86_400_000
+
+# device-safe elementwise function tables
+_MATH_UNARY = {
+    "abs": "abs",
+    "acos": "arccos", "asin": "arcsin", "atan": "arctan",
+    "cos": "cos", "cosh": "cosh", "sin": "sin", "sinh": "sinh",
+    "tan": "tan", "tanh": "tanh", "exp": "exp", "ln": "log",
+    "sqrt": "sqrt", "ceil": "ceil", "ceiling": "ceil",
+    "floor": "floor", "round": "round", "sign": "sign",
+    "radians": "radians", "degrees": "degrees",
+}
+_MATH_BINARY = {
+    "atan2": "arctan2", "power": "power", "pow": "power", "mod": "mod",
+}
+
+#: temporal extraction functions compiled onto the rebased ts32 column;
+#: all exact integer arithmetic (UTC, matching funcs_datetime.py)
+TEMPORAL_FUNCS = ("hour", "minute", "second", "day", "day_of_month",
+                  "day_of_week", "month", "year")
+
+
+def plan_anchor_ms() -> int:
+    """The plan-time temporal anchor: the engine clock's current UTC
+    midnight. All ts32 derivations and rebased literals of one compiled
+    expression share it (it is part of the IR key, so prep share keys
+    can never mix two anchors)."""
+    from ..utils import timex
+
+    return (timex.now_ms() // _MS_DAY) * _MS_DAY
+
+
+# ------------------------------------------------------------ derived cols
+@dataclass(frozen=True)
+class DerivedCol:
+    """A host-derived device column (the expression-prep seam).
+
+    kind="strdict": `raw` dictionary-encodes against `values` (the
+    sorted constants the expression compares it with) into int32 codes:
+    index for a match, -2 for any other real value, -1 for NULL.
+    kind="ts32":    `raw` (epoch ms, any numeric/object dtype) rebases
+    to int32 `raw - anchor`, INT32_MIN for NULL/out-of-range.
+    """
+
+    name: str
+    raw: str
+    kind: str
+    values: Tuple[str, ...] = ()
+    anchor: int = 0
+
+    @property
+    def dtype(self) -> str:
+        return "int32"
+
+    def encode(self, col: Optional[np.ndarray], n: int) -> np.ndarray:
+        if self.kind == "strdict":
+            return self._encode_strdict(col, n)
+        return self._encode_ts32(col, n)
+
+    def _encode_strdict(self, col, n: int) -> np.ndarray:
+        out = np.full(n, SD_OTHER, dtype=np.int32)
+        if col is None:
+            out[:] = SD_NULL
+            return out
+        if col.dtype == np.object_:
+            # vectorized: one C-level object-equality sweep per dict
+            # constant (dicts are small — the plan's literal set), plus
+            # one None sweep. A per-row python loop here was the
+            # filter_heavy host-prep bottleneck.
+            out[np.equal(col, None)] = SD_NULL
+            for i, v in enumerate(self.values):
+                out[col == v] = i
+            return out
+        if np.issubdtype(col.dtype, np.floating):
+            out[np.isnan(col)] = SD_NULL
+        return out  # numeric column vs string dict: no value ever matches
+
+    def _encode_ts32(self, col, n: int) -> np.ndarray:
+        if col is None:
+            return np.full(n, TS_NULL, dtype=np.int32)
+        if col.dtype == np.object_:
+            vals = np.full(n, np.nan, dtype=np.float64)
+            # bulk path first: numeric-only object columns convert in C
+            try:
+                vals = np.asarray(col, dtype=np.float64)
+            except (TypeError, ValueError):
+                for i, v in enumerate(col.tolist()):
+                    if isinstance(v, (int, float)) and \
+                            not isinstance(v, bool):
+                        vals[i] = float(v)
+        else:
+            vals = np.asarray(col, dtype=np.float64)
+        with np.errstate(invalid="ignore"):
+            rel = vals - float(self.anchor)
+            bad = ~np.isfinite(rel) | (np.abs(rel) > _TS_MAX)
+        rel = np.where(bad, 0.0, rel)
+        out = rel.astype(np.int64).astype(np.int32)
+        out[bad] = TS_NULL
+        return out
+
+    def ir_key(self) -> str:
+        if self.kind == "strdict":
+            return f"sd({self.raw};{','.join(self.values)})"
+        return f"ts32({self.raw};{self.anchor})"
+
+
+def derived_name(spec_kind: str, raw: str, tag: str) -> str:
+    return f"__{spec_kind}_{tag}__{raw}"
+
+
+def is_derived_expr_col(name: str) -> bool:
+    return name.startswith("__sd_") or name.startswith("__ts32_")
+
+
+def materialize_derived(derived, cols: Dict[str, np.ndarray], sub,
+                        expr_tag: str = "") -> None:
+    """Fill `cols` with every DerivedCol of `derived` not already built
+    (host prep; runs in the fused node's kernel-input build and in the
+    shared fold's value-column build). With `expr_tag` the encode rides
+    the batch's ("dexpr_host", tag, name) share slot — the SAME key the
+    decode pool's pre-upload stage populates (runtime/ingest.py), so a
+    prep-enabled pipeline encodes each derived column once per batch,
+    not once per consumer."""
+    for d in derived:
+        if d.name in cols:
+            continue
+        share = getattr(sub, "share", None) if expr_tag else None
+        if share is not None:
+            try:
+                cols[d.name] = share(
+                    ("dexpr_host", expr_tag, d.name),
+                    lambda _d=d, _b=sub: _d.encode(
+                        _b.columns.get(_d.raw), _b.n))
+                continue
+            except Exception:
+                pass  # share state unavailable: encode directly
+        cols[d.name] = d.encode(sub.columns.get(d.raw), sub.n)
+
+
+# ------------------------------------------------------------- typed value
+class _V:
+    """A lowered (typed) IR node: canonical key + per-backend builder.
+
+    `build(xp)` returns `fn(cols) -> (value, null)` where `null` is
+    None (never null), a bool array, or a python bool scalar; `lit`
+    holds the python value for literal nodes (temporal rebasing needs
+    to distinguish literals from columns).
+    """
+
+    __slots__ = ("ty", "key", "build", "lit")
+
+    def __init__(self, ty: str, key: str,
+                 build: Callable[[Any], Callable], lit=None) -> None:
+        self.ty = ty
+        self.key = key
+        self.build = build
+        self.lit = lit
+
+
+def _const(ty: str, key: str, value, lit=None) -> _V:
+    return _V(ty, key, lambda xp: lambda cols: (value, None), lit=lit)
+
+
+def _or_null(xp, a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return xp.logical_or(a, b)
+
+
+def _drop_null(xp, val, n):
+    """val AND NOT null — the 'NULL compares false' rule."""
+    if n is None:
+        return val
+    return xp.logical_and(val, xp.logical_not(n))
+
+
+def _is_floating(v) -> bool:
+    dt = getattr(v, "dtype", None)
+    if dt is None:
+        return isinstance(v, float)
+    try:
+        return np.issubdtype(np.dtype(str(dt)), np.floating)
+    except TypeError:
+        return False
+
+
+def _is_int_like(x) -> bool:
+    dt = getattr(x, "dtype", None)
+    if dt is not None:
+        try:
+            return np.issubdtype(np.dtype(str(dt)), np.integer)
+        except TypeError:
+            return False
+    return isinstance(x, int) and not isinstance(x, bool)
+
+
+# ------------------------------------------------------------ type inference
+def _is_ts_literal(e: ast.Expr) -> bool:
+    if isinstance(e, ast.IntegerLiteral):
+        return abs(e.val) >= TS_LITERAL_MIN
+    if isinstance(e, ast.NumberLiteral):
+        return abs(e.val) >= TS_LITERAL_MIN and float(e.val).is_integer()
+    return False
+
+
+def _literal_ty(e: ast.Expr) -> Optional[str]:
+    if isinstance(e, ast.StringLiteral):
+        return STR
+    if _is_ts_literal(e):
+        return TS
+    if isinstance(e, (ast.IntegerLiteral, ast.NumberLiteral)):
+        return NUM
+    if isinstance(e, ast.BooleanLiteral):
+        return BOOL
+    return None
+
+
+def infer_column_types(expr: ast.Expr) -> Dict[str, str]:
+    """Usage-driven column typing, iterated to fixpoint. Unification
+    groups are comparison/IN/BETWEEN/CASE-match operand sets (a STR or
+    TS member types every bare column in the group); temporal function
+    arguments force TS; math-function arguments force NUM. Conflicting
+    facts raise NotVectorizable("mixed-type-column") — never a guess."""
+    types: Dict[str, str] = {}
+
+    def assign(name: str, ty: str) -> bool:
+        cur = types.get(name)
+        if cur is None:
+            types[name] = ty
+            return True
+        if cur != ty:
+            raise NotVectorizable(
+                f"column {name} used as both {cur} and {ty}",
+                reason="mixed-type-column")
+        return False
+
+    def group_ty(exprs: List[ast.Expr]) -> Optional[str]:
+        tys = set()
+        for e in exprs:
+            t = _literal_ty(e)
+            if t is None and isinstance(e, ast.FieldRef):
+                t = types.get(e.name)
+            if t is not None:
+                tys.add(t)
+        if STR in tys:
+            # a STR member only types the group when nothing numeric
+            # contradicts it — `a IN (10, 'ok')` must NOT make `a` a
+            # string column (the row interpreter just skips the
+            # type-mismatched item)
+            return STR if not ({NUM, TS} & tys) else None
+        if TS in tys:
+            return TS
+        return None
+
+    def unify(exprs: List[ast.Expr]) -> bool:
+        ty = group_ty(exprs)
+        if ty not in (STR, TS):
+            return False
+        changed = False
+        for e in exprs:
+            if isinstance(e, ast.FieldRef):
+                changed |= assign(e.name, ty)
+        return changed
+
+    def visit(e: ast.Expr) -> bool:
+        changed = False
+        if isinstance(e, ast.BinaryExpr) and e.op in (
+                "=", "!=", "<", "<=", ">", ">="):
+            changed |= unify([e.lhs, e.rhs])
+        elif isinstance(e, ast.BinaryExpr) and e.op in ("+", "-"):
+            # absolute-time arithmetic: `ts - 1700000000000` types the
+            # bare column TS (STR never propagates through arithmetic)
+            if group_ty([e.lhs, e.rhs]) == TS:
+                changed |= unify([e.lhs, e.rhs])
+        elif isinstance(e, ast.InExpr):
+            changed |= unify([e.value] + list(e.values))
+        elif isinstance(e, ast.BetweenExpr):
+            changed |= unify([e.value, e.lo, e.hi])
+        elif isinstance(e, ast.CaseExpr) and e.value is not None:
+            changed |= unify([e.value] + [w.cond for w in e.whens])
+        elif isinstance(e, ast.Call):
+            if e.name in TEMPORAL_FUNCS and e.args and \
+                    isinstance(e.args[0], ast.FieldRef):
+                changed |= assign(e.args[0].name, TS)
+            elif e.name in _MATH_UNARY or e.name in _MATH_BINARY or \
+                    e.name in ("cot", "bitnot", "log", "trunc"):
+                for a in e.args:
+                    if isinstance(a, ast.FieldRef):
+                        # raises mixed-type-column when the column is
+                        # already STR/TS elsewhere — never a guess
+                        changed |= assign(a.name, NUM)
+        for c in e.children():
+            changed |= visit(c)
+        return changed
+
+    for _ in range(8):  # fixpoint: type facts only ever narrow
+        if not visit(expr):
+            break
+    return types
+
+
+# ---------------------------------------------------------------- lowering
+class _LowerCtx:
+    def __init__(self, types: Dict[str, str], anchor_ms: int,
+                 str_seed: Optional[Dict[str, Set[str]]] = None) -> None:
+        self.types = types
+        self.anchor_ms = int(anchor_ms)
+        # raw column -> set of string constants compared with it; the
+        # dictionaries finalize (sorted, coded) in compile_expr_ir.
+        # `str_seed` pre-populates them with the PLAN-level constant
+        # union, so every expression of one plan (WHERE + agg args +
+        # FILTERs) derives ONE dictionary column per raw column instead
+        # of one per expression — one host encode, one upload.
+        self.str_consts: Dict[str, Set[str]] = {
+            k: set(v) for k, v in (str_seed or {}).items()}
+        self.referenced: Set[str] = set()
+        self.sd_names: Dict[str, str] = {}
+        self.sd_codes: Dict[str, Dict[str, Any]] = {}
+        self.ts_names: Dict[str, str] = {}
+
+
+class Lowerer:
+    """ast.Expr → typed IR closures. One instance per compiled
+    expression; the context's string dictionaries and ts32 anchor are
+    finalized by compile_expr_ir after the whole tree lowered."""
+
+    def __init__(self, ctx: _LowerCtx) -> None:
+        self.ctx = ctx
+
+    # -- dispatch ----------------------------------------------------------
+    def lower(self, e: ast.Expr) -> _V:
+        m = getattr(self, "_l_" + type(e).__name__, None)
+        if m is None:
+            raise NotVectorizable(
+                type(e).__name__,
+                reason=_REASON_BY_NODE.get(type(e).__name__, "other"))
+        return m(e)
+
+    # -- literals ----------------------------------------------------------
+    def _l_IntegerLiteral(self, e):
+        if _is_ts_literal(e):
+            rel = self._rebase(e.val)
+            return _const(TS, f"ts:{e.val}", rel, lit=e.val)
+        return _const(NUM, repr(e.val), e.val, lit=e.val)
+
+    def _l_NumberLiteral(self, e):
+        if _is_ts_literal(e):
+            rel = self._rebase(int(e.val))
+            return _const(TS, f"ts:{int(e.val)}", rel, lit=e.val)
+        return _const(NUM, repr(e.val), e.val, lit=e.val)
+
+    def _l_BooleanLiteral(self, e):
+        return _const(BOOL, repr(bool(e.val)), bool(e.val), lit=bool(e.val))
+
+    def _l_StringLiteral(self, e):
+        # string literals are only meaningful against a dict-encoded
+        # column; the enclosing comparison lowers them to codes. A bare
+        # string value (projection result, concat operand) has no
+        # device representation.
+        raise NotVectorizable("bare string value on device",
+                              reason="string-value")
+
+    def _rebase(self, ms: int) -> int:
+        rel = ms - self.ctx.anchor_ms
+        return max(min(rel, _TS_MAX), -_TS_MAX)
+
+    # -- columns -----------------------------------------------------------
+    def _l_FieldRef(self, e):
+        name = e.name
+        self.ctx.referenced.add(name)
+        ty = self.ctx.types.get(name, NUM)
+        ctx = self.ctx
+        if ty == STR:
+            ctx.str_consts.setdefault(name, set())
+
+            def build_s(xp, _n=name, _c=ctx):
+                def f(cols):
+                    v = cols[_c.sd_names[_n]]
+                    return v, v == SD_NULL
+
+                return f
+
+            return _V(STR, f"scol:{name}", build_s)
+        if ty == TS:
+            def build_t(xp, _n=name, _c=ctx):
+                def f(cols):
+                    v = cols[_c.ts_names[_n]]
+                    return v, v == TS_NULL
+
+                return f
+
+            return _V(TS, f"tscol:{name}", build_t)
+
+        def build(xp, _n=name):
+            def f(cols):
+                if _n not in cols:
+                    raise NotVectorizable(f"column {_n} missing",
+                                          reason="missing-column")
+                v = cols[_n]
+                null = xp.isnan(v) if _is_floating(v) else None
+                vm = cols.get("__valid_" + _n)
+                if vm is not None:
+                    null = _or_null(xp, null, xp.logical_not(vm))
+                return v, null
+
+            return f
+
+        return _V(NUM, f"col:{name}", build)
+
+    # -- unary -------------------------------------------------------------
+    def _l_UnaryExpr(self, e):
+        a = self.lower(e.expr)
+        if e.op == "-":
+            if a.ty != NUM:
+                raise NotVectorizable(f"unary - on {a.ty}",
+                                      reason="type-mismatch")
+
+            def build_n(xp, _a=a):
+                fa = _a.build(xp)
+
+                def f(cols):
+                    v, n = fa(cols)
+                    return -v, n
+
+                return f
+
+            return _V(NUM, f"(-{a.key})", build_n)
+        if e.op == "NOT":
+            if a.ty != BOOL:
+                raise NotVectorizable("NOT on non-boolean",
+                                      reason="type-mismatch")
+
+            def build(xp, _a=a):
+                fa = _a.build(xp)
+
+                def f(cols):
+                    v, n = fa(cols)
+                    return xp.logical_not(v), n  # Kleene: NOT NULL = NULL
+
+                return f
+
+            return _V(BOOL, f"(NOT {a.key})", build)
+        raise NotVectorizable(f"unary {e.op}", reason="operator")
+
+    # -- AND / OR ----------------------------------------------------------
+    def _logic(self, e):
+        a, b = self.lower(e.lhs), self.lower(e.rhs)
+        for s in (a, b):
+            if s.ty != BOOL:
+                raise NotVectorizable(f"{e.op} on non-boolean {s.ty}",
+                                      reason="type-mismatch")
+        is_and = e.op == "AND"
+
+        def build(xp, _a=a, _b=b, _and=is_and):
+            fa, fb = _a.build(xp), _b.build(xp)
+
+            def f(cols):
+                av, an = fa(cols)
+                bv, bn = fb(cols)
+                at = _drop_null(xp, av, an)       # definitely true
+                bt = _drop_null(xp, bv, bn)
+                either = _or_null(xp, an, bn)
+                if _and:
+                    val = xp.logical_and(at, bt)
+                    if either is None:
+                        return val, None
+                    # false wins over null: null only where neither side
+                    # is definitely false
+                    af = _drop_null(xp, xp.logical_not(av), an)
+                    bf = _drop_null(xp, xp.logical_not(bv), bn)
+                    null = xp.logical_and(
+                        either,
+                        xp.logical_not(xp.logical_or(af, bf)))
+                    return val, null
+                val = xp.logical_or(at, bt)
+                if either is None:
+                    return val, None
+                # true wins over null
+                null = xp.logical_and(either, xp.logical_not(val))
+                return val, null
+
+            return f
+
+        return _V(BOOL, f"({a.key} {e.op} {b.key})", build)
+
+    # -- comparisons -------------------------------------------------------
+    _CMP = {"=": "equal", "!=": "not_equal", "<": "less",
+            "<=": "less_equal", ">": "greater", ">=": "greater_equal"}
+
+    def _l_BinaryExpr(self, e):
+        if e.op in ("AND", "OR"):
+            return self._logic(e)
+        if e.op in self._CMP:
+            return self._cmp(e.op, e.lhs, e.rhs)
+        return self._arith(e)
+
+    def _str_code(self, raw: str, value: str) -> _V:
+        """A string literal resolved against `raw`'s dictionary (codes
+        finalize after lowering; the closure reads them at call time)."""
+        self.ctx.str_consts.setdefault(raw, set()).add(value)
+
+        def build(xp, _raw=raw, _v=value, _c=self.ctx):
+            def f(cols):
+                return _c.sd_codes[_raw][_v], None
+
+            return f
+
+        return _V(STR, f"str:{value!r}", build, lit=value)
+
+    def _ts_coerced(self, v: _V) -> _V:
+        """A NUM literal used where the other side is temporal: the
+        literal is an ABSOLUTE epoch-ms time — rebase it (durations
+        appear under arithmetic, which does not coerce)."""
+        rel = self._rebase(int(v.lit))
+        return _const(TS, f"ts:{int(v.lit)}", rel, lit=v.lit)
+
+    def _cmp(self, op: str, lhs_e: ast.Expr, rhs_e: ast.Expr) -> _V:
+        l_str = isinstance(lhs_e, ast.StringLiteral)
+        r_str = isinstance(rhs_e, ast.StringLiteral)
+        if l_str and r_str:
+            if op in ("=", "!="):
+                eq = (lhs_e.val == rhs_e.val) == (op == "=")
+                return _const(BOOL, f"{lhs_e.val!r}{op}{rhs_e.val!r}", eq)
+            raise NotVectorizable("ordered comparison of string literals",
+                                  reason="string-order-compare")
+        if l_str or r_str:
+            lit = lhs_e if l_str else rhs_e
+            other = self.lower(rhs_e if l_str else lhs_e)
+            if other.ty != STR:
+                return self._cmp_mismatch(op, other, None)
+            if op not in ("=", "!="):
+                raise NotVectorizable(
+                    "ordered comparison on dictionary-encoded strings",
+                    reason="string-order-compare")
+            raw = other.key.split(":", 1)[1]
+            code = self._str_code(raw, lit.val)
+            a, b = (code, other) if l_str else (other, code)
+            return self._cmp_plain(op, a, b)
+        a, b = self.lower(lhs_e), self.lower(rhs_e)
+        # temporal coercion: a NUM literal against a TS side is an
+        # absolute time
+        if a.ty == TS and b.ty == NUM and b.lit is not None:
+            b = self._ts_coerced(b)
+        elif b.ty == TS and a.ty == NUM and a.lit is not None:
+            a = self._ts_coerced(a)
+        if a.ty == STR and b.ty == STR:
+            raise NotVectorizable(
+                "string column vs string column comparison",
+                reason="string-col-compare")
+        if {a.ty, b.ty} in ({NUM, STR}, {TS, STR}, {NUM, TS}):
+            return self._cmp_mismatch(op, a, b)
+        if BOOL in (a.ty, b.ty) and a.ty != b.ty:
+            return self._cmp_mismatch(op, a, b)
+        if a.ty == STR and op not in ("=", "!="):
+            raise NotVectorizable(
+                "ordered comparison on dictionary-encoded strings",
+                reason="string-order-compare")
+        return self._cmp_plain(op, a, b)
+
+    def _cmp_plain(self, op: str, a: _V, b: _V) -> _V:
+        fn_name = self._CMP[op]
+
+        def build(xp, _a=a, _b=b, _op=op, _fn=fn_name):
+            fa, fb = _a.build(xp), _b.build(xp)
+            cmp_fn = getattr(xp, _fn)
+
+            def f(cols):
+                av, an = fa(cols)
+                bv, bn = fb(cols)
+                either = _or_null(xp, an, bn)
+                raw = cmp_fn(av, bv)
+                if _op not in ("=", "!="):
+                    # NULL orders false (sql/eval.py cast.compare)
+                    return _drop_null(xp, raw, either), None
+                if either is None:
+                    return raw, None
+                both = (xp.logical_and(an, bn)
+                        if an is not None and bn is not None else False)
+                eq = _drop_null(xp, raw, either)
+                if both is not False:
+                    eq = xp.logical_or(eq, both)      # NULL = NULL is true
+                if _op == "=":
+                    return eq, None
+                one = (xp.logical_and(either, xp.logical_not(both))
+                       if both is not False else either)
+                neq = _drop_null(xp, raw, either)
+                return xp.logical_or(neq, one), None  # NULL != x is true
+
+            return f
+
+        return _V(BOOL, f"({a.key}{op}{b.key})", build)
+
+    def _cmp_mismatch(self, op: str, a: _V, b: Optional[_V]) -> _V:
+        """Type-mismatched comparison, reference semantics: '=' is true
+        only when BOTH sides are NULL, '!=' is its negation, ordered
+        comparisons are false (sql/eval.py: cast.compare -> None)."""
+        if op not in ("=", "!="):
+            key = f"(mismatch {op} {a.key})"
+            return _const(BOOL, key, False)
+        sides = [s for s in (a, b) if s is not None]
+
+        def build(xp, _sides=tuple(sides), _op=op):
+            fns = [s.build(xp) for s in _sides]
+            n_sides = len(_sides)
+
+            def f(cols):
+                nulls = [fn(cols)[1] for fn in fns]
+                if n_sides < 2 or any(n is None for n in nulls):
+                    both = False  # a literal side is never null
+                else:
+                    both = xp.logical_and(nulls[0], nulls[1])
+                if _op == "=":
+                    return both, None
+                return (xp.logical_not(both)
+                        if both is not False else True), None
+
+            return f
+
+        keys = "/".join(s.key for s in sides)
+        return _V(BOOL, f"(mismatch {op} {keys})", build)
+
+    # -- arithmetic --------------------------------------------------------
+    def _arith(self, e):
+        a, b = self.lower(e.lhs), self.lower(e.rhs)
+        op = e.op
+        if BOOL in (a.ty, b.ty) or STR in (a.ty, b.ty):
+            raise NotVectorizable(f"arithmetic {op} on {a.ty}/{b.ty}",
+                                  reason="type-mismatch")
+        out_ty = NUM
+        if TS in (a.ty, b.ty):
+            if op not in ("+", "-"):
+                raise NotVectorizable(
+                    f"temporal arithmetic only supports +/- (got {op})",
+                    reason="temporal-arith")
+            if a.ty == TS and b.ty == TS:
+                if op == "+":
+                    raise NotVectorizable("adding two timestamps",
+                                          reason="temporal-arith")
+                out_ty = NUM  # ts - ts = duration ms (int32 exact)
+            else:
+                other = b if a.ty == TS else a
+                if other.lit is None:
+                    # dynamic float deltas would round through float32
+                    raise NotVectorizable(
+                        "temporal ± dynamic operand (literal offsets "
+                        "only)", reason="temporal-arith")
+                out_ty = TS
+
+        def build(xp, _a=a, _b=b, _op=op):
+            fa, fb = _a.build(xp), _b.build(xp)
+
+            def f(cols):
+                av, an = fa(cols)
+                bv, bn = fb(cols)
+                null = _or_null(xp, an, bn)
+                if _op == "+":
+                    v = av + bv
+                elif _op == "-":
+                    v = av - bv
+                elif _op == "*":
+                    v = av * bv
+                elif _op == "/":
+                    if _is_int_like(av) and _is_int_like(bv):
+                        v = av // bv
+                    else:
+                        v = av / bv
+                elif _op == "%":
+                    v = xp.mod(av, bv)
+                else:
+                    fn = {"&": xp.bitwise_and, "|": xp.bitwise_or,
+                          "^": xp.bitwise_xor}[_op]
+                    v = fn(_as_int(xp, av), _as_int(xp, bv))
+                return v, null
+
+            return f
+
+        return _V(out_ty, f"({a.key}{op}{b.key})", build)
+
+    # -- BETWEEN / IN ------------------------------------------------------
+    def _l_BetweenExpr(self, e):
+        v = self.lower(e.value)
+        lo = self.lower(e.lo)
+        hi = self.lower(e.hi)
+        if v.ty == TS:
+            if lo.ty == NUM and lo.lit is not None:
+                lo = self._ts_coerced(lo)
+            if hi.ty == NUM and hi.lit is not None:
+                hi = self._ts_coerced(hi)
+        for s in (v, lo, hi):
+            if s.ty not in (NUM, TS):
+                raise NotVectorizable("BETWEEN on non-numeric",
+                                      reason="type-mismatch")
+        neg = bool(e.negate)
+
+        def build(xp, _v=v, _lo=lo, _hi=hi, _neg=neg):
+            fv, fl, fh = _v.build(xp), _lo.build(xp), _hi.build(xp)
+
+            def f(cols):
+                vv, vn = fv(cols)
+                lv, ln = fl(cols)
+                hv, hn = fh(cols)
+                null = _or_null(xp, _or_null(xp, vn, ln), hn)
+                raw = xp.logical_and(vv >= lv, vv <= hv)
+                if _neg:
+                    raw = xp.logical_not(raw)
+                return _drop_null(xp, raw, null), null
+
+            return f
+
+        tag = "NOT BETWEEN" if neg else "BETWEEN"
+        return _V(BOOL, f"({v.key} {tag} {lo.key},{hi.key})", build)
+
+    def _l_InExpr(self, e):
+        v = self.lower(e.value)
+        all_literal = all(_literal_ty(x) is not None for x in e.values)
+        if not all_literal:
+            return self._in_dynamic(e, v)
+        if len(e.values) > IN_PAD_LADDER[-1]:
+            raise NotVectorizable(
+                f"IN list wider than the {IN_PAD_LADDER[-1]} pad cap",
+                reason="in-too-wide")
+        neg = bool(e.negate)
+        if v.ty == STR:
+            raw = v.key.split(":", 1)[1]
+            values = sorted({x.val for x in e.values
+                             if isinstance(x, ast.StringLiteral)})
+            for s in values:
+                self.ctx.str_consts.setdefault(raw, set()).add(s)
+
+            def build_s(xp, _v=v, _raw=raw, _vals=tuple(values),
+                        _neg=neg, _c=self.ctx):
+                fv = _v.build(xp)
+
+                def f(cols):
+                    codes = [int(_c.sd_codes[_raw][s]) for s in _vals]
+                    consts = _pad_consts(codes, SD_OTHER - 1, np.int32)
+                    vv, vn = fv(cols)
+                    hit = xp.any(
+                        xp.expand_dims(vv, -1) == xp.asarray(consts), -1)
+                    if _neg:
+                        hit = xp.logical_not(hit)
+                    return _drop_null(xp, hit, vn), vn
+
+                return f
+
+            tag = "NOT IN" if neg else "IN"
+            return _V(BOOL, f"({v.key} {tag} s[{','.join(values)}])",
+                      build_s)
+        # numeric / temporal operand: only numeric constants can match
+        # (string items compare None in the row interpreter — skipped)
+        consts: List[float] = [
+            float(x.val) for x in e.values
+            if isinstance(x, (ast.IntegerLiteral, ast.NumberLiteral,
+                              ast.BooleanLiteral))]
+        if v.ty == TS:
+            padded = _pad_consts([self._rebase(int(c)) for c in consts],
+                                 TS_NULL + 1, np.int32)
+        else:
+            padded = _pad_consts(consts, np.nan, np.float32)
+
+        def build(xp, _v=v, _c=padded, _neg=neg):
+            fv = _v.build(xp)
+
+            def f(cols):
+                vv, vn = fv(cols)
+                hit = xp.any(xp.expand_dims(vv, -1) == xp.asarray(_c), -1)
+                if _neg:
+                    hit = xp.logical_not(hit)
+                return _drop_null(xp, hit, vn), vn
+
+            return f
+
+        tag = "NOT IN" if neg else "IN"
+        return _V(BOOL, f"({v.key} {tag} {padded.tolist()})", build)
+
+    def _in_dynamic(self, e, v: _V) -> _V:
+        """IN with non-literal items: OR-chain of equalities, with the
+        IN null rule (a NULL operand is NULL regardless of the items)."""
+        items = [self._cmp("=", e.value, x) for x in e.values]
+        neg = bool(e.negate)
+
+        def build(xp, _v=v, _items=tuple(items), _neg=neg):
+            fv = _v.build(xp)
+            fns = [i.build(xp) for i in _items]
+
+            def f(cols):
+                _, vn = fv(cols)
+                hit = False
+                for fn in fns:
+                    iv, _ = fn(cols)
+                    hit = iv if hit is False else xp.logical_or(hit, iv)
+                if _neg:
+                    hit = xp.logical_not(hit)
+                return _drop_null(xp, hit, vn), vn
+
+            return f
+
+        tag = "NOT IN" if neg else "IN"
+        return _V(BOOL, f"({v.key} {tag} dyn[{len(items)}])", build)
+
+    # -- CASE --------------------------------------------------------------
+    def _l_CaseExpr(self, e):
+        if e.value is not None:
+            whens = [(self._cmp("=", e.value, w.cond),
+                      self.lower(w.result)) for w in e.whens]
+        else:
+            whens = [(self.lower(w.cond), self.lower(w.result))
+                     for w in e.whens]
+        for cond, res in whens:
+            if cond.ty != BOOL:
+                raise NotVectorizable("CASE condition is not boolean",
+                                      reason="type-mismatch")
+            if res.ty != NUM:
+                # TS results are anchor-rebased int32 — letting them out
+                # as a NUM would silently emit epoch-ms-minus-anchor
+                raise NotVectorizable(
+                    f"CASE result of type {res.ty} on device",
+                    reason="string-value" if res.ty == STR
+                    else "temporal-value")
+        els = self.lower(e.else_expr) if e.else_expr is not None else None
+        if els is not None and els.ty != NUM:
+            raise NotVectorizable("CASE else of unsupported type",
+                                  reason="temporal-value"
+                                  if els.ty == TS else "type-mismatch")
+
+        def build(xp, _whens=tuple(whens), _els=els):
+            fws = [(c.build(xp), r.build(xp)) for c, r in _whens]
+            fe = _els.build(xp) if _els is not None else None
+
+            def f(cols):
+                if fe is not None:
+                    val, null = fe(cols)
+                    null = False if null is None else null
+                else:
+                    val, null = np.float32(np.nan), True
+                for fc, fr in reversed(fws):
+                    cv, cn = fc(cols)
+                    take = _drop_null(xp, cv, cn)
+                    rv, rn = fr(cols)
+                    val = xp.where(take, rv, val)
+                    null = xp.where(take, False if rn is None else rn,
+                                    null)
+                if null is False:
+                    null = None
+                return val, null
+
+            return f
+
+        key = "CASE(" + ";".join(f"{c.key}->{r.key}" for c, r in whens) \
+            + (f";else {els.key}" if els is not None else "") + ")"
+        return _V(NUM, key, build)
+
+    # -- calls -------------------------------------------------------------
+    def _l_Call(self, e):
+        if e.filter is not None or e.partition or e.when is not None:
+            raise NotVectorizable("call clauses", reason="call-clause")
+        if e.name in TEMPORAL_FUNCS:
+            return self._temporal_call(e)
+        if e.name == "pi":
+            return _const(NUM, "pi", float(np.pi))
+        args = [self.lower(a) for a in e.args]
+        for a in args:
+            if a.ty != NUM:
+                raise NotVectorizable(f"{e.name} argument of type {a.ty}",
+                                      reason="type-mismatch")
+        builder = self._math_builder(e.name, len(args))
+        if builder is None:
+            from ..functions import registry
+
+            fd = registry.lookup(e.name)
+            if fd is None:
+                raise NotVectorizable(f"unknown function {e.name}",
+                                      reason="unknown-func")
+            reason = ("stateful-func" if getattr(fd, "stateful", False)
+                      or fd.ftype != registry.SCALAR
+                      else "unvectorized-func")
+            raise NotVectorizable(f"no device impl for {e.name}",
+                                  reason=reason)
+
+        def build(xp, _args=tuple(args), _b=builder):
+            fns = [a.build(xp) for a in _args]
+            impl = _b(xp)
+
+            def f(cols):
+                pairs = [fn(cols) for fn in fns]
+                null = None
+                for _, n in pairs:
+                    null = _or_null(xp, null, n)
+                return impl(*[v for v, _ in pairs]), null
+
+            return f
+
+        key = f"{e.name}({','.join(a.key for a in args)})"
+        return _V(NUM, key, build)
+
+    @staticmethod
+    def _math_builder(name: str, arity: int):
+        if name in _MATH_UNARY and arity == 1:
+            fname = _MATH_UNARY[name]
+            return lambda xp: getattr(xp, fname)
+        if name in _MATH_BINARY and arity == 2:
+            fname = _MATH_BINARY[name]
+            return lambda xp: getattr(xp, fname)
+        if name in ("bitand", "bitor", "bitxor") and arity == 2:
+            fname = {"bitand": "bitwise_and", "bitor": "bitwise_or",
+                     "bitxor": "bitwise_xor"}[name]
+            return lambda xp: (lambda a, b: getattr(xp, fname)(
+                _as_int(xp, a), _as_int(xp, b)))
+        if name == "cot" and arity == 1:
+            return lambda xp: (lambda a: 1.0 / xp.tan(a))
+        if name == "bitnot" and arity == 1:
+            return lambda xp: (lambda a: xp.invert(_as_int(xp, a)))
+        if name == "log":
+            if arity == 1:
+                return lambda xp: xp.log10
+            if arity == 2:
+                return lambda xp: (lambda b, x: xp.log(x) / xp.log(b))
+        if name == "trunc" and arity == 2:
+            return lambda xp: (
+                lambda a, d: xp.trunc(a * 10.0 ** d) / 10.0 ** d)
+        return None
+
+    def _temporal_call(self, e):
+        if len(e.args) != 1:
+            raise NotVectorizable(f"{e.name} arity", reason="temporal-func")
+        a = self.lower(e.args[0])
+        if a.ty != TS:
+            raise NotVectorizable(f"{e.name} on a non-temporal operand",
+                                  reason="temporal-func")
+        anchor = self.ctx.anchor_ms
+        anchor_days = anchor // _MS_DAY
+        anchor_wd = _dt.datetime.fromtimestamp(
+            anchor / 1000.0, tz=_dt.timezone.utc).weekday()  # Mon=0
+        name = e.name
+
+        def build(xp, _a=a, _name=name, _days=anchor_days, _wd=anchor_wd):
+            fa = _a.build(xp)
+
+            def f(cols):
+                v, n = fa(cols)
+                # the anchor is UTC-midnight-aligned, so v mod day ==
+                # ts mod day; floor-mod keeps pre-anchor rows exact
+                if _name == "hour":
+                    out = (v % _MS_DAY) // 3_600_000
+                elif _name == "minute":
+                    out = (v % 3_600_000) // 60_000
+                elif _name == "second":
+                    out = (v % 60_000) // 1000
+                elif _name == "day_of_week":
+                    days = v // _MS_DAY
+                    # reference: Sunday=1 .. Saturday=7 (funcs_datetime)
+                    out = ((_wd + days) % 7 + 1) % 7 + 1
+                else:
+                    y, m, d = _civil(xp, v // _MS_DAY + _days)
+                    out = {"year": y, "month": m, "day": d,
+                           "day_of_month": d}[_name]
+                return out, n
+
+            return f
+
+        return _V(NUM, f"{name}({a.key})", build)
+
+    # -- unsupported node classes (structured reasons) ---------------------
+    def _l_LikeExpr(self, e):
+        raise NotVectorizable("LIKE on device", reason="like")
+
+    def _l_Wildcard(self, e):
+        raise NotVectorizable("wildcard", reason="wildcard")
+
+    def _l_IndexExpr(self, e):
+        raise NotVectorizable("index access", reason="json-path")
+
+    def _l_ArrowExpr(self, e):
+        raise NotVectorizable("arrow access", reason="json-path")
+
+    def _l_MetaRef(self, e):
+        raise NotVectorizable("meta reference", reason="meta-ref")
+
+
+_REASON_BY_NODE = {
+    "LikeExpr": "like", "IndexExpr": "json-path", "ArrowExpr": "json-path",
+    "Wildcard": "wildcard", "MetaRef": "meta-ref",
+}
+
+
+def _pad_consts(values, pad_val, dtype) -> np.ndarray:
+    """Pad an IN constant list to the pow-2 ladder with a sentinel that
+    can never match a real operand value (bucketed operand shapes)."""
+    n = max(len(values), 1)
+    b = IN_PAD_LADDER[-1]
+    for b in IN_PAD_LADDER:
+        if b >= n:
+            break
+    out = np.full(b, pad_val, dtype=dtype)
+    if values:
+        out[:len(values)] = np.asarray(values, dtype=dtype)
+    return out
+
+
+def _as_int(xp, v):
+    if _is_int_like(v):
+        return v
+    if hasattr(v, "dtype") or hasattr(v, "aval"):
+        return xp.asarray(v).astype(np.int32)
+    return int(v)
+
+
+def _civil(xp, z):
+    """Days-since-epoch → (year, month, day): Howard Hinnant's civil
+    algorithm in pure int32 ops."""
+    z = z + 719_468
+    era = z // 146_097
+    doe = z - era * 146_097
+    yoe = (doe - doe // 1460 + doe // 36_524 - doe // 146_096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + xp.where(mp < 10, 3, -9)
+    return y + (m <= 2), m, d
+
+
+# --------------------------------------------------------------- compiled
+class CompiledIR:
+    """One compiled expression: a backend closure plus the plan facts
+    the kernel integration needs (device columns, dtypes, derived-column
+    prep, canonical IR key). Call-compatible with
+    sql/compiler.CompiledExpr (fn/columns/mode/__call__)."""
+
+    def __init__(self, fn, columns: Set[str], mode: str, *,
+                 raw_columns: Set[str], col_dtypes: Dict[str, str],
+                 derived: Tuple[DerivedCol, ...], ir_key: str,
+                 ty: str) -> None:
+        self.fn = fn
+        self.columns = columns
+        self.mode = mode
+        self.raw_columns = raw_columns
+        self.col_dtypes = col_dtypes
+        self.derived = derived
+        self.ir_key = ir_key
+        self.ty = ty
+
+    def __call__(self, cols) -> Any:
+        return self.fn(cols)
+
+
+def ir_hash(keys) -> str:
+    h = hashlib.sha1()
+    for k in sorted(keys):
+        h.update(k.encode("utf-8"))
+        h.update(b"\x00")
+    return h.hexdigest()[:10]
+
+
+def collect_str_consts(expr: ast.Expr) -> Dict[str, Set[str]]:
+    """Plan-level pre-pass: (string column -> string constants) pairs an
+    expression would build dictionaries from — union these across every
+    expression of a plan and seed compile_expr_ir with the result, so
+    the whole plan derives ONE `__sd_*` column per raw column."""
+    try:
+        types = infer_column_types(expr)
+    except NotVectorizable:
+        return {}
+    out: Dict[str, Set[str]] = {}
+
+    def note(col_e, lit_es) -> None:
+        if not isinstance(col_e, ast.FieldRef) or \
+                types.get(col_e.name) != STR:
+            return
+        vals = {x.val for x in lit_es if isinstance(x, ast.StringLiteral)}
+        if vals:
+            out.setdefault(col_e.name, set()).update(vals)
+
+    for node in ast.walk(expr):
+        if isinstance(node, ast.BinaryExpr) and node.op in ("=", "!="):
+            note(node.lhs, [node.rhs])
+            note(node.rhs, [node.lhs])
+        elif isinstance(node, ast.InExpr):
+            note(node.value, node.values)
+        elif isinstance(node, ast.CaseExpr) and node.value is not None:
+            note(node.value, [w.cond for w in node.whens])
+    return out
+
+
+def compile_expr_ir(expr: ast.Expr, mode: str = "device",
+                    want: str = "auto",
+                    anchor_ms: Optional[int] = None,
+                    str_seed: Optional[Dict[str, Set[str]]] = None
+                    ) -> CompiledIR:
+    """Lower + compile one expression for `mode` ("device" → jax.numpy,
+    "host" → the numpy twin). `want`:
+      "bool"   — a WHERE/FILTER mask: NULL and non-boolean drop the row
+                 (sql/eval.py eval_condition's `v is True`).
+      "number" — a float32 value column with NaN at NULLs (agg args).
+      "auto"   — the node's own value (bool: NULL→False; num: NULL→NaN).
+    Raises NotVectorizable (with a structured `reason`) when any node
+    has no device form.
+    """
+    types = infer_column_types(expr)
+    ctx = _LowerCtx(types, plan_anchor_ms() if anchor_ms is None
+                    else int(anchor_ms), str_seed=str_seed)
+    root = Lowerer(ctx).lower(expr)
+    # finalize string dictionaries: codes index the SORTED constant
+    # tuple, so the same (column, constant-set) pair always derives the
+    # same column name and codes across rules — shared folds dedup them
+    derived: List[DerivedCol] = []
+    for raw, consts in sorted(ctx.str_consts.items()):
+        if types.get(raw) != STR or raw not in ctx.referenced:
+            continue  # seeded column this expression never reads
+        values = tuple(sorted(consts))
+        name = derived_name(
+            "sd", raw, ir_hash([f"{raw}|{v}" for v in values])[:8])
+        ctx.sd_names[raw] = name
+        ctx.sd_codes[raw] = {v: np.int32(i) for i, v in enumerate(values)}
+        derived.append(DerivedCol(name=name, raw=raw, kind="strdict",
+                                  values=values))
+    for raw, ty in sorted(types.items()):
+        if ty != TS or raw not in ctx.referenced:
+            continue
+        name = derived_name(
+            "ts32", raw, ir_hash([f"{raw}|{ctx.anchor_ms}"])[:8])
+        ctx.ts_names[raw] = name
+        derived.append(DerivedCol(name=name, raw=raw, kind="ts32",
+                                  anchor=ctx.anchor_ms))
+    if mode == "device":
+        import jax.numpy as jnp
+
+        xp = jnp
+    else:
+        xp = np
+    inner = root.build(xp)
+    ty = root.ty
+
+    if want != "bool" and ty == TS:
+        # a raw temporal VALUE has no device representation outside
+        # comparisons/temporal functions: the rebased int32 would leak
+        # out as epoch-ms-minus-anchor. (ts − ts durations are NUM and
+        # pass; aggregates over a bare ts column type it NUM and take
+        # the ordinary float path.)
+        raise NotVectorizable("temporal value consumed as a number",
+                              reason="temporal-value")
+    if want == "bool":
+        if ty != BOOL:
+            # a non-boolean WHERE never equals True in the row
+            # interpreter — every row drops; keep that exact contract
+            def fn(cols):
+                return False
+        else:
+            def fn(cols):
+                v, n = inner(cols)
+                return _drop_null(xp, v, n)
+    elif want == "number":
+        if ty == BOOL:
+            def fn(cols):
+                v, n = inner(cols)
+                out = xp.where(v, np.float32(1.0), np.float32(0.0))
+                if n is not None:
+                    out = xp.where(n, np.float32(np.nan), out)
+                return out
+        else:
+            def fn(cols):
+                v, n = inner(cols)
+                if hasattr(v, "dtype") or hasattr(v, "aval"):
+                    v = xp.asarray(v).astype(np.float32)
+                if n is not None:
+                    v = xp.where(n, np.float32(np.nan), v)
+                return v
+    else:
+        def fn(cols):
+            v, n = inner(cols)
+            if n is None:
+                return v
+            if ty == BOOL:
+                return _drop_null(xp, v, n)
+            return xp.where(n, np.float32(np.nan), v)
+
+    col_dtypes: Dict[str, str] = {}
+    columns: Set[str] = set()
+    dmap = {d.raw: d for d in derived}
+    for name in ctx.referenced:
+        d = dmap.get(name)
+        if d is not None:
+            columns.add(d.name)
+            col_dtypes[d.name] = d.dtype
+        else:
+            columns.add(name)
+            col_dtypes[name] = "float32"
+    key = f"{root.key}|want={want}"
+    if any(d.kind == "ts32" for d in derived):
+        key += f"|anchor={ctx.anchor_ms}"
+    return CompiledIR(fn, columns, mode, raw_columns=set(ctx.referenced),
+                      col_dtypes=col_dtypes, derived=tuple(derived),
+                      ir_key=key, ty=ty)
+
+
+def try_compile_ir(expr: ast.Expr, mode: str = "device",
+                   want: str = "auto",
+                   anchor_ms: Optional[int] = None,
+                   str_seed: Optional[Dict[str, Set[str]]] = None
+                   ) -> Optional[CompiledIR]:
+    try:
+        return compile_expr_ir(expr, mode=mode, want=want,
+                               anchor_ms=anchor_ms, str_seed=str_seed)
+    except NotVectorizable:
+        return None

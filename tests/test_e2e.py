@@ -129,6 +129,40 @@ class TestFusedTumbling:
         finally:
             topo.close()
 
+    def test_string_case_where_stays_device_fused(self, mock_clock):
+        """Expression-IR WHERE (string-dict IN + CASE) keeps the rule on
+        the fused device path — no FilterNode hop — with row-interpreter
+        result parity."""
+        topo = make_rule(
+            "SELECT deviceId, count(*) AS cnt, sum(temperature) AS s "
+            "FROM demo WHERE deviceId IN ('a', 'b') AND "
+            "CASE WHEN temperature > 25 THEN 1 ELSE 0 END = 1 "
+            "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)"
+        )
+        # the WHERE compiled into the kernel: fused node, no filter hop
+        assert any(n.name == "window_agg" for n in topo.ops)
+        assert not any(n.name == "filter" for n in topo.ops)
+        sink = topo.sinks[0]
+        topo.open()
+        try:
+            feed([
+                {"deviceId": "a", "temperature": 30.0},   # kept
+                {"deviceId": "a", "temperature": 20.0},   # CASE=0
+                {"deviceId": "b", "temperature": 40.0},   # kept
+                {"deviceId": "c", "temperature": 50.0},   # not IN
+                {"deviceId": None, "temperature": 99.0},  # NULL drops
+            ])
+            mock_clock.advance(20)
+            topo.wait_idle()
+            mock_clock.advance(10_000)
+            results = wait_results(sink, 1)
+            got = {r["deviceId"]: r for r in results[0]}
+            assert set(got) == {"a", "b"}
+            assert got["a"]["cnt"] == 1 and got["a"]["s"] == 30.0
+            assert got["b"]["cnt"] == 1 and got["b"]["s"] == 40.0
+        finally:
+            topo.close()
+
     def test_having_on_device_path(self, mock_clock):
         topo = make_rule(
             "SELECT deviceId, avg(temperature) AS t FROM demo "

@@ -1,0 +1,260 @@
+"""Predicate lifting (ops/aggspec.py lift_predicate +
+planner/sharing.py): rules that differ only in WHERE share ONE pooled
+pane fold — each member's predicate becomes per-spec device FILTER
+masks plus a private activity spec. Byte parity: every member's emitted
+windows must be bit-identical to its private (unshared) plan's."""
+import numpy as np
+import pytest
+
+from ekuiper_tpu.data.batch import ColumnBatch
+from ekuiper_tpu.data.rows import WindowRange
+from ekuiper_tpu.ops.aggspec import extract_kernel_plan, lift_predicate
+from ekuiper_tpu.ops.emit import build_direct_emit
+from ekuiper_tpu.ops.panestore import pane_gcd, union_plan
+from ekuiper_tpu.runtime.events import Trigger
+from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+from ekuiper_tpu.runtime.nodes_sharedfold import (
+    MemberSpec, SharedEmitNode, SharedFoldNode,
+)
+from ekuiper_tpu.sql import ast
+from ekuiper_tpu.sql.parser import parse_select
+
+#: four rules over one stream, same GROUP BY + window grid, WHEREs all
+#: different (numeric, string-dict, CASE-bearing, none) — the shape that
+#: planned four PRIVATE folds before predicate lifting
+SQLS = [
+    "SELECT deviceId, count(*) AS c, sum(temperature) AS s FROM demo "
+    "WHERE temperature > 20 GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)",
+    "SELECT deviceId, count(*) AS c, sum(temperature) AS s FROM demo "
+    "WHERE temperature > 30 GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)",
+    "SELECT deviceId, count(*) AS c, min(temperature) AS mn FROM demo "
+    "WHERE status = 'ok' AND temperature <= 40 "
+    "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)",
+    "SELECT deviceId, count(*) AS c FROM demo "
+    "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)",
+]
+
+
+def _batch(rng, n=120, t0=0):
+    ids = np.array([f"d{rng.integers(0, 6)}" for _ in range(n)],
+                   dtype=np.object_)
+    temp = np.rint(rng.normal(25, 12, n)).astype(np.float32)
+    status = np.array([("ok", "warn", "err")[rng.integers(0, 3)]
+                       for _ in range(n)], dtype=np.object_)
+    # a few NULLs: predicate masks must drop them, not fold them
+    for i in rng.integers(0, n, 5):
+        status[i] = None
+    ts = np.full(n, t0, dtype=np.int64)
+    return ColumnBatch(n=n, columns={"deviceId": ids, "temperature": temp,
+                                     "status": status},
+                       timestamps=ts, emitter="demo")
+
+
+def _copy(b):
+    return ColumnBatch(n=b.n, columns=b.columns, valid=b.valid,
+                       timestamps=b.timestamps, emitter=b.emitter)
+
+
+def _drain(entry):
+    out = []
+    while not entry.inq.empty():
+        item = entry.inq.get_nowait()
+        if isinstance(item, ColumnBatch):
+            out.append(item)
+    return out
+
+
+class TestLiftPlan:
+    def test_lift_shape(self):
+        stmt = parse_select(SQLS[0])
+        plan = extract_kernel_plan(stmt)
+        lifted = lift_predicate(plan, stmt.condition)
+        assert lifted.filter is None
+        assert len(lifted.specs) == len(plan.specs) + 1
+        assert lifted.act_idx == len(plan.specs)
+        # every original spec now carries the predicate as FILTER
+        for s in lifted.specs:
+            assert s.filter is not None
+        # spec order preserved: direct-emit indices stay valid
+        assert [s.kind for s in lifted.specs[:-1]] == \
+            [s.kind for s in plan.specs]
+
+    def test_no_predicate_is_identity(self):
+        stmt = parse_select(SQLS[3])
+        plan = extract_kernel_plan(stmt)
+        assert lift_predicate(plan, stmt.condition) is plan
+
+    def test_union_dedups_identical_where_only(self):
+        stmts = [parse_select(s) for s in (SQLS[0], SQLS[0], SQLS[1])]
+        lifted = [lift_predicate(extract_kernel_plan(s), s.condition)
+                  for s in stmts]
+        union, maps = union_plan(lifted)
+        # rules 0 and 1 (identical WHERE) dedup completely; rule 2 adds
+        # its own masked specs. Within one rule the synthetic activity
+        # spec aliases its own `count(*) FILTER(pred)` spec (same call
+        # key), so each rule contributes 2 distinct columns, not 3.
+        assert len(union.specs) == 4
+        assert maps[0] == maps[1]
+        assert maps[2] != maps[0]
+
+
+class TestByteParity:
+    def test_mixed_where_shared_equals_private(self):
+        stmts = [parse_select(s) for s in SQLS]
+        plans = [extract_kernel_plan(s) for s in stmts]
+        assert all(p is not None for p in plans)
+        lifted = [lift_predicate(p, s.condition)
+                  for p, s in zip(plans, stmts)]
+        union, _ = union_plan(lifted)
+        assert union.filter is None
+        pane = pane_gcd([10_000])
+        store = SharedFoldNode("k", "sf_lift", union, pane, 3,
+                               subtopo_ref=None, capacity=64,
+                               micro_batch=256)
+        store._cur_bucket = 0
+        entries = []
+        for i, (stmt, plan, lp) in enumerate(zip(stmts, plans, lifted)):
+            spec = MemberSpec(
+                rule_id=f"r{i}", length_ms=10_000, interval_ms=10_000,
+                plan=lp, dims=["deviceId"],
+                direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+                emit_columnar=True, act_idx=lp.act_idx)
+            e = SharedEmitNode(f"r{i}_emit")
+            assert store.attach_rule(spec, e, None)
+            entries.append(e)
+
+        privs = []
+        for stmt, plan in zip(stmts, plans):
+            n = FusedWindowAggNode(
+                "priv", stmt.window, plan,
+                dims=[d.expr for d in stmt.dimensions],
+                capacity=64, micro_batch=256,
+                direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+                emit_columnar=True, prefinalize_lead_ms=0)
+            n.state = n.gb.init_state()
+            got = []
+            n.broadcast = lambda item, g=got: g.append(item)
+            privs.append((n, got))
+
+        rng = np.random.default_rng(11)
+        for end in (10_000, 20_000, 30_000):
+            for _ in range(3):
+                b = _batch(rng, t0=end - 5_000)
+                store.process(b)
+                for p, _g in privs:
+                    p.process(_copy(b))
+            store.on_trigger(Trigger(ts=end))
+            for p, _g in privs:
+                p._emit(WindowRange(end - 10_000, end))
+                p.state = p.gb.reset_pane(p.state, 0)
+
+        total = 0
+        for i, e in enumerate(entries):
+            shared = _drain(e)
+            priv = [x for x in privs[i][1] if isinstance(x, ColumnBatch)]
+            assert shared, f"rule {i} emitted nothing"
+            assert len(shared) == len(priv), i
+            for s, p in zip(shared, priv):
+                assert set(s.columns) == set(p.columns), i
+                for c in s.columns:
+                    assert s.columns[c].dtype == p.columns[c].dtype, (i, c)
+                    assert np.array_equal(s.columns[c], p.columns[c]), \
+                        (i, c, s.columns[c], p.columns[c])
+                total += s.n
+        assert total > 0
+        # dedup accounting: one fold per batch serves 4 members
+        assert store.folds_did == 9
+        assert store.fold_dedup_ratio() == pytest.approx(0.75)
+
+    def test_member_activity_excludes_fully_filtered_groups(self):
+        """A key whose rows ALL fail one member's predicate must not
+        emit a group for that member (the lifted activity spec), while
+        a no-predicate peer still sees it."""
+        sql_hot = ("SELECT deviceId, count(*) AS c FROM demo "
+                   "WHERE temperature > 100 "
+                   "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        sql_all = ("SELECT deviceId, count(*) AS c FROM demo "
+                   "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        stmts = [parse_select(sql_hot), parse_select(sql_all)]
+        plans = [extract_kernel_plan(s) for s in stmts]
+        lifted = [lift_predicate(p, s.condition)
+                  for p, s in zip(plans, stmts)]
+        union, _ = union_plan(lifted)
+        store = SharedFoldNode("k2", "sf_act", union, 10_000, 3,
+                               subtopo_ref=None, capacity=16,
+                               micro_batch=64)
+        store._cur_bucket = 0
+        entries = []
+        for i, (stmt, plan, lp) in enumerate(zip(stmts, plans, lifted)):
+            spec = MemberSpec(
+                rule_id=f"r{i}", length_ms=10_000, interval_ms=10_000,
+                plan=lp, dims=["deviceId"],
+                direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+                emit_columnar=True, act_idx=lp.act_idx)
+            e = SharedEmitNode(f"r{i}_e")
+            store.attach_rule(spec, e, None)
+            entries.append(e)
+        cold = ColumnBatch(
+            n=4,
+            columns={"deviceId": np.array(["cold"] * 4, dtype=np.object_),
+                     "temperature": np.array([1., 2., 3., 4.],
+                                             dtype=np.float32)},
+            timestamps=np.zeros(4, dtype=np.int64), emitter="demo")
+        hot = ColumnBatch(
+            n=2,
+            columns={"deviceId": np.array(["hot"] * 2, dtype=np.object_),
+                     "temperature": np.array([150., 200.],
+                                             dtype=np.float32)},
+            timestamps=np.zeros(2, dtype=np.int64), emitter="demo")
+        store.process(cold)
+        store.process(hot)
+        store.on_trigger(Trigger(ts=10_000))
+        got_hot = _drain(entries[0])
+        got_all = _drain(entries[1])
+        assert len(got_hot) == 1 and got_hot[0].n == 1
+        assert got_hot[0].columns["deviceId"].tolist() == ["hot"]
+        assert got_all[0].n == 2  # the unfiltered peer sees both keys
+
+
+class TestLiftGuards:
+    def test_uncompilable_conjunction_stays_private(self):
+        """Pieces that compile separately but conflict when conjoined
+        (WHERE types the column temporal, FILTER arithmetic types it
+        numeric) must return None — the caller keeps a private fold —
+        never raise out of rule planning."""
+        stmt = parse_select(
+            "SELECT deviceId, sum(temperature) FILTER (WHERE ts % 2 = 0)"
+            " AS s FROM demo WHERE ts > 1700000000000 "
+            "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        plan = extract_kernel_plan(stmt)
+        assert plan is not None
+        assert lift_predicate(plan, stmt.condition) is None
+
+    def test_lift_reuses_plan_dictionaries(self):
+        """The lifted filters must resolve to the SAME __sd_* columns
+        the plan's arg closures already reference — one host encode,
+        one upload per raw column."""
+        stmt = parse_select(
+            "SELECT deviceId, sum(CASE WHEN status = 'warn' THEN "
+            "temperature ELSE 0.0 END) AS s FROM demo "
+            "WHERE status = 'ok' GROUP BY deviceId, "
+            "TUMBLINGWINDOW(ss, 10)")
+        plan = extract_kernel_plan(stmt)
+        lifted = lift_predicate(plan, stmt.condition)
+        sd = [d for d in lifted.derived if d.kind == "strdict"]
+        assert len(sd) == 1
+        assert set(sd[0].values) == {"ok", "warn"}
+
+    def test_temporal_value_never_escapes_as_number(self):
+        """A CASE yielding the raw (anchor-rebased) event-time column
+        must NOT device-compile — letting it out would emit epoch-ms
+        minus the plan anchor."""
+        from ekuiper_tpu.ops.aggspec import take_expr_fallbacks
+
+        stmt = parse_select(
+            "SELECT deviceId, max(CASE WHEN hour(ts) < 23 THEN ts "
+            "ELSE 0 END) AS m FROM demo "
+            "GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+        assert extract_kernel_plan(stmt) is None
+        assert any(n["reason"] == "temporal-value"
+                   for n in take_expr_fallbacks())

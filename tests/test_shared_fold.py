@@ -16,7 +16,9 @@ import numpy as np
 import pytest
 
 from ekuiper_tpu.data.batch import ColumnBatch
-from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+from ekuiper_tpu.ops.aggspec import (
+    _call_key as spec_call_key_, extract_kernel_plan,
+)
 from ekuiper_tpu.ops.emit import build_direct_emit
 from ekuiper_tpu.ops.panestore import pane_gcd, spec_map_into, union_plan
 from ekuiper_tpu.planner import sharing
@@ -526,9 +528,11 @@ class TestPlannerIntegration:
         assert out["sharing"]["decision"] == "private"
         assert "panes" in out["sharing"]["reason"]
 
-    def test_uncorrelated_where_does_not_share(self):
-        """Different WHERE clauses gate different fold inputs — distinct
-        stores (key includes the WHERE expression)."""
+    def test_mixed_where_shares_one_store_via_predicate_lift(self):
+        """Rules that differ ONLY in WHERE share one pooled fold: each
+        member's predicate lifts into per-spec device FILTER masks + a
+        private activity spec (ops/aggspec.py lift_predicate), so the
+        store key no longer includes the WHERE expression."""
         store = kv.get_store()
         _mk_stream(store)
         def mk(rid, thresh):
@@ -536,8 +540,8 @@ class TestPlannerIntegration:
                          f"WHERE temperature > {thresh} GROUP BY deviceId, "
                          "TUMBLINGWINDOW(ss, 10)")
 
-        # two pairs: within a pair the WHERE matches (they share); across
-        # pairs it differs (distinct stores — the key includes the WHERE)
+        # two pairs of WHEREs: identical-WHERE specs dedup outright,
+        # different-WHERE specs coexist as masked specs in ONE store
         for r in (mk("ra0", 5), mk("rb0", 50)):
             plan_rule(r, store)  # declare candidates
         ta, tb = plan_rule(mk("ra1", 5), store), plan_rule(mk("rb1", 50),
@@ -545,11 +549,20 @@ class TestPlannerIntegration:
         assert not ta.sources and not tb.sources  # both planned shared
         ta.open(); tb.open()
         try:
-            assert sf.pool_size() == 2  # two stores, one per WHERE
-            names = {st.name for st in sf.live_stores()}
-            # distinct display names: identical names would emit duplicate
-            # Prometheus series and invalidate the whole scrape
-            assert len(names) == 2, names
+            assert sf.pool_size() == 1  # ONE store across both WHEREs
+            st = sf.live_stores()[0]
+            assert st.member_count() == 2
+            # the union plan carries each predicate's lifted specs:
+            # count(*) FILTER(t>5), act(t>5), count(*) FILTER(t>50),
+            # act(t>50) — the t>5 pair dedups with ra0's declaration
+            keys = {spec_call_key_(s.call) for s in st.plan.specs}
+            assert len(keys) == len(st.plan.specs)  # all distinct
+            assert any("5" in k and "f:" in k for k in keys)
+            assert any("50" in k and "f:" in k for k in keys)
+            # per-member activity: each attached member reads its own
+            # lifted act spec, not the store-global act
+            for m in st._members.values():
+                assert m.spec.act_idx is not None
         finally:
             ta.close(); tb.close()
 

@@ -442,3 +442,85 @@ class TestRingGuardrails:
         node, _ = mknode(SQL_INV, "daba")
         assert node.bucket_ms == layout.bucket_ms
         assert node.n_ring_panes == layout.n_ring_panes
+
+
+class TestBudgetAwareLayout:
+    """ROADMAP item-2 remnant: wide-hll sliding rules must take the DABA
+    ring inside the slidingDevRingMb budget by coarsening their ring
+    geometry, instead of silently falling back to refold; and the
+    budget check must price exactly what init_state allocates."""
+
+    WIDE_SQL = ("SELECT deviceId, distinct_count_approx(temp) AS dc, "
+                "percentile_approx(temp, 0.9) AS p90, count(*) AS c "
+                "FROM s GROUP BY deviceId, "
+                "SLIDINGWINDOW(ss, 30) OVER (WHEN temp > 90)")
+
+    def test_estimate_matches_allocation(self):
+        stmt = parse_select(SQL_MM)
+        plan = extract_kernel_plan(stmt)
+        from ekuiper_tpu.ops.groupby import DeviceGroupBy
+        from ekuiper_tpu.ops.slidingring import ring_layout_for
+
+        layout = ring_layout_for(stmt.window, plan)
+        gb = DeviceGroupBy(plan, capacity=32, n_panes=layout.n_panes,
+                           micro_batch=16)
+        ring = SlidingRing(gb, layout)
+        state = ring.init_state()
+        assert ring.state_nbytes(state) == ring.estimate_bytes(32)
+
+    def test_plan_time_estimate_matches_kernel_estimate(self):
+        """The planner's no-kernel estimate (_plan_ring_bytes) must
+        price the same bytes SlidingRing.estimate_bytes reports."""
+        from ekuiper_tpu.ops.groupby import DeviceGroupBy
+        from ekuiper_tpu.ops.slidingring import (_plan_ring_bytes,
+                                                 ring_layout_for)
+
+        stmt = parse_select(self.WIDE_SQL)
+        plan = extract_kernel_plan(stmt)
+        layout = ring_layout_for(stmt.window, plan)
+        gb = DeviceGroupBy(plan, capacity=64, n_panes=layout.n_panes,
+                           micro_batch=16)
+        ring = SlidingRing(gb, layout)
+        mm_slot, fixed = _plan_ring_bytes(plan, 64)
+        assert fixed + (1 + layout.n_ring_panes) * mm_slot == \
+            ring.estimate_bytes(64)
+
+    def test_wide_hll_coarsens_into_budget(self):
+        """A wide-hll sliding rule whose default geometry would blow the
+        budget coarsens its buckets until the ring fits — and takes the
+        DABA ring, not the refold fallback."""
+        from ekuiper_tpu.ops.slidingring import (_plan_ring_bytes,
+                                                 ring_layout_for)
+
+        stmt = parse_select(self.WIDE_SQL)
+        plan = extract_kernel_plan(stmt)
+        capacity = 2048
+        default = ring_layout_for(stmt.window, plan)
+        mm_slot, fixed = _plan_ring_bytes(plan, capacity)
+        default_bytes = fixed + (1 + default.n_ring_panes) * mm_slot
+        # pick a budget the default layout misses but a coarser fits
+        budget_mb = max(int(default_bytes * 0.6) >> 20, 1)
+        fitted = ring_layout_for(stmt.window, plan, capacity=capacity,
+                                 budget_mb=budget_mb)
+        assert fitted.n_ring_panes < default.n_ring_panes
+        fitted_bytes = fixed + (1 + fitted.n_ring_panes) * mm_slot
+        assert fitted_bytes <= budget_mb << 20
+        node = FusedWindowAggNode(
+            "wide", stmt.window, plan,
+            dims=[d.expr for d in stmt.dimensions],
+            capacity=capacity, micro_batch=128,
+            direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+            dev_ring_budget_mb=budget_mb, sliding_impl="daba")
+        assert node.sliding_impl == "daba", "wide-hll rule must ride DABA"
+        assert node.ring.estimate_bytes(capacity) <= budget_mb << 20
+
+    def test_impossible_budget_still_refolds(self):
+        stmt = parse_select(self.WIDE_SQL)
+        plan = extract_kernel_plan(stmt)
+        node = FusedWindowAggNode(
+            "none", stmt.window, plan,
+            dims=[d.expr for d in stmt.dimensions],
+            capacity=2048, micro_batch=128,
+            direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+            dev_ring_budget_mb=0, sliding_impl="daba")
+        assert node.sliding_impl == "refold"

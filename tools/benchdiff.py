@@ -60,6 +60,11 @@ HEADLINE = (
     # latency regression gates ci_gate every round, not report-only
     ("phases.sliding_saturated.emit_p99_ms", 0.50),
     ("phases.sliding_paced.emit_p99_ms", 0.50),
+    # compiled expression IR (ISSUE 12): a filter-heavy rule must stay
+    # fold-limited — its throughput gates alongside the tumbling line,
+    # and the predicate-lifted shared fold's dedup ratio must hold
+    ("phases.filter_heavy.rows_per_sec", 0.15),
+    ("phases.multi_rule_shared_mixed.mixed_where_dedup_ratio", 0.10),
 )
 
 #: default noise tolerance for every non-headline comparison

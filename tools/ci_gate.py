@@ -8,6 +8,8 @@ passed):
   kuiperlint        python -m tools.kuiperlint ekuiper_tpu/   (8 passes)
   jitcert certify   derivations deterministic, closed, exercised
   jitcert diff      observed XLA signatures ⊆ certificates (CPU battery)
+  probe_exprs       expression-IR smoke: CASE+IN+temporal rule plans
+                    device-fused, fold parity, jitcert clean
   check_metrics     Prometheus catalog lint (synthetic scrape vs docs)
   benchdiff --smoke trajectory-gate self-test (synthetic artifacts)
 
@@ -37,6 +39,7 @@ GATES: Dict[str, List[str]] = {
                    "ekuiper_tpu/"],
     "jitcert_certify": [sys.executable, "-m", "tools.jitcert", "certify"],
     "jitcert_diff": [sys.executable, "-m", "tools.jitcert", "diff"],
+    "probe_exprs": [sys.executable, "tools/probe_exprs.py"],
     "check_metrics": [sys.executable, "tools/check_metrics.py"],
     "benchdiff_smoke": [sys.executable, "tools/benchdiff.py", "--smoke"],
 }

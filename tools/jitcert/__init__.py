@@ -70,6 +70,14 @@ def _battery():
                    span_buckets=3, scratch_pane=4))
     hh = plan("SELECT deviceId, heavy_hitters(tag, 2) AS hh FROM s "
               "GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)")
+    # expression-IR kernel: device-compiled CASE + string-dict IN +
+    # temporal WHERE — the fold signature family gains int32 derived
+    # columns (__sd_*/__ts32_*, KernelPlan.col_dtypes), which the
+    # _derive_fold dtype axis must close over
+    expr = plan("SELECT deviceId, sum(CASE WHEN status = 'ok' THEN v "
+                "ELSE 0.0 END) AS s, count(*) AS c FROM s "
+                "WHERE status IN ('ok', 'warn') AND hour(ets) < 23 "
+                "GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)")
     mr_sqls = [
         f"SELECT deviceId, count(*) AS c FROM s WHERE v > {t} "
         "GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)" for t in (1.0, 2.0)]
@@ -83,6 +91,8 @@ def _battery():
                                          micro_batch=16),
         "groupby_hh": DeviceGroupBy(hh, capacity=32, n_panes=1,
                                     micro_batch=16),
+        "groupby_expr": DeviceGroupBy(expr, capacity=32, n_panes=1,
+                                      micro_batch=16),
         "multirule": BatchedGroupBy(mr_spec, capacity=32, n_panes=1,
                                     micro_batch=16),
         "sketch": CountMinSketch(depth=2, width=64, max_candidates=16),
@@ -150,6 +160,8 @@ def _drive(kernels) -> None:
 
     def feed(gb: DeviceGroupBy, with_masks: bool, pane_vec: bool,
              n_keys: int = 8):
+        from ekuiper_tpu.ops.groupby import col_np_dtype
+
         cols = {}
         valid = {}
         n = 10
@@ -157,7 +169,9 @@ def _drive(kernels) -> None:
             if name.startswith("__hhc__"):
                 cols[name] = np.arange(n, dtype=np.float32) % 3
             else:
-                cols[name] = np.arange(n, dtype=np.float64)
+                dt = col_np_dtype(gb.plan, name)
+                cols[name] = np.arange(n).astype(
+                    dt if dt != np.dtype(np.float32) else np.float64)
             if with_masks:
                 valid[name] = np.ones(n, dtype=np.bool_)
         slots = (np.arange(n, dtype=np.int32) % n_keys)

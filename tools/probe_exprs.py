@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""probe_exprs — tier-1 smoke for the device-compiled expression IR.
+
+Plans a rule whose WHERE + projection expressions span every operator
+class the IR compiles (CASE, IN with string constants, dictionary-coded
+string equality, temporal extraction on an int64 event-time column,
+BETWEEN, NULL-propagating three-valued logic), then asserts:
+
+  1. the rule takes the FUSED DEVICE path (device_path_eligible returns
+     a kernel plan; no FilterNode / row-interpreter hop),
+  2. the plan carries the expression-IR plumbing: int32 derived columns
+     (__sd_*/__ts32_*), a per-column dtype map, and an IR hash for the
+     prep-upload share keys,
+  3. a real fold + finalize on CPU jax produces the row-interpreter's
+     exact groups (WHERE parity, NULLs dropped),
+  4. every traced signature is inside its jitcert certificate
+     (diff_live clean) — the bounded-signature-family acceptance gate.
+
+Run directly or through tools/ci_gate.py (gate name `probe_exprs`).
+Exit 0 on success. docs/EXPRESSIONS.md documents the IR itself.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+
+SQL = (
+    "SELECT deviceId, count(*) AS c, "
+    "sum(CASE WHEN status = 'ok' THEN v ELSE 0.0 END) AS s_ok, "
+    "avg(v) FILTER (WHERE v BETWEEN 0 AND 100) AS a "
+    "FROM s WHERE status IN ('ok', 'warn') AND hour(ets) < 23 "
+    "AND NOT (v < 0) "
+    "GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)"
+)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from ekuiper_tpu.data.batch import from_messages
+    from ekuiper_tpu.observability import jitcert
+    from ekuiper_tpu.ops.aggspec import extract_kernel_plan, \
+        take_expr_fallbacks
+    from ekuiper_tpu.ops.groupby import DeviceGroupBy
+    from ekuiper_tpu.planner.planner import device_path_eligible
+    from ekuiper_tpu.sql.eval import Evaluator
+    from ekuiper_tpu.sql.expr_ir import materialize_derived
+    from ekuiper_tpu.sql.parser import parse_select
+    from ekuiper_tpu.utils.config import RuleOptionConfig, get_config
+
+    problems = []
+    stmt = parse_select(SQL)
+    opts = RuleOptionConfig(**{**get_config().rule.__dict__})
+    plan = device_path_eligible(stmt, opts)
+    notes = take_expr_fallbacks()
+    if plan is None:
+        problems.append(f"rule did not take the device path: {notes}")
+    if plan is not None:
+        derived = {d.kind for d in plan.derived}
+        if "strdict" not in derived or "ts32" not in derived:
+            problems.append(f"missing derived column kinds: {derived}")
+        if not plan.expr_tag:
+            problems.append("plan has no expression IR hash")
+        if "int32" not in set(plan.col_dtypes.values()):
+            problems.append(f"no int32 kernel columns: {plan.col_dtypes}")
+
+    # ---- fold parity vs the row interpreter --------------------------
+    if plan is not None:
+        anchor = next(d.anchor for d in plan.derived if d.kind == "ts32")
+        msgs = [
+            {"deviceId": "a", "v": 1.0, "status": "ok",
+             "ets": anchor + 3_600_000},
+            {"deviceId": "a", "v": 2.0, "status": "warn",
+             "ets": anchor + 3_600_000},
+            {"deviceId": "b", "v": 3.0, "status": "err",
+             "ets": anchor + 3_600_000},
+            {"deviceId": "b", "v": 4.0, "status": "ok",
+             "ets": anchor + 85_000_000},      # hour 23: dropped
+            {"deviceId": "a", "v": None, "status": "ok", "ets": None},
+            {"deviceId": "c", "v": 250.0, "status": "warn",
+             "ets": anchor + 7_200_000},       # fails the agg FILTER
+        ]
+        batch, _ = from_messages(msgs, [0] * len(msgs), emitter="s")
+        gb = DeviceGroupBy(plan, capacity=16, n_panes=1, micro_batch=8)
+        state = gb.init_state()
+        cols: dict = {}
+        materialize_derived(plan.derived, cols, batch)
+        for name in plan.columns:
+            if name not in cols:
+                cols[name] = np.asarray(batch.columns[name])
+        valid = {n: batch.valid[n] for n in plan.columns
+                 if n in batch.valid}
+        keys = sorted({m["deviceId"] for m in msgs})
+        slots = np.array([keys.index(m["deviceId"]) for m in msgs],
+                         dtype=np.int32)
+        state = gb.fold(state, cols, slots, valid, 0)
+        outs, act = gb.finalize(state, len(keys))
+
+        # reference: the row interpreter over the same WHERE
+        ev = Evaluator()
+        kept = [r for r in batch.to_tuples()
+                if ev.eval_condition(stmt.condition, r)]
+        ref_act = {k: sum(1 for r in kept
+                          if r.value("deviceId")[0] == k) for k in keys}
+        got_act = {k: int(act[i]) for i, k in enumerate(keys)}
+        if got_act != ref_act:
+            problems.append(f"WHERE parity: device act {got_act} != "
+                            f"row-interpreter {ref_act}")
+        # spot-check the CASE projection: key 'a' folds 1.0 (ok) + 0.0
+        # (warn); the NULL-v row dropped by WHERE's NOT(v<0) null rule
+        s_idx = next(i for i, s in enumerate(plan.specs)
+                     if s.kind == "sum")
+        if abs(float(outs[s_idx][keys.index("a")]) - 1.0) > 1e-6:
+            problems.append(
+                f"CASE sum for key a: {outs[s_idx][keys.index('a')]}"
+                " != 1.0")
+
+        d = jitcert.diff_live()
+        if not d["clean"]:
+            problems.append(f"jitcert diff not clean: "
+                            f"{d['uncertified'][:4]}")
+
+    report = {"ok": not problems, "problems": problems,
+              "fallback_notes": notes}
+    print(json.dumps(report, indent=2) if problems else
+          "probe_exprs: OK — CASE+IN+string+temporal rule plans "
+          "device-fused, fold parity holds, jitcert clean")
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
