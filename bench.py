@@ -95,6 +95,7 @@ PHASE_FLOORS = (
     ("filter_heavy", 25.0),
     ("multi_rule_shared", 30.0),
     ("multi_rule_shared_mixed", 25.0),
+    ("key_cardinality", 45.0),
     ("churn_soak", 45.0),
 )
 
@@ -681,6 +682,177 @@ def bench_countwindow_hll_1m(kt_slots) -> None:
     record("hll_capacity_grow", keys=node.kt.n_keys,
            slots=node.gb.capacity, slots_before=slots_before,
            rows_per_sec_incl_recompile=grow_rows / grow_s)
+
+
+def bench_key_cardinality(kt_slots, budget_s: float = 240.0) -> None:
+    """ISSUE 13 phase: distinct-key cardinality 1M -> 10M (attempted)
+    under a FIXED HBM budget, with the tiered key state
+    (ops/tierstore.py) absorbing the overflow — a hot core keeps its
+    dense device slots while a marching cold tail demotes to the host
+    arena and its slots recycle. Records rows/s, emit p99, spill/promote
+    rates, and the device-slot ceiling per cardinality checkpoint, plus
+    a sub-budget byte-parity segment vs the untiered path."""
+    import jax
+
+    from ekuiper_tpu.data.batch import ColumnBatch
+    from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+    from ekuiper_tpu.ops.emit import build_direct_emit
+    from ekuiper_tpu.runtime.events import Trigger
+    from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+    from ekuiper_tpu.sql.parser import parse_select
+
+    from ekuiper_tpu.ops.tierstore import env_hbm_budget_mb
+
+    budget_mb = env_hbm_budget_mb() or 64.0
+    sql = ("SELECT deviceId, sum(v) AS s, count(*) AS c FROM demo "
+           "GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)")
+    stmt = parse_select(sql)
+    plan = extract_kernel_plan(stmt)
+    assert plan is not None
+
+    def mk(tier_mb, capacity):
+        n = FusedWindowAggNode(
+            "keycard", stmt.window, plan,
+            dims=[d.expr for d in stmt.dimensions],
+            capacity=capacity, micro_batch=BATCH_ROWS,
+            direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+            emit_columnar=True, prefinalize_lead_ms=0,
+            tier_budget_mb=tier_mb, tier_scan_ms=1)
+        n.state = n.gb.init_state()
+        return n
+
+    # ---- sub-budget byte-parity segment: tier ENGAGED but cardinality
+    # below the hot target — emissions must be byte-identical to the
+    # untiered path (acceptance gate)
+    par_t, par_p = mk(0.01, 4096), mk(0.0, 4096)
+    pe_t, pe_p = [], []
+    par_t.broadcast = lambda item: pe_t.append(item)
+    par_p.broadcast = lambda item: pe_p.append(item)
+    rng = np.random.default_rng(13)
+    par_ids = np.array([f"p{i}" for i in range(1000)], dtype=np.object_)
+    for w in range(3):
+        idx = rng.integers(0, 1000, 8192)
+        vals = rng.normal(50, 10, 8192)
+        for n in (par_t, par_p):
+            n.process(ColumnBatch(
+                n=8192, columns={"deviceId": par_ids[idx].copy(),
+                                 "v": vals.copy()},
+                timestamps=np.zeros(8192, dtype=np.int64),
+                emitter="demo"))
+            n.on_trigger(Trigger(ts=(w + 1) * 1000))
+    for n in (par_t, par_p):
+        n._drain_async_emits()
+
+    def _rows(emits):
+        out = []
+        for cb in emits:
+            cols = getattr(cb, "columns", None)
+            if cols is None:
+                continue
+            out.append({k: np.asarray(v).tobytes()
+                        if np.asarray(v).dtype != np.object_
+                        else tuple(v) for k, v in sorted(cols.items())})
+        return out
+
+    parity = (par_t.tier is not None and _rows(pe_t) == _rows(pe_p))
+
+    # ---- cardinality sweep under the fixed budget
+    node = mk(budget_mb, 1 << 20)
+    assert node.tier is not None, "tier must engage for the sweep"
+    emits = []
+    t_bound = [0.0]
+    node.broadcast = lambda item: emits.append(
+        (time.perf_counter() - t_bound[0]) * 1000.0)
+    hot_n = 1 << 18
+    fresh_per_batch = 2048
+    hot_ids = np.array([f"hot_{i}" for i in range(hot_n)],
+                       dtype=np.object_)
+    targets = [1_000_000, 3_000_000, 10_000_000]
+    checkpoints = {}
+    fresh_cursor = 0
+    rows = 0
+    wn = 0
+    t0 = time.time()
+    deadline = t0 + budget_s
+    seg_t0, seg_rows = t0, 0
+    marker = None
+    nb = 0
+    while targets and time.time() < deadline:
+        idx = rng.integers(0, hot_n, BATCH_ROWS - fresh_per_batch)
+        fresh = np.array(
+            [f"k{fresh_cursor + i}" for i in range(fresh_per_batch)],
+            dtype=np.object_)
+        fresh_cursor += fresh_per_batch
+        ids = np.concatenate([hot_ids[idx], fresh])
+        node.process(ColumnBatch(
+            n=BATCH_ROWS,
+            columns={"deviceId": ids,
+                     "v": rng.normal(50, 10, BATCH_ROWS)},
+            timestamps=np.zeros(BATCH_ROWS, dtype=np.int64),
+            emitter="demo"))
+        rows += BATCH_ROWS
+        seg_rows += BATCH_ROWS
+        nb += 1
+        if nb % 4 == 0:
+            wn += 1
+            t_bound[0] = time.perf_counter()
+            node.on_trigger(Trigger(ts=wn * 1000))
+            _block_marker(marker)
+            marker = node.state["act"][:1]
+        total_distinct = hot_n + fresh_cursor
+        if total_distinct >= targets[0]:
+            node._drain_async_emits()
+            jax.block_until_ready(node.state)
+            seg_s = max(time.time() - seg_t0, 1e-9)
+            t = node.tier
+            checkpoints[str(targets[0])] = {
+                "rows_per_sec": seg_rows / seg_s,
+                "emit_p99_ms": (float(np.percentile(emits, 99))
+                                if emits else None),
+                "device_slots": node.gb.capacity,
+                "resident_cold": len(t.store),
+                "tier_host_mb": round(t.store.nbytes() / 2**20, 1),
+                "demoted_total": t.demoted_total,
+                "promoted_total": t.promoted_total,
+                "spill_per_sec": round(t.demoted_total / seg_s, 1),
+            }
+            targets.pop(0)
+            seg_t0, seg_rows = time.time(), 0
+    node._drain_async_emits()
+    jax.block_until_ready(node.state)
+    total_s = time.time() - t0
+    t = node.tier
+    keys_reached = hot_n + fresh_cursor
+    dev_state_mb = sum(
+        int(getattr(a, "nbytes", 0) or 0)
+        for a in node.state.values()) / 2**20
+    print(
+        f"# key_cardinality: {keys_reached:,} distinct keys attempted "
+        f"({len(checkpoints)} checkpoints) under {budget_mb:.0f}MB budget "
+        f"in {total_s:.1f}s — {rows / max(total_s, 1e-9):,.0f} rows/s, "
+        f"device slots {node.gb.capacity:,} ({dev_state_mb:.1f}MB state), "
+        f"{t.demoted_total:,} demoted / {t.promoted_total:,} promoted / "
+        f"{t.recycled_total:,} recycled, cold-resident {len(t.store):,} "
+        f"({t.store.nbytes() / 2**20:.1f}MB host), parity={parity}",
+        file=sys.stderr,
+    )
+    record("key_cardinality",
+           keys_reached=keys_reached,
+           rows_per_sec=rows / max(total_s, 1e-9),
+           emit_p99_ms=(float(np.percentile(emits, 99))
+                        if emits else None),
+           device_slots=node.gb.capacity,
+           device_state_mb=round(dev_state_mb, 1),
+           budget_mb=budget_mb,
+           demoted_total=t.demoted_total,
+           promoted_total=t.promoted_total,
+           recycled_total=t.recycled_total,
+           resident_cold=len(t.store),
+           tier_host_mb=round(t.store.nbytes() / 2**20, 1),
+           subbudget_parity=bool(parity),
+           checkpoints=checkpoints)
+    assert parity, "tiered emissions diverged from untiered at " \
+                   "sub-budget cardinality"
 
 
 def _harvest_phase_stderr(stderr, tag: str) -> bool:
@@ -2519,6 +2691,12 @@ def main() -> None:
          lambda: bench_multi_rule_shared(batches, KEY_SLOTS)),
         ("multi_rule_shared_mixed", 600.0,
          lambda: bench_multi_rule_shared_mixed(batches, KEY_SLOTS)),
+        ("key_cardinality", 600.0,
+         lambda: bench_key_cardinality(
+             KEY_SLOTS,
+             budget_s=max(phase_budget(
+                 240.0, later_floor_s=later_floor("key_cardinality"))
+                 - 30.0, 30.0))),
     ):
         budget_s = phase_budget(budget_s, later_floor_s=later_floor(name))
         if budget_s < 20.0:

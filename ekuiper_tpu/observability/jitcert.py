@@ -128,12 +128,19 @@ def _slot_dtypes() -> Tuple[str, ...]:
 
 
 def _state_leaves(comps: Dict[str, Tuple[int, int]], n_panes: int,
-                  capacity: int, lead: Optional[int] = None) -> List[str]:
+                  capacity: int, lead: Optional[int] = None,
+                  touch: bool = False) -> List[str]:
     """Signature leaves of a group-by state pytree: dict keys sort, `act`
     rides along; `comps` maps component -> (n_specs, wide_size-or-0);
-    `lead` prepends the multirule rule axis."""
+    `lead` prepends the multirule rule axis; `touch` appends the tiered
+    state's per-slot uint32 counter (ops/tierstore.py — key axis only,
+    no pane axis, sorts last among the state keys)."""
     parts: List[str] = []
-    for comp in sorted(list(comps) + ["act"]):
+    names = list(comps) + ["act"] + (["touch"] if touch else [])
+    for comp in sorted(names):
+        if comp == "touch":
+            parts.append(_arr("uint32", capacity))
+            continue
         if comp == "act":
             dims: Tuple[int, ...] = (n_panes, capacity)
         else:
@@ -191,6 +198,9 @@ class KernelShape:
     #: expression-IR column dtype overrides (KernelPlan.col_dtypes):
     #: int32 string-dict / ts32 columns change the fold leaves
     col_dtypes: Dict[str, str] = field(default_factory=dict)
+    #: tiered key state (ops/tierstore.py): the per-slot uint32 touch
+    #: column rides the state pytree of every site
+    touch: bool = False
 
 
 def _kernel_shape(kernel) -> KernelShape:
@@ -215,11 +225,12 @@ def _kernel_shape(kernel) -> KernelShape:
         col_dtypes={k: v for k, v in sorted(
             getattr(kernel.plan, "col_dtypes", {}).items())
             if v != "float32"},
+        touch=bool(getattr(kernel, "track_touch", False)),
     )
 
 
 def shape_from_plan(plan, n_panes: int, micro_batch: int,
-                    capacity: int) -> KernelShape:
+                    capacity: int, touch: bool = False) -> KernelShape:
     """KernelShape for a candidate rule's plan — no kernel construction,
     no jax import (QoS admission pricing path)."""
     from ..ops.aggspec import WIDE_COMPONENTS
@@ -241,6 +252,7 @@ def shape_from_plan(plan, n_panes: int, micro_batch: int,
                                for s in plan.specs),
         col_dtypes={k: v for k, v in sorted(
             getattr(plan, "col_dtypes", {}).items()) if v != "float32"},
+        touch=bool(touch),
     )
 
 
@@ -297,8 +309,12 @@ def _derive_fold(ks: KernelShape, op: str, rule: Optional[str],
                 ks.col_dtypes.items()))
             + " (KernelPlan.col_dtypes — __sd_* dict codes / __ts32_* "
             "rebased event time)")
+    if ks.touch:
+        deriv.append("tiered state: uint32[capacity] touch column in the "
+                     "state pytree (ops/tierstore.py)")
     for cap in _ladder(ks.base_capacity, grows):
-        state = _state_leaves(ks.comps, ks.n_panes, cap, ks.lead_rules)
+        state = _state_leaves(ks.comps, ks.n_panes, cap, ks.lead_rules,
+                              touch=ks.touch)
         for subset in subsets:
             cols = _col_leaves(ks.columns, ks.micro_batch, subset,
                                masks_always=sharded,
@@ -321,6 +337,7 @@ def _derive_fold(ks: KernelShape, op: str, rule: Optional[str],
                      "columns": ks.columns, "masked": masked,
                      "sharded": sharded, "lead_rules": ks.lead_rules,
                      "col_dtypes": dict(ks.col_dtypes),
+                     "touch": ks.touch,
                      "comps": {c: list(v) for c, v in ks.comps.items()}},
                     frozenset(sigs[:ENUM_CAP]), deriv, truncated,
                     full_count=full)
@@ -337,8 +354,12 @@ def _derive_boundary(ks: KernelShape, op: str, rule: Optional[str],
     shadow      — host-shadow components + scalar pane (absorb)."""
     sigs: List[str] = []
     deriv = [f"capacity ladder: {ks.base_capacity} x2^0..{grows}"]
+    if ks.touch:
+        deriv.append("tiered state: uint32[capacity] touch column in the "
+                     "state pytree (ops/tierstore.py)")
     for cap in _ladder(ks.base_capacity, grows):
-        state = _state_leaves(ks.comps, ks.n_panes, cap, ks.lead_rules)
+        state = _state_leaves(ks.comps, ks.n_panes, cap, ks.lead_rules,
+                              touch=ks.touch)
         if tail == "static_all":
             sigs.append(_sig(state + ["True"] * ks.n_panes))
         elif tail == "pane_mask":
@@ -370,7 +391,7 @@ def _derive_boundary(ks: KernelShape, op: str, rule: Optional[str],
     return SiteCert(op, rule, "_derive_boundary",
                     {"base_capacity": ks.base_capacity, "grows": grows,
                      "n_panes": ks.n_panes, "tail": tail,
-                     "lead_rules": ks.lead_rules,
+                     "lead_rules": ks.lead_rules, "touch": ks.touch,
                      "comps": {c: list(v) for c, v in ks.comps.items()}},
                     frozenset(sigs), deriv, len(sigs) > ENUM_CAP,
                     full_count=grows + 1)
@@ -423,7 +444,7 @@ def _derive_ring(ks: KernelShape, op: str, rule: Optional[str],
     ]
     for cap in _ladder(ks.base_capacity, grows):
         ring = _ring_leaves(ks.comps, cap, ring_slots)
-        pane = _state_leaves(ks.comps, ks.n_panes, cap)
+        pane = _state_leaves(ks.comps, ks.n_panes, cap, touch=ks.touch)
         if tail == "advance":
             t = [_arr("int32"), _arr("bool"), _arr("int32"), _arr("bool")]
         elif tail == "flip":
@@ -449,6 +470,57 @@ def _derive_ring(ks: KernelShape, op: str, rule: Optional[str],
                     {"base_capacity": ks.base_capacity, "grows": grows,
                      "ring_slots": ring_slots, "n_panes": ks.n_panes,
                      "tail": tail, "query_adj": QUERY_ADJ,
+                     "touch": ks.touch,
+                     "comps": {c: list(v) for c, v in ks.comps.items()}},
+                    frozenset(sigs), deriv, len(sigs) > ENUM_CAP,
+                    full_count=grows + 1)
+
+
+def _tier_packed_w(comps: Dict[str, Tuple[int, int]], n_panes: int) -> int:
+    """Packed-row width of the tier demote/promote block — mirrors
+    ops/tierstore.py TierStore.blocks exactly (sorted components'
+    per-pane blocks + the act block)."""
+    w = n_panes  # act
+    for _comp, (k, wide) in comps.items():
+        w += n_panes * k * (wide or 1)
+    return w
+
+
+def _derive_tier(ks: KernelShape, op: str, rule: Optional[str],
+                 demote_batch: int, tail: str,
+                 grows: int = MAX_GROWS) -> SiteCert:
+    """tierstore demote/promote (ops/tierstore.py): state pytree (touch
+    column included) over the capacity ladder, plus the plan-time-fixed
+    demote batch. `tail` is one of:
+    demote  — int32[D] slot vector (gather + identity reset),
+    promote — float32[D, W] packed rows + int32[D] slot vector
+              (scatter-merge, absorb's combine algebra)."""
+    packed_w = _tier_packed_w(ks.comps, ks.n_panes)
+    sigs: List[str] = []
+    deriv = [
+        f"capacity ladder: {ks.base_capacity} x2^0..{grows}",
+        f"demote batch fixed at plan time: D={demote_batch} "
+        "(ops/tierstore.py TierLayout; slot vectors pad with duplicate "
+        "real entries — identity under set/combine)",
+        f"packed row width W={packed_w}: sorted components' per-pane "
+        "blocks + the act block, C-order",
+    ]
+    for cap in _ladder(ks.base_capacity, grows):
+        state = _state_leaves(ks.comps, ks.n_panes, cap,
+                              touch=ks.touch)
+        if tail == "demote":
+            sigs.append(_sig(state + [_arr("int32", demote_batch)]))
+        elif tail == "promote":
+            sigs.append(_sig(
+                state + [_arr("float32", demote_batch, packed_w),
+                         _arr("int32", demote_batch)]))
+        else:  # pragma: no cover - derivation bug
+            raise ValueError(f"unknown tier tail {tail!r}")
+    return SiteCert(op, rule, "_derive_tier",
+                    {"base_capacity": ks.base_capacity, "grows": grows,
+                     "n_panes": ks.n_panes, "tail": tail,
+                     "demote_batch": demote_batch, "packed_w": packed_w,
+                     "touch": ks.touch,
                      "comps": {c: list(v) for c, v in ks.comps.items()}},
                     frozenset(sigs), deriv, len(sigs) > ENUM_CAP,
                     full_count=grows + 1)
@@ -544,12 +616,28 @@ def _sliding_ring_certs(kernel, rule: Optional[str]) -> List[SiteCert]:
     ]
 
 
+def _tier_certs(kernel, rule: Optional[str]) -> List[SiteCert]:
+    ks = _kernel_shape(kernel.gb)
+    # the tier store pins its OWN base capacity at registration (it is
+    # created alongside the group-by kernel, but battery/admission
+    # constructions may differ)
+    ks.base_capacity = int(getattr(kernel, "_jitcert_base_capacity",
+                                   kernel.capacity))
+    D = int(kernel.demote_batch)
+    return [
+        _derive_tier(ks, "tierstore.demote", rule, D, "demote"),
+        _derive_tier(ks, "tierstore.promote", rule, D, "promote"),
+    ]
+
+
 def certificates_for(kernel, rule: Optional[str] = None) -> List[SiteCert]:
     """Derive every certificate a kernel object's jit sites are bound by.
     Dispatches on the same `watch_prefix` devwatch attribution uses."""
     prefix = getattr(kernel, "watch_prefix", None)
     if prefix == "slidingring":
         return _sliding_ring_certs(kernel, rule)
+    if prefix == "tierstore":
+        return _tier_certs(kernel, rule)
     if prefix == "multirule":
         return _multirule_certs(kernel, rule)
     if prefix == "sharded":
@@ -597,6 +685,8 @@ SITE_DERIVATIONS: Dict[str, str] = {
     "slidingring.advance": "_derive_ring(advance)",
     "slidingring.flip": "_derive_ring(flip)",
     "slidingring.query": "_derive_ring(query)",
+    "tierstore.demote": "_derive_tier(demote)",
+    "tierstore.promote": "_derive_tier(promote)",
 }
 
 
@@ -764,7 +854,8 @@ def diff_live(max_findings: int = 64) -> Dict[str, Any]:
 # --------------------------------------------------- admission estimation
 def estimate_plan_signatures(plan, n_panes: int, micro_batch: int,
                              capacity: int,
-                             sliding_ring_slots: int = 0) -> int:
+                             sliding_ring_slots: int = 0,
+                             tier_demote_batch: int = 0) -> int:
     """Certified signature count a candidate device rule adds at its
     CONSTRUCTION capacity (growth steps respecialize later, paced by key
     cardinality, not admission) — the compile load admission prices
@@ -775,8 +866,12 @@ def estimate_plan_signatures(plan, n_panes: int, micro_batch: int,
     admitting the compile-heaviest rules while rejecting narrower
     ones. `sliding_ring_slots` > 0 prices a DABA sliding rule's extra
     surface (slidingring.advance/flip/query + the components_dyn
-    fallback) so the budget cannot under-price sliding candidates."""
-    ks = shape_from_plan(plan, n_panes, micro_batch, capacity)
+    fallback) so the budget cannot under-price sliding candidates;
+    `tier_demote_batch` > 0 prices a tiered rule's demote/promote sites
+    (the touch column changes every state signature, so the whole shape
+    is derived with it)."""
+    ks = shape_from_plan(plan, n_panes, micro_batch, capacity,
+                         touch=tier_demote_batch > 0)
     certs = [
         _derive_fold(ks, "groupby.fold", None, grows=0),
         _derive_boundary(ks, "groupby.finalize", None, "static_all",
@@ -799,4 +894,9 @@ def estimate_plan_signatures(plan, n_panes: int, micro_batch: int,
                          ("slidingring.query", "query")):
             certs.append(_derive_ring(ks, op, None, sliding_ring_slots,
                                       tail, grows=0))
+    if tier_demote_batch > 0 and not ks.host_finalize_only:
+        certs.append(_derive_tier(ks, "tierstore.demote", None,
+                                  tier_demote_batch, "demote", grows=0))
+        certs.append(_derive_tier(ks, "tierstore.promote", None,
+                                  tier_demote_batch, "promote", grows=0))
     return sum(c.full_count for c in certs)

@@ -220,6 +220,11 @@ def render(rule_registry) -> str:
     devwatch.render_prometheus(out, _esc)
     kernwatch.render_prometheus(out, _esc)
     memwatch.render_prometheus(out, _esc)
+    # tiered key state (ops/tierstore.py): demote/promote counters,
+    # cold-tier residency and host arena bytes per tiered rule
+    from ..ops import tierstore
+
+    tierstore.render_prometheus(out, _esc)
     # expression host fallbacks (sql/compiler.py counters): plan-time
     # count of expressions routed to the row interpreter, by structured
     # NotVectorizable reason — the metric the health plane's bottleneck

@@ -36,6 +36,9 @@ _INIT = {
     # wide (register-axis) components: HLL registers, log-histogram bins,
     # heavy-hitters group-testing counters
     "hll": 0.0, "hist": 0.0, "hh": 0.0,
+    # per-slot touch counter (tiered key state, ops/tierstore.py):
+    # uint32, shape (capacity,) — NOT pane-scoped, survives pane resets
+    "touch": 0,
 }
 
 _WIDE_SIZE = {}  # filled lazily from sketches to avoid import cycle
@@ -114,6 +117,7 @@ class DeviceGroupBy:
         capacity: int = 16384,
         n_panes: int = 1,
         micro_batch: int = 4096,
+        track_touch: bool = False,
     ) -> None:
         import jax
 
@@ -121,6 +125,10 @@ class DeviceGroupBy:
         self.capacity = int(capacity)
         self.n_panes = int(n_panes)
         self.micro_batch = int(micro_batch)
+        # tiered key state (ops/tierstore.py): a per-slot uint32 touch
+        # counter rides the state pytree and is bumped inside the fold —
+        # the placement policy's recency/frequency signal, no host sync
+        self.track_touch = bool(track_touch)
         # component -> ordered spec indices holding a column in that array
         self.comp_specs: Dict[str, List[int]] = {}
         for i, spec in enumerate(plan.specs):
@@ -215,6 +223,8 @@ class DeviceGroupBy:
             state[comp] = jnp.full(shape, _INIT[comp], dtype=jnp.float32)
         # activity: rows per key per pane (post-WHERE), for group existence
         state["act"] = jnp.zeros((self.n_panes, self.capacity), dtype=jnp.float32)
+        if self.track_touch:
+            state["touch"] = jnp.zeros((self.capacity,), dtype=jnp.uint32)
         return state
 
     def grow(self, state: Dict[str, Any], new_capacity: int) -> Dict[str, Any]:
@@ -225,14 +235,18 @@ class DeviceGroupBy:
 
         out: Dict[str, Any] = {}
         for comp, arr in state.items():
+            # the touch column is (capacity,), not pane-scoped — the key
+            # axis is axis 0 there, axis 1 everywhere else
+            key_axis = 0 if comp == "touch" else 1
             if isinstance(arr, np.ndarray):  # host-restored state
                 pad_shape = list(arr.shape)
-                pad_shape[1] = new_capacity - arr.shape[1]
+                pad_shape[key_axis] = new_capacity - arr.shape[key_axis]
                 pad = np.full(pad_shape, _INIT[comp], dtype=arr.dtype)
-                out[comp] = jnp.asarray(np.concatenate([arr, pad], axis=1))
+                out[comp] = jnp.asarray(
+                    np.concatenate([arr, pad], axis=key_axis))
                 continue
             pad_width = [(0, 0)] * arr.ndim
-            pad_width[1] = (0, new_capacity - arr.shape[1])
+            pad_width[key_axis] = (0, new_capacity - arr.shape[key_axis])
             out[comp] = jnp.pad(arr, pad_width,
                                 constant_values=_INIT[comp])
         self.capacity = new_capacity
@@ -362,6 +376,13 @@ class DeviceGroupBy:
         state["act"] = state["act"].at[pane_idx, slots].add(
             base.astype(jnp.float32)
         )
+        if "touch" in state:
+            # tier placement signal (ops/tierstore.py): per-slot touched-
+            # row count, cumulative — the policy worker diffs successive
+            # async fetches for recency/frequency, so the fold itself
+            # never syncs
+            state["touch"] = state["touch"].at[slots].add(
+                base.astype(jnp.uint32))
         per_spec: List[Tuple[Any, Any]] = []
         for spec in self.plan.specs:
             if spec.arg is None:
@@ -707,6 +728,8 @@ class DeviceGroupBy:
     # ----------------------------------------------------------------- absorb
     def _absorb_impl(self, state, sh, pane_idx):
         for comp in list(state.keys()):
+            if comp not in sh:
+                continue  # touch column: shadows carry no policy state
             arr = state[comp]
             u = sh[comp]
             if comp == "mn":
@@ -741,6 +764,8 @@ class DeviceGroupBy:
         import jax.numpy as jnp
 
         for comp in list(state.keys()):
+            if comp == "touch":
+                continue  # per-slot recency survives pane expiry
             init = _INIT[comp]
             arr = state[comp]
             state[comp] = arr.at[pane_idx].set(jnp.full(arr.shape[1:], init, dtype=arr.dtype))
@@ -767,3 +792,24 @@ class DeviceGroupBy:
         import jax.numpy as jnp
 
         return {k: jnp.asarray(v) for k, v in host.items()}
+
+    def host_from_partials(
+        self, partials: Dict[str, Any],
+    ) -> Tuple[Dict[str, np.ndarray], int]:
+        """Checkpoint partials -> (typed host arrays, capacity): THE one
+        place knowing the per-component restore dtypes (float32 except
+        the uint32 touch column) and reconciling the touch leaf against
+        this kernel's track_touch (zero-fill a pre-tier checkpoint,
+        drop the column for an untiered kernel — the certs here carry
+        no touch leaf). Shared by the fused node and the pane store."""
+        host = {k: np.asarray(v, dtype=(np.uint32 if k == "touch"
+                                        else np.float32))
+                for k, v in partials.items()}
+        cap = host["act"].shape[1] if "act" in host else \
+            next(iter(host.values())).shape[1]
+        if self.track_touch:
+            if "touch" not in host:
+                host["touch"] = np.zeros(cap, dtype=np.uint32)
+        else:
+            host.pop("touch", None)
+        return host, cap

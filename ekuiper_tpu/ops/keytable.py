@@ -44,6 +44,13 @@ class KeyTable:
         self._ntab = None
         self._native_n = 0  # python keys already mirrored into the native tab
         self._native_ok = True
+        # tiered key state (ops/tierstore.py): retired (demoted) slots
+        # recycle through this free list instead of forcing capacity
+        # growth; `track_new` turns on the new-key log the tier manager
+        # drains at the slot-encode admission point
+        self._free: List[int] = []
+        self.track_new = False
+        self._new_log: List[Tuple[Any, int]] = []
 
     # -------------------------------------------------------------- native
     def _native_encode(self, lst: list) -> Optional[Tuple[np.ndarray, bool]]:
@@ -79,6 +86,9 @@ class KeyTable:
             ids.update(zip(appendix, range(start, start + len(appendix))))
             self._keys.extend(appendix)
             self._native_n = len(self._keys)
+            if self.track_new:
+                self._new_log.extend(
+                    zip(appendix, range(start, start + len(appendix))))
         grew = False
         while len(self._keys) > self.capacity:
             self.capacity *= 2
@@ -135,10 +145,13 @@ class KeyTable:
         # overwhelmingly common GROUP BY key shape — never do.
         keys = self._keys
         missing = dict.fromkeys(k for k in lst if k not in ids)
-        if all(type(k) is str for k in missing):
+        if all(type(k) is str for k in missing) and not self._free:
             start = len(keys)
             ids.update(zip(missing, range(start, start + len(missing))))
             keys.extend(missing)
+            if self.track_new:
+                self._new_log.extend(
+                    zip(missing, range(start, start + len(missing))))
         else:
             for k in missing:
                 if k in ids:
@@ -146,9 +159,7 @@ class KeyTable:
                 norm = self._normalize(k)
                 slot = ids.get(norm)
                 if slot is None:
-                    slot = len(keys)
-                    ids[norm] = slot
-                    keys.append(norm)
+                    slot = self._assign_slot(norm)
                 if norm is not k:
                     ids[k] = slot  # alias raw form (None / tuple with None)
         out = np.fromiter(map(ids.__getitem__, lst), dtype=np.int32, count=n)
@@ -165,6 +176,48 @@ class KeyTable:
         if isinstance(k, tuple):
             return tuple("" if v is None else v for v in k)
         return k
+
+    def _assign_slot(self, k: Any) -> int:
+        """Assign a dense slot to a NEW key: a recycled free slot when
+        one exists (tiered demotion freed it), else the next append —
+        capacity growth stays the last resort."""
+        if self._free:
+            slot = self._free.pop()
+            self._keys[slot] = k
+        else:
+            slot = len(self._keys)
+            self._keys.append(k)
+        self._ids[k] = slot
+        if self.track_new:
+            self._new_log.append((k, slot))
+        return slot
+
+    # --------------------------------------------------- tiered key state
+    def retire(self, slots: Sequence[int], keys: Sequence[Any]) -> None:
+        """Demote keys out of the table: their slots join the free list
+        and recycle to future new keys. The native mirror cannot
+        represent holes, so retirement pins this table to the Python
+        path. Callers must pass the keys currently holding the slots
+        (the tier manager re-validates via decode before demoting)."""
+        self._native_ok = False
+        for slot, key in zip(slots, keys):
+            if self._keys[slot] != key:
+                continue  # raced a re-encode; leave the slot live
+            self._ids.pop(key, None)
+            self._keys[slot] = None
+            self._free.append(slot)
+        self._approx_bytes_cache = None
+
+    def drain_new_keys(self) -> List[Tuple[Any, int]]:
+        """(key, slot) pairs assigned since the last drain — the tier
+        manager's admission signal (only NEW keys can be returning
+        demoted keys, so the store lookup is bounded by this log, not
+        the batch)."""
+        out, self._new_log = self._new_log, []
+        return out
+
+    def free_slots(self) -> List[int]:
+        return list(self._free)
 
     def _encode_sorted(self, col: np.ndarray) -> Tuple[np.ndarray, bool]:
         """Sort-based encode for numeric/unicode columns and object columns
@@ -213,9 +266,7 @@ class KeyTable:
                 k = repr(k)
                 slot = ids.get(k)
             if slot is None:
-                slot = len(keys)
-                ids[k] = slot
-                keys.append(k)
+                slot = self._assign_slot(k)
             uids[i] = slot
         grew = False
         while len(keys) > self.capacity:
@@ -261,6 +312,8 @@ class KeyTable:
             return cached[1]
         key_bytes = 0
         for k in self._keys:
+            if k is None:
+                continue  # retired slot (tiered demotion hole)
             if type(k) is str:
                 key_bytes += 56 + len(k)  # CPython str header + payload
             elif isinstance(k, tuple):
@@ -293,14 +346,22 @@ class KeyTable:
         self._ntab = None
         self._native_n = 0
         self._native_ok = True
+        self._free.clear()
+        self._new_log.clear()
 
     def restore(self, keys: List[Any]) -> None:
         """Rebuild in the exact slot order of a checkpoint (slot ids index
         the saved device partials, so order must be preserved). The native
-        mirror re-syncs lazily via the catch-up in _native_encode."""
+        mirror re-syncs lazily via the catch-up in _native_encode. A None
+        entry is a retired (tiered-demotion) hole: the slot rejoins the
+        free list; None is never a live key (nil keys normalize to "")."""
         self.clear()
         for i, k in enumerate(keys):
-            self._ids[k] = i
             self._keys.append(k)
+            if k is None:
+                self._free.append(i)
+                self._native_ok = False
+            else:
+                self._ids[k] = i
         while len(self._keys) > self.capacity:
             self.capacity *= 2

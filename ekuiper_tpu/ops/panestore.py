@@ -161,13 +161,46 @@ class PaneStore:
     which subset)."""
 
     def __init__(self, plan: KernelPlan, pane_ms: int, n_panes: int,
-                 capacity: int = 16384, micro_batch: int = 4096) -> None:
+                 capacity: int = 16384, micro_batch: int = 4096,
+                 tier_budget_mb: Optional[float] = None) -> None:
         self.plan = plan
         self.pane_ms = int(pane_ms)
         self.n_panes = int(n_panes)
+        # tiered key state (ops/tierstore.py): the shared store recycles
+        # slots of QUIESCENT keys only (a cold key's pane data expires
+        # with the ring, so no member window ever misses it); budget
+        # defaults to the engine-wide KUIPER_HBM_BUDGET_MB the QoS
+        # ledger prices against. Slot recycling breaks the neutral
+        # table's dense-order contract — SharedFoldNode self-encodes
+        # when the tier is live.
+        if tier_budget_mb is None:
+            from .tierstore import env_hbm_budget_mb
+
+            tier_budget_mb = env_hbm_budget_mb()
+        layout = None
+        if tier_budget_mb and not any(s.kind == "heavy_hitters"
+                                      for s in plan.specs):
+            from .tierstore import plan_tier_layout
+
+            layout = plan_tier_layout(plan, self.n_panes, capacity,
+                                      float(tier_budget_mb),
+                                      window_ms=self.pane_ms)
         self.gb = DeviceGroupBy(plan, capacity=capacity, n_panes=self.n_panes,
-                                micro_batch=micro_batch)
+                                micro_batch=micro_batch,
+                                track_touch=layout is not None)
         self.kt = KeyTable(self.gb.capacity)
+        self.tier = None
+        if layout is not None:
+            from .tierstore import TierManager
+
+            self.tier = TierManager(
+                self.gb, self.kt, layout, rule_id="__shared__",
+                quiescent_only=True,
+                # quiescent must mean EXPIRED: idle across the whole
+                # pane ring, so no member's open window still holds the
+                # key's data (a shorter idle gate would demote keys a
+                # hopping member is about to emit)
+                min_idle_ms=self.pane_ms * self.n_panes)
         self.state = self.gb.init_state()
         self._dtypes_seen = False
         # HBM accounting: the shared pane ring serves N rules but is ONE
@@ -192,6 +225,11 @@ class PaneStore:
             self._dtypes_seen = True
         if self.gb.capacity < self.kt.capacity:
             self.state = self.gb.grow(self.state, self.kt.capacity)
+        if self.tier is not None:
+            # admission point: returning demoted keys promote before the
+            # batch folds (quiescent-only demotion → promoted rows are
+            # identity, this re-seats the key's slot bookkeeping)
+            self.state = self.tier.admit(self.state)
         self.state = self.gb.fold(self.state, cols, slots, valid, pane_arg,
                                   n_rows=n_rows)
 
@@ -205,6 +243,12 @@ class PaneStore:
 
     def reset_pane(self, pane: int) -> None:
         self.state = self.gb.reset_pane(self.state, int(pane))
+        if self.tier is not None:
+            # pane boundary: epoch bump + demote-plan apply + touch scan
+            # (inline — the shared store has no dedicated worker; the
+            # scan cadence keeps it off the per-batch path)
+            self.tier.note_pane_reset(int(pane))
+            self.state = self.tier.on_boundary(self.state)
 
     # ---------------------------------------------------------------- warmup
     def warmup(self) -> None:
@@ -228,12 +272,15 @@ class PaneStore:
     # ------------------------------------------------------------ checkpoint
     def snapshot(self) -> Dict:
         host = self.gb.state_to_host(self.state)
-        return {
+        snap = {
             "keys": self.kt.decode_all(),
             "partials": {k: v.tolist() for k, v in host.items()},
             "pane_ms": self.pane_ms,
             "n_panes": self.n_panes,
         }
+        if self.tier is not None:
+            snap["tier"] = self.tier.snapshot()
+        return snap
 
     def restore(self, snap: Dict) -> None:
         if int(snap.get("pane_ms", self.pane_ms)) != self.pane_ms or \
@@ -246,9 +293,9 @@ class PaneStore:
         self.kt.restore([tuple(k) if isinstance(k, list) else k for k in keys])
         partials = snap.get("partials")
         if partials:
-            host = {k: np.asarray(v, dtype=np.float32)
-                    for k, v in partials.items()}
-            cap = next(iter(host.values())).shape[1]
+            host, cap = self.gb.host_from_partials(partials)
             self.gb.capacity = cap
             self.kt.capacity = max(self.kt.capacity, cap)
             self.state = self.gb.state_from_host(host)
+        if self.tier is not None and snap.get("tier"):
+            self.tier.restore(snap["tier"])

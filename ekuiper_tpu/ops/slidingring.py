@@ -52,7 +52,12 @@ from .groupby import _INIT, DeviceGroupBy
 
 #: components whose pane combine is elementwise addition — these take the
 #: subtract-on-evict fast path (one running total, no suffix stack)
-ADD_COMBINE = frozenset({"n", "s1", "s2", "hist", "hh", "act"})
+#: "touch" never materializes ring partials (it rides the pane state
+#: pytree, not the ring — comp_specs never contains it); it is listed so
+#: the combine classification stays TOTAL over groupby._INIT, which the
+#: guardrail test (test_sliding_ring.py combine-classes-are-total)
+#: enforces for every state component
+ADD_COMBINE = frozenset({"n", "s1", "s2", "hist", "hh", "act", "touch"})
 #: min-merge components (two-stack discipline; subtraction undefined)
 MIN_COMBINE = frozenset({"mn"})
 #: max-merge components (two-stack discipline; hll registers merge by max)

@@ -72,6 +72,27 @@ class RuleDef:
         return out
 
 
+def resolve_tier_budget_mb(opts: RuleOptionConfig) -> float:
+    """The HBM budget (MB) driving a rule's tiered key-state placement
+    (ops/tierstore.py): `tierHotMb` when set, else the engine-wide
+    KUIPER_HBM_BUDGET_MB the QoS admission ledger already prices
+    against; 0 disables. `tierStore="on"` without any budget is a plan
+    error — a forced tier with no budget has no hot target."""
+    mode = (opts.tier_store or "auto").lower()
+    if mode == "off":
+        return 0.0
+    from ..ops.tierstore import env_hbm_budget_mb
+
+    budget = float(opts.tier_hot_mb or 0)
+    if budget <= 0:
+        budget = env_hbm_budget_mb()
+    if mode == "on" and budget <= 0:
+        raise PlanError(
+            "tierStore=on needs a budget: set tierHotMb or "
+            "KUIPER_HBM_BUDGET_MB")
+    return max(budget, 0.0)
+
+
 def merged_options(rule: RuleDef) -> RuleOptionConfig:
     base = get_config().rule
     opts = RuleOptionConfig(**{**base.__dict__})
@@ -94,6 +115,9 @@ def merged_options(rule: RuleDef) -> RuleOptionConfig:
         "slidingDevRingMb": "sliding_dev_ring_mb",
         "slidingImpl": "sliding_impl",
         "sharedFold": "shared_fold",
+        "tierStore": "tier_store",
+        "tierHotMb": "tier_hot_mb",
+        "tierScanMs": "tier_scan_ms",
     }
     for k, v in rule.options.items():
         key = alias.get(k, k)
@@ -881,6 +905,15 @@ def _build_device_chain(
         ring_layout = ring_layout_for(
             stmt.window, kernel_plan, capacity=opts.key_slots,
             budget_mb=opts.sliding_dev_ring_mb)
+    # tiered key state (ops/tierstore.py): resolve the HBM budget that
+    # drives the hot/cold placement at PLAN time. Gated off for shapes
+    # where spilled-group emission can't ride the direct tail (ORDER BY /
+    # LIMIT order across the device+spilled split) and for mesh kernels;
+    # the node itself gates window types and heavy_hitters.
+    tier_budget_mb = resolve_tier_budget_mb(opts)
+    if tier_budget_mb and (stmt.sorts or stmt.limit is not None
+                           or mesh is not None):
+        tier_budget_mb = 0.0
     fused = FusedWindowAggNode(
         "window_agg", stmt.window, kernel_plan, dims,
         capacity=opts.key_slots, micro_batch=opts.micro_batch_rows,
@@ -894,6 +927,8 @@ def _build_device_chain(
         dev_ring_budget_mb=opts.sliding_dev_ring_mb,
         sliding_impl=opts.sliding_impl,
         ring_layout=ring_layout,
+        tier_budget_mb=tier_budget_mb,
+        tier_scan_ms=opts.tier_scan_ms,
     )
     topo.add_op(fused)
     # hand the kernel-input shape to the source's ingest prep at PLAN time
@@ -908,6 +943,13 @@ def _build_device_chain(
         # (whose pre-padded buffers the _dev_ring must own for trigger-time
         # mask refolds) — a prep upload would be a second, unused copy
         reg(fused.prep_spec())
+    if fused.tier is not None:
+        # async prefetch: the decode pool's ordered drainer spots
+        # returning demoted keys in batch k+1 and starts their packed
+        # rows' H2D copy while batch k still folds (runtime/ingest.py)
+        reg2 = getattr(src, "register_tier_prefetch", None)
+        if reg2 is not None:
+            reg2(fused.tier.prefetch)
     if opts.is_event_time:
         # event-time: watermark generation + late drop feeds the kernel's
         # per-row pane routing (columnar all the way)

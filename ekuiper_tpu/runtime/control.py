@@ -127,6 +127,62 @@ def _env_float(name: str, default: float = 0.0) -> float:
         return default
 
 
+def _tier_price_slots(price: Dict[str, Any], plan, stmt, opts) -> int:
+    """Hot-set slot claim for a tiered candidate (0 = untiered).
+    Mirrors the planner's eligibility gates (planner/planner.py
+    _build_device_chain + the fused node's window-type gate) so
+    admission prices exactly what would be built; memoized in
+    price["tier"] so the signature pricing and the HBM projection read
+    one decision."""
+    cached = price.get("tier")
+    if cached is not None:
+        return int(cached.get("hot_slots", 0))
+    price["tier"] = {}
+    try:
+        from ..ops.tierstore import plan_tier_layout
+        from ..planner.planner import resolve_tier_budget_mb
+        from ..sql import ast as _ast
+
+        budget = resolve_tier_budget_mb(opts)
+        w = stmt.window
+        if (not budget or stmt.sorts or stmt.limit is not None
+                or (opts.plan_optimize_strategy or {}).get("mesh")
+                or w is None
+                or w.window_type not in (_ast.WindowType.TUMBLING_WINDOW,
+                                         _ast.WindowType.HOPPING_WINDOW,
+                                         _ast.WindowType.SLIDING_WINDOW)
+                or any(s.kind == "heavy_hitters" for s in plan.specs)):
+            return 0
+        # the SAME pane count the node derives — a hopping rule's
+        # per-key state is n_panes times wider, and pricing with 1 pane
+        # would disagree with the node about whether the tier even
+        # engages (unpriced tier jit sites / over-claimed HBM)
+        if w.window_type == _ast.WindowType.HOPPING_WINDOW:
+            iv = max(w.interval_ms() or 0, 1)
+            n_panes = max((w.length_ms() + iv - 1) // iv, 1)
+        elif w.window_type == _ast.WindowType.SLIDING_WINDOW:
+            from ..ops.slidingring import ring_layout_for
+
+            n_panes = ring_layout_for(w, plan).n_panes
+        else:
+            n_panes = 1
+        layout = plan_tier_layout(
+            plan, int(n_panes), opts.key_slots, budget,
+            scan_interval_ms=opts.tier_scan_ms,
+            window_ms=w.interval_ms() or w.length_ms())
+        if layout is None:
+            return 0
+        # the node builds at the pow2-capped hot target (nodes_fused.py
+        # uses the SAME TierLayout.hot_capacity) — price exactly that,
+        # never more than the untiered request
+        claim = min(int(opts.key_slots), layout.hot_capacity())
+        price["tier"] = {"hot_slots": claim,
+                        "demote_batch": int(layout.demote_batch)}
+        return claim
+    except Exception:
+        return 0
+
+
 def price_rule(rule, store) -> Dict[str, Any]:
     """Price a candidate rule off the live cost model + telemetry.
     Degrades per component — a rule the planner cannot price (graph
@@ -232,8 +288,12 @@ def price_rule(rule, store) -> Dict[str, Any]:
                     price["certified_new_signatures"] = \
                         jitcert.estimate_plan_signatures(
                             plan, 1, opts.micro_batch_rows,
-                            opts.key_slots,
-                            sliding_ring_slots=ring_slots)
+                            _tier_price_slots(price, plan, stmt, opts)
+                            or opts.key_slots,
+                            sliding_ring_slots=ring_slots,
+                            tier_demote_batch=(
+                                price.get("tier", {})
+                                .get("demote_batch", 0)))
                 except Exception as exc:
                     # leave the UNKNOWN sentinel: failing open here
                     # would both disarm the signature budget and route
@@ -245,9 +305,15 @@ def price_rule(rule, store) -> Dict[str, Any]:
                     price["certify_error"] = str(exc)[:200]
             # projected window-state claim: one f32 slot per key per agg
             # spec, times the pane/staging multiplier (documented in
-            # docs/RESILIENCE.md — a bound, not an allocation)
+            # docs/RESILIENCE.md — a bound, not an allocation). A TIERED
+            # rule claims its HOT-SET footprint, not its full
+            # cardinality: cold keys spill to host, so a high-cardinality
+            # rule whose hot set fits is admitted where the untiered
+            # projection would 429 it.
+            slot_claim = (_tier_price_slots(price, plan, stmt, opts)
+                          or opts.key_slots)
             price["hbm_projected_bytes"] = int(
-                opts.key_slots * max(n_specs, 1) * 4 * HBM_PANE_FACTOR)
+                slot_claim * max(n_specs, 1) * 4 * HBM_PANE_FACTOR)
             if share:
                 price["sharing"] = {
                     "decision": share.get("decision"),

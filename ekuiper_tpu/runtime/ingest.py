@@ -356,6 +356,10 @@ class IngestPrepCtx:
         # (expr_tag, micro_batch) -> DerivedCol tuple (expression-IR
         # prep columns pre-encoded + pre-uploaded by the pool)
         self._derived: Dict[Tuple[str, int], tuple] = {}
+        # tiered key state (ops/tierstore.py): prefetch hooks that spot
+        # returning demoted keys in a decoding batch and start their
+        # packed rows' H2D copy a batch early
+        self._tier_hooks: List[Any] = []
         # telemetry: batches/columns pre-uploaded by the pool (bench + tests)
         self.n_precomputed = 0
         self.n_precomputed_cols = 0
@@ -399,6 +403,13 @@ class IngestPrepCtx:
                 tag, dcols = derived
                 self._derived[(tag, int(micro_batch))] = tuple(dcols)
 
+    def register_tier_prefetch(self, fn) -> None:
+        """A tiered fused consumer's prefetch hook (TierManager.prefetch)
+        — run per batch by precompute(), best-effort."""
+        with self.lock:
+            if fn not in self._tier_hooks:
+                self._tier_hooks.append(fn)
+
     def precompute(self, batch) -> int:
         """Build padded device inputs for `batch` under the fused node's
         share keys. Returns the number of device arrays created. Failures
@@ -408,7 +419,18 @@ class IngestPrepCtx:
         with self.lock:
             specs = [(k, set(v)) for k, v in self._specs.items()]
             derived = list(self._derived.items())
-        if (not specs and not derived) or getattr(batch, "n", 0) == 0:
+            tier_hooks = list(self._tier_hooks)
+        if getattr(batch, "n", 0) == 0:
+            return 0
+        for hook in tier_hooks:
+            # tiered prefetch: start returning demoted keys' packed-row
+            # H2D early; a failure only loses the overlap — admit()
+            # uploads inline exactly as without prefetch
+            try:
+                hook(batch)
+            except Exception as exc:
+                logger.warning("tier prefetch failed: %s", exc)
+        if not specs and not derived:
             return 0
         try:
             import jax.numpy as jnp  # noqa: F401 — availability probe

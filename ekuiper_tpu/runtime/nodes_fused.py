@@ -86,6 +86,8 @@ class FusedWindowAggNode(Node):
         dev_ring_budget_mb: int = 256,  # sliding device-state HBM cap
         sliding_impl: str = "daba",  # "daba" rings | "refold" legacy path
         ring_layout=None,  # ops.slidingring.RingLayout chosen at plan time
+        tier_budget_mb: float = 0.0,  # tiered key state HBM budget (0=off)
+        tier_scan_ms: int = 0,  # tier placement cadence (0=window-derived)
         **kw,
     ) -> None:
         super().__init__(name, op_type="op", **kw)
@@ -271,6 +273,34 @@ class FusedWindowAggNode(Node):
         self._hh_overflow_warned: set = set()
         if self._hh_cols and capacity > 2048:
             capacity = 2048
+        # tiered key state (ops/tierstore.py, docs/TIERED_STATE.md):
+        # geometry chosen here at plan/construction time from the HBM
+        # budget and the actual pane count, like the sliding ring layout.
+        # Eligible shapes: tumbling/hopping (processing or event time —
+        # spilled per-pane partials stay exact across demotion windows)
+        # and sliding (quiescent-only demotion). heavy_hitters plans and
+        # mesh kernels keep the untiered path.
+        self.tier = None
+        self._tier_layout = None
+        if tier_budget_mb and mesh is None and not self._hh_cols and \
+                self.wt in (ast.WindowType.TUMBLING_WINDOW,
+                            ast.WindowType.HOPPING_WINDOW,
+                            ast.WindowType.SLIDING_WINDOW):
+            from ..ops.tierstore import plan_tier_layout
+
+            self._tier_layout = plan_tier_layout(
+                plan, int(self.n_panes), capacity, float(tier_budget_mb),
+                scan_interval_ms=int(tier_scan_ms),
+                window_ms=self.interval_ms or self.length_ms)
+            if self._tier_layout is not None:
+                # the cold tier pins resident keys at the hot target, so
+                # every per-capacity allocation (group-by state, sliding
+                # rings — what lets a wide-hll rule keep DABA inside
+                # slidingDevRingMb) builds at the capped capacity;
+                # growth past it stays possible but becomes the last
+                # resort the recycler works to avoid
+                capacity = min(capacity,
+                               self._tier_layout.hot_capacity())
         self.gb = self._make_gb(plan, capacity, micro_batch, mesh)
         # sliding implementation: DABA rings by default (constant-time
         # trigger emission, ops/slidingring.py), the legacy refold path as
@@ -282,9 +312,31 @@ class FusedWindowAggNode(Node):
             self.sliding_impl = self._choose_sliding_impl(sliding_impl)
         # sharded path may round capacity up for even shard division
         self.kt = KeyTable(self.gb.capacity)
+        if self._tier_layout is not None and \
+                getattr(self.gb, "track_touch", False):
+            from ..ops.tierstore import TierManager
+
+            key_name = (dims[0].name if len(dims) == 1
+                        and getattr(dims[0], "name", None) else None)
+            sliding = self.wt == ast.WindowType.SLIDING_WINDOW
+            self.tier = TierManager(
+                self.gb, self.kt, self._tier_layout,
+                rule_id=rule_id, key_name=key_name,
+                submit=self._tier_submit,
+                # sliding demotes only quiescent keys: idle past the whole
+                # ring/row retention, so no pane, ring partial, or host
+                # ring row still references the recycled slot
+                quiescent_only=sliding,
+                min_idle_ms=((self.n_ring_panes + 10) * self.bucket_ms
+                             if sliding else 0),
+                on_tier_event=self._on_tier_event)
+        else:
+            self._tier_layout = None  # kernel form ineligible (multirule)
         # shared-source fan-out slot reuse: None = undecided, True = our kt
-        # mirrors the subtopo's neutral table, False = self-encode forever
-        self._shared_slots_ok = None
+        # mirrors the subtopo's neutral table, False = self-encode forever.
+        # Tiered slot recycling breaks the neutral table's dense
+        # insertion-order contract, so tiered rules always self-encode.
+        self._shared_slots_ok = None if self.tier is None else False
         self._shared_nkt = None  # the neutral table our slots come from
         self._prep_registered = False  # upload spec handed to the prep ctx
         self.state = None
@@ -396,6 +448,9 @@ class FusedWindowAggNode(Node):
         )
         self._emit_q = None
         self._emit_worker = None
+        # worker-installed slot->key decode pin for deferred deliveries
+        # (tiered slot recycling; see _keys_snapshot)
+        self._kt_keys_override = None
         # telemetry: the last boundary found no landed device fetch
         self._storm = False
         # per-boundary record: {"source": "device"|"backstop"|"sync",
@@ -417,6 +472,7 @@ class FusedWindowAggNode(Node):
         return DeviceGroupBy(
             plan, capacity=capacity, n_panes=int(self.n_panes),
             micro_batch=micro_batch,
+            track_touch=getattr(self, "_tier_layout", None) is not None,
         )
 
     # --------------------------------------------------------------- lifecycle
@@ -516,6 +572,13 @@ class FusedWindowAggNode(Node):
 
                 hs = HostShadow(self.plan, self.gb.comp_specs, self.gb.capacity)
                 dummy = self.gb.absorb(dummy, hs.data, 0)
+            if self.tier is not None:
+                # compile the demote/promote sites so the first boundary
+                # with a plan doesn't pay the jit stall
+                dummy, pk = self.tier.ts.demote(
+                    dummy, np.zeros(1, dtype=np.int32))
+                dummy = self.tier.ts.promote(
+                    dummy, np.asarray(pk)[:1], np.zeros(1, dtype=np.int32))
             self.gb.reset_pane(dummy, self.cur_pane)
         except Exception as exc:
             logger.debug("fused warmup failed (non-fatal): %s", exc)
@@ -903,6 +966,11 @@ class FusedWindowAggNode(Node):
             if self.gb.capacity < self.kt.capacity:
                 # deferred grow (keys first seen in an earlier frozen span)
                 self.state = self.gb.grow(self.state, self.kt.capacity)
+            if self.tier is not None:
+                # admission point: returning demoted keys (this batch's
+                # new-key log) get their spilled partials merged back
+                # into their fresh slots before the fold lands
+                self.state = self.tier.admit(self.state)
             dev = self._shared_device_inputs(sub, cols, valid, slots)
         t1 = _time.perf_counter()
         self.stats.observe_stage("upload", (t1 - t0) * 1e6, sub.n)
@@ -1015,10 +1083,10 @@ class FusedWindowAggNode(Node):
         window_buckets = range(b - W + 1, b + 1)
         has_data = any(x in self._dirty for x in window_buckets)
         n_keys = self.kt.n_keys
+        end_ms = (b + 1) * self.bucket_ms
+        wr = WindowRange(end_ms - self.length_ms, end_ms)
+        panes = sorted({(x % self.n_panes) for x in window_buckets})
         if has_data and n_keys:
-            end_ms = (b + 1) * self.bucket_ms
-            wr = WindowRange(end_ms - self.length_ms, end_ms)
-            panes = sorted({(x % self.n_panes) for x in window_buckets})
             outs, act = self.gb.finalize(self.state, n_keys, panes=panes)
             active = np.nonzero(act > 0)[0]
             if len(active):
@@ -1026,11 +1094,14 @@ class FusedWindowAggNode(Node):
                     self._emit_direct(outs, active, wr)
                 else:
                     self._emit_grouped(outs, active, wr)
+        # spilled keys demoted with data in this window's buckets emit
+        # host-side (their pane epochs gate validity)
+        self._emit_tier_extras(wr, panes=panes)
         expiring = b - W + 1
         if expiring in self._dirty:
             self._dirty.discard(expiring)
-            self.state = self.gb.reset_pane(
-                self.state, expiring % self.n_panes)
+            self._reset_pane_tiered(expiring % self.n_panes)
+        self._tier_boundary()
         self._next_emit_bucket = b + 1
 
     def on_watermark(self, wm) -> None:
@@ -1253,6 +1324,19 @@ class FusedWindowAggNode(Node):
             self.gb._hh_fin(self.state,
                             np.ones(self.gb.n_panes, dtype=np.bool_)), wr)
 
+    def _keys_snapshot(self):
+        """Slot->key decode snapshot for a DEFERRED delivery: tiered
+        rules retire/recycle slots at boundaries (ops/tierstore.py), so
+        a worker delivery decoding the LIVE table could attribute the
+        window to a slot's next tenant. Untiered tables are append-only
+        — no snapshot needed. Sliding stays live too: it demotes only
+        quiescent keys (act 0 in every pane — never in a delivery's
+        active set), and a per-trigger million-entry copy would be real
+        overhead."""
+        if self.tier is None or self.wt == ast.WindowType.SLIDING_WINDOW:
+            return None
+        return self.kt.decode_all()
+
     def _emit_async(self, kind: str, stacked_dev, wr: WindowRange) -> None:
         """Shared async-emit protocol: start the device→host copy, enqueue
         for the worker. The dispatched program sees an immutable snapshot,
@@ -1268,7 +1352,8 @@ class FusedWindowAggNode(Node):
         # thread): the worker must not read the live _cur_ingest_ms,
         # which keeps advancing with post-boundary folds
         self._emit_q.put((kind, stacked_dev, self.kt.n_keys, wr,
-                          _time.perf_counter(), self._cur_ingest_ms))
+                          _time.perf_counter(), self._cur_ingest_ms,
+                          self._keys_snapshot()))
 
     def _ensure_emit_worker(self) -> None:
         import queue
@@ -1293,12 +1378,23 @@ class FusedWindowAggNode(Node):
             item = self._emit_q.get()
             if item is None:
                 break
-            kind, stacked_dev, n_keys, wr, t_issue, issue_ing = item
+            (kind, stacked_dev, n_keys, wr, t_issue, issue_ing,
+             keys_snap) = item
             # install the issue-time provenance for every emit() this
             # delivery makes (node.py reads it ahead of _cur_ingest_ms;
-            # issue_ing=None means "stamp nothing", not "read live")
+            # issue_ing=None means "stamp nothing", not "read live");
+            # keys_snap pins the slot->key decode to dispatch time so a
+            # tiered boundary's slot retire/recycle between dispatch and
+            # delivery cannot misattribute the window
             _emit_ctx.ingest_ms = issue_ing
+            self._kt_keys_override = keys_snap
             try:
+                if kind == "tier":
+                    # tiered-state maintenance (ops/tierstore.py): harvest
+                    # a landed demote block / run the placement scan —
+                    # off the fold thread, by design
+                    self.tier.worker_task(stacked_dev)
+                    continue
                 if kind == "pf":
                     pipeline, frozen, backup = stacked_dev
                     self._deliver_pf(pipeline, frozen, backup, n_keys, wr,
@@ -1364,6 +1460,7 @@ class FusedWindowAggNode(Node):
                 self.stats.inc_exception(f"async {kind} emit failed: {exc}")
             finally:
                 _emit_ctx.ingest_ms = _NO_OVERRIDE
+                self._kt_keys_override = None
                 self._emit_q.task_done()
 
     # bounded drain deadline; tests shrink it to exercise the abort path
@@ -1406,6 +1503,109 @@ class FusedWindowAggNode(Node):
                     return
                 q.all_tasks_done.wait(remaining)
 
+    # -------------------------------------------------------- tiered state
+    def _tier_submit(self, payload: tuple) -> None:
+        """Hand a tier task (demote harvest / policy scan) to the
+        prefinalize/emit worker — the policy and the packed-row fetch
+        never run on the fold thread."""
+        import time as _time
+
+        self._ensure_emit_worker()
+        self._emit_q.put(("tier", payload, 0, None, _time.perf_counter(),
+                          None, None))
+
+    def _on_tier_event(self, kind: str, n: int = 0) -> None:
+        """Tier transition hook: demotions/promotions invalidate the
+        sliding ring's running partials (the panes stay the truth — the
+        next trigger rebuilds via flip or the components_dyn fallback),
+        and demotions leave a flight-recorder breadcrumb."""
+        if self.wt == ast.WindowType.SLIDING_WINDOW and \
+                self.sliding_impl == "daba":
+            self._rg_dirty = True
+        if kind == "demote":
+            from .events import recorder
+
+            recorder().record(
+                "tier_demote", rule=self.stats.rule_id, severity="info",
+                component="tier_store", node=self.name, keys=n)
+
+    def _reset_pane_tiered(self, pane: int) -> None:
+        """reset_pane + the tier epoch bump: spilled rows remember the
+        per-pane epoch they were packed under, so a reset here marks
+        their slice of that pane stale (ops/tierstore.py)."""
+        self.state = self.gb.reset_pane(self.state, pane)
+        if self.tier is not None:
+            self.tier.note_pane_reset(pane)
+
+    def _tier_boundary(self) -> None:
+        """Pane-boundary tier hook (fold thread): apply the worker's
+        pending demote plan and dispatch the next touch scan."""
+        if self.tier is not None:
+            self.state = self.tier.on_boundary(self.state)
+
+    def _emit_tier_extras(self, wr: WindowRange,
+                          panes: Optional[List[int]] = None) -> None:
+        """Emit the spilled (cold-tier) keys' contribution to a closing
+        window: their still-valid per-pane partials finalize host-side
+        (the prefinalize numpy tail) and ride the same emit tail as the
+        device groups — as a second message for the window, after (or
+        concurrent with) the device groups."""
+        if self.tier is None:
+            return
+        res = self.tier.window_groups(self.plan, panes)
+        if res is None:
+            return
+        keys, outs, _act = res
+        if self.direct_emit is not None:
+            dim_names = [d.name for d in self.dims]
+            dim_cols: Dict[str, np.ndarray] = {}
+            if dim_names:
+                if len(dim_names) == 1:
+                    col = np.empty(len(keys), dtype=np.object_)
+                    col[:] = keys
+                    dim_cols[dim_names[0]] = col
+                else:
+                    for i, dn in enumerate(dim_names):
+                        col = np.empty(len(keys), dtype=np.object_)
+                        col[:] = [k[i] for k in keys]
+                        dim_cols[dn] = col
+            if self.emit_columnar:
+                cb = self.direct_emit.run_columnar(
+                    dim_cols, outs, wr.window_start, wr.window_end)
+                if cb is not None and cb.n:
+                    self.emit(cb, count=cb.n)
+            else:
+                msgs = self.direct_emit.run(
+                    dim_cols, outs, wr.window_start, wr.window_end)
+                if msgs:
+                    self.emit(msgs, count=len(msgs))
+            return
+        out_lists = []
+        for col in outs:
+            sel = col
+            if np.issubdtype(sel.dtype, np.floating):
+                sel = np.where(np.isnan(sel), None, sel.astype(object))
+            out_lists.append(sel.tolist())
+        groups: List[GroupedTuples] = []
+        dim_names = [d.name for d in self.dims]
+        single_dim = dim_names[0] if len(dim_names) == 1 else None
+        spec_keys = self._spec_keys
+        ts = wr.window_end
+        for j, key in enumerate(keys):
+            if single_dim is not None:
+                msg = {single_dim: key}
+            elif dim_names:
+                msg = dict(zip(dim_names, key))
+            else:
+                msg = {}
+            agg_values = {spec_keys[i]: out_lists[i][j]
+                          for i in range(len(spec_keys))}
+            groups.append(GroupedTuples(
+                content=[Tuple(emitter="", message=msg, timestamp=ts)],
+                group_key=str(key), window_range=wr,
+                agg_values=agg_values))
+        self.emit(GroupedTuplesSet(groups=groups, window_range=wr))
+
     # ------------------------------------------------------------- sliding
     def _choose_sliding_impl(self, requested: str) -> str:
         """Resolve the sliding implementation at construction: DABA rings
@@ -1433,12 +1633,39 @@ class FusedWindowAggNode(Node):
             return "refold"
         est = ring.estimate_bytes(self.gb.capacity)
         if est > self.dev_ring_budget_bytes:
+            # structured flight event either way: a wide-hll rule that
+            # still exceeds slidingDevRingMb after bucket coarsening
+            # either got its capacity capped by the cold tier (tiered
+            # construction shrinks it to the hot target, so this branch
+            # means even THAT didn't fit) or silently refolding would
+            # hide the regression class PR 11 left open
+            from .events import recorder
+
+            recorder().record(
+                "sliding_ring_budget", rule=self.stats.rule_id,
+                severity="warn", component="sliding_ring", node=self.name,
+                estimate_bytes=int(est),
+                budget_bytes=int(self.dev_ring_budget_bytes),
+                tiered=self._tier_layout is not None, action="refold")
             logger.warning(
                 "%s: sliding ring needs %.1fMB > slidingDevRingMb=%.0fMB "
-                "budget — using the refold path (raise the budget or "
-                "coarsen the window to enable DABA rings)",
+                "budget — using the refold path (raise the budget, "
+                "coarsen the window, or tighten the tier hot target)",
                 self.name, est / 2**20, self.dev_ring_budget_bytes / 2**20)
             return "refold"
+        if self._tier_layout is not None:
+            # DABA accepted at the tier-capped capacity: record that the
+            # cold tier (not refolding) is what absorbs excess
+            # cardinality for this rule
+            from .events import recorder
+
+            recorder().record(
+                "sliding_tier_demote", rule=self.stats.rule_id,
+                severity="info", component="sliding_ring", node=self.name,
+                estimate_bytes=int(est),
+                budget_bytes=int(self.dev_ring_budget_bytes),
+                hot_slots=int(self._tier_layout.hot_slots),
+                action="daba_tiered")
         self.ring = ring
         self._ring_reset_tracking()
         # the running total retains one spare bucket beyond the window
@@ -1580,7 +1807,7 @@ class FusedWindowAggNode(Node):
             pane = int(b) % self.n_ring_panes
             held = self._pane_bucket.get(pane)
             if held is not None and held != int(b):
-                self.state = self.gb.reset_pane(self.state, pane)
+                self._reset_pane_tiered(pane)
             self._pane_bucket[pane] = int(b)
         self._ring_max_bucket = max(self._ring_max_bucket,
                                     int(buckets.max()))
@@ -1606,6 +1833,8 @@ class FusedWindowAggNode(Node):
         daba = self.sliding_impl == "daba"
         t0 = _time.perf_counter()
         cols, valid, slots = self._build_kernel_inputs(sub)
+        if self.tier is not None:
+            self.state = self.tier.admit(self.state)
         # the DABA path needs no device batch cache: triggers combine
         # running partials, edges fold on host from the row ring
         dev = (None if daba
@@ -1652,6 +1881,9 @@ class FusedWindowAggNode(Node):
                 self._bucket_max_ts[int(b)] = bmax
         if daba:
             self._ring_advance_buckets(buckets)
+        # tier maintenance at bucket granularity (sliding's pane
+        # boundary): throttled by the scan cadence inside
+        self._tier_boundary()
         # trigger rows: vectorized OVER(WHEN ...) on the raw batch columns;
         trig_mask = _host_mask(self._trigger_host, sub.columns, sub.n)
         for i in np.nonzero(trig_mask)[0].tolist():
@@ -1878,7 +2110,7 @@ class FusedWindowAggNode(Node):
                 "count", self.gb._finalize_dyn(self.state, pane_mask),
                 WindowRange(lo, hi))
         if used_scratch:
-            self.state = self.gb.reset_pane(self.state, self._scratch_pane)
+            self._reset_pane_tiered(self._scratch_pane)
 
     # ---------------------------------------------------- sliding (DABA)
     def _emit_sliding_ring(self, t: int) -> None:
@@ -1923,7 +2155,7 @@ class FusedWindowAggNode(Node):
         self._ensure_emit_worker()
         self._emit_q.put(("ring", (pending, shadow), n_keys,
                           WindowRange(lo, hi), _time.perf_counter(),
-                          self._cur_ingest_ms))
+                          self._cur_ingest_ms, None))
 
     def _shadow_ring_rows(self, shadow, b: int, lo_excl: Optional[int] = None,
                           hi_incl: Optional[int] = None) -> None:
@@ -2130,12 +2362,16 @@ class FusedWindowAggNode(Node):
             self._emit_mr_async(wr)
         else:
             self._boundary_emit(wr)
+        # spilled (cold-tier) keys with live pane data contribute to this
+        # window host-side, BEFORE the pane expiry marks them stale
+        self._emit_tier_extras(wr)
         if self.wt == ast.WindowType.TUMBLING_WINDOW:
-            self.state = self.gb.reset_pane(self.state, 0)
+            self._reset_pane_tiered(0)
         else:
             # advance to the next pane; expire it (it held the oldest slice)
             self.cur_pane = (self.cur_pane + 1) % self.n_panes
-            self.state = self.gb.reset_pane(self.state, self.cur_pane)
+            self._reset_pane_tiered(self.cur_pane)
+        self._tier_boundary()
         self.begin_window_backstop()
         self._schedule_next_tick()
 
@@ -2199,9 +2435,11 @@ class FusedWindowAggNode(Node):
                 self._close_session(now)
             self.broadcast(eof)
             return
-        self._emit(WindowRange(now - self.length_ms, now))
+        wr_eof = WindowRange(now - self.length_ms, now)
+        self._emit(wr_eof)
+        self._emit_tier_extras(wr_eof)
         if self.wt == ast.WindowType.TUMBLING_WINDOW:
-            self.state = self.gb.reset_pane(self.state, 0)
+            self._reset_pane_tiered(0)
         self.broadcast(eof)
 
     # ------------------------------------------------------------------- emit
@@ -2238,7 +2476,8 @@ class FusedWindowAggNode(Node):
             # that fallback
             backup = self.gb._finalize(self.state, (True,) * self.gb.n_panes)
             self._emit_q.put(("pf", (pipeline, frozen, backup), n_keys, wr,
-                              _time.perf_counter(), self._cur_ingest_ms))
+                              _time.perf_counter(), self._cur_ingest_ms,
+                              self._keys_snapshot()))
         else:
             # no pre-issue in flight: dispatch the finalize on the
             # immutable state and let the worker fetch + deliver
@@ -2396,7 +2635,8 @@ class FusedWindowAggNode(Node):
         dim_names = [d.name for d in self.dims]
         single_dim = dim_names[0] if len(dim_names) == 1 else None
         spec_keys = self._spec_keys
-        decode = self.kt.decode
+        snap = self._kt_keys_override
+        decode = snap.__getitem__ if snap is not None else self.kt.decode
         ts = wr.window_end
         for j, slot in enumerate(active_list):
             key = decode(slot)
@@ -2424,7 +2664,9 @@ class FusedWindowAggNode(Node):
         dim_names = [d.name for d in self.dims]
         dim_cols: Dict[str, np.ndarray] = {}
         if dim_names:
-            keys = self.kt.decode_all()
+            keys = (self._kt_keys_override
+                    if self._kt_keys_override is not None
+                    else self.kt.decode_all())
             if len(dim_names) == 1:
                 col = np.empty(len(active), dtype=np.object_)
                 col[:] = [keys[s] for s in active.tolist()]
@@ -2493,6 +2735,12 @@ class FusedWindowAggNode(Node):
             snap["hh_dicts"] = {
                 c: vd.snapshot() for c, vd in self._hh_dicts.items()
             }
+        if self.tier is not None:
+            # both tiers persist: the device partials above already carry
+            # the hot tier (keys list encodes retired slots as None
+            # holes); this is the cold tier — spilled rows + epochs, so
+            # a key demoted at kill time comes back queryable
+            snap["tier"] = self.tier.snapshot()
         if self.wt == ast.WindowType.SESSION_WINDOW:
             snap["session_open"] = self._session_open
             snap["session_start"] = self._session_start
@@ -2536,11 +2784,12 @@ class FusedWindowAggNode(Node):
         self.kt.restore([tuple(k) if isinstance(k, list) else k for k in keys])
         partials = state.get("partials")
         if partials:
-            host = {k: np.asarray(v, dtype=np.float32) for k, v in partials.items()}
-            cap = next(iter(host.values())).shape[1]
+            host, cap = self.gb.host_from_partials(partials)
             self.gb.capacity = cap
             self.kt.capacity = max(self.kt.capacity, cap)
             self.state = self.gb.state_from_host(host)
+        if self.tier is not None and state.get("tier"):
+            self.tier.restore(state["tier"])
         self.cur_pane = state.get("cur_pane", 0)
         self._rows_in_window = state.get("rows_in_window", 0)
         for c, values in state.get("hh_dicts", {}).items():

@@ -169,8 +169,11 @@ class SharedFoldNode(Node):
         # cursors restored ahead of member re-attach (restore_state)
         self._restored_cursors: Dict[str, int] = {}
         # shared-source fan-out key encode (mirrors nodes_fused.py
-        # _shared_encode): None = undecided, False = self-encode forever
-        self._shared_slots_ok: Optional[bool] = None
+        # _shared_encode): None = undecided, False = self-encode forever.
+        # A live tier (ops/tierstore.py) recycles slots, which breaks the
+        # neutral table's dense insertion-order contract — self-encode.
+        self._shared_slots_ok: Optional[bool] = (
+            None if self.store.tier is None else False)
         self._shared_nkt = None
         self.prep_ctx = None  # set by SrcSubTopo.attach
         self.prep_specs: List[tuple] = [self._prep_spec()]

@@ -438,6 +438,12 @@ class SourceNode(Node):
         if self.prep_ctx is not None:
             self.prep_ctx.register_upload(*spec)
 
+    def register_tier_prefetch(self, fn) -> None:
+        """Tiered key state (ops/tierstore.py): wire the fused consumer's
+        cold-tier prefetch into the pool's ordered upload stage."""
+        if self.prep_ctx is not None:
+            self.prep_ctx.register_tier_prefetch(fn)
+
     def _dispatch_job(self, job) -> None:
         """Decode+emit one flush unit: on the decode pool when configured
         (shard-parallel native parse off the connector thread, ordered

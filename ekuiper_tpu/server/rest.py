@@ -203,6 +203,10 @@ class RestApi:
         # autosize log (runtime/control.py)
         r("GET", r"^/diagnostics/control$",
           lambda m: self.diagnostics_control())
+        # tiered key state: per-rule hot/cold placement counters and the
+        # host spill arena (ops/tierstore.py)
+        r("GET", r"^/diagnostics/tier$",
+          lambda m: self.diagnostics_tier())
         r("POST", r"^/rules/(?P<id>[^/]+)/trace/start$",
           lambda m, body=None: self._tracer().enable(
               m["id"], (body or {}).get("strategy", "always"))
@@ -541,6 +545,15 @@ class RestApi:
 
         ctl = control.controller() or self.qos_controller
         return ctl.diagnostics()
+
+    @staticmethod
+    def diagnostics_tier() -> Dict[str, Any]:
+        """GET /diagnostics/tier — per-tiered-rule placement state:
+        demote/promote/recycle counters, cold-tier residency, host arena
+        bytes, and the plan-time geometry (ops/tierstore.py)."""
+        from ..ops import tierstore
+
+        return {"rules": tierstore.diagnostics()}
 
     @staticmethod
     def diagnostics_memory() -> Dict[str, Any]:

@@ -71,6 +71,18 @@ class RuleOptionConfig:
     # edge-refold path (parity baseline / escape hatch)
     sliding_impl: str = "daba"
     key_slots: int = 16384  # group-by hash-slot table size per rule
+    # tiered key state (ops/tierstore.py, docs/TIERED_STATE.md): "auto"
+    # enables the HBM-resident hot set + host cold tier when
+    # KUIPER_HBM_BUDGET_MB is set and too tight for the rule's capacity
+    # ladder; "on" forces it (budget or tierHotMb required), "off"
+    # disables. Cold keys' per-pane partials spill to a pinned host
+    # arena and their device slots recycle through the key table.
+    tier_store: str = "auto"
+    # explicit hot-tier HBM allowance (MB); 0 = derive from
+    # KUIPER_HBM_BUDGET_MB
+    tier_hot_mb: int = 0
+    # placement-policy cadence; 0 = derive from the window geometry
+    tier_scan_ms: int = 0
     use_device_kernel: bool = True  # fuse window+agg into a jitted kernel when possible
     # pre-issue the window finalize this long before the boundary so the
     # device round trip overlaps the stream (ops/prefinalize.py); 0 disables

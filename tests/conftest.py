@@ -69,6 +69,9 @@ def fresh_engine_state():
     kernwatch.reset()
     memwatch.registry().clear()
     jitcert.reset()
+    from ekuiper_tpu.ops import tierstore
+
+    tierstore.reset()
     timex.use_real_clock()
     # dynamic lock-order teardown check: the acquisition graph
     # accumulates across tests (a consistent GLOBAL order is the

@@ -291,3 +291,136 @@ class TestCrashReplay:
         res = {m["deviceId"]: (m["c"], round(m["a"], 4)) for m in msgs}
         # uninterrupted expectation: a -> 3 rows avg 20; b -> 2 rows avg 20
         assert res == {"a": (3, 20.0), "b": (2, 20.0)}, res
+
+
+class TestTieredRestore:
+    """ISSUE 13: kill/restore through tiered key state — keys demoted at
+    checkpoint time restore correctly in BOTH tiers (hot-tier holes +
+    cold-tier rows), cross-impl with slidingImpl=daba and through the
+    shared pane fold (docs/TIERED_STATE.md)."""
+
+    def test_kill_restore_through_tiered_shared_fold(self):
+        from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+        from ekuiper_tpu.ops.panestore import PaneStore
+        from ekuiper_tpu.sql.parser import parse_select
+
+        plan = extract_kernel_plan(parse_select(
+            "SELECT deviceId, sum(temperature) AS s, count(*) AS c FROM "
+            "demo GROUP BY deviceId, TUMBLINGWINDOW(ss, 4)"))
+
+        def mk():
+            return PaneStore(plan, 1000, 4, capacity=64, micro_batch=128,
+                             tier_budget_mb=0.001)
+
+        store = mk()
+        assert store.tier is not None and store.gb.track_touch
+        ids = np.array(["a", "b", "c"], dtype=np.object_)
+        slots, _ = store.kt.encode_column(ids)
+        store.fold({"temperature": np.array([1.0, 2.0, 3.0])}, {},
+                   slots, 0)
+        # pane 0 expires -> every key quiescent; demote a and b
+        store.reset_pane(0)
+        store.tier._plan = [0, 1]
+        store.reset_pane(1)  # boundary hook applies the plan
+        assert store.tier.demoted_total == 2
+        assert store.kt.free_slots() == [0, 1]
+
+        snap = store.snapshot()
+        assert None in snap["keys"]  # hot-tier holes persist
+        restored = mk()
+        restored.restore(snap)
+        assert restored.kt.free_slots() == [0, 1]
+        assert restored.kt.decode(2) == "c"
+        # a demoted-at-kill key comes back queryable: it re-encodes into
+        # a recycled slot and folds/combines exactly
+        s2, grew = restored.kt.encode_column(
+            np.array(["a"], dtype=np.object_))
+        assert not grew and s2[0] in (0, 1)
+        restored.fold({"temperature": np.array([7.0])}, {}, s2, 2)
+        outs, act = restored.combine([2], restored.kt.n_keys)
+        alive = np.nonzero(act > 0)[0]
+        assert [restored.kt.decode(i) for i in alive.tolist()] == ["a"]
+        assert outs[0][alive][0] == 7.0 and outs[1][alive][0] == 1
+
+    def test_kill_restore_daba_tiered_cross_impl(self, mock_clock):
+        """A tiered DABA sliding rule killed with a key demoted restores
+        into a REFOLD-impl node (cross-impl, pane layout shared): the
+        demoted key's slot hole survives, the ring rebuilds from the
+        panes, and post-restore triggers emit exactly the untiered
+        reference's windows."""
+        from ekuiper_tpu.data.batch import ColumnBatch
+        from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+        from ekuiper_tpu.ops.emit import build_direct_emit
+        from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+        from ekuiper_tpu.sql.parser import parse_select
+
+        sql = ("SELECT deviceId, count(*) AS c, sum(temp) AS s FROM s "
+               "GROUP BY deviceId, SLIDINGWINDOW(ss, 2) "
+               "OVER (WHEN temp > 90)")
+        stmt = parse_select(sql)
+        plan = extract_kernel_plan(stmt)
+
+        def mk(impl, tier_mb):
+            node = FusedWindowAggNode(
+                f"tsl_{impl}", stmt.window, plan,
+                dims=[d.expr for d in stmt.dimensions],
+                capacity=64, micro_batch=128,
+                direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+                sliding_impl=impl, tier_budget_mb=tier_mb)
+            node.state = node.gb.init_state()
+            got = []
+            node.broadcast = lambda item: got.append(item)
+            return node, got
+
+        def batch(ids, temps, tss):
+            ids = np.array(ids, dtype=np.object_)
+            return ColumnBatch(
+                n=len(ids),
+                columns={"deviceId": ids,
+                         "temp": np.asarray(temps, np.float64)},
+                timestamps=np.asarray(tss, np.int64), emitter="s")
+
+        tiered, out_t = mk("daba", 0.001)
+        ref, out_r = mk("refold", 0.0)
+        assert tiered.tier is not None and tiered.tier.quiescent_only
+        # d_old folds once, then the stream moves on long past the ring
+        # retention — d_old becomes quiescent
+        for n in (tiered, ref):
+            n.process(batch(["d_old", "d1"], [10.0, 20.0], [100, 100]))
+        for t in range(1, 40):
+            ts = t * 250
+            for n in (tiered, ref):
+                n.process(batch(["d1"], [30.0], [ts]))
+        slot_old = tiered.kt._ids["d_old"]
+        tiered.tier._plan = [slot_old]
+        tiered._tier_boundary()
+        tiered._drain_async_emits()
+        assert tiered.tier.demoted_total == 1
+        assert tiered.kt.decode(slot_old) is None
+        assert tiered._rg_dirty  # ring invalidated, panes stay truth
+
+        snap = tiered.snapshot_state()
+        assert None in snap["keys"]
+        restored, out_c = mk("refold", 0.001)  # CROSS impl, tier on
+        restored.restore_state(snap)
+        assert restored.kt.free_slots() == tiered.kt.free_slots()
+        # post-restore: d_old returns, a trigger row fires the window —
+        # both the restored and the uninterrupted reference must emit
+        # identical windows
+        tail_ts = 40 * 250
+        for n, sink in ((restored, out_c), (ref, out_r)):
+            sink.clear()
+            n.process(batch(["d_old", "d1"], [50.0, 95.0],
+                            [tail_ts, tail_ts]))
+            n._drain_async_emits()
+
+        def flat(items):
+            rows = {}
+            for m in items:
+                for r in (m if isinstance(m, list) else [m]):
+                    k = tuple(sorted(r.items()))
+                    rows[k] = rows.get(k, 0) + 1
+            return rows
+
+        assert flat(out_c) == flat(out_r)
+        assert flat(out_c), "trigger emitted nothing"

@@ -1,0 +1,473 @@
+"""Tiered key state (ops/tierstore.py, docs/TIERED_STATE.md): layout
+planning, demote/promote exactness, slot recycling, pane-epoch
+staleness, spilled-window emission, the promote-before-harvest race,
+telemetry, and checkpoints."""
+import numpy as np
+import pytest
+
+from ekuiper_tpu.data.batch import ColumnBatch
+from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+from ekuiper_tpu.ops.emit import build_direct_emit
+from ekuiper_tpu.ops.groupby import DeviceGroupBy
+from ekuiper_tpu.ops.keytable import KeyTable
+from ekuiper_tpu.ops.tierstore import (HostTierStore, TierLayout,
+                                       TierManager, TierStore,
+                                       plan_tier_layout,
+                                       state_bytes_per_key)
+from ekuiper_tpu.runtime.events import Trigger
+from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+from ekuiper_tpu.sql.parser import parse_select
+
+SQL = ("SELECT deviceId, sum(v) AS s, count(*) AS c, min(v) AS mn "
+       "FROM demo GROUP BY deviceId, HOPPINGWINDOW(ss, 4, 2)")
+
+
+def _plan(sql=SQL):
+    p = extract_kernel_plan(parse_select(sql))
+    assert p is not None
+    return p
+
+
+def _batch(ids, vals):
+    ids = np.array(ids, dtype=np.object_)
+    return ColumnBatch(
+        n=len(ids),
+        columns={"deviceId": ids, "v": np.asarray(vals, np.float64)},
+        timestamps=np.zeros(len(ids), np.int64), emitter="demo")
+
+
+def _mknode(tier_mb, capacity=64, sql=SQL):
+    stmt = parse_select(sql)
+    plan = extract_kernel_plan(stmt)
+    node = FusedWindowAggNode(
+        "t", stmt.window, plan, [d.expr for d in stmt.dimensions],
+        capacity=capacity, micro_batch=128, prefinalize_lead_ms=0,
+        direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+        emit_columnar=False, tier_budget_mb=tier_mb)
+    node.state = node.gb.init_state()
+    out = []
+    node.emit = lambda item, count=None, _o=out: _o.append(item)
+    return node, out
+
+
+def _flat(msgs):
+    rows = {}
+    for m in msgs:
+        for r in (m if isinstance(m, list) else [m]):
+            k = tuple(sorted(r.items()))
+            rows[k] = rows.get(k, 0) + 1
+    return rows
+
+
+class TestLayout:
+    def test_roomy_budget_disables(self):
+        # budget covering 4x the requested capacity: tiering is a no-op
+        assert plan_tier_layout(_plan(), 2, 1024, 1e6) is None
+
+    def test_tight_budget_engages_and_clamps(self):
+        plan = _plan()
+        # n rides 3 specs (sum/count/min), s1 one, mn one, act — x2
+        # panes, f32, + the uint32 touch slot
+        per_key = state_bytes_per_key(plan, 2)
+        assert per_key == (2 * (3 + 1 + 1 + 1)) * 4 + 4
+        layout = plan_tier_layout(plan, 2, 1 << 20, 1.0)
+        assert layout is not None
+        assert 1024 <= layout.hot_slots < (1 << 20)
+
+    def test_forced_off(self):
+        assert plan_tier_layout(_plan(), 1, 1024, 0) is None
+
+
+class TestTouchColumn:
+    def test_fold_bumps_and_reset_preserves(self):
+        plan = _plan()
+        gb = DeviceGroupBy(plan, capacity=16, n_panes=2, micro_batch=8,
+                           track_touch=True)
+        st = gb.init_state()
+        st = gb.fold(st, {"v": np.ones(4)},
+                     np.array([0, 1, 0, 2], np.int32), pane_idx=0)
+        touch = np.asarray(st["touch"])
+        assert touch[:3].tolist() == [2, 1, 1]
+        st = gb.reset_pane(st, 0)
+        assert np.asarray(st["touch"])[:3].tolist() == [2, 1, 1]
+        st = gb.grow(st, 32)
+        t2 = np.asarray(st["touch"])
+        assert t2.shape == (32,) and t2[:3].tolist() == [2, 1, 1]
+        assert t2.dtype == np.uint32
+
+    def test_untracked_state_has_no_touch(self):
+        gb = DeviceGroupBy(_plan(), capacity=16, n_panes=2, micro_batch=8)
+        assert "touch" not in gb.init_state()
+
+
+class TestDemotePromote:
+    def test_roundtrip_bit_exact(self):
+        plan = _plan()
+        gb = DeviceGroupBy(plan, capacity=32, n_panes=2, micro_batch=16,
+                           track_touch=True)
+        ref = DeviceGroupBy(plan, capacity=32, n_panes=2, micro_batch=16)
+        ts = TierStore(gb, TierLayout(8, 4, 100, 1))
+        st, rst = gb.init_state(), ref.init_state()
+        cols = {"v": np.array([1., 2., 3., 4., 5., 6.])}
+        slots = np.array([0, 1, 2, 0, 1, 2], np.int32)
+        st = gb.fold(st, dict(cols), slots, pane_idx=0)
+        rst = ref.fold(rst, dict(cols), slots, pane_idx=0)
+        st, packed = ts.demote(st, np.array([1, 2], np.int32))
+        packed_h = np.asarray(packed)
+        # demoted slots read as identity now
+        outs_mid, act_mid = gb.finalize(st, 3)
+        assert act_mid[1] == 0 and act_mid[2] == 0
+        st = ts.promote(st, packed_h[:2], np.array([1, 2], np.int32))
+        outs, act = gb.finalize(st, 3)
+        routs, ract = ref.finalize(rst, 3)
+        for a, b in zip(outs, routs):
+            np.testing.assert_array_equal(np.nan_to_num(a),
+                                          np.nan_to_num(b))
+        np.testing.assert_array_equal(act, ract)
+
+    def test_idle_row_detection_and_stale_mask(self):
+        gb = DeviceGroupBy(_plan(), capacity=16, n_panes=2, micro_batch=8,
+                           track_touch=True)
+        ts = TierStore(gb, TierLayout(8, 4, 100, 1))
+        row = ts.init_row()
+        assert ts.row_is_idle(row)
+        st = gb.fold(gb.init_state(), {"v": np.ones(1)},
+                     np.zeros(1, np.int32), pane_idx=1)
+        st, packed = ts.demote(st, np.zeros(1, np.int32))
+        live = np.asarray(packed)[0].copy()
+        assert not ts.row_is_idle(live)
+        # masking the one live pane (1) returns it to identity
+        ts.mask_stale_panes(live, np.array([False, True]))
+        assert ts.row_is_idle(live)
+
+
+class TestKeyTable:
+    def test_retire_recycle_and_log(self):
+        kt = KeyTable(16)
+        kt.track_new = True
+        slots, _ = kt.encode_column(np.array(["a", "b", "c"], np.object_))
+        assert kt.drain_new_keys() == [("a", 0), ("b", 1), ("c", 2)]
+        kt.retire([1], ["b"])
+        assert kt.free_slots() == [1]
+        assert kt.decode(1) is None
+        s2, grew = kt.encode_column(np.array(["d"], np.object_))
+        assert s2[0] == 1 and not grew  # recycled, no growth
+        assert kt.drain_new_keys() == [("d", 1)]
+        # stale retire (slot re-assigned) is a no-op
+        kt.retire([1], ["b"])
+        assert kt.decode(1) == "d" and kt.free_slots() == []
+
+    def test_restore_with_holes(self):
+        kt = KeyTable(16)
+        kt.restore(["a", None, "c"])
+        assert kt.decode(0) == "a" and kt.decode(1) is None
+        assert kt.free_slots() == [1]
+        s, _ = kt.encode_column(np.array(["x"], np.object_))
+        assert s[0] == 1
+
+    def test_roundtrip_through_decode_all(self):
+        kt = KeyTable(16)
+        kt.encode_column(np.array(["a", "b", "c"], np.object_))
+        kt.retire([0], ["a"])
+        kt2 = KeyTable(16)
+        kt2.restore(kt.decode_all())
+        assert kt2.decode_all() == [None, "b", "c"]
+        assert kt2.free_slots() == [0]
+
+
+class TestHostTierStore:
+    def test_put_take_grow_bytes(self):
+        hs = HostTierStore(8, 2, initial_rows=16)
+        base = hs.nbytes()
+        assert base == hs._rows.nbytes + hs._epochs.nbytes
+        for i in range(40):  # force two grows
+            hs.put(f"k{i}", np.full(8, i, np.float32),
+                   np.zeros(2, np.int64))
+        assert len(hs) == 40 and hs.nbytes() > base
+        row, ep = hs.take("k7")
+        assert row[0] == 7.0 and "k7" not in hs
+        assert hs.take("k7") is None
+
+    def test_memwatch_estimate_is_allocation(self):
+        from ekuiper_tpu.observability import memwatch
+
+        gb = DeviceGroupBy(_plan(), capacity=16, n_panes=2,
+                           micro_batch=8, track_touch=True)
+        mgr = TierManager(gb, KeyTable(16), TierLayout(8, 4, 100, 1),
+                          rule_id="tr")
+        rows = {r["component"]: r["bytes"]
+                for r in memwatch.registry().snapshot()
+                if r["rule"] == "tr"}
+        assert rows.get("tier_host_store") == mgr.store.nbytes()
+        assert rows["tier_host_store"] == \
+            mgr.store._rows.nbytes + mgr.store._epochs.nbytes
+
+
+class TestFusedIntegration:
+    def test_demote_spill_emit_promote_parity(self):
+        tiered, out_t = _mknode(0.001)
+        plain, out_p = _mknode(0.0)
+        assert tiered.tier is not None and plain.tier is None
+        rng = np.random.default_rng(3)
+
+        def feed(ids):
+            vals = np.rint(rng.normal(50, 10, len(ids)))
+            tiered.process(_batch(list(ids), vals))
+            plain.process(_batch(list(ids), vals))
+
+        def boundary(ts):
+            tiered.on_trigger(Trigger(ts=ts))
+            plain.on_trigger(Trigger(ts=ts))
+
+        feed([f"c{i}" for i in range(10)] + ["h"])
+        boundary(2000)
+        tiered.tier._plan = list(range(10))
+        tiered._tier_boundary()
+        assert tiered.tier.demoted_total == 10
+        assert len(tiered.kt.free_slots()) == 10
+        # half reappear mid-window (promotion), fresh keys recycle slots
+        feed([f"c{i}" for i in range(0, 10, 2)]
+             + [f"n{i}" for i in range(4)] + ["h"])
+        boundary(4000)
+        boundary(6000)
+        tiered._drain_async_emits()
+        plain._drain_async_emits()
+        assert _flat(out_t) == _flat(out_p)
+        assert tiered.tier.promoted_total == 5
+        assert tiered.gb.capacity == plain.gb.capacity  # no grow
+
+    def test_promote_before_harvest_race(self):
+        tiered, out_t = _mknode(0.001)
+        plain, out_p = _mknode(0.0)
+        held = []
+        tiered.tier._submit = held.append  # hold the worker back
+        rng = np.random.default_rng(4)
+        vals = np.rint(rng.normal(50, 5, 6))
+        for n in (tiered, plain):
+            n.process(_batch([f"k{i}" for i in range(6)], vals))
+            n.on_trigger(Trigger(ts=2000))
+        tiered.tier._plan = list(range(6))
+        tiered._tier_boundary()
+        assert held  # harvest NOT run yet
+        assert len(tiered.tier._inflight) == 6
+        vals2 = np.rint(rng.normal(50, 5, 3))
+        for n in (tiered, plain):
+            n.process(_batch(["k0", "k1", "k2"], vals2))
+            n.on_trigger(Trigger(ts=4000))
+        # returning keys promoted straight off the pending device block
+        assert tiered.tier.promoted_total == 3
+        for payload in held:  # late harvest skips the consumed keys
+            tiered.tier.worker_task(payload)
+        assert len(tiered.tier._inflight) == 0
+        for n in (tiered, plain):
+            n.on_trigger(Trigger(ts=6000))
+        tiered._drain_async_emits()
+        plain._drain_async_emits()
+        assert _flat(out_t) == _flat(out_p)
+
+    def test_pane_epoch_masks_closed_windows(self):
+        tiered, out_t = _mknode(0.001)
+        tiered.process(_batch(["a", "b"], [1.0, 2.0]))
+        tiered.on_trigger(Trigger(ts=2000))
+        tiered.tier._plan = [0, 1]
+        tiered._tier_boundary()
+        # run past the full hopping span: both panes reset since demotion
+        tiered.on_trigger(Trigger(ts=4000))
+        tiered.on_trigger(Trigger(ts=6000))
+        out_t.clear()
+        # reappearance after expiry: stale rows must NOT merge
+        tiered.process(_batch(["a"], [5.0]))
+        tiered.on_trigger(Trigger(ts=8000))
+        tiered._drain_async_emits()
+        rows = _flat(out_t)
+        key = next(k for k in rows if ("deviceId", "a") in k)
+        assert dict(key)["s"] == 5.0 and dict(key)["c"] == 1
+
+    def test_shared_slot_reuse_disabled(self):
+        tiered, _ = _mknode(0.001)
+        assert tiered._shared_slots_ok is False
+
+
+class TestQuiescentMode:
+    def test_live_spill_requeues(self):
+        gb = DeviceGroupBy(_plan(), capacity=16, n_panes=2,
+                           micro_batch=8, track_touch=True)
+        kt = KeyTable(16)
+        mgr = TierManager(gb, kt, TierLayout(4, 4, 100, 1),
+                          quiescent_only=True)
+        st = gb.init_state()
+        kt.encode_column(np.array(["a", "b"], np.object_))
+        kt.drain_new_keys()
+        st = gb.fold(st, {"v": np.ones(2)},
+                     np.array([0, 1], np.int32), pane_idx=0)
+        mgr._plan = [0]  # "a" has LIVE data — quiescent mode must not lose it
+        st = mgr.on_boundary(st)
+        assert mgr.demoted_total == 1
+        assert mgr._requeue  # harvested live row queued for re-promotion
+        st = mgr.admit(st)
+        assert mgr.promoted_total == 1
+        assert "a" in kt._ids  # re-seated with a fresh slot
+        outs, act = gb.finalize(st, kt.n_keys)
+        alive = {kt.decode(i) for i in np.nonzero(act > 0)[0].tolist()}
+        assert alive == {"a", "b"}
+
+
+class TestTelemetry:
+    def test_render_families(self):
+        from ekuiper_tpu.ops import tierstore
+
+        gb = DeviceGroupBy(_plan(), capacity=16, n_panes=2,
+                           micro_batch=8, track_touch=True)
+        mgr = TierManager(gb, KeyTable(16), TierLayout(8, 4, 100, 1),
+                          rule_id="tr")
+        mgr.demoted_total = 7
+        out = []
+        tierstore.render_prometheus(out, lambda s: s)
+        text = "\n".join(out)
+        assert 'kuiper_spill_demoted_total{rule="tr"} 7' in text
+        for fam in ("kuiper_spill_promoted_total",
+                    "kuiper_spill_resident_total",
+                    "kuiper_tier_host_bytes"):
+            assert f"# TYPE {fam}" in text
+        diag = tierstore.diagnostics()
+        assert diag and diag[0]["rule"] == "tr"
+        assert diag[0]["demoted_total"] == 7
+
+    def test_admission_prices_hot_set(self):
+        import os
+
+        from ekuiper_tpu.planner.planner import RuleDef
+        from ekuiper_tpu.runtime.control import price_rule
+        from ekuiper_tpu.store import kv
+
+        store = kv
+        rule = RuleDef.from_dict({
+            "id": "tier_price", "sql": SQL,
+            "actions": [{"log": {}}],
+            "options": {"key_slots": 1 << 20},
+        })
+        old = os.environ.get("KUIPER_HBM_BUDGET_MB")
+        try:
+            os.environ["KUIPER_HBM_BUDGET_MB"] = "4"
+            tiered_price = price_rule(rule, store)
+            os.environ.pop("KUIPER_HBM_BUDGET_MB")
+            untiered_price = price_rule(rule, store)
+        finally:
+            if old is not None:
+                os.environ["KUIPER_HBM_BUDGET_MB"] = old
+            else:
+                os.environ.pop("KUIPER_HBM_BUDGET_MB", None)
+        assert tiered_price.get("tier", {}).get("hot_slots")
+        assert tiered_price["hbm_projected_bytes"] < \
+            untiered_price["hbm_projected_bytes"]
+
+    def test_estimate_includes_tier_sites(self):
+        from ekuiper_tpu.observability import jitcert
+
+        plan = _plan()
+        base = jitcert.estimate_plan_signatures(plan, 2, 128, 64)
+        tiered = jitcert.estimate_plan_signatures(plan, 2, 128, 64,
+                                                  tier_demote_batch=512)
+        assert tiered > base
+
+
+class TestCheckpoint:
+    def test_manager_snapshot_roundtrip(self):
+        gb = DeviceGroupBy(_plan(), capacity=16, n_panes=2,
+                           micro_batch=8, track_touch=True)
+        mgr = TierManager(gb, KeyTable(16), TierLayout(8, 4, 100, 1))
+        row = mgr.ts.init_row()
+        row[-1] = 3.0  # act pane 1
+        mgr.store.put("k", row, np.array([0, 5], np.int64))
+        mgr.note_pane_reset(0)
+        snap = mgr.snapshot()
+        gb2 = DeviceGroupBy(_plan(), capacity=16, n_panes=2,
+                            micro_batch=8, track_touch=True)
+        mgr2 = TierManager(gb2, KeyTable(16), TierLayout(8, 4, 100, 1))
+        mgr2.restore(snap)
+        assert "k" in mgr2.store
+        r2, e2 = mgr2.store.peek("k")
+        np.testing.assert_array_equal(r2, row)
+        assert e2.tolist() == [0, 5]
+        assert mgr2._pane_epoch.tolist() == [1, 0]
+
+    def test_fused_cross_tier_restore(self):
+        tiered, out_t = _mknode(0.001)
+        tiered.process(_batch(["a", "b", "c"], [1.0, 2.0, 3.0]))
+        tiered.on_trigger(Trigger(ts=2000))
+        tiered.tier._plan = [0, 1]
+        tiered._tier_boundary()
+        snap = tiered.snapshot_state()
+        assert snap["tier"]["keys"]  # cold tier serialized
+        assert None in snap["keys"]  # hot-tier holes serialized
+        restored, out_r = _mknode(0.001)
+        restored.restore_state(snap)
+        assert len(restored.tier.store) == len(tiered.tier.store)
+        assert restored.kt.free_slots() == tiered.kt.free_slots()
+        # demoted-at-kill key comes back queryable in both runs
+        for n in (tiered, restored):
+            n.process(_batch(["a"], [10.0]))
+            n.on_trigger(Trigger(ts=4000))
+            n._drain_async_emits()
+        assert _flat(out_t[-2:]) == _flat(out_r[-2:]) or \
+            _flat(out_t) != {} and _flat(out_r) != {}
+        # exact: window 2 covers a's promoted pane-0 partial + new row
+        def val(out):
+            for m in reversed(out):
+                for r in (m if isinstance(m, list) else [m]):
+                    if r.get("deviceId") == "a":
+                        return (r["s"], r["c"])
+            return None
+        assert val(out_t) == val(out_r) == (11.0, 2)
+
+
+class TestEventTime:
+    def test_event_time_tiered_parity(self):
+        """Event-time tumbling with tiering: bucket-pane epochs gate
+        spilled validity; demote mid-stream + reappearance stays exact
+        vs the untiered node (watermark-driven emission)."""
+        from ekuiper_tpu.runtime.events import Watermark
+
+        sql = ("SELECT deviceId, sum(v) AS s, count(*) AS c FROM demo "
+               "GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)")
+        stmt = parse_select(sql)
+        plan = extract_kernel_plan(stmt)
+
+        def mk(tier_mb):
+            node = FusedWindowAggNode(
+                "evt", stmt.window, plan,
+                [d.expr for d in stmt.dimensions],
+                capacity=64, micro_batch=128, prefinalize_lead_ms=0,
+                direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+                emit_columnar=False, is_event_time=True,
+                tier_budget_mb=tier_mb)
+            node.state = node.gb.init_state()
+            out = []
+            node.emit = lambda item, count=None, _o=out: _o.append(item)
+            return node, out
+
+        tiered, out_t = mk(0.001)
+        plain, out_p = mk(0.0)
+        assert tiered.tier is not None
+
+        def ebatch(ids, vals, tss):
+            ids = np.array(ids, dtype=np.object_)
+            return ColumnBatch(
+                n=len(ids),
+                columns={"deviceId": ids,
+                         "v": np.asarray(vals, np.float64)},
+                timestamps=np.asarray(tss, np.int64), emitter="demo")
+
+        for n in (tiered, plain):
+            n.process(ebatch(["a", "b", "c"], [1., 2., 3.],
+                             [100, 150, 200]))
+            n.on_watermark(Watermark(ts=1100))  # bucket 0 emits
+        tiered.tier._plan = [0, 1]  # demote a, b (quiescent post-emit)
+        tiered._tier_boundary()
+        for n in (tiered, plain):
+            n.process(ebatch(["a", "d"], [10., 20.], [1300, 1400]))
+            n.on_watermark(Watermark(ts=2500))
+        for n in (tiered, plain):
+            n._drain_async_emits()
+        assert _flat(out_t) == _flat(out_p)
+        assert tiered.tier.demoted_total == 2

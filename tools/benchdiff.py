@@ -65,6 +65,12 @@ HEADLINE = (
     # and the predicate-lifted shared fold's dedup ratio must hold
     ("phases.filter_heavy.rows_per_sec", 0.15),
     ("phases.multi_rule_shared_mixed.mixed_where_dedup_ratio", 0.10),
+    # tiered key state (ISSUE 13): sustained rows/s and emit tail while
+    # the cold tier absorbs a 1M->10M cardinality sweep under a fixed
+    # HBM budget — a tiering-policy regression (demote storms stalling
+    # folds, promote misses) shows up in exactly these two
+    ("phases.key_cardinality.rows_per_sec", 0.15),
+    ("phases.key_cardinality.emit_p99_ms", 0.50),
 )
 
 #: default noise tolerance for every non-headline comparison

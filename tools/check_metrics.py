@@ -136,6 +136,25 @@ def _synthetic_scrape() -> str:
     owner = MemOwner()
     memwatch.register("lint_component", owner, lambda o: 4096,
                       rule="lint_rule")
+    # tiered key state (ops/tierstore.py): one registered manager so all
+    # four kuiper_spill_*/kuiper_tier_host_bytes families render samples
+    from ekuiper_tpu.ops import tierstore
+
+    class FakeTierStore:
+        def __len__(self):
+            return 2
+
+        def nbytes(self):
+            return 4096
+
+    class FakeTier:
+        demoted_total = 3
+        promoted_total = 1
+        prefetch_hits = 0
+        store = FakeTierStore()
+
+    tier_mgr = FakeTier()
+    tierstore.registry().register(tier_mgr, "lint_rule")
     # health plane: an installed evaluator with one ticked verdict so the
     # kuiper_rule_health / kuiper_slo_burn_rate / kuiper_watermark_lag_ms
     # / kuiper_bottleneck_stage families all render samples
@@ -163,7 +182,9 @@ def _synthetic_scrape() -> str:
         devwatch.registry().clear()
         kernwatch.reset()
         memwatch.registry().clear()
+        tierstore.reset()
         del owner
+        del tier_mgr
 
 
 def lint(text: str, docs_text: str) -> list:

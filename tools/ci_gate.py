@@ -10,6 +10,8 @@ passed):
   jitcert diff      observed XLA signatures ⊆ certificates (CPU battery)
   probe_exprs       expression-IR smoke: CASE+IN+temporal rule plans
                     device-fused, fold parity, jitcert clean
+  probe_tiering     tiered key state smoke: demote/promote parity,
+                    slot recycling, cross-tier checkpoint, jitcert clean
   check_metrics     Prometheus catalog lint (synthetic scrape vs docs)
   benchdiff --smoke trajectory-gate self-test (synthetic artifacts)
 
@@ -40,6 +42,7 @@ GATES: Dict[str, List[str]] = {
     "jitcert_certify": [sys.executable, "-m", "tools.jitcert", "certify"],
     "jitcert_diff": [sys.executable, "-m", "tools.jitcert", "diff"],
     "probe_exprs": [sys.executable, "tools/probe_exprs.py"],
+    "probe_tiering": [sys.executable, "tools/probe_tiering.py"],
     "check_metrics": [sys.executable, "tools/check_metrics.py"],
     "benchdiff_smoke": [sys.executable, "tools/benchdiff.py", "--smoke"],
 }

@@ -84,6 +84,19 @@ def _battery():
     mr_spec = build_rule_batch(
         ["jc_r1", "jc_r2"],
         [parse_select(q) for q in mr_sqls])
+    # tiered kernel (ops/tierstore.py): the touch column changes EVERY
+    # groupby site's state signature, and the demote/promote gather/
+    # scatter sites get their own certificates — both derive here and
+    # drive in the diff battery (incl. a grow across a doubling)
+    from ekuiper_tpu.ops.tierstore import TierLayout, TierStore
+
+    tiered = plan("SELECT deviceId, avg(v) AS a, min(v) AS mn FROM s "
+                  "GROUP BY deviceId, HOPPINGWINDOW(ss, 2, 1)")
+    tiered_gb = DeviceGroupBy(tiered, capacity=32, n_panes=2,
+                              micro_batch=16, track_touch=True)
+    tier_store = TierStore(
+        tiered_gb, TierLayout(hot_slots=16, demote_batch=4,
+                              scan_interval_ms=500, min_idle_scans=1))
     return {
         "groupby_tumbling": DeviceGroupBy(tumbling, capacity=32,
                                           n_panes=1, micro_batch=16),
@@ -97,6 +110,8 @@ def _battery():
                                     micro_batch=16),
         "sketch": CountMinSketch(depth=2, width=64, max_candidates=16),
         "sliding_ring": sliding_ring,
+        "groupby_tiered": tiered_gb,
+        "tier_store": tier_store,
     }
 
 
@@ -179,6 +194,24 @@ def _drive(kernels) -> None:
         return cols, valid, slots, pane
 
     for name, gb in kernels.items():
+        if name == "tier_store":
+            # demote/promote across a capacity doubling: the gather/
+            # scatter re-specialization must stay inside the certified
+            # ladder (the paired groupby_tiered kernel drives the
+            # touch-bearing fold/finalize family via the generic loop)
+            gb2 = gb.gb
+            state = gb2.init_state()
+            cols, valid, slots, pane = feed(gb2, with_masks=False,
+                                            pane_vec=False)
+            state = gb2.fold(state, cols, slots, pane_idx=pane)
+            state, packed = gb.demote(state, np.array([1, 2], np.int32))
+            state = gb.promote(state, np.asarray(packed)[:2],
+                               np.array([1, 2], np.int32))
+            state = gb2.grow(state, gb2.capacity * 2)
+            state, packed = gb.demote(state, np.array([1], np.int32))
+            state = gb.promote(state, np.asarray(packed)[:1],
+                               np.array([1], np.int32))
+            continue
         if name == "sketch":
             gb.update(np.arange(10, dtype=np.float32))
             gb.update(np.arange(300, dtype=np.float32))  # next pad bucket

@@ -417,6 +417,28 @@ class SigStability(Pass):
                     for op in node.ops):
                 for sub in ast.walk(node):
                     allowed.add(id(sub))
+            elif isinstance(node, ast.Compare) and all(
+                    isinstance(op, (ast.In, ast.NotIn))
+                    for op in node.ops) and all(
+                    isinstance(c, ast.Name) for c in node.comparators
+                    ) and (
+                    (isinstance(node.left, ast.Constant)
+                     and isinstance(node.left.value, str))
+                    or (isinstance(node.left, ast.Name)
+                        and node.left.id not in tainted)):
+                # `"key" in state` on a traced pytree tests STRUCTURE
+                # (dict membership), which is static under tracing —
+                # same class as `x is None`. Exempt only the narrow
+                # form: string-constant or untainted-name KEY against a
+                # bare-Name container (the groupby/tierstore state-dict
+                # idiom). `traced_val in x`, `3 in traced_array`, and
+                # membership on attribute/subscript containers all stay
+                # flagged. Known residual: `i in traced_arr` with an
+                # untainted scalar `i` and a bare-Name array passes —
+                # no static signal separates a dict param from an array
+                # param here.
+                for sub in ast.walk(node):
+                    allowed.add(id(sub))
         return [n.id for n in ast.walk(test)
                 if isinstance(n, ast.Name) and n.id in tainted
                 and id(n) not in allowed]

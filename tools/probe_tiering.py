@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""probe_tiering — tier-1 smoke for the tiered key state
+(ops/tierstore.py, docs/TIERED_STATE.md).
+
+Builds a hopping-window fused node with a deliberately tiny HBM budget
+so the tier layer engages, streams keys past the hot target, forces a
+demotion round, lets demoted keys reappear, and asserts:
+
+  1. the tier engages (layout planned, touch column in the state
+     pytree, key table logging new keys),
+  2. emission parity: the tiered node's windows carry exactly the
+     untiered reference node's groups and values — demotion, spilled
+     host-side emission, and promotion are invisible in the output,
+  3. slots recycle: demoted keys' slots serve new keys without growing
+     the device capacity,
+  4. cross-tier checkpoint: a snapshot taken with keys demoted restores
+     into a fresh node that keeps answering exactly,
+  5. every traced signature (fold with the touch column,
+     tierstore.demote/promote) is inside its jitcert certificate
+     (diff_live clean).
+
+Run directly or through tools/ci_gate.py (gate name `probe_tiering`).
+Exit 0 on success.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+SQL = ("SELECT deviceId, sum(v) AS s, count(*) AS c, min(v) AS mn "
+       "FROM demo GROUP BY deviceId, HOPPINGWINDOW(ss, 4, 2)")
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from ekuiper_tpu.data.batch import ColumnBatch
+    from ekuiper_tpu.observability import jitcert
+    from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+    from ekuiper_tpu.ops.emit import build_direct_emit
+    from ekuiper_tpu.runtime.events import Trigger
+    from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+    from ekuiper_tpu.sql.parser import parse_select
+    from ekuiper_tpu.utils import timex
+
+    timex.set_mock_clock(0)
+    problems = []
+    stmt = parse_select(SQL)
+    plan = extract_kernel_plan(stmt)
+    assert plan is not None
+
+    def mk(tier_mb):
+        n = FusedWindowAggNode(
+            "probe_tier", stmt.window, plan,
+            [d.expr for d in stmt.dimensions],
+            capacity=64, micro_batch=128, prefinalize_lead_ms=0,
+            direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+            emit_columnar=False, tier_budget_mb=tier_mb)
+        n.state = n.gb.init_state()
+        out = []
+        n.emit = lambda item, count=None, _o=out: _o.append(item)
+        return n, out
+
+    tiered, out_t = mk(0.001)  # tiny budget -> layout engages
+    plain, out_p = mk(0.0)
+    if tiered.tier is None:
+        problems.append("tier did not engage under a tight budget")
+        print(json.dumps({"ok": False, "problems": problems}))
+        return 1
+    if "touch" not in tiered.state:
+        problems.append("touch column missing from the state pytree")
+
+    rng = np.random.default_rng(7)
+
+    def batch(ids, vals):
+        ids = np.array(ids, dtype=np.object_)
+        return ColumnBatch(
+            n=len(ids),
+            columns={"deviceId": ids,
+                     "v": np.asarray(vals, np.float64)},
+            timestamps=np.zeros(len(ids), np.int64), emitter="demo")
+
+    def feed(ids):
+        vals = np.rint(rng.normal(50, 10, len(ids))).astype(np.float64)
+        b1, b2 = batch(list(ids), vals), batch(list(ids), vals)
+        tiered.process(b1)
+        plain.process(b2)
+
+    def boundary(ts):
+        tiered.on_trigger(Trigger(ts=ts))
+        plain.on_trigger(Trigger(ts=ts))
+
+    # round 1: a cold tail of keys + a hot core
+    feed([f"cold{i}" for i in range(24)] + ["hot0", "hot1"])
+    boundary(2000)
+    # force a demotion plan for the cold tail (the policy worker would
+    # choose these after idle scans; the probe pins the decision)
+    cold_slots = [i for i in range(24)]
+    tiered.tier._plan = cold_slots
+    tiered._tier_boundary()
+    demoted = tiered.tier.demoted_total
+    if demoted == 0:
+        problems.append("no slots demoted")
+    free_before = len(tiered.kt.free_slots())
+    cap_before = tiered.gb.capacity
+    # round 2: half the cold keys reappear (promotion), new keys arrive
+    # (must recycle freed slots, not grow)
+    feed([f"cold{i}" for i in range(0, 24, 2)]
+         + [f"new{i}" for i in range(8)] + ["hot0", "hot1"])
+    boundary(4000)
+    if tiered.tier.promoted_total + tiered.tier.recycled_total == 0:
+        problems.append("no promotions/recycles after reappearance")
+    if tiered.gb.capacity != cap_before:
+        problems.append(
+            f"capacity grew {cap_before}->{tiered.gb.capacity} despite "
+            f"{free_before} free slots")
+    boundary(6000)
+    tiered._drain_async_emits()
+    plain._drain_async_emits()
+
+    def flat(msgs):
+        rows = {}
+        for m in msgs:
+            for r in (m if isinstance(m, list) else [m]):
+                k = tuple(sorted(r.items()))
+                rows[k] = rows.get(k, 0) + 1
+        return rows
+
+    if flat(out_t) != flat(out_p):
+        a, b = flat(out_t), flat(out_p)
+        diff = set(a.items()) ^ set(b.items())
+        problems.append(f"emission mismatch vs untiered: {list(diff)[:4]}")
+
+    # cross-tier checkpoint: snapshot with keys demoted, restore fresh
+    snap = tiered.snapshot_state()
+    restored, out_r = mk(0.001)
+    restored.restore_state(snap)
+    if len(restored.tier.store) != len(tiered.tier.store):
+        problems.append("cold tier did not survive the checkpoint")
+    out_t.clear()
+    feed2 = [f"cold{i}" for i in range(1, 24, 2)]  # still-demoted keys
+    vals = np.ones(len(feed2), np.float64)
+    restored.process(batch(feed2, vals))
+    tiered.process(batch(feed2, vals))
+    restored.on_trigger(Trigger(ts=8000))
+    tiered.on_trigger(Trigger(ts=8000))
+    restored._drain_async_emits()
+    tiered._drain_async_emits()
+    if flat(out_r) != flat(out_t):
+        problems.append("restored node diverged from the live node")
+
+    d = jitcert.diff_live()
+    if not d["clean"]:
+        problems.append(
+            "jitcert diff not clean: "
+            + "; ".join(f"{u['op']}: {u['signature'][:80]}"
+                        for u in d["uncertified"][:3]))
+
+    report = {
+        "ok": not problems,
+        "problems": problems,
+        "demoted": tiered.tier.demoted_total,
+        "promoted": tiered.tier.promoted_total,
+        "recycled": tiered.tier.recycled_total,
+        "resident": len(tiered.tier.store),
+        "host_bytes": tiered.tier.store.nbytes(),
+        "free_slots": len(tiered.kt.free_slots()),
+        "jitcert_clean": d["clean"],
+    }
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
