@@ -225,6 +225,11 @@ def render(rule_registry) -> str:
     from ..ops import tierstore
 
     tierstore.render_prometheus(out, _esc)
+    # multi-chip sharded serving (parallel/sharded.py): per-shard fold
+    # rows and key occupancy for every live mesh kernel
+    from ..parallel import sharded as _sharded
+
+    _sharded.render_prometheus(out, _esc)
     # expression host fallbacks (sql/compiler.py counters): plan-time
     # count of expressions routed to the row interpreter, by structured
     # NotVectorizable reason — the metric the health plane's bottleneck

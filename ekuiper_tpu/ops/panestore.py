@@ -162,10 +162,12 @@ class PaneStore:
 
     def __init__(self, plan: KernelPlan, pane_ms: int, n_panes: int,
                  capacity: int = 16384, micro_batch: int = 4096,
-                 tier_budget_mb: Optional[float] = None) -> None:
+                 tier_budget_mb: Optional[float] = None,
+                 mesh=None) -> None:
         self.plan = plan
         self.pane_ms = int(pane_ms)
         self.n_panes = int(n_panes)
+        self.mesh = mesh
         # tiered key state (ops/tierstore.py): the shared store recycles
         # slots of QUIESCENT keys only (a cold key's pane data expires
         # with the ring, so no member window ever misses it); budget
@@ -178,16 +180,32 @@ class PaneStore:
 
             tier_budget_mb = env_hbm_budget_mb()
         layout = None
-        if tier_budget_mb and not any(s.kind == "heavy_hitters"
-                                      for s in plan.specs):
+        if tier_budget_mb and mesh is None and not any(
+                s.kind == "heavy_hitters" for s in plan.specs):
+            # mesh-sharded stores keep the untiered path (the cold tier
+            # is single-chip machinery; ROADMAP names a peer-chip tier
+            # as the follow-up)
             from .tierstore import plan_tier_layout
 
             layout = plan_tier_layout(plan, self.n_panes, capacity,
                                       float(tier_budget_mb),
                                       window_ms=self.pane_ms)
-        self.gb = DeviceGroupBy(plan, capacity=capacity, n_panes=self.n_panes,
-                                micro_batch=micro_batch,
-                                track_touch=layout is not None)
+        if mesh is not None:
+            # key-range-partitioned shared store: the pane ring shards
+            # over the mesh's "keys" axis exactly like a private sharded
+            # rule's state; folds/combines run through the SPMD kernel
+            # (parallel/sharded.py), one pooled fold per batch serving
+            # every member — now across every chip
+            from ..parallel.sharded import ShardedGroupBy
+
+            self.gb = ShardedGroupBy(plan, mesh, capacity=capacity,
+                                     n_panes=self.n_panes,
+                                     micro_batch=micro_batch)
+        else:
+            self.gb = DeviceGroupBy(plan, capacity=capacity,
+                                    n_panes=self.n_panes,
+                                    micro_batch=micro_batch,
+                                    track_touch=layout is not None)
         self.kt = KeyTable(self.gb.capacity)
         self.tier = None
         if layout is not None:
@@ -295,7 +313,9 @@ class PaneStore:
         if partials:
             host, cap = self.gb.host_from_partials(partials)
             self.gb.capacity = cap
-            self.kt.capacity = max(self.kt.capacity, cap)
+            # sharded stores may round the capacity up for even shard
+            # division (mesh-size-change tolerance) — kt follows
             self.state = self.gb.state_from_host(host)
+            self.kt.capacity = max(self.kt.capacity, self.gb.capacity)
         if self.tier is not None and snap.get("tier"):
             self.tier.restore(snap["tier"])

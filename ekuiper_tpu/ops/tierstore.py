@@ -47,7 +47,6 @@ the exactness argument, and the knobs.
 from __future__ import annotations
 
 import threading
-import weakref
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -380,29 +379,10 @@ class HostTierStore:
 
 
 # -------------------------------------------------------------- telemetry
-class _TierRegistry:
-    """Weakref registry of live TierManagers — the kuiper_spill_* /
-    kuiper_tier_host_bytes render source (memwatch's ownership model)."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._refs: List[Tuple[Any, str]] = []
-
-    def register(self, mgr, rule: str) -> None:
-        with self._lock:
-            self._refs = [(r, ru) for (r, ru) in self._refs
-                          if r() is not None]
-            self._refs.append((weakref.ref(mgr), rule))
-
-    def managers(self) -> List[Tuple[Any, str]]:
-        with self._lock:
-            refs = list(self._refs)
-        return [(m, rule) for (r, rule) in refs if (m := r()) is not None]
-
-    def clear(self) -> None:
-        with self._lock:
-            self._refs.clear()
-
+# weakref registry of live TierManagers — the kuiper_spill_* /
+# kuiper_tier_host_bytes render source (utils/weakreg.py, THE shared
+# ownership model)
+from ..utils.weakreg import WeakRegistry as _TierRegistry
 
 _registry = _TierRegistry()
 

@@ -15,7 +15,8 @@ emits all_gather over "keys" only at window triggers.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import os
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -77,6 +78,46 @@ def ensure_devices(n: int):
         f"— run in a subprocess with JAX_PLATFORMS=cpu and "
         f"--xla_force_host_platform_device_count={n}"
     )
+
+
+def mesh_cfg_from_env() -> Optional[Dict[str, Any]]:
+    """Parse the deployment-wide KUIPER_MESH env into a mesh config dict:
+    "RxK" (rows x keys), a bare shard count K (keys axis), or "auto"
+    (all local devices on the keys axis, resolved at mesh-build time).
+    Unset / "0" / "off" / "none" -> None. Parse errors return None with
+    nothing raised — a malformed env var must not take rule planning
+    down; the planner logs the single-chip fallback it causes."""
+    raw = os.environ.get("KUIPER_MESH", "").strip().lower()
+    if not raw or raw in ("0", "off", "none", "1"):
+        return None
+    if raw == "auto":
+        return {"auto": True}
+    try:
+        if "x" in raw:
+            rows_s, keys_s = raw.split("x", 1)
+            rows, keys = int(rows_s), int(keys_s)
+        else:
+            rows, keys = 1, int(raw)
+    except ValueError:
+        return None
+    if rows < 1 or keys < 1 or rows * keys < 2:
+        return None
+    return {"rows": rows, "keys": keys}
+
+
+def resolve_auto_cfg(cfg: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Turn an {"auto": True} config into a concrete {"rows", "keys"}
+    using the devices this process can already see (never provisions or
+    resets backends). None when the host has fewer than 2 devices —
+    auto sharding on a single chip is just the single-chip kernel."""
+    if not cfg.get("auto"):
+        return cfg
+    import jax
+
+    n = len(jax.devices())
+    if n < 2:
+        return None
+    return {"rows": 1, "keys": n}
 
 
 def mesh_from_options(mesh_cfg: dict):

@@ -50,11 +50,11 @@ class ShardedGroupBy(DeviceGroupBy):
     # finalize runs collective gathers across the mesh; the pre-issued
     # emit pipeline (ops/prefinalize.py) is single-chip only for now
     supports_prefinalize = False
-    accepts_device_inputs = False  # fold shards host arrays over the mesh
 
     def __init__(
         self, plan: KernelPlan, mesh, capacity: int = 16384,
         n_panes: int = 1, micro_batch: int = 4096,
+        track_touch: bool = False,
     ) -> None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -66,7 +66,8 @@ class ShardedGroupBy(DeviceGroupBy):
         capacity = -(-int(capacity) // K) * K
         micro_batch = -(-int(micro_batch) // R) * R
         super().__init__(plan, capacity=capacity, n_panes=n_panes,
-                         micro_batch=micro_batch)
+                         micro_batch=micro_batch, track_touch=track_touch)
+        self.mesh_tag = f"{R}x{K}"
         self.state_sharding = {
             comp: NamedSharding(
                 mesh,
@@ -76,6 +77,11 @@ class ShardedGroupBy(DeviceGroupBy):
             for comp in self.comp_specs
         }
         self.state_sharding["act"] = NamedSharding(mesh, P(None, "keys"))
+        if track_touch:
+            # tiered-state recency column (ops/tierstore.py): (capacity,)
+            # uint32, key axis 0 — same key-range partitioning as the
+            # pane state, so a later sharded tier reads local slices
+            self.state_sharding["touch"] = NamedSharding(mesh, P("keys"))
         self.batch_sharding = NamedSharding(mesh, P("rows"))
         self.scalar_sharding = NamedSharding(mesh, P())
         # meshes spanning processes can't device_put host data onto
@@ -86,11 +92,25 @@ class ShardedGroupBy(DeviceGroupBy):
         self.multiprocess = any(
             d.process_index != jax.process_index()
             for d in np.asarray(mesh.devices).flat)
+        # the zero-copy ingest-prep upload stage (runtime/ingest.py) can
+        # pre-place batch columns/slots with this kernel's row sharding —
+        # single-process meshes only (multi-host data arrives as local
+        # slices through _put)
+        self.accepts_device_inputs = not self.multiprocess
         self._fold = self._build_fold()  # replaces the single-chip jit
         # per-row pane-vector variant (event-time multi-bucket batches);
         # built lazily — most rules never need it
         self._fold_vec = None
         self._all_true = None  # cached device ones-mask (common no-null case)
+        # per-shard observability (kuiper_shard_* families): rows folded
+        # into each shard's key range, counted host-side off the slot
+        # vector (one bincount per batch), plus a key-occupancy hint the
+        # driving node refreshes from its KeyTable
+        self.shard_rows = np.zeros(K, dtype=np.int64)
+        self.n_keys_hint = 0
+        from ..utils.rulelog import current_rule
+
+        _registry.register(self, current_rule())
 
     def _put(self, arr, sharding):
         """Host→mesh placement that also works when the mesh spans
@@ -121,22 +141,57 @@ class ShardedGroupBy(DeviceGroupBy):
         out: Dict[str, Any] = {}
         for comp, arr in state.items():
             np_arr = np.asarray(arr)
+            # the touch column is (capacity,), not pane-scoped — key axis
+            # 0 there, axis 1 everywhere else; its uint32 dtype rides
+            # np_arr.dtype (ops/groupby.py grew the same special case)
+            key_axis = 0 if comp == "touch" else 1
             pad_shape = list(np_arr.shape)
-            pad_shape[1] = new_capacity - np_arr.shape[1]
+            pad_shape[key_axis] = new_capacity - np_arr.shape[key_axis]
             pad = np.full(pad_shape, _INIT[comp], dtype=np_arr.dtype)
             out[comp] = self._put(
-                np.concatenate([np_arr, pad], axis=1), self.state_sharding[comp]
+                np.concatenate([np_arr, pad], axis=key_axis),
+                self.state_sharding[comp]
             )
         self.capacity = new_capacity
         return out
 
     def state_from_host(self, host: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """Host partials -> mesh-sharded device state. Mesh-size-change
+        tolerant: a checkpoint taken on a different shard count (incl.
+        the single-chip kernel, K=1) may carry a capacity that does not
+        divide this mesh's K — pad the key axis up to divisibility with
+        each component's identity (the extra slots are unassigned; the
+        KeyTable's dense slot ids are placement-independent, so every
+        restored slot keeps its key). The uint32 touch column keeps its
+        dtype (np.asarray preserves it; host_from_partials already
+        typed it)."""
         import jax
 
-        return {
-            k: self._put(np.asarray(v), self.state_sharding[k])
-            for k, v in host.items()
-        }
+        K = self.n_keys_shards
+        out: Dict[str, Any] = {}
+        cap = None
+        for k, v in host.items():
+            np_arr = np.asarray(v)
+            key_axis = 0 if k == "touch" else 1
+            c = np_arr.shape[key_axis]
+            rounded = -(-int(c) // K) * K
+            if rounded != c:
+                pad_shape = list(np_arr.shape)
+                pad_shape[key_axis] = rounded - c
+                pad = np.full(pad_shape, _INIT.get(k, 0.0),
+                              dtype=np_arr.dtype)
+                np_arr = np.concatenate([np_arr, pad], axis=key_axis)
+            cap = rounded if cap is None else max(cap, rounded)
+            sharding = self.state_sharding.get(k)
+            if sharding is None:
+                # a checkpoint component this kernel form doesn't track
+                # (host_from_partials should have dropped it) — replicate
+                # rather than crash the restore
+                sharding = self.scalar_sharding
+            out[k] = self._put(np_arr, sharding)
+        if cap is not None:
+            self.capacity = int(cap)
+        return out
 
     # ------------------------------------------------------------------- fold
     def _build_fold(self):
@@ -192,6 +247,13 @@ class ShardedGroupBy(DeviceGroupBy):
             out["act"] = state["act"].at[pane_idx].add(
                 jax.lax.psum(act_add, "rows")
             )
+            if "touch" in state:
+                # tier recency signal (ops/tierstore.py): per-slot touched-
+                # row count, key axis sharded like the pane state — each
+                # device's row shard contributes, one psum merges
+                t_add = jnp.zeros((cap_per_shard,), jnp.uint32).at[local].add(
+                    base.astype(jnp.uint32))
+                out["touch"] = state["touch"] + jax.lax.psum(t_add, "rows")
             for comp, spec_idxs in comp_specs.items():
                 arr = state[comp]
                 parts = []
@@ -257,6 +319,8 @@ class ShardedGroupBy(DeviceGroupBy):
             for comp in comp_specs
         }
         state_specs["act"] = P(None, "keys")
+        if self.track_touch:
+            state_specs["touch"] = P("keys")
         cols_specs: Dict[str, Any] = {}
         for name in plan.columns:
             cols_specs[name] = P("rows")
@@ -326,6 +390,10 @@ class ShardedGroupBy(DeviceGroupBy):
             act_add = (jnp.zeros((n_panes, cap_per_shard), jnp.float32)
                        .at[pv, local].add(base.astype(jnp.float32)))
             out["act"] = state["act"] + jax.lax.psum(act_add, "rows")
+            if "touch" in state:
+                t_add = jnp.zeros((cap_per_shard,), jnp.uint32).at[local].add(
+                    base.astype(jnp.uint32))
+                out["touch"] = state["touch"] + jax.lax.psum(t_add, "rows")
             for comp, spec_idxs in comp_specs.items():
                 arr = state[comp]
                 parts = []
@@ -387,6 +455,8 @@ class ShardedGroupBy(DeviceGroupBy):
             for comp in comp_specs
         }
         state_specs["act"] = P(None, "keys")
+        if self.track_touch:
+            state_specs["touch"] = P("keys")
         cols_specs: Dict[str, Any] = {}
         for name in plan.columns:
             cols_specs[name] = P("rows")
@@ -418,8 +488,9 @@ class ShardedGroupBy(DeviceGroupBy):
         """Host entry: chunk/pad to the static micro_batch, upload with
         row shardings, run the SPMD step. Signature matches DeviceGroupBy
         so FusedWindowAggNode drives either interchangeably (n_rows is the
-        pre-padded-inputs convention; this path always re-pads host arrays
-        so it only overrides the row count)."""
+        pre-padded-inputs convention — the mesh-aware ingest prep hands
+        columns/slots already padded AND placed with this kernel's row
+        sharding, single-chunk by contract; host arrays re-pad here)."""
         import jax
         import jax.numpy as jnp
 
@@ -429,12 +500,70 @@ class ShardedGroupBy(DeviceGroupBy):
         mb = self.micro_batch
         valid = valid or {}
         cols = materialize_hll_columns(self.plan.columns, cols, n)
+        if isinstance(slots, np.ndarray):
+            # per-shard row accounting (kuiper_shard_rows_total) off the
+            # host slot vector; the prep path's device slots are counted
+            # by the driving node (it still holds the host vector)
+            self.note_rows(slots, n)
         pane_vec = pane_idx if isinstance(pane_idx, np.ndarray) else None
         if pane_vec is not None and self._fold_vec is None:
             self._fold_vec = self._build_fold_vec()
         pane = None if pane_vec is not None else self._put(
             jnp.asarray(pane_idx, dtype=jnp.int32), self.scalar_sharding
         )
+        # pre-padded device inputs (runtime/ingest.py pad_*_for_device
+        # with this kernel's shardings): single-chunk by contract — use
+        # them as-is, fill absent masks with the cached all-true buffer
+        has_dev = isinstance(slots, jax.Array) or any(
+            isinstance(cols.get(name), jax.Array)
+            for name in self.plan.columns)
+        if has_dev:
+            assert n <= mb, "pre-uploaded device inputs must be one chunk"
+            if n <= 0:
+                return state
+            dev_cols = {}
+            for name in self.plan.columns:
+                c = cols[name]
+                if isinstance(c, jax.Array):
+                    dev_cols[name] = c
+                else:
+                    arr = np.asarray(c[:n], dtype=np.float32)
+                    if n < mb:
+                        arr = np.pad(arr, (0, mb - n))
+                    dev_cols[name] = self._put(arr, self.batch_sharding)
+                vm = valid.get(name)
+                if isinstance(vm, jax.Array):
+                    dev_cols["__valid_" + name] = vm
+                elif vm is not None:
+                    m = np.asarray(vm[:n], dtype=np.bool_)
+                    if n < mb:
+                        m = np.pad(m, (0, mb - n))
+                    dev_cols["__valid_" + name] = self._put(
+                        m, self.batch_sharding)
+                else:
+                    if self._all_true is None:
+                        self._all_true = self._put(
+                            np.ones(mb, dtype=np.bool_),
+                            self.batch_sharding)
+                    dev_cols["__valid_" + name] = self._all_true
+            if isinstance(slots, jax.Array):
+                s_dev = slots
+            else:
+                s = np.asarray(slots[:n], dtype=np.int32)
+                if n < mb:
+                    s = np.pad(s, (0, mb - n))
+                s_dev = self._put(s, self.batch_sharding)
+            rv = np.zeros(mb, dtype=np.bool_)
+            rv[:n] = True
+            rv_dev = self._put(rv, self.batch_sharding)
+            if pane_vec is not None:
+                pv = np.asarray(pane_vec[:n], dtype=np.int32)
+                if n < mb:
+                    pv = np.pad(pv, (0, mb - n))
+                return self._fold_vec(
+                    state, dev_cols, s_dev, rv_dev,
+                    self._put(pv, self.batch_sharding))
+            return self._fold(state, dev_cols, s_dev, rv_dev, pane)
         for start in range(0, max(n, 1), mb):
             end = min(start + mb, n)
             cnt = end - start
@@ -493,5 +622,111 @@ class ShardedGroupBy(DeviceGroupBy):
 
     # finalize / reset_pane / state_to_host / observe_dtypes inherited from
     # DeviceGroupBy: they are plain jit over the (sharded) state arrays, so
-    # XLA keeps the capacity axis sharded and gathers only at the final
-    # np.asarray device->host transfer.
+    # the whole finalize (pane merge + final values) runs LOCAL per shard —
+    # XLA keeps the capacity axis sharded end-to-end and the only cross-
+    # shard movement is the host-side assembly of the per-shard result
+    # slices at the final np.asarray device->host transfer (the "host-side
+    # merge at window boundaries" of docs/DISTRIBUTED.md).
+
+    # ------------------------------------------------------- observability
+    def note_rows(self, slots: np.ndarray, n: Optional[int] = None,
+                  n_keys: Optional[int] = None) -> None:
+        """Accrue per-shard fold rows off a HOST slot vector (the shard of
+        slot s is s // (capacity/K)). One bincount per batch — the
+        kuiper_shard_rows_total source. `n_keys` refreshes the occupancy
+        hint (the driving node's KeyTable count)."""
+        if n is not None:
+            slots = slots[:n]
+        if n_keys is not None:
+            self.n_keys_hint = int(n_keys)
+        if len(slots) == 0:
+            return
+        K = self.n_keys_shards
+        cap_per_shard = max(self.capacity // K, 1)
+        shard = np.minimum(
+            np.asarray(slots, dtype=np.int64) // cap_per_shard, K - 1)
+        self.shard_rows += np.bincount(shard, minlength=K)[:K]
+
+    def shard_stats(self, state: Optional[Dict[str, Any]] = None
+                    ) -> List[Dict[str, Any]]:
+        """Per-shard view for metrics/diagnostics/bench: rows folded into
+        each shard's key range, key slots it owns (from the occupancy
+        hint), and its share of the state bytes. Pure host math — never
+        syncs the device."""
+        K = self.n_keys_shards
+        cap_per_shard = max(self.capacity // K, 1)
+        state_bytes = 0
+        if state is not None:
+            state_bytes = sum(int(getattr(a, "nbytes", 0) or 0)
+                              for a in state.values())
+        out = []
+        for i in range(K):
+            keys = min(max(self.n_keys_hint - i * cap_per_shard, 0),
+                       cap_per_shard)
+            out.append({
+                "shard": i,
+                "rows": int(self.shard_rows[i]),
+                "keys": int(keys),
+                "slots": cap_per_shard,
+                "state_bytes": state_bytes // K,
+            })
+        return out
+
+
+# ----------------------------------------------------------- shard registry
+# weakref index of live sharded kernels for the kuiper_shard_* families
+# (utils/weakreg.py — THE shared ownership model, also tierstore's)
+from ..utils.weakreg import WeakRegistry as _Registry
+
+_registry = _Registry()
+
+
+def registry() -> _Registry:
+    return _registry
+
+
+def reset() -> None:
+    """Test hook."""
+    _registry.clear()
+
+
+def render_prometheus(out: List[str], esc) -> None:
+    """Append the per-shard serving families to a /metrics scrape."""
+    fams = (
+        ("kuiper_shard_rows_total", "counter",
+         "rows folded into each mesh shard's key range",
+         lambda st: st["rows"]),
+        ("kuiper_shard_keys", "gauge",
+         "key slots occupied in each mesh shard's range",
+         lambda st: st["keys"]),
+    )
+    kernels = _registry.items()
+    for name, mtype, help_txt, fn in fams:
+        out.append(f"# TYPE {name} {mtype}")
+        out.append(f"# HELP {name} {help_txt}")
+        # aggregate per (rule, shard) label pair: duplicate sample lines
+        # would fail the whole Prometheus scrape
+        agg: Dict[Tuple[str, int], int] = {}
+        for kernel, rule in kernels:
+            label = rule or "__engine__"
+            try:
+                for st in kernel.shard_stats():
+                    key = (label, st["shard"])
+                    agg[key] = agg.get(key, 0) + int(fn(st))
+            except Exception:
+                continue
+        for (label, shard), v in sorted(agg.items()):
+            out.append(f'{name}{{rule="{esc(label)}",shard="{shard}"}} {v}')
+
+
+def diagnostics() -> List[Dict[str, Any]]:
+    """Per-kernel shard state for GET /diagnostics + kuiperdiag."""
+    rows = []
+    for kernel, rule in _registry.items():
+        rows.append({
+            "rule": rule or "__engine__",
+            "mesh": kernel.mesh_tag,
+            "capacity": int(kernel.capacity),
+            "shards": kernel.shard_stats(),
+        })
+    return rows

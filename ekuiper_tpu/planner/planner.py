@@ -93,6 +93,69 @@ def resolve_tier_budget_mb(opts: RuleOptionConfig) -> float:
     return max(budget, 0.0)
 
 
+def mesh_request(opts: RuleOptionConfig, plan=None) -> Dict[str, Any]:
+    """The sharding decision for one rule, WITHOUT building a mesh (pure
+    option/env parse — safe for explain, sharing store keys, and QoS
+    pricing). Resolution order:
+
+      1. `planOptimizeStrategy.mesh = {"rows": R, "keys": K}` — explicit
+         geometry (the original opt-in; build failures are PlanErrors).
+      2. `planOptimizeStrategy.shards = "auto" | K | "off"` — the serving
+         mode: "auto" takes KUIPER_MESH when set, else every local device
+         on the keys axis; an integer K puts K shards on the keys axis;
+         "off"/0 pins the rule single-chip even under KUIPER_MESH.
+      3. `KUIPER_MESH` env ("RxK", "K", or "auto") — the deployment-wide
+         default for rules that say nothing.
+
+    Returns {"mode": "sharded"|"single-chip", "cfg": dict|None,
+    "source": str|None, "reason": str}. Auto/env selections degrade to
+    single-chip (never PlanError) — the fallback reason lands in the
+    explain "shards" section and the planner log."""
+    from ..parallel.mesh import mesh_cfg_from_env
+
+    strategy = getattr(opts, "plan_optimize_strategy", None) or {}
+    explicit = strategy.get("mesh")
+    if explicit:
+        return {"mode": "sharded", "cfg": dict(explicit),
+                "source": "planOptimizeStrategy.mesh",
+                "reason": "explicit mesh geometry"}
+    shards = strategy.get("shards")
+    cfg, source = None, None
+    if shards is not None:
+        s = str(shards).strip().lower()
+        if s in ("0", "off", "none", "false", "1"):
+            return {"mode": "single-chip", "cfg": None,
+                    "source": f"shards={shards}",
+                    "reason": "sharding disabled by rule option"}
+        if s == "auto":
+            cfg = mesh_cfg_from_env() or {"auto": True}
+            source = "shards=auto"
+        else:
+            try:
+                k = int(s)
+            except ValueError:
+                raise PlanError(
+                    f"invalid shards option {shards!r}: use 'auto', "
+                    "'off', or a shard count")
+            cfg = {"rows": 1, "keys": k}
+            source = f"shards={k}"
+    else:
+        cfg = mesh_cfg_from_env()
+        if cfg is not None:
+            source = "KUIPER_MESH"
+    if cfg is None:
+        return {"mode": "single-chip", "cfg": None, "source": None,
+                "reason": "no mesh requested"}
+    if plan is not None and any(
+            s.kind == "heavy_hitters" for s in plan.specs):
+        return {"mode": "single-chip", "cfg": None, "source": source,
+                "reason": "heavy_hitters state is node-local (value "
+                          "dictionary) — single-chip kernel"}
+    return {"mode": "sharded", "cfg": cfg, "source": source,
+            "reason": "key-range-partitioned GROUP BY state across the "
+                      "device mesh"}
+
+
 def merged_options(rule: RuleDef) -> RuleOptionConfig:
     base = get_config().rule
     opts = RuleOptionConfig(**{**base.__dict__})
@@ -884,14 +947,32 @@ def _build_device_chain(
     # emit tail when possible — the whole rule becomes fold + direct emit
     direct = build_direct_emit(stmt, kernel_plan, [d.name for d in dims])
     mesh = None
-    mesh_cfg = (opts.plan_optimize_strategy or {}).get("mesh")
-    if mesh_cfg:
-        from ..parallel.mesh import mesh_from_options
+    req = mesh_request(opts, kernel_plan)
+    shard_info: Dict[str, Any] = {k: req.get(k)
+                                  for k in ("mode", "source", "reason")}
+    if req["mode"] == "sharded":
+        from ..parallel.mesh import mesh_from_options, resolve_auto_cfg
 
+        cfg = req["cfg"]
+        explicit = req["source"] == "planOptimizeStrategy.mesh"
         try:
-            mesh = mesh_from_options(mesh_cfg)
+            resolved = resolve_auto_cfg(cfg)
+            if resolved is None:
+                raise ValueError("fewer than 2 devices visible")
+            mesh = mesh_from_options(resolved)
+            shard_info["mesh"] = dict(resolved)
+            shard_info["shards"] = int(resolved["keys"])
         except Exception as exc:
-            raise PlanError(f"cannot build device mesh {mesh_cfg}: {exc}")
+            if explicit:
+                raise PlanError(f"cannot build device mesh {cfg}: {exc}")
+            # auto/env selection degrades to the single-chip kernel —
+            # a deployment-wide KUIPER_MESH must not brick rule create
+            # on a 1-device box
+            mesh = None
+            shard_info = {"mode": "single-chip", "source": req["source"],
+                          "reason": f"mesh unavailable ({exc}) — "
+                                    "single-chip fallback"}
+            logger.info("rule %s: %s", rule_id, shard_info["reason"])
     # sliding ring geometry is chosen HERE, at plan time, from the
     # window/delay/pane declarations (ops/slidingring.py) — the node and
     # the jitcert certificates both consume the same layout
@@ -930,6 +1011,7 @@ def _build_device_chain(
         tier_budget_mb=tier_budget_mb,
         tier_scan_ms=opts.tier_scan_ms,
     )
+    fused.shard_info = shard_info  # explain/status "shards" section twin
     topo.add_op(fused)
     # hand the kernel-input shape to the source's ingest prep at PLAN time
     # (runtime/ingest.py IngestPrepCtx): the decode pool's upload stage then
@@ -1126,6 +1208,52 @@ def explain(rule: RuleDef, store) -> Dict[str, Any]:
     out: Dict[str, Any] = {"path": path, "operators": ops}
     if sharing_info is not None:
         out["sharing"] = sharing_info
+    # shards section: the placement decision this rule's plan would make
+    # (docs/DISTRIBUTED.md serving mode) — resolved against the devices
+    # this process can see, but never building a mesh (explain is a probe)
+    if kernel_plan is not None:
+        try:
+            req = mesh_request(opts, kernel_plan)
+            info: Dict[str, Any] = {k: req.get(k)
+                                    for k in ("mode", "source", "reason")}
+            if req["mode"] == "sharded":
+                from ..parallel.mesh import resolve_auto_cfg
+
+                try:
+                    resolved = resolve_auto_cfg(req["cfg"])
+                except Exception:
+                    resolved = None
+                if resolved is None:
+                    info = {"mode": "single-chip",
+                            "source": req["source"],
+                            "reason": "mesh unavailable (fewer than 2 "
+                                      "devices) — single-chip fallback"}
+                else:
+                    info["mesh"] = dict(resolved)
+                    info["shards"] = int(resolved["keys"])
+            out["shards"] = info
+        except Exception as exc:  # explain must never fail on the probe
+            out["shards"] = {"mode": "unknown", "reason": str(exc)}
+    # sliding section (ISSUE 15 satellite): which sliding implementation
+    # this plan takes and WHY a DABA request falls back to the exact
+    # refold — the mesh ring is future work, so a sharded plan's refold
+    # must be attributable here and in the flight recorder, never silent
+    if kernel_plan is not None and stmt.window is not None and \
+            stmt.window.window_type == ast.WindowType.SLIDING_WINDOW:
+        requested = opts.sliding_impl
+        impl, reason = "daba", None
+        if requested != "daba":
+            impl, reason = "refold", f"slidingImpl={requested} requested"
+        elif (out.get("shards") or {}).get("mode") == "sharded":
+            impl, reason = ("refold",
+                            "sharded kernel: the mesh DABA ring is future "
+                            "work — exact refold path")
+        elif any(s.kind == "heavy_hitters" for s in kernel_plan.specs):
+            impl, reason = ("refold",
+                            "heavy_hitters finalize is host-assembled — "
+                            "exact refold path")
+        out["sliding"] = {"requested": requested, "impl": impl,
+                          "fallback_reason": reason}
     # structured expression-compilation report: which WHERE/arg/FILTER
     # pieces device-compile and which fall back to the row interpreter
     # (with NotVectorizable reason slugs) — so "path: host" is

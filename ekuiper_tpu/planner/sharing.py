@@ -180,7 +180,25 @@ def store_key(subtopo_key: str, stmt: ast.SelectStatement, opts) -> str:
     dims = ",".join(d.expr.name for d in stmt.dimensions
                     if isinstance(d.expr, ast.FieldRef))
     return (f"{subtopo_key}|fold|dims={dims}"
-            f"|evt={int(opts.is_event_time)}:{opts.late_tolerance_ms}")
+            f"|evt={int(opts.is_event_time)}:{opts.late_tolerance_ms}"
+            f"{_mesh_facet(opts)}")
+
+
+def _mesh_facet(opts) -> str:
+    """Mesh identity facet of the store key: rules whose sharding
+    decision differs must never pool one pane store (a replicated and a
+    key-range-sharded ring have different placement). Pure option/env
+    parse — the unresolved form ("auto") is the facet, so the key stays
+    stable between plan and store build."""
+    from .planner import mesh_request
+
+    req = mesh_request(opts)
+    if req["mode"] != "sharded":
+        return ""
+    cfg = req["cfg"] or {}
+    if cfg.get("auto"):
+        return "|mesh=auto"
+    return f"|mesh={cfg.get('rows', 1)}x{cfg.get('keys', 1)}"
 
 
 def decide(stmt: ast.SelectStatement, opts, plan: KernelPlan,
@@ -222,8 +240,9 @@ def decide(stmt: ast.SelectStatement, opts, plan: KernelPlan,
             ast.WindowType.TUMBLING_WINDOW, ast.WindowType.HOPPING_WINDOW):
         wt = w.window_type.value if w is not None else "none"
         return no(f"window type {wt} is not pane-decomposable across rules")
-    if (opts.plan_optimize_strategy or {}).get("mesh"):
-        return no("mesh-sharded kernels keep private folds")
+    # mesh-sharded rules POOL like any others — the store key's mesh
+    # facet groups same-mesh peers onto one key-range-sharded pane
+    # store (ops/panestore.py mesh=). Only the placement differs.
     if any(s.kind == "heavy_hitters" for s in plan.specs):
         return no("heavy_hitters state is node-local (value dictionary)")
     if not has_direct_emit:
@@ -346,13 +365,21 @@ def _store_builder(store_key_: str, subtopo_key: str, build_nodes,
                  if is_event_time else 0)
         n_panes = min(max(spans) + slack + 2, 255)
         union, _ = union_plan([d["plan"] for d in decls])
+        # same-mesh members (the store key's mesh facet) get a key-range-
+        # sharded pane ring: resolve the rule options' mesh request here
+        # at build time (device backends are up by now)
+        from .planner import mesh_request
+
+        req = mesh_request(opts)
+        mesh_cfg = req["cfg"] if req["mode"] == "sharded" else None
         return sf.SharedFoldNode(
             store_key_, display, union, pane, n_panes,
             subtopo_ref=SubTopoRef(subtopo_key, build_nodes),
             capacity=opts.key_slots, micro_batch=opts.micro_batch_rows,
             is_event_time=is_event_time,
             late_tolerance_ms=late_tolerance_ms,
-            buffer_length=opts.buffer_length)
+            buffer_length=opts.buffer_length,
+            mesh_cfg=mesh_cfg)
 
     return build
 

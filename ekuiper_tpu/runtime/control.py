@@ -127,6 +127,86 @@ def _env_float(name: str, default: float = 0.0) -> float:
         return default
 
 
+def placement_shards() -> int:
+    """Chips available for rule placement — the multi-chip serving mode's
+    admission axis (docs/DISTRIBUTED.md). KUIPER_MESH geometry when set
+    ("RxK"/"K", or "auto" = every local device); 1 otherwise, which keeps
+    every single-chip deployment's admission semantics bit-identical."""
+    from ..parallel.mesh import mesh_cfg_from_env
+
+    cfg = mesh_cfg_from_env()
+    if cfg is None:
+        return 1
+    if cfg.get("auto"):
+        try:
+            import jax
+
+            return max(len(jax.devices()), 1)
+        except Exception:
+            return 1
+    return max(int(cfg.get("rows", 1)) * int(cfg.get("keys", 1)), 1)
+
+
+def _placement_for(price: Dict[str, Any], loads: List[float],
+                   budget_bytes: float) -> Optional[Dict[str, Any]]:
+    """Pick a placement for a candidate against the per-chip committed
+    ledger: a mesh-eligible rule spreads its claim 1/K across every chip
+    (its state is key-range sharded), anything else lands whole on the
+    least-loaded chip. Returns the placement dict, or None when no chip
+    (set) can hold the claim within the per-chip budget."""
+    K = len(loads)
+    cur_share = float(price.get("hbm_current_bytes", 0)) / max(K, 1)
+    projected = float(price.get("hbm_projected_bytes", 0))
+    if price.get("placement_eligible") and K > 1:
+        share = projected / K
+        if max(loads) + share + cur_share <= budget_bytes:
+            return {"mode": "sharded", "shards": list(range(K)),
+                    "bytes_per_shard": int(share)}
+        return None
+    chip = int(np_argmin(loads))
+    if loads[chip] + projected + cur_share <= budget_bytes:
+        return {"mode": "single", "shards": [chip],
+                "bytes_per_shard": int(projected)}
+    return None
+
+
+def np_argmin(vals: List[float]) -> int:
+    best, best_v = 0, None
+    for i, v in enumerate(vals):
+        if best_v is None or v < best_v:
+            best, best_v = i, v
+    return best
+
+
+def bill_placement(price: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Placement for an already-admitted rule being (re)billed OUTSIDE
+    the admission gate (boot recovery, operator start of a stopped
+    rule): the same math as the gate, but it never rejects — a claim
+    that no longer fits still bills where it would land, so the
+    per-chip ledger reflects what actually runs after a restart
+    instead of re-gating admissions against an empty ledger."""
+    ctl = _controller
+    K = placement_shards()
+    budget = _env_float("KUIPER_HBM_BUDGET_MB") * 1024 * 1024
+    if ctl is None or K <= 1 or budget <= 0:
+        # with the budget unset the admission gate never places rules
+        # either — billing only here would make the ledger (and the
+        # kuiper_shard_rules gauge) appear out of nowhere after restarts
+        return None
+    loads = ctl.shard_loads(K)
+    p = _placement_for(price, loads, budget)
+    if p is not None:
+        return p
+    # nothing fits (the fleet outgrew the budget while it ran): bill
+    # where the claim lands anyway, so the ledger reflects what runs
+    projected = int(price.get("hbm_projected_bytes", 0))
+    if price.get("placement_eligible"):
+        return {"mode": "sharded", "shards": list(range(K)),
+                "bytes_per_shard": projected // K}
+    return {"mode": "single", "shards": [np_argmin(loads)],
+            "bytes_per_shard": projected}
+
+
 def _tier_price_slots(price: Dict[str, Any], plan, stmt, opts) -> int:
     """Hot-set slot claim for a tiered candidate (0 = untiered).
     Mirrors the planner's eligibility gates (planner/planner.py
@@ -314,6 +394,19 @@ def price_rule(rule, store) -> Dict[str, Any]:
                           or opts.key_slots)
             price["hbm_projected_bytes"] = int(
                 slot_claim * max(n_specs, 1) * 4 * HBM_PANE_FACTOR)
+            # placement (multi-chip serving): a rule the planner would
+            # shard spreads its state claim 1/K across the mesh — the
+            # HBM gate then places it instead of rejecting at the
+            # single-chip budget (docs/DISTRIBUTED.md)
+            try:
+                from ..planner.planner import mesh_request
+
+                req = mesh_request(opts, plan)
+                price["placement_eligible"] = req["mode"] == "sharded"
+                if req["mode"] == "sharded":
+                    price["mesh_source"] = req.get("source")
+            except Exception:
+                price["placement_eligible"] = False
             if share:
                 price["sharing"] = {
                     "decision": share.get("decision"),
@@ -325,21 +418,48 @@ def price_rule(rule, store) -> Dict[str, Any]:
 
 
 def _static_gates(price: Dict[str, Any],
-                  committed_us_per_s: float) -> Optional[Dict[str, Any]]:
+                  committed_us_per_s: float,
+                  ctl: "Optional[QoSController]" = None,
+                  rule_id: Optional[str] = None
+                  ) -> Optional[Dict[str, Any]]:
     """Budget gates that need no controller: return a reject decision or
-    None. Budgets default OFF (env unset) — admission then accepts."""
+    None. Budgets default OFF (env unset) — admission then accepts.
+    With a controller AND a multi-chip mesh (KUIPER_MESH), the HBM
+    budget becomes PER-CHIP and placement-aware: the candidate is
+    assigned to the least-loaded shard (or spread 1/K when its plan
+    shards) instead of rejecting at the single-chip budget."""
     hbm_budget_mb = _env_float("KUIPER_HBM_BUDGET_MB")
     if hbm_budget_mb > 0:
-        projected = price["hbm_current_bytes"] + price["hbm_projected_bytes"]
-        if projected > hbm_budget_mb * 1024 * 1024:
-            return {
-                "decision": "reject",
-                "reason": (
-                    f"projected HBM {projected / 1e6:.1f}MB exceeds the "
-                    f"{hbm_budget_mb:.0f}MB budget "
-                    "(KUIPER_HBM_BUDGET_MB)"),
-                "price": price,
-            }
+        budget = hbm_budget_mb * 1024 * 1024
+        K = placement_shards()
+        if ctl is not None and K > 1:
+            loads = ctl.shard_loads(K, exclude=rule_id)
+            placement = _placement_for(price, loads, budget)
+            if placement is None:
+                projected = price["hbm_projected_bytes"]
+                return {
+                    "decision": "reject",
+                    "reason": (
+                        f"projected HBM {projected / 1e6:.1f}MB does not "
+                        f"fit any of {K} chips' {hbm_budget_mb:.0f}MB "
+                        "per-chip budgets (KUIPER_HBM_BUDGET_MB; "
+                        "least-loaded "
+                        f"{min(loads) / 1e6:.1f}MB committed)"),
+                    "price": price,
+                }
+            price["placement"] = placement
+        else:
+            projected = (price["hbm_current_bytes"]
+                         + price["hbm_projected_bytes"])
+            if projected > budget:
+                return {
+                    "decision": "reject",
+                    "reason": (
+                        f"projected HBM {projected / 1e6:.1f}MB exceeds "
+                        f"the {hbm_budget_mb:.0f}MB budget "
+                        "(KUIPER_HBM_BUDGET_MB)"),
+                    "price": price,
+                }
     fold_budget = _env_float("KUIPER_ADMISSION_FOLD_BUDGET_US_PER_S")
     if fold_budget > 0:
         if committed_us_per_s + price["fold_us_per_s"] > fold_budget:
@@ -423,6 +543,11 @@ class QoSController:
         self._adm_counts = {"accept": 0, "reject": 0, "queue": 0}
         self._aqueue: Dict[str, Dict[str, Any]] = {}  # rid -> entry
         self._committed: Dict[str, float] = {}  # rid -> fold_us_per_s
+        # per-chip HBM ledger (multi-chip serving): rid -> placement
+        # {"mode": "sharded"|"single", "shards": [chip...],
+        #  "bytes_per_shard": int} — billed at commit, released with the
+        # rule; shard_loads() folds them into per-chip committed bytes
+        self._placements: Dict[str, Dict[str, Any]] = {}
         self._prev_storms = 0
         self._storm_active = False
         # shed accounting: monotonic row totals per (rule, qos class) —
@@ -487,17 +612,48 @@ class QoSController:
             self._adm_counts[decision] = \
                 self._adm_counts.get(decision, 0) + 1
 
-    def commit(self, rule_id: str, fold_us_per_s: float) -> None:
+    def commit(self, rule_id: str, fold_us_per_s: float,
+               placement: Optional[Dict[str, Any]] = None) -> None:
         with self._lock:
             self._committed[rule_id] = float(fold_us_per_s)
+            if placement:
+                self._placements[rule_id] = dict(placement)
 
     def release(self, rule_id: str) -> None:
         """Rule deleted: drop its admission ledger entry + queue slot +
-        controller track (shed TOTALS survive — monotonic counters)."""
+        placement billing + controller track (shed TOTALS survive —
+        monotonic counters)."""
         with self._lock:
             self._committed.pop(rule_id, None)
+            self._placements.pop(rule_id, None)
             self._aqueue.pop(rule_id, None)
             self._tracks.pop(rule_id, None)
+
+    def shard_loads(self, n_shards: Optional[int] = None,
+                    exclude: Optional[str] = None) -> List[float]:
+        """Committed HBM bytes per chip off the placement ledger — the
+        per-chip half of the admission gate and the kuiper_shard_hbm_*
+        families. Sized to max(n_shards, highest billed chip + 1).
+        `exclude` drops one rule's own billing (an UPDATE replaces its
+        claim — gating it against itself would double-bill the HBM
+        axis, the same contract the fold-budget gate keeps)."""
+        K = n_shards if n_shards is not None else placement_shards()
+        with self._lock:
+            placements = [p for rid, p in self._placements.items()
+                          if rid != exclude]
+        for p in placements:
+            for c in p.get("shards", ()):
+                K = max(K, int(c) + 1)
+        loads = [0.0] * max(K, 1)
+        for p in placements:
+            share = float(p.get("bytes_per_shard", 0))
+            for c in p.get("shards", ()):
+                loads[int(c)] += share
+        return loads
+
+    def placement_state(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {rid: dict(p) for rid, p in self._placements.items()}
 
     def enqueue(self, rule_id: str, decision: Dict[str, Any]) -> bool:
         """Park a queue-decided rule for retry at control ticks. False
@@ -539,8 +695,11 @@ class QoSController:
             entry = self._aqueue.pop(rule_id, None)
             if entry is None:
                 return None
+            price = entry.get("price") or {}
             self._committed[rule_id] = float(
-                (entry.get("price") or {}).get("fold_us_per_s", 0.0))
+                price.get("fold_us_per_s", 0.0))
+            if price.get("placement"):
+                self._placements[rule_id] = dict(price["placement"])
             return entry
 
     def _drain_admission_queue(self, now: int) -> None:
@@ -583,7 +742,7 @@ class QoSController:
                     memwatch.registry().total_bytes()
             except Exception:
                 price.setdefault("hbm_current_bytes", 0)
-            rej = _static_gates(price, committed)
+            rej = _static_gates(price, committed, ctl=self, rule_id=rid)
             if rej is not None:
                 with self._lock:
                     self._aqueue.pop(rid, None)
@@ -600,6 +759,12 @@ class QoSController:
                     except Exception:
                         pass
                 continue
+            with self._lock:
+                # the gate re-run may have picked a placement against
+                # the LIVE ledger — claim() must commit that, not the
+                # enqueue-time snapshot
+                if rid in self._aqueue:
+                    self._aqueue[rid]["price"] = price
             entry = self.claim(rid)
             if entry is None:
                 continue
@@ -917,6 +1082,12 @@ class QoSController:
                 },
                 "storm_active": self.storm_active(),
             },
+            "placement": {
+                "shards": placement_shards(),
+                "committed_bytes_per_shard": [
+                    int(v) for v in self.shard_loads()],
+                "rules": self.placement_state(),
+            },
             "shedding": self.shed_state(),
             "shed_totals": {
                 f"{rid}|{qos}": n
@@ -984,7 +1155,8 @@ def admit_rule(rule, store, allow_queue: bool = True) -> Dict[str, Any]:
     if ctl is not None:
         with ctl._lock:
             committed -= ctl._committed.get(rule.id, 0.0)
-    decision = _static_gates(price, max(committed, 0.0))
+    decision = _static_gates(price, max(committed, 0.0), ctl=ctl,
+                             rule_id=rule.id)
     if decision is None and ctl is not None and allow_queue:
         defer, reason = ctl._pressure(price)
         if defer:
@@ -1036,3 +1208,24 @@ def render_prometheus(out: List[str], esc) -> None:
     out.append("# HELP kuiper_autosize_events_total decode pool / ingest "
                "ring autosize actions taken by the control plane")
     out.append(f"kuiper_autosize_events_total {ctl.autosize_events}")
+    # placement-aware admission (multi-chip serving): the per-chip HBM
+    # ledger the gate places rules against, plus rules placed per chip
+    loads = ctl.shard_loads()
+    placements = ctl.placement_state()
+    rules_per = [0] * len(loads)
+    for p in placements.values():
+        for c in p.get("shards", ()):
+            if 0 <= int(c) < len(rules_per):
+                rules_per[int(c)] += 1
+    out.append("# TYPE kuiper_shard_hbm_committed_bytes gauge")
+    out.append("# HELP kuiper_shard_hbm_committed_bytes admission-"
+               "committed HBM bytes per placement shard (per-chip "
+               "ledger, runtime/control.py)")
+    for i, v in enumerate(loads):
+        out.append(
+            f'kuiper_shard_hbm_committed_bytes{{shard="{i}"}} {int(v)}')
+    out.append("# TYPE kuiper_shard_rules gauge")
+    out.append("# HELP kuiper_shard_rules rules placed on each shard by "
+               "placement-aware admission")
+    for i, v in enumerate(rules_per):
+        out.append(f'kuiper_shard_rules{{shard="{i}"}} {v}')

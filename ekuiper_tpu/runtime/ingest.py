@@ -282,7 +282,8 @@ class DecodePool:
                         self._drained.notify_all()
 
 
-def pad_col_for_device(host, vm, mb: int, dtype: str = "float32"):
+def pad_col_for_device(host, vm, mb: int, dtype: str = "float32",
+                       sharding=None):
     """Canonical pad + device upload for one kernel column — the ONE
     builder behind the share keys ("dcol", name, mb) and
     ("dexpr", expr_tag, name, mb). Both the prep ctx (pool-side
@@ -290,7 +291,11 @@ def pad_col_for_device(host, vm, mb: int, dtype: str = "float32"):
     call this, so a cache hit can never serve a differently built array
     than the inline path would have made. `dtype` follows the plan's
     per-column map (ops/groupby.py col_np_dtype): float32 for plain
-    numeric columns, int32 for the expression IR's derived columns."""
+    numeric columns, int32 for the expression IR's derived columns.
+    `sharding` (a jax NamedSharding — the sharded kernel's "rows" axis)
+    places the padded array ACROSS the mesh so each shard's slice does
+    its own H2D copy; such uploads live under mesh-tag-suffixed share
+    keys and can never alias the replicated single-chip form."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -300,20 +305,48 @@ def pad_col_for_device(host, vm, mb: int, dtype: str = "float32"):
     dm = None
     if vm is not None:
         m = vm if len(vm) == mb else np.pad(vm, (0, mb - len(vm)))
-        dm = jnp.asarray(m)
-    return jnp.asarray(arr), dm
+        dm = _put(m, sharding)
+    return _put(arr, sharding), dm
 
 
-def pad_slots_for_device(slots, mb: int, u16: bool):
-    """Canonical pad + dtype + upload for the slot vector — the ONE
-    builder behind the share key ("dslots", key_name, mb, u16)."""
+def _put(arr, sharding):
+    import jax
     import jax.numpy as jnp
+
+    if sharding is None:
+        return jnp.asarray(arr)
+    return jax.device_put(arr, sharding)
+
+
+def share_key(kind: str, *parts, mesh_tag: str = ""):
+    """THE share-key builder for pre-padded device uploads — used by the
+    prep ctx (pool side) AND both consumer twins
+    (nodes_fused._shared_device_inputs, nodes_sharedfold._device_inputs)
+    so producer and consumer can never drift to different keys: a miss
+    means a silently duplicated upload, a half-match could serve a
+    replicated array to a sharded consumer. Mesh-tagged keys get the
+    tag suffix; un-tagged keys keep the historical tuple shape."""
+    return (kind,) + parts + ((mesh_tag,) if mesh_tag else ())
+
+
+def slot_wire_u16(capacity_u16: bool, mesh_tag: str) -> bool:
+    """Slot wire dtype decision for shared uploads: uint16 only when the
+    capacity allows AND the consumer is single-chip — sharded kernels
+    always take int32 (the certified shard_map form)."""
+    return bool(capacity_u16) and not mesh_tag
+
+
+def pad_slots_for_device(slots, mb: int, u16: bool, sharding=None):
+    """Canonical pad + dtype + upload for the slot vector — the ONE
+    builder behind the share key ("dslots", key_name, mb, u16[, mesh]).
+    Sharded consumers always pass u16=False (int32 is the certified
+    shard_map wire dtype) plus their row sharding."""
     import numpy as np
 
     s = slots
     if len(s) < mb:
         s = np.pad(s, (0, mb - len(s)))
-    return jnp.asarray(s.astype(np.uint16 if u16 else np.int32))
+    return _put(s.astype(np.uint16 if u16 else np.int32), sharding)
 
 
 class IngestPrepCtx:
@@ -350,12 +383,15 @@ class IngestPrepCtx:
     def __init__(self) -> None:
         self.lock = threading.RLock()
         self.key_tables: Dict[str, Any] = {}
-        # (key_name|None, micro_batch) -> set of kernel column names;
-        # key_name None = columns-only spec (multi-dim consumers)
-        self._specs: Dict[Tuple[Optional[str], int], set] = {}
-        # (expr_tag, micro_batch) -> DerivedCol tuple (expression-IR
-        # prep columns pre-encoded + pre-uploaded by the pool)
-        self._derived: Dict[Tuple[str, int], tuple] = {}
+        # (key_name|None, micro_batch, mesh_tag) -> {"columns": set,
+        # "sharding": NamedSharding|None}; key_name None = columns-only
+        # spec (multi-dim consumers); mesh_tag "" = single-chip uploads,
+        # "RxK" = mesh-placed uploads under tag-suffixed share keys
+        self._specs: Dict[Tuple[Optional[str], int, str], Dict[str, Any]] = {}
+        # (expr_tag, micro_batch, mesh_tag) -> (DerivedCol tuple,
+        # sharding|None) — expression-IR prep columns pre-encoded +
+        # pre-uploaded by the pool, placed per the consumer's mesh
+        self._derived: Dict[Tuple[str, int, str], tuple] = {}
         # tiered key state (ops/tierstore.py): prefetch hooks that spot
         # returning demoted keys in a decoding batch and start their
         # packed rows' H2D copy a batch early
@@ -387,21 +423,35 @@ class IngestPrepCtx:
 
     # ------------------------------------------------------- upload stage
     def register_upload(self, key_name: Optional[str], columns,
-                        micro_batch: int, derived=None) -> None:
+                        micro_batch: int, derived=None, sharding=None,
+                        mesh_tag: str = "") -> None:
         """A fused consumer declares what precompute() should build. Merged
-        by (key_name, micro_batch): heterogeneous consumers of one stream
-        union their column needs — one upload serves all of them.
-        `derived` is an optional (expr_tag, DerivedCol tuple): the
-        consumer's expression-IR prep columns (sql/expr_ir.py), encoded
-        + pre-uploaded under share keys that include the IR hash so two
-        plans with different expressions can never alias an upload."""
+        by (key_name, micro_batch, mesh_tag): heterogeneous consumers of
+        one stream union their column needs — one upload serves all of
+        them; mesh-sharded consumers register separately under their mesh
+        tag with the row `sharding` their kernel folds from (per-shard
+        H2D, nodes_fused.py prep_spec). `derived` is an optional
+        (expr_tag, DerivedCol tuple): the consumer's expression-IR prep
+        columns (sql/expr_ir.py), encoded + pre-uploaded under share keys
+        that include the IR hash so two plans with different expressions
+        can never alias an upload."""
         with self.lock:
             spec = self._specs.setdefault(
-                (key_name, int(micro_batch)), set())
-            spec.update(columns)
+                (key_name, int(micro_batch), str(mesh_tag or "")),
+                {"columns": set(), "sharding": sharding})
+            spec["columns"].update(columns)
+            if sharding is not None:
+                spec["sharding"] = sharding
             if derived:
                 tag, dcols = derived
-                self._derived[(tag, int(micro_batch))] = tuple(dcols)
+                # derived uploads are mesh-scoped too: a sharded
+                # consumer's ("dexpr", ..., mesh_tag) lookup must hit a
+                # mesh-placed array, and the replicated form must not be
+                # built for nobody
+                self._derived[(tag, int(micro_batch),
+                               str(mesh_tag or ""))] = (
+                    tuple(dcols),
+                    sharding if mesh_tag else None)
 
     def register_tier_prefetch(self, fn) -> None:
         """A tiered fused consumer's prefetch hook (TierManager.prefetch)
@@ -417,7 +467,9 @@ class IngestPrepCtx:
         import numpy as np
 
         with self.lock:
-            specs = [(k, set(v)) for k, v in self._specs.items()]
+            specs = [(k, {"columns": set(v["columns"]),
+                          "sharding": v.get("sharding")})
+                     for k, v in self._specs.items()]
             derived = list(self._derived.items())
             tier_hooks = list(self._tier_hooks)
         if getattr(batch, "n", 0) == 0:
@@ -437,7 +489,9 @@ class IngestPrepCtx:
         except Exception:
             return 0
         n_up = 0
-        for (key_name, mb), columns in specs:
+        for (key_name, mb, mesh_tag), spec in specs:
+            columns = spec["columns"]
+            shd = spec.get("sharding") if mesh_tag else None
             if batch.n > mb:
                 # multi-chunk batches can't ship as one pre-padded upload
                 # (fold's device-input contract); source flushes are
@@ -448,34 +502,41 @@ class IngestPrepCtx:
                 from ..ops.groupby import slot_dtype
 
                 with self.lock:
-                    u16 = slot_dtype(kt.capacity) is np.uint16
-                batch.share(("dslots", key_name, mb, u16),
-                            lambda s=slots, u=u16, m=mb:
-                            pad_slots_for_device(s, m, u))
+                    u16 = slot_wire_u16(
+                        slot_dtype(kt.capacity) is np.uint16, mesh_tag)
+                batch.share(share_key("dslots", key_name, mb, u16,
+                                      mesh_tag=mesh_tag),
+                            lambda s=slots, u=u16, m=mb, d=shd:
+                            pad_slots_for_device(s, m, u, sharding=d))
                 n_up += 1
             for name in sorted(columns):
                 col = batch.columns.get(name)
                 if col is None or col.dtype == np.object_:
                     continue  # fused node NaN-fills / coerces these itself
                 vm = batch.valid.get(name)
-                batch.share(("dcol", name, mb),
-                            lambda h=col, v=vm, m=mb:
-                            pad_col_for_device(h, v, m))
+                batch.share(share_key("dcol", name, mb,
+                                      mesh_tag=mesh_tag),
+                            lambda h=col, v=vm, m=mb, d=shd:
+                            pad_col_for_device(h, v, m, sharding=d))
                 n_up += 1
-        for (tag, mb), dcols in derived:
+        for (tag, mb, mesh_tag), (dcols, dshd) in derived:
             if batch.n > mb:
                 continue
             for d in dcols:
                 # encode once per batch (shared across consumers with the
-                # same IR), then pad+upload under the tagged share key —
-                # the fused node's inline twin uses the SAME builders
+                # same IR — the host encode is placement-independent),
+                # then pad+upload under the tagged share key with the
+                # consumer's placement — the fused node's inline twin
+                # uses the SAME builders and keys
                 host = batch.share(
                     ("dexpr_host", tag, d.name),
                     lambda _d=d, _b=batch: _d.encode(
                         _b.columns.get(_d.raw), _b.n))
-                batch.share(("dexpr", tag, d.name, mb),
-                            lambda h=host, m=mb, _dt=d.dtype:
-                            pad_col_for_device(h, None, m, dtype=_dt))
+                batch.share(share_key("dexpr", tag, d.name, mb,
+                                      mesh_tag=mesh_tag),
+                            lambda h=host, m=mb, _dt=d.dtype, _s=dshd:
+                            pad_col_for_device(h, None, m, dtype=_dt,
+                                               sharding=_s))
                 n_up += 1
         if n_up:
             with self.lock:

@@ -755,19 +755,30 @@ class FusedWindowAggNode(Node):
         return len(dirty) / max(self.n_panes, 1)
 
     def prep_spec(self):
-        """(key_name, kernel columns, micro_batch, derived) for the
-        ingest prep's upload stage — the ONE definition of what
-        precompute() should build for this node (the planner registers
-        it at plan time, the first _shared_device_inputs call covers
-        un-plumbed paths). `derived` is (expr_tag, DerivedCol tuple):
-        the expression IR's host-derived columns, pre-encoded and
+        """(key_name, kernel columns, micro_batch, derived, sharding,
+        mesh_tag) for the ingest prep's upload stage — the ONE definition
+        of what precompute() should build for this node (the planner
+        registers it at plan time, the first _shared_device_inputs call
+        covers un-plumbed paths). `derived` is (expr_tag, DerivedCol
+        tuple): the expression IR's host-derived columns, pre-encoded and
         pre-uploaded by the pool under share keys that include the IR
-        hash — plans whose expressions differ can never alias."""
+        hash — plans whose expressions differ can never alias. Sharded
+        kernels add their row sharding + mesh tag: the pool then places
+        each padded column/slot vector ACROSS the mesh (per-shard H2D)
+        under tag-suffixed share keys, so a sharded and an unsharded
+        consumer of one stream can never alias an upload."""
         from ..sql.expr_ir import is_derived_expr_col
 
         key_name = (self.dims[0].name
                     if len(self.dims) == 1
                     and getattr(self.dims[0], "name", None) else None)
+        # mesh placement only when the kernel actually CONSUMES device
+        # inputs: a multi-process mesh can't device_put onto
+        # non-addressable devices (ShardedGroupBy uses its own
+        # local-slice _put and opts out of device inputs) — registering
+        # its sharding would make every precompute() raise per batch
+        shard_ok = (getattr(self.gb, "mesh_tag", "")
+                    and getattr(self.gb, "accepts_device_inputs", False))
         return (key_name,
                 [n for n in self.plan.columns
                  if not n.startswith(HLL_COL_PREFIX)
@@ -775,7 +786,9 @@ class FusedWindowAggNode(Node):
                  and not is_derived_expr_col(n)],
                 self.gb.micro_batch,
                 ((self.plan.expr_tag, self.plan.derived)
-                 if self.plan.derived else None))
+                 if self.plan.derived else None),
+                self.gb.batch_sharding if shard_ok else None,
+                self.gb.mesh_tag if shard_ok else "")
 
     def _shared_device_inputs(self, sub: ColumnBatch, cols, valid, slots):
         """One device upload per column/slot vector for ALL fan-out
@@ -798,14 +811,25 @@ class FusedWindowAggNode(Node):
             reg = getattr(ctx, "register_upload", None)
             if reg is not None:
                 reg(*self.prep_spec())
-        # canonical builders shared with the prep ctx's pool-side
-        # pre-upload (runtime/ingest.py): same keys, same bytes
+        # canonical builders + key scheme shared with the prep ctx's
+        # pool-side pre-upload (runtime/ingest.py): same keys, same bytes
         from ..sql.expr_ir import is_derived_expr_col
-        from .ingest import pad_col_for_device, pad_slots_for_device
+        from .ingest import (pad_col_for_device, pad_slots_for_device,
+                             share_key, slot_wire_u16)
 
         dcols: Dict[str, Any] = {}
         dvalid: Dict[str, Any] = {}
         expr_tag = getattr(self.plan, "expr_tag", "")
+        # mesh-aware uploads: a sharded kernel's inputs are placed with
+        # its row sharding (per-shard H2D) under tag-suffixed share keys
+        # — the replicated single-chip upload and the mesh placement can
+        # never serve each other
+        mesh_tag = getattr(self.gb, "mesh_tag", "")
+        shd = getattr(self.gb, "batch_sharding", None) if mesh_tag else None
+
+        def _key(*parts):
+            return share_key(*parts, mesh_tag=mesh_tag)
+
         for name in self.plan.columns:
             if name.startswith(HLL_COL_PREFIX) or \
                     name.startswith(HH_COL_PREFIX):
@@ -818,19 +842,21 @@ class FusedWindowAggNode(Node):
                 # key, never a false cache hit
                 host = cols[name]
                 dt = str(host.dtype)
-                dv, _ = sub.share(("dexpr", expr_tag, name, mb),
+                dv, _ = sub.share(_key("dexpr", expr_tag, name, mb),
                                   lambda h=host, d=dt:
                                   pad_col_for_device(h, None, mb,
-                                                     dtype=d))
+                                                     dtype=d,
+                                                     sharding=shd))
                 dcols[name] = dv
                 continue
             src_col = sub.columns.get(name)
             if src_col is None or src_col.dtype == np.object_:
                 continue
             host, vm = cols[name], valid.get(name)
-            dv, dm = sub.share(("dcol", name, mb),
+            dv, dm = sub.share(_key("dcol", name, mb),
                                lambda h=host, v=vm:
-                               pad_col_for_device(h, v, mb))
+                               pad_col_for_device(h, v, mb,
+                                                  sharding=shd))
             dcols[name] = dv
             if dm is not None:
                 dvalid[name] = dm
@@ -842,13 +868,15 @@ class FusedWindowAggNode(Node):
             # dtype follows the NEUTRAL table's capacity (the slots' value
             # domain — and what the prep ctx keyed its pre-upload on, so
             # the lookup below hits); our own kt may be pre-sized larger
-            # without invalidating a uint16 wire format
+            # without invalidating a uint16 wire format. Sharded kernels
+            # always ship int32 (the certified shard_map wire dtype).
             cap = (self._shared_nkt.capacity
                    if self._shared_nkt is not None else self.kt.capacity)
-            u16 = slot_dtype(cap) is np.uint16
+            u16 = slot_wire_u16(slot_dtype(cap) is np.uint16, mesh_tag)
             dslots = sub.share(
-                ("dslots", self.dims[0].name, mb, u16),
-                lambda s=slots, u=u16: pad_slots_for_device(s, mb, u))
+                _key("dslots", self.dims[0].name, mb, u16),
+                lambda s=slots, u=u16: pad_slots_for_device(
+                    s, mb, u, sharding=shd))
         if not dcols and dslots is None:
             return None
         return dcols, dvalid, dslots
@@ -988,6 +1016,15 @@ class FusedWindowAggNode(Node):
                                           pane_arg)
             self.stats.observe_stage(
                 "fold", (_time.perf_counter() - t1) * 1e6, sub.n)
+            if hasattr(self.gb, "note_rows"):
+                # per-shard accounting (kuiper_shard_*): the kernel counts
+                # host slot vectors itself; the prep path hands it DEVICE
+                # slots, so count off the host copy here — and refresh the
+                # key-occupancy hint either way
+                if dev is not None and dev[2] is not None:
+                    self.gb.note_rows(slots, sub.n, n_keys=self.kt.n_keys)
+                else:
+                    self.gb.n_keys_hint = self.kt.n_keys
         # every live shadow mirrors the fold (dedup: frozen-span retries and
         # the backstop may share shadow objects)
         seen = set()
@@ -1618,10 +1655,25 @@ class FusedWindowAggNode(Node):
         if getattr(self.gb, "watch_prefix", "") != "groupby" or \
                 not getattr(self.gb, "supports_prefinalize", False) or \
                 getattr(self.gb, "_host_finalize_only", False):
+            # structured + attributable (ISSUE 15 satellite): the silent
+            # auto-fallback hid that a sharded rule's sliding triggers
+            # still refold — the flight event names the reason, and the
+            # explain "sliding" section mirrors it at plan time
+            reason = ("sharded_kernel"
+                      if getattr(self.gb, "watch_prefix", "") == "sharded"
+                      else "heavy_hitters"
+                      if getattr(self.gb, "_host_finalize_only", False)
+                      else "kernel_form")
+            from .events import recorder
+
+            recorder().record(
+                "sliding_impl_fallback", rule=self.stats.rule_id,
+                severity="info", component="sliding_ring", node=self.name,
+                requested="daba", action="refold", reason=reason)
             logger.info(
                 "%s: sliding ring unavailable for this kernel form "
-                "(sharded/heavy_hitters) — using the refold path",
-                self.name)
+                "(%s) — using the refold path (mesh DABA ring is future "
+                "work)", self.name, reason)
             return "refold"
         from ..ops.slidingring import SlidingRing
 
@@ -1856,6 +1908,8 @@ class FusedWindowAggNode(Node):
                                       fold_valid, pane_vec, n_rows=n_rows)
         self.stats.observe_stage(
             "fold", (_time.perf_counter() - t1) * 1e6, sub.n)
+        if hasattr(self.gb, "note_rows"):
+            self.gb.n_keys_hint = self.kt.n_keys  # fold counted host slots
         for b in np.unique(buckets).tolist():
             m = buckets == b
             sel = np.nonzero(m)[0]
@@ -1905,7 +1959,11 @@ class FusedWindowAggNode(Node):
         be rejected and the first real trigger would pay the jit stall)."""
         mb = self.gb.micro_batch
         n = len(slots)
-        if n > mb or not getattr(self.gb, "accepts_device_inputs", False):
+        if n > mb or not getattr(self.gb, "accepts_device_inputs", False) \
+                or getattr(self.gb, "mesh_tag", ""):
+            # sharded sliding keeps the host-path edge refold: fold_masked
+            # is uncertified for the sharded kernel and the _dev_ring
+            # would pin replicated (unsharded) copies across the mesh
             return None
         if n < mb // 4 and not force:
             # small batches would pin a full mb-padded device buffer each
@@ -2786,8 +2844,12 @@ class FusedWindowAggNode(Node):
         if partials:
             host, cap = self.gb.host_from_partials(partials)
             self.gb.capacity = cap
-            self.kt.capacity = max(self.kt.capacity, cap)
+            # a sharded kernel may round the restored capacity UP for
+            # even shard division (mesh-size-change tolerance: an 8-shard
+            # restore of a 1-chip snapshot, or vice versa) — state_from_
+            # host owns that decision, the key table follows it
             self.state = self.gb.state_from_host(host)
+            self.kt.capacity = max(self.kt.capacity, self.gb.capacity)
         if self.tier is not None and state.get("tier"):
             self.tier.restore(state["tier"])
         self.cur_pane = state.get("cur_pane", 0)

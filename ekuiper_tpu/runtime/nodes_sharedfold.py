@@ -125,6 +125,7 @@ class SharedFoldNode(Node):
         is_event_time: bool = False,
         late_tolerance_ms: int = 0,
         buffer_length: int = 1024,
+        mesh_cfg=None,
     ) -> None:
         super().__init__(name, op_type="op", buffer_length=buffer_length)
         self.key = key
@@ -134,8 +135,24 @@ class SharedFoldNode(Node):
         self.n_panes = int(n_panes)
         self.is_event_time = bool(is_event_time)
         self.late_tolerance_ms = int(late_tolerance_ms)
+        # key-range-sharded store (ISSUE 15): same-mesh members pool a
+        # pane ring partitioned over the mesh's "keys" axis; an
+        # unavailable mesh degrades to the single-chip store with a log
+        # (the store key's mesh facet kept mismatched peers apart)
+        mesh = None
+        if mesh_cfg:
+            from ..parallel.mesh import mesh_from_options, resolve_auto_cfg
+
+            try:
+                resolved = resolve_auto_cfg(dict(mesh_cfg))
+                mesh = (mesh_from_options(resolved)
+                        if resolved is not None else None)
+            except Exception as exc:
+                logger.warning(
+                    "%s: shared pane store mesh %s unavailable (%s) — "
+                    "single-chip store", name, mesh_cfg, exc)
         self.store = PaneStore(plan, pane_ms, n_panes, capacity=capacity,
-                               micro_batch=micro_batch)
+                               micro_batch=micro_batch, mesh=mesh)
         self.dims: List[str] = []  # set by first attach (compat-keyed)
         self._members: Dict[str, _Member] = {}
         self._mlock = threading.RLock()
@@ -224,14 +241,20 @@ class SharedFoldNode(Node):
         return m.last_end_ms if m is not None else None
 
     def _prep_spec(self):
-        """(key_name, kernel columns, micro_batch, derived) for the
-        shared ingest prep's upload stage — the union plan's one
-        declaration of what precompute() should pre-upload for this
-        store (incl. the members' predicate-lift derived columns, keyed
-        by the union's expression-IR hash)."""
+        """(key_name, kernel columns, micro_batch, derived, sharding,
+        mesh_tag) for the shared ingest prep's upload stage — the union
+        plan's one declaration of what precompute() should pre-upload
+        for this store (incl. the members' predicate-lift derived
+        columns, keyed by the union's expression-IR hash; sharded stores
+        add their row sharding + mesh tag, nodes_fused.py prep_spec)."""
         from ..sql.expr_ir import is_derived_expr_col
 
         key_name = self.dims[0] if len(self.dims) == 1 else None
+        # same gate as nodes_fused.prep_spec: never register a mesh
+        # placement the kernel won't consume (multi-process meshes)
+        shard_ok = (getattr(self.store.gb, "mesh_tag", "")
+                    and getattr(self.store.gb, "accepts_device_inputs",
+                                False))
         return (key_name,
                 [n for n in self.plan.columns
                  if not n.startswith(HLL_COL_PREFIX)
@@ -239,7 +262,9 @@ class SharedFoldNode(Node):
                  and not is_derived_expr_col(n)],
                 self.store.gb.micro_batch,
                 ((self.plan.expr_tag, self.plan.derived)
-                 if getattr(self.plan, "derived", ()) else None))
+                 if getattr(self.plan, "derived", ()) else None),
+                self.store.gb.batch_sharding if shard_ok else None,
+                self.store.gb.mesh_tag if shard_ok else "")
 
     # --------------------------------------------------------- attach/detach
     def attach_rule(self, spec: MemberSpec, entry: Node, topo: Any) -> bool:
@@ -435,6 +460,15 @@ class SharedFoldNode(Node):
             self.store.fold(cols, valid, slots, pane_arg)
         self.stats.observe_stage(
             "fold", (_time.perf_counter() - t1) * 1e6, sub.n)
+        if hasattr(self.store.gb, "note_rows"):
+            # per-shard accounting (kuiper_shard_*): the kernel counts
+            # host slot vectors itself; the prep path hands it DEVICE
+            # slots, so count off the host copy here (nodes_fused twin)
+            if dev is not None and dev[2] is not None:
+                self.store.gb.note_rows(slots, sub.n,
+                                        n_keys=self.store.kt.n_keys)
+            else:
+                self.store.gb.n_keys_hint = self.store.kt.n_keys
         self.folds_did += 1
         self.folds_would += max(len(self._members), 1)
 
@@ -560,11 +594,21 @@ class SharedFoldNode(Node):
                 not getattr(self.store.gb, "accepts_device_inputs", False):
             return None
         from ..sql.expr_ir import is_derived_expr_col
-        from .ingest import pad_col_for_device, pad_slots_for_device
+        from .ingest import (pad_col_for_device, pad_slots_for_device,
+                             share_key, slot_wire_u16)
 
         dcols: Dict[str, Any] = {}
         dvalid: Dict[str, Any] = {}
         expr_tag = getattr(self.plan, "expr_tag", "")
+        # mesh-aware uploads: tag-suffixed keys + row-sharded placement
+        # for sharded stores (mirror of nodes_fused._shared_device_inputs)
+        mesh_tag = getattr(self.store.gb, "mesh_tag", "")
+        shd = (getattr(self.store.gb, "batch_sharding", None)
+               if mesh_tag else None)
+
+        def _key(*parts):
+            return share_key(*parts, mesh_tag=mesh_tag)
+
         for name in self.plan.columns:
             if name.startswith(HLL_COL_PREFIX) or \
                     name.startswith(HH_COL_PREFIX):
@@ -572,19 +616,21 @@ class SharedFoldNode(Node):
             if is_derived_expr_col(name):
                 host = cols[name]
                 dt = str(host.dtype)
-                dv, _ = sub.share(("dexpr", expr_tag, name, mb),
+                dv, _ = sub.share(_key("dexpr", expr_tag, name, mb),
                                   lambda h=host, d=dt:
                                   pad_col_for_device(h, None, mb,
-                                                     dtype=d))
+                                                     dtype=d,
+                                                     sharding=shd))
                 dcols[name] = dv
                 continue
             src_col = sub.columns.get(name)
             if src_col is None or src_col.dtype == np.object_:
                 continue
             host, vm = cols[name], valid.get(name)
-            dv, dm = sub.share(("dcol", name, mb),
+            dv, dm = sub.share(_key("dcol", name, mb),
                                lambda h=host, v=vm:
-                               pad_col_for_device(h, v, mb))
+                               pad_col_for_device(h, v, mb,
+                                                  sharding=shd))
             dcols[name] = dv
             if dm is not None:
                 dvalid[name] = dm
@@ -594,10 +640,11 @@ class SharedFoldNode(Node):
 
             cap = (self._shared_nkt.capacity
                    if self._shared_nkt is not None else self.store.kt.capacity)
-            u16 = slot_dtype(cap) is np.uint16
+            u16 = slot_wire_u16(slot_dtype(cap) is np.uint16, mesh_tag)
             dslots = sub.share(
-                ("dslots", self.dims[0], mb, u16),
-                lambda s=slots, u=u16: pad_slots_for_device(s, mb, u))
+                _key("dslots", self.dims[0], mb, u16),
+                lambda s=slots, u=u16: pad_slots_for_device(
+                    s, mb, u, sharding=shd))
         if not dcols and dslots is None:
             return None
         return dcols, dvalid, dslots

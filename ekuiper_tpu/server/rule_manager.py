@@ -144,9 +144,10 @@ class RuleRegistry:
         if triggered:
             ctl = control.controller()
             if ctl is not None:
-                ctl.commit(rule.id, float(
-                    (decision.get("price") or {})
-                    .get("fold_us_per_s", 0.0)))
+                price = decision.get("price") or {}
+                ctl.commit(rule.id,
+                           float(price.get("fold_us_per_s", 0.0)),
+                           placement=price.get("placement"))
             rs.start()
             self.store.kv("rule_run_state").set(rule.id, True)
         return rule.id
@@ -202,9 +203,11 @@ class RuleRegistry:
                 if decision is not None:
                     ctl = control.controller()
                     if ctl is not None:
-                        ctl.commit(rule.id, float(
-                            (decision.get("price") or {})
-                            .get("fold_us_per_s", 0.0)))
+                        price = decision.get("price") or {}
+                        ctl.commit(
+                            rule.id,
+                            float(price.get("fold_us_per_s", 0.0)),
+                            placement=price.get("placement"))
                 new_rs.start()
         else:
             with self._lock:
@@ -243,7 +246,11 @@ class RuleRegistry:
             return
         try:
             price = control.price_rule(rule, self.store)
-            ctl.commit(rule.id, float(price.get("fold_us_per_s", 0.0)))
+            # price_rule never sets "placement" (the admission gate
+            # does) — recovery/operator-start billing derives one from
+            # the live ledger so restarts keep the per-chip accounting
+            ctl.commit(rule.id, float(price.get("fold_us_per_s", 0.0)),
+                       placement=control.bill_placement(price))
         except Exception:
             pass
 

@@ -1,0 +1,44 @@
+"""Weakref object registry — THE ownership model shared by the
+per-component metric render sources (ops/tierstore.py TierManagers,
+parallel/sharded.py sharded kernels; memwatch pioneered it): strong
+ownership stays with the registered object, the registry holds only a
+weak reference plus a rule label, and a collected object's rows simply
+stop rendering. One implementation so the pruning/dedup/locking
+semantics cannot drift between consumers."""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, List, Optional, Tuple
+
+
+class WeakRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._refs: List[Tuple[Any, Optional[str]]] = []
+
+    def register(self, obj, rule: Optional[str] = None) -> None:
+        """Add (or re-label) an object; dead refs prune, and a
+        re-registration of the same object replaces its entry."""
+        with self._lock:
+            kept = []
+            for r, ru in self._refs:
+                o = r()
+                if o is None or o is obj:
+                    continue
+                kept.append((r, ru))
+            kept.append((weakref.ref(obj), rule))
+            self._refs = kept
+
+    def items(self) -> List[Tuple[Any, Optional[str]]]:
+        """Live (object, rule) pairs."""
+        with self._lock:
+            refs = list(self._refs)
+        return [(o, rule) for (r, rule) in refs if (o := r()) is not None]
+
+    # legacy alias (ops/tierstore.py grew up calling it managers())
+    managers = items
+
+    def clear(self) -> None:
+        with self._lock:
+            self._refs.clear()

@@ -1,0 +1,464 @@
+"""Multi-chip sharded serving (ISSUE 15): planner selection, cross-mesh
+checkpoint restore, touch-column dtype parity, sharded pane stores,
+mesh-aware ingest prep, placement-aware admission, and the sliding
+fallback's attributability — all on the virtual 8-device CPU mesh."""
+import numpy as np
+import pytest
+
+from ekuiper_tpu.data.batch import ColumnBatch
+from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+from ekuiper_tpu.ops.emit import build_direct_emit
+from ekuiper_tpu.ops.groupby import DeviceGroupBy
+from ekuiper_tpu.ops.keytable import KeyTable
+from ekuiper_tpu.parallel.mesh import make_mesh
+from ekuiper_tpu.parallel.sharded import ShardedGroupBy
+from ekuiper_tpu.runtime.events import Trigger, recorder
+from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+from ekuiper_tpu.sql.parser import parse_select
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return jax.devices()
+
+
+HOP_SQL = ("SELECT k, sum(v) AS s, count(*) AS c, min(v) AS mn "
+           "FROM d GROUP BY k, HOPPINGWINDOW(ss, 4, 2)")
+
+
+def _mk_node(mesh, capacity=64):
+    stmt = parse_select(HOP_SQL)
+    plan = extract_kernel_plan(stmt)
+    node = FusedWindowAggNode(
+        "mc_test", stmt.window, plan, [d.expr for d in stmt.dimensions],
+        capacity=capacity, micro_batch=128, prefinalize_lead_ms=0,
+        direct_emit=build_direct_emit(stmt, plan, ["k"]),
+        emit_columnar=False, mesh=mesh)
+    node.state = node.gb.init_state()
+    out = []
+    node.emit = lambda item, count=None, _o=out: _o.append(item)
+    return node, out
+
+
+def _batch(ids, vals):
+    ids = np.array(ids, dtype=np.object_)
+    return ColumnBatch(
+        n=len(ids),
+        columns={"k": ids, "v": np.asarray(vals, np.float64)},
+        timestamps=np.zeros(len(ids), np.int64), emitter="d")
+
+
+def _flat(msgs):
+    rows = {}
+    for m in msgs:
+        for r in (m if isinstance(m, list) else [m]):
+            rows[tuple(sorted(r.items()))] = \
+                rows.get(tuple(sorted(r.items())), 0) + 1
+    return rows
+
+
+class TestCrossMeshRestore:
+    """Satellite: kill a sharded rule, restore at a different shard
+    count (8->1 and 1->8) — KeyTable slots, pane cursors, and emitted
+    windows byte-identical to an unsharded run."""
+
+    def _feed(self, nodes, ids, vals):
+        for n in nodes:
+            n.process(_batch(list(ids), list(vals)))
+
+    def _fire(self, nodes, ts):
+        for n in nodes:
+            n.on_trigger(Trigger(ts=ts))
+            n._drain_async_emits()
+
+    def test_restore_8_to_1_and_back(self, eight_devices, mock_clock):
+        rng = np.random.default_rng(3)
+        sharded, out_s = _mk_node(make_mesh(rows=2, keys=4))
+        ids = [f"k{i}" for i in range(90)]  # forces a grow past 64
+        vals = np.rint(rng.normal(40, 9, len(ids)))
+        self._feed([sharded], ids, vals)
+        self._fire([sharded], 2000)
+        assert sharded.cur_pane == 1
+
+        snap8 = sharded.snapshot_state()
+        single, out_1 = _mk_node(None)
+        single.restore_state(snap8)
+        assert single.kt.decode_all() == sharded.kt.decode_all()
+        assert single.cur_pane == sharded.cur_pane
+
+        tail_ids = [f"k{i}" for i in range(30, 120)]
+        tail_vals = np.rint(rng.normal(40, 9, len(tail_ids)))
+        self._feed([sharded, single], tail_ids, tail_vals)
+        out_s.clear()
+        self._fire([sharded, single], 4000)
+        assert _flat(out_1) == _flat(out_s)
+
+        # 1 -> 8: snapshot the single-chip node, restore onto the mesh
+        snap1 = single.snapshot_state()
+        remesh, out_8 = _mk_node(make_mesh(rows=1, keys=8))
+        remesh.restore_state(snap1)
+        assert remesh.kt.decode_all() == single.kt.decode_all()
+        assert remesh.cur_pane == single.cur_pane
+        # capacity rounds UP to shard divisibility, never truncates
+        assert remesh.gb.capacity >= single.gb.capacity
+        assert remesh.gb.capacity % 8 == 0
+        self._feed([remesh, single], ids, vals)
+        out_1.clear()
+        self._fire([remesh, single], 6000)
+        assert _flat(out_8) == _flat(out_1)
+
+    def test_restore_rounds_odd_capacity(self, eight_devices, mock_clock):
+        plain, _ = _mk_node(None, capacity=24)
+        plain.process(_batch([f"k{i}" for i in range(10)],
+                             np.ones(10)))
+        snap = plain.snapshot_state()
+        remesh, out = _mk_node(make_mesh(rows=1, keys=8), capacity=24)
+        remesh.restore_state(snap)
+        assert remesh.gb.capacity % 8 == 0
+        remesh.on_trigger(Trigger(ts=2000))
+        remesh._drain_async_emits()
+        got = _flat(out)
+        assert sum(got.values()) == 10  # every restored key emits
+
+
+class TestAutoShardSelection:
+    def test_mesh_request_resolution(self, monkeypatch):
+        from ekuiper_tpu.planner.planner import (RuleDef, merged_options,
+                                                 mesh_request)
+
+        plan = extract_kernel_plan(parse_select(HOP_SQL))
+        monkeypatch.setenv("KUIPER_MESH", "2x4")
+        opts = merged_options(RuleDef(
+            id="a", sql=HOP_SQL,
+            options={"planOptimizeStrategy": {"shards": "auto"}}))
+        req = mesh_request(opts, plan)
+        assert req["mode"] == "sharded"
+        assert req["cfg"] == {"rows": 2, "keys": 4}
+        # env acts as the deployment default for silent rules
+        req2 = mesh_request(
+            merged_options(RuleDef(id="b", sql=HOP_SQL)), plan)
+        assert req2["mode"] == "sharded"
+        assert req2["source"] == "KUIPER_MESH"
+        # shards=off pins single-chip even under the env
+        req3 = mesh_request(merged_options(RuleDef(
+            id="c", sql=HOP_SQL,
+            options={"planOptimizeStrategy": {"shards": "off"}})), plan)
+        assert req3["mode"] == "single-chip"
+        # integer shard counts need no env
+        monkeypatch.delenv("KUIPER_MESH")
+        req4 = mesh_request(merged_options(RuleDef(
+            id="d", sql=HOP_SQL,
+            options={"planOptimizeStrategy": {"shards": 4}})), plan)
+        assert req4["mode"] == "sharded"
+        assert req4["cfg"] == {"rows": 1, "keys": 4}
+
+    def test_heavy_hitters_falls_back_single_chip(self, monkeypatch):
+        from ekuiper_tpu.planner.planner import (RuleDef, merged_options,
+                                                 mesh_request)
+
+        hh_sql = ("SELECT k, heavy_hitters(t, 2) AS hh FROM d "
+                  "GROUP BY k, TUMBLINGWINDOW(ss, 2)")
+        plan = extract_kernel_plan(parse_select(hh_sql))
+        assert plan is not None
+        monkeypatch.setenv("KUIPER_MESH", "1x8")
+        req = mesh_request(merged_options(RuleDef(id="h", sql=hh_sql)),
+                           plan)
+        assert req["mode"] == "single-chip"
+        assert "heavy_hitters" in req["reason"]
+
+    def test_planner_builds_sharded_node(self, eight_devices, monkeypatch):
+        from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+        from ekuiper_tpu.server.processors import StreamProcessor
+        from ekuiper_tpu.store import kv
+        from ekuiper_tpu.utils.infra import PlanError
+
+        monkeypatch.setenv("KUIPER_MESH", "2x4")
+        store = kv.get_store()
+        try:
+            StreamProcessor(store).exec_stmt(
+                'CREATE STREAM mc_sel (k STRING, v FLOAT) '
+                'WITH (DATASOURCE="mc/in", TYPE="memory", FORMAT="JSON")')
+        except PlanError:
+            pass
+        rule = RuleDef(
+            id="mc_auto",
+            sql=("SELECT k, avg(v) AS a FROM mc_sel "
+                 "GROUP BY k, TUMBLINGWINDOW(ss, 10)"),
+            actions=[{"nop": {}}],
+            options={"sharedFold": False,
+                     "planOptimizeStrategy": {"shards": "auto"}})
+        topo = plan_rule(rule, store)
+        fused = next(n for n in topo.ops
+                     if isinstance(n, FusedWindowAggNode))
+        assert isinstance(fused.gb, ShardedGroupBy)
+        assert fused.shard_info["mode"] == "sharded"
+        assert fused.shard_info["mesh"] == {"rows": 2, "keys": 4}
+
+    def test_explain_shards_and_sliding_sections(self, eight_devices,
+                                                 monkeypatch):
+        from ekuiper_tpu.planner.planner import RuleDef, explain
+        from ekuiper_tpu.store import kv
+
+        monkeypatch.setenv("KUIPER_MESH", "1x8")
+        store = kv.get_store()
+        out = explain(RuleDef(
+            id="ex1",
+            sql=("SELECT k, avg(v) AS a FROM d "
+                 "GROUP BY k, TUMBLINGWINDOW(ss, 10)"),
+            options={"planOptimizeStrategy": {"shards": "auto"}}), store)
+        assert out["shards"]["mode"] == "sharded"
+        assert out["shards"]["shards"] == 8
+        sl = explain(RuleDef(
+            id="ex2",
+            sql=("SELECT k, count(*) AS c FROM d GROUP BY k, "
+                 "SLIDINGWINDOW(ss, 2) OVER (WHEN v > 90)")), store)
+        assert sl["sliding"]["impl"] == "refold"
+        assert "sharded" in sl["sliding"]["fallback_reason"]
+        monkeypatch.delenv("KUIPER_MESH")
+        sl2 = explain(RuleDef(
+            id="ex3",
+            sql=("SELECT k, count(*) AS c FROM d GROUP BY k, "
+                 "SLIDINGWINDOW(ss, 2) OVER (WHEN v > 90)")), store)
+        assert sl2["sliding"]["impl"] == "daba"
+        assert sl2["sliding"]["fallback_reason"] is None
+
+
+class TestShardedTouchColumn:
+    """Satellite: grow/state_from_host carry the uint32 touch column the
+    same way DeviceGroupBy does — no forked dtype logic for a later
+    sharded tier."""
+
+    def test_touch_parity_across_grow_and_restore(self, eight_devices):
+        sql = ("SELECT k, avg(v) AS a FROM d "
+               "GROUP BY k, TUMBLINGWINDOW(ss, 10)")
+        plan = extract_kernel_plan(parse_select(sql))
+        mesh = make_mesh(rows=2, keys=4)
+        sgb = ShardedGroupBy(plan, mesh, capacity=32, micro_batch=64,
+                             track_touch=True)
+        gb = DeviceGroupBy(extract_kernel_plan(parse_select(sql)),
+                           capacity=32, micro_batch=64, track_touch=True)
+        kt = KeyTable(32)
+        rng = np.random.default_rng(5)
+        keys = np.array([f"k{rng.integers(20)}" for _ in range(200)],
+                        dtype=np.object_)
+        slots, _ = kt.encode_column(keys)
+        cols = {"v": rng.normal(0, 1, 200).astype(np.float32)}
+        ss = sgb.fold(sgb.init_state(), cols, slots)
+        ds = gb.fold(gb.init_state(), cols, slots)
+        np.testing.assert_array_equal(np.asarray(ss["touch"]),
+                                      np.asarray(ds["touch"]))
+        ss = sgb.grow(ss, 64)
+        ds = gb.grow(ds, 64)
+        assert np.asarray(ss["touch"]).dtype == np.uint32
+        np.testing.assert_array_equal(np.asarray(ss["touch"]),
+                                      np.asarray(ds["touch"]))
+        # roundtrip through checkpoint typing: uint32 survives
+        host, cap = sgb.host_from_partials(sgb.state_to_host(ss))
+        assert host["touch"].dtype == np.uint32
+        ss2 = sgb.state_from_host(host)
+        np.testing.assert_array_equal(np.asarray(ss2["touch"]),
+                                      np.asarray(ds["touch"]))
+
+
+class TestShardedPaneStore:
+    def test_pane_store_mesh_parity(self, eight_devices):
+        from ekuiper_tpu.ops.panestore import PaneStore
+
+        sql = ("SELECT k, sum(v) AS s, min(v) AS mn FROM d "
+               "GROUP BY k, HOPPINGWINDOW(ss, 4, 2)")
+        plan = extract_kernel_plan(parse_select(sql))
+        mesh = make_mesh(rows=2, keys=4)
+        sharded = PaneStore(plan, 2000, 4, capacity=32, micro_batch=64,
+                            tier_budget_mb=0.0, mesh=mesh)
+        plain = PaneStore(extract_kernel_plan(parse_select(sql)), 2000, 4,
+                          capacity=32, micro_batch=64, tier_budget_mb=0.0)
+        assert isinstance(sharded.gb, ShardedGroupBy)
+        assert sharded.tier is None
+        kt = KeyTable(32)
+        rng = np.random.default_rng(9)
+        for pane in range(3):
+            keys = np.array([f"k{rng.integers(40)}" for _ in range(120)],
+                            dtype=np.object_)
+            slots, grew = kt.encode_column(keys)
+            cols = {"v": rng.normal(5, 2, 120).astype(np.float32)}
+            for st in (sharded, plain):
+                st.kt.restore(kt.decode_all())
+                st.fold(dict(cols), {}, slots, pane)
+        souts, sact = sharded.combine([0, 1, 2], kt.n_keys)
+        pouts, pact = plain.combine([0, 1, 2], kt.n_keys)
+        np.testing.assert_array_equal(sact, pact)
+        for i in range(len(souts)):
+            np.testing.assert_allclose(souts[i], pouts[i], rtol=1e-5,
+                                       atol=1e-5)
+
+    def test_sharing_store_key_carries_mesh_facet(self, monkeypatch):
+        from ekuiper_tpu.planner.planner import RuleDef, merged_options
+        from ekuiper_tpu.planner.sharing import store_key
+
+        stmt = parse_select(HOP_SQL)
+        opts_plain = merged_options(RuleDef(id="a", sql=HOP_SQL))
+        monkeypatch.setenv("KUIPER_MESH", "2x4")
+        opts_mesh = merged_options(RuleDef(id="b", sql=HOP_SQL))
+        k_mesh = store_key("sub", stmt, opts_mesh)
+        monkeypatch.delenv("KUIPER_MESH")
+        k_plain = store_key("sub", stmt, opts_plain)
+        assert k_mesh != k_plain
+        assert "mesh=2x4" in k_mesh
+
+
+class TestMeshAwarePrep:
+    def test_device_input_fold_parity(self, eight_devices):
+        sql = ("SELECT k, avg(v) AS a, count(*) AS c FROM d "
+               "GROUP BY k, TUMBLINGWINDOW(ss, 10)")
+        plan = extract_kernel_plan(parse_select(sql))
+        mesh = make_mesh(rows=1, keys=8)
+        sgb = ShardedGroupBy(plan, mesh, capacity=64, micro_batch=256)
+        assert sgb.accepts_device_inputs
+        from ekuiper_tpu.runtime.ingest import (pad_col_for_device,
+                                                pad_slots_for_device)
+
+        kt = KeyTable(64)
+        rng = np.random.default_rng(13)
+        keys = np.array([f"k{rng.integers(30)}" for _ in range(200)],
+                        dtype=np.object_)
+        slots, _ = kt.encode_column(keys)
+        vals = rng.normal(3, 1, 200).astype(np.float32)
+        dv, _ = pad_col_for_device(vals, None, 256,
+                                   sharding=sgb.batch_sharding)
+        ds = pad_slots_for_device(slots, 256, False,
+                                  sharding=sgb.batch_sharding)
+        st_dev = sgb.fold(sgb.init_state(), {"v": dv}, ds, n_rows=200)
+        st_host = sgb.fold(sgb.init_state(), {"v": vals}, slots)
+        o1, a1 = sgb.finalize(st_dev, kt.n_keys)
+        o2, a2 = sgb.finalize(st_host, kt.n_keys)
+        np.testing.assert_array_equal(a1, a2)
+        for i in range(len(o1)):
+            np.testing.assert_allclose(o1[i], o2[i], rtol=1e-6)
+
+    def test_shard_metrics_render(self, eight_devices):
+        from ekuiper_tpu.parallel import sharded as sharded_mod
+
+        sql = ("SELECT k, count(*) AS c FROM d "
+               "GROUP BY k, TUMBLINGWINDOW(ss, 10)")
+        plan = extract_kernel_plan(parse_select(sql))
+        sgb = ShardedGroupBy(plan, make_mesh(rows=1, keys=8),
+                             capacity=64, micro_batch=64)
+        kt = KeyTable(64)
+        keys = np.array([f"k{i}" for i in range(40)], dtype=np.object_)
+        slots, _ = kt.encode_column(keys)
+        sgb.fold(sgb.init_state(), {}, slots)
+        sgb.note_rows(slots, n_keys=kt.n_keys)
+        out: list = []
+        sharded_mod.render_prometheus(out, lambda s: s)
+        text = "\n".join(out)
+        assert "kuiper_shard_rows_total" in text
+        assert 'shard="0"' in text
+        stats = sgb.shard_stats()
+        assert sum(s["rows"] for s in stats) >= 40
+        assert sum(s["keys"] for s in stats) == 40
+
+
+class TestPlacementAdmission:
+    """The QoS control plane's per-chip ledger: a rule the single-chip
+    HBM budget would 429 is placed across the mesh instead."""
+
+    FAT_SQL = ("SELECT k, avg(v) AS a, sum(v) AS s FROM d "
+               "GROUP BY k, TUMBLINGWINDOW(ss, 10)")
+
+    def _fat_rule(self):
+        from ekuiper_tpu.planner.planner import RuleDef
+
+        return RuleDef(id="fat", sql=self.FAT_SQL,
+                       options={"key_slots": 524288, "sharedFold": False,
+                                "tierStore": "off"})
+
+    def test_single_chip_rejects_mesh_accepts(self, monkeypatch):
+        from ekuiper_tpu.runtime import control
+        from ekuiper_tpu.store import kv
+
+        store = kv.get_store()
+        monkeypatch.setenv("KUIPER_HBM_BUDGET_MB", "8")
+        ctl = control.install(lambda: [], start=False)
+        try:
+            rejected = control.admit_rule(self._fat_rule(), store)
+            assert rejected["decision"] == "reject"
+            monkeypatch.setenv("KUIPER_MESH", "1x8")
+            placed = control.admit_rule(self._fat_rule(), store)
+            assert placed["decision"] == "accept"
+            placement = placed["price"]["placement"]
+            assert placement["mode"] == "sharded"
+            assert placement["shards"] == list(range(8))
+            # commit bills every chip; release clears the ledger
+            ctl.commit("fat", 1.0, placement=placement)
+            loads = ctl.shard_loads(8)
+            assert all(v == placement["bytes_per_shard"] for v in loads)
+            ctl.release("fat")
+            assert all(v == 0 for v in ctl.shard_loads(8))
+        finally:
+            control.reset()
+
+    def test_single_chip_rule_lands_least_loaded(self, monkeypatch):
+        from ekuiper_tpu.planner.planner import RuleDef
+        from ekuiper_tpu.runtime import control
+        from ekuiper_tpu.store import kv
+
+        store = kv.get_store()
+        monkeypatch.setenv("KUIPER_HBM_BUDGET_MB", "8")
+        monkeypatch.setenv("KUIPER_MESH", "1x4")
+        ctl = control.install(lambda: [], start=False)
+        try:
+            # a small single-chip-pinned rule: placed whole on one chip
+            small = RuleDef(
+                id="small", sql=self.FAT_SQL,
+                options={"key_slots": 4096, "sharedFold": False,
+                         "tierStore": "off",
+                         "planOptimizeStrategy": {"shards": "off"}})
+            ctl.commit("existing", 1.0, placement={
+                "mode": "single", "shards": [0],
+                "bytes_per_shard": 4 << 20})
+            d = control.admit_rule(small, store)
+            assert d["decision"] == "accept"
+            placement = d["price"]["placement"]
+            assert placement["mode"] == "single"
+            assert placement["shards"][0] != 0  # avoided the loaded chip
+        finally:
+            control.reset()
+
+    def test_placement_in_diagnostics(self, monkeypatch):
+        from ekuiper_tpu.runtime import control
+
+        monkeypatch.setenv("KUIPER_MESH", "1x4")
+        ctl = control.QoSController(lambda: [])
+        ctl.commit("r1", 1.0, placement={
+            "mode": "sharded", "shards": [0, 1, 2, 3],
+            "bytes_per_shard": 100})
+        diag = ctl.diagnostics()
+        assert diag["placement"]["shards"] == 4
+        assert diag["placement"]["committed_bytes_per_shard"] == [100] * 4
+        assert "r1" in diag["placement"]["rules"]
+
+
+class TestSlidingFallbackEvent:
+    def test_sharded_daba_request_records_flight_event(self,
+                                                       eight_devices,
+                                                       mock_clock):
+        sql = ("SELECT k, count(*) AS c FROM d GROUP BY k, "
+               "SLIDINGWINDOW(ss, 2) OVER (WHEN v > 90)")
+        stmt = parse_select(sql)
+        plan = extract_kernel_plan(stmt)
+        recorder().clear()
+        node = FusedWindowAggNode(
+            "mc_slide", stmt.window, plan,
+            [d.expr for d in stmt.dimensions],
+            capacity=32, micro_batch=64,
+            mesh=make_mesh(rows=2, keys=4), sliding_impl="daba")
+        assert node.sliding_impl == "refold"
+        evs = [e for e in recorder().events(kind="sliding_impl_fallback")]
+        assert evs, "no sliding_impl_fallback flight event"
+        assert evs[-1]["reason"] == "sharded_kernel"
+        assert evs[-1]["action"] == "refold"
+        assert evs[-1]["requested"] == "daba"

@@ -71,6 +71,10 @@ HEADLINE = (
     # folds, promote misses) shows up in exactly these two
     ("phases.key_cardinality.rows_per_sec", 0.15),
     ("phases.key_cardinality.emit_p99_ms", 0.50),
+    # multi-chip sharded serving (ISSUE 15): the saturated tumbling full
+    # pipe on the device mesh gates every round instead of a dryrun —
+    # same throughput tolerance as the single-chip full-pipe line
+    ("phases.multichip_full_pipe.rows_per_sec", 0.15),
 )
 
 #: default noise tolerance for every non-headline comparison

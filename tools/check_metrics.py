@@ -155,6 +155,22 @@ def _synthetic_scrape() -> str:
 
     tier_mgr = FakeTier()
     tierstore.registry().register(tier_mgr, "lint_rule")
+    # multi-chip sharded serving (parallel/sharded.py): one registered
+    # fake kernel so kuiper_shard_rows_total / kuiper_shard_keys render
+    from ekuiper_tpu.parallel import sharded as sharded_mod
+
+    class FakeSharded:
+        mesh_tag = "1x2"
+        capacity = 64
+
+        def shard_stats(self):
+            return [{"shard": 0, "rows": 5, "keys": 3, "slots": 32,
+                     "state_bytes": 128},
+                    {"shard": 1, "rows": 2, "keys": 1, "slots": 32,
+                     "state_bytes": 128}]
+
+    shard_kernel = FakeSharded()
+    sharded_mod.registry().register(shard_kernel)
     # health plane: an installed evaluator with one ticked verdict so the
     # kuiper_rule_health / kuiper_slo_burn_rate / kuiper_watermark_lag_ms
     # / kuiper_bottleneck_stage families all render samples
@@ -183,8 +199,10 @@ def _synthetic_scrape() -> str:
         kernwatch.reset()
         memwatch.registry().clear()
         tierstore.reset()
+        sharded_mod.reset()
         del owner
         del tier_mgr
+        del shard_kernel
 
 
 def lint(text: str, docs_text: str) -> list:

@@ -12,6 +12,9 @@ passed):
                     device-fused, fold parity, jitcert clean
   probe_tiering     tiered key state smoke: demote/promote parity,
                     slot recycling, cross-tier checkpoint, jitcert clean
+  probe_multichip   sharded serving smoke: full-pipe parity on the
+                    8-virtual-device CPU mesh, cross-mesh restore,
+                    placement admission, jitcert clean
   check_metrics     Prometheus catalog lint (synthetic scrape vs docs)
   benchdiff --smoke trajectory-gate self-test (synthetic artifacts)
 
@@ -43,6 +46,7 @@ GATES: Dict[str, List[str]] = {
     "jitcert_diff": [sys.executable, "-m", "tools.jitcert", "diff"],
     "probe_exprs": [sys.executable, "tools/probe_exprs.py"],
     "probe_tiering": [sys.executable, "tools/probe_tiering.py"],
+    "probe_multichip": [sys.executable, "tools/probe_multichip.py"],
     "check_metrics": [sys.executable, "tools/check_metrics.py"],
     "benchdiff_smoke": [sys.executable, "tools/benchdiff.py", "--smoke"],
 }
