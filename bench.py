@@ -97,6 +97,7 @@ PHASE_FLOORS = (
     ("multi_rule_shared_mixed", 25.0),
     ("key_cardinality", 45.0),
     ("multichip_full_pipe", 40.0),
+    ("cold_start", 30.0),
     ("churn_soak", 45.0),
 )
 
@@ -1083,7 +1084,12 @@ def _churn_soak_main() -> None:
                {k: v for k, v in a.items() if k != "ts_ms"}
                for a in ((api.qos_controller.diagnostics()
                           .get("autosize") or {}).get("recent") or [])
-           ][-8:])
+           ][-8:],
+           # churn keeps re-planning rules over the same certified
+           # signature set: compile_total staying flat (vs rules_created
+           # growing) is the AOT cache's zero-compile-churn claim
+           compile_total=_compile_total(),
+           aot=_aot_fields())
     dog.disarm()
     # daemon node threads + live jax state can segfault interpreter
     # teardown; the records are flushed — exit hard (kuiperdiag
@@ -1138,13 +1144,25 @@ def _multichip_full_pipe_main() -> None:
     from ekuiper_tpu.server.processors import StreamProcessor
     from ekuiper_tpu.store import kv
 
+    on_tpu = os.environ.get("KUIPER_BENCH_MULTICHIP_TPU", "0") == "1"
+    # CPU host-device emulation pays every shard's fold on the same
+    # shared cores, so the full-size workload cannot finish two legs +
+    # parity inside the phase floor (BENCH_r05: rc=124 with parsed null
+    # — the child outlived the whole driver budget with nothing
+    # recorded). Shrink rows, key universe, and per-fold state for the
+    # emulated run; real chips keep the full-size workload.
+    # (universe ~85% of the slot table so the key-range partition still
+    # engages nearly every shard of the virtual mesh)
+    key_universe = N_DEVICES if on_tpu else 3_500
+    drain_rows = 2048 if on_tpu else 1024
+    mb_rows = 16384 if on_tpu else 8192
+    slots = 16384 if on_tpu else 4096
     rng = np.random.default_rng(29)
-    drain_rows = 2048
     drains = []
     for _ in range(8):
         drains.append([
             _json.dumps({
-                "deviceId": f"dev_{rng.integers(0, N_DEVICES)}",
+                "deviceId": f"dev_{rng.integers(0, key_universe)}",
                 "temperature": round(float(rng.normal(20, 5)), 2),
             }).encode()
             for _ in range(drain_rows)
@@ -1153,6 +1171,11 @@ def _multichip_full_pipe_main() -> None:
     seg_s = 8.0
     if child_budget > 0:
         seg_s = min(seg_s, max((child_budget - 60.0) / 2.0, 3.0))
+    # per-leg deadline: each leg (plan + compile + warm + timed segment)
+    # gets its share of the child budget; a leg that cannot start in
+    # time is dropped with the partial record already emitted
+    leg_deadline = (time.time() + child_budget - 20.0
+                    if child_budget > 0 else float("inf"))
 
     def run_leg(shards: str, tag: str):
         """Plan + open one rule, saturate it for seg_s, return metrics."""
@@ -1170,8 +1193,8 @@ def _multichip_full_pipe_main() -> None:
                 "SELECT deviceId, avg(temperature) AS a, count(*) AS c "
                 "FROM pipe_mc GROUP BY deviceId, TUMBLINGWINDOW(ss, 5)"),
             actions=[{"nop": {}}],
-            options={"bufferLength": 64, "micro_batch_rows": 16384,
-                     "micro_batch_linger_ms": 50, "key_slots": 16384,
+            options={"bufferLength": 64, "micro_batch_rows": mb_rows,
+                     "micro_batch_linger_ms": 50, "key_slots": slots,
                      "decodePoolSize": 2, "ingestRingDepth": 2,
                      "sharedFold": False,
                      "planOptimizeStrategy": {"shards": shards}})
@@ -1221,6 +1244,19 @@ def _multichip_full_pipe_main() -> None:
     os.environ["KUIPER_MESH"] = f"1x{n_dev}"
     try:
         single = run_leg("off", "single")
+        # partial record NOW: if the sharded leg dies to the watchdog or
+        # the parent's kill, the artifact still carries the single-shard
+        # leg instead of a bare timeout (the r05 parsed-null class)
+        record("multichip_full_pipe",
+               single_shard_rows_per_sec=single["rows_per_sec"],
+               n_devices=n_dev, partial="single leg only")
+        if time.time() + 25.0 > leg_deadline:
+            print("# multichip_full_pipe: sharded leg dropped — "
+                  "per-leg budget exhausted after the single leg",
+                  file=sys.stderr)
+            dog.disarm()
+            sys.stderr.flush()
+            os._exit(0)
         sharded = run_leg("auto", "sharded")
     finally:
         os.environ.pop("KUIPER_MESH", None)
@@ -1284,6 +1320,143 @@ def _multichip_full_pipe_main() -> None:
            jitcert=_jitcert_fields(),
            emit_p99_ms=sharded["e2e_p99_ms"],
            e2e_p50_ms=sharded["e2e_p50_ms"])
+    dog.disarm()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
+
+
+def bench_cold_start() -> None:
+    _run_isolated("_cold_start_main", "cold_start", timeout=180)
+
+
+def _cold_start_main() -> None:
+    """Zero-compile serving phase (ISSUE 16): boot→first-emit and
+    rule-create→first-emit for the SAME planned rule, cold (empty AOT
+    executable cache — warmup lowers + compiles every fused-window
+    executable) then warm (in-process restart against the disk cache the
+    cold leg just baked — warmup is a deserialization sweep). The warm
+    leg must show ZERO XLA traces and zero AOT misses: that pair is the
+    cache's zero-compile-restart claim, and `speedup_first_fold_x` is
+    its headline (seconds cold vs tens of ms warm).
+
+    Runs on CPU jax in its own subprocess: the phase measures compile
+    amortization, not device throughput."""
+    import json as _json
+    import shutil
+    import tempfile
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    cache_dir = tempfile.mkdtemp(prefix="bench-aot-")
+    os.environ["KUIPER_AOT_CACHE_DIR"] = cache_dir
+    child_budget = float(os.environ.get("BENCH_CHILD_BUDGET_S", "0") or 0)
+    dog = PhaseWatchdog()
+    if child_budget > 0:
+        dog.arm("cold_start_child", child_budget)
+    from ekuiper_tpu.io import memory as mem
+    from ekuiper_tpu.observability import devwatch, jitcert
+    from ekuiper_tpu.planner.planner import RuleDef, plan_rule
+    from ekuiper_tpu.runtime import aotcache
+    from ekuiper_tpu.server.processors import StreamProcessor
+    from ekuiper_tpu.store import kv
+
+    rng = np.random.default_rng(31)
+    rows = [
+        _json.dumps({
+            "deviceId": f"dev_{rng.integers(0, 500)}",
+            "temperature": round(float(rng.normal(20, 5)), 2),
+        }).encode()
+        for _ in range(2048)
+    ]
+
+    def leg(tag: str) -> dict:
+        t_boot = time.time()
+        mem.reset()
+        store = kv.get_store()
+        try:
+            StreamProcessor(store).exec_stmt(
+                'CREATE STREAM pipe_cs (deviceId STRING, temperature '
+                'FLOAT) WITH (DATASOURCE="topic/pipe_cs", TYPE="memory", '
+                'FORMAT="JSON")')
+        except Exception:
+            pass
+        t_rule = time.time()
+        # ONE rule id + no shared-fold grouping: the warm leg must plan
+        # the byte-identical kernel config (a store still holding the
+        # cold leg's rule would otherwise vmap-group the warm plan into
+        # different state shapes, and nothing would hit the cache)
+        rule = RuleDef(
+            id="cs_restart",
+            sql=("SELECT deviceId, avg(temperature) AS a, count(*) AS c "
+                 "FROM pipe_cs GROUP BY deviceId, TUMBLINGWINDOW(ss, 1)"),
+            actions=[{"nop": {}}],
+            options={"bufferLength": 64, "micro_batch_rows": 2048,
+                     "micro_batch_linger_ms": 20, "key_slots": 1024,
+                     "sharedFold": False})
+        topo = plan_rule(rule, store)
+        topo.open()  # <- warmup: compile sweep cold, cache probe warm
+        src = (topo.sources[0] if topo.sources
+               else topo._live_shared[0][0].source)
+        try:
+            src.ingest(rows)
+            topo.wait_idle(60.0)
+            t_fold = time.time()
+            # first EMIT additionally waits for the 1s tumbling window
+            # to close — the user-visible latency, window wait included
+            emit_deadline = time.time() + 30.0
+            while (topo.e2e_hist.count == 0
+                   and time.time() < emit_deadline):
+                time.sleep(0.01)
+            t_emit = time.time()
+            return {
+                "boot_to_first_fold_ms": (t_fold - t_boot) * 1000.0,
+                "rule_create_to_first_fold_ms":
+                    (t_fold - t_rule) * 1000.0,
+                "boot_to_first_emit_ms": (t_emit - t_boot) * 1000.0,
+                "rule_create_to_first_emit_ms":
+                    (t_emit - t_rule) * 1000.0,
+                "emitted": bool(topo.e2e_hist.count > 0),
+                "compile_total": _compile_total(),
+                "aot": _aot_fields(),
+            }
+        finally:
+            topo.close()
+            mem.reset()
+
+    try:
+        cold = leg("cold")
+        # partial record NOW so a watchdog kill still leaves the cold
+        # numbers in the artifact (the r05 parsed-null class)
+        record("cold_start", cold=cold, partial="cold leg only")
+        # in-process restart: kernels + every registry die; only the
+        # disk cache the cold leg baked survives — what a real process
+        # restart on the same image sees
+        devwatch.registry().clear()
+        jitcert.reset()
+        aotcache.reset()
+        warm = leg("warm")
+        zero_compile = (warm["compile_total"] == 0
+                        and warm["aot"]["misses"] == 0)
+        record("cold_start",
+               cold=cold, warm=warm,
+               zero_compile_restart=zero_compile,
+               warm_disk_loads=warm["aot"]["disk_loads"],
+               speedup_first_fold_x=round(
+                   cold["rule_create_to_first_fold_ms"]
+                   / max(warm["rule_create_to_first_fold_ms"], 1e-3), 1),
+               jitcert=_jitcert_fields())
+        print(
+            "# cold_start: rule-create→first-fold "
+            f"{cold['rule_create_to_first_fold_ms']:.0f}ms cold -> "
+            f"{warm['rule_create_to_first_fold_ms']:.0f}ms warm; "
+            f"first-emit {cold['rule_create_to_first_emit_ms']:.0f}ms "
+            f"cold -> {warm['rule_create_to_first_emit_ms']:.0f}ms warm; "
+            f"warm compiles {warm['compile_total']}, aot misses "
+            f"{warm['aot']['misses']} (zero_compile_restart="
+            f"{zero_compile})", file=sys.stderr)
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        os.environ.pop("KUIPER_AOT_CACHE_DIR", None)
     dog.disarm()
     sys.stdout.flush()
     sys.stderr.flush()
@@ -1820,6 +1993,28 @@ def _hist_overhead(fused) -> dict:
     return {"record_us": round(per_record_us, 3),
             "fold_us_per_call": round(fold_us, 1),
             "pct_of_fold": round(pct, 3) if pct is not None else None}
+
+
+def _compile_total() -> int:
+    """Engine-wide XLA trace count (devwatch): the number the AOT cache
+    exists to hold flat across rule churn and restarts."""
+    from ekuiper_tpu.observability import devwatch
+
+    return int(devwatch.registry().totals()["compiles"])
+
+
+def _aot_fields() -> dict:
+    """AOT executable-cache counters for the artifact (runtime/
+    aotcache.py): hits serve from prebuilt executables, misses paid a
+    serve-path lower+compile, disk_loads deserialized a baked entry."""
+    from ekuiper_tpu.runtime import aotcache
+
+    s = aotcache.stats().snapshot()
+    return {"hits": s["hits"], "misses": s["misses"],
+            "serve_misses": s["serve_misses"],
+            "disk_loads": s["disk_loads"], "builds": s["builds"],
+            "build_seconds": s["build_seconds"],
+            "executables": s["executables"]}
 
 
 def _e2e_fields(topo) -> dict:
@@ -2918,6 +3113,9 @@ def main() -> None:
     # emulation unless KUIPER_BENCH_MULTICHIP_TPU=1 points it at real
     # chips, so it never contends with the parent's TPU client
     bench_multichip_full_pipe()
+    # cold vs warm boot on CPU jax in its own subprocess: the AOT
+    # executable cache's zero-compile-restart claim, measured
+    bench_cold_start()
     # the churn soak runs LAST (its floor is reserved by every earlier
     # phase): it needs no chip to itself — it measures the QoS control
     # plane on CPU jax in its own subprocess

@@ -870,6 +870,21 @@ def estimate_plan_signatures(plan, n_panes: int, micro_batch: int,
     `tier_demote_batch` > 0 prices a tiered rule's demote/promote sites
     (the touch column changes every state signature, so the whole shape
     is derived with it)."""
+    return sum(c.full_count for c in estimate_plan_certs(
+        plan, n_panes, micro_batch, capacity,
+        sliding_ring_slots=sliding_ring_slots,
+        tier_demote_batch=tier_demote_batch))
+
+
+def estimate_plan_certs(plan, n_panes: int, micro_batch: int,
+                        capacity: int,
+                        sliding_ring_slots: int = 0,
+                        tier_demote_batch: int = 0) -> List[SiteCert]:
+    """The cert OBJECTS behind estimate_plan_signatures. The AOT cache
+    (runtime/aotcache.py) prices a candidate against their enumerated
+    signature strings — certificate strings ARE cache-key material, so
+    admission can tell certified-but-uncached signatures (real compile
+    debt) from ones a fleet bake already persisted."""
     ks = shape_from_plan(plan, n_panes, micro_batch, capacity,
                          touch=tier_demote_batch > 0)
     certs = [
@@ -899,4 +914,4 @@ def estimate_plan_signatures(plan, n_panes: int, micro_batch: int,
                                   tier_demote_batch, "demote", grows=0))
         certs.append(_derive_tier(ks, "tierstore.promote", None,
                                   tier_demote_batch, "promote", grows=0))
-    return sum(c.full_count for c in certs)
+    return certs

@@ -220,6 +220,12 @@ def render(rule_registry) -> str:
     devwatch.render_prometheus(out, _esc)
     kernwatch.render_prometheus(out, _esc)
     memwatch.render_prometheus(out, _esc)
+    # AOT executable cache (runtime/aotcache.py): pre-built-executable
+    # hit/miss/build accounting + the warmup-failure counter — the
+    # zero-compile-serving plane's scrape surface
+    from ..runtime import aotcache as _aotcache
+
+    _aotcache.render_prometheus(out, _esc)
     # tiered key state (ops/tierstore.py): demote/promote counters,
     # cold-tier residency and host arena bytes per tiered rule
     from ..ops import tierstore

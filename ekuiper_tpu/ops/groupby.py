@@ -134,14 +134,14 @@ class DeviceGroupBy:
         for i, spec in enumerate(plan.specs):
             for comp in spec.components:
                 self.comp_specs.setdefault(comp, []).append(i)
-        from ..observability.devwatch import watched_jit
+        from ..runtime.aotcache import aot_jit
 
-        self._fold = watched_jit(self._fold_impl, op=self._watch_op("fold"),
+        self._fold = aot_jit(self._fold_impl, op=self._watch_op("fold"),
                                  donate_argnums=(0,))
         # row-masked fold: the sliding edge refold re-folds CACHED device
         # batches under an arbitrary (mb,) bool row mask (window time cut),
         # so trigger emission uploads one 65KB mask instead of the rows
-        self._fold_m = watched_jit(self._fold_masked_impl,
+        self._fold_m = aot_jit(self._fold_masked_impl,
                                    op=self._watch_op("fold_masked"),
                                    kind="boundary",
                                    donate_argnums=(0,))
@@ -149,17 +149,17 @@ class DeviceGroupBy:
         # executable per live-pane combination (few), and the output is ONE
         # stacked array -> a single device->host transfer per window emit
         # (sync round trips cost 10-90ms on tunneled TPU; see bench notes)
-        self._finalize = watched_jit(self._finalize_impl,
+        self._finalize = aot_jit(self._finalize_impl,
                                      op=self._watch_op("finalize"),
                                      kind="boundary",
                                      static_argnums=(1,))
         # dynamic-mask variant: event-time windows rotate through per-window
         # pane subsets; a static mask would compile one executable per
         # subset (up to n_panes compiles), a traced mask compiles once
-        self._finalize_dyn = watched_jit(self._finalize_dyn_impl,
+        self._finalize_dyn = aot_jit(self._finalize_dyn_impl,
                                          op=self._watch_op("finalize_dyn"),
                                          kind="boundary")
-        self._components = watched_jit(self._components_impl,
+        self._components = aot_jit(self._components_impl,
                                        op=self._watch_op("components"),
                                        kind="boundary",
                                        static_argnums=(1,))
@@ -167,10 +167,10 @@ class DeviceGroupBy:
         # fallback (delayed emissions, recycled panes) merges an arbitrary
         # live-pane subset into the SAME stacked components layout with
         # one compiled executable per capacity
-        self._components_dyn = watched_jit(self._components_dyn_impl,
+        self._components_dyn = aot_jit(self._components_dyn_impl,
                                            op=self._watch_op("components_dyn"),
                                            kind="boundary")
-        self._reset_pane = watched_jit(self._reset_pane_impl,
+        self._reset_pane = aot_jit(self._reset_pane_impl,
                                        op=self._watch_op("reset_pane"),
                                        kind="boundary",
                                        donate_argnums=(0,))
@@ -182,7 +182,7 @@ class DeviceGroupBy:
             s.kind == "heavy_hitters" for s in plan.specs
         )
         if self._host_finalize_only:
-            self._hh_fin = watched_jit(self._hh_finalize_impl,
+            self._hh_fin = aot_jit(self._hh_finalize_impl,
                                        op=self._watch_op("hh_finalize"),
                                        kind="boundary")
         # bind this kernel to its compile contract: jitcert derives the
@@ -750,9 +750,9 @@ class DeviceGroupBy:
         import jax.numpy as jnp
 
         if not hasattr(self, "_absorb"):
-            from ..observability.devwatch import watched_jit
+            from ..runtime.aotcache import aot_jit
 
-            self._absorb = watched_jit(self._absorb_impl,
+            self._absorb = aot_jit(self._absorb_impl,
                                        op=self._watch_op("absorb"),
                                        kind="boundary",
                                        donate_argnums=(0,))

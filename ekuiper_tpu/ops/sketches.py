@@ -256,12 +256,12 @@ class CountMinSketch:
         self.max_candidates = max_candidates
         self.counts = jnp.zeros((depth, width), dtype=jnp.float32)
         self.candidates: dict = {}
-        from ..observability.devwatch import watched_jit
+        from ..runtime.aotcache import aot_jit
         from ..observability import jitcert, memwatch
 
-        self._update = watched_jit(self._update_impl, op="sketch.update",
+        self._update = aot_jit(self._update_impl, op="sketch.update",
                                    donate_argnums=(0,))
-        self._query = watched_jit(self._query_impl, op="sketch.query",
+        self._query = aot_jit(self._query_impl, op="sketch.query",
                                   kind="boundary")
         # HBM accounting: the (d, w) device counts plus the bounded host
         # candidate map (~96B/entry of dict + key machinery)

@@ -193,15 +193,15 @@ class SlidingRing:
         if unknown:
             raise ValueError(
                 f"no sliding-ring combine class for components {unknown}")
-        from ..observability.devwatch import watched_jit
+        from ..runtime.aotcache import aot_jit
 
-        self._advance = watched_jit(self._advance_impl,
+        self._advance = aot_jit(self._advance_impl,
                                     op=self._watch_op("advance"),
                                     kind="boundary", donate_argnums=(0,))
-        self._flip = watched_jit(self._flip_impl,
+        self._flip = aot_jit(self._flip_impl,
                                  op=self._watch_op("flip"),
                                  kind="boundary", donate_argnums=(0,))
-        self._query = watched_jit(self._query_impl,
+        self._query = aot_jit(self._query_impl,
                                   op=self._watch_op("query"),
                                   kind="boundary")
         from ..observability import jitcert

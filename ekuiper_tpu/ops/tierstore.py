@@ -174,12 +174,12 @@ class TierStore:
         self.blocks.append(("act", col, ()))
         col += self.n_panes
         self.packed_w = col
-        from ..observability.devwatch import watched_jit
+        from ..runtime.aotcache import aot_jit
 
-        self._demote = watched_jit(self._demote_impl,
+        self._demote = aot_jit(self._demote_impl,
                                    op=self._watch_op("demote"),
                                    kind="boundary", donate_argnums=(0,))
-        self._promote = watched_jit(self._promote_impl,
+        self._promote = aot_jit(self._promote_impl,
                                     op=self._watch_op("promote"),
                                     kind="boundary", donate_argnums=(0,))
         from ..observability import jitcert

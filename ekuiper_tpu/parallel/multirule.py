@@ -149,15 +149,15 @@ class BatchedGroupBy(DeviceGroupBy):
         import jax.numpy as jnp
 
         self._params = jnp.asarray(spec.params)  # (R, P)
-        from ..observability.devwatch import watched_jit
+        from ..runtime.aotcache import aot_jit
 
-        self._fold = watched_jit(self._batched_fold_impl,
+        self._fold = aot_jit(self._batched_fold_impl,
                                  op="multirule.fold", donate_argnums=(0,))
-        self._finalize = watched_jit(self._batched_finalize_impl,
+        self._finalize = aot_jit(self._batched_finalize_impl,
                                      op="multirule.finalize",
                                      kind="boundary",
                                      static_argnums=(1,))
-        self._reset_pane = watched_jit(self._batched_reset_impl,
+        self._reset_pane = aot_jit(self._batched_reset_impl,
                                        op="multirule.reset_pane",
                                        kind="boundary",
                                        donate_argnums=(0,))

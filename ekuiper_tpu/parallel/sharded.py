@@ -334,9 +334,9 @@ class ShardedGroupBy(DeviceGroupBy):
                 out_specs=state_specs,
             )(state, cols, slots, row_valid, pane_idx)
 
-        from ..observability.devwatch import watched_jit
+        from ..runtime.aotcache import aot_jit
 
-        return watched_jit(step, op=self._watch_op("fold_step"),
+        return aot_jit(step, op=self._watch_op("fold_step"),
                            donate_argnums=(0,))
 
     def _build_fold_vec(self):
@@ -471,9 +471,9 @@ class ShardedGroupBy(DeviceGroupBy):
                 out_specs=state_specs,
             )(state, cols, slots, row_valid, pane_vec)
 
-        from ..observability.devwatch import watched_jit
+        from ..runtime.aotcache import aot_jit
 
-        return watched_jit(step, op=self._watch_op("fold_step_vec"),
+        return aot_jit(step, op=self._watch_op("fold_step_vec"),
                            donate_argnums=(0,))
 
     def fold(

@@ -1254,6 +1254,35 @@ def explain(rule: RuleDef, store) -> Dict[str, Any]:
                             "exact refold path")
         out["sliding"] = {"requested": requested, "impl": impl,
                           "fallback_reason": reason}
+    # aot section (docs/AOT_CACHE.md): the executable-cache posture of
+    # this plan's certified compile surface — how many signatures the
+    # jitcert certificate closes over, how many a fleet bake already
+    # persisted (cache hits at boot), and the live per-site hit/miss
+    # counters once the rule is serving. A "cached: 0" on a warm fleet
+    # image is a bake gap: first emit will pay compiles
+    if kernel_plan is not None:
+        try:
+            from ..observability import jitcert as _jitcert
+            from ..runtime import aotcache
+
+            ring_slots = 0
+            if (stmt.window is not None
+                    and stmt.window.window_type
+                    == ast.WindowType.SLIDING_WINDOW
+                    and opts.sliding_impl == "daba"):
+                from ..ops.slidingring import ring_layout_for
+
+                ring_slots = ring_layout_for(
+                    stmt.window, kernel_plan).n_ring_panes
+            aot = aotcache.plan_compile_price(_jitcert.estimate_plan_certs(
+                kernel_plan, 1, opts.micro_batch_rows, opts.key_slots,
+                sliding_ring_slots=ring_slots))
+            live = aotcache.site_report(rule.id)
+            if live:
+                aot["serving"] = live
+            out["aot"] = aot
+        except Exception as exc:  # explain must never fail on the probe
+            out["aot"] = {"error": str(exc)}
     # structured expression-compilation report: which WHERE/arg/FILTER
     # pieces device-compile and which fall back to the row interpreter
     # (with NotVectorizable reason slugs) — so "path: host" is

@@ -365,15 +365,24 @@ def price_rule(rule, store) -> Dict[str, Any]:
 
                         ring_slots = ring_layout_for(
                             stmt.window, plan).n_ring_panes
+                    sig_args = (plan, 1, opts.micro_batch_rows,
+                                _tier_price_slots(price, plan, stmt, opts)
+                                or opts.key_slots)
+                    sig_kw = dict(
+                        sliding_ring_slots=ring_slots,
+                        tier_demote_batch=(price.get("tier", {})
+                                           .get("demote_batch", 0)))
                     price["certified_new_signatures"] = \
                         jitcert.estimate_plan_signatures(
-                            plan, 1, opts.micro_batch_rows,
-                            _tier_price_slots(price, plan, stmt, opts)
-                            or opts.key_slots,
-                            sliding_ring_slots=ring_slots,
-                            tier_demote_batch=(
-                                price.get("tier", {})
-                                .get("demote_batch", 0)))
+                            *sig_args, **sig_kw)
+                    # AOT ledger: signatures a fleet bake already
+                    # persisted are NOT compile debt — the signature
+                    # budget gates on `uncached` when the disk cache is
+                    # on (runtime/aotcache.py, docs/AOT_CACHE.md)
+                    from . import aotcache
+
+                    price["compile"] = aotcache.plan_compile_price(
+                        jitcert.estimate_plan_certs(*sig_args, **sig_kw))
                 except Exception as exc:
                     # leave the UNKNOWN sentinel: failing open here
                     # would both disarm the signature budget and route
@@ -478,14 +487,24 @@ def _static_gates(price: Dict[str, Any],
         # unknown (None) passes THIS gate — rejecting on a pricing
         # failure would make every unpriceable host rule a 429; the
         # storm gate below stays conservative for unknowns instead
-        if certified is not None and int(certified) > sig_budget:
+        priced = certified
+        ledger = price.get("compile")
+        if (priced is not None and ledger is not None
+                and ledger.get("enabled") and not ledger.get("truncated")):
+            # warm fleet image: only certified-but-UNCACHED signatures
+            # are compile debt — executables the AOT bake persisted load
+            # in tens of ms, they cannot stall the serve path
+            priced = int(ledger.get("uncached", priced))
+        if priced is not None and int(priced) > sig_budget:
             return {
                 "decision": "reject",
                 "reason": (
-                    f"certified compile surface of {certified} XLA "
-                    f"signatures exceeds the {sig_budget}-signature "
-                    "budget (KUIPER_ADMISSION_SIG_BUDGET; jitcert "
-                    "certificate at construction capacity)"),
+                    f"certified uncached compile surface of {priced} XLA "
+                    f"signatures (certified {certified}) exceeds the "
+                    f"{sig_budget}-signature budget "
+                    "(KUIPER_ADMISSION_SIG_BUDGET; jitcert certificate "
+                    "at construction capacity minus AOT-cached "
+                    "executables)"),
                 "price": price,
             }
     return None

@@ -513,79 +513,109 @@ class FusedWindowAggNode(Node):
         self._warmup()
 
     def _warmup(self) -> None:
-        """Compile fold/finalize/prefinalize/absorb/reset on a THROWAWAY
-        state before data arrives, so the first window doesn't pay 1-40s of
-        jit latency. Must never touch self.state — it may hold partials
-        restored from a checkpoint."""
+        """Probe the AOT executable cache for every jit site this node
+        will exercise, on a THROWAWAY state, before data arrives. Against
+        a warm disk cache (runtime/aotcache.py) this is a deserialization
+        sweep — tens of ms, zero traces; against a cold one it is the
+        build (1-40s of jit latency the first window would otherwise
+        pay). Runs inside aotcache.building() so the builds it triggers
+        are accounted as deliberate, not serve-time misses. Must never
+        touch self.state — it may hold partials restored from a
+        checkpoint."""
+        from . import aotcache
+
+        self._warmup_stage = "init"
         try:
-            # no valid masks: matches the common typed-schema batch pytree so
-            # the compiled executable is the one real folds will hit
-            # (dtype-correct per column — expression-IR derived columns
-            # are int32, ops/groupby.py col_np_dtype)
-            from ..ops.groupby import warmup_cols
-
-            cols = warmup_cols(self.plan)
-            slots = np.zeros(1, dtype=np.int32)
-            dummy = self.gb.init_state()
-            if self.is_event_time or self.wt == ast.WindowType.SLIDING_WINDOW:
-                # event-time and sliding folds ship per-row pane VECTORS
-                # for multi-bucket batches and the SCALAR pane for
-                # single-bucket ones (the in-order common case) — warm both
-                # executables, and the traced-mask finalize
-                dummy = self.gb.fold(dummy, cols, slots,
-                                     pane_idx=np.zeros(1, dtype=np.int64))
-                dummy = self.gb.fold(dummy, cols, slots, pane_idx=0)
-                self.gb.finalize(dummy, 1, panes=[0])
-                if self.wt == ast.WindowType.SLIDING_WINDOW:
-                    # implementation-aware trigger-path warmup: the DABA
-                    # rounds warm the ring kernels, the refold rounds warm
-                    # fold_masked — never a dead kernel's executable
-                    if self.sliding_impl == "daba":
-                        self._warmup_ring(dummy)
-                    else:
-                        # compile the mask-only edge refold (fold_masked)
-                        # with the exact runtime pytree: pre-padded device
-                        # inputs + (mb,) bool mask — a first real trigger
-                        # must not pay a 20-40s jit stall mid-stream.
-                        # force=True bypasses the small-batch HBM guard,
-                        # which would silently reject this 1-row batch and
-                        # skip the compile
-                        dev = self._upload_sliding_inputs(
-                            warmup_cols(self.plan),
-                            {}, np.zeros(1, dtype=np.int32), force=True)
-                        if dev is not None:
-                            mask = np.zeros(self.gb.micro_batch,
-                                            dtype=np.bool_)
-                            dummy = self.gb.fold_masked(
-                                dummy, dev[3], dev[2], mask,
-                                self.n_ring_panes)
-            else:
-                dummy = self.gb.fold(dummy, cols, slots,
-                                     pane_idx=self.cur_pane)
-                self.gb.finalize(dummy, 1)
-            if self._prefinalize_ok:
-                pending = self.gb.prefinalize_begin(dummy)
-                self.gb.prefinalize_merge(pending, None, 1)
-            if self._tail_host_only:
-                # compile absorb with an identity (empty) shadow
-                from ..ops.prefinalize import HostShadow
-
-                hs = HostShadow(self.plan, self.gb.comp_specs, self.gb.capacity)
-                dummy = self.gb.absorb(dummy, hs.data, 0)
-            if self.tier is not None:
-                # compile the demote/promote sites so the first boundary
-                # with a plan doesn't pay the jit stall
-                dummy, pk = self.tier.ts.demote(
-                    dummy, np.zeros(1, dtype=np.int32))
-                dummy = self.tier.ts.promote(
-                    dummy, np.asarray(pk)[:1], np.zeros(1, dtype=np.int32))
-            self.gb.reset_pane(dummy, self.cur_pane)
+            with aotcache.building():
+                self._warmup_probe()
         except Exception as exc:
-            logger.debug("fused warmup failed (non-fatal): %s", exc)
+            # a swallowed warmup failure is a guaranteed serve-time
+            # compile stall on the first real window — count it
+            # (kuiper_warmup_failures_total), leave a flight event, and
+            # say which stage died so it bisects
+            stage = getattr(self, "_warmup_stage", "?")
+            rule = getattr(self._topo, "rule_id", "") if self._topo else ""
+            logger.warning(
+                "fused warmup failed at stage %r (rule %s will pay "
+                "serve-time compiles): %s", stage, rule or "?", exc)
+            aotcache.note_warmup_failure(rule, stage, exc)
+
+    def _warmup_probe(self) -> None:
+        # no valid masks: matches the common typed-schema batch pytree so
+        # the compiled executable is the one real folds will hit
+        # (dtype-correct per column — expression-IR derived columns
+        # are int32, ops/groupby.py col_np_dtype)
+        from ..ops.groupby import warmup_cols
+
+        self._warmup_stage = "fold"
+        cols = warmup_cols(self.plan)
+        slots = np.zeros(1, dtype=np.int32)
+        dummy = self.gb.init_state()
+        if self.is_event_time or self.wt == ast.WindowType.SLIDING_WINDOW:
+            # event-time and sliding folds ship per-row pane VECTORS
+            # for multi-bucket batches and the SCALAR pane for
+            # single-bucket ones (the in-order common case) — warm both
+            # executables, and the traced-mask finalize
+            dummy = self.gb.fold(dummy, cols, slots,
+                                 pane_idx=np.zeros(1, dtype=np.int64))
+            dummy = self.gb.fold(dummy, cols, slots, pane_idx=0)
+            self._warmup_stage = "finalize"
+            self.gb.finalize(dummy, 1, panes=[0])
+            if self.wt == ast.WindowType.SLIDING_WINDOW:
+                # implementation-aware trigger-path warmup: the DABA
+                # rounds warm the ring kernels, the refold rounds warm
+                # fold_masked — never a dead kernel's executable
+                if self.sliding_impl == "daba":
+                    self._warmup_stage = "ring"
+                    self._warmup_ring(dummy)
+                else:
+                    # compile the mask-only edge refold (fold_masked)
+                    # with the exact runtime pytree: pre-padded device
+                    # inputs + (mb,) bool mask — a first real trigger
+                    # must not pay a 20-40s jit stall mid-stream.
+                    # force=True bypasses the small-batch HBM guard,
+                    # which would silently reject this 1-row batch and
+                    # skip the compile
+                    dev = self._upload_sliding_inputs(
+                        warmup_cols(self.plan),
+                        {}, np.zeros(1, dtype=np.int32), force=True)
+                    self._warmup_stage = "fold_masked"
+                    if dev is not None:
+                        mask = np.zeros(self.gb.micro_batch,
+                                        dtype=np.bool_)
+                        dummy = self.gb.fold_masked(
+                            dummy, dev[3], dev[2], mask,
+                            self.n_ring_panes)
+        else:
+            dummy = self.gb.fold(dummy, cols, slots,
+                                 pane_idx=self.cur_pane)
+            self._warmup_stage = "finalize"
+            self.gb.finalize(dummy, 1)
+        if self._prefinalize_ok:
+            self._warmup_stage = "prefinalize"
+            pending = self.gb.prefinalize_begin(dummy)
+            self.gb.prefinalize_merge(pending, None, 1)
+        if self._tail_host_only:
+            self._warmup_stage = "absorb"
+            # compile absorb with an identity (empty) shadow
+            from ..ops.prefinalize import HostShadow
+
+            hs = HostShadow(self.plan, self.gb.comp_specs, self.gb.capacity)
+            dummy = self.gb.absorb(dummy, hs.data, 0)
+        if self.tier is not None:
+            self._warmup_stage = "tier"
+            # compile the demote/promote sites so the first boundary
+            # with a plan doesn't pay the jit stall
+            dummy, pk = self.tier.ts.demote(
+                dummy, np.zeros(1, dtype=np.int32))
+            dummy = self.tier.ts.promote(
+                dummy, np.asarray(pk)[:1], np.zeros(1, dtype=np.int32))
+        self._warmup_stage = "reset_pane"
+        self.gb.reset_pane(dummy, self.cur_pane)
 
     def _warmup_ring(self, dummy) -> None:
-        """Compile the DABA trigger path (advance/flip/query + the
-        traced-mask components fallback) on throwaway state."""
+        """Probe/compile the DABA trigger path (advance/flip/query +
+        the traced-mask components fallback) on throwaway state."""
         from ..ops.slidingring import QUERY_ADJ
 
         if self._ring_dev is None:  # follow a checkpoint-restored capacity

@@ -75,6 +75,9 @@ def fresh_engine_state():
     from ekuiper_tpu.parallel import sharded
 
     sharded.reset()
+    from ekuiper_tpu.runtime import aotcache
+
+    aotcache.reset()
     timex.use_real_clock()
     # dynamic lock-order teardown check: the acquisition graph
     # accumulates across tests (a consistent GLOBAL order is the

@@ -1,0 +1,248 @@
+"""runtime/aotcache.py — the AOT executable cache (ISSUE 16).
+
+The zero-compile-serving contract, unit-level: cache keys derived from
+jitcert certificate signature strings are byte-stable across fresh
+processes (same plan + capacity ladder → identical keys → disk hits),
+a toolchain/mesh fingerprint change is a clean miss (never a poisoned
+load), disk entries round-trip through serialize/deserialize, warmup
+failures land in the counter + flight recorder instead of a debug log,
+and admission's compile ledger prices exactly the uncached remainder.
+"""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ekuiper_tpu.runtime import aotcache
+from ekuiper_tpu.runtime.events import recorder
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Drives the same plan + one capacity doubling against a shared cache
+# dir and prints the cert-derived cache keys plus the aotcache stats —
+# two fresh interpreters running THIS must agree byte-for-byte.
+_DRIVE = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["KUIPER_AOT_CACHE_DIR"] = sys.argv[1]
+import numpy as np
+from ekuiper_tpu.observability import jitcert
+from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+from ekuiper_tpu.ops.groupby import DeviceGroupBy
+from ekuiper_tpu.ops.keytable import KeyTable
+from ekuiper_tpu.runtime import aotcache
+from ekuiper_tpu.sql.parser import parse_select
+
+stmt = parse_select("SELECT deviceId, avg(v) AS a, count(*) AS c "
+                    "FROM s GROUP BY deviceId, TUMBLINGWINDOW(ss, 5)")
+plan = extract_kernel_plan(stmt)
+kt = KeyTable(32)
+keys = np.array([f"k{i % 8}" for i in range(16)], dtype=np.object_)
+slots, _ = kt.encode_column(keys)
+vals = np.arange(16, dtype=np.float32)
+for cap in (32, 64):  # the capacity ladder: two rungs, same plan
+    gb = DeviceGroupBy(plan, capacity=cap, micro_batch=16)
+    state = gb.fold(gb.init_state(), {"v": vals}, slots)
+    gb.finalize(state, kt.n_keys)
+certs = jitcert.estimate_plan_certs(plan, 1, 16, 32)
+print(json.dumps({
+    "cert_keys": sorted(
+        aotcache.cache_key(c.op, s)
+        for c in certs if not c.truncated for s in c.signatures),
+    "fingerprint": aotcache.fingerprint(),
+    "stats": aotcache.stats().snapshot(),
+}))
+"""
+
+
+def _drive_process(cache_dir: str) -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([sys.executable, "-c", _DRIVE, cache_dir],
+                       capture_output=True, text=True, timeout=300,
+                       cwd=str(REPO), env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_cache_keys_stable_and_warm_across_processes(tmp_path):
+    """THE stability contract: two fresh interpreters derive
+    byte-identical cert cache keys for the same plan + capacity ladder,
+    and the second serves every executable from the first's disk cache
+    (zero compiles — a process restart costs deserialization only)."""
+    cache = str(tmp_path / "aot")
+    first = _drive_process(cache)
+    second = _drive_process(cache)
+    assert first["cert_keys"] == second["cert_keys"]
+    assert first["fingerprint"] == second["fingerprint"]
+    assert first["stats"]["builds"] > 0
+    assert first["stats"]["executables"] > 0
+    # the restart: everything the drive traces comes off disk
+    assert second["stats"]["builds"] == 0
+    assert second["stats"]["misses"] == 0
+    assert second["stats"]["disk_loads"] > 0
+
+
+def test_fingerprint_change_is_clean_miss(tmp_path, monkeypatch):
+    """A jaxlib-version or mesh-shape change re-keys every entry: the
+    old executables are unreachable (clean miss + rebuild), never a
+    poisoned load."""
+    monkeypatch.setenv("KUIPER_AOT_CACHE_DIR", str(tmp_path))
+    sig = "float32[8]"
+    key = aotcache.cache_key("op.x", sig)
+    (tmp_path / f"{key}.aotx").write_bytes(b"placeholder")
+    assert aotcache.is_cached("op.x", sig)
+    real = aotcache._fingerprint_parts()
+    monkeypatch.setattr(
+        aotcache, "_fingerprint_parts",
+        lambda: tuple("jaxlib=9.9.9" if p.startswith("jaxlib=") else p
+                      for p in real))
+    assert aotcache.cache_key("op.x", sig) != key
+    assert not aotcache.is_cached("op.x", sig)
+    mesh = tuple("mesh=2x4" if p.startswith("mesh=") else p for p in real)
+    monkeypatch.setattr(aotcache, "_fingerprint_parts", lambda: mesh)
+    assert aotcache.cache_key("op.x", sig) != key
+    assert not aotcache.is_cached("op.x", sig)
+
+
+def test_disk_roundtrip_and_probe(tmp_path, monkeypatch):
+    """An aot_jit site persists on first trace and a FRESH site object
+    (same op — a restart's new kernel instance) serves from disk."""
+    monkeypatch.setenv("KUIPER_AOT_CACHE_DIR", str(tmp_path))
+
+    def f(x):
+        return x * 2.0
+
+    site = aotcache.aot_jit(f, op="test.roundtrip")
+    x = jnp.arange(8, dtype=jnp.float32)
+    assert site.probe(x) == "built"  # warmup's compile, nothing executed
+    np.testing.assert_allclose(site(x), np.arange(8) * 2.0)
+    assert site.probe(x) == "mem"
+    assert aotcache.stats().snapshot()["builds"] == 1
+    assert any(p.suffix == ".aotx" for p in tmp_path.iterdir())
+    fresh = aotcache.aot_jit(f, op="test.roundtrip")
+    assert fresh.probe(x) == "disk"
+    np.testing.assert_allclose(fresh(x), np.arange(8) * 2.0)
+    snap = aotcache.stats().snapshot()
+    assert snap["builds"] == 1  # no recompile on the fresh site
+    assert snap["disk_loads"] >= 1
+
+
+def test_corrupt_entry_is_rebuilt(tmp_path, monkeypatch):
+    """A truncated/corrupt .aotx must never poison serving: the load
+    fails closed, the entry is dropped, and the site rebuilds."""
+    monkeypatch.setenv("KUIPER_AOT_CACHE_DIR", str(tmp_path))
+
+    def f(x):
+        return x + 1.0
+
+    x = jnp.arange(4, dtype=jnp.float32)
+    site = aotcache.aot_jit(f, op="test.corrupt")
+    site(x)
+    entries = [p for p in tmp_path.iterdir() if p.suffix == ".aotx"]
+    assert len(entries) == 1
+    entries[0].write_bytes(b"\x80garbage")
+    fresh = aotcache.aot_jit(f, op="test.corrupt")
+    np.testing.assert_allclose(fresh(x), np.arange(4) + 1.0)
+    assert aotcache.stats().snapshot()["builds"] == 2  # rebuilt
+    assert not entries[0].exists() or entries[0].read_bytes() != b"\x80garbage"
+
+
+def test_serve_miss_outside_build_scope_leaves_paper_trail(tmp_path,
+                                                          monkeypatch):
+    """A compile at serve time (outside aotcache.building()) is the
+    failure mode this subsystem exists to eliminate: it must count as a
+    serve miss AND drop an aot_cache_miss flight event."""
+    monkeypatch.setenv("KUIPER_AOT_CACHE_DIR", str(tmp_path))
+    recorder().clear()
+
+    def f(x):
+        return x - 1.0
+
+    site = aotcache.aot_jit(f, op="test.servemiss")
+    site(jnp.arange(4, dtype=jnp.float32))
+    assert aotcache.stats().snapshot()["serve_misses"] == 1
+    evs = recorder().events(kind="aot_cache_miss")
+    assert evs and evs[-1]["op"] == "test.servemiss"
+    # the same compile INSIDE a build scope is not a serve miss
+    recorder().clear()
+    with aotcache.building():
+        site(jnp.arange(16, dtype=jnp.float32))
+    assert aotcache.stats().snapshot()["serve_misses"] == 1
+    assert not recorder().events(kind="aot_cache_miss")
+
+
+def test_warmup_failure_counter_and_flight_event():
+    """Satellite 2: a swallowed warmup failure was a silent serve-time
+    compile storm — it now lands in kuiper_warmup_failures_total and
+    the flight recorder with the failing stage attached."""
+    recorder().clear()
+    aotcache.note_warmup_failure("r_test", "ring",
+                                 RuntimeError("synthetic"))
+    assert aotcache.stats().snapshot()["warmup_failures"] == 1
+    evs = recorder().events(kind="warmup_failure")
+    assert evs
+    ev = evs[-1]
+    assert ev["rule"] == "r_test"
+    assert ev["severity"] == "warn"
+    assert ev["stage"] == "ring"
+    assert "synthetic" in ev["error"]
+
+
+def test_plan_compile_price_prices_uncached_remainder(tmp_path,
+                                                     monkeypatch):
+    """Admission's ledger: certified counts come from the cert product
+    formula; cached counts from disk probes; uncached is the compile
+    debt a new rule actually pays on a warm image."""
+    from ekuiper_tpu.observability.jitcert import SiteCert
+
+    monkeypatch.setenv("KUIPER_AOT_CACHE_DIR", str(tmp_path))
+
+    def f(x):
+        return x * 3.0
+
+    site = aotcache.aot_jit(f, op="test.price")
+    site(jnp.arange(8, dtype=jnp.float32))  # persists "float32[8]"
+    certs = [
+        SiteCert(op="test.price", rule=None, builder="b", params={},
+                 signatures=frozenset({"float32[8]", "float32[16]"}),
+                 full_count=2),
+    ]
+    ledger = aotcache.plan_compile_price(certs)
+    assert ledger["enabled"] is True
+    assert ledger["certified"] == 2
+    assert ledger["cached"] == 1
+    assert ledger["uncached"] == 1
+    assert ledger["sites"] == [
+        {"op": "test.price", "certified": 2, "cached": 1}]
+
+
+def test_disabled_falls_back_to_plain_watched_jit(monkeypatch):
+    """KUIPER_AOT=0 keeps serving on the plain devwatch path — the
+    cache must be an opt-out, not a dependency."""
+    monkeypatch.setenv("KUIPER_AOT", "0")
+    assert not aotcache.enabled()
+
+    def f(x):
+        return x
+
+    site = aotcache.aot_jit(f, op="test.disabled")
+    assert not isinstance(site, aotcache._AotJit)
+    np.testing.assert_allclose(site(jnp.arange(4.0)), np.arange(4.0))
+
+
+def test_prometheus_families_render():
+    out = []
+    aotcache.render_prometheus(out, lambda s: s)
+    text = "\n".join(out)
+    for fam in ("kuiper_aot_hits_total", "kuiper_aot_misses_total",
+                "kuiper_aot_serve_misses_total",
+                "kuiper_aot_disk_loads_total",
+                "kuiper_aot_build_seconds", "kuiper_aot_executables",
+                "kuiper_warmup_failures_total"):
+        assert f"# TYPE {fam}" in text, fam

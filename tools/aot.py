@@ -1,0 +1,262 @@
+#!/usr/bin/env python
+"""aot — build / verify / cold-start-check the AOT executable cache.
+
+The runtime's zero-compile serving plane (ekuiper_tpu/runtime/aotcache.py)
+keys persisted XLA executables by jitcert certificate signature strings,
+so the certification battery (tools/jitcert.py) doubles as the cache's
+build manifest. Three subcommands, all tier-1-safe on CPU jax:
+
+  python -m tools.aot build --dir DIR [--json]
+      Fleet image bake: drive the jitcert kernel battery with the disk
+      cache enabled inside an aotcache.building() scope — every jit
+      site × certified signature the battery exercises is lowered,
+      compiled, and persisted under DIR, and DIR/manifest.json records
+      what was built (op, signature, cache key, toolchain fingerprint).
+
+  python -m tools.aot verify --dir DIR [--json]
+      Check a baked cache against the image that will serve from it:
+      every manifest entry must resolve to a disk entry whose metadata
+      matches the CURRENT toolchain fingerprint — a jax/jaxlib upgrade
+      or mesh change fails verify instead of silently compiling at
+      serve time. Exit 1 on any missing or stale entry.
+
+  python -m tools.aot coldstart [--dir DIR] [--json]
+      The ci_gate "cold-start" gate: build the cache, restart
+      in-process (fresh kernels, fresh registries — only the disk
+      survives, like a process restart), re-drive the full battery and
+      assert ZERO serve-path compiles: every executable must come from
+      the cache. Exit 1 when any site compiled on the second pass.
+
+docs/AOT_CACHE.md documents the cache layout and the bake workflow.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+import time
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))  # repo root
+
+
+def _manifest_entries(root: str) -> List[Dict[str, Any]]:
+    """Read every cache entry's metadata (never the payloads)."""
+    out = []
+    for fn in sorted(os.listdir(root)):
+        if not fn.endswith(".aotx"):
+            continue
+        path = os.path.join(root, fn)
+        try:
+            with open(path, "rb") as fh:
+                blob = pickle.load(fh)
+            meta = dict(blob.get("meta") or {})
+        except Exception as exc:
+            meta = {"error": f"{type(exc).__name__}: {exc}"[:160]}
+        meta["key"] = fn[:-len(".aotx")]
+        meta["bytes"] = os.path.getsize(path)
+        out.append(meta)
+    return out
+
+
+def build(root: str, as_json: bool = False) -> int:
+    os.environ["KUIPER_AOT_CACHE_DIR"] = root
+    os.makedirs(root, exist_ok=True)
+    from tools import jitcert as jitcert_cli
+
+    from ekuiper_tpu.observability import jitcert
+    from ekuiper_tpu.runtime import aotcache
+
+    t0 = time.perf_counter()
+    with aotcache.building():
+        kernels = jitcert_cli._battery()
+        jitcert_cli._drive(kernels)
+    wall = time.perf_counter() - t0
+    snap = aotcache.stats().snapshot()
+    entries = _manifest_entries(root)
+    certs = jitcert.live_certificates()
+    manifest = {
+        "fingerprint": aotcache.fingerprint(),
+        "entries": [{k: e.get(k) for k in ("key", "op", "signature",
+                                           "compile_s", "bytes")}
+                    for e in entries],
+        "certified_signatures": sum(len(v["signatures"])
+                                    for v in certs.values()),
+        "battery_kernels": sorted(kernels.keys()),
+        "build_wall_s": round(wall, 2),
+        "build_compile_s": snap["build_seconds"],
+    }
+    with open(os.path.join(root, "manifest.json"), "w") as fh:
+        json.dump(manifest, fh, indent=2)
+    report = {
+        "ok": True, "dir": root, "executables": len(entries),
+        "builds": snap["builds"], "disk_loads": snap["disk_loads"],
+        "build_wall_s": manifest["build_wall_s"],
+        "build_compile_s": snap["build_seconds"],
+        "fingerprint": manifest["fingerprint"],
+    }
+    if as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(f"aot build: OK — {report['executables']} executables under "
+              f"{root} ({snap['builds']} compiled in "
+              f"{snap['build_seconds']:.1f}s, {snap['disk_loads']} "
+              "already baked)")
+    return 0
+
+
+def verify(root: str, as_json: bool = False) -> int:
+    from ekuiper_tpu.runtime import aotcache
+
+    problems: List[str] = []
+    mpath = os.path.join(root, "manifest.json")
+    try:
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+    except Exception as exc:
+        problems.append(f"manifest unreadable: {exc}")
+        manifest = {"entries": []}
+    fp = aotcache.fingerprint()
+    if manifest.get("fingerprint") not in (None, fp):
+        problems.append(
+            "manifest fingerprint mismatch (cache baked for "
+            f"{manifest.get('fingerprint')!r}, this image is {fp!r})")
+    checked = 0
+    for e in manifest.get("entries", []):
+        op, sig = e.get("op"), e.get("signature")
+        key = e.get("key") or ""
+        path = os.path.join(root, f"{key}.aotx")
+        if not os.path.exists(path):
+            problems.append(f"{op}: entry {key[:12]}… missing on disk")
+            continue
+        if op is not None and sig is not None \
+                and aotcache.cache_key(op, sig, fp) != key:
+            problems.append(
+                f"{op}: key does not re-derive under the current "
+                "fingerprint (stale toolchain/mesh — rebake)")
+            continue
+        checked += 1
+    report = {
+        "ok": not problems, "dir": root, "checked": checked,
+        "problems": problems, "fingerprint": fp,
+    }
+    if as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        state = "OK" if report["ok"] else "FAILED"
+        print(f"aot verify: {state} — {checked} entries match the "
+              "current fingerprint"
+              + ("" if report["ok"]
+                 else "\n  " + "\n  ".join(problems)))
+    return 0 if report["ok"] else 1
+
+
+def coldstart(root: str, as_json: bool = False) -> int:
+    """Build, then simulate a restart (fresh kernels + registries, disk
+    survives) and assert the battery re-drives with zero compiles."""
+    import gc
+
+    os.environ["KUIPER_AOT_CACHE_DIR"] = root
+    os.makedirs(root, exist_ok=True)
+    from tools import jitcert as jitcert_cli
+
+    from ekuiper_tpu.observability import devwatch, jitcert
+    from ekuiper_tpu.runtime import aotcache
+
+    t0 = time.perf_counter()
+    with aotcache.building():
+        kernels = jitcert_cli._battery()
+        jitcert_cli._drive(kernels)
+    build_s = time.perf_counter() - t0
+    built = aotcache.stats().snapshot()
+    # ---- in-process restart: drop every kernel and registry; only the
+    # disk layer survives, exactly like a process restart on the image
+    del kernels
+    gc.collect()
+    devwatch.registry().clear()
+    jitcert.reset()
+    aotcache.reset()
+    t1 = time.perf_counter()
+    kernels = jitcert_cli._battery()
+    jitcert_cli._drive(kernels)
+    warm_s = time.perf_counter() - t1
+    warm = aotcache.stats().snapshot()
+    diff_report = jitcert.diff_live()
+    problems: List[str] = []
+    if warm["misses"] > 0:
+        problems.append(
+            f"{warm['misses']} serve-path compile(s) after restart — "
+            "cache coverage gap (see aot_cache_miss flight events)")
+    if warm["disk_loads"] == 0:
+        problems.append("warm pass loaded nothing from disk — cache "
+                        "was not exercised")
+    if not diff_report["clean"]:
+        problems.append("jitcert diff not clean on the warm pass")
+    report = {
+        "ok": not problems,
+        "dir": root,
+        "cold": {"seconds": round(build_s, 2), "builds": built["builds"],
+                 "compile_s": built["build_seconds"]},
+        "warm": {"seconds": round(warm_s, 2), "misses": warm["misses"],
+                 "disk_loads": warm["disk_loads"], "hits": warm["hits"]},
+        "speedup": round(build_s / warm_s, 1) if warm_s > 0 else None,
+        "problems": problems,
+    }
+    if as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        state = "OK" if report["ok"] else "FAILED"
+        print(f"aot coldstart: {state} — cold {build_s:.1f}s "
+              f"({built['builds']} compiles) vs warm {warm_s:.1f}s "
+              f"({warm['disk_loads']} disk loads, {warm['misses']} "
+              "compiles)"
+              + ("" if report["ok"] else "\n  " + "\n  ".join(problems)))
+    return 0 if report["ok"] else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.aot", description=__doc__.splitlines()[0])
+    ap.add_argument("command", choices=["build", "verify", "coldstart"])
+    ap.add_argument("--dir", default=None,
+                    help="cache directory (default: $KUIPER_AOT_CACHE_DIR;"
+                         " coldstart falls back to a temp dir)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # 8 virtual CPU devices so the sharded battery kernel constructs
+    # (must land before the first jax import initializes the backend)
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=8")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    root = args.dir or os.environ.get("KUIPER_AOT_CACHE_DIR") or None
+    if args.command == "build":
+        if root is None:
+            print("aot: --dir (or KUIPER_AOT_CACHE_DIR) is required",
+                  file=sys.stderr)
+            return 2
+        return build(root, as_json=args.json)
+    if args.command == "verify":
+        if root is None:
+            print("aot: --dir (or KUIPER_AOT_CACHE_DIR) is required",
+                  file=sys.stderr)
+            return 2
+        return verify(root, as_json=args.json)
+    if root is not None:
+        return coldstart(root, as_json=args.json)
+    import shutil
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="kuiper-aot-")
+    try:
+        return coldstart(root, as_json=args.json)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,11 @@ HEADLINE = (
     # pipe on the device mesh gates every round instead of a dryrun —
     # same throughput tolerance as the single-chip full-pipe line
     ("phases.multichip_full_pipe.rows_per_sec", 0.15),
+    # AOT executable cache (ISSUE 16): rule-create→first-fold on a warm
+    # disk cache is the zero-compile-restart claim — a serve-path
+    # compile sneaking back in moves this from tens of ms to seconds,
+    # far past any tolerance; ordinary scheduler jitter stays inside it
+    ("phases.cold_start.warm.rule_create_to_first_fold_ms", 0.50),
 )
 
 #: default noise tolerance for every non-headline comparison

@@ -17,6 +17,8 @@ passed):
                     placement admission, jitcert clean
   check_metrics     Prometheus catalog lint (synthetic scrape vs docs)
   benchdiff --smoke trajectory-gate self-test (synthetic artifacts)
+  cold_start        AOT cache round trip: bake the jitcert battery,
+                    restart in-process, assert zero serve-path compiles
 
 Usage:
   python tools/ci_gate.py [--json] [--skip GATE[,GATE...]]
@@ -49,6 +51,7 @@ GATES: Dict[str, List[str]] = {
     "probe_multichip": [sys.executable, "tools/probe_multichip.py"],
     "check_metrics": [sys.executable, "tools/check_metrics.py"],
     "benchdiff_smoke": [sys.executable, "tools/benchdiff.py", "--smoke"],
+    "cold_start": [sys.executable, "-m", "tools.aot", "coldstart"],
 }
 
 #: per-gate wall bound — generous; the whole gate must stay tier-1-safe

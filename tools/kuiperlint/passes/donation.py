@@ -21,7 +21,9 @@ from typing import Dict, List, Optional, Set, Tuple
 from .. import ImportMap, LintFile, Pass, Report, register
 
 JIT_WRAPPERS = ("watched_jit", "jax.jit",
-                "ekuiper_tpu.observability.devwatch.watched_jit")
+                "ekuiper_tpu.observability.devwatch.watched_jit",
+                "aot_jit",
+                "ekuiper_tpu.runtime.aotcache.aot_jit")
 
 
 def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:

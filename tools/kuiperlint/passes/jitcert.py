@@ -96,7 +96,9 @@ def _resolve_prefix(cls: Optional[_ClassInfo],
 
 
 def _watched_jit_calls(tree: ast.AST, imports: ImportMap):
-    """Yield (call_node, enclosing_class_name) for every watched_jit()."""
+    """Yield (call_node, enclosing_class_name) for every watched_jit()
+    or aot_jit() site (runtime/aotcache.py — same contract, AOT-cached
+    dispatch)."""
     def walk(node, cls_name):
         for child in ast.iter_child_nodes(node):
             if isinstance(child, ast.ClassDef):
@@ -106,7 +108,9 @@ def _watched_jit_calls(tree: ast.AST, imports: ImportMap):
                 target = imports.resolve_call(child.func)
                 if target is not None and (
                         target == "watched_jit"
-                        or target.endswith(".watched_jit")):
+                        or target.endswith(".watched_jit")
+                        or target == "aot_jit"
+                        or target.endswith(".aot_jit")):
                     yield_list.append((child, cls_name))
             walk(child, cls_name)
 
