@@ -82,7 +82,7 @@ def phase_budget(nominal_s: float, remaining_s=None,
 #: the pool. Floors sum to well under TOTAL_BUDGET_S (asserted in
 #: tests/test_bench_budget.py).
 PHASE_FLOORS = (
-    ("full-pipe", 120.0),
+    ("full-pipe", 110.0),
     ("full-pipe-contended", 90.0),
     ("hetero 256-rule", 90.0),
     ("phase_throughput", 60.0),
@@ -93,6 +93,7 @@ PHASE_FLOORS = (
     ("event_time", 25.0),
     ("rule_group", 25.0),
     ("filter_heavy", 25.0),
+    ("join_heavy", 15.0),
     ("multi_rule_shared", 30.0),
     ("multi_rule_shared_mixed", 25.0),
     ("key_cardinality", 45.0),
@@ -2406,6 +2407,103 @@ def bench_multi_rule_shared(batches, kt_slots) -> None:
                shared, s_el, rule_id="r0"))
 
 
+def bench_join_heavy(kt_slots) -> None:
+    """ISSUE 19 acceptance phase: interval stream-stream join through
+    the device join ring (ops/joinring.py). Two legs:
+
+    - columnar throughput: 2048-rows-per-side windows through the
+      certified match kernel (key equality + event-time band + residual)
+      — rows/s counts both sides, acceptance floor 500k rows/s on the
+      CPU smoke;
+    - emission tail: full DeviceJoinNode._join_step windows (mask +
+      host-order emission reconstruction) at 256 rows/side — the
+      per-window latency p99 is the join analogue of the emit p99.
+
+    Every window must take the device mask: a single runtime fallback
+    (fallback_windows_total != 0) fails the phase."""
+    import jax
+
+    from ekuiper_tpu.data.rows import JoinTuple, Tuple
+    from ekuiper_tpu.ops.joinring import SideBatch
+    from ekuiper_tpu.planner import relational
+    from ekuiper_tpu.runtime.nodes_relational import DeviceJoinNode
+    from ekuiper_tpu.sql.parser import parse_select
+
+    sql = ("SELECT l.v, r.w FROM l INNER JOIN r ON l.k = r.k "
+           "AND l.ts - r.ts >= -5000 AND l.ts - r.ts <= 5000 "
+           "AND l.v > r.w GROUP BY TUMBLINGWINDOW(ss, 10)")
+    stmt = parse_select(sql)
+    lowering = relational.lower_join(stmt, stmt.joins)
+    ring = lowering.build_ring(capacity=kt_slots)
+    rng = np.random.default_rng(19)
+    n_keys = 512
+
+    def side(n, left):
+        b = SideBatch(n=n)
+        b.key_cols.append([f"k{i}" for i in rng.integers(0, n_keys, n)])
+        b.band = rng.integers(0, 60_000, n).tolist()
+        col = "__jl_v" if left else "__jr_w"
+        b.cols[col] = rng.uniform(0.0, 100.0, n).tolist()
+        return b
+
+    per_side = 2048
+    windows = [(side(per_side, True), side(per_side, False))
+               for _ in range(4)]
+    mask = ring.match(*windows[0])  # warm: compile the (2048, 2048) pad
+    matches = 0
+    rows = 0
+    n = 0
+    t0 = time.time()
+    while time.time() - t0 < 6.0:
+        left, right = windows[n % len(windows)]
+        mask = ring.match(left, right)
+        rows += left.n + right.n
+        n += 1
+    matches = int(mask.sum())
+    elapsed = time.time() - t0
+    rows_per_sec = rows / elapsed
+
+    # emission-order reconstruction leg: host rows through the full node
+    node = DeviceJoinNode("join", stmt.joins, left_name="l",
+                          lowering=lowering)
+    node.ring = ring
+
+    def mk_rows(n, left):
+        out = []
+        for i in range(n):
+            ts = int(rng.integers(0, 60_000))
+            msg = {"k": f"k{int(rng.integers(0, n_keys))}", "ts": ts}
+            if left:
+                msg["v"] = float(rng.uniform(0.0, 100.0))
+            else:
+                msg["w"] = float(rng.uniform(0.0, 100.0))
+            out.append(Tuple(emitter="l" if left else "r", message=msg,
+                             timestamp=ts))
+        return out
+
+    lat_ms = []
+    emitted = 0
+    for _ in range(40):
+        left = [JoinTuple(tuples=[t]) for t in mk_rows(256, True)]
+        right = mk_rows(256, False)
+        w0 = time.perf_counter()
+        out = node._join_step(left, right, stmt.joins[0])
+        lat_ms.append((time.perf_counter() - w0) * 1e3)
+        emitted += len(out)
+    p99 = float(np.percentile(lat_ms, 99))
+    fallbacks = int(ring.fallback_windows_total)
+    print(f"# join_heavy: match {rows_per_sec:,.0f} rows/s "
+          f"({matches:,} pairs/window at {per_side}/side), emission "
+          f"window p99 {p99:.1f}ms ({emitted:,} tuples over 40 windows), "
+          f"fallback windows {fallbacks} (must be 0); device="
+          f"{jax.devices()[0].device_kind}", file=sys.stderr)
+    record("join_heavy", rows_per_sec=rows_per_sec,
+           emit_p99_ms=p99, matches_per_window=matches,
+           emitted_tuples=emitted, fallback_windows=fallbacks)
+    assert fallbacks == 0, \
+        f"join_heavy: {fallbacks} windows fell back to the host loop"
+
+
 def bench_filter_heavy(batches, kt_slots) -> None:
     """ISSUE 12 acceptance phase: a rule with a non-trivial WHERE
     (string-dict IN + numeric predicate) and a CASE agg projection at
@@ -3080,6 +3178,7 @@ def main() -> None:
         ("rule_group", 600.0, lambda: bench_rule_group(batches, KEY_SLOTS)),
         ("filter_heavy", 600.0,
          lambda: bench_filter_heavy(batches, KEY_SLOTS)),
+        ("join_heavy", 600.0, lambda: bench_join_heavy(KEY_SLOTS)),
         ("multi_rule_shared", 600.0,
          lambda: bench_multi_rule_shared(batches, KEY_SLOTS)),
         ("multi_rule_shared_mixed", 600.0,

@@ -556,6 +556,96 @@ def _derive_sketch(op: str, rule: Optional[str], depth: int, width: int,
                     full_count=len(sigs))
 
 
+def _derive_join(op: str, rule: Optional[str],
+                 resid_l: Dict[str, str], resid_r: Dict[str, str]
+                 ) -> SiteCert:
+    """joinring.match (ops/joinring.py): each side pads to the next
+    power of two independently, so the legal set is the (PL, PR)
+    pad-pair ladder. Leaf order is the call order: left slots/ts/valid,
+    right slots/ts/valid, the two int32 band scalars, then each side's
+    residual column dict (jax flattens dicts sorted by key). Residual
+    columns are construction-frozen (the ON clause is plan text), so
+    the set is closed — no mask subsets, no value dependence."""
+    from ..ops.joinring import JOIN_PAD_CAP, JOIN_PAD_FLOOR
+
+    pads: List[int] = []
+    b = JOIN_PAD_FLOOR
+    while b <= JOIN_PAD_CAP:
+        pads.append(b)
+        b <<= 1
+    sigs: List[str] = []
+    for pl in pads:
+        for pr in pads:
+            parts = [_arr("int32", pl), _arr("int32", pl),
+                     _arr("bool", pl),
+                     _arr("int32", pr), _arr("int32", pr),
+                     _arr("bool", pr),
+                     _arr("int32"), _arr("int32")]
+            parts += [_arr(resid_l[c], pl) for c in sorted(resid_l)]
+            parts += [_arr(resid_r[c], pr) for c in sorted(resid_r)]
+            sigs.append(_sig(parts))
+    deriv = [
+        f"per-side pads: powers of two [{JOIN_PAD_FLOOR}..{JOIN_PAD_CAP}]"
+        " (ops/joinring.py _pad_pow2; padded rows carry valid=False)",
+        f"signature set = (PL, PR) pad pairs: {len(pads)}^2 = {len(sigs)}",
+        "band bounds ride as int32 scalars (0-d), rebased per call",
+        f"residual columns frozen at plan time: "
+        f"L={sorted(resid_l)} R={sorted(resid_r)}",
+    ]
+    return SiteCert(op, rule, "_derive_join",
+                    {"resid_l": dict(sorted(resid_l.items())),
+                     "resid_r": dict(sorted(resid_r.items())),
+                     "pad_floor": JOIN_PAD_FLOOR,
+                     "pad_cap": JOIN_PAD_CAP},
+                    frozenset(sigs), deriv, False, full_count=len(sigs))
+
+
+def _derive_segscan(op: str, rule: Optional[str], tail: str,
+                    base_capacity: int = 0,
+                    grows: int = MAX_GROWS) -> SiteCert:
+    """segscan.shift / segscan.sort (ops/segscan.py): micro-batches pad
+    to the SEG_PAD_FLOOR..SEG_PAD_CAP power-of-two ladder. `shift`
+    additionally carries the donated per-key partials (count, last
+    value, has-last, running sum) on the key-capacity doubling ladder;
+    `sort` is stateless (one complete collection per call)."""
+    from ..ops.segscan import SEG_PAD_CAP, SEG_PAD_FLOOR
+
+    mbs: List[int] = []
+    b = SEG_PAD_FLOOR
+    while b <= SEG_PAD_CAP:
+        mbs.append(b)
+        b <<= 1
+    sigs: List[str] = []
+    if tail == "sort":
+        for mb in mbs:
+            sigs.append(_sig([_arr("int32", mb), _arr("float32", mb),
+                              _arr("bool", mb)]))
+        params: Dict[str, Any] = {"tail": tail}
+    elif tail == "shift":
+        for cap in _ladder(base_capacity, grows):
+            for mb in mbs:
+                sigs.append(_sig([
+                    _arr("int32", cap), _arr("float32", cap),
+                    _arr("bool", cap), _arr("float32", cap),
+                    _arr("int32", mb), _arr("float32", mb),
+                    _arr("bool", mb)]))
+        params = {"tail": tail, "base_capacity": base_capacity,
+                  "grows": grows}
+    else:  # pragma: no cover - derivation bug
+        raise ValueError(f"unknown segscan tail {tail!r}")
+    deriv = [
+        f"micro-batches pad to powers of two "
+        f"[{SEG_PAD_FLOOR}..{SEG_PAD_CAP}] (ops/segscan.py _pad_pow2; "
+        "padded rows carry valid=False and segment to a ghost id)",
+    ]
+    if tail == "shift":
+        deriv.append(
+            f"carry partials (count/last/has/sum) on the key capacity "
+            f"ladder: {base_capacity} x2^0..{grows}")
+    return SiteCert(op, rule, "_derive_segscan", params,
+                    frozenset(sigs), deriv, False, full_count=len(sigs))
+
+
 # --------------------------------------------------- per-kernel dispatch
 def _groupby_certs(kernel, prefix: str, rule: Optional[str]
                    ) -> List[SiteCert]:
@@ -649,6 +739,19 @@ def certificates_for(kernel, rule: Optional[str] = None) -> List[SiteCert]:
             _derive_sketch("sketch.query", rule, kernel.depth,
                            kernel.width, query_only=True),
         ]
+    if prefix == "joinring":
+        dt = getattr(kernel, "col_dtypes", {}) or {}
+        return [_derive_join(
+            "joinring.match", rule,
+            {c: dt.get(c, "float32") for c in kernel.resid_l},
+            {c: dt.get(c, "float32") for c in kernel.resid_r})]
+    if prefix == "segscan":
+        base = int(getattr(kernel, "_jitcert_base_capacity",
+                           getattr(kernel, "capacity", 0)))
+        return [
+            _derive_segscan("segscan.shift", rule, "shift", base),
+            _derive_segscan("segscan.sort", rule, "sort"),
+        ]
     if prefix == "groupby":
         return _groupby_certs(kernel, prefix, rule)
     raise ValueError(
@@ -687,6 +790,9 @@ SITE_DERIVATIONS: Dict[str, str] = {
     "slidingring.query": "_derive_ring(query)",
     "tierstore.demote": "_derive_tier(demote)",
     "tierstore.promote": "_derive_tier(promote)",
+    "joinring.match": "_derive_join",
+    "segscan.shift": "_derive_segscan(shift)",
+    "segscan.sort": "_derive_segscan(sort)",
 }
 
 
@@ -915,3 +1021,34 @@ def estimate_plan_certs(plan, n_panes: int, micro_batch: int,
         certs.append(_derive_tier(ks, "tierstore.promote", None,
                                   tier_demote_batch, "promote", grows=0))
     return certs
+
+
+def estimate_relational_certs(join_resid_l: Optional[Dict[str, str]] = None,
+                              join_resid_r: Optional[Dict[str, str]] = None,
+                              join: bool = False,
+                              analytic_shift: bool = False,
+                              analytic_sort: bool = False,
+                              capacity: int = 4096) -> List[SiteCert]:
+    """Admission-pricing twin for the relational tier (joinring/segscan).
+    A lifted join prices the full (PL, PR) pad-pair surface — the pads
+    track window data, not capacity, so the construction-time truth IS
+    the whole ladder. Analytic sites price the micro-batch ladder
+    (shift at construction capacity, grows=0 — growth respecializes
+    later, paced by key cardinality, exactly like the group-by sites)."""
+    certs: List[SiteCert] = []
+    if join:
+        certs.append(_derive_join("joinring.match", None,
+                                  dict(join_resid_l or {}),
+                                  dict(join_resid_r or {})))
+    if analytic_shift:
+        certs.append(_derive_segscan("segscan.shift", None, "shift",
+                                     capacity, grows=0))
+    if analytic_sort:
+        certs.append(_derive_segscan("segscan.sort", None, "sort"))
+    return certs
+
+
+def estimate_relational_signatures(**kw) -> int:
+    """Sum of `full_count` over estimate_relational_certs — the number
+    a candidate relational rule adds to the QoS signature budget."""
+    return sum(c.full_count for c in estimate_relational_certs(**kw))

@@ -236,6 +236,14 @@ def render(rule_registry) -> str:
     from ..parallel import sharded as _sharded
 
     _sharded.render_prometheus(out, _esc)
+    # relational tier (ops/joinring.py, ops/segscan.py): join-ring rows,
+    # matches, per-window host fallbacks and ring bytes; segscan rows
+    # and partial spills per rule
+    from ..ops import joinring as _joinring
+    from ..ops import segscan as _segscan
+
+    _joinring.render_prometheus(out, _esc)
+    _segscan.render_prometheus(out, _esc)
     # expression host fallbacks (sql/compiler.py counters): plan-time
     # count of expressions routed to the row interpreter, by structured
     # NotVectorizable reason — the metric the health plane's bottleneck

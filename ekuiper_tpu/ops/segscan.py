@@ -1,0 +1,417 @@
+"""Segmented-scan analytic kernels — ROW_NUMBER / RANK / DENSE_RANK /
+LAG / LEAD and running aggregates on device.
+
+The reference computes window/analytic functions one row at a time
+against host-side state caches (runtime/nodes_ops.py AnalyticNode /
+WindowFuncNode; internal/topo/operator/*_operator.go). Here a micro-batch
+key-sorts once inside the kernel (jnp.lexsort — stable, original index as
+tiebreak) and every function becomes a segmented `jax.lax.associative_scan`
+over the sorted order:
+
+  * segmented cumsum   -> ROW_NUMBER, running sum/count
+  * propagate-last     -> RANK (first position of each value group)
+  * new-value flags    -> DENSE_RANK
+  * in-segment shift   -> LAG / LEAD
+
+Partitions larger than one micro-batch follow the tierstore spill
+discipline (arxiv 2007.10385): the cross-batch state is O(partitions)
+scalar partials — count, last value, running sum per key slot — never
+buffered rows. The `segscan.shift` site carries those partials in donated
+device arrays on the key-capacity growth ladder; `segscan.sort` is the
+stateless per-collection variant (window functions see one complete
+collection at a time, so no partial ever crosses calls).
+
+NULL semantics match the host evaluator: a NULL value ranks as NULL
+(rank/dense_rank skip it and it never counts as "smaller"), LAG records
+NULL rows in history (NaN-encoded), running sums are NULL-transparent.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: pow-2 pad floor per micro-batch — one executable serves every
+#: collection up to the floor, doublings cover the rest
+SEG_PAD_FLOOR = 256
+
+#: certified top of the micro-batch pad ladder
+SEG_PAD_CAP = 1 << 17
+
+#: pad-row segment id: sorts after every real slot, so pads form their
+#: own segment and can never pollute a real partition's scan
+_SEG_PAD = 1 << 30
+
+
+def _pad_pow2(n: int) -> int:
+    b = SEG_PAD_FLOOR
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _seg_cumsum(head, x):
+    """Inclusive segmented sum: resets at every True in `head`."""
+    import jax
+
+    def comb(a, b):
+        import jax.numpy as jnp
+
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, va + vb)
+
+    _, v = jax.lax.associative_scan(comb, (head, x))
+    return v
+
+
+def _seg_propagate(flag, x):
+    """Propagate the most recent flagged value forward (copy scan);
+    `flag` must be True at every segment head so propagation never
+    crosses a segment boundary."""
+    import jax
+
+    def comb(a, b):
+        import jax.numpy as jnp
+
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, va)
+
+    _, v = jax.lax.associative_scan(comb, (flag, x))
+    return v
+
+
+class SegScan:
+    """Owner of the two certified segmented-scan sites plus their host
+    shadow twins. One instance per lifted node; the cross-batch partials
+    (`segscan.shift`) live in donated device arrays sized to the key
+    capacity and grow on the same doubling ladder as every other kernel."""
+
+    #: jitcert/devwatch site family for this kernel's jit sites
+    watch_prefix = "segscan"
+
+    def __init__(self, capacity: int = 4096) -> None:
+        import jax.numpy as jnp
+
+        self.capacity = int(capacity)
+        self._carry = (
+            jnp.zeros(self.capacity, dtype=jnp.int32),    # row count
+            jnp.zeros(self.capacity, dtype=jnp.float32),  # last value
+            jnp.zeros(self.capacity, dtype=bool),         # has last
+            jnp.zeros(self.capacity, dtype=jnp.float32),  # running sum
+        )
+        self.rows_total = 0
+        self.spills_total = 0  # partitions continued across micro-batches
+        from ..observability import jitcert, memwatch
+        from ..runtime.aotcache import aot_jit
+
+        self._shift = aot_jit(self._shift_impl, op="segscan.shift",
+                              donate_argnums=(0,))
+        self._sort = aot_jit(self._sort_impl, op="segscan.sort",
+                             kind="boundary")
+        memwatch.register("segscan", self,
+                          lambda ss: sum(int(c.nbytes)
+                                         for c in ss._carry))
+        jitcert.register_kernel(self)
+        _registry.register(self)
+
+    def _watch_op(self, site: str) -> str:
+        return f"{self.watch_prefix}.{site}"
+
+    # ----------------------------------------------------------- kernels
+    def _shift_impl(self, carry, slots, vals, valid):
+        import jax.numpy as jnp
+
+        cnt, last, has, acc = carry
+        mb = slots.shape[0]
+        s = jnp.where(valid, slots, jnp.int32(_SEG_PAD))
+        idx = jnp.arange(mb, dtype=jnp.int32)
+        order = jnp.lexsort((idx, s))
+        ss, vv, mm = s[order], vals[order], valid[order]
+        head = jnp.concatenate([jnp.ones(1, bool), ss[1:] != ss[:-1]])
+        tail = jnp.concatenate([ss[:-1] != ss[1:], jnp.ones(1, bool)])
+        sc = jnp.clip(ss, 0, cnt.shape[0] - 1)  # pad-safe gather index
+        pos = _seg_cumsum(head, jnp.ones(mb, jnp.int32))
+        rn_s = cnt[sc] + pos
+        pv = jnp.concatenate([vv[:1], vv[:-1]])
+        lag_s = jnp.where(head,
+                          jnp.where(has[sc], last[sc], jnp.nan), pv)
+        lhas_s = jnp.where(head, has[sc], True)
+        vz = jnp.where(jnp.isnan(vv), 0.0, vv)
+        cum = _seg_cumsum(head, vz)
+        run_s = acc[sc] + cum
+        continued = jnp.sum((head & mm & has[sc]).astype(jnp.int32))
+        # partial spill: segment tails scatter O(partitions) scalars back
+        # into the carry; pad rows dump into a ghost row sliced off below
+        dump = jnp.int32(cnt.shape[0])
+        tidx = jnp.where(tail & mm, ss, dump)
+
+        def ext(a):
+            return jnp.concatenate([a, a[:1]])
+
+        cnt2 = ext(cnt).at[tidx].add(jnp.where(tail & mm, pos, 0))[:-1]
+        last2 = ext(last).at[tidx].set(vv)[:-1]
+        has2 = ext(has).at[tidx].set(True)[:-1]
+        acc2 = ext(acc).at[tidx].add(jnp.where(tail & mm, cum, 0.0))[:-1]
+
+        def unsort(x):
+            return jnp.zeros(mb, x.dtype).at[order].set(x)
+
+        return ((cnt2, last2, has2, acc2), unsort(rn_s), unsort(lag_s),
+                unsort(lhas_s), unsort(run_s), continued)
+
+    def _sort_impl(self, seg, vals, valid):
+        import jax.numpy as jnp
+
+        mb = seg.shape[0]
+        s = jnp.where(valid, seg, jnp.int32(_SEG_PAD))
+        idx = jnp.arange(mb, dtype=jnp.int32)
+        # arrival order within segment: ROW_NUMBER / LEAD
+        o1 = jnp.lexsort((idx, s))
+        s1, v1 = s[o1], vals[o1]
+        head1 = jnp.concatenate([jnp.ones(1, bool), s1[1:] != s1[:-1]])
+        rn_s = _seg_cumsum(head1, jnp.ones(mb, jnp.int32))
+        same = jnp.concatenate([s1[:-1] == s1[1:], jnp.zeros(1, bool)])
+        nxt = jnp.where(same, jnp.concatenate([v1[1:], v1[:1]]), jnp.nan)
+        # value order within segment: RANK / DENSE_RANK (NULLs sort last
+        # and rank as NULL; they never count as "smaller")
+        vkey = jnp.where(jnp.isnan(vals), jnp.inf, vals)
+        o2 = jnp.lexsort((idx, vkey, s))
+        s2, k2 = s[o2], vkey[o2]
+        vval2 = ~jnp.isnan(vals[o2])
+        head2 = jnp.concatenate([jnp.ones(1, bool), s2[1:] != s2[:-1]])
+        newv = head2 | jnp.concatenate(
+            [jnp.ones(1, bool), k2[1:] != k2[:-1]])
+        pos2 = _seg_cumsum(head2, jnp.ones(mb, jnp.int32))
+        rank_s = jnp.where(vval2, _seg_propagate(newv, pos2), 0)
+        dense_s = jnp.where(vval2,
+                            _seg_cumsum(head2, newv.astype(jnp.int32)), 0)
+
+        def unsort(order, x):
+            return jnp.zeros(mb, x.dtype).at[order].set(x)
+
+        return (unsort(o1, rn_s), unsort(o1, nxt), unsort(o1, same),
+                unsort(o2, rank_s), unsort(o2, dense_s),
+                unsort(o2, vval2))
+
+    # -------------------------------------------------------- host entry
+    def shift(self, slots: np.ndarray, vals: np.ndarray, n: int
+              ) -> Dict[str, np.ndarray]:
+        """Streaming analytics for one micro-batch (arrival order):
+        per-partition ROW_NUMBER, LAG(1), running sum. Donated carry,
+        so cross-batch state never leaves the device."""
+        import jax.numpy as jnp
+
+        while int(np.max(slots, initial=0)) >= self.capacity:
+            self.grow(self.capacity * 2)
+        b = _pad_pow2(n)
+        sl = np.zeros(b, dtype=np.int32)
+        sl[:n] = slots[:n]
+        va = np.full(b, np.nan, dtype=np.float32)
+        va[:n] = vals[:n]
+        valid = np.zeros(b, dtype=bool)
+        valid[:n] = True
+        self._carry, rn, lag, lhas, run, cont = self._shift(
+            self._carry, jnp.asarray(sl), jnp.asarray(va),
+            jnp.asarray(valid))
+        self.rows_total += n
+        self.spills_total += int(cont)
+        return {"row_number": np.asarray(rn)[:n],
+                "lag": np.asarray(lag)[:n],
+                "lag_has": np.asarray(lhas)[:n],
+                "run_sum": np.asarray(run)[:n]}
+
+    def ranks(self, seg: np.ndarray, vals: np.ndarray, n: int
+              ) -> Dict[str, np.ndarray]:
+        """Whole-collection window functions: ROW_NUMBER / RANK /
+        DENSE_RANK / LEAD(1) over one complete (padded) collection."""
+        import jax.numpy as jnp
+
+        b = _pad_pow2(n)
+        sg = np.zeros(b, dtype=np.int32)
+        sg[:n] = seg[:n]
+        va = np.full(b, np.nan, dtype=np.float32)
+        va[:n] = vals[:n]
+        valid = np.zeros(b, dtype=bool)
+        valid[:n] = True
+        rn, lead, lead_has, rank, dense, rhas = self._sort(
+            jnp.asarray(sg), jnp.asarray(va), jnp.asarray(valid))
+        self.rows_total += n
+        return {"row_number": np.asarray(rn)[:n],
+                "lead": np.asarray(lead)[:n],
+                "lead_has": np.asarray(lead_has)[:n],
+                "rank": np.asarray(rank)[:n],
+                "dense_rank": np.asarray(dense)[:n],
+                "rank_has": np.asarray(rhas)[:n]}
+
+    # ------------------------------------------------------------- state
+    def grow(self, new_capacity: int) -> None:
+        """Capacity doubling: carries pad with fold identities (count 0,
+        no last value, sum 0) — the same ladder jitcert certifies."""
+        import jax.numpy as jnp
+
+        if new_capacity <= self.capacity:
+            return
+        pad = new_capacity - self.capacity
+        cnt, last, has, acc = self._carry
+        self._carry = (
+            jnp.concatenate([cnt, jnp.zeros(pad, dtype=jnp.int32)]),
+            jnp.concatenate([last, jnp.zeros(pad, dtype=jnp.float32)]),
+            jnp.concatenate([has, jnp.zeros(pad, dtype=bool)]),
+            jnp.concatenate([acc, jnp.zeros(pad, dtype=jnp.float32)]),
+        )
+        self.capacity = new_capacity
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable carry state (checkpoint seam). NaN floats
+        survive the json round-trip (allow_nan default)."""
+        cnt, last, has, acc = self._carry
+        return {"capacity": self.capacity,
+                "cnt": [int(x) for x in np.asarray(cnt)],
+                "last": [float(x) for x in np.asarray(last)],
+                "has": [bool(x) for x in np.asarray(has)],
+                "acc": [float(x) for x in np.asarray(acc)]}
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        import jax.numpy as jnp
+
+        self.capacity = int(state["capacity"])
+        self._carry = (
+            jnp.asarray(np.asarray(state["cnt"], dtype=np.int32)),
+            jnp.asarray(np.asarray(state["last"], dtype=np.float32)),
+            jnp.asarray(np.asarray(state["has"], dtype=bool)),
+            jnp.asarray(np.asarray(state["acc"], dtype=np.float32)),
+        )
+
+    def peek_carry(self) -> Dict[str, np.ndarray]:
+        """Host view of the carry partials (lag-state migration and the
+        parity/battery drivers read this; never on the hot path)."""
+        cnt, last, has, acc = self._carry
+        return {"cnt": np.asarray(cnt), "last": np.asarray(last),
+                "has": np.asarray(has), "acc": np.asarray(acc)}
+
+
+# ------------------------------------------------------------- host twins
+def sort_host(seg: np.ndarray, vals: np.ndarray, n: int
+              ) -> Dict[str, np.ndarray]:
+    """Numpy shadow twin of `segscan.sort` — the host window-function
+    path computes rank/dense_rank/lead with exactly this, so host and
+    device emissions are definitionally comparable bit-for-bit."""
+    seg = np.asarray(seg[:n], dtype=np.int64)
+    vals = np.asarray(vals[:n], dtype=np.float32)
+    rn = np.zeros(n, dtype=np.int32)
+    rank = np.zeros(n, dtype=np.int32)
+    dense = np.zeros(n, dtype=np.int32)
+    rhas = np.zeros(n, dtype=bool)
+    lead = np.full(n, np.nan, dtype=np.float32)
+    lead_has = np.zeros(n, dtype=bool)
+    for s in np.unique(seg):
+        sel = np.nonzero(seg == s)[0]
+        rn[sel] = np.arange(1, len(sel) + 1, dtype=np.int32)
+        lead[sel[:-1]] = vals[sel[1:]]
+        lead_has[sel[:-1]] = True
+        sv = vals[sel]
+        ok = ~np.isnan(sv)
+        rhas[sel] = ok
+        vv = sv[ok]
+        if len(vv):
+            uniq = np.unique(vv)
+            rank[sel[ok]] = 1 + np.searchsorted(np.sort(vv), vv,
+                                                side="left").astype(np.int32)
+            dense[sel[ok]] = 1 + np.searchsorted(uniq, vv).astype(np.int32)
+    return {"row_number": rn, "rank": rank, "dense_rank": dense,
+            "rank_has": rhas, "lead": lead, "lead_has": lead_has}
+
+
+def shift_host(carry: Dict[str, np.ndarray], slots: np.ndarray,
+               vals: np.ndarray, n: int) -> Dict[str, np.ndarray]:
+    """Numpy shadow twin of `segscan.shift` (mutates `carry` in place —
+    dict of cnt/last/has/acc arrays)."""
+    rn = np.zeros(n, dtype=np.int32)
+    lag = np.full(n, np.nan, dtype=np.float32)
+    lhas = np.zeros(n, dtype=bool)
+    run = np.zeros(n, dtype=np.float32)
+    for i in range(n):
+        s = int(slots[i])
+        carry["cnt"][s] += 1
+        rn[i] = carry["cnt"][s]
+        lag[i] = carry["last"][s] if carry["has"][s] else np.nan
+        lhas[i] = bool(carry["has"][s])
+        v = float(vals[i])
+        if not np.isnan(v):
+            carry["acc"][s] += np.float32(v)
+        run[i] = carry["acc"][s]
+        carry["last"][s] = v
+        carry["has"][s] = True
+    return {"row_number": rn, "lag": lag, "lag_has": lhas, "run_sum": run}
+
+
+# ----------------------------------------------------------- observability
+class _Registry:
+    """Weakref index of live segscan kernels for /metrics."""
+
+    def __init__(self) -> None:
+        import weakref
+
+        self._weakref = weakref
+        self._lock = threading.Lock()
+        self._entries: List[Tuple[Any, Optional[str]]] = []
+
+    def register(self, ss, rule: Optional[str] = None) -> None:
+        from ..utils.rulelog import current_rule
+
+        with self._lock:
+            self._entries = [(r, ru) for (r, ru) in self._entries
+                             if r() is not None]
+            self._entries.append((self._weakref.ref(ss),
+                                  rule or current_rule()))
+
+    def kernels(self) -> List[Tuple[Any, Optional[str]]]:
+        with self._lock:
+            refs = list(self._entries)
+        return [(k, rule) for (r, rule) in refs if (k := r()) is not None]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+_registry = _Registry()
+
+
+def registry() -> _Registry:
+    return _registry
+
+
+def reset() -> None:
+    """Test hook."""
+    _registry.clear()
+
+
+def render_prometheus(out: List[str], esc) -> None:
+    """Append the kuiper_segscan_* families to a /metrics scrape."""
+    fams = (
+        ("kuiper_segscan_rows_total", "counter",
+         "rows computed through the segmented-scan analytic kernels",
+         lambda ss: ss.rows_total),
+        ("kuiper_segscan_spills_total", "counter",
+         "partition partials carried across micro-batch boundaries "
+         "(spilled partials, never rows)",
+         lambda ss: ss.spills_total),
+    )
+    kernels = _registry.kernels()
+    for name, mtype, help_txt, fn in fams:
+        out.append(f"# TYPE {name} {mtype}")
+        out.append(f"# HELP {name} {help_txt}")
+        agg: Dict[str, int] = {}
+        for ss, rule in kernels:
+            try:
+                v = int(fn(ss))
+            except Exception:
+                continue
+            label = rule or "__engine__"
+            agg[label] = agg.get(label, 0) + v
+        for rule, v in sorted(agg.items()):
+            out.append(f'{name}{{rule="{esc(rule)}"}} {v}')

@@ -177,6 +177,8 @@ def merged_options(rule: RuleDef) -> RuleOptionConfig:
         "ingestPrepUpload": "ingest_prep_upload",
         "slidingDevRingMb": "sliding_dev_ring_mb",
         "slidingImpl": "sliding_impl",
+        "joinImpl": "join_impl",
+        "analyticImpl": "analytic_impl",
         "sharedFold": "shared_fold",
         "tierStore": "tier_store",
         "tierHotMb": "tier_hot_mb",
@@ -1061,6 +1063,76 @@ def _build_device_chain(
     return tail.connect(proj)
 
 
+def _make_join_node(stmt, stream_joins, opts: RuleOptionConfig,
+                    rule_id: str) -> JoinNode:
+    """Stream-stream join operator: the device ring when the ON clause
+    lowers (planner/relational.py), else the host nested loop — with the
+    structured reason recorded so /explain and the fallback counter name
+    exactly why the plan stayed on host."""
+    left = stmt.sources[0].ref_name
+    if opts.join_impl == "device":
+        from ..sql.compiler import record_host_fallback
+        from ..sql.expr_ir import NotVectorizable
+
+        from . import relational
+        from ..runtime.nodes_relational import DeviceJoinNode
+
+        try:
+            lowering = relational.lower_join(stmt, stream_joins)
+            return DeviceJoinNode("join", stream_joins, left_name=left,
+                                  lowering=lowering,
+                                  buffer_length=opts.buffer_length)
+        except NotVectorizable as nv:
+            record_host_fallback(nv.reason)
+    return JoinNode("join", stream_joins, left_name=left,
+                    buffer_length=opts.buffer_length)
+
+
+def _make_analytic_node(stmt, analytic, opts: RuleOptionConfig,
+                        rule_id: str) -> AnalyticNode:
+    if opts.analytic_impl == "device":
+        from ..sql.compiler import record_host_fallback
+        from ..sql.expr_ir import NotVectorizable
+
+        from . import relational
+        from ..runtime.nodes_relational import DeviceAnalyticNode
+
+        try:
+            lowering = relational.lower_analytics(analytic)
+            return DeviceAnalyticNode("analytic", analytic,
+                                      lowering=lowering, rule_id=rule_id,
+                                      buffer_length=opts.buffer_length)
+        except NotVectorizable as nv:
+            record_host_fallback(nv.reason)
+    return AnalyticNode("analytic", analytic, rule_id=rule_id,
+                        buffer_length=opts.buffer_length)
+
+
+def _make_window_func_node(wf, opts: RuleOptionConfig) -> WindowFuncNode:
+    """rank/dense_rank/lead are whole-collection functions — they always
+    route through the vector operator (a per-row exec cannot see the
+    value order); `analytic_impl` only decides whether exact-float32 rank
+    batches use the segscan sort kernel."""
+    from . import relational
+
+    if not any(c.name in relational.VECTOR_WINDOW_FUNCS for c in wf):
+        return WindowFuncNode("window_func", wf,
+                              buffer_length=opts.buffer_length)
+    from ..sql.compiler import record_host_fallback
+    from ..sql.expr_ir import NotVectorizable
+    from ..runtime.nodes_relational import VectorWindowFuncNode
+
+    use_device = False
+    if opts.analytic_impl == "device":
+        try:
+            lowering = relational.lower_window_funcs(wf)
+            use_device = lowering.device_eligible()
+        except NotVectorizable as nv:
+            record_host_fallback(nv.reason)
+    return VectorWindowFuncNode("window_func", wf, use_device=use_device,
+                                buffer_length=opts.buffer_length)
+
+
 def _build_host_chain(
     topo: Topo, stmt, source_nodes: List[SourceNode], opts: RuleOptionConfig,
     rule_id: str, stream_joins=None, lookup_joins=None, store=None,
@@ -1115,8 +1187,7 @@ def _build_host_chain(
 
     analytic = _analytic_calls(stmt)
     if analytic:
-        attach(AnalyticNode("analytic", analytic, rule_id=rule_id,
-                            buffer_length=opts.buffer_length))
+        attach(_make_analytic_node(stmt, analytic, opts, rule_id))
     # predicate pushdown: WHERE before the window when it has no analytic refs
     where_pushed = False
     if stmt.condition is not None and not analytic:
@@ -1129,9 +1200,7 @@ def _build_host_chain(
     if stmt.condition is not None and not where_pushed:
         attach(FilterNode("filter", stmt.condition, buffer_length=opts.buffer_length))
     if stream_joins:
-        left = stmt.sources[0].ref_name
-        attach(JoinNode("join", stream_joins, left_name=left,
-                        buffer_length=opts.buffer_length))
+        attach(_make_join_node(stmt, stream_joins, opts, rule_id))
     if stmt.dimensions:
         attach(AggregateNode("aggregate", [d.expr for d in stmt.dimensions],
                              buffer_length=opts.buffer_length))
@@ -1140,7 +1209,7 @@ def _build_host_chain(
                           buffer_length=opts.buffer_length))
     wf = _window_func_calls(stmt)
     if wf:
-        attach(WindowFuncNode("window_func", wf, buffer_length=opts.buffer_length))
+        attach(_make_window_func_node(wf, opts))
     if stmt.sorts:
         attach(OrderNode("order", stmt.sorts, buffer_length=opts.buffer_length))
     tail = attach(ProjectNode(
@@ -1293,5 +1362,29 @@ def explain(rule: RuleDef, store) -> Dict[str, Any]:
         out["expressions"] = explain_expressions(stmt)
     except Exception as exc:  # explain must never fail on the probe
         out["expressions"] = {"error": str(exc)}
+    # relational pieces (joins / analytic / window funcs) join the same
+    # report: each names its device-vs-host verdict with the reason slug
+    # the fallback counter would carry
+    try:
+        from . import relational
+
+        pieces = relational.explain_relational(
+            stmt, stream_joins=stmt.joins)
+        for p in pieces:  # rule options veto the lowering verdict
+            if p["kind"] == "join" and opts.join_impl != "device":
+                p.update(path="host", reason="join_impl_option")
+            elif p["kind"] in ("analytic", "window_func") \
+                    and opts.analytic_impl != "device":
+                p.update(path="host", reason="analytic_impl_option")
+        if pieces and isinstance(out["expressions"], dict):
+            out["expressions"].setdefault("pieces", []).extend(pieces)
+            hosted = [p for p in pieces if p.get("path") == "host"]
+            if hosted:
+                out["expressions"]["host_fallbacks"] = (
+                    out["expressions"].get("host_fallbacks", 0)
+                    + len(hosted))
+    except Exception as exc:  # explain must never fail on the probe
+        if isinstance(out.get("expressions"), dict):
+            out["expressions"]["relational_error"] = str(exc)
     take_expr_fallbacks()  # drop probe-recorded notes (explain is read-only)
     return out

@@ -263,6 +263,44 @@ def _tier_price_slots(price: Dict[str, Any], plan, stmt, opts) -> int:
         return 0
 
 
+def _relational_signatures(stmt, opts) -> int:
+    """Certified signature count for the relational tier a host-chain
+    rule would instantiate: the join-ring pad-pair ladder when the ON
+    clause lowers, segscan shift/sort when lag or the rank family
+    lowers. Non-lowering pieces cost nothing — they run as host python."""
+    from ..observability import jitcert
+    from ..planner import relational
+    from ..planner.planner import _analytic_calls, _window_func_calls
+    from ..sql.expr_ir import NotVectorizable
+
+    kw: Dict[str, Any] = {}
+    if stmt.joins and opts.join_impl == "device":
+        try:
+            low = relational.lower_join(stmt, stmt.joins)
+            rl, rr = low.resid_signature()
+            kw.update(join=True, join_resid_l=rl, join_resid_r=rr)
+        except NotVectorizable:
+            pass
+    if opts.analytic_impl == "device":
+        analytic = _analytic_calls(stmt)
+        if analytic:
+            try:
+                relational.lower_analytics(analytic)
+                kw["analytic_shift"] = True
+            except NotVectorizable:
+                pass
+        wf = _window_func_calls(stmt)
+        if wf:
+            try:
+                if relational.lower_window_funcs(wf).device_eligible():
+                    kw["analytic_sort"] = True
+            except NotVectorizable:
+                pass
+    if not kw:
+        return 0
+    return jitcert.estimate_relational_signatures(**kw)
+
+
 def price_rule(rule, store) -> Dict[str, Any]:
     """Price a candidate rule off the live cost model + telemetry.
     Degrades per component — a rule the planner cannot price (graph
@@ -322,7 +360,17 @@ def price_rule(rule, store) -> Dict[str, Any]:
         if plan is None:
             price["path"] = "host"
             price["fold_us_per_s"] = round(HOST_BATCH_US * batches_per_s, 1)
-            price["certified_new_signatures"] = 0  # no device kernel
+            price["certified_new_signatures"] = 0  # no fused kernel
+            # relational kernels (join ring / segscan) still compile on
+            # the host chain — price their certified signature sets so a
+            # join-heavy candidate cannot slip past the compile budget
+            try:
+                price["certified_new_signatures"] = \
+                    _relational_signatures(stmt, opts)
+            except Exception as exc:
+                logger.warning(
+                    "relational pricing failed for rule %s: %s",
+                    rule.id, exc)
         else:
             n_specs = len(plan.specs)
             explain = {}

@@ -115,8 +115,9 @@ class AnalyticNode(Node):
         try:
             import json
 
-            json.dumps(self.ev.func_states)
-            return {"func_states": self.ev.func_states}
+            # round-trip: the snapshot must be a frozen copy — handing out
+            # the live dict lets post-barrier rows mutate the checkpoint
+            return {"func_states": json.loads(json.dumps(self.ev.func_states))}
         except (TypeError, ValueError):
             return None
 

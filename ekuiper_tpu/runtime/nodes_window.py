@@ -455,6 +455,10 @@ class WindowNode(Node):
             "buffer": [
                 {"message": r.message, "timestamp": r.timestamp,
                  "emitter": r.emitter,
+                 # __analytic_* overlays are computed upstream of the
+                 # window; losing them on restore would make the evaluator
+                 # re-run the analytic (double-advancing its state)
+                 "cal_cols": dict(r.cal_cols),
                  # sliding windows: already-triggered rows must not
                  # re-trigger (and duplicate their window) after a restore
                  "slid": id(r) in self._slid_ids}
@@ -470,11 +474,15 @@ class WindowNode(Node):
         self._slid_ids = set()
         for d in state.get("buffer", []):
             r = Tuple(emitter=d.get("emitter", ""), message=d["message"],
-                      timestamp=d["timestamp"])
+                      timestamp=d["timestamp"],
+                      cal_cols=dict(d.get("cal_cols", {})))
             restored.append(r)
             if d.get("slid"):
                 self._slid_ids.add(id(r))
-        if self._use_bbuf and restored:
+        # columnarizing drops cal-col overlays; rows carrying __analytic_*
+        # state stay in the row buffer after a restore
+        if (self._use_bbuf and restored
+                and not any(r.cal_cols for r in restored)):
             from ..data.batch import from_tuples
 
             # one batch per emitter: joins match rows by emitter, and a

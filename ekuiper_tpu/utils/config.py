@@ -70,6 +70,14 @@ class RuleOptionConfig:
     # (ops/slidingring.py, default); "refold" = legacy pane-merge +
     # edge-refold path (parity baseline / escape hatch)
     sliding_impl: str = "daba"
+    # stream-stream joins: "device" = banded-gather ring kernel
+    # (ops/joinring.py) when the ON clause lowers, with per-window host
+    # fallback; "host" = always the nested-loop reference operator
+    join_impl: str = "device"
+    # analytic/window functions: "device" = lag on the segscan shift
+    # kernel + rank/dense_rank through the segscan sort kernel
+    # (ops/segscan.py); "host" = per-row evaluator state machines
+    analytic_impl: str = "device"
     key_slots: int = 16384  # group-by hash-slot table size per rule
     # tiered key state (ops/tierstore.py, docs/TIERED_STATE.md): "auto"
     # enables the HBM-resident hot set + host cold tier when

@@ -145,3 +145,207 @@ class TestLookupJoin:
             topo.close()
         msgs = _flat(got)
         assert msgs and msgs[0]["site"] == "berlin" and msgs[0]["val"] == 7.0
+
+
+# --------------------------------------------------------------------------
+# Device relational tier (ISSUE 19): the device join ring must emit
+# byte-identical results to the host nested loop across join types,
+# interval vs window-only bounds, NULL-key rows and late rows — and a
+# join rule must survive kill/restore mid-window.
+import random
+
+import pytest
+
+from ekuiper_tpu.planner import relational
+from ekuiper_tpu.runtime.nodes_join import JoinNode
+from ekuiper_tpu.runtime.nodes_relational import DeviceJoinNode
+from ekuiper_tpu.data.rows import JoinTuple, Tuple
+from ekuiper_tpu.sql.parser import parse_select
+
+
+def _parity_case(sql, trials=6, seed=0, late=False):
+    """Drive host JoinNode and DeviceJoinNode over identical randomized
+    windows; emissions must match byte-for-byte (messages AND order)."""
+    stmt = parse_select(sql)
+    low = relational.lower_join(stmt, stmt.joins)
+    host = JoinNode("join", stmt.joins, left_name=stmt.sources[0].ref_name)
+    dev = DeviceJoinNode("join", stmt.joins,
+                         left_name=stmt.sources[0].ref_name, lowering=low)
+    rng = random.Random(seed)
+    for trial in range(trials):
+        nl, nr = rng.randint(0, 10), rng.randint(0, 10)
+
+        def rows(side, n):
+            out = []
+            for _ in range(n):
+                ts = rng.randint(0, 25)
+                if late:  # stragglers far outside the band
+                    ts = rng.choice([ts, ts + 10_000])
+                msg = {"k": rng.choice(["a", "b", None]), "ts": ts}
+                if side == "l":
+                    msg["v"] = rng.choice([1.0, 5.0, None])
+                else:
+                    msg["w"] = rng.choice([0.0, 3.0, None])
+                out.append(Tuple(emitter=side, message=msg, timestamp=ts))
+            return out
+
+        left = [JoinTuple(tuples=[t]) for t in rows("l", nl)]
+        right = rows("r", nr)
+        eh = host._join_step(left, right, stmt.joins[0])
+        ed = dev._join_step(left, right, stmt.joins[0])
+        got_h = [[t.message for t in j.tuples] for j in eh]
+        got_d = [[t.message for t in j.tuples] for j in ed]
+        assert got_h == got_d, (sql, trial, got_h, got_d)
+
+
+class TestDeviceJoinParity:
+    @pytest.mark.parametrize("jt", ["INNER", "LEFT", "RIGHT", "FULL"])
+    def test_interval_join_types(self, jt):
+        _parity_case(
+            f"SELECT l.v, r.w FROM l {jt} JOIN r ON l.k = r.k "
+            "AND l.ts - r.ts >= -5 AND l.ts - r.ts <= 5 "
+            "GROUP BY TUMBLINGWINDOW(ss, 1)", seed=hash(jt) % 1000)
+
+    @pytest.mark.parametrize("jt", ["INNER", "LEFT", "RIGHT", "FULL"])
+    def test_window_bounds_join_types(self, jt):
+        # window-only: no band predicate, every in-window pair is a
+        # key-equality candidate
+        _parity_case(
+            f"SELECT l.v, r.w FROM l {jt} JOIN r ON l.k = r.k "
+            "GROUP BY TUMBLINGWINDOW(ss, 1)", seed=31 + hash(jt) % 1000)
+
+    def test_cross_join(self):
+        _parity_case("SELECT l.v, r.w FROM l CROSS JOIN r "
+                     "GROUP BY TUMBLINGWINDOW(ss, 1)", seed=7)
+
+    def test_interval_join_with_residual(self):
+        _parity_case(
+            "SELECT l.v, r.w FROM l FULL JOIN r ON l.k = r.k "
+            "AND l.ts - r.ts >= -5 AND l.ts - r.ts <= 5 AND l.v > r.w "
+            "GROUP BY TUMBLINGWINDOW(ss, 1)", seed=13)
+
+    def test_late_rows(self):
+        _parity_case(
+            "SELECT l.v, r.w FROM l LEFT JOIN r ON l.k = r.k "
+            "AND l.ts - r.ts >= -5 AND l.ts - r.ts <= 5 "
+            "GROUP BY TUMBLINGWINDOW(ss, 1)", seed=17, late=True)
+
+    def test_fallback_window_runs_host_loop(self):
+        # a non-integer event time in ONE window falls back to the host
+        # nested loop for that window only, counted on the ring
+        sql = ("SELECT l.v, r.w FROM l INNER JOIN r ON l.k = r.k "
+               "AND l.ts - r.ts >= -5 AND l.ts - r.ts <= 5 "
+               "GROUP BY TUMBLINGWINDOW(ss, 1)")
+        stmt = parse_select(sql)
+        low = relational.lower_join(stmt, stmt.joins)
+        dev = DeviceJoinNode("join", stmt.joins, left_name="l",
+                             lowering=low)
+        host = JoinNode("join", stmt.joins, left_name="l")
+        left = [JoinTuple(tuples=[Tuple(
+            emitter="l", message={"k": "a", "ts": 0.5, "v": 1.0},
+            timestamp=0)])]
+        right = [Tuple(emitter="r", message={"k": "a", "ts": 1, "w": 2.0},
+                       timestamp=1)]
+        eh = host._join_step(left, right, stmt.joins[0])
+        ed = dev._join_step(left, right, stmt.joins[0])
+        assert [[t.message for t in j.tuples] for j in eh] == \
+               [[t.message for t in j.tuples] for j in ed]
+        assert dev.ring.fallback_windows_total == 1
+
+
+class TestDeviceJoinE2E:
+    def _run(self, impl, mock_clock, tag):
+        store = kv.get_store()
+        try:
+            _streams(store)
+        except PlanError:
+            pass  # second run in the same test: streams already defined
+        topo = plan_rule(RuleDef(
+            id=f"dj_{tag}", sql=(
+                "SELECT ls.id, ls.v, rs.w FROM ls "
+                "LEFT JOIN rs ON ls.id = rs.id "
+                "GROUP BY TUMBLINGWINDOW(ss, 10)"),
+            actions=[{"memory": {"topic": f"dj_{tag}/out"}}],
+            options={"joinImpl": impl}), store)
+        got = []
+        mem.subscribe(f"dj_{tag}/out", lambda t, p: got.append(p))
+        topo.open()
+        try:
+            mem.publish("j/l", {"id": "a", "v": 1.0})
+            mem.publish("j/r", {"id": "a", "w": 2.0})
+            mem.publish("j/l", {"id": "solo", "v": 9.0})
+            mem.publish("j/r", {"id": "a", "w": 4.0})
+            mock_clock.advance(20)
+            assert topo.wait_idle(10)
+            mock_clock.advance(10_000)
+            deadline = time.time() + 6
+            while time.time() < deadline and not _flat(got):
+                time.sleep(0.02)
+        finally:
+            topo.close()
+        return _flat(got)
+
+    def test_device_rule_byte_identical_to_host_rule(self, mock_clock):
+        dev = self._run("device", mock_clock, "dev")
+        host = self._run("host", mock_clock, "host")
+        assert dev == host and dev, (dev, host)
+        # the planner actually took the device path (not a silent host)
+        store = kv.get_store()
+        topo = plan_rule(RuleDef(
+            id="dj_probe", sql=(
+                "SELECT ls.id FROM ls LEFT JOIN rs ON ls.id = rs.id "
+                "GROUP BY TUMBLINGWINDOW(ss, 10)"),
+            actions=[{"log": {}}], options={}), store)
+        assert any(isinstance(n, DeviceJoinNode) for n in topo.ops)
+
+    def test_kill_restore_mid_window(self, mock_clock):
+        store = kv.get_store()
+        _streams(store)
+
+        def make_topo():
+            return plan_rule(RuleDef(
+                id="djc", sql=(
+                    "SELECT ls.id, ls.v, rs.w FROM ls "
+                    "INNER JOIN rs ON ls.id = rs.id "
+                    "GROUP BY TUMBLINGWINDOW(ss, 10)"),
+                actions=[{"memory": {"topic": "djc/out"}}],
+                options={"qos": 1, "checkpointInterval": 3_600_000}),
+                store)
+
+        topo = make_topo()
+        topo.open()
+        got = []
+        mem.subscribe("djc/out", lambda t, p: got.append(p))
+        mem.publish("j/l", {"id": "a", "v": 1.0})
+        mem.publish("j/r", {"id": "a", "w": 2.0})
+        mock_clock.advance(20)
+        assert topo.wait_idle(10)
+        from conftest import wait_for_checkpoint
+
+        cid = topo.trigger_checkpoint()
+        wait_for_checkpoint(store, "djc", cid)
+        mem.publish("j/l", {"id": "b", "v": 3.0})
+        mem.publish("j/r", {"id": "b", "w": 4.0})
+        mock_clock.advance(20)
+        assert topo.wait_idle(10)
+        topo.close()  # crash: no graceful save
+
+        topo2 = make_topo()
+        topo2.open()
+        try:
+            # at-least-once replay of the post-checkpoint rows
+            mem.publish("j/l", {"id": "b", "v": 3.0})
+            mem.publish("j/r", {"id": "b", "w": 4.0})
+            mock_clock.advance(20)
+            assert topo2.wait_idle(10)
+            mock_clock.advance(10_000)
+            deadline = time.time() + 6
+            while time.time() < deadline and not _flat(got):
+                time.sleep(0.02)
+        finally:
+            topo2.close()
+        msgs = _flat(got)
+        pairs = {(m["id"], m["v"], m["w"]) for m in msgs}
+        # uninterrupted expectation: both pairs exactly once
+        assert pairs == {("a", 1.0, 2.0), ("b", 3.0, 4.0)}, msgs
+        assert len(msgs) == 2, msgs

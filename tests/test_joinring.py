@@ -1,0 +1,181 @@
+"""Device join-ring kernel (ops/joinring.py): match-mask parity against
+the numpy twin, NULL-key semantics (NULL = NULL is true in this engine),
+band arithmetic, residual three-valued logic, window fallback reasons,
+and the time-bucketed dual-side ring mechanics."""
+import random
+
+import numpy as np
+import pytest
+
+from ekuiper_tpu.ops.joinring import (JOIN_PAD_FLOOR, JoinRing,
+                                      JoinWindowFallback, SideBatch,
+                                      TS_RANGE_CAP)
+from ekuiper_tpu.planner import relational
+from ekuiper_tpu.sql.expr_ir import NotVectorizable
+from ekuiper_tpu.sql.parser import parse_select
+
+
+def _lower(sql):
+    stmt = parse_select(sql)
+    return relational.lower_join(stmt, stmt.joins)
+
+
+def _side(keys, ts=None, **cols):
+    b = SideBatch(n=len(keys))
+    b.key_cols.append(list(keys))
+    if ts is not None:
+        b.band = list(ts)
+    for name, vals in cols.items():
+        b.cols[name] = list(vals)
+    return b
+
+
+JOIN_SQL = ("SELECT l.v, r.w FROM l INNER JOIN r ON l.k = r.k "
+            "AND l.ts - r.ts >= -5 AND l.ts - r.ts <= 5 AND l.v > r.w "
+            "GROUP BY TUMBLINGWINDOW(ss, 1)")
+
+
+class TestMatchParity:
+    def test_randomized_device_equals_host(self):
+        ring = _lower(JOIN_SQL).build_ring(capacity=64)
+        rng = random.Random(11)
+        for _ in range(8):
+            nl, nr = rng.randint(0, 12), rng.randint(0, 12)
+            left = _side(
+                [rng.choice(["a", "b", None, ""]) for _ in range(nl)],
+                ts=[rng.choice([rng.randint(0, 30), None])
+                    for _ in range(nl)],
+                __jl_v=[rng.choice([1.0, 5.0, None]) for _ in range(nl)])
+            right = _side(
+                [rng.choice(["a", "b", None, ""]) for _ in range(nr)],
+                ts=[rng.choice([rng.randint(0, 30), None])
+                    for _ in range(nr)],
+                __jr_w=[rng.choice([0.0, 3.0, None]) for _ in range(nr)])
+            dev = ring.match(left, right)
+            host = ring.match_host(left, right)
+            assert dev.shape == (nl, nr)
+            np.testing.assert_array_equal(dev, host)
+
+    def test_null_keys_pair_with_each_other_not_empty_string(self):
+        # this engine evaluates NULL = NULL as true (sql/eval.py), and
+        # NULL = "" as false — the ring must encode both distinctly
+        ring = _lower(
+            "SELECT l.v, r.w FROM l INNER JOIN r ON l.k = r.k "
+            "GROUP BY TUMBLINGWINDOW(ss, 1)").build_ring(capacity=16)
+        mask = ring.match(_side([None, "", "a"]), _side([None, "", "a"]))
+        np.testing.assert_array_equal(mask, np.eye(3, dtype=bool))
+
+    def test_band_bounds_inclusive(self):
+        ring = _lower(
+            "SELECT l.v FROM l INNER JOIN r ON l.k = r.k "
+            "AND l.ts - r.ts >= -2 AND l.ts - r.ts <= 2 "
+            "GROUP BY TUMBLINGWINDOW(ss, 1)").build_ring(capacity=16)
+        left = _side(["a"] * 1, ts=[10])
+        right = _side(["a"] * 5, ts=[7, 8, 10, 12, 13])
+        mask = ring.match(left, right)
+        assert mask.tolist() == [[False, True, True, True, False]]
+
+    def test_residual_null_is_not_a_match(self):
+        ring = _lower(JOIN_SQL).build_ring(capacity=16)
+        left = _side(["a", "a"], ts=[0, 0], __jl_v=[5.0, None])
+        right = _side(["a"], ts=[0], __jr_w=[1.0])
+        mask = ring.match(left, right)
+        assert mask.tolist() == [[True], [False]]
+
+
+class TestFallbackContract:
+    def test_non_integer_event_time_reason(self):
+        ring = _lower(JOIN_SQL).build_ring(capacity=16)
+        with pytest.raises(JoinWindowFallback) as ei:
+            ring.match(_side(["a"], ts=["not-a-ts"], __jl_v=[1.0]),
+                       _side(["a"], ts=[0], __jr_w=[0.0]))
+        assert ei.value.reason == "join_ts_type"
+
+    def test_ts_range_overflow_reason(self):
+        ring = _lower(JOIN_SQL).build_ring(capacity=16)
+        with pytest.raises(JoinWindowFallback) as ei:
+            ring.match(
+                _side(["a", "a"], ts=[0, TS_RANGE_CAP + 10],
+                      __jl_v=[1.0, 1.0]),
+                _side(["a"], ts=[0], __jr_w=[0.0]))
+        assert ei.value.reason == "join_ts_range"
+
+
+class TestRingMechanics:
+    def test_append_window_evict(self):
+        ring = _lower(JOIN_SQL).build_ring(capacity=16, bucket_ms=10)
+        for t in range(0, 50, 5):
+            ring.append("l", _side(["a"], ts=[t], __jl_v=[1.0]))
+        assert ring.ring_rows("l") == 10
+        win = ring.window("l", 10, 29)
+        assert all(10 <= t <= 29 for t in win.band)
+        assert win.n >= 4  # bucket granularity may over-select; never under
+        evicted = ring.evict(before_ts=20)
+        assert evicted > 0
+        assert ring.ring_rows("l") < 10
+        assert ring.nbytes() > 0
+        ring.reset_ring()
+        assert ring.ring_rows("l") == 0
+
+    def test_capacity_doubles_under_key_pressure(self):
+        ring = _lower(
+            "SELECT l.v FROM l INNER JOIN r ON l.k = r.k "
+            "GROUP BY TUMBLINGWINDOW(ss, 1)").build_ring(capacity=4)
+        n = 64
+        keys = [f"k{i}" for i in range(n)]
+        mask = ring.match(_side(keys), _side(keys))
+        np.testing.assert_array_equal(mask, np.eye(n, dtype=bool))
+        assert ring.capacity >= n
+
+    def test_pads_power_of_two(self):
+        ring = _lower(JOIN_SQL).build_ring(capacity=16)
+        mask = ring.match(
+            _side(["a"] * 3, ts=[0] * 3, __jl_v=[1.0] * 3),
+            _side(["a"] * (JOIN_PAD_FLOOR + 1),
+                  ts=[0] * (JOIN_PAD_FLOOR + 1),
+                  __jr_w=[0.0] * (JOIN_PAD_FLOOR + 1)))
+        assert mask.shape == (3, JOIN_PAD_FLOOR + 1)
+
+
+class TestLoweringGrammar:
+    def test_rejects_multiway_join(self):
+        stmt = parse_select(
+            "SELECT a.v FROM a INNER JOIN b ON a.k = b.k "
+            "INNER JOIN c ON a.k = c.k GROUP BY TUMBLINGWINDOW(ss, 1)")
+        with pytest.raises(NotVectorizable) as ei:
+            relational.lower_join(stmt, stmt.joins)
+        assert ei.value.reason == "join_multiway"
+
+    def test_cross_stream_comparison_lowers_half_open_band(self):
+        # no equi key: the affine comparison takes the band lane with a
+        # half-open bound (> v becomes >= v+1 over the integer domain;
+        # non-integral values fall back per window at runtime)
+        stmt = parse_select(
+            "SELECT l.v FROM l INNER JOIN r ON l.v > r.w "
+            "GROUP BY TUMBLINGWINDOW(ss, 1)")
+        low = relational.lower_join(stmt, stmt.joins)
+        assert low.key_l == []
+        assert (low.band_l, low.band_r, low.lo, low.hi) == ("v", "w", 1, None)
+
+    def test_rejects_join_with_no_lowerable_conjunct(self):
+        # an ON clause the expression IR cannot compile at all
+        stmt = parse_select(
+            "SELECT l.v FROM l INNER JOIN r ON l.s LIKE r.p "
+            "GROUP BY TUMBLINGWINDOW(ss, 1)")
+        with pytest.raises(NotVectorizable) as ei:
+            relational.lower_join(stmt, stmt.joins)
+        assert ei.value.reason.startswith("join_")
+
+    def test_cross_join_lowers_without_on(self):
+        stmt = parse_select("SELECT l.v, r.w FROM l CROSS JOIN r "
+                            "GROUP BY TUMBLINGWINDOW(ss, 1)")
+        low = relational.lower_join(stmt, stmt.joins)
+        assert low.key_l == [] and low.residual_dev is None
+
+    def test_band_lowers_to_int_bounds(self):
+        low = _lower(JOIN_SQL)
+        assert (low.lo, low.hi) == (-5, 5)
+        assert low.band_l == "ts" and low.band_r == "ts"
+        assert low.key_l == ["k"] and low.key_r == ["k"]
+        rl, rr = low.resid_signature()
+        assert list(rl) == ["__jl_v"] and list(rr) == ["__jr_w"]

@@ -1,0 +1,120 @@
+"""Segmented-scan analytic kernels (ops/segscan.py): device-vs-host twin
+parity for the stateful lag shift and the per-collection rank sort,
+partial-spill counters, capacity growth, and carry snapshot/restore."""
+import random
+
+import numpy as np
+
+from ekuiper_tpu.ops.segscan import SegScan, shift_host, sort_host
+
+
+def _rand_batch(rng, n, n_slots):
+    slots = np.array([rng.randrange(n_slots) for _ in range(n)],
+                     dtype=np.int32)
+    vals = np.array([rng.choice([1.5, 2.5, 7.0, np.nan])
+                     for _ in range(n)], dtype=np.float32)
+    return slots, vals
+
+
+class TestShiftParity:
+    def test_stateful_lag_matches_host_across_batches(self):
+        rng = random.Random(3)
+        dev = SegScan(capacity=16)
+        host_carry = {
+            "cnt": np.zeros(16, np.int64),
+            "last": np.zeros(16, np.float64),
+            "has": np.zeros(16, bool),
+            "acc": np.zeros(16, np.float64),
+        }
+        for _ in range(6):
+            n = rng.randint(1, 40)
+            slots, vals = _rand_batch(rng, n, 12)
+            d = dev.shift(slots, vals, n)
+            h = shift_host(host_carry, slots, vals, n)
+            for key in ("row_number", "lag", "lag_has", "run_sum"):
+                np.testing.assert_allclose(
+                    np.asarray(d[key], dtype=np.float64),
+                    np.asarray(h[key], dtype=np.float64),
+                    rtol=1e-6, err_msg=key)
+
+    def test_fresh_partition_has_no_lag(self):
+        dev = SegScan(capacity=8)
+        out = dev.shift(np.array([0, 1, 0], np.int32),
+                        np.array([1.0, 2.0, 3.0], np.float32), 3)
+        assert list(out["lag_has"]) == [False, False, True]
+        assert float(out["lag"][2]) == 1.0
+
+    def test_spill_counter_counts_continued_partitions(self):
+        dev = SegScan(capacity=8)
+        dev.shift(np.array([0, 1], np.int32),
+                  np.array([1.0, 2.0], np.float32), 2)
+        assert dev.spills_total == 0
+        dev.shift(np.array([0, 2], np.int32),
+                  np.array([3.0, 4.0], np.float32), 2)
+        # slot 0 continued from the previous micro-batch; slot 2 is fresh
+        assert dev.spills_total == 1
+
+    def test_capacity_grows_and_preserves_carry(self):
+        dev = SegScan(capacity=4)
+        dev.shift(np.array([0], np.int32), np.array([9.0], np.float32), 1)
+        out = dev.shift(np.array([40, 0], np.int32),
+                        np.array([1.0, 2.0], np.float32), 2)
+        assert dev.capacity >= 41
+        assert bool(out["lag_has"][1]) and float(out["lag"][1]) == 9.0
+
+    def test_snapshot_restore_roundtrip(self):
+        import json
+
+        a = SegScan(capacity=8)
+        a.shift(np.array([0, 1, 0], np.int32),
+                np.array([1.0, 2.0, 3.0], np.float32), 3)
+        snap = json.loads(json.dumps(a.snapshot()))
+        b = SegScan(capacity=8)
+        b.restore(snap)
+        oa = a.shift(np.array([0, 1], np.int32),
+                     np.array([5.0, 6.0], np.float32), 2)
+        ob = b.shift(np.array([0, 1], np.int32),
+                     np.array([5.0, 6.0], np.float32), 2)
+        for key in ("row_number", "lag", "lag_has", "run_sum"):
+            np.testing.assert_allclose(
+                np.asarray(oa[key], np.float64),
+                np.asarray(ob[key], np.float64), err_msg=key)
+        assert float(oa["lag"][0]) == 3.0
+
+
+class TestSortParity:
+    def test_randomized_ranks_match_host(self):
+        rng = random.Random(5)
+        dev = SegScan(capacity=8)
+        for _ in range(8):
+            n = rng.randint(1, 50)
+            seg = np.array([rng.randrange(4) for _ in range(n)],
+                           dtype=np.int32)
+            vals = np.array([rng.choice([1.0, 2.0, 2.0, 5.0, np.nan])
+                             for _ in range(n)], dtype=np.float32)
+            d = dev.ranks(seg, vals, n)
+            h = sort_host(seg, vals, n)
+            for key in ("row_number", "rank", "dense_rank", "rank_has",
+                        "lead", "lead_has"):
+                np.testing.assert_allclose(
+                    np.asarray(d[key], np.float64),
+                    np.asarray(h[key], np.float64),
+                    rtol=1e-6, err_msg=key)
+
+    def test_rank_semantics(self):
+        dev = SegScan(capacity=8)
+        seg = np.zeros(4, np.int32)
+        vals = np.array([2.0, 1.0, 2.0, np.nan], np.float32)
+        out = dev.ranks(seg, vals, 4)
+        assert [int(r) for r in out["rank"][:3]] == [2, 1, 2]
+        assert [int(r) for r in out["dense_rank"][:3]] == [2, 1, 2]
+        assert not out["rank_has"][3]  # NULL ranks as NULL
+
+    def test_lead_is_next_row_within_segment(self):
+        dev = SegScan(capacity=8)
+        seg = np.array([0, 1, 0, 1], np.int32)
+        vals = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        out = dev.ranks(seg, vals, 4)
+        assert float(out["lead"][0]) == 3.0
+        assert float(out["lead"][1]) == 4.0
+        assert not out["lead_has"][2] and not out["lead_has"][3]

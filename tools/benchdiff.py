@@ -64,6 +64,11 @@ HEADLINE = (
     # fold-limited — its throughput gates alongside the tumbling line,
     # and the predicate-lifted shared fold's dedup ratio must hold
     ("phases.filter_heavy.rows_per_sec", 0.15),
+    # device relational tier (ISSUE 19): interval-join match throughput
+    # and the per-window emission tail through the join ring — a kernel
+    # or emission-reconstruction regression gates every round
+    ("phases.join_heavy.rows_per_sec", 0.15),
+    ("phases.join_heavy.emit_p99_ms", 0.50),
     ("phases.multi_rule_shared_mixed.mixed_where_dedup_ratio", 0.10),
     # tiered key state (ISSUE 13): sustained rows/s and emit tail while
     # the cold tier absorbs a 1M->10M cardinality sweep under a fixed

@@ -171,6 +171,29 @@ def _synthetic_scrape() -> str:
 
     shard_kernel = FakeSharded()
     sharded_mod.registry().register(shard_kernel)
+    # relational tier (ops/joinring.py / ops/segscan.py): one fake ring
+    # and one fake scan kernel so the kuiper_join_* / kuiper_segscan_*
+    # families all render samples
+    from ekuiper_tpu.ops import joinring as joinring_mod
+    from ekuiper_tpu.ops import segscan as segscan_mod
+
+    class FakeRing:
+        rows_total = {"l": 5, "r": 4}
+        matches_total = 3
+        fallback_windows_total = 1
+
+        @staticmethod
+        def nbytes():
+            return 2048
+
+    class FakeSegScan:
+        rows_total = 7
+        spills_total = 2
+
+    join_ring = FakeRing()
+    seg_kernel = FakeSegScan()
+    joinring_mod.registry().register(join_ring, "lint_rule")
+    segscan_mod.registry().register(seg_kernel, "lint_rule")
     # health plane: an installed evaluator with one ticked verdict so the
     # kuiper_rule_health / kuiper_slo_burn_rate / kuiper_watermark_lag_ms
     # / kuiper_bottleneck_stage families all render samples
@@ -200,9 +223,13 @@ def _synthetic_scrape() -> str:
         memwatch.registry().clear()
         tierstore.reset()
         sharded_mod.reset()
+        joinring_mod.reset()
+        segscan_mod.reset()
         del owner
         del tier_mgr
         del shard_kernel
+        del join_ring
+        del seg_kernel
 
 
 def lint(text: str, docs_text: str) -> list:

@@ -15,6 +15,9 @@ passed):
   probe_multichip   sharded serving smoke: full-pipe parity on the
                     8-virtual-device CPU mesh, cross-mesh restore,
                     placement admission, jitcert clean
+  probe_joins       device relational tier smoke: join/analytic rules
+                    lift, mask+emission parity vs the host nested loop,
+                    structured join_* fallbacks, jitcert clean
   check_metrics     Prometheus catalog lint (synthetic scrape vs docs)
   benchdiff --smoke trajectory-gate self-test (synthetic artifacts)
   cold_start        AOT cache round trip: bake the jitcert battery,
@@ -49,6 +52,7 @@ GATES: Dict[str, List[str]] = {
     "probe_exprs": [sys.executable, "tools/probe_exprs.py"],
     "probe_tiering": [sys.executable, "tools/probe_tiering.py"],
     "probe_multichip": [sys.executable, "tools/probe_multichip.py"],
+    "probe_joins": [sys.executable, "tools/probe_joins.py"],
     "check_metrics": [sys.executable, "tools/check_metrics.py"],
     "benchdiff_smoke": [sys.executable, "tools/benchdiff.py", "--smoke"],
     "cold_start": [sys.executable, "-m", "tools.aot", "coldstart"],

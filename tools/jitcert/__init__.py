@@ -113,6 +113,20 @@ def _battery():
         "groupby_tiered": tiered_gb,
         "tier_store": tier_store,
     }
+    # relational tier (ops/joinring.py, ops/segscan.py): interval join
+    # with an ON residual (the residual column dtypes enter the match
+    # signature) and the analytic scan pair, driven across a capacity
+    # doubling in the diff battery
+    from ekuiper_tpu.planner import relational
+    from ekuiper_tpu.ops.segscan import SegScan
+
+    jstmt = parse_select(
+        "SELECT l.v, r.w FROM l INNER JOIN r ON l.k = r.k "
+        "AND l.ts - r.ts >= -5 AND l.ts - r.ts <= 5 AND l.v > r.w "
+        "GROUP BY TUMBLINGWINDOW(ss, 1)")
+    kernels["join_ring"] = relational.lower_join(
+        jstmt, jstmt.joins).build_ring(capacity=32)
+    kernels["segscan"] = SegScan(capacity=32)
     # sharded battery kernel (multi-chip serving, parallel/sharded.py):
     # the shard_map fold/finalize family driven across a capacity
     # doubling — needs >= 4 devices (2x2 mesh); the CLI forces 8 virtual
@@ -258,6 +272,36 @@ def _drive(kernels) -> None:
             state, packed = gb.demote(state, np.array([1], np.int32))
             state = gb.promote(state, np.asarray(packed)[:1],
                                np.array([1], np.int32))
+            continue
+        if name == "join_ring":
+            from ekuiper_tpu.ops.joinring import SideBatch
+
+            def side(n, prefix, base):
+                b = SideBatch(n=n)
+                b.key_cols.append([f"k{i % 5}" for i in range(n)])
+                b.band = [base + i for i in range(n)]
+                col = "__jl_v" if prefix == "l" else "__jr_w"
+                b.cols[col] = [float(i) for i in range(n)]
+                return b
+
+            # two pad-pair steps of the certified (PL, PR) ladder, plus
+            # a key-table doubling (capacity is not a match leaf — the
+            # signature must NOT change across the grow)
+            gb.match(side(10, "l", 0), side(10, "r", 0))
+            gb.match(side(300, "l", 0), side(10, "r", 0))
+            gb.match(side(40, "l", 0), side(300, "r", 0))
+            continue
+        if name == "segscan":
+            # micro-batch pad ladder + a carry-capacity doubling (slot
+            # beyond capacity forces grow; the shift signature's carry
+            # dims step one rung)
+            slots = (np.arange(10) % 8).astype(np.int32)
+            vals = np.arange(10, dtype=np.float32)
+            gb.shift(slots, vals, 10)
+            gb.ranks(slots, vals, 10)
+            big = (np.arange(300) % 40).astype(np.int32)
+            gb.shift(big, np.arange(300, dtype=np.float32), 300)
+            gb.ranks(big, np.arange(300, dtype=np.float32), 300)
             continue
         if name == "sketch":
             gb.update(np.arange(10, dtype=np.float32))

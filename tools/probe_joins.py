@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""probe_joins — tier-1 smoke for the device relational tier.
+
+Covers the join-ring + segmented-scan subsystem end to end:
+
+  1. lift engagement: the planner builds a DeviceJoinNode for a
+     canonical interval join, a DeviceAnalyticNode for a lag() rule and
+     a VectorWindowFuncNode for a rank() rule — no silent host routing,
+  2. mask parity: randomized windows (NULL keys, NULL event times, NULL
+     residual operands) through the certified match kernel equal the
+     numpy shadow twin bit-for-bit,
+  3. emission parity: full DeviceJoinNode._join_step windows reproduce
+     the host nested loop's messages AND emission order for INNER and
+     FULL joins,
+  4. fallback taxonomy: a non-liftable ON clause surfaces a structured
+     `join_*` reason in /rules/{id}/explain's expressions report and in
+     the kuiper_expr_host_fallback_total counter — never an exception,
+  5. every traced signature is inside its jitcert certificate
+     (diff_live clean) — the bounded-signature-family acceptance gate.
+
+Run directly or through tools/ci_gate.py (gate name `probe_joins`).
+Exit 0 on success. docs/JOINS.md documents the subsystem.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+JOIN_SQL = ("SELECT ls.v, rs.w FROM ls INNER JOIN rs ON ls.id = rs.id "
+            "AND ls.ts - rs.ts >= -5 AND ls.ts - rs.ts <= 5 "
+            "AND ls.v > rs.w GROUP BY TUMBLINGWINDOW(ss, 10)")
+LIKE_SQL = ("SELECT ls.v FROM ls INNER JOIN rs ON ls.id LIKE rs.id "
+            "GROUP BY TUMBLINGWINDOW(ss, 10)")
+LAG_SQL = ("SELECT id, lag(v) OVER (PARTITION BY id) AS prev FROM ls")
+RANK_SQL = ("SELECT id, rank(v) OVER (PARTITION BY id) AS rk FROM ls "
+            "GROUP BY TUMBLINGWINDOW(ss, 10)")
+
+
+def _mk_streams(store):
+    from ekuiper_tpu.server.processors import StreamProcessor
+
+    sp = StreamProcessor(store)
+    sp.exec_stmt('CREATE STREAM ls (id STRING, v FLOAT, ts BIGINT) '
+                 'WITH (DATASOURCE="pj/l", TYPE="memory", FORMAT="JSON")')
+    sp.exec_stmt('CREATE STREAM rs (id STRING, w FLOAT, ts BIGINT) '
+                 'WITH (DATASOURCE="pj/r", TYPE="memory", FORMAT="JSON")')
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from ekuiper_tpu.data.rows import JoinTuple, Tuple
+    from ekuiper_tpu.observability import jitcert
+    from ekuiper_tpu.ops.joinring import SideBatch
+    from ekuiper_tpu.planner import relational
+    from ekuiper_tpu.planner.planner import RuleDef, explain, plan_rule
+    from ekuiper_tpu.runtime.nodes_relational import (DeviceAnalyticNode,
+                                                      DeviceJoinNode,
+                                                      VectorWindowFuncNode)
+    from ekuiper_tpu.sql.compiler import host_fallback_counts
+    from ekuiper_tpu.sql.parser import parse_select
+    from ekuiper_tpu.store import kv
+
+    problems = []
+    store = kv.get_store()
+    _mk_streams(store)
+
+    # ---- 1. lift engagement through the real planner -----------------
+    def node_types(sql, rid):
+        topo = plan_rule(RuleDef(id=rid, sql=sql,
+                                 actions=[{"log": {}}], options={}), store)
+        return [type(n).__name__ for n in topo.ops]
+
+    if not any(t == "DeviceJoinNode"
+               for t in node_types(JOIN_SQL, "pj_join")):
+        problems.append("interval join rule did not build a DeviceJoinNode")
+    if not any(t == "DeviceAnalyticNode"
+               for t in node_types(LAG_SQL, "pj_lag")):
+        problems.append("lag rule did not build a DeviceAnalyticNode")
+    if not any(t == "VectorWindowFuncNode"
+               for t in node_types(RANK_SQL, "pj_rank")):
+        problems.append("rank rule did not build a VectorWindowFuncNode")
+
+    # ---- 2. randomized mask parity: device kernel vs numpy twin ------
+    stmt = parse_select(JOIN_SQL)
+    low = relational.lower_join(stmt, stmt.joins)
+    ring = low.build_ring(capacity=64)
+    rng = random.Random(19)
+
+    def side(n, col):
+        b = SideBatch(n=n)
+        b.key_cols.append(
+            [rng.choice(["a", "b", None, ""]) for _ in range(n)])
+        b.band = [rng.choice([rng.randint(0, 30), None]) for _ in range(n)]
+        b.cols[col] = [rng.choice([1.0, 5.0, None]) for _ in range(n)]
+        return b
+
+    for trial in range(6):
+        left = side(rng.randint(0, 16), "__jl_v")
+        right = side(rng.randint(0, 16), "__jr_w")
+        dev = ring.match(left, right)
+        host = ring.match_host(left, right)
+        if not np.array_equal(dev, host):
+            problems.append(f"mask parity break at trial {trial}: "
+                            f"{dev.tolist()} != {host.tolist()}")
+            break
+
+    # ---- 3. emission parity: full node vs host nested loop -----------
+    from ekuiper_tpu.runtime.nodes_join import JoinNode
+
+    for jt in ("INNER", "FULL"):
+        sql = (f"SELECT l.v, r.w FROM l {jt} JOIN r ON l.k = r.k "
+               "AND l.ts - r.ts >= -5 AND l.ts - r.ts <= 5 "
+               "GROUP BY TUMBLINGWINDOW(ss, 1)")
+        s2 = parse_select(sql)
+        lw = relational.lower_join(s2, s2.joins)
+        host_n = JoinNode("join", s2.joins, left_name="l")
+        dev_n = DeviceJoinNode("join", s2.joins, left_name="l", lowering=lw)
+        for trial in range(4):
+            def rows(sd, n):
+                out = []
+                for _ in range(n):
+                    ts = rng.randint(0, 25)
+                    msg = {"k": rng.choice(["a", "b", None]), "ts": ts,
+                           ("v" if sd == "l" else "w"): rng.random()}
+                    out.append(Tuple(emitter=sd, message=msg, timestamp=ts))
+                return out
+
+            lrows = [JoinTuple(tuples=[t])
+                     for t in rows("l", rng.randint(0, 8))]
+            rrows = rows("r", rng.randint(0, 8))
+            eh = host_n._join_step(lrows, rrows, s2.joins[0])
+            ed = dev_n._join_step(lrows, rrows, s2.joins[0])
+            got_h = [[t.message for t in j.tuples] for j in eh]
+            got_d = [[t.message for t in j.tuples] for j in ed]
+            if got_h != got_d:
+                problems.append(f"{jt} emission parity break: "
+                                f"{got_h} != {got_d}")
+                break
+        if dev_n.ring.fallback_windows_total:
+            problems.append(f"{jt} parity windows took the fallback path")
+
+    # ---- 4. fallback taxonomy is structured, not an exception --------
+    before = dict(host_fallback_counts())
+    types = node_types(LIKE_SQL, "pj_like")
+    if any(t == "DeviceJoinNode" for t in types):
+        problems.append("LIKE-ON join must not lift to DeviceJoinNode")
+    if not any(t == "JoinNode" for t in types):
+        problems.append(f"LIKE-ON join lost its host JoinNode: {types}")
+    after = host_fallback_counts()
+    gained = {k: after.get(k, 0) - before.get(k, 0)
+              for k in after if after.get(k, 0) > before.get(k, 0)}
+    if not any(k.startswith("join_") for k in gained):
+        problems.append(f"no join_* host-fallback counter recorded "
+                        f"for the LIKE-ON plan: {gained}")
+    exp = explain(RuleDef(id="pj_like", sql=LIKE_SQL,
+                          actions=[{"log": {}}], options={}), store)
+    pieces = (exp.get("expressions") or {}).get("pieces") or []
+    join_pieces = [p for p in pieces if p.get("kind") == "join"]
+    if not join_pieces:
+        problems.append(f"explain has no join piece: {pieces}")
+    elif not (join_pieces[0].get("path") == "host"
+              and str(join_pieces[0].get("reason", "")).startswith("join_")):
+        problems.append(f"explain join piece lacks a join_* host reason: "
+                        f"{join_pieces[0]}")
+
+    # ---- 5. certificate closure --------------------------------------
+    d = jitcert.diff_live()
+    if not d["clean"]:
+        problems.append(f"jitcert diff not clean: {d['uncertified'][:4]}")
+
+    report = {"ok": not problems, "problems": problems}
+    print(json.dumps(report, indent=2) if problems else
+          "probe_joins: OK — join/analytic rules lift, mask+emission "
+          "parity holds, fallbacks are structured, jitcert clean")
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
