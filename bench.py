@@ -981,6 +981,14 @@ def _churn_soak_main() -> None:
     last_shift = t0
     recover_stats: dict = {}
     killed = False
+    # fleet observatory duty cycle under churn: observe() + full-scrape
+    # timeline snapshot at the production default 5s cadence inside the
+    # soak, so observatory_overhead_pct is measured against a live
+    # 25-rule fleet (the rules here are single-chip, so skew/collective
+    # read ~0 — the leaves exist report-only for trajectory tracking)
+    from ekuiper_tpu.observability import meshwatch as _meshwatch
+    obs_s = 0.0
+    next_obs = t0 + 5.0
     # offered load calibrated to keep the HEALTHY fleet comfortably
     # inside its SLO on one CPU: the soak demonstrates per-rule
     # isolation (victim shed, workload holds), not saturation collapse
@@ -1010,6 +1018,16 @@ def _churn_soak_main() -> None:
             running = h.hard_kill()
             recover_stats = h.recover(running)
             killed = True
+        if now >= next_obs:
+            # thread CPU time, not wall: on a saturated box a wall
+            # clock mostly measures GIL contention with the workload,
+            # not what the observatory itself costs
+            ot = time.thread_time()
+            _meshwatch.observe()
+            if api.timeline is not None:
+                api.timeline.snapshot()
+            obs_s += time.thread_time() - ot
+            next_obs = now + 5.0
         if now >= next_progress:
             # partial progress survives a watchdog/timeout kill as a
             # harvested `#R ` line (the r05 rc=124 class)
@@ -1052,6 +1070,12 @@ def _churn_soak_main() -> None:
                       if rid == victim and qos == "low")
     soak_p99 = max(p99.values()) if p99 else float("nan")
     workload_ok = bool(p99) and all(v <= 5000.0 for v in p99.values())
+    mrep = _meshwatch.observe()
+    msplit = _meshwatch.collective_split()
+    soak_skew = max((e["skew_ratio"] or 0.0 for e in mrep.values()),
+                    default=0.0)
+    mcoll = sorted(v["collective_us"] / 1000.0
+                   for (op, _), v in msplit.items() if "fold" in str(op))
     print(f"# churn_soak: {rows:,} rows over {elapsed:.1f}s; "
           f"churn {s['churn']}; live={s['live_rules']}; "
           f"workload p99 {p99}; victim shed {victim_shed} rows; "
@@ -1075,6 +1099,10 @@ def _churn_soak_main() -> None:
            unexplained_drop_rules=len(s["unexplained_drops"]),
            zero_unexplained=not s["unexplained_drops"],
            admission_structured=admission_structured,
+           skew_ratio=soak_skew,
+           collective_ms_p50=(mcoll[len(mcoll) // 2] if mcoll else 0.0),
+           observatory_overhead_pct=(100.0 * obs_s / elapsed
+                                     if elapsed > 0 else 0.0),
            recovered=recover_stats.get("recovered", 0),
            recover_expected=recover_stats.get("expected", 0),
            pooled_sources=True,
@@ -1205,6 +1233,31 @@ def _multichip_full_pipe_main() -> None:
         topo.open()
         src = (topo.sources[0] if topo.sources
                else topo._live_shared[0][0].source)
+        # fleet observatory duty cycle rides the sharded leg: observe()
+        # + timeline snapshot at a 1s cadence inside the timed segment,
+        # so observatory_overhead is the measured fraction of fold wall
+        # time the observatory costs (budget: <1%)
+        fleetobs = None
+        if shards != "off":
+            import shutil as _shutil
+            import tempfile as _tempfile
+
+            from ekuiper_tpu.observability import meshwatch
+            from ekuiper_tpu.observability import timeline as _tl_mod
+
+            def _scrape() -> str:
+                fam: list = []
+                meshwatch.render_prometheus(fam, lambda s: s)
+                return "\n".join(fam) + "\n"
+
+            _tl_dir = _tempfile.mkdtemp(prefix="bench_mc_timeline_")
+            fleetobs = (meshwatch,
+                        _tl_mod.Timeline(scrape_fn=_scrape,
+                                         base_dir=_tl_dir,
+                                         interval_ms=0),
+                        _tl_dir, _shutil)
+            meshwatch.observe()  # baseline the skew window
+        obs_s = 0.0
         try:
             # warm: compile the fold executables before the timed segment
             for d in drains:
@@ -1213,11 +1266,20 @@ def _multichip_full_pipe_main() -> None:
             topo.e2e_hist.snapshot_and_decay(0.0)
             rows = 0
             t0 = time.time()
+            next_obs = t0 + 1.0
             n = 0
             while time.time() - t0 < seg_s:
                 src.ingest(drains[n % len(drains)])
                 rows += drain_rows
                 n += 1
+                if fleetobs is not None and time.time() >= next_obs:
+                    # thread CPU time: wall would mostly count GIL
+                    # waits behind the fold workers, not the observatory
+                    ot = time.thread_time()
+                    fleetobs[0].observe()
+                    fleetobs[1].snapshot()
+                    obs_s += time.thread_time() - ot
+                    next_obs = time.time() + 1.0
                 bp_deadline = time.time() + 60
                 while fused.inq.qsize() > 8:
                     time.sleep(0.002)
@@ -1229,6 +1291,22 @@ def _multichip_full_pipe_main() -> None:
             e2e = _e2e_fields(topo)
             shard_stats = (fused.gb.shard_stats(fused.state)
                            if hasattr(fused.gb, "shard_stats") else [])
+            skew_ratio = 0.0
+            coll_p50 = 0.0
+            if fleetobs is not None:
+                ot = time.thread_time()
+                rep = fleetobs[0].observe()
+                split = fleetobs[0].collective_split()
+                fleetobs[1].snapshot()
+                obs_s += time.thread_time() - ot
+                skew_ratio = max(
+                    (e["skew_ratio"] or 0.0 for e in rep.values()),
+                    default=0.0)
+                coll = sorted(v["collective_us"] / 1000.0
+                              for (op, _), v in split.items()
+                              if "fold" in str(op))
+                if coll:
+                    coll_p50 = coll[len(coll) // 2]
             return {
                 "rows_per_sec": rows / elapsed,
                 "rows": rows,
@@ -1236,11 +1314,17 @@ def _multichip_full_pipe_main() -> None:
                 "shard_info": getattr(fused, "shard_info", {}),
                 "per_shard_rows": [s["rows"] for s in shard_stats],
                 "mesh": getattr(fused.gb, "mesh_tag", ""),
+                "skew_ratio": skew_ratio,
+                "collective_ms_p50": coll_p50,
+                "observatory_overhead_pct": (100.0 * obs_s / elapsed
+                                             if elapsed > 0 else 0.0),
                 **e2e,
             }
         finally:
             topo.close()
             mem.reset()
+            if fleetobs is not None:
+                fleetobs[3].rmtree(fleetobs[2], ignore_errors=True)
 
     os.environ["KUIPER_MESH"] = f"1x{n_dev}"
     try:
@@ -1305,7 +1389,9 @@ def _multichip_full_pipe_main() -> None:
         f"single {single['rows_per_sec']:,.0f} rows/s -> sharded "
         f"{sharded['rows_per_sec']:,.0f} rows/s ({scaling:.2f}x); "
         f"per-shard {sharded['per_shard_rows']}; emit p99 "
-        f"{sharded['e2e_p99_ms']}ms; parity={'ok' if parity_ok else 'FAIL'}",
+        f"{sharded['e2e_p99_ms']}ms; parity={'ok' if parity_ok else 'FAIL'}; "
+        f"skew {sharded.get('skew_ratio', 0.0):.2f}; observatory "
+        f"{sharded.get('observatory_overhead_pct', 0.0):.3f}%",
         file=sys.stderr,
     )
     record("multichip_full_pipe",
@@ -1316,6 +1402,10 @@ def _multichip_full_pipe_main() -> None:
            mesh=sharded["mesh"],
            per_shard_rows=sharded["per_shard_rows"],
            shard_info=sharded["shard_info"],
+           skew_ratio=sharded.get("skew_ratio", 0.0),
+           collective_ms_p50=sharded.get("collective_ms_p50", 0.0),
+           observatory_overhead_pct=sharded.get(
+               "observatory_overhead_pct", 0.0),
            parity_ok=parity_ok,
            platform=str(jax.devices()[0].platform),
            jitcert=_jitcert_fields(),

@@ -77,9 +77,12 @@ _LEVEL_STATE = {v: k for k, v in STATE_LEVEL.items()}
 #: (FilterNode vectorized/row WHERE, the row-interpreter fallback seam —
 #: sql/expr_ir.py compiles these onto the device for fused rules);
 #: "other" absorbs host-op busy time that belongs to none of the named
-#: stages (projections, joins)
+#: stages (projections, joins); "shard_skew" is mesh-level — a sharded
+#: rule whose hottest shard absorbs ≥ KUIPER_MESH_SKEW_THRESHOLD times
+#: the mean fold rows (observability/meshwatch.py) is bound by one
+#: chip's key range, not by any pipeline stage
 STAGES = ("decode", "upload", "fold", "emit_combine", "sink",
-          "host_expr", "other")
+          "host_expr", "shard_skew", "other")
 
 #: node-local stage labels → canonical taxonomy
 _STAGE_CANON = {"decode": "decode", "ring": "decode",
@@ -319,6 +322,16 @@ class HealthEvaluator:
                 self._tick_kern = kernwatch.rule_ops_all()
             except Exception:
                 self._tick_kern = {}
+            # mesh skew observed once per tick, shared by every rule's
+            # attribution below (observability/meshwatch.py); ts passed
+            # explicitly — we hold self._lock, the clock lock is off
+            # limits (same ABBA discipline as the recorder calls)
+            from . import meshwatch
+
+            try:
+                self._tick_mesh = meshwatch.observe(now)
+            except Exception:
+                self._tick_mesh = {}
             seen = set()
             for entry in rules:
                 try:
@@ -565,6 +578,31 @@ class HealthEvaluator:
         if device_time is not None and bottleneck.get("stage"):
             bottleneck["axis"] = device_time["axis"]
             bottleneck["device_time"] = device_time
+
+        # ---- mesh attribution (observability/meshwatch.py): a sharded
+        # rule whose hottest shard absorbs a super-threshold multiple of
+        # the mean fold rows is bound by one chip's key range — that
+        # outranks stage attribution (the skewed chip IS the dominant
+        # stage's critical path). Attribution only: burn math and the
+        # health FSM are untouched, so a skewed-but-meeting-SLO rule
+        # stays HEALTHY with a shard_skew verdict attached.
+        mesh = (getattr(self, "_tick_mesh", None) or {}).get(rid)
+        if mesh is not None:
+            bottleneck["mesh"] = {
+                "skew_ratio": mesh.get("skew_ratio"),
+                "hot_shard": mesh.get("hot_shard"),
+                "mesh": mesh.get("mesh"),
+                "skewed": bool(mesh.get("skewed")),
+            }
+            if mesh.get("skewed"):
+                hot = next(
+                    (s for s in mesh.get("shards", [])
+                     if s["shard"] == mesh.get("hot_shard")), None)
+                total = sum(s["rows"] for s in mesh.get("shards", [])) or 1
+                bottleneck["stage"] = "shard_skew"
+                bottleneck["node"] = f"shard:{mesh.get('hot_shard')}"
+                bottleneck["share"] = round(
+                    (hot["rows"] / total) if hot else 0.0, 4)
 
         # ---- event-time progress (watermark lag, pane occupancy)
         wm_info = self._watermark_probe(rid, ordered, now)

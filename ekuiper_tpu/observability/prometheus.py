@@ -236,6 +236,18 @@ def render(rule_registry) -> str:
     from ..parallel import sharded as _sharded
 
     _sharded.render_prometheus(out, _esc)
+    # mesh attribution (observability/meshwatch.py): per-rule shard skew
+    # ratio + rows/s, collective-vs-compute split of the sharded fold
+    # sites — observes the shard registry at scrape time
+    from . import meshwatch as _meshwatch
+
+    _meshwatch.render_prometheus(out, _esc)
+    # telemetry timeline (observability/timeline.py): on-disk segment
+    # count/bytes of the durable snapshot ring (absent when none is
+    # installed)
+    from . import timeline as _timeline
+
+    _timeline.render_prometheus(out, _esc)
     # relational tier (ops/joinring.py, ops/segscan.py): join-ring rows,
     # matches, per-window host fallbacks and ring bytes; segscan rows
     # and partial spills per rule

@@ -1,0 +1,463 @@
+"""Durable telemetry timeline — a bounded on-disk ring of metric
+snapshots and flight events.
+
+The in-memory surfaces (the 1024-event flight recorder, the last health
+verdicts, a point-in-time /metrics scrape) all die with the process or
+age out within minutes. This module gives a post-mortem a time axis: on
+a `KUIPER_TIMELINE_INTERVAL_MS` cadence it scrapes the full Prometheus
+render (every family — kernel timings, shard rows, burn rates, shed
+totals — plus the health verdict states), delta-encodes the sample
+against the previous one, and appends a JSON line to a segment file
+under `<store.path>/timeline/`. Flight-recorder events mirror in as
+they happen (runtime/events.py `record()` calls `note_event`), so the
+incident trail outlives the ring.
+
+Segment format (`seg-<seq>-<t0 ms>.jsonl`, one JSON object per line):
+
+- `{"t": ms, "k": "snap", "full": true, "d": {series: value, ...}}` —
+  the first snapshot record of every segment carries the complete
+  sample, so any single segment replays standalone;
+- `{"t": ms, "k": "snap", "d": {changed...}, "x": [removed...]}` —
+  later records carry only series whose value changed (`x` lists series
+  that disappeared);
+- `{"t": ms, "k": "ev", "ev": {...}}` — a mirrored flight event,
+  verbatim.
+
+Series keys are the Prometheus sample identity (`name{labels}`), so
+`query(family=, rule=)` filters are plain string tests. Segments rotate
+at `KUIPER_TIMELINE_SEG_KB` and the directory is capped by
+`KUIPER_TIMELINE_MAX_MB` / `KUIPER_TIMELINE_MAX_AGE_MS` (oldest
+segments deleted first — a ring, on disk). Every append flushes, so a
+hard kill (chaos-harness `hard_kill`) loses at most the line being
+written; `dying_gasp()` (wired to atexit and the fatal paths) forces one
+last full snapshot out. `tools/kuiperdiag.py --timeline` packs recent
+segments into the support bundle; `GET /diagnostics/timeline` serves
+the replay over REST.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils import timex
+from ..utils.infra import logger
+
+DEFAULT_INTERVAL_MS = 5_000   # KUIPER_TIMELINE_INTERVAL_MS (0 = no timer)
+DEFAULT_SEG_KB = 256          # KUIPER_TIMELINE_SEG_KB — rotate threshold
+DEFAULT_MAX_MB = 8            # KUIPER_TIMELINE_MAX_MB — directory byte cap
+DEFAULT_MAX_AGE_MS = 6 * 3600 * 1000  # KUIPER_TIMELINE_MAX_AGE_MS
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except (TypeError, ValueError):
+        return default
+
+
+def parse_scrape(text: str) -> Dict[str, float]:
+    """Prometheus text exposition -> {series identity: value}. The series
+    identity is the sample line minus its value (`name{labels}`), which
+    keeps delta keys stable across scrapes."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        if not key:
+            continue
+        try:
+            v = float(val)
+        except ValueError:
+            continue
+        out[key] = int(v) if v == int(v) else v
+    return out
+
+
+class Timeline:
+    """One on-disk telemetry ring. `scrape_fn()` returns the Prometheus
+    text to snapshot; `verdicts_fn()` (optional) returns the health
+    verdict map folded in as pseudo-series `health|<rule> = state`."""
+
+    def __init__(self, scrape_fn: Callable[[], str],
+                 base_dir: Optional[str] = None,
+                 verdicts_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 interval_ms: Optional[int] = None) -> None:
+        if base_dir is None:
+            from ..utils.config import get_config
+
+            base_dir = os.path.join(get_config().store.path, "timeline")
+        self.dir = base_dir
+        self._scrape_fn = scrape_fn
+        self._verdicts_fn = verdicts_fn
+        self.interval_ms = (
+            _env_int("KUIPER_TIMELINE_INTERVAL_MS", DEFAULT_INTERVAL_MS)
+            if interval_ms is None else int(interval_ms))
+        self.seg_bytes = _env_int(
+            "KUIPER_TIMELINE_SEG_KB", DEFAULT_SEG_KB) * 1024
+        self.max_bytes = _env_int(
+            "KUIPER_TIMELINE_MAX_MB", DEFAULT_MAX_MB) * 1024 * 1024
+        self.max_age_ms = _env_int(
+            "KUIPER_TIMELINE_MAX_AGE_MS", DEFAULT_MAX_AGE_MS)
+        self._lock = threading.Lock()
+        self._fh = None
+        self._fh_path: Optional[str] = None
+        self._fh_bytes = 0
+        self._last: Optional[Dict[str, float]] = None
+        self._timer = None
+        self._running = False
+        self._gasped = False
+        self.snapshots = 0
+        self.events_mirrored = 0
+        os.makedirs(self.dir, exist_ok=True)
+        # resume the seq past any segments a previous life left behind —
+        # recovery IS the point, never clobber them
+        self._seq = max(
+            [self._parse_name(n)[0] for n in self._list_names()] or [0])
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self.interval_ms <= 0:
+            return
+        with self._lock:
+            if self._running:
+                return
+            self._running = True
+        self._arm()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._running = False
+            if self._timer is not None:
+                self._timer.stop()
+                self._timer = None
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except Exception:
+                    pass
+                self._fh = None
+
+    def _arm(self) -> None:
+        self._timer = timex.after(self.interval_ms, self._fire)
+
+    def _fire(self, ts: int) -> None:
+        if not self._running:
+            return
+        try:
+            self.snapshot(now=ts)
+        except Exception as exc:
+            logger.warning("timeline snapshot failed: %s", exc)
+        finally:
+            if self._running:
+                self._arm()
+
+    # ----------------------------------------------------------- segments
+    @staticmethod
+    def _parse_name(name: str) -> Tuple[int, int]:
+        """seg-<seq>-<t0>.jsonl -> (seq, t0); (0, 0) for foreign files."""
+        try:
+            stem = name[:-len(".jsonl")]
+            _, seq, t0 = stem.split("-", 2)
+            return int(seq), int(t0)
+        except (ValueError, IndexError):
+            return (0, 0)
+
+    def _list_names(self) -> List[str]:
+        try:
+            return sorted(
+                n for n in os.listdir(self.dir)
+                if n.startswith("seg-") and n.endswith(".jsonl"))
+        except OSError:
+            return []
+
+    def _open_segment(self, now: int) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except Exception:
+                pass
+        self._seq += 1
+        name = f"seg-{self._seq:08d}-{now}.jsonl"
+        self._fh_path = os.path.join(self.dir, name)
+        self._fh = open(self._fh_path, "a", encoding="utf-8")
+        self._fh_bytes = 0
+        self._last = None  # force the segment-opening record to be full
+
+    def _roll(self, now: int) -> None:
+        """Rotate when the active segment is missing or over the size
+        threshold. Caller holds self._lock."""
+        if self._fh is None or self._fh_bytes >= self.seg_bytes:
+            self._open_segment(now)
+
+    def _write(self, rec: Dict[str, Any], now: int) -> None:
+        """Serialize + append + flush one record, then retire segments to
+        the caps. Caller holds self._lock and has called _roll()."""
+        line = json.dumps(rec, separators=(",", ":"), default=str)
+        self._fh.write(line + "\n")
+        self._fh.flush()
+        self._fh_bytes += len(line) + 1
+        self._retire(now)
+
+    def _retire(self, now: int) -> None:
+        """Oldest-first segment deletion to the byte/age caps. Caller
+        holds self._lock; the active segment is never deleted. Segment
+        start times ride the filename — no file reads here."""
+        names = self._list_names()
+        sizes = {}
+        for n in names:
+            try:
+                sizes[n] = os.path.getsize(os.path.join(self.dir, n))
+            except OSError:
+                sizes[n] = 0
+        total = sum(sizes.values())
+        for n in names[:-1]:  # keep the active (newest) segment
+            _, t0 = self._parse_name(n)
+            too_big = total > self.max_bytes
+            too_old = bool(self.max_age_ms > 0 and t0
+                           and (now - t0) > self.max_age_ms)
+            if not (too_big or too_old):
+                break  # t0 rises with the name sort; newer can't be older
+            try:
+                os.remove(os.path.join(self.dir, n))
+                total -= sizes[n]
+            except OSError:
+                pass
+
+    # ---------------------------------------------------------- recording
+    def snapshot(self, now: Optional[int] = None) -> Dict[str, Any]:
+        """Scrape, delta against the previous sample, append. The scrape
+        runs OUTSIDE the timeline lock (it takes every registry's lock);
+        clock reads happen before the lock (timer callbacks hold the
+        clock lock — utils/lockcheck.py ABBA discipline)."""
+        if now is None:
+            now = timex.now_ms()
+        sample = parse_scrape(self._scrape_fn() or "")
+        if self._verdicts_fn is not None:
+            try:
+                for rid, v in (self._verdicts_fn() or {}).items():
+                    state = v.get("state") if isinstance(v, dict) else v
+                    sample[f"health|{rid}"] = str(state)
+            except Exception:
+                pass
+        with self._lock:
+            # rotate BEFORE building the record: _open_segment clears
+            # self._last, so a fresh segment always opens with a full
+            # sample and replays standalone
+            self._roll(now)
+            prev = self._last
+            if prev is None:
+                rec: Dict[str, Any] = {"t": now, "k": "snap", "full": True,
+                                       "d": sample}
+            else:
+                changed = {k: v for k, v in sample.items()
+                           if prev.get(k) != v}
+                removed = [k for k in prev if k not in sample]
+                rec = {"t": now, "k": "snap", "d": changed}
+                if removed:
+                    rec["x"] = removed
+            self._write(rec, now)
+            self._last = sample
+            self.snapshots += 1
+        return rec
+
+    def note_event(self, ev: Dict[str, Any]) -> None:
+        """Mirror one flight-recorder event (already stamped with ts_ms
+        and seq by the ring)."""
+        now = int(ev.get("ts_ms", 0))
+        with self._lock:
+            self._roll(now)
+            self._write({"t": now, "k": "ev", "ev": ev}, now)
+            self.events_mirrored += 1
+
+    def dying_gasp(self) -> None:
+        """One last full snapshot on the way down — fatal handlers and
+        atexit call this; re-entry and double-gasp are no-ops."""
+        if self._gasped:
+            return
+        self._gasped = True
+        try:
+            with self._lock:
+                self._last = None  # force a full, standalone record
+            self.snapshot()
+        except Exception as exc:
+            logger.warning("timeline dying gasp failed: %s", exc)
+
+    # ------------------------------------------------------------- replay
+    def query(self, family: Optional[str] = None,
+              rule: Optional[str] = None,
+              since: Optional[int] = None,
+              limit: int = 200) -> Dict[str, Any]:
+        """Replay the segments oldest→newest into filtered records:
+        `family` matches the series name (exact) or prefix with a
+        trailing `*`; `rule` matches the `rule="..."` label (and event
+        rules); `since` drops records at/before that engine ms; `limit`
+        keeps the NEWEST n after filtering."""
+        def keep_series(key: str) -> bool:
+            if family:
+                name = key.split("{", 1)[0]
+                if family.endswith("*"):
+                    if not name.startswith(family[:-1]):
+                        return False
+                elif name != family and key != family:
+                    return False
+            if rule and f'rule="{rule}"' not in key \
+                    and not key.endswith(f"|{rule}"):
+                return False
+            return True
+
+        records: List[Dict[str, Any]] = []
+        for name in self._list_names():
+            try:
+                with open(os.path.join(self.dir, name),
+                          encoding="utf-8") as fh:
+                    for line in fh:
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue  # torn tail line after a hard kill
+                        t = int(rec.get("t", 0))
+                        if since is not None and t <= since:
+                            continue
+                        if rec.get("k") == "ev":
+                            ev = rec.get("ev") or {}
+                            if rule and ev.get("rule") != rule:
+                                continue
+                            if family and family not in ("ev", "events"):
+                                continue
+                            records.append(
+                                {"t": t, "kind": "event", "event": ev})
+                        else:
+                            d = {k: v for k, v in
+                                 (rec.get("d") or {}).items()
+                                 if keep_series(k)}
+                            if not d and not rec.get("full"):
+                                continue
+                            out_rec = {"t": t, "kind": "snapshot",
+                                       "series": d}
+                            if rec.get("full"):
+                                out_rec["full"] = True
+                            records.append(out_rec)
+            except OSError:
+                continue
+        if limit is not None and limit >= 0:
+            records = records[len(records) - min(limit, len(records)):]
+        return {"records": records, "returned": len(records),
+                **self.stats()}
+
+    def segment_dump(self, max_segments: int = 8,
+                     max_bytes: int = 1 << 20) -> Dict[str, List[str]]:
+        """Newest segments as raw lines for the kuiperdiag bundle,
+        bounded by count and total bytes (newest win)."""
+        out: Dict[str, List[str]] = {}
+        budget = max_bytes
+        for name in reversed(self._list_names()[-max_segments:]):
+            try:
+                with open(os.path.join(self.dir, name),
+                          encoding="utf-8") as fh:
+                    lines = fh.read().splitlines()
+            except OSError:
+                continue
+            size = sum(len(ln) + 1 for ln in lines)
+            if size > budget:
+                break
+            budget -= size
+            out[name] = lines
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        names = self._list_names()
+        total = 0
+        for n in names:
+            try:
+                total += os.path.getsize(os.path.join(self.dir, n))
+            except OSError:
+                pass
+        return {"dir": self.dir, "segments": len(names),
+                "bytes": total, "snapshots": self.snapshots,
+                "events_mirrored": self.events_mirrored,
+                "interval_ms": self.interval_ms,
+                "seg_bytes": self.seg_bytes,
+                "max_bytes": self.max_bytes,
+                "max_age_ms": self.max_age_ms}
+
+
+# -------------------------------------------------------------- singleton
+_timeline: Optional[Timeline] = None
+_install_lock = threading.Lock()
+
+
+def install(scrape_fn: Callable[[], str],
+            base_dir: Optional[str] = None,
+            verdicts_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+            interval_ms: Optional[int] = None,
+            start: bool = True) -> Timeline:
+    """Install (replacing any prior) the engine-wide timeline. The REST
+    server installs one over its own /metrics render at boot."""
+    global _timeline
+    with _install_lock:
+        if _timeline is not None:
+            _timeline.stop()
+        _timeline = Timeline(scrape_fn, base_dir=base_dir,
+                             verdicts_fn=verdicts_fn,
+                             interval_ms=interval_ms)
+        tl = _timeline
+    if start:
+        tl.start()
+    return tl
+
+
+def timeline() -> Optional[Timeline]:
+    return _timeline
+
+
+def note_event(ev: Dict[str, Any]) -> None:
+    """Flight-recorder mirror hook — a no-op until install()."""
+    tl = _timeline
+    if tl is None:
+        return
+    try:
+        tl.note_event(ev)
+    except Exception:
+        pass  # telemetry must never take down a producer
+
+
+def dying_gasp() -> None:
+    tl = _timeline
+    if tl is not None:
+        tl.dying_gasp()
+
+
+def render_prometheus(out: List[str], esc) -> None:
+    tl = _timeline
+    if tl is None:
+        return
+    st = tl.stats()
+    out.append("# TYPE kuiper_timeline_segments gauge")
+    out.append("# HELP kuiper_timeline_segments on-disk telemetry "
+               "segments in the timeline ring")
+    out.append(f"kuiper_timeline_segments {st['segments']}")
+    out.append("# TYPE kuiper_timeline_bytes gauge")
+    out.append("# HELP kuiper_timeline_bytes total bytes of the on-disk "
+               "timeline ring")
+    out.append(f"kuiper_timeline_bytes {st['bytes']}")
+    out.append("# TYPE kuiper_timeline_snapshots_total counter")
+    out.append("# HELP kuiper_timeline_snapshots_total snapshots appended "
+               "since install")
+    out.append(f"kuiper_timeline_snapshots_total {st['snapshots']}")
+
+
+def reset() -> None:
+    """Test hook: stop and drop the installed timeline."""
+    global _timeline
+    with _install_lock:
+        if _timeline is not None:
+            _timeline.stop()
+        _timeline = None
+
+
+atexit.register(dying_gasp)

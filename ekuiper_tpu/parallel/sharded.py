@@ -111,6 +111,17 @@ class ShardedGroupBy(DeviceGroupBy):
         from ..utils.rulelog import current_rule
 
         _registry.register(self, current_rule())
+        # retired-kernel rollup (the devwatch retire_dead discipline):
+        # when this kernel is collected — rule dropped, or replaced by a
+        # restore onto a different mesh — its accrued per-shard rows fold
+        # into the module counters so kuiper_shard_rows_total stays
+        # monotonic across 8->1->8 restore cycles. The finalize captures
+        # shard_rows itself (note_rows mutates it in place), so the
+        # callback always sees the final counts.
+        import weakref as _weakref
+
+        _weakref.finalize(
+            self, _note_retired, _gen[0], current_rule(), self.shard_rows)
 
     def _put(self, arr, sharding):
         """Host→mesh placement that also works when the mesh spans
@@ -672,13 +683,64 @@ class ShardedGroupBy(DeviceGroupBy):
             })
         return out
 
+    def collective_bytes_per_fold(self) -> int:
+        """Estimated cross-chip bytes ONE fold step moves per chip: the
+        psum/pmin/pmax merge over the "rows" axis reduces each chip's
+        (n_panes, capacity/K, k) component partials, which a ring
+        all-reduce ships as ~2*(R-1)/R of the slice bytes. R == 1 meshes
+        fold with no collective at all (key-sharded state is chip-local),
+        so the estimate is exactly 0 there. Wide sketch components carry
+        their trailing dim. Host math only — meshwatch's
+        collective-vs-compute split divides this by the ICI bandwidth
+        class to price kernwatch's sampled device time."""
+        R = self.n_row_shards
+        if R <= 1:
+            return 0
+        from ..ops.groupby import _wide_size
+
+        K = max(self.n_keys_shards, 1)
+        cap_per_shard = max(self.capacity // K, 1)
+        elems = self.n_panes * cap_per_shard  # the "act" activity mask
+        for comp, spec_idxs in self.comp_specs.items():
+            w = _wide_size(comp) if comp in WIDE_COMPONENTS else 1
+            elems += self.n_panes * cap_per_shard * len(spec_idxs) * w
+        return int(2 * (R - 1) / R * elems * 4)  # float32 partials
+
 
 # ----------------------------------------------------------- shard registry
 # weakref index of live sharded kernels for the kuiper_shard_* families
 # (utils/weakreg.py — THE shared ownership model, also tierstore's)
+import threading as _threading
+
 from ..utils.weakreg import WeakRegistry as _Registry
 
 _registry = _Registry()
+
+# rows rolled up from collected kernels, keyed (rule, shard). The
+# generation counter guards against finalizers from a previous test
+# epoch landing after reset() — a late GC must not resurrect counts.
+_retired_lock = _threading.Lock()
+_retired_rows: Dict[Tuple[str, int], int] = {}
+_gen = [0]
+
+
+def _note_retired(gen: int, rule: Optional[str], shard_rows) -> None:
+    """weakref.finalize callback — fold a dead kernel's shard rows into
+    the module rollup (GC thread; keep it lock-tight and exception-free)."""
+    with _retired_lock:
+        if gen != _gen[0]:
+            return
+        label = rule or "__engine__"
+        for i, n in enumerate(shard_rows):
+            if n:
+                key = (label, i)
+                _retired_rows[key] = _retired_rows.get(key, 0) + int(n)
+
+
+def retired_rows() -> Dict[Tuple[str, int], int]:
+    """Snapshot of the retired-kernel rollup ((rule, shard) -> rows)."""
+    with _retired_lock:
+        return dict(_retired_rows)
 
 
 def registry() -> _Registry:
@@ -688,6 +750,9 @@ def registry() -> _Registry:
 def reset() -> None:
     """Test hook."""
     _registry.clear()
+    with _retired_lock:
+        _gen[0] += 1
+        _retired_rows.clear()
 
 
 def render_prometheus(out: List[str], esc) -> None:
@@ -705,8 +770,12 @@ def render_prometheus(out: List[str], esc) -> None:
         out.append(f"# TYPE {name} {mtype}")
         out.append(f"# HELP {name} {help_txt}")
         # aggregate per (rule, shard) label pair: duplicate sample lines
-        # would fail the whole Prometheus scrape
+        # would fail the whole Prometheus scrape. The rows counter seeds
+        # from the retired-kernel rollup so it never regresses when a
+        # restore replaces the kernel.
         agg: Dict[Tuple[str, int], int] = {}
+        if name == "kuiper_shard_rows_total":
+            agg.update(retired_rows())
         for kernel, rule in kernels:
             label = rule or "__engine__"
             try:

@@ -1303,6 +1303,28 @@ def explain(rule: RuleDef, store) -> Dict[str, Any]:
             out["shards"] = info
         except Exception as exc:  # explain must never fail on the probe
             out["shards"] = {"mode": "unknown", "reason": str(exc)}
+    # mesh section (fleet observatory): LIVE skew + rebalance-hint state
+    # for a rule already serving sharded — read-only off meshwatch and
+    # the installed controller, never building a mesh (explain stays a
+    # probe; the signal feeds ROADMAP item 2's rebalancer)
+    if (out.get("shards") or {}).get("mode") == "sharded":
+        try:
+            from ..observability import meshwatch
+            from ..runtime import control as _control
+
+            mesh_info: Dict[str, Any] = {
+                "skew": meshwatch.rule_skew(rule.id),
+                "threshold": meshwatch.skew_threshold(),
+            }
+            ctl = _control.controller()
+            if ctl is not None:
+                ctl_mesh = ctl._mesh_diagnostics()
+                mesh_info["hint"] = ctl_mesh["rules"].get(rule.id)
+                mesh_info["rebalance_hints_total"] = (
+                    ctl_mesh["rebalance_hints_total"])
+            out["mesh"] = mesh_info
+        except Exception as exc:  # explain must never fail on the probe
+            out["mesh"] = {"error": str(exc)}
     # sliding section (ISSUE 15 satellite): which sliding implementation
     # this plan takes and WHY a DABA request falls back to the exact
     # refold — the mesh ring is future work, so a sharded plan's refold

@@ -564,7 +564,7 @@ class _RuleCtl:
 
     __slots__ = ("shed_level", "breach_run", "clear_run", "qos_class",
                  "shed_rows_seen", "autosize_cool", "orig_sizes",
-                 "missing_runs")
+                 "missing_runs", "skew_run", "hint_active")
 
     def __init__(self) -> None:
         self.shed_level = 0
@@ -575,6 +575,10 @@ class _RuleCtl:
         self.autosize_cool = 0
         self.orig_sizes: Dict[str, Dict[str, int]] = {}
         self.missing_runs = 0
+        # mesh skew hysteresis (observability/meshwatch.py): consecutive
+        # skewed ticks, and whether a rebalance_hint is currently open
+        self.skew_run = 0
+        self.hint_active = False
 
 
 class QoSController:
@@ -623,6 +627,8 @@ class QoSController:
         # autosize accounting
         self.autosize_events = 0
         self._autosize_log: deque = deque(maxlen=64)
+        # mesh skew accounting: rebalance_hint events raised (lifetime)
+        self._rebalance_hints = 0
 
     # -------------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -1039,6 +1045,46 @@ class QoSController:
             if auto:
                 tr.autosize_cool = 3  # cooldown: one action per ~3 ticks
                 act["autosize"] = auto
+
+        # ---- mesh skew -> rebalance_hint (signal only: actually moving
+        # key ranges is ROADMAP item 2's rebalancer; this gives it — and
+        # the operator — the structured trigger). Shed-ladder-style
+        # hysteresis: a hint opens after up_ticks consecutive skewed
+        # observations, closes once the run drains back to zero (one
+        # step per clear tick), and never re-fires while open.
+        mesh = ((verdict or {}).get("bottleneck") or {}).get("mesh")
+        if mesh is not None and mesh.get("skewed"):
+            tr.skew_run += 1
+            if tr.skew_run >= self.up_ticks and not tr.hint_active:
+                tr.hint_active = True
+                self._rebalance_hints += 1
+                from .events import recorder
+
+                recorder().record(
+                    "rebalance_hint", rule=rid, severity="warn", ts_ms=now,
+                    skew_ratio=mesh.get("skew_ratio"),
+                    hot_shard=mesh.get("hot_shard"),
+                    mesh=mesh.get("mesh"),
+                    shard_loads=self.shard_loads())
+                logger.warning(
+                    "rule %s: mesh skew %.2fx on shard %s (mesh %s) — "
+                    "rebalance hint raised", rid,
+                    mesh.get("skew_ratio") or 0.0,
+                    mesh.get("hot_shard"), mesh.get("mesh"))
+                act["rebalance_hint"] = {
+                    "skew_ratio": mesh.get("skew_ratio"),
+                    "hot_shard": mesh.get("hot_shard"),
+                }
+        else:
+            if tr.skew_run >= 1:
+                tr.skew_run -= 1
+            if tr.skew_run == 0 and tr.hint_active:
+                tr.hint_active = False
+                from .events import recorder
+
+                recorder().record(
+                    "rebalance_hint", rule=rid, severity="info", ts_ms=now,
+                    cleared=True)
         return act
 
     def _autosize_rule(self, rid: str, topo: Any, tr: _RuleCtl,
@@ -1163,6 +1209,32 @@ class QoSController:
                 "events": self.autosize_events,
                 "recent": autosize_recent,
             },
+            "mesh": self._mesh_diagnostics(),
+        }
+
+    def _mesh_diagnostics(self) -> Dict[str, Any]:
+        """Controller-side mesh view: skew/hint hysteresis per rule plus
+        the meshwatch skew report — the "mesh" section of
+        /diagnostics/control and the explain "mesh" detail's hint state."""
+        from ..observability import meshwatch
+
+        with self._lock:
+            rules = {
+                rid: {"skew_run": tr.skew_run,
+                      "hint_active": tr.hint_active}
+                for rid, tr in self._tracks.items()
+                if tr.skew_run or tr.hint_active
+            }
+            hints = self._rebalance_hints
+        try:
+            skew = meshwatch.skew_report()
+        except Exception:
+            skew = {}
+        return {
+            "rebalance_hints_total": hints,
+            "rules": rules,
+            "skew": skew,
+            "threshold": meshwatch.skew_threshold(),
         }
 
 

@@ -78,14 +78,25 @@ class ErrorEvent:
 
 
 class FlightRecorder:
-    """Bounded ring of engine events, oldest evicted first."""
+    """Bounded ring of engine events, oldest evicted first. Capacity
+    defaults from `KUIPER_EVENTS_RING` (read at construction — the
+    singleton below picks it up at import, tests construct their own);
+    the durable trail beyond the ring is the telemetry timeline
+    (observability/timeline.py), which `record()` mirrors into."""
 
     DEFAULT_CAPACITY = 1024
 
-    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+    def __init__(self, capacity: Optional[int] = None) -> None:
         from collections import deque
 
-        self.capacity = int(capacity)
+        if capacity is None:
+            import os
+
+            try:
+                capacity = int(os.environ.get("KUIPER_EVENTS_RING", ""))
+            except (TypeError, ValueError):
+                capacity = self.DEFAULT_CAPACITY
+        self.capacity = max(int(capacity), 1)
         self._ring: "deque" = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
         self._seq = 0  # total ever recorded (monotonic event id)
@@ -114,6 +125,13 @@ class FlightRecorder:
             self._seq += 1
             ev["seq"] = self._seq
             self._ring.append(ev)
+        # mirror into the durable timeline AFTER the ring lock releases
+        # (the timeline takes its own lock + does file I/O — neither
+        # belongs under this ring's short lock, and callers may already
+        # hold evaluator/controller locks above us)
+        from ..observability import timeline as _timeline
+
+        _timeline.note_event(ev)
 
     def events(self, kind: Optional[str] = None,
                rule: Optional[str] = None,

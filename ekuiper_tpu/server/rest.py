@@ -207,6 +207,13 @@ class RestApi:
         # host spill arena (ops/tierstore.py)
         r("GET", r"^/diagnostics/tier$",
           lambda m: self.diagnostics_tier())
+        # fleet observatory: mesh skew/collective attribution
+        # (observability/meshwatch.py) and the durable telemetry
+        # timeline's replay (observability/timeline.py)
+        r("GET", r"^/diagnostics/mesh$",
+          lambda m: self.diagnostics_mesh())
+        r("GET", r"^/diagnostics/timeline$",
+          lambda m, query=None: self.diagnostics_timeline(query or {}))
         r("POST", r"^/rules/(?P<id>[^/]+)/trace/start$",
           lambda m, body=None: self._tracer().enable(
               m["id"], (body or {}).get("strategy", "always"))
@@ -274,6 +281,15 @@ class RestApi:
             self._health_rules, start_fn=self.rules.start,
             unqueue_fn=lambda rid: self.store.kv(
                 "admission_queue").delete(rid))
+        # durable telemetry timeline: periodic delta-encoded snapshots of
+        # the full /metrics render + health verdicts into on-disk
+        # segments under the store path (observability/timeline.py); the
+        # flight recorder mirrors events in as they happen
+        from ..observability import timeline as _timeline
+
+        self.timeline = _timeline.install(
+            scrape_fn=lambda: str(self.prometheus_metrics()),
+            verdicts_fn=lambda: self.health_evaluator.verdicts())
 
     # ----------------------------------------------------- data import/export
     def data_import(self, m, body: Optional[dict] = None,
@@ -545,6 +561,51 @@ class RestApi:
 
         ctl = control.controller() or self.qos_controller
         return ctl.diagnostics()
+
+    @staticmethod
+    def diagnostics_mesh() -> Dict[str, Any]:
+        """GET /diagnostics/mesh — fleet observatory: per-rule shard skew
+        report + collective-vs-compute split (observability/meshwatch.py)
+        and the controller's rebalance-hint state."""
+        from ..observability import meshwatch
+        from ..runtime import control
+
+        out = meshwatch.diagnostics()
+        ctl = control.controller()
+        if ctl is not None:
+            out["control"] = ctl.diagnostics().get("mesh")
+        return out
+
+    def diagnostics_timeline(self, query: Dict[str, str]) -> Dict[str, Any]:
+        """GET /diagnostics/timeline?family=&rule=&since=&limit= — replay
+        the durable telemetry ring (observability/timeline.py): family
+        filters by series name (`kuiper_shard_*` prefix form allowed),
+        since by engine ms, limit keeps the newest n records."""
+        from ..observability import timeline as _timeline
+
+        tl = _timeline.timeline() or getattr(self, "timeline", None)
+        if tl is None:
+            raise EngineError("timeline not installed")
+        limit = 200
+        if query.get("limit"):
+            try:
+                limit = max(int(query["limit"]), 0)
+            except ValueError:
+                raise EngineError(f"invalid limit {query['limit']!r}")
+        since = None
+        if query.get("since"):
+            try:
+                since = max(int(query["since"]), 0)
+            except ValueError:
+                raise EngineError(f"invalid since {query['since']!r}")
+        out = tl.query(family=query.get("family") or None,
+                       rule=query.get("rule") or None,
+                       since=since, limit=limit)
+        if query.get("dump") in ("1", "true"):
+            # kuiperdiag --timeline: pack the raw segments (bounded) so
+            # the bundle carries the replayable ring, not just a query
+            out["segment_dump"] = tl.segment_dump()
+        return out
 
     @staticmethod
     def diagnostics_tier() -> Dict[str, Any]:

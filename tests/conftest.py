@@ -75,6 +75,10 @@ def fresh_engine_state():
     from ekuiper_tpu.parallel import sharded
 
     sharded.reset()
+    from ekuiper_tpu.observability import meshwatch, timeline
+
+    meshwatch.reset()
+    timeline.reset()
     from ekuiper_tpu.runtime import aotcache
 
     aotcache.reset()

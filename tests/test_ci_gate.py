@@ -25,8 +25,8 @@ def test_full_gate_green_with_json_verdict():
     names = {g["gate"] for g in verdict["gates"]}
     assert names == {"kuiperlint", "jitcert_certify", "jitcert_diff",
                      "probe_exprs", "probe_tiering", "probe_multichip",
-                     "probe_joins", "check_metrics", "benchdiff_smoke",
-                     "cold_start"}
+                     "probe_joins", "probe_fleetobs", "check_metrics",
+                     "benchdiff_smoke", "cold_start"}
     assert all(g["ok"] and g["returncode"] == 0
                for g in verdict["gates"])
 
@@ -35,7 +35,7 @@ def test_skip_and_unknown_gate():
     proc = _run("--json", "--skip",
                 "jitcert_diff,benchdiff_smoke,check_metrics,kuiperlint,"
                 "probe_exprs,probe_tiering,probe_multichip,probe_joins,"
-                "cold_start")
+                "probe_fleetobs,cold_start")
     assert proc.returncode == 0
     verdict = json.loads(proc.stdout)
     assert [g["gate"] for g in verdict["gates"]] == ["jitcert_certify"]

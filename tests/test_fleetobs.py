@@ -1,0 +1,404 @@
+"""Fleet observatory (meshwatch + durable timeline): shard-skew
+attribution and the rebalance-hint hysteresis, the collective split's
+bounds, delta-encoded timeline segments with hard-kill recovery and
+byte/age retention, the flight-recorder mirror, and the REST handlers.
+All CPU — fake sharded kernels stand in for the mesh; the real 8-device
+integration lives in tools/probe_fleetobs.py and
+tests/test_multichip_serving.py."""
+import json
+import os
+import types
+
+import pytest
+
+from ekuiper_tpu.observability import health, meshwatch
+from ekuiper_tpu.observability import timeline as tmod
+from ekuiper_tpu.parallel import sharded as sharded_mod
+from ekuiper_tpu.runtime import control
+from ekuiper_tpu.runtime.events import FlightRecorder, recorder
+from ekuiper_tpu.utils import timex
+
+
+class FakeSharded:
+    """Just enough surface for the observatory: mutable per-shard rows,
+    a mesh tag, and a collective payload estimate."""
+
+    def __init__(self, rows, mesh_tag="2x4", bytes_per_fold=256):
+        self.rows = list(rows)
+        self.keys = [max(r // 10, 1) for r in self.rows]
+        self.mesh_tag = mesh_tag
+        self._bpf = bytes_per_fold
+        self.capacity = 64
+
+    def shard_stats(self):
+        return [{"shard": i, "rows": r, "keys": k, "slots": 32,
+                 "state_bytes": 128}
+                for i, (r, k) in enumerate(zip(self.rows, self.keys))]
+
+    def collective_bytes_per_fold(self):
+        return self._bpf
+
+
+def _register(kernel, rule):
+    sharded_mod.registry().register(kernel, rule)
+    return kernel
+
+
+# ---------------------------------------------------------------- meshwatch
+class TestMeshWatch:
+    def test_skew_flagged_above_threshold(self, mock_clock):
+        k = _register(FakeSharded([800, 100, 50, 50]), "r_hot")
+        rep = meshwatch.observe()
+        e = rep["r_hot"]
+        assert e["skewed"] and e["hot_shard"] == 0
+        assert e["skew_ratio"] == pytest.approx(800 / 250.0)
+        assert e["mesh"] == "2x4"
+        assert len(e["shards"]) == 4
+        del k
+
+    def test_uniform_not_flagged(self, mock_clock):
+        k = _register(FakeSharded([260, 250, 240, 255]), "r_flat")
+        e = meshwatch.observe()["r_flat"]
+        assert not e["skewed"]
+        assert e["skew_ratio"] < meshwatch.skew_threshold()
+        del k
+
+    def test_quiet_window_carries_prior_skew(self, mock_clock):
+        k = _register(FakeSharded([900, 60, 20, 20]), "r_carry")
+        first = meshwatch.observe()["r_carry"]
+        assert first["skewed"]
+        # no new rows: the delta window is 0 < min_rows — a quiet
+        # interval is NOT evidence the imbalance cleared
+        mock_clock.advance(1000)
+        second = meshwatch.observe()["r_carry"]
+        assert second["skewed"]
+        assert second["skew_ratio"] == pytest.approx(first["skew_ratio"])
+        del k
+
+    def test_window_delta_and_rebaseline(self, mock_clock):
+        k = _register(FakeSharded([250, 250, 250, 250]), "r_delta")
+        assert not meshwatch.observe()["r_delta"]["skewed"]
+        # the NEXT window is skewed even though cumulative looks flat
+        k.rows = [1250, 270, 260, 260]
+        mock_clock.advance(1000)
+        e = meshwatch.observe()["r_delta"]
+        assert e["skewed"] and e["window_rows"] == 1040
+        # restore drops the counters: negative delta re-baselines off
+        # the fresh cumulative instead of going negative
+        k.rows = [400, 10, 0, 0]
+        mock_clock.advance(1000)
+        e = meshwatch.observe()["r_delta"]
+        assert e["window_rows"] == 410
+        del k
+
+    def test_threshold_env_override(self, mock_clock, monkeypatch):
+        monkeypatch.setenv("KUIPER_MESH_SKEW_THRESHOLD", "10.0")
+        meshwatch.reset()
+        k = _register(FakeSharded([800, 100, 50, 50]), "r_env")
+        e = meshwatch.observe()["r_env"]
+        assert e["skew_ratio"] > 3 and not e["skewed"]
+        del k
+
+    def test_collective_split_bounded_by_device_time(self, mock_clock):
+        from ekuiper_tpu.observability import devwatch
+
+        k = _register(FakeSharded([300, 300], bytes_per_fold=10 ** 9),
+                      "r_coll")
+        meshwatch.observe()  # primes the bytes cache off the kernel
+        site = devwatch.registry().register("sharded.fold_step", "r_coll")
+        site.kern.record_sample(dispatch_us=10.0, total_us=500.0)
+        split = meshwatch.collective_split()
+        v = split[("sharded.fold_step", "r_coll")]
+        # an absurd payload must clamp to the sampled device time, and
+        # the share can never exceed 1.0
+        assert v["collective_us"] == pytest.approx(v["device_us"])
+        assert 0.0 <= v["share"] <= 1.0
+        assert v["compute_us"] == pytest.approx(0.0)
+        devwatch.registry().clear()
+        del k
+
+    def test_render_families(self, mock_clock):
+        from ekuiper_tpu.observability import devwatch
+
+        k = _register(FakeSharded([700, 100]), "r_render")
+        meshwatch.observe()
+        mock_clock.advance(1000)
+        k.rows = [1400, 200]
+        meshwatch.observe()  # second pass -> rows/s EWMA has a rate
+        site = devwatch.registry().register("sharded.fold_step",
+                                            "r_render")
+        site.kern.record_sample(dispatch_us=5.0, total_us=100.0)
+        out: list = []
+        meshwatch.render_prometheus(out, lambda s: s)
+        text = "\n".join(out)
+        assert 'kuiper_mesh_skew_ratio{rule="r_render"}' in text
+        assert 'kuiper_mesh_shard_rows_per_s{rule="r_render",shard="0"}' \
+            in text
+        assert "kuiper_mesh_collective_ms" in text
+        assert "kuiper_mesh_collective_share" in text
+        devwatch.registry().clear()
+        del k
+
+
+# ------------------------------------------------- health + control wiring
+class TestSkewVerdictAndHint:
+    def _tick_both(self, hv, ctl, clock, n):
+        for _ in range(n):
+            hv.tick()
+            ctl.tick()
+            clock.advance(1000)
+
+    def test_shard_skew_verdict_and_single_hint(self, mock_clock):
+        k = _register(FakeSharded([800, 100, 50, 50]), "r_skew")
+        stub = types.SimpleNamespace()
+        triples = [("r_skew", stub, {})]
+        hv = health.install(lambda: triples, start=False)
+        ctl = control.install(lambda: triples, start=False,
+                              verdicts_fn=lambda: hv.verdicts())
+        self._tick_both(hv, ctl, mock_clock, ctl.up_ticks + 2)
+        v = hv.verdicts()["r_skew"]
+        bn = v["bottleneck"]
+        assert bn["stage"] == "shard_skew"
+        assert bn["node"] == "shard:0"
+        assert bn["mesh"]["skewed"] and bn["mesh"]["hot_shard"] == 0
+        # hysteresis: exactly ONE warn hint however long the skew holds
+        hints = recorder().events(kind="rebalance_hint")
+        assert len(hints) == 1
+        assert hints[0]["severity"] == "warn"
+        assert hints[0]["rule"] == "r_skew"
+        assert hints[0]["skew_ratio"] > 2
+        md = ctl.diagnostics()["mesh"]
+        assert md["rebalance_hints_total"] == 1
+        assert md["rules"]["r_skew"]["hint_active"]
+
+        # drain: balanced windows clear the run and emit ONE info event
+        mock_clock.advance(1000)
+        k.rows = [r + 500 for r in k.rows]  # uniform delta
+        self._tick_both(hv, ctl, mock_clock, ctl.up_ticks + 2)
+        evs = recorder().events(kind="rebalance_hint")
+        cleared = [e for e in evs if e.get("cleared")]
+        assert len(cleared) == 1 and cleared[0]["severity"] == "info"
+        # a fully drained rule is pruned from the hysteresis view
+        assert "r_skew" not in ctl.diagnostics()["mesh"]["rules"]
+        del k
+
+    def test_uniform_rule_never_hints(self, mock_clock):
+        k = _register(FakeSharded([300, 280, 290, 310]), "r_ok")
+        stub = types.SimpleNamespace()
+        triples = [("r_ok", stub, {})]
+        hv = health.install(lambda: triples, start=False)
+        ctl = control.install(lambda: triples, start=False,
+                              verdicts_fn=lambda: hv.verdicts())
+        self._tick_both(hv, ctl, mock_clock, 4)
+        bn = hv.verdicts()["r_ok"]["bottleneck"]
+        assert bn.get("stage") != "shard_skew"
+        assert bn["mesh"]["skewed"] is False  # detail present, signal off
+        assert recorder().events(kind="rebalance_hint") == []
+        del k
+
+    def test_explain_mesh_section(self, mock_clock, monkeypatch):
+        from ekuiper_tpu.planner.planner import RuleDef, explain
+        from ekuiper_tpu.store import kv
+
+        monkeypatch.setenv("KUIPER_MESH", "2x4")
+        k = _register(FakeSharded([800, 100, 50, 50]), "exp_rule")
+        meshwatch.observe()
+        out = explain(RuleDef(
+            id="exp_rule",
+            sql=("SELECT k, count(*) AS c FROM d "
+                 "GROUP BY k, TUMBLINGWINDOW(ss, 10)"),
+            options={"planOptimizeStrategy": {"shards": "auto"}}),
+            kv.get_store())
+        assert out["shards"]["mode"] == "sharded"
+        mesh = out.get("mesh")
+        assert mesh is not None
+        assert mesh["skew"]["skewed"]
+        assert mesh["threshold"] == meshwatch.skew_threshold()
+        del k
+
+
+# ----------------------------------------------------------------- timeline
+class TestTimeline:
+    def _mk(self, tmp_path, scrape, **kw):
+        return tmod.Timeline(scrape, base_dir=str(tmp_path / "tl"),
+                             interval_ms=0, **kw)
+
+    def test_delta_encoding_and_replay(self, tmp_path, mock_clock):
+        vals = {"a": 1, "b": 2}
+
+        def scrape():
+            return "".join(f"kuiper_x_{k} {v}\n" for k, v in vals.items())
+
+        tl = self._mk(tmp_path, scrape)
+        r1 = tl.snapshot()
+        assert r1["full"] and r1["d"] == {"kuiper_x_a": 1, "kuiper_x_b": 2}
+        mock_clock.advance(1000)
+        vals["a"] = 5
+        r2 = tl.snapshot()
+        assert "full" not in r2 and r2["d"] == {"kuiper_x_a": 5}
+        mock_clock.advance(1000)
+        del vals["b"]
+        r3 = tl.snapshot()
+        assert r3["x"] == ["kuiper_x_b"]
+        q = tl.query(family="kuiper_x_a")
+        assert [r["series"]["kuiper_x_a"] for r in q["records"]] == [1, 5]
+
+    def test_query_filters(self, tmp_path, mock_clock):
+        tl = self._mk(
+            tmp_path, lambda:
+            'kuiper_shard_rows_total{rule="r1",shard="0"} 5\n'
+            'kuiper_shard_keys{rule="r2",shard="1"} 3\n'
+            "kuiper_uptime_seconds 1\n")
+        tl.snapshot()
+        tl.note_event({"kind": "rebalance_hint", "rule": "r1",
+                       "ts_ms": timex.now_ms()})
+        # exact family, prefix family, rule, since, limit
+        assert tl.query(family="kuiper_uptime_seconds")["returned"] == 1
+        pre = tl.query(family="kuiper_shard_*")["records"]
+        assert len(pre[0]["series"]) == 2
+        by_rule = tl.query(family="kuiper_shard_*", rule="r2")["records"]
+        assert list(by_rule[0]["series"]) == \
+            ['kuiper_shard_keys{rule="r2",shard="1"}']
+        ev = tl.query(family="events", rule="r1")["records"]
+        assert ev and ev[-1]["event"]["kind"] == "rebalance_hint"
+        assert tl.query(since=timex.now_ms())["returned"] == 0
+        mock_clock.advance(10)
+        tl.snapshot()
+        assert tl.query(limit=1)["returned"] == 1
+
+    def test_hard_kill_recovery_appends(self, tmp_path, mock_clock):
+        beat = [0]
+
+        def scrape():
+            beat[0] += 1
+            return f"kuiper_beat {beat[0]}\n"
+
+        tl = self._mk(tmp_path, scrape)
+        tl.snapshot()
+        mock_clock.advance(5)
+        tl.snapshot()
+        # hard kill: no stop(), no gasp — a fresh instance over the same
+        # dir resumes the segment sequence past the dead one's tail
+        tl2 = self._mk(tmp_path, scrape)
+        tl2.snapshot()
+        q = tl2.query(family="kuiper_beat")
+        assert [r["series"]["kuiper_beat"] for r in q["records"]] == \
+            [1, 2, 3]
+        names = sorted(os.listdir(tl2.dir))
+        assert len(names) == len(set(names))
+
+    def test_torn_tail_line_skipped(self, tmp_path, mock_clock):
+        tl = self._mk(tmp_path, lambda: "kuiper_beat 1\n")
+        tl.snapshot()
+        with open(tl._fh_path, "a") as fh:  # simulated mid-write kill
+            fh.write('{"t": 99, "k": "snap", "d": {"kuiper_be')
+        tl2 = self._mk(tmp_path, lambda: "kuiper_beat 2\n")
+        assert tl2.query(family="kuiper_beat")["returned"] == 1
+
+    def test_byte_cap_retention(self, tmp_path, mock_clock):
+        n = [0]
+
+        def scrape():
+            n[0] += 1
+            return f"kuiper_beat {n[0]}\n"
+
+        tl = self._mk(tmp_path, scrape)
+        tl.seg_bytes, tl.max_bytes = 256, 1024
+        for _ in range(100):
+            mock_clock.advance(100)
+            tl.snapshot()
+        st = tl.stats()
+        assert st["bytes"] <= tl.max_bytes + tl.seg_bytes
+        assert st["segments"] >= 2
+        q = tl.query(family="kuiper_beat")
+        assert q["returned"] > 0  # the live tail survives
+        # oldest records were truly deleted, newest kept
+        assert q["records"][-1]["series"]["kuiper_beat"] == 100
+
+    def test_age_cap_retention(self, tmp_path, mock_clock):
+        # non-zero start: a segment stamped t0=0 is indistinguishable
+        # from a foreign file and exempt from the age cap
+        mock_clock.advance(1000)
+        tl = self._mk(tmp_path, lambda: f"kuiper_t {timex.now_ms()}\n")
+        tl.seg_bytes = 1  # rotate on every write
+        tl.max_age_ms = 5000
+        tl.snapshot()
+        mock_clock.advance(60_000)
+        tl.snapshot()
+        mock_clock.advance(10)
+        tl.snapshot()
+        q = tl.query(family="kuiper_t")
+        assert all(r["t"] >= 61_000 for r in q["records"])
+
+    def test_dying_gasp_forces_full_and_is_once(self, tmp_path,
+                                                mock_clock):
+        tl = self._mk(tmp_path, lambda: "kuiper_beat 1\n")
+        tl.snapshot()
+        mock_clock.advance(5)
+        snaps_before = tl.snapshots
+        tl.dying_gasp()
+        assert tl.snapshots == snaps_before + 1
+        tl.dying_gasp()  # double-gasp is a no-op
+        assert tl.snapshots == snaps_before + 1
+        recs = tl.query(family="kuiper_beat")["records"]
+        assert recs[-1].get("full")
+
+    def test_recorder_mirror_and_env_capacity(self, tmp_path, mock_clock,
+                                              monkeypatch):
+        monkeypatch.setenv("KUIPER_EVENTS_RING", "5")
+        ring = FlightRecorder()
+        assert ring.capacity == 5
+        for i in range(9):
+            ring.record(f"k{i}", rule="r")
+        assert len(ring.events()) == 5
+        monkeypatch.setenv("KUIPER_EVENTS_RING", "not-a-number")
+        assert FlightRecorder().capacity == \
+            FlightRecorder.DEFAULT_CAPACITY
+
+        # the installed singleton mirrors the GLOBAL recorder's events
+        tmod.install(scrape_fn=lambda: "", base_dir=str(tmp_path / "m"),
+                     interval_ms=0, start=False)
+        recorder().record("mirror_probe", rule="r9",
+                          ts_ms=timex.now_ms())
+        q = tmod.timeline().query(family="events", rule="r9")
+        assert q["returned"] == 1
+        assert q["records"][0]["event"]["kind"] == "mirror_probe"
+
+    def test_health_pseudo_series(self, tmp_path, mock_clock):
+        tl = self._mk(tmp_path, lambda: "kuiper_beat 1\n",
+                      verdicts_fn=lambda: {"r1": {"state": "breaching"}})
+        tl.snapshot()
+        q = tl.query(rule="r1")
+        assert q["records"][0]["series"]["health|r1"] == "breaching"
+
+
+# --------------------------------------------------------------------- REST
+class TestRestHandlers:
+    def test_diagnostics_mesh(self, mock_clock):
+        from ekuiper_tpu.server.rest import RestApi
+
+        k = _register(FakeSharded([900, 60, 20, 20]), "r_rest")
+        meshwatch.observe()
+        out = RestApi.diagnostics_mesh()
+        assert out["skew"]["r_rest"]["skewed"]
+        assert isinstance(out["collective"], list)
+        del k
+
+    def test_diagnostics_timeline(self, tmp_path, mock_clock):
+        from ekuiper_tpu.server.rest import EngineError, RestApi
+
+        stub = types.SimpleNamespace(timeline=None)
+        with pytest.raises(EngineError):
+            RestApi.diagnostics_timeline(stub, {})
+        tmod.install(scrape_fn=lambda: "kuiper_beat 1\n",
+                     base_dir=str(tmp_path / "r"), interval_ms=0,
+                     start=False)
+        tmod.timeline().snapshot()
+        out = RestApi.diagnostics_timeline(stub, {"limit": "10"})
+        assert out["returned"] == 1
+        dumped = RestApi.diagnostics_timeline(stub, {"dump": "1"})
+        assert dumped["segment_dump"]
+        with pytest.raises(EngineError):
+            RestApi.diagnostics_timeline(stub, {"since": "nope"})
+        # the bundle must stay one JSON document
+        json.dumps(dumped)

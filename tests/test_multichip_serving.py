@@ -462,3 +462,110 @@ class TestSlidingFallbackEvent:
         assert evs[-1]["reason"] == "sharded_kernel"
         assert evs[-1]["action"] == "refold"
         assert evs[-1]["requested"] == "daba"
+
+
+class TestShardMetricMonotonicity:
+    """Satellite (fleet observatory): `kuiper_shard_rows_total` must be
+    monotonic per (rule, shard) across kill/restore at a different shard
+    count — a retired kernel's rows roll into the module ledger
+    (parallel/sharded.py `retired_rows`) instead of vanishing from the
+    scrape when the weakref registry drops it."""
+
+    def _rows(self):
+        from ekuiper_tpu.parallel import sharded as sharded_mod
+
+        out: list = []
+        sharded_mod.render_prometheus(out, lambda s: s)
+        vals = {}
+        for line in out:
+            if line.startswith("kuiper_shard_rows_total{"):
+                labels, _, v = line.rpartition(" ")
+                vals[labels] = float(v)
+        return vals
+
+    @staticmethod
+    def _assert_monotonic(before, after):
+        for k, v in before.items():
+            assert after.get(k, -1.0) >= v, \
+                f"{k} regressed: {v} -> {after.get(k)}"
+
+    def test_rows_total_monotonic_8_1_8(self, eight_devices, mock_clock):
+        import gc
+
+        from ekuiper_tpu.utils.rulelog import set_rule_context
+
+        set_rule_context("mono_rule")
+        try:
+            node8, _ = _mk_node(make_mesh(rows=2, keys=4))
+        finally:
+            set_rule_context(None)
+        ids = [f"k{i}" for i in range(60)]
+        node8.process(_batch(ids, np.ones(60)))
+        t_live = self._rows()
+        assert sum(t_live.values()) >= 60
+        snap8 = node8.snapshot_state()
+
+        # kill the mesh kernel: its rows must survive via the rollup
+        del node8
+        gc.collect()
+        t_dead = self._rows()
+        self._assert_monotonic(t_live, t_dead)
+
+        # 8 -> 1: the single-chip interlude renders no NEW shard rows,
+        # but the retired totals must keep the scrape monotonic
+        single, _ = _mk_node(None)
+        single.restore_state(snap8)
+        single.process(_batch(ids[:30], np.ones(30)))
+        t_mid = self._rows()
+        self._assert_monotonic(t_dead, t_mid)
+        snap1 = single.snapshot_state()
+
+        # 1 -> 8: a fresh mesh kernel starts its live counters at zero —
+        # rendered = retired + live must never dip below the dead totals
+        set_rule_context("mono_rule")
+        try:
+            remesh, _ = _mk_node(make_mesh(rows=1, keys=8))
+        finally:
+            set_rule_context(None)
+        remesh.restore_state(snap1)
+        t_restored = self._rows()
+        self._assert_monotonic(t_mid, t_restored)
+        remesh.process(_batch(ids, np.ones(60)))
+        t_fed = self._rows()
+        self._assert_monotonic(t_restored, t_fed)
+        assert sum(t_fed.values()) > sum(t_dead.values())
+
+    def test_retired_rollup_and_reset(self, eight_devices, mock_clock):
+        import gc
+
+        from ekuiper_tpu.parallel import sharded as sharded_mod
+        from ekuiper_tpu.utils.rulelog import set_rule_context
+
+        sql = ("SELECT k, count(*) AS c FROM d "
+               "GROUP BY k, TUMBLINGWINDOW(ss, 10)")
+        plan = extract_kernel_plan(parse_select(sql))
+        set_rule_context("retire_rule")
+        try:
+            sgb = ShardedGroupBy(plan, make_mesh(rows=2, keys=4),
+                                 capacity=64, micro_batch=64)
+        finally:
+            set_rule_context(None)
+        kt = KeyTable(64)
+        slots, _ = kt.encode_column(
+            np.array([f"k{i}" for i in range(40)], dtype=np.object_))
+        sgb.fold(sgb.init_state(), {}, slots)
+        sgb.note_rows(slots, n_keys=kt.n_keys)
+        live_total = sum(s["rows"] for s in sgb.shard_stats())
+        assert live_total >= 40
+        del sgb
+        gc.collect()
+        retired = sharded_mod.retired_rows()
+        assert sum(v for (rule, _s), v in retired.items()
+                   if rule == "retire_rule") == live_total
+        # the render seeds its aggregation from the ledger
+        assert sum(self._rows().values()) >= live_total
+        # reset() (test isolation) clears the ledger and bumps the
+        # generation so in-flight finalizers of dead kernels can't
+        # resurrect stale rows afterwards
+        sharded_mod.reset()
+        assert sharded_mod.retired_rows() == {}

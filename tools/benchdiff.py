@@ -93,7 +93,11 @@ DEFAULT_TOLERANCE = 0.10
 #: flat-key suffixes where LOWER is better; everything else numeric that
 #: we compare is higher-better (throughput-shaped). Order matters only
 #: for readability — first suffix match wins.
-LOWER_IS_BETTER = ("_ms", "_us", "us_per_call", "_pct", "_bytes_peak")
+LOWER_IS_BETTER = ("_ms", "_us", "us_per_call", "_pct", "_bytes_peak",
+                   # fleet observatory (ISSUE 20), report-only — shard
+                   # imbalance and priced collective time should trend
+                   # down (observatory_overhead_pct rides the _pct rule)
+                   "skew_ratio", "collective_ms_p50")
 
 #: suffixes compared at all — a flat key must end in one of these (either
 #: direction) to be diffed; other numeric leaves (counts, booleans,

@@ -5,7 +5,11 @@ fails loudly when any emitted metric
 
   1. is not `kuiper_`-prefixed,
   2. lacks a `# TYPE` or `# HELP` header, or
-  3. is missing from the docs/OBSERVABILITY.md catalog.
+  3. is missing from the docs/OBSERVABILITY.md catalog,
+
+and — the reverse direction — when any family with a catalog row in
+docs/OBSERVABILITY.md fails to render a sample in the synthetic scrape
+(dead doc rows for renamed/removed metrics; see RENDER_EXEMPT).
 
 The synthetic registry exercises every family render() can emit: a rule
 with a staged + pooled node, a shared subtopo node, and a populated
@@ -26,6 +30,56 @@ DOCS = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "docs", "OBSERVABILITY.md")
 
 _SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{|\s)")
+
+
+#: catalog families the synthetic scrape legitimately cannot render —
+#: every entry must carry a reason; an undocumented reason is a lint bug
+RENDER_EXEMPT: dict = {}
+
+
+def catalog_families(docs_text: str) -> set:
+    """Families with a ROW in the docs/OBSERVABILITY.md catalog table
+    (`| \\`kuiper_...\\` | type | ...`) — prose mentions and label
+    examples do not count. This is the reverse lint's contract set."""
+    return set(re.findall(r"^\|\s*`(kuiper_[a-z0-9_]+)`", docs_text,
+                          re.MULTILINE))
+
+
+def rendered_families(text: str) -> set:
+    """Base family names with at least one sample line in a scrape."""
+    types = set()
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 3:
+                types.add(parts[2])
+    seen = set()
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name = m.group(1)
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                name = name[: -len(suffix)]
+                break
+        seen.add(name)
+    return seen
+
+
+def reverse_lint(text: str, docs_text: str) -> list:
+    """The catalog must stay honest in BOTH directions: every documented
+    family must actually render a sample in the synthetic scrape, or the
+    doc row is dead (a renamed/removed metric nobody pruned) and the
+    forward lint can never catch it."""
+    missing = catalog_families(docs_text) - rendered_families(text) \
+        - set(RENDER_EXEMPT)
+    return [f"{fam}: documented in docs/OBSERVABILITY.md but never "
+            "rendered by the synthetic scrape (dead catalog row, or the "
+            "synthetic registry lost its branch)"
+            for fam in sorted(missing)]
 
 
 def documented_families(docs_path: str = DOCS) -> set:
@@ -164,13 +218,40 @@ def _synthetic_scrape() -> str:
         capacity = 64
 
         def shard_stats(self):
-            return [{"shard": 0, "rows": 5, "keys": 3, "slots": 32,
+            # >= KUIPER_MESH_SKEW_MIN_ROWS total so the fleet
+            # observatory computes a skew ratio on the first observe
+            return [{"shard": 0, "rows": 300, "keys": 3, "slots": 32,
                      "state_bytes": 128},
-                    {"shard": 1, "rows": 2, "keys": 1, "slots": 32,
+                    {"shard": 1, "rows": 100, "keys": 1, "slots": 32,
                      "state_bytes": 128}]
 
+        def collective_bytes_per_fold(self):
+            return 192
+
     shard_kernel = FakeSharded()
-    sharded_mod.registry().register(shard_kernel)
+    sharded_mod.registry().register(shard_kernel, "lint_rule")
+    # fleet observatory (observability/meshwatch.py): one sampled
+    # sharded fold site + an observe pass so all four kuiper_mesh_*
+    # families render samples
+    from ekuiper_tpu.observability import meshwatch
+
+    meshwatch.reset()
+    mesh_site = devwatch.registry().register("sharded.fold_step",
+                                             "lint_rule")
+    mesh_site.kern.set_cost(flops=1e6, bytes_=1e6)
+    mesh_site.kern.record_sample(dispatch_us=10.0, total_us=500.0)
+    meshwatch.observe()
+    # durable telemetry timeline (observability/timeline.py): install
+    # over a throwaway dir + one snapshot so kuiper_timeline_* render
+    import shutil
+    import tempfile
+
+    from ekuiper_tpu.observability import timeline as timeline_mod
+
+    tl_dir = tempfile.mkdtemp(prefix="lint_timeline_")
+    tl = timeline_mod.install(scrape_fn=lambda: "kuiper_rule_status 1\n",
+                              base_dir=tl_dir, interval_ms=0)
+    tl.snapshot()
     # relational tier (ops/joinring.py / ops/segscan.py): one fake ring
     # and one fake scan kernel so the kuiper_join_* / kuiper_segscan_*
     # families all render samples
@@ -225,6 +306,9 @@ def _synthetic_scrape() -> str:
         sharded_mod.reset()
         joinring_mod.reset()
         segscan_mod.reset()
+        meshwatch.reset()
+        timeline_mod.reset()
+        shutil.rmtree(tl_dir, ignore_errors=True)
         del owner
         del tier_mgr
         del shard_kernel
@@ -288,7 +372,7 @@ def main() -> int:
         print(f"check_metrics: missing {DOCS}")
         return 1
     text = _synthetic_scrape()
-    errors = lint(text, docs_text)
+    errors = lint(text, docs_text) + reverse_lint(text, docs_text)
     if errors:
         print(f"check_metrics: {len(errors)} violation(s)")
         for e in errors:

@@ -53,6 +53,7 @@ GATES: Dict[str, List[str]] = {
     "probe_tiering": [sys.executable, "tools/probe_tiering.py"],
     "probe_multichip": [sys.executable, "tools/probe_multichip.py"],
     "probe_joins": [sys.executable, "tools/probe_joins.py"],
+    "probe_fleetobs": [sys.executable, "tools/probe_fleetobs.py"],
     "check_metrics": [sys.executable, "tools/check_metrics.py"],
     "benchdiff_smoke": [sys.executable, "tools/benchdiff.py", "--smoke"],
     "cold_start": [sys.executable, "-m", "tools.aot", "coldstart"],

@@ -12,6 +12,9 @@ engine remotely, into ONE self-contained JSON document:
   - XLA compile watcher state (/diagnostics/xla)
   - kernel observatory: sampled device-time split, XLA cost estimates
     and roofline utilization per jit site (/diagnostics/kernels)
+  - fleet observatory: per-rule shard-skew report + collective split
+    (/diagnostics/mesh) and the durable telemetry timeline
+    (/diagnostics/timeline; --timeline packs the raw ring segments)
   - the runtime config overlay (/configs)
 
 Usage:
@@ -48,7 +51,7 @@ Post = Callable[[str, dict], Tuple[int, Any]]
 #: sections (beyond per-rule detail) a valid bundle must carry
 REQUIRED_SECTIONS = ("server", "rules", "metrics", "events", "memory",
                      "xla", "kernels", "health", "control", "configs",
-                     "versions")
+                     "versions", "mesh", "timeline")
 
 
 def _versions() -> Dict[str, Any]:
@@ -70,12 +73,15 @@ def _versions() -> Dict[str, Any]:
 def collect(fetch: Fetch, events_limit: int = 1000,
             events_since: Optional[int] = None,
             profile_ms: int = 0, post: Optional[Post] = None,
-            profile_dir: Optional[str] = None) -> Dict[str, Any]:
+            profile_dir: Optional[str] = None,
+            timeline_dump: bool = False) -> Dict[str, Any]:
     """Assemble the bundle through `fetch(path) -> (status, payload)` —
     HTTP against a live server, or in-process dispatch for --smoke.
     `events_since` tails the flight-recorder ring incrementally (pass a
     prior bundle's `events.last_seq`); `profile_ms > 0` also triggers a
-    bounded profiler capture through `post` and records the result."""
+    bounded profiler capture through `post` and records the result;
+    `timeline_dump` packs the raw on-disk telemetry segments (bounded)
+    so the bundle carries the replayable ring, not just a query."""
 
     def get(path: str) -> Any:
         try:
@@ -116,6 +122,11 @@ def collect(fetch: Fetch, events_limit: int = 1000,
     bundle["kernels"] = get("/diagnostics/kernels")
     bundle["health"] = get("/diagnostics/health")
     bundle["control"] = get("/diagnostics/control")
+    bundle["mesh"] = get("/diagnostics/mesh")
+    tl_path = "/diagnostics/timeline?limit=100"
+    if timeline_dump:
+        tl_path += "&dump=1"
+    bundle["timeline"] = get(tl_path)
     bundle["configs"] = get("/configs")
     if profile_ms > 0 and post is not None:
         body = {"duration_ms": profile_ms}
@@ -250,9 +261,14 @@ def smoke() -> int:
 
         profile_dir = os.path.join(get_config().store.path, "profiles",
                                    f"ekdiag_smoke_{os.getpid()}")
+        # force one telemetry snapshot so the timeline section carries a
+        # real record even if the periodic timer has not fired yet
+        tl = getattr(api, "timeline", None)
+        if tl is not None:
+            tl.snapshot()
         bundle = collect(inproc_fetch(api), events_limit=100,
                          profile_ms=1000, post=inproc_post(api),
-                         profile_dir=profile_dir)
+                         profile_dir=profile_dir, timeline_dump=True)
         missing = [k for k in REQUIRED_SECTIONS
                    if not bundle.get(k)
                    or (isinstance(bundle[k], dict) and "error" in bundle[k])]
@@ -305,6 +321,26 @@ def smoke() -> int:
             problems.append("kernels.device.kind")
         if not isinstance(kern.get("sites"), list):
             problems.append("kernels.sites")
+        # fleet observatory: the mesh section must carry the skew report
+        # (empty dict on an unsharded engine — shape, not content) and
+        # the link-speed table lookup must have resolved
+        msh = bundle.get("mesh") or {}
+        if not isinstance(msh.get("skew"), dict):
+            problems.append("mesh.skew")
+        if not isinstance(msh.get("collective"), list):
+            problems.append("mesh.collective")
+        if not (msh.get("link_gbs") or 0) > 0:
+            problems.append("mesh.link_gbs")
+        # durable telemetry ring: the forced snapshot above must have
+        # landed on disk and replayed back through the query + dump
+        tls = bundle.get("timeline") or {}
+        if not tls.get("dir") or not isinstance(tls.get("segments"), int):
+            problems.append("timeline stats shape")
+        if not any(r.get("kind") == "snapshot"
+                   for r in tls.get("records") or []):
+            problems.append("timeline snapshot records")
+        if not tls.get("segment_dump"):
+            problems.append("timeline segment_dump")
         # incremental tailing: the recorded last_seq must tail cleanly
         last_seq = (bundle.get("events") or {}).get("last_seq")
         if not isinstance(last_seq, int) or last_seq <= 0:
@@ -358,6 +394,9 @@ def main() -> int:
                          "bundle directory")
     ap.add_argument("--profile-ms", type=int, default=1000,
                     help="profiler capture duration (server-capped)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="also pack the raw on-disk telemetry ring "
+                         "segments (bounded) into the bundle")
     ap.add_argument("--smoke", action="store_true",
                     help="in-process self-test (tier-1)")
     args = ap.parse_args()
@@ -377,7 +416,8 @@ def main() -> int:
         profile_ms=args.profile_ms if args.profile else 0,
         post=http_post(args.host, args.port,
                        timeout=max(args.profile_ms / 1000.0 + 30.0, 60.0))
-        if args.profile else None)
+        if args.profile else None,
+        timeline_dump=args.timeline)
     text = json.dumps(bundle, indent=2, default=str)
     if args.out == "-":
         print(text)

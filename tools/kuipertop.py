@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""kuipertop — live fleet console over a running engine's REST plane.
+
+`top` for the mesh: refreshes a one-screen fleet view off `/metrics`
+plus the `/diagnostics/{health,control,mesh}` views —
+
+- header: uptime, device kind, admission decisions, compile storms,
+  AOT serve-misses (the zero-compile-serving tripwire);
+- per-rule table: fold rows/s (delta between refreshes), health
+  verdict, fast-window SLO burn, shed level/rows, bottleneck stage;
+- mesh panel: per-shard load bars (rows/s EWMA from meshwatch) with
+  skew ratio + hot-shard marker per sharded rule, committed HBM per
+  placement shard, collective-vs-compute share of the sharded folds;
+- timeline footer: on-disk telemetry ring segments/bytes.
+
+Stdlib only (urllib + ANSI), same as every tool here. Usage:
+
+    python tools/kuipertop.py [--url http://127.0.0.1:9081]
+                              [--interval 2.0] [--once] [--no-color]
+
+`--once` paints a single frame without clearing the screen (smoke tests
+and `watch -n` users).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional, Tuple
+
+LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+Sample = Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]
+
+
+def fetch(url: str, timeout: float = 3.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
+def fetch_json(url: str, timeout: float = 3.0) -> Dict[str, Any]:
+    try:
+        return json.loads(fetch(url, timeout))
+    except (urllib.error.URLError, ValueError, OSError):
+        return {}
+
+
+def parse_metrics(text: str) -> Sample:
+    out: Sample = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        try:
+            v = float(val)
+        except ValueError:
+            continue
+        name, _, rest = key.partition("{")
+        labels = tuple(sorted(
+            (m.group(1), m.group(2)) for m in LABEL_RE.finditer(rest)))
+        out[(name, labels)] = v
+    return out
+
+
+def series(sample: Sample, name: str):
+    for (n, labels), v in sample.items():
+        if n == name:
+            yield dict(labels), v
+
+
+def total(sample: Sample, name: str) -> float:
+    return sum(v for _, v in series(sample, name))
+
+
+def bar(frac: float, width: int = 20) -> str:
+    frac = max(0.0, min(1.0, frac))
+    n = int(round(frac * width))
+    return "█" * n + "·" * (width - n)
+
+
+def fmt_rate(v: float) -> str:
+    if v >= 1e6:
+        return f"{v / 1e6:.1f}M"
+    if v >= 1e3:
+        return f"{v / 1e3:.1f}k"
+    return f"{v:.0f}"
+
+
+HEALTH_NAMES = {0: "healthy", 1: "DEGRADED", 2: "BREACHING"}
+
+
+class Console:
+    def __init__(self, url: str, color: bool = True) -> None:
+        self.url = url.rstrip("/")
+        self.color = color
+        self.prev: Optional[Sample] = None
+        self.prev_t: Optional[float] = None
+
+    def _c(self, code: str, s: str) -> str:
+        return f"\x1b[{code}m{s}\x1b[0m" if self.color else s
+
+    def _delta_rate(self, cur: Sample, name: str, dt: float,
+                    by: str = "rule") -> Dict[str, float]:
+        """Per-<by> rate of a counter between refreshes (0 on frame 1)."""
+        rates: Dict[str, float] = {}
+        if self.prev is None or dt <= 0:
+            return rates
+        for (n, labels), v in cur.items():
+            if n != name:
+                continue
+            prev_v = self.prev.get((n, labels))
+            if prev_v is None or v < prev_v:
+                continue
+            key = dict(labels).get(by, "")
+            rates[key] = rates.get(key, 0.0) + (v - prev_v) / dt
+        return rates
+
+    def frame(self) -> str:
+        now = time.time()
+        try:
+            cur = parse_metrics(fetch(self.url + "/metrics"))
+        except (urllib.error.URLError, OSError) as exc:
+            return f"kuipertop: {self.url} unreachable: {exc}"
+        mesh = fetch_json(self.url + "/diagnostics/mesh")
+        control = fetch_json(self.url + "/diagnostics/control")
+        dt = (now - self.prev_t) if self.prev_t else 0.0
+
+        lines = []
+        # ---- header
+        uptime = total(cur, "kuiper_uptime_seconds")
+        storms = total(cur, "kuiper_xla_compile_storms_total")
+        serve_miss = total(cur, "kuiper_aot_serve_misses_total")
+        adm = {d.get("decision", ""): int(v)
+               for d, v in series(cur, "kuiper_admission_total")}
+        head = (f"kuipertop — {self.url}  up {uptime:.0f}s  "
+                f"admission a/r/q {adm.get('accept', 0)}/"
+                f"{adm.get('reject', 0)}/{adm.get('queue', 0)}  ")
+        head += (self._c("31", f"storms {storms:.0f}") if storms
+                 else "storms 0")
+        head += "  "
+        head += (self._c("31", f"aot-serve-miss {serve_miss:.0f}")
+                 if serve_miss else "aot-serve-miss 0")
+        lines.append(self._c("1", head))
+
+        # ---- per-rule table: rows/s (fold-stage delta), health, burn,
+        # shed, bottleneck
+        fold_rates = self._delta_rate(
+            cur, "kuiper_op_stage_rows_total", dt)
+        shed_rates = self._delta_rate(cur, "kuiper_shed_total", dt)
+        health = {dict_l.get("rule", ""): int(v)
+                  for dict_l, v in series(cur, "kuiper_rule_health")}
+        burn = {d.get("rule", ""): v
+                for d, v in series(cur, "kuiper_slo_burn_rate")
+                if d.get("window") == "fast"}
+        bn = {d.get("rule", ""): d.get("stage", "")
+              for d, v in series(cur, "kuiper_bottleneck_stage")}
+        rules = sorted(set(health) | set(fold_rates) | set(burn),
+                       key=lambda r: -fold_rates.get(r, 0.0))
+        lines.append(self._c(
+            "4", f"{'rule':<24}{'rows/s':>10}{'health':>11}"
+                 f"{'burn':>7}{'shed/s':>9}  bottleneck"))
+        for r in rules[:12]:
+            hv = health.get(r, 0)
+            hname = HEALTH_NAMES.get(hv, "?")
+            if hv and self.color:
+                hname = self._c("31" if hv == 2 else "33", hname)
+            stage = bn.get(r, "")
+            if stage == "shard_skew" and self.color:
+                stage = self._c("35", stage)
+            hw = 20 if self.color and hv else 11  # ANSI codes are 9 chars
+            lines.append(
+                f"{r[:23]:<24}{fmt_rate(fold_rates.get(r, 0.0)):>10}"
+                f"{hname:>{hw}}"
+                f"{burn.get(r, 0.0):>7.1f}"
+                f"{fmt_rate(shed_rates.get(r, 0.0)):>9}  {stage}")
+        if not rules:
+            lines.append("  (no rules reporting)")
+
+        # ---- mesh panel: shard bars + skew + collective split
+        skew = (mesh.get("skew") or {})
+        if skew:
+            lines.append(self._c("1", "mesh"))
+            for rule in sorted(skew):
+                e = skew[rule]
+                shards = e.get("shards") or []
+                peak = max((s.get("rows_per_s", 0.0) for s in shards),
+                           default=0.0) or 1.0
+                ratio = e.get("skew_ratio")
+                tag = f"skew {ratio:.2f}x" if ratio is not None else ""
+                if e.get("skewed"):
+                    tag = self._c("31", tag + " ⚠ rebalance")
+                lines.append(f"  {rule[:22]:<23} mesh {e.get('mesh', '')}"
+                             f"  {tag}")
+                for s in shards:
+                    mark = "←hot" if (e.get("skewed") and
+                                      s["shard"] == e.get("hot_shard")) \
+                        else ""
+                    lines.append(
+                        f"    shard {s['shard']:<2} "
+                        f"{bar(s.get('rows_per_s', 0.0) / peak)} "
+                        f"{fmt_rate(s.get('rows_per_s', 0.0)):>8}/s "
+                        f"keys {s.get('keys', 0):<6}{mark}")
+        hbm = sorted(series(cur, "kuiper_shard_hbm_committed_bytes"),
+                     key=lambda t: t[0].get("shard", ""))
+        if hbm:
+            peak_b = max((v for _, v in hbm), default=0.0) or 1.0
+            lines.append("  committed HBM per chip")
+            for d, v in hbm:
+                lines.append(f"    chip {d.get('shard', '?'):<3} "
+                             f"{bar(v / peak_b)} {v / 1e6:8.1f} MB")
+        coll = mesh.get("collective") or []
+        for c in coll[:6]:
+            lines.append(
+                f"  {c.get('op', ''):<28} collective "
+                f"{100.0 * c.get('share', 0.0):5.1f}% of "
+                f"{c.get('device_us', 0.0) / 1e3:.1f}ms sampled device "
+                f"time ({c.get('samples', 0)} samples)")
+        hints = ((control.get("mesh") or {})
+                 .get("rebalance_hints_total", 0))
+        if hints:
+            lines.append(self._c("33", f"  rebalance hints: {hints}"))
+
+        # ---- timeline footer
+        segs = total(cur, "kuiper_timeline_segments")
+        tl_bytes = total(cur, "kuiper_timeline_bytes")
+        if segs:
+            lines.append(
+                f"timeline: {segs:.0f} segments, "
+                f"{tl_bytes / 1024:.0f} KB on disk "
+                f"(GET /diagnostics/timeline)")
+        self.prev, self.prev_t = cur, now
+        return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default="http://127.0.0.1:9081")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="paint one frame and exit")
+    ap.add_argument("--no-color", action="store_true")
+    args = ap.parse_args(argv)
+    con = Console(args.url, color=not args.no_color and
+                  sys.stdout.isatty())
+    if args.once:
+        print(con.frame())
+        return 0
+    try:
+        while True:
+            frame = con.frame()
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""probe_fleetobs — tier-1 smoke for the fleet observatory
+(observability/meshwatch.py + timeline.py, docs/OBSERVABILITY.md).
+
+Runs on the 8-virtual-device CPU mesh (same recipe as tests/conftest.py
+and probe_multichip) and asserts BOTH signal directions:
+
+  1. skewed workload: one hot key hogging a row shard drives
+     `kuiper_mesh_skew_ratio` above the threshold, the health plane
+     attributes the bottleneck to `shard_skew` naming the hot shard,
+     and after `up_ticks` consecutive skewed observations the QoS
+     controller raises ONE structured `rebalance_hint` flight event;
+  2. uniform workload (negative control): skew stays under threshold,
+     no `shard_skew` verdict, no hint — the signal must not cry wolf;
+  3. collective split: the sharded fold sites carry a
+     collective-vs-compute estimate bounded by sampled device time;
+  4. durable timeline: snapshots + mirrored events land on disk,
+     survive a hard kill (fresh Timeline over the same dir), replay
+     through query filters, and byte-cap retention actually deletes;
+  5. prometheus: all kuiper_mesh_* / kuiper_timeline_* families render.
+
+Run directly or through tools/ci_gate.py (gate name `probe_fleetobs`).
+Exit 0 on success.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+SQL = ("SELECT deviceId, sum(v) AS s, count(*) AS c "
+       "FROM demo GROUP BY deviceId, TUMBLINGWINDOW(ss, 10)")
+
+
+def _force_devices(n: int = 8) -> None:
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    _force_devices(8)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import types
+
+    import numpy as np
+
+    from ekuiper_tpu.data.batch import ColumnBatch
+    from ekuiper_tpu.observability import health, kernwatch, meshwatch
+    from ekuiper_tpu.observability import timeline as tmod
+    from ekuiper_tpu.ops.aggspec import extract_kernel_plan
+    from ekuiper_tpu.ops.emit import build_direct_emit
+    from ekuiper_tpu.parallel.mesh import make_mesh
+    from ekuiper_tpu.runtime import control
+    from ekuiper_tpu.runtime.events import recorder
+    from ekuiper_tpu.runtime.nodes_fused import FusedWindowAggNode
+    from ekuiper_tpu.sql.parser import parse_select
+    from ekuiper_tpu.utils import timex
+    from ekuiper_tpu.utils.rulelog import set_rule_context
+
+    clock = timex.set_mock_clock(0)
+    problems = []
+    if len(jax.devices()) < 8:
+        print(json.dumps({"ok": False, "problems": [
+            f"only {len(jax.devices())} devices — the virtual-device "
+            "recipe did not engage"]}))
+        return 1
+    meshwatch.reset()
+    recorder().clear()
+    # sample EVERY kernel call: the probe feeds a couple of batches, the
+    # default 1-in-N hot-path cadence would leave the split empty
+    prior_sampling = kernwatch.set_sampling(hot=1, boundary=1)
+    stmt = parse_select(SQL)
+    plan = extract_kernel_plan(stmt)
+    assert plan is not None
+
+    def mk(rule_id):
+        # rule context BEFORE construction: the shard registry label and
+        # the kernwatch sample label must agree for the collective split
+        set_rule_context(rule_id)
+        try:
+            n = FusedWindowAggNode(
+                rule_id, stmt.window, extract_kernel_plan(stmt),
+                [d.expr for d in stmt.dimensions],
+                capacity=64, micro_batch=128, prefinalize_lead_ms=0,
+                direct_emit=build_direct_emit(stmt, plan, ["deviceId"]),
+                emit_columnar=False, mesh=make_mesh(rows=2, keys=4))
+            n.state = n.gb.init_state()
+            n.emit = lambda item, count=None: None
+        finally:
+            set_rule_context(None)
+        return n
+
+    def feed(node, rule_id, ids):
+        ids = np.array(ids, dtype=np.object_)
+        b = ColumnBatch(
+            n=len(ids),
+            columns={"deviceId": ids,
+                     "v": np.ones(len(ids), np.float64)},
+            timestamps=np.zeros(len(ids), np.int64), emitter="demo")
+        set_rule_context(rule_id)
+        try:
+            node.process(b)
+        finally:
+            set_rule_context(None)
+
+    # ---- 1+2. skewed vs uniform workloads through real sharded kernels
+    skew_node = mk("r_skew")
+    uni_node = mk("r_uniform")
+    # 80% of rows on ONE key -> one row shard runs hot
+    feed(skew_node, "r_skew", ["hotdev"] * 800
+         + [f"dev{i}" for i in range(200)])
+    # uniform: 1000 rows over 200 keys spread across the hash space
+    feed(uni_node, "r_uniform", [f"dev{i % 200}" for i in range(1000)])
+    clock.advance(1000)
+
+    # health + control over stub topos: meshwatch reads the shard
+    # registry directly, so the verdict path only needs the rule ids
+    stub = types.SimpleNamespace()
+    triples = [("r_skew", stub, {}), ("r_uniform", stub, {})]
+    hv = health.install(lambda: triples, start=False)
+    ctl = control.install(lambda: triples, start=False,
+                          verdicts_fn=lambda: hv.verdicts())
+    try:
+        for _ in range(ctl.up_ticks):
+            hv.tick()
+            ctl.tick()
+            clock.advance(1000)
+        verdicts = hv.verdicts()
+        vs = verdicts.get("r_skew") or {}
+        mesh_s = (vs.get("bottleneck") or {}).get("mesh") or {}
+        if not mesh_s.get("skewed"):
+            problems.append(f"skewed rule not flagged: {mesh_s}")
+        if (vs.get("bottleneck") or {}).get("stage") != "shard_skew":
+            problems.append("skewed rule verdict stage != shard_skew: "
+                            f"{(vs.get('bottleneck') or {}).get('stage')}")
+        ratio = meshwatch.rule_skew("r_skew").get("skew_ratio") or 0.0
+        if ratio < meshwatch.skew_threshold():
+            problems.append(f"skew_ratio {ratio:.2f} under threshold")
+        vu = verdicts.get("r_uniform") or {}
+        mesh_u = (vu.get("bottleneck") or {}).get("mesh") or {}
+        if mesh_u.get("skewed"):
+            problems.append(f"uniform rule falsely flagged: {mesh_u}")
+        if (vu.get("bottleneck") or {}).get("stage") == "shard_skew":
+            problems.append("uniform rule verdict stage is shard_skew")
+        hints = recorder().events(kind="rebalance_hint")
+        skew_hints = [e for e in hints if e.get("rule") == "r_skew"]
+        if len(skew_hints) != 1:
+            problems.append(f"expected exactly 1 rebalance_hint for "
+                            f"r_skew, got {len(skew_hints)}")
+        elif skew_hints[0].get("hot_shard") is None \
+                or not skew_hints[0].get("skew_ratio"):
+            problems.append(f"hint missing attribution: {skew_hints[0]}")
+        if any(e.get("rule") == "r_uniform" for e in hints):
+            problems.append("rebalance_hint raised for the uniform rule")
+        md = ctl.diagnostics().get("mesh") or {}
+        if md.get("rebalance_hints_total") != 1:
+            problems.append(f"controller hint counter: {md}")
+    finally:
+        control.reset()
+        health.reset()
+
+    # ---- 3. collective-vs-compute split on the sharded fold sites
+    split = meshwatch.collective_split()
+    fold_sites = {k: v for k, v in split.items() if "fold" in k[0]}
+    if not fold_sites:
+        problems.append(f"no sharded fold sites in the split: "
+                        f"{sorted(k[0] for k in split)}")
+    for (op, label), v in fold_sites.items():
+        if not (0.0 <= v["collective_us"] <= v["device_us"]):
+            problems.append(f"collective estimate unbounded at {op}: {v}")
+        if v["bytes_per_fold"] <= 0:
+            problems.append(f"no collective payload priced at {op}")
+
+    # ---- 4. durable timeline: snapshot/mirror, hard kill, retention
+    tdir = tempfile.mkdtemp(prefix="fleetobs_tl_")
+    try:
+        beat = [0]
+
+        def scrape():
+            beat[0] += 1
+            return (f"kuiper_probe_beat {beat[0]}\n"
+                    'kuiper_probe_static{rule="r_skew"} 7\n')
+
+        tl = tmod.Timeline(scrape, base_dir=tdir, interval_ms=0)
+        tl.snapshot()
+        tl.note_event({"kind": "rebalance_hint", "rule": "r_skew",
+                       "ts_ms": timex.now_ms()})
+        clock.advance(1000)
+        tl.snapshot()
+        tl.dying_gasp()
+        # hard kill: a FRESH instance over the same dir must resume the
+        # segment sequence and replay everything already on disk
+        tl2 = tmod.Timeline(scrape, base_dir=tdir, interval_ms=0)
+        q = tl2.query(family="kuiper_probe_beat")
+        if q["returned"] < 2:
+            problems.append(f"timeline replay after hard kill: {q}")
+        qe = tl2.query(family="events", rule="r_skew")
+        if not any(r["kind"] == "event" for r in q["records"]) and \
+                not qe["returned"]:
+            problems.append("mirrored event lost across hard kill")
+        tl2.snapshot()  # must append past the old tail, not clobber it
+        if tl2.query(family="kuiper_probe_beat")["returned"] < 3:
+            problems.append("post-recovery snapshot did not append")
+        # byte-cap retention: shrink the caps and write until the ring
+        # must delete its oldest segments
+        tl2.seg_bytes = 512
+        tl2.max_bytes = 2048
+        for _ in range(200):
+            clock.advance(100)
+            tl2.snapshot()
+        st = tl2.stats()
+        if st["bytes"] > tl2.max_bytes + tl2.seg_bytes:
+            problems.append(f"retention over cap: {st}")
+        if st["segments"] < 2:
+            problems.append(f"rotation never split segments: {st}")
+        if tl2.query(family="kuiper_probe_beat")["returned"] == 0:
+            problems.append("retention deleted the live tail")
+    finally:
+        shutil.rmtree(tdir, ignore_errors=True)
+
+    # ---- 5. the new families must render
+    out, rendered = [], ""
+    meshwatch.render_prometheus(out, lambda s: str(s))
+    tmod.render_prometheus(out, lambda s: str(s))
+    rendered = "\n".join(out)
+    for fam in ("kuiper_mesh_skew_ratio", "kuiper_mesh_shard_rows_per_s",
+                "kuiper_mesh_collective_ms", "kuiper_mesh_collective_share"):
+        if fam not in rendered:
+            problems.append(f"{fam} did not render")
+
+    kernwatch.set_sampling(**prior_sampling)
+    report = {
+        "ok": not problems,
+        "problems": problems,
+        "devices": len(jax.devices()),
+        "skew_ratio": round(
+            meshwatch.rule_skew("r_skew").get("skew_ratio") or 0.0, 3),
+        "uniform_ratio": round(
+            meshwatch.rule_skew("r_uniform").get("skew_ratio") or 0.0, 3),
+        "threshold": meshwatch.skew_threshold(),
+        "fold_sites": sorted(k[0] for k in fold_sites),
+    }
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
